@@ -1,0 +1,984 @@
+"""The ``Accelerator``: prepare / train-step / gather / checkpoint engine.
+
+Reference analogue: src/accelerate/accelerator.py (4015 LoC, class at :184).
+The contract preserved: a user writes a plain training loop, calls
+``prepare()`` once, and gets sharding + mixed precision + grad accumulation +
+checkpointing + tracking for free. What changes is *how*: the reference
+dispatches to per-strategy wrapper branches (DDP/FSDP/DeepSpeed/Megatron,
+accelerator.py:1447-2285); here ``prepare`` lays parameters out on one mesh
+with ``NamedSharding``s and the whole hot loop (forward/backward/allreduce/
+optimizer — reference call stack §3.4) becomes **one jitted function** with
+gradient accumulation folded in as a branchless on-device buffer.
+
+Two ways to drive training:
+
+* **fast path** — ``step = accelerator.build_train_step(loss_fn)``; call
+  ``step(batch)`` per dataloader batch. One XLA program per step; grad sync
+  is an XLA-inserted reduction over the batch axes.
+* **imperative parity path** — ``accumulate()`` / ``backward(loss_fn,
+  batch)`` / ``optimizer.step()`` / ``clip_grad_norm_`` mirror the
+  reference's eager API; each piece is itself jit-cached so the cost over
+  the fast path is only the python between calls.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+import os
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .data_loader import BaseDataLoader, prepare_data_loader, skip_first_batches as _skip_first_batches
+from .logging import get_logger
+from .modeling import Model, as_model
+from .optimizer import AcceleratedOptimizer
+from .parallel.mesh import MeshConfig, batch_sharding, data_parallel_size, replicated
+from .parallel.sharding import fsdp_rules_for, infer_shardings
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import (
+    AutocastKwargs,
+    DataLoaderConfiguration,
+    DistributedInitKwargs,
+    DistributedType,
+    GradientAccumulationPlugin,
+    GradScalerKwargs,
+    MixedPrecisionPolicy,
+    ParallelismPlugin,
+    PrecisionType,
+    ProfileKwargs,
+    ProjectConfiguration,
+)
+from .utils.operations import convert_to_fp32, gather, gather_object, pad_across_processes, reduce, send_to_device
+
+logger = get_logger(__name__)
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Accelerator:
+    """(reference: accelerator.py:184)."""
+
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: Optional[str] = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: Optional[DataLoaderConfiguration] = None,
+        log_with=None,
+        project_dir: Optional[str] = None,
+        project_config: Optional[ProjectConfiguration] = None,
+        gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        parallelism_plugin: Optional[ParallelismPlugin] = None,
+        rng_types: Optional[list] = None,
+        kwargs_handlers: Optional[list] = None,
+        step_scheduler_with_optimizer: bool = True,
+    ):
+        # kwargs handlers (reference: accelerator.py:415-452)
+        self.autocast_handler = AutocastKwargs()
+        self.scaler_handler = GradScalerKwargs()
+        self.profile_handler = ProfileKwargs()
+        self.init_handler = DistributedInitKwargs()
+        for handler in kwargs_handlers or []:
+            if isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
+            elif isinstance(handler, GradScalerKwargs):
+                self.scaler_handler = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_handler = handler
+            elif isinstance(handler, DistributedInitKwargs):
+                self.init_handler = handler
+
+        if gradient_accumulation_plugin is None:
+            env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=env_steps)
+        elif gradient_accumulation_steps != 1:
+            raise ValueError("Pass either gradient_accumulation_steps or a GradientAccumulationPlugin, not both")
+
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        init_kwargs = {}
+        if self.init_handler.coordinator_address is not None:
+            init_kwargs = dict(
+                coordinator_address=self.init_handler.coordinator_address,
+                num_processes=self.init_handler.num_processes,
+                process_id=self.init_handler.process_id,
+                local_device_ids=self.init_handler.local_device_ids,
+            )
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision,
+            cpu=cpu,
+            parallelism_plugin=parallelism_plugin,
+            _from_accelerator=True,
+            **init_kwargs,
+        )
+        self.gradient_state = GradientState(gradient_accumulation_plugin)
+        self.device_placement = device_placement
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.rng_types = rng_types or ["numpy", "python"]
+
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(split_batches=split_batches)
+        if split_batches:
+            self.dataloader_config.split_batches = True
+
+        # registries (reference keeps the same lists: accelerator.py:520-540)
+        self._models: list[Model] = []
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list[AcceleratedScheduler] = []
+        self._dataloaders: list[BaseDataLoader] = []
+        self._custom_objects: list = []
+        self._save_model_hooks: list = []
+        self._load_model_hooks: list = []
+
+        # imperative-path machinery — gradient buffers are per-model
+        # (multi-model setups like GANs must not share one buffer)
+        self.step = 0
+        self._grad_buffers: dict[int, Any] = {}
+        self._grad_count = 0
+        self._clip_max_norm = None
+        self._last_grad_norm = None
+        self._jit_cache: dict = {}
+        self._trigger_flag = False
+
+        # fp16 dynamic loss scale (host-side; bf16 needs none of this —
+        # reference scaler: accelerator.py:551-604)
+        self._loss_scale = self.scaler_handler.init_scale if self.mixed_precision == "fp16" else 1.0
+        self._scale_growth_tracker = 0
+
+        self.trackers: list = []
+        self._log_with = log_with
+
+        self.flag_tensor = None
+
+    # ------------------------------------------------------------------ #
+    # topology / state passthroughs (reference: accelerator.py:600-1030)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def mesh(self):
+        return self.state.mesh
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def distributed_type(self) -> DistributedType:
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self) -> int:
+        return self.state.num_processes
+
+    @property
+    def process_index(self) -> int:
+        return self.state.process_index
+
+    @property
+    def local_process_index(self) -> int:
+        return self.state.local_process_index
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.state.is_last_process
+
+    @property
+    def mixed_precision(self) -> str:
+        return self.state.mixed_precision
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.state.use_distributed
+
+    @property
+    def num_data_shards(self) -> int:
+        return data_parallel_size(self.mesh)
+
+    @property
+    def sync_gradients(self) -> bool:
+        return self.gradient_state.sync_gradients
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value: int):
+        self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    @property
+    def save_iteration(self):
+        return self.project_configuration.iteration
+
+    def print(self, *args, **kwargs):
+        self.state.print(*args, **kwargs)
+
+    def wait_for_everyone(self):
+        self.state.wait_for_everyone()
+
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        return self.state.split_between_processes(inputs, apply_padding=apply_padding)
+
+    def on_main_process(self, function):
+        return self.state.on_main_process(function)
+
+    def on_local_main_process(self, function):
+        return self.state.on_local_main_process(function)
+
+    def on_process(self, function=None, process_index=None):
+        return self.state.on_process(function, process_index)
+
+    def on_last_process(self, function):
+        return self.state.on_last_process(function)
+
+    def main_process_first(self):
+        return self.state.main_process_first()
+
+    def local_main_process_first(self):
+        return self.state.local_main_process_first()
+
+    # ------------------------------------------------------------------ #
+    # prepare (reference: accelerator.py:1316)
+    # ------------------------------------------------------------------ #
+
+    def _is_model_like(self, obj) -> bool:
+        if isinstance(obj, Model):
+            return True
+        if self._is_optimizer_like(obj):  # optax tx is itself a 2-tuple
+            return False
+        return isinstance(obj, tuple) and len(obj) == 2 and (hasattr(obj[0], "apply") or callable(obj[0]))
+
+    def _is_optimizer_like(self, obj) -> bool:
+        if isinstance(obj, AcceleratedOptimizer):
+            return True
+        return hasattr(obj, "init") and hasattr(obj, "update") and not hasattr(obj, "apply")
+
+    def _is_dataloader_like(self, obj) -> bool:
+        if isinstance(obj, BaseDataLoader):
+            return True
+        try:
+            import torch.utils.data as tud
+
+            if isinstance(obj, tud.DataLoader):
+                return True
+        except ImportError:
+            pass
+        return False
+
+    def prepare(self, *args, device_placement=None):
+        """Shard/wrap models, optimizers, dataloaders, schedulers; returns
+        them in the same order (reference: accelerator.py:1316).
+
+        Two-pass like the reference (scheduler after optimizer,
+        accelerator.py:1456-1459) so a scheduler can bind to its prepared
+        optimizer. Idempotent via the ``_is_accelerate_prepared`` marker
+        (reference: accelerator.py:1470-1475).
+        """
+        staged = {}
+        # models first (argument order must not matter: an optimizer passed
+        # before its model still binds to it), then optimizers/loaders,
+        # then schedulers — mirrors the reference's two-pass ordering.
+        for i, obj in enumerate(args):
+            if getattr(obj, "_is_accelerate_prepared", False):
+                staged[i] = obj
+            elif self._is_model_like(obj):
+                staged[i] = self.prepare_model(obj)
+        for i, obj in enumerate(args):
+            if i in staged:
+                continue
+            if self._is_optimizer_like(obj):
+                staged[i] = self.prepare_optimizer(obj)
+            elif (
+                self._is_dataloader_like(obj)
+                or hasattr(obj, "__iter__")
+                or (hasattr(obj, "__getitem__") and hasattr(obj, "__len__"))
+            ):
+                staged[i] = self.prepare_data_loader(obj)
+        for i, obj in enumerate(args):
+            if i in staged:
+                continue
+            staged[i] = self.prepare_scheduler(obj)
+        result = [staged[i] for i in range(len(args))]
+        return result[0] if len(result) == 1 else tuple(result)
+
+    def _sharding_rules_for(self, model: Model):
+        plugin = self.state.parallelism_plugin
+        if plugin.sharding_rules is not None:
+            return list(plugin.sharding_rules)
+        rules = list(model.sharding_rules or [])
+        if self.mesh.shape.get("fsdp", 1) > 1:
+            rules = rules + list(fsdp_rules_for(model.params, self.mesh))
+        return rules
+
+    def prepare_model(self, model, device_placement: Optional[bool] = None, evaluation_mode: bool = False) -> Model:
+        """(reference: accelerator.py:1549). Cast params to the fp32 master
+        dtype, compute per-param shardings from the layout rules, and
+        ``device_put`` — the DDP/FSDP/TP wrap branches (reference
+        :1647-1750) all reduce to the sharding choice."""
+        model = as_model(model)
+        if model._is_accelerate_prepared:
+            return model
+        jax = _jax()
+        jnp = _jnp()
+        if device_placement is None:
+            device_placement = self.device_placement
+
+        param_dtype = jnp.dtype(self.state.dtype_policy.param_dtype)
+
+        def cast(p):
+            if hasattr(p, "dtype") and jnp.issubdtype(np.asarray(p).dtype if not hasattr(p, "dtype") else p.dtype, jnp.floating):
+                return np.asarray(p, dtype=param_dtype) if isinstance(p, np.ndarray) else p.astype(param_dtype)
+            return p
+
+        params = jax.tree_util.tree_map(cast, model.params)
+        if device_placement:
+            rules = self._sharding_rules_for(model)
+            shardings = infer_shardings(params, rules, self.mesh)
+            params = jax.device_put(params, shardings)
+            model.param_shardings = shardings
+        model.params = params
+        model._is_accelerate_prepared = True
+        model.accelerator = self
+        if not evaluation_mode:
+            self._models.append(model)
+        return model
+
+    def prepare_optimizer(self, optimizer, device_placement: Optional[bool] = None) -> AcceleratedOptimizer:
+        """(reference: accelerator.py:2464). The optax state is created
+        *from sharded params* inside jit, so XLA propagates param layouts
+        into the optimizer moments — ZeRO/FSDP optimizer-state sharding
+        with no extra code (this replaces the reference's FSDP2
+        optimizer-param-swap dance, accelerator.py:1479-1547)."""
+        if isinstance(optimizer, AcceleratedOptimizer):
+            if not optimizer._is_accelerate_prepared:
+                optimizer._is_accelerate_prepared = True
+                optimizer.accelerator = self
+                self._optimizers.append(optimizer)
+            return optimizer
+        opt = AcceleratedOptimizer(optimizer, accelerator=self)
+        self._ensure_opt_state(opt)
+        opt._is_accelerate_prepared = True
+        self._optimizers.append(opt)
+        return opt
+
+    def _ensure_opt_state(self, opt: AcceleratedOptimizer, model: Optional[Model] = None):
+        """Bind the optimizer to a prepared model and init its (sharded)
+        state. Deferred when no model has been prepared yet, so argument
+        order in ``prepare()`` doesn't matter."""
+        if opt.opt_state is not None:
+            return
+        model = model or getattr(opt, "_model", None) or (self._models[-1] if self._models else None)
+        if model is None:
+            return
+        jax = _jax()
+        opt.opt_state = jax.jit(opt.optimizer.init)(model.params)
+        opt._model = model
+
+    def prepare_data_loader(self, data_loader, device_placement: Optional[bool] = None, slice_fn_for_dispatch=None):
+        if isinstance(data_loader, BaseDataLoader):
+            if data_loader not in self._dataloaders:
+                self._dataloaders.append(data_loader)
+            return data_loader
+        prepared = prepare_data_loader(
+            data_loader,
+            put_on_device=device_placement if device_placement is not None else self.device_placement,
+            data_loader_config=self.dataloader_config,
+            rng_types=self.rng_types,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    def prepare_scheduler(self, scheduler) -> AcceleratedScheduler:
+        if isinstance(scheduler, AcceleratedScheduler):
+            return scheduler
+        prepared = AcceleratedScheduler(
+            scheduler,
+            optimizers=self._optimizers,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.dataloader_config.split_batches,
+        )
+        prepared._is_accelerate_prepared = True
+        self._schedulers.append(prepared)
+        return prepared
+
+    # ------------------------------------------------------------------ #
+    # the jitted train step (fast path)
+    # ------------------------------------------------------------------ #
+
+    def _compute_cast(self, params):
+        """fp32 master -> compute dtype, keeping norm-like params in fp32
+        (the autocast policy; reference: accelerator.py:1590-1601)."""
+        jnp = _jnp()
+        jax = _jax()
+        compute = jnp.dtype(self.state.dtype_policy.compute_dtype)
+        if compute == jnp.float32 or not self.autocast_handler.enabled:
+            return params
+        from .parallel.sharding import path_str
+
+        keep = tuple(self.autocast_handler.keep_fp32_patterns)
+
+        def cast(kp, p):
+            if not hasattr(p, "dtype") or not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            path = path_str(kp).lower()
+            if any(pat in path for pat in keep):
+                return p
+            return p.astype(compute)
+
+        return jax.tree_util.tree_map_with_path(cast, params)
+
+    def build_train_step(
+        self,
+        loss_fn: Callable,
+        model: Optional[Model] = None,
+        optimizer: Optional[AcceleratedOptimizer] = None,
+        scheduler: Optional[AcceleratedScheduler] = None,
+        has_aux: bool = False,
+        donate: bool = True,
+    ) -> Callable:
+        """Build the single jitted train step (reference hot loop §3.4
+        collapsed into one XLA program).
+
+        ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)``). The
+        returned ``step(batch)`` mutates the prepared model/optimizer in
+        place (their pytrees are swapped each call) and returns the loss
+        (plus aux), keeping per-step python under a microsecond-scale
+        dispatch. Gradient accumulation runs as a branchless on-device
+        buffer: every call accumulates; on sync boundaries the update
+        applies and the buffer zeroes — ``1/accum``-weighted so the applied
+        gradient is the mean over microbatches.
+        """
+        jax = _jax()
+        jnp = _jnp()
+        model = model or self._models[-1]
+        optimizer = optimizer or (self._optimizers[-1] if self._optimizers else None)
+        if optimizer is None:
+            raise ValueError("prepare() an optimizer before building a train step")
+        self._ensure_opt_state(optimizer, model)
+        scheduler = scheduler or (self._schedulers[-1] if self._schedulers else None)
+        accum = self.gradient_accumulation_steps
+        clip_norm = self._clip_max_norm
+        use_fp16 = self.mixed_precision == "fp16"
+        compute_cast = self._compute_cast
+
+        def step_fn(params, opt_state, grad_buf, micro_step, batch, loss_scale):
+            def scaled_loss(p):
+                out = loss_fn(compute_cast(p), batch)
+                loss, aux = (out if has_aux else (out, None))
+                return loss.astype(jnp.float32) * loss_scale, (loss, aux)
+
+            grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / (loss_scale * accum), grads)
+            grad_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
+
+            is_sync = (micro_step + 1) % accum == 0
+
+            def apply(operand):
+                params, opt_state, grad_buf = operand
+                g = grad_buf
+                gnorm = optax_global_norm(g)
+                if clip_norm is not None:
+                    scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                    g = jax.tree_util.tree_map(lambda t: t * scale, g)
+                finite = jnp.isfinite(gnorm)
+
+                def do_update(_):
+                    updates, new_opt = optimizer.optimizer.update(g, opt_state, params)
+                    new_params = jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates)
+                    return new_params, new_opt
+
+                if use_fp16:
+                    new_params, new_opt = jax.lax.cond(
+                        finite, do_update, lambda _: (params, opt_state), operand=None
+                    )
+                else:
+                    new_params, new_opt = do_update(None)
+                zero_buf = jax.tree_util.tree_map(jnp.zeros_like, grad_buf)
+                return new_params, new_opt, zero_buf, gnorm, finite
+
+            def hold(operand):
+                params, opt_state, grad_buf = operand
+                return params, opt_state, grad_buf, jnp.float32(0.0), jnp.bool_(True)
+
+            if accum == 1:
+                new_params, new_opt, new_buf, gnorm, finite = apply((params, opt_state, grad_buf))
+            else:
+                new_params, new_opt, new_buf, gnorm, finite = jax.lax.cond(
+                    is_sync, apply, hold, (params, opt_state, grad_buf)
+                )
+            return new_params, new_opt, new_buf, micro_step + 1, loss, gnorm, finite, aux
+
+        donate_args = (0, 1, 2) if donate else ()
+        jitted = jax.jit(step_fn, donate_argnums=donate_args)
+
+        zeros_like_params = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p))
+        grad_buf = zeros_like_params(model.params)
+        micro_step = jnp.int32(0)
+
+        state_box = {"grad_buf": grad_buf, "micro_step": micro_step}
+
+        def step(batch):
+            nonlocal_state = state_box
+            self.gradient_state._set_sync_gradients((self.step + 1) % accum == 0)
+            new_params, new_opt, new_buf, new_micro, loss, gnorm, finite, aux = jitted(
+                model.params,
+                optimizer.opt_state,
+                nonlocal_state["grad_buf"],
+                nonlocal_state["micro_step"],
+                batch,
+                jnp.float32(self._loss_scale),
+            )
+            model.params = new_params
+            optimizer.opt_state = new_opt
+            nonlocal_state["grad_buf"] = new_buf
+            nonlocal_state["micro_step"] = new_micro
+            self.step += 1
+            self._last_grad_norm = gnorm
+            if self.sync_gradients:
+                if use_fp16:
+                    self._update_loss_scale(bool(finite))
+                    optimizer._step_was_skipped = not bool(finite)
+                if scheduler is not None:
+                    scheduler.step()
+            return (loss, aux) if has_aux else loss
+
+        step._jitted = jitted
+        return step
+
+    def _update_loss_scale(self, finite: bool):
+        h = self.scaler_handler
+        if not finite:
+            self._loss_scale = max(1.0, self._loss_scale * h.backoff_factor)
+            self._scale_growth_tracker = 0
+        else:
+            self._scale_growth_tracker += 1
+            if self._scale_growth_tracker >= h.growth_interval:
+                self._loss_scale *= h.growth_factor
+                self._scale_growth_tracker = 0
+
+    # ------------------------------------------------------------------ #
+    # imperative parity path (reference: accumulate/backward/step §3.4)
+    # ------------------------------------------------------------------ #
+
+    def _do_sync(self):
+        """(reference: accelerator.py:1123-1131)."""
+        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+            self.step = 0
+            self.gradient_state._set_sync_gradients(True)
+        else:
+            self.step += 1
+            sync = (self.step % self.gradient_accumulation_steps) == 0
+            sync = sync or self.gradient_state.plugin_kwargs.get("sync_each_batch", False)
+            self.gradient_state._set_sync_gradients(sync)
+
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """(reference: accelerator.py:1149). Gradient-sync bookkeeping for
+        the imperative path: inside the context, ``backward`` accumulates;
+        ``optimizer.step()`` applies only on sync boundaries."""
+        self._do_sync()
+        yield
+
+    @contextlib.contextmanager
+    def no_sync(self, model=None):
+        """(reference: accelerator.py:1033). Forces accumulation-only for
+        the body. On TPU there is no DDP hook to disable — the flag simply
+        gates the buffered apply."""
+        old = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(False)
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(old)
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):
+        """(reference: accelerator.py:1194). Uneven batches never reach the
+        step on TPU (padding+mask in the dataloader), so this is a
+        compatibility context that optionally overrides ``even_batches``."""
+        loaders = [dl for dl in self._dataloaders if hasattr(dl, "even_batches")]
+        old = [dl.even_batches for dl in loaders]
+        if even_batches is not None:
+            for dl in loaders:
+                dl.even_batches = even_batches
+        try:
+            yield
+        finally:
+            for dl, val in zip(loaders, old):
+                dl.even_batches = val
+
+    def backward(self, loss_fn: Callable, batch=None, model: Optional[Model] = None, **kwargs):
+        """Imperative gradient computation + accumulation
+        (reference: accelerator.py:2549).
+
+        JAX cannot differentiate an already-computed loss value, so the
+        imperative contract takes the *loss function* plus the batch:
+        ``accelerator.backward(loss_fn, batch)`` computes
+        ``grad(loss_fn)(params, batch)``, scales by
+        ``1/gradient_accumulation_steps`` (reference :2571), and adds into
+        the on-device gradient buffer.
+        """
+        jax = _jax()
+        jnp = _jnp()
+        model = model or self._models[-1]
+        accum = self.gradient_accumulation_steps
+        cache_key = (id(loss_fn), id(model))
+        if cache_key not in self._jit_cache:
+            compute_cast = self._compute_cast
+
+            def grad_step(params, grad_buf, batch, loss_scale):
+                def scaled(p):
+                    loss = loss_fn(compute_cast(p), batch)
+                    return loss.astype(jnp.float32) * loss_scale, loss
+
+                grads, loss = jax.grad(scaled, has_aux=True)(params)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / (loss_scale * accum), grads)
+                new_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
+                return new_buf, loss
+
+            self._jit_cache[cache_key] = jax.jit(grad_step, donate_argnums=(1,))
+        if self._grad_buffers.get(id(model)) is None:
+            self._grad_buffers[id(model)] = jax.jit(
+                lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p)
+            )(model.params)
+        self._grad_buffers[id(model)], loss = self._jit_cache[cache_key](
+            model.params, self._grad_buffers[id(model)], batch, jnp.float32(self._loss_scale)
+        )
+        self._grad_count += 1
+        return loss
+
+    def _buffer_for(self, model: Optional[Model] = None):
+        """The gradient buffer for ``model`` (default: the single active
+        buffer, or the last prepared model's)."""
+        if model is not None:
+            return id(model), self._grad_buffers.get(id(model))
+        if len(self._grad_buffers) == 1:
+            return next(iter(self._grad_buffers.items()))
+        if self._models:
+            mid = id(self._models[-1])
+            return mid, self._grad_buffers.get(mid)
+        return None, None
+
+    def _zero_grad_buffer(self, model: Optional[Model] = None):
+        jax = _jax()
+        jnp = _jnp()
+        keys = [id(model)] if model is not None else list(self._grad_buffers)
+        for k in keys:
+            if self._grad_buffers.get(k) is not None:
+                self._grad_buffers[k] = jax.tree_util.tree_map(lambda x: jnp.zeros_like(x), self._grad_buffers[k])
+        self._grad_count = 0
+
+    def _apply_accumulated_gradients(self, opt: AcceleratedOptimizer) -> bool:
+        """Apply the imperative-path gradient buffer through the optimizer.
+        Returns False when skipped (non-finite, fp16)."""
+        jax = _jax()
+        jnp = _jnp()
+        model = getattr(opt, "_model", None) or self._models[-1]
+        self._ensure_opt_state(opt, model)
+        _, grad_buffer = self._buffer_for(model)
+        if grad_buffer is None:
+            return True
+        cache_key = ("apply", id(opt), self._clip_max_norm)
+        if cache_key not in self._jit_cache:
+            clip_norm = self._clip_max_norm
+            use_fp16 = self.mixed_precision == "fp16"
+
+            def apply_fn(params, opt_state, grad_buf):
+                g = grad_buf
+                gnorm = optax_global_norm(g)
+                if clip_norm is not None:
+                    scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                    g = jax.tree_util.tree_map(lambda t: t * scale, g)
+                finite = jnp.isfinite(gnorm)
+
+                def do(_):
+                    updates, new_opt = opt.optimizer.update(g, opt_state, params)
+                    return (
+                        jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates),
+                        new_opt,
+                    )
+
+                if use_fp16:
+                    new_params, new_opt = jax.lax.cond(finite, do, lambda _: (params, opt_state), operand=None)
+                else:
+                    new_params, new_opt = do(None)
+                zero = jax.tree_util.tree_map(jnp.zeros_like, grad_buf)
+                return new_params, new_opt, zero, gnorm, finite
+
+            self._jit_cache[cache_key] = jax.jit(apply_fn, donate_argnums=(0, 1, 2))
+        new_params, new_opt, zero_buf, gnorm, finite = self._jit_cache[cache_key](
+            model.params, opt.opt_state, grad_buffer
+        )
+        model.params = new_params
+        opt.opt_state = new_opt
+        self._grad_buffers[id(model)] = zero_buf
+        self._grad_count = 0
+        self._last_grad_norm = gnorm
+        ok = bool(finite)
+        if self.mixed_precision == "fp16":
+            self._update_loss_scale(ok)
+        return ok
+
+    def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
+        """(reference: accelerator.py:2677). Fast path: sets the norm used
+        inside the jitted step (rebuild the step to change it). Imperative
+        path: also clips the current buffer and returns its pre-clip norm."""
+        if norm_type != 2.0:
+            raise NotImplementedError("only the L2 global norm is supported on TPU")
+        rebuild = self._clip_max_norm != max_norm
+        self._clip_max_norm = max_norm
+        if rebuild:
+            self._jit_cache = {k: v for k, v in self._jit_cache.items() if not (isinstance(k, tuple) and k and k[0] == "apply")}
+        model = parameters if isinstance(parameters, Model) else None
+        key, buf = self._buffer_for(model)
+        if buf is not None:
+            jax = _jax()
+            gnorm = optax_global_norm(buf)
+            scale = _jnp().minimum(1.0, max_norm / (gnorm + 1e-6))
+            self._grad_buffers[key] = jax.tree_util.tree_map(lambda t: t * scale, buf)
+            self._last_grad_norm = gnorm
+            return gnorm
+        return self._last_grad_norm
+
+    def clip_grad_value_(self, parameters, clip_value: float):
+        """(reference: accelerator.py:2754)."""
+        model = parameters if isinstance(parameters, Model) else None
+        key, buf = self._buffer_for(model)
+        if buf is not None:
+            jax = _jax()
+            jnp = _jnp()
+            self._grad_buffers[key] = jax.tree_util.tree_map(lambda t: jnp.clip(t, -clip_value, clip_value), buf)
+
+    # ------------------------------------------------------------------ #
+    # metrics / gathering (reference: accelerator.py:2799-2871)
+    # ------------------------------------------------------------------ #
+
+    def gather(self, tensor):
+        return gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather + drop the duplicated tail of the final uneven batch
+        (reference: accelerator.py:2799; remainder from
+        data_loader.py:365-405)."""
+        if use_gather_object or not _has_array_leaves(input_data):
+            data = gather_object(input_data if isinstance(input_data, list) else [input_data])
+        else:
+            data = gather(input_data)
+        if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+            rem = self.gradient_state.remainder
+
+            def trunc(x):
+                return x[:rem] if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 else x
+
+            import jax
+
+            return jax.tree_util.tree_map(trunc, data)
+        return data
+
+    def reduce(self, tensor, reduction: str = "mean", scale: float = 1.0):
+        return reduce(tensor, reduction, scale)
+
+    def pad_across_processes(self, tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+        return pad_across_processes(tensor, dim, pad_index, pad_first)
+
+    # ------------------------------------------------------------------ #
+    # precision helpers
+    # ------------------------------------------------------------------ #
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler: Optional[AutocastKwargs] = None):
+        """(reference: accelerator.py:3832). Compute-dtype casting is baked
+        into the jitted step (``_compute_cast``); this context exists for
+        API parity and temporarily overrides the policy for code that calls
+        :meth:`cast_to_compute`."""
+        old = self.autocast_handler
+        if autocast_handler is not None:
+            self.autocast_handler = autocast_handler
+        try:
+            yield
+        finally:
+            self.autocast_handler = old
+
+    def cast_to_compute(self, tree):
+        return self._compute_cast(tree)
+
+    # ------------------------------------------------------------------ #
+    # triggers (reference: accelerator.py:2583-2640)
+    # ------------------------------------------------------------------ #
+
+    def set_trigger(self):
+        self._trigger_flag = True
+
+    def check_trigger(self) -> bool:
+        flags = gather_object([self._trigger_flag])
+        fired = any(flags)
+        if fired:
+            self._trigger_flag = False
+        return fired
+
+    # ------------------------------------------------------------------ #
+    # model export / unwrap
+    # ------------------------------------------------------------------ #
+
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        """(reference: accelerator.py:2744 via utils/other.py:217). Models
+        are never wrapped on TPU; returns as-is."""
+        return model
+
+    def free_memory(self, *objects):
+        """(reference: accelerator.py:3633)."""
+        self._models.clear()
+        self._optimizers.clear()
+        self._schedulers.clear()
+        self._dataloaders.clear()
+        self._grad_buffers.clear()
+        self._jit_cache.clear()
+        self.step = 0
+        from .utils.memory import release_memory
+
+        return release_memory(*objects)
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    # ------------------------------------------------------------------ #
+    # checkpointing (reference: accelerator.py:3308/3474)
+    # ------------------------------------------------------------------ #
+
+    def register_for_checkpointing(self, *objects):
+        """(reference: accelerator.py:3795)."""
+        invalid = [o for o in objects if not (hasattr(o, "state_dict") and hasattr(o, "load_state_dict"))]
+        if invalid:
+            raise ValueError(f"Objects must expose state_dict/load_state_dict: {invalid}")
+        self._custom_objects.extend(objects)
+
+    def register_save_state_pre_hook(self, hook):
+        self._save_model_hooks.append(hook)
+        return _RemovableHandle(self._save_model_hooks, hook)
+
+    def register_load_state_pre_hook(self, hook):
+        self._load_model_hooks.append(hook)
+        return _RemovableHandle(self._load_model_hooks, hook)
+
+    def save_state(self, output_dir: Optional[str] = None, **save_model_func_kwargs):
+        from .checkpointing import save_accelerator_state
+
+        return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+
+    def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        return load_accelerator_state(self, input_dir, **load_model_func_kwargs)
+
+    def save_model(self, model, save_directory: str, max_shard_size="10GB", safe_serialization: bool = True):
+        from .checkpointing import save_model as _save_model
+
+        return _save_model(model, save_directory, max_shard_size=max_shard_size, safe_serialization=safe_serialization)
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        """(reference: accelerator.py:3929)."""
+        return _skip_first_batches(dataloader, num_batches)
+
+    # ------------------------------------------------------------------ #
+    # tracking (reference: accelerator.py:3002-3114)
+    # ------------------------------------------------------------------ #
+
+    def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: dict = {}):
+        from .tracking import filter_trackers
+
+        self.trackers = filter_trackers(self._log_with, self.logging_dir, project_name, config, init_kwargs)
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(f"{name} is not an active tracker: {[t.name for t in self.trackers]}")
+
+    def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = {}):
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
+
+    def end_training(self):
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.finish()
+        self.wait_for_everyone()
+
+    # ------------------------------------------------------------------ #
+    # profiling (reference: accelerator.py:3859)
+    # ------------------------------------------------------------------ #
+
+    @contextlib.contextmanager
+    def profile(self, profile_handler: Optional[ProfileKwargs] = None):
+        handler = profile_handler or self.profile_handler
+        import jax
+
+        trace_dir = handler.output_trace_dir or os.path.join(self.logging_dir or ".", "profile")
+        jax.profiler.start_trace(trace_dir, create_perfetto_trace=handler.create_perfetto_trace)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            if handler.on_trace_ready is not None:
+                handler.on_trace_ready(trace_dir)
+
+    def __repr__(self):
+        return f"Accelerator(mesh={dict(self.mesh.shape)}, mixed_precision={self.mixed_precision!r})"
+
+
+class _RemovableHandle:
+    def __init__(self, hooks_list, hook):
+        self._list = hooks_list
+        self._hook = hook
+
+    def remove(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+def optax_global_norm(tree):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def _has_array_leaves(data) -> bool:
+    import jax
+
+    return any(hasattr(l, "shape") for l in jax.tree_util.tree_leaves(data))

@@ -1,0 +1,295 @@
+"""Checkpoint save/load for full training state.
+
+Reference analogue: src/accelerate/checkpointing.py (330 LoC —
+``save_accelerator_state`` :61, ``load_accelerator_state`` :179, custom
+objects :313) plus the ``Accelerator.save_state``/``load_state`` drivers
+(accelerator.py:3308/3474) and ``save_model`` export (:3165).
+
+On-disk layout per checkpoint directory (logical contents match the
+reference: model weights, optimizer, scheduler, sampler positions, RNG
+state, step counter, custom objects):
+
+```
+checkpoint_dir/
+  model_0/            # orbax sharded pytree (each host writes its shards)
+  optimizer_0/        # orbax sharded pytree
+  scheduler_0.json
+  sampler_0.json
+  custom_checkpoint_0.pkl
+  rng_state_0.pkl     # per-process host RNG (reference: per-rank RNG :152)
+  accelerate_state.json
+```
+
+Sharded arrays are saved/restored with orbax (async-capable, multi-host
+aware: every host writes only its addressable shards — the TPU-native
+equivalent of FSDP's sharded DCP state dicts, reference:
+utils/fsdp_utils.py:101-412). ``save_model`` exports a consolidated
+safetensors file set with ``max_shard_size`` splitting like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import re
+import shutil
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from .logging import get_logger
+
+logger = get_logger(__name__)
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+RNG_STATE_NAME = "rng_state"
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _save_pytree(tree, path: Path):
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path.absolute(), tree, force=True)
+
+
+def _load_pytree(path: Path, like):
+    """Restore with the target's shardings/dtypes (reshard-on-load)."""
+    import orbax.checkpoint as ocp
+    import jax
+
+    def to_abstract(x):
+        if hasattr(x, "sharding"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "shape"):
+            return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        return x
+
+    abstract = jax.tree_util.tree_map(to_abstract, like)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path.absolute(), abstract)
+
+
+def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True):
+    """(reference: Accelerator.save_state accelerator.py:3308 +
+    checkpointing.save_accelerator_state :61)."""
+    from .state import GradientState
+
+    project = accelerator.project_configuration
+    if project.automatic_checkpoint_naming:
+        base = os.path.join(accelerator.project_dir or ".", "checkpoints")
+        output_dir = os.path.join(base, f"checkpoint_{project.iteration}")
+        # total_limit pruning (reference: accelerator.py:3350-3365)
+        if accelerator.is_main_process and project.total_limit is not None and os.path.isdir(base):
+            existing = sorted(
+                (d for d in os.listdir(base) if d.startswith("checkpoint_")),
+                key=lambda d: int(d.split("_")[-1]),
+            )
+            while len(existing) + 1 > project.total_limit:
+                victim = existing.pop(0)
+                shutil.rmtree(os.path.join(base, victim), ignore_errors=True)
+    if output_dir is None:
+        raise ValueError("output_dir is required unless automatic_checkpoint_naming is enabled")
+    out = Path(output_dir)
+    if accelerator.is_main_process:
+        out.mkdir(parents=True, exist_ok=True)
+    accelerator.wait_for_everyone()
+
+    for hook in accelerator._save_model_hooks:
+        hook(accelerator._models, [], str(out))
+
+    # models + optimizers: sharded orbax saves (every host participates)
+    for i, model in enumerate(accelerator._models):
+        _save_pytree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME)
+    for i, opt in enumerate(accelerator._optimizers):
+        if opt.opt_state is not None:
+            _save_pytree(opt.opt_state, out / f"{OPTIMIZER_NAME}_{i}" if i > 0 else out / OPTIMIZER_NAME)
+
+    if accelerator.is_main_process:
+        for i, sched in enumerate(accelerator._schedulers):
+            (out / f"{SCHEDULER_NAME}_{i}.json").write_text(json.dumps(sched.state_dict()))
+        # dataloader/sampler positions (reference: checkpointing.py:128-143)
+        samplers = []
+        for dl in accelerator._dataloaders:
+            samplers.append(
+                {
+                    "iteration": getattr(dl, "iteration", 0),
+                    "batch_size": getattr(dl, "batch_size", None),
+                    "sampler_epoch": getattr(getattr(dl, "sampler", None), "epoch", None),
+                    "sampler_seed": getattr(getattr(dl, "sampler", None), "seed", None),
+                }
+            )
+        (out / "samplers.json").write_text(json.dumps(samplers))
+        for i, obj in enumerate(accelerator._custom_objects):
+            with open(out / f"custom_checkpoint_{i}.pkl", "wb") as f:
+                pickle.dump(obj.state_dict(), f)
+        meta = {
+            "step": accelerator.step,
+            "save_iteration": project.iteration,
+            "loss_scale": accelerator._loss_scale,
+            "mixed_precision": accelerator.mixed_precision,
+        }
+        (out / "accelerate_state.json").write_text(json.dumps(meta))
+
+    # per-process host RNG (reference: checkpointing.py:152-175)
+    from .utils.random import get_seed
+
+    rng_states = {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+        "seed": get_seed(),
+    }
+    with open(out / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl", "wb") as f:
+        pickle.dump(rng_states, f)
+
+    project.iteration += 1
+    accelerator.wait_for_everyone()
+    logger.info(f"Saved accelerator state to {out}")
+    return str(out)
+
+
+def load_accelerator_state(accelerator, input_dir: str, **kwargs):
+    """(reference: Accelerator.load_state accelerator.py:3474 +
+    checkpointing.load_accelerator_state :179). Restores onto the *current*
+    shardings — loading a checkpoint saved on a different mesh reshards
+    transparently (reference needs FULL_STATE_DICT / merge tooling)."""
+    inp = Path(input_dir)
+    if not inp.is_dir():
+        raise FileNotFoundError(f"checkpoint directory {input_dir} not found")
+
+    for hook in accelerator._load_model_hooks:
+        hook(accelerator._models, str(inp))
+
+    for i, model in enumerate(accelerator._models):
+        path = inp / (f"{MODEL_NAME}_{i}" if i > 0 else MODEL_NAME)
+        model.params = _load_pytree(path, model.params)
+    for i, opt in enumerate(accelerator._optimizers):
+        path = inp / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
+        if path.exists() and opt.opt_state is not None:
+            opt.opt_state = _load_pytree(path, opt.opt_state)
+    for i, sched in enumerate(accelerator._schedulers):
+        path = inp / f"{SCHEDULER_NAME}_{i}.json"
+        if path.exists():
+            sched.load_state_dict(json.loads(path.read_text()))
+    samplers_path = inp / "samplers.json"
+    if samplers_path.exists():
+        saved = json.loads(samplers_path.read_text())
+        for dl, s in zip(accelerator._dataloaders, saved):
+            if s.get("iteration") is not None:
+                dl.iteration = s["iteration"]
+            sampler = getattr(dl, "sampler", None)
+            if sampler is not None and s.get("sampler_epoch") is not None:
+                sampler.set_epoch(s["sampler_epoch"])
+    for i, obj in enumerate(accelerator._custom_objects):
+        path = inp / f"custom_checkpoint_{i}.pkl"
+        if path.exists():
+            with open(path, "rb") as f:
+                obj.load_state_dict(pickle.load(f))
+    meta_path = inp / "accelerate_state.json"
+    if meta_path.exists():
+        meta = json.loads(meta_path.read_text())
+        accelerator.step = meta.get("step", 0)
+        accelerator._loss_scale = meta.get("loss_scale", accelerator._loss_scale)
+    rng_path = inp / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"
+    if rng_path.exists():
+        with open(rng_path, "rb") as f:
+            rng_states = pickle.load(f)
+        random.setstate(rng_states["python"])
+        np.random.set_state(rng_states["numpy"])
+        if rng_states.get("seed") is not None:
+            from .utils.random import set_seed
+
+            set_seed(rng_states["seed"])
+    logger.info(f"Loaded accelerator state from {inp}")
+
+
+def _parse_size(size) -> int:
+    if isinstance(size, int):
+        return size
+    m = re.fullmatch(r"(\d+)\s*([KMGT]?B)", str(size).strip(), re.IGNORECASE)
+    if not m:
+        raise ValueError(f"cannot parse shard size {size!r}")
+    mult = {"B": 1, "KB": 2**10, "MB": 2**20, "GB": 2**30, "TB": 2**40}[m.group(2).upper()]
+    return int(m.group(1)) * mult
+
+
+def save_model(model, save_directory: str, max_shard_size="10GB", safe_serialization: bool = True):
+    """Standalone consolidated weight export with shard splitting
+    (reference: Accelerator.save_model accelerator.py:3165). Writes
+    ``model.safetensors`` or an indexed shard set."""
+    from .modeling import as_model
+
+    model = as_model(model) if not hasattr(model, "state_dict") else model
+    state = model.state_dict()  # host numpy, fully gathered
+    os.makedirs(save_directory, exist_ok=True)
+    limit = _parse_size(max_shard_size)
+
+    shards, current, current_bytes = [], {}, 0
+    for key, arr in state.items():
+        nbytes = arr.nbytes
+        if current and current_bytes + nbytes > limit:
+            shards.append(current)
+            current, current_bytes = {}, 0
+        current[key] = arr
+        current_bytes += nbytes
+    if current:
+        shards.append(current)
+
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        if len(shards) == 1:
+            save_file(shards[0], os.path.join(save_directory, "model.safetensors"))
+        else:
+            index = {"metadata": {"total_size": sum(a.nbytes for a in state.values())}, "weight_map": {}}
+            for i, shard in enumerate(shards, 1):
+                name = f"model-{i:05d}-of-{len(shards):05d}.safetensors"
+                save_file(shard, os.path.join(save_directory, name))
+                for k in shard:
+                    index["weight_map"][k] = name
+            with open(os.path.join(save_directory, "model.safetensors.index.json"), "w") as f:
+                json.dump(index, f, indent=2)
+    else:
+        with open(os.path.join(save_directory, "model.pkl"), "wb") as f:
+            pickle.dump(state, f)
+    return save_directory
+
+
+def load_model(model, path: str):
+    """Load a ``save_model`` export back into a Model (reshards onto the
+    model's current layout)."""
+    state = {}
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    single_path = os.path.join(path, "model.safetensors")
+    if os.path.exists(index_path):
+        from safetensors.numpy import load_file
+
+        index = json.loads(Path(index_path).read_text())
+        for shard_name in sorted(set(index["weight_map"].values())):
+            state.update(load_file(os.path.join(path, shard_name)))
+    elif os.path.exists(single_path):
+        from safetensors.numpy import load_file
+
+        state = load_file(single_path)
+    else:
+        with open(os.path.join(path, "model.pkl"), "rb") as f:
+            state = pickle.load(f)
+    model.load_state_dict(state)
+    return model

@@ -1,0 +1,559 @@
+"""Data loading: host-local reads assembled into *global* sharded arrays.
+
+Reference analogue: src/accelerate/data_loader.py (1447 LoC). The reference
+has two sharding modes — shard-the-sampler (``DataLoaderShard`` :500 +
+``BatchSamplerShard`` :110) and dispatch-from-rank-0 (``DataLoaderDispatcher``
+:704) — plus an XLA wrapper (``MpDeviceLoaderWrapper`` :654). Here both modes
+produce the same thing: a pytree of **global ``jax.Array``s whose batch dim
+is sharded over the mesh batch axes** (``data``×``fsdp``), built with
+``jax.make_array_from_process_local_data``. A jitted step consumes them with
+zero re-layout.
+
+Key behaviors preserved (and their reference anchors):
+
+* per-shard ``batch_size`` semantics and ``split_batches``
+  (data_loader.py:996 ``prepare_data_loader`` args);
+* seedable, cross-process-identical shuffling (``SeedableRandomSampler``
+  :73) via a seed+epoch-derived ``numpy`` Generator — every host computes
+  the same permutation, no RNG broadcast needed;
+* fetch-ahead-one iteration so ``end_of_dataloader``/``remainder`` are set
+  *before* the last batch is yielded (:558-592, :365-405);
+* ``even_batches`` wrap-around padding of the final batch with
+  ``GradientState.remainder`` bookkeeping driving ``gather_for_metrics``
+  truncation (:878-916);
+* ``skip_first_batches`` for checkpoint resume (:1371).
+
+Static-shape note (TPU-specific): uneven final batches are *padded, never
+ragged* — a ragged batch would retrigger XLA compilation. ``even_batches=
+False`` pads to the next multiple of the data-shard count instead of going
+ragged, with the mask carried by ``remainder``.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from .logging import get_logger
+from .state import GradientState
+from .utils.dataclasses import DataLoaderConfiguration
+from .utils.random import synchronize_rng_states
+
+logger = get_logger(__name__)
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _to_numpy(x):
+    if hasattr(x, "detach"):  # torch tensor (optional interop)
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def default_collate(samples: list) -> Any:
+    """Stack a list of samples into a batch pytree of numpy arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+    return np.stack([_to_numpy(s) for s in samples])
+
+
+class SeedableRandomSampler:
+    """Cross-process reproducible permutation sampler
+    (reference: data_loader.py:73). The permutation is a pure function of
+    ``seed + epoch`` so every host computes the same order."""
+
+    def __init__(self, data_source_len: int, seed: int = 0, epoch: int = 0):
+        self.data_source_len = data_source_len
+        self.seed = seed
+        self.epoch = epoch
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self.epoch)
+        yield from rng.permutation(self.data_source_len).tolist()
+
+    def __len__(self):
+        return self.data_source_len
+
+
+class SequentialSampler:
+    def __init__(self, data_source_len: int):
+        self.data_source_len = data_source_len
+
+    def set_epoch(self, epoch: int):
+        pass
+
+    def __iter__(self):
+        yield from range(self.data_source_len)
+
+    def __len__(self):
+        return self.data_source_len
+
+
+class BaseDataLoader:
+    """Shared bookkeeping: GradientState registration, remainder tracking,
+    device placement of global batches."""
+
+    def __init__(
+        self,
+        *,
+        batch_sharding=None,
+        device_placement: bool = True,
+        rng_types: Optional[list] = None,
+        generator=None,
+        prefetch_size: int = 2,
+    ):
+        self.gradient_state = GradientState()
+        self.batch_sharding_ = batch_sharding
+        self.device_placement = device_placement
+        self.rng_types = rng_types
+        self.generator = generator
+        self.prefetch_size = max(1, prefetch_size)
+        self.end_of_dataloader = False
+        self.remainder = -1
+        self.iteration = 0
+        self.skip_batches = 0
+        self._is_accelerate_prepared = True
+
+    def _mesh_sharding(self):
+        if self.batch_sharding_ is not None:
+            return self.batch_sharding_
+        from .state import AcceleratorState
+
+        state = AcceleratorState._shared_state
+        if state.get("_initialized") and state.get("mesh") is not None:
+            from .parallel.mesh import batch_sharding
+
+            self.batch_sharding_ = batch_sharding(state["mesh"])
+        return self.batch_sharding_
+
+    def _num_shards(self) -> int:
+        sharding = self._mesh_sharding()
+        if sharding is None:
+            return 1
+        from .parallel.mesh import data_parallel_size
+
+        return data_parallel_size(sharding.mesh)
+
+    def _place(self, host_batch):
+        """per-host numpy batch -> global sharded jax.Array pytree."""
+        if not self.device_placement:
+            return host_batch
+        sharding = self._mesh_sharding()
+        jax = _jax()
+        if sharding is None:
+            return jax.device_put(host_batch)
+
+        def make(x):
+            x = _to_numpy(x)
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree_util.tree_map(make, host_batch)
+
+    def begin(self):
+        """(reference: data_loader.py:365) reset + register with GradientState."""
+        self.end_of_dataloader = False
+        self.remainder = -1
+        self.gradient_state._add_dataloader(self)
+
+    def end(self):
+        self.gradient_state._remove_dataloader(self)
+
+    def set_epoch(self, epoch: int):
+        self.iteration = epoch
+        if hasattr(self, "sampler") and hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
+        if hasattr(self, "dataset") and hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+
+class DataLoaderShard(BaseDataLoader):
+    """Map-style loader: every host samples the same global index order and
+    reads only the rows destined for its local devices
+    (reference: data_loader.py:500 + BatchSamplerShard :110).
+
+    ``batch_size`` is per data-shard (matching the reference's per-process
+    meaning); the global batch is ``batch_size * num_data_shards`` unless
+    ``split_batches``.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        seed: int = 0,
+        collate_fn: Optional[Callable] = None,
+        drop_last: bool = False,
+        even_batches: bool = True,
+        split_batches: bool = False,
+        sampler=None,
+        rng_types: Optional[list] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_last = drop_last
+        self.even_batches = even_batches
+        self.split_batches = split_batches
+        self.rng_types = rng_types
+        if sampler is None:
+            sampler = SeedableRandomSampler(len(dataset), seed=seed) if shuffle else SequentialSampler(len(dataset))
+        self.sampler = sampler
+
+    @property
+    def total_batch_size(self) -> int:
+        """Global batch size (reference: data_loader.py:612)."""
+        n = self._num_shards()
+        return self.batch_size if self.split_batches else self.batch_size * n
+
+    @property
+    def total_dataset_length(self) -> int:
+        return len(self.dataset)
+
+    def __len__(self):
+        g = self.total_batch_size
+        n = len(self.dataset) - self.skip_batches * g
+        if self.drop_last:
+            return max(0, n // g)
+        return max(0, math.ceil(n / g))
+
+    def _global_index_batches(self):
+        indices = list(self.sampler)
+        g = self.total_batch_size
+        start = self.skip_batches * g
+        for i in range(start, len(indices), g):
+            chunk = indices[i : i + g]
+            if len(chunk) < g:
+                if self.drop_last:
+                    return
+                n_real = len(chunk)
+                if self.even_batches:
+                    # wrap-around pad to the full global batch
+                    # (reference: data_loader.py:878-916)
+                    while len(chunk) < g:
+                        chunk += indices[: g - len(chunk)]
+                else:
+                    # pad minimally to a multiple of the shard count —
+                    # never ragged (static shapes; see module docstring)
+                    n = self._num_shards()
+                    target = math.ceil(len(chunk) / n) * n
+                    while len(chunk) < target:
+                        chunk += indices[: target - len(chunk)]
+                yield chunk, n_real
+                return
+            yield chunk, len(chunk)
+
+    def _local_rows(self, index_batch: list) -> list:
+        jax = _jax()
+        pc, pi = jax.process_count(), jax.process_index()
+        if pc == 1:
+            return index_batch
+        rows = len(index_batch) // pc
+        return index_batch[pi * rows : (pi + 1) * rows]
+
+    def _load(self, index_batch: list):
+        samples = [self.dataset[i] for i in self._local_rows(index_batch)]
+        return self.collate_fn(samples)
+
+    def __iter__(self):
+        if self.rng_types is not None:
+            synchronize_rng_states(self.rng_types, self.generator)
+        self.begin()
+        try:
+            # Prefetch window: device transfers (device_put is async) are
+            # scheduled ``prefetch_size`` batches ahead, overlapping host
+            # collate with device compute. Fetch-ahead also guarantees
+            # end_of_dataloader/remainder are set *before* the final batch
+            # is yielded (reference :558-592).
+            window: deque = deque()
+            for idx_batch, n_real in self._global_index_batches():
+                window.append((self._place(self._load(idx_batch)), n_real, len(idx_batch)))
+                if len(window) > self.prefetch_size:
+                    yield window.popleft()[0]
+            while window:
+                batch, n_real, padded = window.popleft()
+                if not window:
+                    self.end_of_dataloader = True
+                    self.remainder = n_real if n_real != padded else -1
+                yield batch
+        finally:
+            self.skip_batches = 0
+            self.iteration += 1
+            if hasattr(self.sampler, "set_epoch"):
+                self.sampler.set_epoch(self.iteration)
+            self.end()
+
+
+class IterableDataLoaderShard(BaseDataLoader):
+    """Iterable-dataset variant (reference: IterableDatasetShard,
+    data_loader.py:266): stream samples, chunk into global batches; every
+    process must iterate the same stream (or the dataset shards itself by
+    ``jax.process_index()``)."""
+
+    def __init__(
+        self,
+        dataset: Iterable,
+        batch_size: int = 1,
+        collate_fn: Optional[Callable] = None,
+        drop_last: bool = False,
+        even_batches: bool = True,
+        split_batches: bool = False,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_last = drop_last
+        self.even_batches = even_batches
+        self.split_batches = split_batches
+
+    @property
+    def total_batch_size(self) -> int:
+        n = self._num_shards()
+        return self.batch_size if self.split_batches else self.batch_size * n
+
+    def _batched_samples(self):
+        jax = _jax()
+        pc, pi = jax.process_count(), jax.process_index()
+        g = self.total_batch_size
+        buf, first = [], []
+        skipped = 0
+        for sample in self.dataset:
+            buf.append(sample)
+            if len(first) < g:
+                first.append(sample)
+            if len(buf) == g:
+                if skipped < self.skip_batches:
+                    skipped += 1
+                    buf = []
+                    continue
+                local = buf[pi * (g // pc) : (pi + 1) * (g // pc)] if pc > 1 else buf
+                yield self.collate_fn(local), g
+                buf = []
+        if buf and not self.drop_last:
+            n_real = len(buf)
+            if self.even_batches:
+                target = g
+            else:
+                n = self._num_shards()
+                target = math.ceil(len(buf) / n) * n
+            i = 0
+            while len(buf) < target and first:
+                buf.append(first[i % len(first)])
+                i += 1
+            local = buf[pi * (target // pc) : (pi + 1) * (target // pc)] if pc > 1 else buf
+            yield self.collate_fn(local), n_real
+
+    def __iter__(self):
+        self.begin()
+        try:
+            window: deque = deque()
+            for host_batch, n_real in self._batched_samples():
+                window.append((self._place(host_batch), n_real))
+                if len(window) > self.prefetch_size:
+                    yield window.popleft()[0]
+            while window:
+                batch, n_real = window.popleft()
+                if not window:
+                    self.end_of_dataloader = True
+                    self.remainder = n_real if n_real != self.total_batch_size else -1
+                yield batch
+        finally:
+            self.skip_batches = 0
+            self.end()
+
+
+class DataLoaderDispatcher(BaseDataLoader):
+    """Dispatch mode: process 0 reads every batch and broadcasts it over DCN
+    (reference: data_loader.py:704, ``_fetch_batches`` :786-850). Useful when
+    the dataset is only reachable from one host."""
+
+    def __init__(self, inner: DataLoaderShard):
+        super().__init__(
+            batch_sharding=inner.batch_sharding_,
+            device_placement=inner.device_placement,
+            prefetch_size=inner.prefetch_size,
+        )
+        self.inner = inner
+        # the inner loader runs host-unsharded on process 0
+        self.inner.device_placement = False
+
+    @property
+    def total_batch_size(self) -> int:
+        return self.inner.total_batch_size
+
+    @property
+    def total_dataset_length(self) -> int:
+        return self.inner.total_dataset_length
+
+    def __len__(self):
+        return len(self.inner)
+
+    def set_epoch(self, epoch: int):
+        self.inner.set_epoch(epoch)
+
+    def __iter__(self):
+        from .utils.operations import broadcast_object_list
+
+        jax = _jax()
+        pc, pi = jax.process_count(), jax.process_index()
+        self.begin()
+        try:
+            if pc == 1:
+                for batch in self.inner:
+                    self.end_of_dataloader = self.inner.end_of_dataloader
+                    self.remainder = self.inner.remainder
+                    yield self._place(batch)
+                return
+            it = iter(self.inner) if pi == 0 else None
+            while True:
+                payload = [None]
+                if pi == 0:
+                    try:
+                        batch = next(it)
+                        payload = [(batch, self.inner.end_of_dataloader, self.inner.remainder)]
+                    except StopIteration:
+                        payload = [None]
+                broadcast_object_list(payload, from_process=0)
+                if payload[0] is None:
+                    return
+                full_batch, end, rem = payload[0]
+                self.end_of_dataloader = end
+                self.remainder = rem
+                # each process slices its rows, then assembles the global array
+                g = None
+
+                def slice_rows(x):
+                    rows = x.shape[0] // pc
+                    return x[pi * rows : (pi + 1) * rows]
+
+                local = jax.tree_util.tree_map(slice_rows, full_batch)
+                yield self._place(local)
+        finally:
+            self.end()
+
+
+def prepare_data_loader(
+    dataloader,
+    device=None,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+    split_batches: bool = False,
+    put_on_device: bool = True,
+    rng_types: Optional[list] = None,
+    dispatch_batches: Optional[bool] = None,
+    even_batches: bool = True,
+    use_seedable_sampler: bool = True,
+    seed: int = 0,
+    data_loader_config: Optional[DataLoaderConfiguration] = None,
+    batch_size: Optional[int] = None,
+    shuffle: bool = False,
+    collate_fn: Optional[Callable] = None,
+    drop_last: bool = False,
+):
+    """Coerce a data source into a sharded loader
+    (reference entry point: data_loader.py:996).
+
+    Accepts: an already-prepared loader (idempotent, reference
+    accelerator.py:1470-1475), a torch ``DataLoader`` (its dataset/batch
+    size/collate/drop_last are lifted — torch never runs on device), any
+    indexable dataset, or an iterable of samples.
+    """
+    if data_loader_config is not None:
+        split_batches = data_loader_config.split_batches
+        dispatch_batches = data_loader_config.dispatch_batches
+        even_batches = data_loader_config.even_batches
+        use_seedable_sampler = data_loader_config.use_seedable_sampler
+
+    if isinstance(dataloader, BaseDataLoader):
+        return dataloader
+
+    # torch DataLoader interop: unwrap to its dataset + settings
+    torch_loader = None
+    try:  # soft dependency
+        import torch.utils.data as tud
+
+        if isinstance(dataloader, tud.DataLoader):
+            torch_loader = dataloader
+    except ImportError:
+        pass
+
+    if torch_loader is not None:
+        dataset = torch_loader.dataset
+        batch_size = torch_loader.batch_size if batch_size is None else batch_size
+        drop_last = torch_loader.drop_last
+        import torch.utils.data as tud
+
+        shuffle = isinstance(getattr(torch_loader, "sampler", None), tud.RandomSampler)
+        if torch_loader.collate_fn is not None and torch_loader.collate_fn is not tud.dataloader.default_collate:
+            user_collate = torch_loader.collate_fn
+
+            def collate_fn(samples):  # run torch collate, convert to numpy
+                out = user_collate(samples)
+                return _jax().tree_util.tree_map(_to_numpy, out)
+
+        dataloader = dataset
+
+    if batch_size is None:
+        batch_size = 1
+
+    common = dict(
+        batch_size=batch_size,
+        collate_fn=collate_fn,
+        drop_last=drop_last,
+        even_batches=even_batches,
+        split_batches=split_batches,
+        device_placement=put_on_device,
+        prefetch_size=data_loader_config.prefetch_size if data_loader_config is not None else 2,
+    )
+
+    if hasattr(dataloader, "__len__") and hasattr(dataloader, "__getitem__"):
+        sampler = None
+        if shuffle and not use_seedable_sampler:
+            # draw one random seed but keep it identical on every host —
+            # shuffling must stay cross-process consistent even when the
+            # user opted out of the deterministic sampler
+            from .utils.operations import broadcast_object_list
+
+            random_seed = [int(np.random.randint(0, 2**31))]
+            broadcast_object_list(random_seed, from_process=0)
+            sampler = SeedableRandomSampler(len(dataloader), seed=random_seed[0])
+        loader = DataLoaderShard(
+            dataloader, shuffle=shuffle, seed=seed, sampler=sampler, rng_types=rng_types, **common
+        )
+    else:
+        loader = IterableDataLoaderShard(dataloader, **common)
+
+    if dispatch_batches:
+        if not isinstance(loader, DataLoaderShard):
+            raise ValueError("dispatch_batches requires a map-style dataset")
+        loader = DataLoaderDispatcher(loader)
+    return loader
+
+
+def skip_first_batches(dataloader, num_batches: int = 0):
+    """Resume mid-epoch: skip the first ``num_batches`` of the next
+    iteration (reference: data_loader.py:1371)."""
+    if isinstance(dataloader, DataLoaderDispatcher):
+        dataloader.inner.skip_batches = num_batches
+        return dataloader
+    if isinstance(dataloader, BaseDataLoader):
+        dataloader.skip_batches = num_batches
+        return dataloader
+    raise TypeError("skip_first_batches expects a loader returned by prepare()/prepare_data_loader()")

@@ -1,0 +1,127 @@
+"""The model container: an ``apply_fn`` paired with its parameter pytree.
+
+The reference mutates ``nn.Module``s in place (DDP wrap, autocast-wrap,
+``.to(device)`` — reference: src/accelerate/accelerator.py:1549-1750). JAX
+models are (function, pytree) pairs, so the prepared "model" object is this
+thin container: callable like the reference's wrapped module, but its
+parameters are an explicit, shardable pytree that ``Accelerator.prepare``
+lays out on the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+class Model:
+    """Pairs ``apply_fn(params, *args, **kwargs)`` with ``params``.
+
+    ``sharding_rules`` may carry model-provided ``(regex, PartitionSpec)``
+    rules (e.g. Megatron-style TP splits) consumed by
+    :meth:`Accelerator.prepare`.
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable,
+        params: Any,
+        *,
+        sharding_rules=None,
+        name: Optional[str] = None,
+        eval_apply_fn: Optional[Callable] = None,
+    ):
+        self.apply_fn = apply_fn
+        self.eval_apply_fn = eval_apply_fn or apply_fn
+        self.params = params
+        self.sharding_rules = sharding_rules
+        self.name = name or getattr(apply_fn, "__name__", "model")
+        self._is_accelerate_prepared = False  # reference marker: accelerator.py:1470
+        self.training = True
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_flax(cls, module, params: Any, *, sharding_rules=None, **apply_kwargs) -> "Model":
+        """Wrap a ``flax.linen.Module`` + params."""
+
+        def apply_fn(p, *args, **kwargs):
+            return module.apply({"params": p}, *args, **{**apply_kwargs, **kwargs})
+
+        m = cls(apply_fn, params, sharding_rules=sharding_rules, name=type(module).__name__)
+        m.module = module
+        return m
+
+    # -- behaviour ---------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        fn = self.apply_fn if self.training else self.eval_apply_fn
+        return fn(self.params, *args, **kwargs)
+
+    def eval(self) -> "Model":
+        self.training = False
+        return self
+
+    def train(self, mode: bool = True) -> "Model":
+        self.training = mode
+        return self
+
+    def num_parameters(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params) if hasattr(p, "shape"))
+
+    def parameter_bytes(self) -> int:
+        return sum(
+            int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+            for p in jax.tree_util.tree_leaves(self.params)
+            if hasattr(p, "shape")
+        )
+
+    def state_dict(self) -> Any:
+        """Flat ``{path: np.ndarray}`` view (for save/export)."""
+        flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        from .parallel.sharding import path_str
+
+        return {path_str(kp): np.asarray(jax.device_get(v)) for kp, v in flat}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        from .parallel.sharding import path_str
+
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        new_leaves = []
+        for kp, old in leaves_with_path:
+            key = path_str(kp)
+            if key not in state_dict:
+                raise KeyError(f"missing parameter {key!r} in state_dict")
+            new = np.asarray(state_dict[key])
+            if tuple(new.shape) != tuple(old.shape):
+                raise ValueError(f"shape mismatch for {key!r}: {new.shape} vs {old.shape}")
+            if hasattr(old, "sharding"):
+                new = jax.device_put(new.astype(old.dtype), old.sharding)
+            new_leaves.append(new)
+        self.params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    def __repr__(self) -> str:
+        return f"Model({self.name}, params={self.num_parameters():,})"
+
+
+def as_model(model) -> Model:
+    """Coerce supported inputs to :class:`Model`:
+
+    * a :class:`Model` — unchanged
+    * ``(flax_module, params)`` tuple
+    * ``(apply_fn, params)`` tuple
+    """
+    if isinstance(model, Model):
+        return model
+    if isinstance(model, tuple) and len(model) == 2:
+        head, params = model
+        if hasattr(head, "apply"):
+            return Model.from_flax(head, params)
+        if callable(head):
+            return Model(head, params)
+    raise TypeError(
+        f"Cannot interpret {type(model)} as a model. Pass an accelerate_tpu.Model, "
+        "a (flax_module, params) pair, or an (apply_fn, params) pair."
+    )

@@ -1,0 +1,103 @@
+"""Optimizer wrapper over an optax ``GradientTransformation``.
+
+Reference analogue: src/accelerate/optimizer.py (213 LoC,
+``AcceleratedOptimizer`` at :38). The reference's jobs — device-placed
+state, scaler-aware ``step`` with overflow skip detection
+(optimizer.py:145-181), manual XLA gradient all-reduce (:149-155) — map to:
+
+* optimizer state is an optax pytree created *from sharded params*, so ZeRO
+  optimizer-state sharding is automatic (state inherits param shardings, or
+  the ``data`` axis layout when ``shard_optimizer_state`` is on);
+* gradient sync needs no manual all-reduce: grads come out of a jitted step
+  already reduced by XLA;
+* fp16 overflow skipping is ``optax.apply_if_finite``-style masking inside
+  the step — ``step_was_skipped`` (reference :188) is read back from a flag.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class AcceleratedOptimizer:
+    """Wraps an ``optax.GradientTransformation``; holds (sharded) opt state.
+
+    Imperative use (API parity with the reference): after
+    ``Accelerator.backward`` has accumulated gradients, ``step()`` applies
+    them through a jitted update. The fast path (``build_train_step``)
+    bypasses these host-side calls entirely.
+    """
+
+    def __init__(self, optimizer, scaler=None, accelerator=None):
+        self.optimizer = optimizer  # optax.GradientTransformation
+        self.scaler = scaler
+        self.accelerator = accelerator
+        self.opt_state = None
+        self._is_accelerate_prepared = False
+        self._step_was_skipped = False
+        self._accumulated_steps = 0
+        from .state import AcceleratorState, GradientState
+
+        self.accelerator_state = AcceleratorState()
+        self.gradient_state = GradientState()
+
+    # -- optax plumbing ----------------------------------------------------
+
+    def init(self, params: Any, out_shardings=None):
+        """Create optimizer state. With ``out_shardings`` the state is
+        *born sharded* (jit with out_shardings) — no post-hoc re-layout."""
+        if out_shardings is not None:
+            self.opt_state = jax.jit(self.optimizer.init, out_shardings=out_shardings)(params)
+        else:
+            self.opt_state = self.optimizer.init(params)
+        return self.opt_state
+
+    def update(self, grads, params):
+        return self.optimizer.update(grads, self.opt_state, params)
+
+    # -- reference API surface --------------------------------------------
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """(reference: optimizer.py:188) True when the last ``step`` was
+        dropped due to non-finite gradients (fp16 overflow semantics)."""
+        return self._step_was_skipped
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Clear this optimizer's model's gradient buffer (imperative path)."""
+        if self.accelerator is not None:
+            self.accelerator._zero_grad_buffer(getattr(self, "_model", None))
+
+    def step(self, closure=None):
+        """Apply accumulated gradients (imperative path). No-op while inside
+        an accumulation window (reference gates this via GradScaler +
+        sync_gradients; here via GradientState.sync_gradients)."""
+        if self.accelerator is None:
+            raise RuntimeError("This optimizer was not prepared by an Accelerator.")
+        if not self.gradient_state.sync_gradients:
+            self._step_was_skipped = False
+            return
+        self._step_was_skipped = not self.accelerator._apply_accumulated_gradients(self)
+
+    def state_dict(self) -> dict:
+        """Host-side snapshot of optimizer state (for checkpointing)."""
+        leaves = jax.tree_util.tree_leaves(self.opt_state)
+        return {"leaves": [np.asarray(jax.device_get(l)) for l in leaves]}
+
+    def load_state_dict(self, state_dict: dict):
+        leaves, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        new = state_dict["leaves"]
+        if len(new) != len(leaves):
+            raise ValueError(f"optimizer state has {len(leaves)} leaves, checkpoint has {len(new)}")
+        placed = []
+        for old, arr in zip(leaves, new):
+            if hasattr(old, "sharding"):
+                arr = jax.device_put(np.asarray(arr).astype(old.dtype), old.sharding)
+            placed.append(arr)
+        self.opt_state = jax.tree_util.tree_unflatten(treedef, placed)
+
+    def __repr__(self):
+        return f"AcceleratedOptimizer({self.optimizer})"

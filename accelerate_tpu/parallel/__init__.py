@@ -1,0 +1,11 @@
+from .mesh import AXIS_NAMES, BATCH_AXES, MeshConfig, batch_sharding, data_parallel_size, replicated
+from .sharding import (
+    Rules,
+    fsdp_rules_for,
+    infer_shardings,
+    leaf_path_strings,
+    path_str,
+    shard_pytree,
+    spec_for_path,
+)
+from . import collectives

@@ -1,0 +1,58 @@
+"""In-program collectives over named mesh axes.
+
+The TPU-native replacement for the reference's NCCL/Gloo/oneCCL dispatch
+(reference: src/accelerate/utils/operations.py:300-351 and
+state.py:746-812): inside ``jit`` XLA *derives* collectives from shardings;
+when you drop to ``shard_map`` for explicit SPMD (ring attention, pipeline
+schedules, custom reductions) these thin wrappers are the vocabulary. They
+ride ICI when the mesh axis maps to intra-slice links and DCN otherwise —
+placement is XLA's job, the call site is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+
+def all_reduce_sum(x: Any, axis_name: str):
+    """NCCL all_reduce(SUM) analogue (reference consumes
+    torch.distributed.all_reduce; here: one psum over the named axis)."""
+    return jax.tree_util.tree_map(lambda t: lax.psum(t, axis_name), x)
+
+
+def all_reduce_mean(x: Any, axis_name: str):
+    return jax.tree_util.tree_map(lambda t: lax.pmean(t, axis_name), x)
+
+
+def all_gather(x: Any, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    """all_gather along a mesh axis (reference: operations.py:300
+    ``_gpu_gather``/``xm.all_gather``)."""
+    return jax.tree_util.tree_map(lambda t: lax.all_gather(t, axis_name, axis=axis, tiled=tiled), x)
+
+
+def reduce_scatter_sum(x: Any, axis_name: str, *, scatter_dimension: int = 0):
+    return jax.tree_util.tree_map(
+        lambda t: lax.psum_scatter(t, axis_name, scatter_dimension=scatter_dimension, tiled=True), x
+    )
+
+
+def ppermute_next(x: Any, axis_name: str, axis_size: int):
+    """Rotate values to the next rank on a ring (the building block of ring
+    attention and pipeline microbatch hand-off)."""
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return jax.tree_util.tree_map(lambda t: lax.ppermute(t, axis_name, perm), x)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def barrier_value(axis_name: str):
+    """A data-dependent barrier: psum of 1 (host barrier lives in
+    PartialState.wait_for_everyone)."""
+    import jax.numpy as jnp
+
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)

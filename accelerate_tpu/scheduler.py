@@ -1,0 +1,87 @@
+"""LR scheduler wrapper.
+
+Reference analogue: src/accelerate/scheduler.py (98 LoC): step the scheduler
+only when the optimizer actually stepped, and scale step count by
+``num_processes`` unless ``split_batches`` (scheduler.py:54-84).
+
+optax schedules are pure functions of the step counter, so "stepping" is
+advancing a counter; the skip/scale semantics live here and the jitted fast
+path reads ``schedule(step)`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+
+class AcceleratedScheduler:
+    """Wraps an optax schedule fn ``step -> lr`` (or any object exposing
+    ``step()``/``get_last_lr()``)."""
+
+    def __init__(
+        self,
+        scheduler: Union[Callable[[int], float], object],
+        optimizers=None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers] if optimizers else []
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.step_count = 0
+        self._is_accelerate_prepared = False
+        from .state import AcceleratorState, GradientState
+
+        self.gradient_state = GradientState()
+        self._num_data_shards = None
+
+    def _data_shards(self) -> int:
+        if self._num_data_shards is None:
+            from .state import AcceleratorState
+            from .parallel.mesh import data_parallel_size
+
+            state = AcceleratorState._shared_state
+            if state.get("_initialized") and state.get("mesh") is not None:
+                self._num_data_shards = data_parallel_size(state["mesh"])
+            else:
+                self._num_data_shards = 1
+        return self._num_data_shards
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            self._advance(1)
+            return
+        # only step when gradients were synced (reference: scheduler.py:62)
+        if not self.gradient_state.sync_gradients:
+            return
+        # skip when the optimizer skipped (fp16 overflow) — reference :69-75
+        for opt in self.optimizers:
+            if getattr(opt, "step_was_skipped", False):
+                return
+        # one optimizer step consumed num_data_shards batches worth of data
+        # (reference multiplies by num_processes, scheduler.py:78-84)
+        self._advance(1 if self.split_batches else self._data_shards())
+
+    def _advance(self, n: int):
+        self.step_count += n
+        if hasattr(self.scheduler, "step"):
+            for _ in range(n):
+                self.scheduler.step()
+
+    def get_last_lr(self):
+        if hasattr(self.scheduler, "get_last_lr"):
+            return self.scheduler.get_last_lr()
+        return [float(self.scheduler(self.step_count))]
+
+    def current_lr(self, step: Optional[int] = None) -> float:
+        s = self.step_count if step is None else step
+        if callable(self.scheduler):
+            return float(self.scheduler(s))
+        return self.get_last_lr()[0]
+
+    def state_dict(self) -> dict:
+        return {"step_count": self.step_count}
+
+    def load_state_dict(self, state_dict: dict):
+        self.step_count = int(state_dict["step_count"])

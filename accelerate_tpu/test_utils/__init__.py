@@ -1,0 +1,9 @@
+from .training import RegressionDataset, RegressionModel, linear_loss_fn
+from .testing import (
+    AccelerateTestCase,
+    TempDirTestCase,
+    execute_subprocess_async,
+    require_multi_device,
+    require_tpu,
+    skip,
+)

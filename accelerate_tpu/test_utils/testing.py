@@ -1,0 +1,97 @@
+"""Test harness utilities shipped in-package
+(reference: src/accelerate/test_utils/testing.py, 870 LoC — require_*
+decorators :151-585, AccelerateTestCase :639, TempDirTestCase :606,
+execute_subprocess_async :753)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import unittest
+from pathlib import Path
+
+from ..utils.imports import is_tpu_available
+
+
+def skip(reason="Test was skipped"):
+    import unittest
+
+    return unittest.skip(reason)
+
+
+def require_tpu(test_case):
+    """(reference: testing.py:346 require_tpu)."""
+    return unittest.skipUnless(is_tpu_available(), "test requires TPU")(test_case)
+
+
+def require_multi_device(test_case):
+    import jax
+
+    return unittest.skipUnless(len(jax.devices()) > 1, "test requires multiple devices")(test_case)
+
+
+def require_cpu_only(test_case):
+    import jax
+
+    return unittest.skipUnless(jax.default_backend() == "cpu", "test requires CPU backend")(test_case)
+
+
+class AccelerateTestCase(unittest.TestCase):
+    """Resets singleton state between tests (reference: testing.py:639-651)."""
+
+    def tearDown(self):
+        super().tearDown()
+        from ..state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+
+
+class TempDirTestCase(AccelerateTestCase):
+    """Class-scoped temp dir, wiped between tests (reference: testing.py:606)."""
+
+    clear_on_setup = True
+
+    @classmethod
+    def setUpClass(cls):
+        cls.tmpdir = Path(tempfile.mkdtemp())
+
+    @classmethod
+    def tearDownClass(cls):
+        if cls.tmpdir.exists():
+            shutil.rmtree(cls.tmpdir, ignore_errors=True)
+
+    def setUp(self):
+        super().setUp()
+        if self.clear_on_setup:
+            for path in self.tmpdir.glob("**/*"):
+                if path.is_file():
+                    path.unlink()
+                elif path.is_dir():
+                    shutil.rmtree(path, ignore_errors=True)
+
+
+def execute_subprocess_async(cmd: list, env=None, timeout: int = 600) -> "SubprocessResult":
+    """Run a command, stream+capture output, raise on failure
+    (reference: testing.py:753)."""
+    import subprocess
+
+    env = env if env is not None else os.environ.copy()
+    result = subprocess.run(
+        [str(c) for c in cmd], capture_output=True, text=True, env=env, timeout=timeout
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"command {' '.join(map(str, cmd))!r} failed (rc={result.returncode})\n"
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+    return result
+
+
+def get_launch_command(num_processes: int = 1) -> list:
+    """(reference: testing.py:110 DEFAULT_LAUNCH_COMMAND)."""
+    return [sys.executable, "-m", "accelerate_tpu.commands.launch", "--num_processes", str(num_processes)]

@@ -1,0 +1,42 @@
+"""Tiny fixtures for framework tests
+(reference: src/accelerate/test_utils/training.py — RegressionModel /
+RegressionDataset, a 1-parameter linear model used by every distributed
+correctness test)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..modeling import Model
+
+
+class RegressionDataset:
+    """y = a*x + b + noise (reference: test_utils/training.py RegressionDataset)."""
+
+    def __init__(self, a=2.0, b=3.0, length=64, seed=42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + rng.normal(scale=0.1, size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def regression_apply(params, x):
+    return params["a"] * x + params["b"]
+
+
+def RegressionModel(a=0.0, b=0.0) -> Model:
+    """(reference: test_utils/training.py RegressionModel — torch module with
+    scalar weight+bias; here an apply_fn + 2-leaf pytree)."""
+    params = {"a": np.float32(a), "b": np.float32(b)}
+    return Model(regression_apply, params, name="RegressionModel")
+
+
+def linear_loss_fn(params, batch):
+    pred = regression_apply(params, batch["x"])
+    return ((pred - batch["y"]) ** 2).mean()

@@ -1,0 +1,388 @@
+"""Experiment trackers.
+
+Reference analogue: src/accelerate/tracking.py (1326 LoC): ``GeneralTracker``
+ABC (:101-181, contract: ``name``/``requires_logging_directory``/``start``/
+``store_init_configuration``/``log``/``finish``, main-process gating via the
+``on_main_process`` decorator :77) + nine hosted-service integrations.
+
+The ABC and the TensorBoard/WandB/MLflow/Aim/CometML/ClearML trackers are
+kept (import-gated); a dependency-free ``JSONLTracker`` is the default so
+tracking works on a bare TPU VM.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.dataclasses import LoggerType
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """Method decorator: run only on the main process (reference:
+    tracking.py:77)."""
+
+    @functools.wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """(reference: tracking.py:101). Subclass contract: class attrs ``name``
+    and ``requires_logging_directory``; methods ``store_init_configuration``
+    and ``log``; optionally ``finish`` and a ``tracker`` property."""
+
+    main_process_only = True
+
+    def __init__(self, _blank: bool = False):
+        if not _blank:
+            for attr in ("name", "requires_logging_directory"):
+                if not hasattr(self, attr):
+                    raise NotImplementedError(f"Tracker subclass must define `{attr}`")
+
+    @property
+    def tracker(self):
+        raise NotImplementedError
+
+    def store_init_configuration(self, values: dict):
+        raise NotImplementedError
+
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        raise NotImplementedError
+
+    def finish(self):
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Dependency-free default: one JSON object per log call, appended to
+    ``{logging_dir}/{run_name}/metrics.jsonl``. No reference analogue —
+    exists so a bare TPU VM always has a tracker."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "metrics.jsonl")
+
+    @property
+    def tracker(self):
+        return self.path
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(os.path.join(self.dir, "config.json"), "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        record = {"_time": time.time()}
+        if step is not None:
+            record["_step"] = step
+        record.update(values)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record, default=float) + "\n")
+
+
+class TensorBoardTracker(GeneralTracker):
+    """(reference: tracking.py:182). Uses tensorboardX or
+    torch.utils.tensorboard, whichever is importable."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError:
+            from tensorboardX import SummaryWriter
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (int, float, str, bool))}, metric_dict={}
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """(reference: tracking.py:297)."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """(reference: tracking.py:705)."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        self.active_run = mlflow.start_run(run_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for chunk_start in range(0, len(values), 100):
+            mlflow.log_params(dict(list(values.items())[chunk_start : chunk_start + 100]))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import mlflow
+
+        mlflow.log_metrics({k: v for k, v in values.items() if isinstance(v, (int, float))}, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+class AimTracker(GeneralTracker):
+    """(reference: tracking.py:602)."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class CometMLTracker(GeneralTracker):
+    """(reference: tracking.py:508)."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from comet_ml import Experiment
+
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.end()
+
+
+class ClearMLTracker(GeneralTracker):
+    """(reference: tracking.py:912)."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                clearml_logger.report_single_value(name=k, value=v) if step is None else clearml_logger.report_scalar(
+                    title=k, series=k, value=v, iteration=step
+                )
+
+    @on_main_process
+    def finish(self):
+        self.task.close()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "aim": AimTracker,
+    "comet_ml": CometMLTracker,
+    "clearml": ClearMLTracker,
+}
+
+_AVAILABILITY = {
+    "jsonl": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "aim": is_aim_available,
+    "comet_ml": is_comet_ml_available,
+    "clearml": is_clearml_available,
+}
+
+
+def filter_trackers(log_with, logging_dir=None, project_name: str = "accelerate_tpu", config=None, init_kwargs=None):
+    """Resolve requested trackers to instantiated, available ones
+    (reference: tracking.py:1271 + Accelerator.init_trackers
+    accelerator.py:3002)."""
+    init_kwargs = init_kwargs or {}
+    if log_with is None:
+        requested = ["jsonl"]
+    elif not isinstance(log_with, (list, tuple)):
+        requested = [log_with]
+    else:
+        requested = list(log_with)
+
+    names = []
+    for item in requested:
+        if isinstance(item, GeneralTracker):
+            names.append(item)
+            continue
+        value = str(LoggerType(item) if not isinstance(item, LoggerType) else item)
+        if value == "all":
+            names.extend([n for n, avail in _AVAILABILITY.items() if avail()])
+        else:
+            names.append(value)
+
+    trackers = []
+    seen = set()
+    for item in names:
+        if isinstance(item, GeneralTracker):
+            trackers.append(item)
+            continue
+        if item in seen:
+            continue
+        seen.add(item)
+        if not _AVAILABILITY.get(item, lambda: False)():
+            logger.warning(f"Tracker {item!r} requested but its package is not installed; skipping.")
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[item]
+        kwargs = dict(init_kwargs.get(item, {}))
+        if cls.requires_logging_directory:
+            kwargs.setdefault("logging_dir", logging_dir or ".")
+        tracker = cls(project_name, **kwargs)
+        if config:
+            tracker.store_init_configuration(config)
+        trackers.append(tracker)
+    return trackers

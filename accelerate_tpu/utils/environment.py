@@ -1,0 +1,104 @@
+"""Environment parsing and manipulation helpers.
+
+Plays the role of the reference's ``utils/environment.py``
+(reference: src/accelerate/utils/environment.py:59-360): string->bool parsing,
+flag parsing from env, and context managers to clear/patch the process
+environment. CUDA/NUMA-specific helpers from the reference have no TPU
+meaning and are intentionally absent.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from contextlib import contextmanager
+
+_TRUE = {"1", "true", "yes", "on", "y", "t"}
+_FALSE = {"0", "false", "no", "off", "n", "f", ""}
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string to 1/0 (reference: utils/environment.py:59)."""
+    value = str(value).lower().strip()
+    if value in _TRUE:
+        return 1
+    if value in _FALSE:
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def get_int_from_env(env_keys, default: int) -> int:
+    """First set env var among ``env_keys`` parsed as int, else ``default``."""
+    for key in env_keys:
+        val = os.environ.get(key)
+        if val is not None and val != "":
+            return int(val)
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    """Parse a boolean flag from the environment (reference: utils/environment.py:83)."""
+    value = os.environ.get(key)
+    if value is None:
+        return default
+    return bool(str_to_bool(value))
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, default)
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Return the subset of ``library_names`` already imported in this process."""
+    import sys
+
+    return [name for name in library_names if name in sys.modules]
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily empty ``os.environ`` (reference: utils/environment.py:291)."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+@contextmanager
+def patch_environment(**kwargs):
+    """Temporarily set env vars; keys are upper-cased (reference: utils/environment.py:326)."""
+    saved = {}
+    missing = object()
+    for key, value in kwargs.items():
+        key = key.upper()
+        saved[key] = os.environ.get(key, missing)
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key, value in saved.items():
+            if value is missing:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def purge_accelerate_environment(func):
+    """Decorator: run ``func`` with all ``ACCELERATE_*`` env vars removed
+    (reference: utils/environment.py:362). Used by the test harness so state
+    leakage between tests cannot occur through the env-var protocol."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        saved = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")}
+        for k in saved:
+            del os.environ[k]
+        try:
+            return func(*args, **kwargs)
+        finally:
+            os.environ.update(saved)
+
+    return wrapper

@@ -1,0 +1,155 @@
+"""Availability probes for optional dependencies.
+
+Role of the reference's ``utils/imports.py`` (reference:
+src/accelerate/utils/imports.py:50-300): cheap, cached ``is_*_available()``
+checks that gate optional integrations (trackers, torch interop, datasets).
+The probe list is TPU-native: JAX-stack packages are the core, torch is an
+*optional* interop dependency (checkpoint import only), CUDA probes are gone.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.metadata
+import importlib.util
+
+
+@functools.lru_cache(maxsize=None)
+def _package_available(pkg_name: str) -> bool:
+    return importlib.util.find_spec(pkg_name) is not None
+
+
+def package_version(pkg_name: str) -> str | None:
+    try:
+        return importlib.metadata.version(pkg_name)
+    except importlib.metadata.PackageNotFoundError:
+        return None
+
+
+def is_jax_available() -> bool:
+    return _package_available("jax")
+
+
+def is_flax_available() -> bool:
+    return _package_available("flax")
+
+
+def is_optax_available() -> bool:
+    return _package_available("optax")
+
+
+def is_orbax_available() -> bool:
+    return _package_available("orbax")
+
+
+def is_chex_available() -> bool:
+    return _package_available("chex")
+
+
+def is_torch_available() -> bool:
+    return _package_available("torch")
+
+
+def is_safetensors_available() -> bool:
+    return _package_available("safetensors")
+
+
+def is_transformers_available() -> bool:
+    return _package_available("transformers")
+
+
+def is_datasets_available() -> bool:
+    return _package_available("datasets")
+
+
+def is_einops_available() -> bool:
+    return _package_available("einops")
+
+
+def is_numpy_available() -> bool:
+    return _package_available("numpy")
+
+
+def is_pandas_available() -> bool:
+    return _package_available("pandas")
+
+
+def is_rich_available() -> bool:
+    return _package_available("rich")
+
+
+def is_tqdm_available() -> bool:
+    return _package_available("tqdm")
+
+
+def is_psutil_available() -> bool:
+    return _package_available("psutil")
+
+
+# ---------------------------------------------------------------------------
+# Tracker probes (reference: utils/imports.py tracker section; tracking.py)
+# ---------------------------------------------------------------------------
+
+def is_tensorboard_available() -> bool:
+    return (
+        _package_available("tensorboardX")
+        or _package_available("tensorboard")
+        or _package_available("torch")  # torch ships torch.utils.tensorboard
+    )
+
+
+def is_wandb_available() -> bool:
+    return _package_available("wandb")
+
+
+def is_mlflow_available() -> bool:
+    return _package_available("mlflow")
+
+
+def is_comet_ml_available() -> bool:
+    return _package_available("comet_ml")
+
+
+def is_aim_available() -> bool:
+    return _package_available("aim")
+
+
+def is_clearml_available() -> bool:
+    return _package_available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return _package_available("dvclive")
+
+
+def is_swanlab_available() -> bool:
+    return _package_available("swanlab")
+
+
+def is_trackio_available() -> bool:
+    return _package_available("trackio")
+
+
+# ---------------------------------------------------------------------------
+# Hardware probes
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def is_tpu_available() -> bool:
+    """True when a real TPU backend is attached to this process."""
+    if not is_jax_available():
+        return False
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def is_multihost() -> bool:
+    if not is_jax_available():
+        return False
+    import jax
+
+    return jax.process_count() > 1

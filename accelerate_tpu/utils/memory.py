@@ -1,0 +1,114 @@
+"""Memory helpers: OOM-retry batch-size finder, cache clearing.
+
+Reference analogue: src/accelerate/utils/memory.py (find_executable_batch_size
+:119 — the reference's only automatic failure-recovery loop; release_memory
+:70; clear_device_cache :43). On TPU "OOM" is an XLA ``RESOURCE_EXHAUSTED``
+raised at compile or first execution, so the decorator catches that instead
+of torch's CUDA OOM strings.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Drop cached compiled executables + live buffers where possible
+    (reference: utils/memory.py:43)."""
+    if garbage_collection:
+        gc.collect()
+    import jax
+
+    jax.clear_caches()
+
+
+def release_memory(*objects):
+    """Del references and clear caches (reference: utils/memory.py:70).
+    Returns a None per input so callers can rebind."""
+    if len(objects) == 1 and isinstance(objects[0], list):
+        objects = objects[0]
+    objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    clear_device_cache()
+    return objects
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Heuristic OOM detection for XLA/TPU (reference: utils/memory.py:94
+    matches CUDA OOM strings)."""
+    statements = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Attempting to reserve",
+        "Ran out of memory",
+        "exceeds the maximum",
+        "HBM",
+    )
+    msg = str(exception)
+    return any(s in msg for s in statements)
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None, starting_batch_size: int = 128, reduce_batch_size_fn: Optional[Callable] = None
+):
+    """Decorator: call ``function(batch_size, *args)``; on OOM halve the
+    batch size and retry (reference: utils/memory.py:119-184)."""
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+    if reduce_batch_size_fn is None:
+        reduce_batch_size_fn = lambda bs: bs // 2
+
+    batch_size_box = {"value": starting_batch_size}
+
+    @functools.wraps(function)
+    def decorator(*args, **kwargs):
+        nonlocal batch_size_box
+        batch_size_box["value"] = starting_batch_size
+        params = list(inspect.signature(function).parameters.keys())
+        if not params or params[0] != "batch_size":
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument, but its signature "
+                f"is {params} — it must accept `batch_size` first."
+            )
+        while True:
+            if batch_size_box["value"] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size_box["value"], *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size_box["value"] = reduce_batch_size_fn(batch_size_box["value"])
+                else:
+                    raise
+
+    return decorator
+
+
+def get_device_memory_stats() -> dict:
+    """Per-device live/limit bytes where the backend exposes them."""
+    import jax
+
+    stats = {}
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats()
+        except Exception:
+            s = None
+        if s:
+            stats[str(d)] = {
+                "bytes_in_use": s.get("bytes_in_use"),
+                "bytes_limit": s.get("bytes_limit"),
+                "peak_bytes_in_use": s.get("peak_bytes_in_use"),
+            }
+    return stats

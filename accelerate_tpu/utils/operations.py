@@ -1,0 +1,344 @@
+"""Structure-preserving operations on pytrees of arrays + host-level
+collectives.
+
+Reference analogue: src/accelerate/utils/operations.py (866 LoC). Two big
+semantic shifts on TPU:
+
+* **In-program collectives don't live here.** Inside ``jit``, XLA inserts
+  ``psum``/``all_gather`` from shardings; explicit in-jit collectives are in
+  :mod:`accelerate_tpu.parallel.collectives` (for ``shard_map`` bodies).
+  This module is the *host-level* layer: cross-process gathers for metrics,
+  object broadcast, input padding — the reference's
+  ``gather``/``broadcast``/``reduce``/``pad_across_processes``
+  (operations.py:418-760) at the process boundary.
+
+* **"Per-process tensor" becomes "global array".** One JAX process drives
+  many chips and dataloaders hand out *global* ``jax.Array``s, so ``gather``
+  means "materialise the full value on host" (multihost: DCN allgather).
+
+The debug-mode operation verifier (reference: operations.py:363-395) is kept:
+with ``ACCELERATE_DEBUG_MODE=1`` every collective first gathers per-process
+shapes and raises :class:`DistributedOperationException` with a per-process
+report on mismatch.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Callable
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class DistributedOperationException(Exception):
+    """Raised by debug-mode verification when per-process inputs mismatch
+    (reference: utils/operations.py DistributedOperationException)."""
+
+
+def is_array_like(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = is_array_like,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every leaf of ``data`` passing ``test_type``
+    (reference: operations.py:84). Thin shim over ``jax.tree_util`` keeping
+    the reference's name and error contract."""
+    jax = _jax()
+
+    def apply(leaf):
+        if test_type(leaf):
+            return func(leaf, *args, **kwargs)
+        if error_on_other_type:
+            raise TypeError(f"Unsupported type {type(leaf)} passed to {getattr(func, '__name__', func)}")
+        return leaf
+
+    return jax.tree_util.tree_map(apply, data)
+
+
+def send_to_device(tensor: Any, device=None, non_blocking: bool = True, skip_keys=None):
+    """Move a pytree onto device(s) (reference: operations.py:135).
+
+    ``device`` may be a ``jax.Device``, a ``Sharding``, or None (default
+    device). ``device_put`` is always async; ``non_blocking`` kept for parity.
+    """
+    jax = _jax()
+
+    def put(leaf):
+        if not is_array_like(leaf):
+            return leaf
+        return jax.device_put(leaf, device)
+
+    if skip_keys and isinstance(tensor, dict):
+        return type(tensor)(
+            {k: (v if k in skip_keys else send_to_device(v, device)) for k, v in tensor.items()}
+        )
+    return jax.tree_util.tree_map(put, tensor)
+
+
+def get_data_structure(data):
+    """Shape/dtype skeleton of a pytree (reference: operations.py:184)."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if is_array_like(x) else x, data
+    )
+
+
+def find_batch_size(data) -> int | None:
+    """Leading dim of the first array leaf (reference: operations.py:233)."""
+    jax = _jax()
+    for leaf in jax.tree_util.tree_leaves(data):
+        if is_array_like(leaf) and len(leaf.shape) >= 1:
+            return leaf.shape[0]
+    return None
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Slice every array leaf (reference: operations.py:558)."""
+    return recursively_apply(lambda x: x[tensor_slice], data)
+
+
+def concatenate(data: list, dim: int = 0):
+    """Concatenate matching pytrees leaf-wise (reference: operations.py:600)."""
+    jax = _jax()
+    first = data[0]
+    if isinstance(first, (list, tuple)):
+        return type(first)(concatenate([d[i] for d in data], dim=dim) for i in range(len(first)))
+    if isinstance(first, dict):
+        return type(first)({k: concatenate([d[k] for d in data], dim=dim) for k in first})
+    if not is_array_like(first):
+        raise TypeError(f"Can only concatenate arrays/dicts/lists, got {type(first)}")
+    if any(hasattr(x, "addressable_shards") for x in data):
+        import jax.numpy as jnp
+
+        return jnp.concatenate(data, axis=dim)
+    return np.concatenate([np.asarray(x) for x in data], axis=dim)
+
+
+def convert_to_fp32(tensor):
+    """Upcast floating leaves to fp32 (reference: operations.py:777)."""
+    import jax.numpy as jnp
+
+    def upcast(x):
+        if is_array_like(x) and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32:
+            return x.astype(jnp.float32)
+        return x
+
+    return recursively_apply(upcast, tensor)
+
+
+class ConvertOutputsToFp32:
+    """Callable wrapper casting a function's float outputs to fp32
+    (reference: operations.py:814 ``convert_outputs_to_fp32``)."""
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        functools.update_wrapper(self, model_forward)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+
+def convert_outputs_to_fp32(model_forward):
+    return ConvertOutputsToFp32(model_forward)
+
+
+# ---------------------------------------------------------------------------
+# Host-level collectives
+# ---------------------------------------------------------------------------
+
+
+def _num_processes() -> int:
+    return _jax().process_count()
+
+
+def _verify_operation(func):
+    """Debug-mode shape pre-verification before a cross-process collective
+    (reference: operations.py:363-395)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        from ..state import PartialState
+
+        state = PartialState._shared_state
+        if state.get("_initialized") and state.get("debug") and _num_processes() > 1:
+            data = args[0] if args else kwargs.get("tensor", kwargs.get("object_list"))
+            skeleton = repr(get_data_structure(data))
+            all_skeletons = gather_object([skeleton])
+            if len(set(all_skeletons)) != 1:
+                report = "\n".join(f"  process {i}: {s}" for i, s in enumerate(all_skeletons))
+                raise DistributedOperationException(
+                    f"Mismatched inputs to `{func.__name__}` across processes:\n{report}"
+                )
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+@_verify_operation
+def gather(tensor):
+    """Materialise the full (cross-process) value on host as numpy
+    (reference: operations.py:418 — per-rank tensors -> concatenated).
+
+    * global ``jax.Array`` (even partially addressable): full array via
+      allgather of shards over DCN when needed.
+    * host numpy per process: concatenation across processes along dim 0.
+    """
+    jax = _jax()
+
+    def gather_one(x):
+        if hasattr(x, "is_fully_addressable"):
+            if x.is_fully_addressable:
+                return np.asarray(jax.device_get(x))
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        if _num_processes() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=True))
+        return np.asarray(x)
+
+    return recursively_apply(gather_one, tensor)
+
+
+def gather_object(object_list: list):
+    """Gather python objects from all processes into one list
+    (reference: operations.py:506). Pickle -> padded uint8 -> allgather."""
+    if _num_processes() == 1:
+        return list(object_list)
+    from jax.experimental import multihost_utils
+
+    payload = pickle.dumps(object_list)
+    length = np.array([len(payload)], dtype=np.int64)
+    max_len = int(multihost_utils.process_allgather(length, tiled=False).max())
+    buf = np.zeros((max_len,), dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    all_bufs = multihost_utils.process_allgather(buf, tiled=False)
+    all_lens = multihost_utils.process_allgather(length, tiled=False).reshape(-1)
+    out = []
+    for i in range(all_bufs.shape[0]):
+        out.extend(pickle.loads(all_bufs[i, : int(all_lens[i])].tobytes()))
+    return out
+
+
+@_verify_operation
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast array leaves from one process to all
+    (reference: operations.py:538)."""
+    if _num_processes() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    def bcast(x):
+        return np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x), is_source=_jax().process_index() == from_process))
+
+    return recursively_apply(bcast, tensor)
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0):
+    """Broadcast python objects (reference: operations.py:559). In-place
+    semantics preserved: returns the (mutated) list."""
+    if _num_processes() == 1:
+        return object_list
+    from jax.experimental import multihost_utils
+
+    jax = _jax()
+    is_src = jax.process_index() == from_process
+    payload = pickle.dumps(list(object_list)) if is_src else b""
+    length = multihost_utils.broadcast_one_to_all(np.array([len(payload)], np.int64), is_source=is_src)
+    buf = np.zeros((int(length[0]),), dtype=np.uint8)
+    if is_src:
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+    result = pickle.loads(buf.tobytes())
+    object_list[:] = result
+    return object_list
+
+
+@_verify_operation
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Elementwise reduce across processes (reference: operations.py:723)."""
+    def red(x):
+        x = np.asarray(x if not hasattr(x, "addressable_shards") else _jax().device_get(x))
+        if _num_processes() > 1:
+            from jax.experimental import multihost_utils
+
+            stacked = multihost_utils.process_allgather(x, tiled=False)
+            x = stacked.sum(axis=0)
+            if reduction == "mean":
+                x = x / stacked.shape[0]
+        return x * scale
+
+    return recursively_apply(red, tensor)
+
+
+@_verify_operation
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each process's arrays to the max size along ``dim`` so a gather
+    is well-formed (reference: operations.py:627)."""
+    def pad(x):
+        x = np.asarray(x if not hasattr(x, "addressable_shards") else _jax().device_get(x))
+        if dim >= x.ndim:
+            return x
+        size = np.array([x.shape[dim]], dtype=np.int64)
+        if _num_processes() > 1:
+            from jax.experimental import multihost_utils
+
+            max_size = int(multihost_utils.process_allgather(size, tiled=False).max())
+        else:
+            max_size = int(size[0])
+        if max_size == x.shape[dim]:
+            return x
+        pad_width = [(0, 0)] * x.ndim
+        pad_width[dim] = (max_size - x.shape[dim], 0) if pad_first else (0, max_size - x.shape[dim])
+        return np.pad(x, pad_width, constant_values=pad_index)
+
+    return recursively_apply(pad, tensor)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad batch so it divides evenly (reference: operations.py:694)."""
+    def pad(x):
+        x = np.asarray(x)
+        remainder = x.shape[dim] % num_processes
+        if remainder == 0:
+            return x
+        extra = num_processes - remainder
+        take = [slice(None)] * x.ndim
+        take[dim] = slice(0, extra)
+        filler = x[tuple(take)]
+        if filler.shape[dim] < extra:  # repeat last rows if batch < procs
+            reps = [1] * x.ndim
+            reps[dim] = int(np.ceil(extra / max(1, filler.shape[dim])))
+            filler = np.tile(filler, reps)
+            take[dim] = slice(0, extra)
+            filler = filler[tuple(take)]
+        return np.concatenate([x, filler], axis=dim)
+
+    return recursively_apply(pad, tensor)
+
+
+def initialize_tensors(data_structure):
+    """Materialise zeros from a shape skeleton (reference: operations.py:226)."""
+    jax = _jax()
+
+    def init(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return np.zeros(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(init, data_structure, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))

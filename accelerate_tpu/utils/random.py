@@ -1,0 +1,97 @@
+"""Seeding and cross-process RNG synchronisation.
+
+Reference analogue: src/accelerate/utils/random.py (set_seed :39,
+synchronize_rng_states :154 — broadcasts torch RNG state from rank 0).
+
+JAX RNG is explicit (keys, not global state), which makes the reference's
+hardest problem — "same shuffle on every rank" — trivial: every process
+derives the same key from the same seed, and per-step/per-host streams are
+``jax.random.fold_in`` folds, never mutation. What still needs syncing is
+the *host-side* RNG (numpy/python) used by dataloader shuffling when no
+seed was given; ``synchronize_rng_states`` broadcasts those from process 0.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .dataclasses import RNGType
+from .operations import broadcast_object_list
+
+
+_GLOBAL_SEED: Optional[int] = None
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> None:
+    """Seed python/numpy and record the seed for JAX key derivation
+    (reference: utils/random.py:39). ``device_specific`` folds in the
+    process index so hosts draw distinct-but-reproducible streams."""
+    global _GLOBAL_SEED
+    if device_specific:
+        import jax
+
+        seed += jax.process_index()
+    _GLOBAL_SEED = seed
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+
+
+def get_seed() -> Optional[int]:
+    return _GLOBAL_SEED
+
+
+def root_key():
+    """The process-identical root PRNG key (requires prior ``set_seed``)."""
+    import jax
+
+    if _GLOBAL_SEED is None:
+        set_seed(0)
+    return jax.random.key(_GLOBAL_SEED)
+
+
+def key_for_step(step: int, *folds: int):
+    """Derive a per-step (and optionally per-axis-index) key by folding —
+    the idiomatic replacement for the reference's RNG-state broadcast."""
+    import jax
+
+    k = jax.random.fold_in(root_key(), step)
+    for f in folds:
+        k = jax.random.fold_in(k, f)
+    return k
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None) -> None:
+    """Broadcast one host RNG state from process 0 (reference:
+    utils/random.py:106)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    if rng_type == RNGType.NUMPY:
+        state = [np.random.get_state()]
+        broadcast_object_list(state, from_process=0)
+        np.random.set_state(state[0])
+    elif rng_type == RNGType.PYTHON:
+        state = [random.getstate()]
+        broadcast_object_list(state, from_process=0)
+        random.setstate(state[0])
+    elif rng_type == RNGType.JAX:
+        # JAX keys are derived from the shared seed; broadcast the seed.
+        global _GLOBAL_SEED
+        state = [_GLOBAL_SEED]
+        broadcast_object_list(state, from_process=0)
+        if state[0] is not None:
+            _GLOBAL_SEED = state[0]
+    elif generator is not None:
+        state = [generator.bit_generator.state]
+        broadcast_object_list(state, from_process=0)
+        generator.bit_generator.state = state[0]
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None) -> None:
+    """(reference: utils/random.py:154)."""
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type), generator=generator)

@@ -1,0 +1,38 @@
+"""Test harness bootstrap: force an 8-device CPU fake mesh.
+
+This is the multi-chip CI story from SURVEY.md §4 — the reference cannot
+simulate multi-node without hardware; JAX can
+(``--xla_force_host_platform_device_count``), so every sharding/collective
+test runs against a real 8-way mesh on CPU. Must run before any backend
+initialisation (the axon TPU plugin registers at interpreter start, so the
+platform override happens via jax.config, not env)."""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def reset_singletons():
+    """Reset borg singletons between tests (reference analogue:
+    AccelerateTestCase.tearDown, test_utils/testing.py:639-651)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+@pytest.fixture
+def mesh8():
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    return MeshConfig(data=8).build()

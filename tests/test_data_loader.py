@@ -1,0 +1,157 @@
+"""Data loader tests (reference analogue: tests/test_data_loader.py, 897 LoC
+of BatchSamplerShard index math; here the invariants are: global arrays with
+correct batch sharding, seedable cross-epoch shuffling, remainder
+bookkeeping, skip_first_batches resume)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.data_loader import (
+    DataLoaderShard,
+    IterableDataLoaderShard,
+    SeedableRandomSampler,
+    default_collate,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+class ToyDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.float32(i), "y": np.float32(2 * i)}
+
+
+def global_values(batch):
+    return np.asarray(jax.device_get(batch["x"])).ravel().tolist()
+
+
+def test_even_dataset_batches(mesh8):
+    AcceleratorState()
+    dl = DataLoaderShard(ToyDataset(32), batch_size=2)  # global batch = 16
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (16,)
+    # sharded over the data axis
+    assert len(batches[0]["x"].sharding.device_set) == 8
+    assert global_values(batches[0]) == [float(i) for i in range(16)]
+
+
+def test_remainder_and_padding(mesh8):
+    AcceleratorState()
+    gs = GradientState()
+    dl = DataLoaderShard(ToyDataset(20), batch_size=2)  # 16 + 4 -> padded batch
+    batches = []
+    remainders = []
+    for b in dl:
+        batches.append(b)
+        remainders.append((gs.end_of_dataloader, gs.remainder))
+    assert len(batches) == 2
+    assert remainders[0] == (False, -1)
+    assert remainders[1] == (True, 4)
+    # padded batch wraps around from batch start
+    vals = global_values(batches[1])
+    assert vals[:4] == [16.0, 17.0, 18.0, 19.0]
+    assert len(vals) == 16
+
+
+def test_drop_last(mesh8):
+    AcceleratorState()
+    dl = DataLoaderShard(ToyDataset(20), batch_size=2, drop_last=True)
+    assert len(list(dl)) == 1
+    assert len(dl) == 1
+
+
+def test_shuffle_is_seeded_and_epoch_varies(mesh8):
+    AcceleratorState()
+    dl = DataLoaderShard(ToyDataset(16), batch_size=2, shuffle=True, seed=7)
+    epoch0 = [v for b in dl for v in global_values(b)]
+    epoch1 = [v for b in dl for v in global_values(b)]
+    assert sorted(epoch0) == [float(i) for i in range(16)]
+    assert epoch0 != epoch1  # set_epoch advanced
+    # reproducible: fresh loader with same seed gives same epoch-0 order
+    dl2 = DataLoaderShard(ToyDataset(16), batch_size=2, shuffle=True, seed=7)
+    epoch0_again = [v for b in dl2 for v in global_values(b)]
+    assert epoch0 == epoch0_again
+
+
+def test_skip_first_batches(mesh8):
+    AcceleratorState()
+    dl = DataLoaderShard(ToyDataset(32), batch_size=2)
+    all_batches = [global_values(b) for b in dl]
+    skip_first_batches(dl, 1)
+    resumed = [global_values(b) for b in dl]
+    assert resumed == all_batches[1:]
+    # skip resets after one epoch
+    assert len(list(dl)) == 2
+
+
+def test_iterable_loader(mesh8):
+    AcceleratorState()
+
+    def gen():
+        for i in range(20):
+            yield {"x": np.float32(i)}
+
+    dl = IterableDataLoaderShard(gen(), batch_size=2)
+    batches = list(dl)
+    assert len(batches) == 2
+    assert batches[0]["x"].shape == (16,)
+    assert dl.remainder == 4
+
+
+def test_gradient_state_registration(mesh8):
+    AcceleratorState()
+    gs = GradientState()
+    dl = DataLoaderShard(ToyDataset(16), batch_size=2)
+    assert not gs.in_dataloader
+    for _ in dl:
+        assert gs.in_dataloader
+    assert not gs.in_dataloader
+
+
+def test_prepare_data_loader_idempotent(mesh8):
+    AcceleratorState()
+    dl = prepare_data_loader(ToyDataset(16), batch_size=2)
+    assert prepare_data_loader(dl) is dl
+
+
+def test_prepare_from_torch_loader(mesh8):
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader as TorchDL
+
+    class TDS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"x": torch.tensor(float(i))}
+
+    AcceleratorState()
+    tdl = TorchDL(TDS(), batch_size=2, shuffle=False)
+    dl = prepare_data_loader(tdl)
+    batches = list(dl)
+    assert batches[0]["x"].shape == (16,)
+    assert global_values(batches[0]) == [float(i) for i in range(16)]
+
+
+def test_seedable_sampler_epochs():
+    s = SeedableRandomSampler(10, seed=3)
+    order0 = list(s)
+    s.set_epoch(1)
+    assert list(s) != order0
+    s.set_epoch(0)
+    assert list(s) == order0
+
+
+def test_collate_tuples():
+    out = default_collate([(np.float32(1), np.float32(2)), (np.float32(3), np.float32(4))])
+    assert isinstance(out, tuple)
+    np.testing.assert_array_equal(out[0], [1, 3])

@@ -1,0 +1,112 @@
+"""Collective/pytree op tests (reference analogue: tests/test_utils.py ops
+section + test_utils/scripts/test_ops.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.state import AcceleratorState
+from accelerate_tpu.utils import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    convert_outputs_to_fp32,
+    convert_to_fp32,
+    find_batch_size,
+    gather,
+    gather_object,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+)
+
+
+def test_send_to_device_pytree():
+    batch = {"x": np.ones((4, 2)), "y": [np.zeros(3), np.arange(5)], "meta": "keep"}
+    out = send_to_device(batch)
+    assert isinstance(out["x"], jax.Array)
+    assert out["meta"] == "keep"
+    np.testing.assert_array_equal(np.asarray(out["y"][1]), np.arange(5))
+
+
+def test_send_to_device_with_sharding(mesh8):
+    sharding = NamedSharding(mesh8, P("data"))
+    out = send_to_device(np.ones((16, 2)), sharding)
+    assert out.sharding == sharding
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(2), "skip": np.ones(2)}
+    out = send_to_device(batch, skip_keys=["skip"])
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["skip"], np.ndarray)
+
+
+def test_gather_sharded_array(mesh8):
+    x = jax.device_put(np.arange(16.0).reshape(16, 1), NamedSharding(mesh8, P("data")))
+    out = gather(x)
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, np.arange(16.0).reshape(16, 1))
+
+
+def test_gather_object_single_process():
+    assert gather_object([1, "a"]) == [1, "a"]
+
+
+def test_broadcast_single_process():
+    x = np.ones((3,))
+    np.testing.assert_array_equal(broadcast(x), x)
+    objs = [1, 2]
+    assert broadcast_object_list(objs) == [1, 2]
+
+
+def test_reduce_mean_sharded(mesh8):
+    x = jax.device_put(np.full((8, 2), 3.0), NamedSharding(mesh8, P("data")))
+    out = reduce(x, "mean")
+    np.testing.assert_allclose(out, np.full((8, 2), 3.0))
+
+
+def test_pad_across_processes_noop_single():
+    x = np.ones((3, 2))
+    np.testing.assert_array_equal(pad_across_processes(x, dim=0), x)
+
+
+def test_pad_input_tensors():
+    x = {"a": np.arange(10).reshape(10, 1)}
+    out = pad_input_tensors(x, batch_size=10, num_processes=4)
+    assert out["a"].shape[0] == 12
+    np.testing.assert_array_equal(out["a"][10:].ravel(), [0, 1])
+
+
+def test_find_batch_size():
+    assert find_batch_size({"x": np.ones((5, 3)), "y": np.ones((5,))}) == 5
+    assert find_batch_size({"x": 1}) is None
+
+
+def test_convert_to_fp32():
+    tree = {"a": jnp.ones(2, dtype=jnp.bfloat16), "b": jnp.ones(2, dtype=jnp.int32)}
+    out = convert_to_fp32(tree)
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.int32
+
+
+def test_convert_outputs_to_fp32_wrapper():
+    fn = convert_outputs_to_fp32(lambda x: {"out": x.astype(jnp.bfloat16)})
+    out = fn(jnp.ones(3))
+    assert out["out"].dtype == jnp.float32
+
+
+def test_concatenate_dicts():
+    a = {"x": np.ones((2, 3))}
+    b = {"x": np.zeros((4, 3))}
+    out = concatenate([a, b])
+    assert out["x"].shape == (6, 3)
+
+
+def test_recursively_apply_error_on_other_type():
+    with pytest.raises(TypeError):
+        recursively_apply(lambda x: x, {"a": object()}, error_on_other_type=True)

@@ -1,0 +1,80 @@
+"""PartialState / AcceleratorState / GradientState unit tests
+(reference analogue: tests/test_state_checkpointing.py + test_utils/scripts/
+test_script.py process-control sections)."""
+
+import pytest
+
+from accelerate_tpu import DistributedType, MeshConfig, ParallelismPlugin
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+    assert a.num_devices == 8
+    assert a.is_main_process
+    assert a.is_last_process  # single process
+    assert a.process_index == 0
+
+
+def test_wait_for_everyone_single_process():
+    PartialState().wait_for_everyone()  # no-op, must not raise
+
+
+def test_split_between_processes_single():
+    with PartialState().split_between_processes([1, 2, 3]) as chunk:
+        assert chunk == [1, 2, 3]
+
+
+def test_on_main_process_decorator():
+    state = PartialState()
+    calls = []
+    state.on_main_process(lambda: calls.append(1))()
+    assert calls == [1]
+
+
+def test_accelerator_state_mesh_default_dp():
+    state = AcceleratorState()
+    assert dict(state.mesh.shape)["data"] == 8
+    assert state.distributed_type == DistributedType.DATA_PARALLEL
+
+
+def test_accelerator_state_hybrid_mesh():
+    plugin = ParallelismPlugin(mesh_config=MeshConfig(data=2, fsdp=2, tensor=2))
+    state = AcceleratorState(parallelism_plugin=plugin)
+    shape = dict(state.mesh.shape)
+    assert (shape["data"], shape["fsdp"], shape["tensor"]) == (2, 2, 2)
+    assert state.distributed_type == DistributedType.HYBRID
+
+
+def test_accelerator_state_mixed_precision():
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    assert state.dtype_policy.compute_dtype == "bfloat16"
+    assert state.dtype_policy.param_dtype == "float32"
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert not gs.end_of_dataloader
+    assert gs.remainder == -1
+
+
+def test_mesh_config_fill_and_errors():
+    assert MeshConfig(data=-1, tensor=2).sizes(8) == {
+        "pipe": 1, "data": 4, "fsdp": 1, "expert": 1, "seq": 1, "tensor": 2,
+    }
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).sizes(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).sizes(8)
+
+
+def test_mesh_config_from_env(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_MESH_TENSOR", "4")
+    monkeypatch.setenv("ACCELERATE_MESH_DATA", "2")
+    cfg = MeshConfig.from_env()
+    assert cfg.tensor == 4 and cfg.data == 2

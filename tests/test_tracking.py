@@ -1,0 +1,52 @@
+"""Tracker tests (reference analogue: tests/test_tracking.py, 870 LoC —
+trackers with temp dirs and mocked APIs)."""
+
+import json
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import GeneralTracker, JSONLTracker, filter_trackers
+
+
+def test_jsonl_tracker_logs(tmp_path):
+    t = JSONLTracker("run", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"loss": 1.5}, step=0)
+    t.log({"loss": 0.5}, step=1)
+    lines = [json.loads(l) for l in (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
+    assert lines[0]["loss"] == 1.5 and lines[1]["_step"] == 1
+    assert json.loads((tmp_path / "run" / "config.json").read_text()) == {"lr": 0.1}
+
+
+def test_accelerator_tracking_end_to_end(tmp_path):
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("proj", config={"bs": 8})
+    acc.log({"metric": 2.0}, step=3)
+    acc.end_training()
+    lines = (tmp_path / "proj" / "metrics.jsonl").read_text().splitlines()
+    assert json.loads(lines[0])["metric"] == 2.0
+
+
+def test_filter_trackers_skips_unavailable(tmp_path):
+    trackers = filter_trackers(["jsonl", "wandb"], str(tmp_path), "p")
+    names = [t.name for t in trackers]
+    assert "jsonl" in names  # wandb may or may not be installed; jsonl always
+
+
+def test_custom_tracker_instance_passthrough(tmp_path):
+    class MyTracker(GeneralTracker):
+        name = "mine"
+        requires_logging_directory = False
+
+        def __init__(self):
+            super().__init__()
+            self.logged = []
+
+        def store_init_configuration(self, values):
+            pass
+
+        def log(self, values, step=None, **kw):
+            self.logged.append(values)
+
+    mine = MyTracker()
+    trackers = filter_trackers([mine], None, "p")
+    assert trackers == [mine]
