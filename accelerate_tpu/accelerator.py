@@ -490,11 +490,11 @@ class Accelerator:
         self._ensure_opt_state(optimizer, model)
         scheduler = scheduler or (self._schedulers[-1] if self._schedulers else None)
         accum = self.gradient_accumulation_steps
-        clip_norm = self._clip_max_norm
         use_fp16 = self.mixed_precision == "fp16"
         compute_cast = self._compute_cast
+        apply_gradients = self._make_gradient_applier(optimizer.optimizer)
 
-        def step_fn(params, opt_state, grad_buf, micro_step, batch, loss_scale):
+        def step_fn(params, opt_state, grad_buf, batch, loss_scale, do_sync):
             def scaled_loss(p):
                 out = loss_fn(compute_cast(p), batch)
                 loss, aux = (out if has_aux else (out, None))
@@ -504,70 +504,52 @@ class Accelerator:
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / (loss_scale * accum), grads)
             grad_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
 
-            is_sync = (micro_step + 1) % accum == 0
-
-            def apply(operand):
-                params, opt_state, grad_buf = operand
-                g = grad_buf
-                gnorm = optax_global_norm(g)
-                if clip_norm is not None:
-                    scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
-                    g = jax.tree_util.tree_map(lambda t: t * scale, g)
-                finite = jnp.isfinite(gnorm)
-
-                def do_update(_):
-                    updates, new_opt = optimizer.optimizer.update(g, opt_state, params)
-                    new_params = jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates)
-                    return new_params, new_opt
-
-                if use_fp16:
-                    new_params, new_opt = jax.lax.cond(
-                        finite, do_update, lambda _: (params, opt_state), operand=None
-                    )
-                else:
-                    new_params, new_opt = do_update(None)
-                zero_buf = jax.tree_util.tree_map(jnp.zeros_like, grad_buf)
-                return new_params, new_opt, zero_buf, gnorm, finite
-
             def hold(operand):
                 params, opt_state, grad_buf = operand
                 return params, opt_state, grad_buf, jnp.float32(0.0), jnp.bool_(True)
 
             if accum == 1:
-                new_params, new_opt, new_buf, gnorm, finite = apply((params, opt_state, grad_buf))
+                new_params, new_opt, new_buf, gnorm, finite = apply_gradients((params, opt_state, grad_buf))
             else:
                 new_params, new_opt, new_buf, gnorm, finite = jax.lax.cond(
-                    is_sync, apply, hold, (params, opt_state, grad_buf)
+                    do_sync, apply_gradients, hold, (params, opt_state, grad_buf)
                 )
-            return new_params, new_opt, new_buf, micro_step + 1, loss, gnorm, finite, aux
+            return new_params, new_opt, new_buf, loss, gnorm, finite, aux
 
         donate_args = (0, 1, 2) if donate else ()
         jitted = jax.jit(step_fn, donate_argnums=donate_args)
 
-        zeros_like_params = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p))
-        grad_buf = zeros_like_params(model.params)
-        micro_step = jnp.int32(0)
-
-        state_box = {"grad_buf": grad_buf, "micro_step": micro_step}
+        grad_buf = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p))(
+            model.params
+        )
+        state_box = {"grad_buf": grad_buf, "micro": 0}
 
         def step(batch):
-            nonlocal_state = state_box
-            self.gradient_state._set_sync_gradients((self.step + 1) % accum == 0)
-            new_params, new_opt, new_buf, new_micro, loss, gnorm, finite, aux = jitted(
+            # sync on the accumulation boundary OR at end-of-dataloader
+            # (reference sync_with_dataloader semantics: accelerator.py:1123)
+            do_sync = (state_box["micro"] + 1) % accum == 0
+            if (
+                self.gradient_state.sync_with_dataloader
+                and self.gradient_state.in_dataloader
+                and self.gradient_state.end_of_dataloader
+            ):
+                do_sync = True
+            self.gradient_state._set_sync_gradients(do_sync)
+            new_params, new_opt, new_buf, loss, gnorm, finite, aux = jitted(
                 model.params,
                 optimizer.opt_state,
-                nonlocal_state["grad_buf"],
-                nonlocal_state["micro_step"],
+                state_box["grad_buf"],
                 batch,
                 jnp.float32(self._loss_scale),
+                jnp.bool_(do_sync),
             )
             model.params = new_params
             optimizer.opt_state = new_opt
-            nonlocal_state["grad_buf"] = new_buf
-            nonlocal_state["micro_step"] = new_micro
+            state_box["grad_buf"] = new_buf
+            state_box["micro"] = 0 if do_sync else state_box["micro"] + 1
             self.step += 1
             self._last_grad_norm = gnorm
-            if self.sync_gradients:
+            if do_sync:
                 if use_fp16:
                     self._update_loss_scale(bool(finite))
                     optimizer._step_was_skipped = not bool(finite)
@@ -577,6 +559,38 @@ class Accelerator:
 
         step._jitted = jitted
         return step
+
+    def _make_gradient_applier(self, optax_tx):
+        """The shared clip + finite-check + update + zero-buffer body used by
+        both the fast path and the imperative path — one definition so the
+        two paths can never diverge."""
+        jax = _jax()
+        jnp = _jnp()
+        clip_norm = self._clip_max_norm
+        use_fp16 = self.mixed_precision == "fp16"
+
+        def apply_gradients(operand):
+            params, opt_state, grad_buf = operand
+            g = grad_buf
+            gnorm = optax_global_norm(g)
+            if clip_norm is not None:
+                scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                g = jax.tree_util.tree_map(lambda t: t * scale, g)
+            finite = jnp.isfinite(gnorm)
+
+            def do_update(_):
+                updates, new_opt = optax_tx.update(g, opt_state, params)
+                new_params = jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates)
+                return new_params, new_opt
+
+            if use_fp16:
+                new_params, new_opt = jax.lax.cond(finite, do_update, lambda _: (params, opt_state), operand=None)
+            else:
+                new_params, new_opt = do_update(None)
+            zero_buf = jax.tree_util.tree_map(jnp.zeros_like, grad_buf)
+            return new_params, new_opt, zero_buf, gnorm, finite
+
+        return apply_gradients
 
     def _update_loss_scale(self, finite: bool):
         h = self.scaler_handler
@@ -655,8 +669,11 @@ class Accelerator:
         jnp = _jnp()
         model = model or self._models[-1]
         accum = self.gradient_accumulation_steps
-        cache_key = (id(loss_fn), id(model))
-        if cache_key not in self._jit_cache:
+        # the cache entry holds a strong reference to loss_fn: a freed
+        # lambda's id() can be reused, so identity is re-checked on hit
+        cache_key = ("backward", id(loss_fn), id(model), accum)
+        entry = self._jit_cache.get(cache_key)
+        if entry is None or entry[0] is not loss_fn:
             compute_cast = self._compute_cast
 
             def grad_step(params, grad_buf, batch, loss_scale):
@@ -669,12 +686,13 @@ class Accelerator:
                 new_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
                 return new_buf, loss
 
-            self._jit_cache[cache_key] = jax.jit(grad_step, donate_argnums=(1,))
+            entry = (loss_fn, jax.jit(grad_step, donate_argnums=(1,)))
+            self._jit_cache[cache_key] = entry
         if self._grad_buffers.get(id(model)) is None:
             self._grad_buffers[id(model)] = jax.jit(
                 lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p)
             )(model.params)
-        self._grad_buffers[id(model)], loss = self._jit_cache[cache_key](
+        self._grad_buffers[id(model)], loss = entry[1](
             model.params, self._grad_buffers[id(model)], batch, jnp.float32(self._loss_scale)
         )
         self._grad_count += 1
@@ -713,32 +731,11 @@ class Accelerator:
             return True
         cache_key = ("apply", id(opt), self._clip_max_norm)
         if cache_key not in self._jit_cache:
-            clip_norm = self._clip_max_norm
-            use_fp16 = self.mixed_precision == "fp16"
-
-            def apply_fn(params, opt_state, grad_buf):
-                g = grad_buf
-                gnorm = optax_global_norm(g)
-                if clip_norm is not None:
-                    scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
-                    g = jax.tree_util.tree_map(lambda t: t * scale, g)
-                finite = jnp.isfinite(gnorm)
-
-                def do(_):
-                    updates, new_opt = opt.optimizer.update(g, opt_state, params)
-                    return (
-                        jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates),
-                        new_opt,
-                    )
-
-                if use_fp16:
-                    new_params, new_opt = jax.lax.cond(finite, do, lambda _: (params, opt_state), operand=None)
-                else:
-                    new_params, new_opt = do(None)
-                zero = jax.tree_util.tree_map(jnp.zeros_like, grad_buf)
-                return new_params, new_opt, zero, gnorm, finite
-
-            self._jit_cache[cache_key] = jax.jit(apply_fn, donate_argnums=(0, 1, 2))
+            apply_gradients = self._make_gradient_applier(opt.optimizer)
+            self._jit_cache[cache_key] = jax.jit(
+                lambda params, opt_state, grad_buf: apply_gradients((params, opt_state, grad_buf)),
+                donate_argnums=(0, 1, 2),
+            )
         new_params, new_opt, zero_buf, gnorm, finite = self._jit_cache[cache_key](
             model.params, opt.opt_state, grad_buffer
         )

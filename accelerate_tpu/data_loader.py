@@ -437,7 +437,6 @@ class DataLoaderDispatcher(BaseDataLoader):
                 self.end_of_dataloader = end
                 self.remainder = rem
                 # each process slices its rows, then assembles the global array
-                g = None
 
                 def slice_rows(x):
                     rows = x.shape[0] // pc

@@ -1,0 +1,14 @@
+from .bert import (
+    BERT_SHARDING_RULES,
+    BertConfig,
+    BertForSequenceClassification,
+    bert_classification_loss,
+    create_bert_model,
+)
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    causal_lm_loss,
+    create_llama_model,
+)

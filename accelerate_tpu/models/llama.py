@@ -1,0 +1,230 @@
+"""Llama-family decoder (flax.linen): RMSNorm, RoPE, GQA, SwiGLU.
+
+The scale-out model for the framework (the reference's FSDP2 benchmark
+fine-tunes Llama-2-7B — BASELINE.json configs). TPU-first choices:
+
+* sharding rules for the full 4D layout (fsdp x tensor x seq x data):
+  Megatron column/row splits over ``tensor``, sequence-dim activation
+  sharding constraint over ``seq`` (Megatron-SP equivalent);
+* ``lax.scan`` over layers (``scan_layers=True``) so trace/compile time is
+  O(1) in depth — the TPU answer to the reference's "regional compilation"
+  (reference: utils/other.py:101-172 compile_regions, SURVEY §2.6);
+* attention dispatches to flash/blockwise for long sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    scan_layers: bool = True
+    remat: bool = True
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+
+# Megatron column/row splits over ``tensor``. Two path layouts exist:
+# scan_layers=True stacks per-layer weights with a leading layer dim under
+# ``layers/block/...`` (specs start with None for the scan dim);
+# scan_layers=False names layers ``layer_<i>/...``. Anchored so neither
+# rule set can match the other layout's paths.
+LLAMA_SHARDING_RULES = [
+    (r"embed_tokens/embedding", P("tensor", None)),
+    # stacked (scan) variants: [L, in, out]-shaped kernels
+    (r"layers/block/attn/(q|k|v)_proj/kernel", P(None, None, "tensor")),
+    (r"layers/block/attn/o_proj/kernel", P(None, "tensor", None)),
+    (r"layers/block/mlp/(gate|up)_proj/kernel", P(None, None, "tensor")),
+    (r"layers/block/mlp/down_proj/kernel", P(None, "tensor", None)),
+    (r"lm_head/kernel", P(None, "tensor")),
+    # unstacked variants (scan_layers=False): [in, out]-shaped kernels
+    (r"layer_\d+/attn/(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"layer_\d+/attn/o_proj/kernel", P("tensor", None)),
+    (r"layer_\d+/mlp/(gate|up)_proj/kernel", P(None, "tensor")),
+    (r"layer_\d+/mlp/down_proj/kernel", P("tensor", None)),
+]
+
+# Activation sharding (Megatron-SP equivalent): token dim over ``seq``.
+ACTIVATION_SPEC = P(("data", "fsdp"), "seq", None)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        # norm math in fp32, output back in the residual-stream dtype (the
+        # scale param may be fp32 under the autocast keep-list)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim of [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        q = nn.Dense(cfg.num_attention_heads * head_dim, use_bias=False, name="q_proj", dtype=hidden.dtype)(hidden)
+        k = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="k_proj", dtype=hidden.dtype)(hidden)
+        v = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="v_proj", dtype=hidden.dtype)(hidden)
+        q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
+        k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
+        v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        from ..ops.attention import dot_product_attention
+
+        out = dot_product_attention(q, k, v, causal=True)
+        out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype)(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj", dtype=hidden.dtype)(hidden)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj", dtype=hidden.dtype)(hidden)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj", dtype=hidden.dtype)(
+            nn.silu(gate) * up
+        )
+
+
+class LlamaLayer(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.config
+        hidden = hidden + LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions
+        )
+        hidden = hidden + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden)
+        )
+        return hidden
+
+
+class _ScanLayer(nn.Module):
+    """scan-compatible wrapper: carry-in/carry-out signature."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        return LlamaLayer(self.config, name="block")(hidden, positions), None
+
+
+class LlamaModel(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[-1]), input_ids.shape)
+        # constrain activations onto the mesh (seq axis = Megatron-SP)
+        from ..parallel.sharding import maybe_shard
+
+        hidden = maybe_shard(hidden, ACTIVATION_SPEC)
+
+        if cfg.scan_layers:
+            layer_cls = nn.remat(_ScanLayer, prevent_cse=False) if cfg.remat else _ScanLayer
+            scanned = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            hidden, _ = scanned(cfg, name="layers")(hidden, positions)
+        else:
+            layer_cls = nn.remat(LlamaLayer, prevent_cse=False) if cfg.remat else LlamaLayer
+            for i in range(cfg.num_hidden_layers):
+                hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, positions)
+        hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
+
+
+def create_llama_model(config: Optional[LlamaConfig] = None, seed: int = 0, seq_len: int = 128) -> Model:
+    config = config or LlamaConfig.tiny()
+    module = LlamaModel(config)
+    dummy = jnp.zeros((2, seq_len), jnp.int32)
+    params = module.init(jax.random.key(seed), dummy)["params"]
+
+    def apply_fn(p, input_ids):
+        return module.apply({"params": p}, input_ids)
+
+    model = Model(apply_fn, params, sharding_rules=LLAMA_SHARDING_RULES, name="llama")
+    model.config = config
+    model.module = module
+    return model
+
+
+def causal_lm_loss(params, batch, apply_fn):
+    """Next-token cross entropy; labels = input shifted left, padding via
+    ``loss_mask``. When labels are auto-derived, the final position (whose
+    target would be fabricated) is masked out."""
+    logits = apply_fn(params, batch["input_ids"])
+    mask = batch.get("loss_mask")
+    if "labels" in batch:
+        labels = batch["labels"]
+    else:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)))
+        last_pos = jnp.zeros(labels.shape, bool).at[:, -1].set(True)
+        mask = jnp.where(last_pos, 0.0, 1.0 if mask is None else mask)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

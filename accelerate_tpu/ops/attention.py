@@ -1,0 +1,71 @@
+"""Attention ops: XLA reference implementation + Pallas flash-attention
+dispatch.
+
+The reference framework has no attention kernels at all (it delegates to
+torch models); this module exists because the build is a *framework with a
+model zoo* and attention is the hot op. Dispatch policy:
+
+* small/medium sequence or non-TPU backend -> plain XLA einsum attention
+  (XLA fuses the softmax chain well);
+* long sequence on TPU -> Pallas flash attention
+  (:mod:`accelerate_tpu.ops.flash_attention`), O(S) memory;
+* ``seq``-sharded activations -> ring attention
+  (:mod:`accelerate_tpu.parallel.ring_attention`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Below this many query positions the quadratic XLA path is faster than the
+# Pallas kernel's grid overhead (empirical on v5e; see bench notes).
+FLASH_MIN_SEQ = 1024
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, H_kv, D]
+    v: jax.Array,  # [B, S, H_kv, D]
+    mask: Optional[jax.Array] = None,  # bool, broadcastable to [B, H, Sq, Sk]
+    causal: bool = False,
+    scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-head attention with optional GQA (H_kv divides H) and
+    flash-kernel dispatch. Returns [B, S, H, D]."""
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim**-0.5
+    seq_len = q.shape[1]
+
+    if use_flash is None:
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and seq_len >= FLASH_MIN_SEQ
+            and mask is None  # kernel supports causal masking only
+        )
+    if use_flash:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    num_heads, num_kv = q.shape[-2], k.shape[-2]
+    if num_kv != num_heads:  # GQA: repeat kv groups
+        reps = num_heads // num_kv
+        k = jnp.repeat(k, reps, axis=-2)
+        v = jnp.repeat(v, reps, axis=-2)
+
+    # [B,S,H,D] -> [B,H,Sq,Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        q_pos = jnp.arange(seq_len)[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        causal_mask = q_pos >= k_pos
+        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)

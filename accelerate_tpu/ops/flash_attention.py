@@ -1,0 +1,91 @@
+"""Memory-efficient attention: blockwise online-softmax over key/value
+chunks.
+
+The flash-attention recurrence (running max + running normaliser) expressed
+as ``lax.scan`` over KV blocks: O(S) activation memory instead of the
+O(S^2) logits tensor, fully differentiable (AD through the scan yields the
+standard recompute-style backward), and XLA fuses each block's
+matmul+softmax chain onto the MXU. The reference framework has no
+long-context mechanism at all (SURVEY §5 long-context: only Megatron-SP);
+this op is the parity-plus path, and the hand-tiled Pallas kernel
+(same signature) can replace the scan body without touching callers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_size"))
+def flash_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, H_kv, D]
+    v: jax.Array,  # [B, Sk, H_kv, D]
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+) -> jax.Array:
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    num_heads, num_kv = q.shape[-2], k.shape[-2]
+    if num_kv != num_heads:
+        k = jnp.repeat(k, num_heads // num_kv, axis=-2)
+        v = jnp.repeat(v, num_heads // num_kv, axis=-2)
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    blk = min(block_size, sk)
+    if sk % blk != 0:
+        # pad keys to a block multiple; padded positions are masked out
+        pad = blk - sk % blk
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    n_blocks = k.shape[1] // blk
+
+    qf = (q * scale).astype(q.dtype)
+    k_blocks = k.reshape(b, n_blocks, blk, h, d)
+    v_blocks = v.reshape(b, n_blocks, blk, h, d)
+
+    q_pos = jnp.arange(sq)
+
+    def body(carry, inputs):
+        acc, m, l = carry  # [B,Sq,H,D], [B,H,Sq], [B,H,Sq]
+        (k_blk, v_blk, blk_idx) = inputs
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk).astype(jnp.float32)  # [B,H,Sq,blk]
+        k_pos = blk_idx * blk + jnp.arange(blk)
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
+            s = jnp.where(valid[None, None], s, -jnp.inf)
+        else:
+            s = jnp.where(valid[None, None, None, :], s, -jnp.inf)
+        m_blk = s.max(axis=-1)  # [B,H,Sq]
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows (all -inf): exp(-inf - -inf) -> use 0
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)  # [B,H,Sq]
+        l_new = l * correction + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
+        acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+        return (acc, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (acc, m, l), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (acc0, m0, l0),
+        (
+            k_blocks.transpose(1, 0, 2, 3, 4),
+            v_blocks.transpose(1, 0, 2, 3, 4),
+            jnp.arange(n_blocks),
+        ),
+    )
+    l = jnp.maximum(l, 1e-37)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

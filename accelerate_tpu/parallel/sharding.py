@@ -121,6 +121,21 @@ def fsdp_rules_for(tree: Any, mesh: Mesh, axis: str = "fsdp", *, min_size: int =
     return rules
 
 
+def maybe_shard(x: Any, spec: PartitionSpec, mesh: Mesh | None = None):
+    """``with_sharding_constraint`` against the active Accelerator mesh;
+    no-op when no mesh is initialised (so model code can carry layout
+    annotations without requiring the framework)."""
+    if mesh is None:
+        from ..state import AcceleratorState
+
+        state = AcceleratorState._shared_state
+        mesh = state.get("mesh") if state.get("_initialized") else None
+    if mesh is None:
+        return x
+    spec = _prune_spec(spec, getattr(x, "ndim", 0), getattr(x, "shape", ()), mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def shard_pytree(tree: Any, shardings: Any):
     """``device_put`` a pytree with per-leaf shardings (host->device)."""
     return jax.device_put(tree, shardings)

@@ -1,0 +1,92 @@
+"""Headline benchmark: BERT-base fine-tune throughput (samples/sec).
+
+Matches BASELINE.json's metric ("BERT-base MRPC samples/sec + step time").
+Runs on whatever accelerator is attached (the driver runs this on one real
+TPU chip). Prints ONE JSON line:
+
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N, ...}
+
+``vs_baseline`` is measured against a **per-chip A100 baseline of 350
+samples/sec** — the commonly reported BERT-base GLUE fine-tune throughput
+(seq 128, fp16, HF Trainer) on one A100; the reference's north-star target
+(BASELINE.json) is v5e-8 within 10% of 8xA100, i.e. per-chip parity ~0.9+.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+A100_PER_CHIP_SAMPLES_PER_SEC = 350.0
+
+
+def main():
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import BertConfig, bert_classification_loss, create_bert_model
+    from accelerate_tpu.parallel.mesh import batch_sharding
+
+    seq_len = 128
+    batch_size = 128  # per-chip; v5e HBM fits this comfortably in bf16
+
+    accelerator = Accelerator(mixed_precision="bf16")
+    n_dev = accelerator.state.num_devices
+    global_batch = batch_size * accelerator.num_data_shards
+
+    model = accelerator.prepare_model(create_bert_model(BertConfig.base(), seq_len=seq_len))
+    optimizer = accelerator.prepare_optimizer(optax.adamw(2e-5, weight_decay=0.01))
+    loss_fn = lambda p, b: bert_classification_loss(p, b, model.apply_fn)
+    step = accelerator.build_train_step(loss_fn)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(5, 30000, size=(global_batch, seq_len)).astype(np.int32),
+        "attention_mask": np.ones((global_batch, seq_len), np.bool_),
+        "labels": rng.integers(0, 2, size=(global_batch,)).astype(np.int32),
+    }
+    batch = jax.device_put(batch, batch_sharding(accelerator.mesh))
+
+    # compile + warmup
+    t_compile = time.perf_counter()
+    jax.block_until_ready(step(batch))
+    compile_s = time.perf_counter() - t_compile
+    for _ in range(3):
+        loss = step(batch)
+    jax.block_until_ready(loss)
+
+    # steady state
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        loss = step(batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    step_time_ms = dt / n_steps * 1000
+    samples_per_sec = global_batch * n_steps / dt
+    per_chip = samples_per_sec / n_dev
+
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_seq128_train_samples_per_sec",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(per_chip / A100_PER_CHIP_SAMPLES_PER_SEC, 3),
+                "step_time_ms": round(step_time_ms, 2),
+                "per_chip_samples_per_sec": round(per_chip, 1),
+                "compile_s": round(compile_s, 1),
+                "n_devices": n_dev,
+                "global_batch": global_batch,
+                "backend": accelerator.state.backend,
+                "baseline": "350 samples/sec/A100 (BERT-base seq128 fp16 fine-tune)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -197,3 +197,17 @@ def test_accumulate_syncs_on_end_of_dataloader():
             syncs.append(acc.sync_gradients)
     # end of dataloader forces a sync even mid-accumulation window
     assert syncs[-1] is True
+
+
+def test_fast_path_syncs_at_end_of_dataloader():
+    """Regression: with accum=4 and 2 batches/epoch, the epoch tail must
+    still apply an update (sync_with_dataloader semantics)."""
+    acc = make_accelerator(gradient_accumulation_steps=4)
+    ds = RegressionDataset(length=32)
+    model, optimizer, loader = acc.prepare(RegressionModel(), optax.sgd(0.1), ds)
+    loader.batch_size = 16 // acc.num_data_shards  # 2 batches per epoch
+    step = acc.build_train_step(linear_loss_fn)
+    a0 = float(model.params["a"])
+    for batch in loader:
+        step(batch)
+    assert float(model.params["a"]) != a0  # update applied at epoch end
