@@ -493,10 +493,15 @@ class Accelerator:
         use_fp16 = self.mixed_precision == "fp16"
         compute_cast = self._compute_cast
         apply_gradients = self._make_gradient_applier(optimizer.optimizer)
+        # loss_fn(params, batch) or loss_fn(params, batch, rng) — the rng
+        # variant gets a per-step folded key (dropout etc.)
+        import inspect
 
-        def step_fn(params, opt_state, grad_buf, batch, loss_scale, do_sync):
+        wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
+
+        def step_fn(params, opt_state, grad_buf, batch, loss_scale, do_sync, rng):
             def scaled_loss(p):
-                out = loss_fn(compute_cast(p), batch)
+                out = loss_fn(compute_cast(p), batch, rng) if wants_rng else loss_fn(compute_cast(p), batch)
                 loss, aux = (out if has_aux else (out, None))
                 return loss.astype(jnp.float32) * loss_scale, (loss, aux)
 
@@ -535,6 +540,8 @@ class Accelerator:
             ):
                 do_sync = True
             self.gradient_state._set_sync_gradients(do_sync)
+            from .utils.random import key_for_step
+
             new_params, new_opt, new_buf, loss, gnorm, finite, aux = jitted(
                 model.params,
                 optimizer.opt_state,
@@ -542,6 +549,7 @@ class Accelerator:
                 batch,
                 jnp.float32(self._loss_scale),
                 jnp.bool_(do_sync),
+                key_for_step(self.step),
             )
             model.params = new_params
             optimizer.opt_state = new_opt

@@ -1,0 +1,350 @@
+"""Big-model inference: abstract init, HBM-budget placement, streamed
+execution, checkpoint-and-dispatch.
+
+Reference analogue: src/accelerate/big_modeling.py (749) + utils/modeling.py
+(2199) + hooks.py (765). The reference's machinery — meta device modules,
+``infer_auto_device_map`` greedy packing, ``AlignDevicesHook`` pre/post
+forward weight shuffling — maps to TPU as:
+
+* meta device        -> ``jax.eval_shape`` pytrees (:func:`init_empty_weights`,
+                        :func:`abstract_params`);
+* device_map         -> :func:`infer_auto_device_map`: greedy packing of
+                        layer groups into per-device HBM budgets, with
+                        "cpu" (host RAM) and "disk" (memmap) tiers;
+* AlignDevicesHook   -> :class:`StreamedExecutor`: per-layer weight
+                        streaming with double-buffering — the transfer of
+                        layer i+1 overlaps compute of layer i (device_put
+                        is async), which replaces the reference's
+                        synchronous hook H2D copies (hooks.py:328-402);
+* load_checkpoint_and_dispatch -> same-named function over safetensors
+                        shard indexes, loading each tensor straight to its
+                        placement tier.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+from .logging import get_logger
+from .utils.offload import OffloadedWeightsLoader, offload_state_dict
+
+logger = get_logger(__name__)
+
+
+# --------------------------------------------------------------------- #
+# meta-device equivalents
+# --------------------------------------------------------------------- #
+
+
+@contextlib.contextmanager
+def init_empty_weights(include_buffers: bool = False):
+    """(reference: big_modeling.py:61). In JAX "empty init" is not a patch
+    but the natural mode: yield a helper that eval_shapes an init function.
+
+    Usage::
+
+        with init_empty_weights() as empty:
+            abstract = empty(module.init, rng, dummy_input)
+    """
+
+    def evaluate(init_fn, *args, **kwargs):
+        import jax
+
+        return jax.eval_shape(init_fn, *args, **kwargs)
+
+    yield evaluate
+
+
+def abstract_params(init_fn: Callable, *args, **kwargs):
+    """Shape/dtype pytree of ``init_fn(*args)`` with zero FLOPs/memory."""
+    import jax
+
+    return jax.eval_shape(init_fn, *args, **kwargs)
+
+
+def _walk_insertion_order(tree: Any, prefix: str = ""):
+    """Yield (path, leaf) preserving dict insertion order — module
+    *definition* order, which the greedy packer must honour (jax's
+    tree_flatten sorts keys alphabetically and would scramble layers)."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk_insertion_order(v, f"{prefix}{k}/")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _walk_insertion_order(v, f"{prefix}{i}/")
+    else:
+        yield prefix[:-1], tree
+
+
+def compute_module_sizes(params: Any, prefix_depth: int = 1) -> dict[str, int]:
+    """Bytes per top-level (or depth-N) parameter group, in definition order
+    (reference: utils/modeling.py compute_module_sizes)."""
+    sizes: dict[str, int] = {}
+    for path, leaf in _walk_insertion_order(params):
+        group = "/".join(path.split("/")[:prefix_depth])
+        nbytes = int(np.prod(getattr(leaf, "shape", (1,)) or (1,))) * np.dtype(leaf.dtype).itemsize
+        sizes[group] = sizes.get(group, 0) + nbytes
+    return sizes
+
+
+def get_max_memory(max_memory: Optional[dict] = None) -> dict:
+    """Per-device HBM budgets (reference: utils/modeling.py:761 probes
+    ``torch.cuda.mem_get_info``; here ``device.memory_stats``)."""
+    import jax
+
+    if max_memory is not None:
+        return {k: _parse_size(v) for k, v in max_memory.items()}
+    out = {}
+    for i, d in enumerate(jax.local_devices()):
+        try:
+            stats = d.memory_stats()
+            budget = int(stats.get("bytes_limit", 16 * 2**30) * 0.9) - int(stats.get("bytes_in_use", 0))
+        except Exception:
+            budget = int(16 * 2**30 * 0.9)
+        out[i] = budget
+    out["cpu"] = int(0.8 * _host_ram_bytes())
+    return out
+
+
+def _host_ram_bytes() -> int:
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        return 32 * 2**30
+
+
+def _parse_size(size) -> int:
+    if isinstance(size, (int, float)):
+        return int(size)
+    m = re.fullmatch(r"([\d.]+)\s*([KMGT]?i?B)", str(size).strip(), re.IGNORECASE)
+    if not m:
+        raise ValueError(f"cannot parse memory size {size!r}")
+    mult = {"B": 1, "KB": 10**3, "MB": 10**6, "GB": 10**9, "TB": 10**12,
+            "KIB": 2**10, "MIB": 2**20, "GIB": 2**30, "TIB": 2**40}[m.group(2).upper()]
+    return int(float(m.group(1)) * mult)
+
+
+def infer_auto_device_map(
+    params: Any,
+    max_memory: Optional[dict] = None,
+    no_split_module_classes=None,
+    prefix_depth: int = 2,
+    tied_groups: Optional[list[list[str]]] = None,
+) -> dict[str, Union[int, str]]:
+    """Greedy layer-group -> placement packing
+    (reference: utils/modeling.py:1294-1601 incl. tied-weight accounting).
+
+    Returns ``{group_prefix: device_index | "cpu" | "disk"}``, filling
+    devices in order, then host RAM, then disk. Tied groups (weight-shared,
+    e.g. embeddings/lm_head) are forced to the same placement.
+    """
+    budgets = get_max_memory(max_memory)
+    sizes = compute_module_sizes(params, prefix_depth=prefix_depth)
+    device_order = [k for k in budgets if k not in ("cpu", "disk")] + ["cpu", "disk"]
+    remaining = {k: budgets.get(k, float("inf")) for k in device_order}
+    remaining.setdefault("disk", float("inf"))
+
+    tied = {}
+    for group in tied_groups or []:
+        for name in group:
+            tied[name] = group[0]
+
+    device_map: dict[str, Union[int, str]] = {}
+    cursor = 0
+    for group, nbytes in sizes.items():
+        if group in tied and tied[group] in device_map:
+            device_map[group] = device_map[tied[group]]
+            continue
+        placed = False
+        while cursor < len(device_order):
+            dev = device_order[cursor]
+            if remaining.get(dev, 0) >= nbytes:
+                device_map[group] = dev
+                remaining[dev] -= nbytes
+                placed = True
+                break
+            cursor += 1
+        if not placed:
+            device_map[group] = "disk"
+    return device_map
+
+
+def get_balanced_memory(params: Any, num_devices: int, prefix_depth: int = 2) -> dict:
+    """Even split targets (reference: utils/modeling.py:935)."""
+    total = sum(compute_module_sizes(params, prefix_depth).values())
+    per = int(total / num_devices * 1.15)  # slack for activations
+    return {i: per for i in range(num_devices)}
+
+
+# --------------------------------------------------------------------- #
+# dispatch + streamed execution
+# --------------------------------------------------------------------- #
+
+
+class DispatchedParams:
+    """Parameters split by placement tier: device-resident jax arrays,
+    host-RAM numpy, and disk-memmap lazy entries. The functional analogue
+    of a ``dispatch_model``-ed module (reference: big_modeling.py:309)."""
+
+    def __init__(self, flat: dict[str, Any], device_map: dict, offload_dir: Optional[str] = None):
+        import jax
+
+        self.device_map = dict(device_map)
+        self.flat_device: dict[str, Any] = {}
+        self.flat_host: dict[str, np.ndarray] = {}
+        self.disk_loader: Optional[OffloadedWeightsLoader] = None
+        devices = jax.local_devices()
+
+        disk_entries = {}
+        for name, value in flat.items():
+            placement = self._placement_for(name)
+            if placement == "disk":
+                disk_entries[name] = value
+            elif placement == "cpu":
+                self.flat_host[name] = np.asarray(value)
+            else:
+                idx = int(placement) if placement is not None else 0
+                self.flat_device[name] = jax.device_put(value, devices[min(idx, len(devices) - 1)])
+        if disk_entries:
+            if offload_dir is None:
+                raise ValueError("disk placements require offload_dir")
+            offload_state_dict(offload_dir, disk_entries)
+            self.disk_loader = OffloadedWeightsLoader(save_folder=offload_dir)
+
+    def _placement_for(self, name: str):
+        best, best_len = None, -1
+        for prefix, placement in self.device_map.items():
+            if (name == prefix or name.startswith(prefix + "/")) and len(prefix) > best_len:
+                best, best_len = placement, len(prefix)
+        return best
+
+    def __getitem__(self, name: str):
+        if name in self.flat_device:
+            return self.flat_device[name]
+        if name in self.flat_host:
+            return self.flat_host[name]
+        if self.disk_loader is not None and name in self.disk_loader:
+            return self.disk_loader[name]
+        raise KeyError(name)
+
+    def keys(self):
+        keys = set(self.flat_device) | set(self.flat_host)
+        if self.disk_loader is not None:
+            keys |= set(self.disk_loader.all_keys)
+        return sorted(keys)
+
+
+class StreamedExecutor:
+    """Layer-streamed forward: weights for layer i+1 prefetch (async
+    ``device_put``) while layer i computes — the double-buffered
+    replacement for the reference's AlignDevicesHook pre_forward H2D copy
+    (hooks.py:328-371) and post_forward re-offload (:373-402).
+
+    ``layer_params``: list of host-side pytrees (one per layer).
+    ``layer_fn(params_i, carry, i)`` -> carry.
+    """
+
+    def __init__(self, layer_params: list, layer_fn: Callable, device=None, jit: bool = True):
+        import jax
+
+        self.layer_params = layer_params
+        self.device = device or jax.local_devices()[0]
+        self.layer_fn = jax.jit(layer_fn, static_argnums=(2,)) if jit else layer_fn
+
+    def __call__(self, carry):
+        import jax
+
+        n = len(self.layer_params)
+        if n == 0:
+            return carry
+        next_weights = jax.device_put(self.layer_params[0], self.device)
+        for i in range(n):
+            weights = next_weights
+            if i + 1 < n:
+                # schedule the next transfer before blocking on compute
+                next_weights = jax.device_put(self.layer_params[i + 1], self.device)
+            carry = self.layer_fn(weights, carry, i)
+            # drop the consumed layer's device buffers eagerly
+            jax.tree_util.tree_map(lambda x: x.delete() if hasattr(x, "delete") else None, weights)
+        return carry
+
+
+def dispatch_model(
+    model,
+    device_map: dict,
+    offload_dir: Optional[str] = None,
+    state_dict: Optional[dict] = None,
+):
+    """Place a Model's params per ``device_map`` and rebind its params to a
+    :class:`DispatchedParams` view (reference: big_modeling.py:309-509)."""
+    flat = state_dict if state_dict is not None else model.state_dict()
+    dispatched = DispatchedParams(flat, device_map, offload_dir=offload_dir)
+    model.dispatched_params = dispatched
+    model.device_map = device_map
+    return model
+
+
+def load_checkpoint_in_model(
+    flat_target: dict[str, Any],
+    checkpoint: str,
+    device_map: Optional[dict] = None,
+    offload_dir: Optional[str] = None,
+) -> DispatchedParams | dict:
+    """Load safetensors (single file, shard index, or directory) straight to
+    placement tiers (reference: utils/modeling.py:1804)."""
+    state: dict[str, np.ndarray] = {}
+    index_file = None
+    if os.path.isdir(checkpoint):
+        candidates = [f for f in os.listdir(checkpoint) if f.endswith(".safetensors.index.json")]
+        if candidates:
+            index_file = os.path.join(checkpoint, candidates[0])
+        else:
+            from safetensors.numpy import load_file
+
+            for f in sorted(os.listdir(checkpoint)):
+                if f.endswith(".safetensors"):
+                    state.update(load_file(os.path.join(checkpoint, f)))
+    elif checkpoint.endswith(".index.json"):
+        index_file = checkpoint
+    else:
+        from safetensors.numpy import load_file
+
+        state = load_file(checkpoint)
+
+    if index_file is not None:
+        from safetensors.numpy import load_file
+
+        with open(index_file) as f:
+            weight_map = json.load(f)["weight_map"]
+        base = os.path.dirname(index_file)
+        for shard in sorted(set(weight_map.values())):
+            state.update(load_file(os.path.join(base, shard)))
+
+    missing = [k for k in flat_target if k not in state]
+    if missing:
+        raise KeyError(f"checkpoint missing {len(missing)} keys, e.g. {missing[:3]}")
+    if device_map is None:
+        return state
+    return DispatchedParams(state, device_map, offload_dir=offload_dir)
+
+
+def load_checkpoint_and_dispatch(
+    model,
+    checkpoint: str,
+    device_map: Optional[Union[str, dict]] = "auto",
+    max_memory: Optional[dict] = None,
+    offload_dir: Optional[str] = None,
+):
+    """(reference: big_modeling.py:512)."""
+    flat_target = {k: None for k in model.state_dict().keys()} if model.params is not None else {}
+    if device_map == "auto":
+        device_map = infer_auto_device_map(model.params, max_memory=max_memory)
+    state = load_checkpoint_in_model(flat_target, checkpoint, device_map=None)
+    return dispatch_model(model, device_map, offload_dir=offload_dir, state_dict=state)

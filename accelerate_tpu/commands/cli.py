@@ -1,0 +1,32 @@
+"""CLI root: subcommand registry
+(reference: src/accelerate/commands/accelerate_cli.py:28-50)."""
+
+from __future__ import annotations
+
+import argparse
+
+from .config import config_parser
+from .env import env_parser
+from .estimate import estimate_parser
+from .launch import launch_parser
+from .merge import merge_parser
+from .test import test_parser
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    launch_parser(subparsers)
+    config_parser(subparsers)
+    env_parser(subparsers)
+    test_parser(subparsers)
+    estimate_parser(subparsers)
+    merge_parser(subparsers)
+    args = parser.parse_args()
+    raise SystemExit(args.func(args) or 0)
+
+
+if __name__ == "__main__":
+    main()

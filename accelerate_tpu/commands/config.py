@@ -1,0 +1,130 @@
+"""``accelerate-tpu config`` — YAML config file management.
+
+Reference analogue: src/accelerate/commands/config/ (869-LoC interactive
+questionnaire + menu widget + schema at config_args.py:179-234). The
+schema keeps the reference's core keys (num_processes, mixed_precision,
+tpu_name/tpu_zone) plus mesh-shape fields; the questionnaire is a compact
+prompt loop rather than a cursor-driven menu.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import json
+
+CONFIG_KEYS = {
+    "num_processes": int,
+    "num_machines": int,
+    "mixed_precision": str,
+    "mesh_data": int,
+    "mesh_fsdp": int,
+    "mesh_tensor": int,
+    "mesh_seq": int,
+    "mesh_pipe": int,
+    "mesh_expert": int,
+    "main_process_ip": str,
+    "main_process_port": int,
+    "tpu_name": str,
+    "tpu_zone": str,
+    "tpu_hosts": str,
+    "gradient_accumulation_steps": int,
+    "debug": bool,
+}
+
+
+def default_config_path() -> str:
+    """(reference default: ~/.cache/huggingface/accelerate/default_config.yaml,
+    config_args.py:40-60)."""
+    cache = os.environ.get("ACCELERATE_TPU_HOME", os.path.expanduser("~/.cache/accelerate_tpu"))
+    return os.path.join(cache, "default_config.yaml")
+
+
+def _dump_yaml(config: dict) -> str:
+    try:
+        import yaml
+
+        return yaml.safe_dump(config, sort_keys=True)
+    except ImportError:
+        return json.dumps(config, indent=2, sort_keys=True)
+
+
+def _load_yaml(text: str) -> dict:
+    try:
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    except ImportError:
+        return json.loads(text)
+
+
+def load_config(path: str) -> dict:
+    with open(path) as f:
+        config = _load_yaml(f.read())
+    out = {}
+    for key, value in config.items():
+        if key in CONFIG_KEYS and value is not None:
+            caster = CONFIG_KEYS[key]
+            out[key] = bool(value) if caster is bool else caster(value)
+    return out
+
+
+def save_config(config: dict, path: str | None = None) -> str:
+    path = path or default_config_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(_dump_yaml(config))
+    return path
+
+
+def interactive_config() -> dict:
+    """Compact questionnaire (reference: commands/config/cluster.py)."""
+    config = {}
+
+    def ask(key, prompt, default, caster=str):
+        raw = input(f"{prompt} [{default}]: ").strip()
+        config[key] = caster(raw) if raw else default
+
+    ask("num_machines", "How many machines (pod hosts)?", 1, int)
+    ask("mixed_precision", "Mixed precision (no/bf16/fp16/fp8)?", "bf16")
+    ask("mesh_data", "Data-parallel mesh axis size (-1 = all remaining)", -1, int)
+    ask("mesh_fsdp", "FSDP mesh axis size", 1, int)
+    ask("mesh_tensor", "Tensor-parallel mesh axis size", 1, int)
+    ask("mesh_seq", "Sequence-parallel mesh axis size", 1, int)
+    ask("gradient_accumulation_steps", "Gradient accumulation steps", 1, int)
+    if config["num_machines"] > 1:
+        ask("tpu_hosts", "Comma-separated pod host list", "")
+        ask("main_process_port", "Coordinator port", 7777, int)
+    return config
+
+
+def config_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", help="Create the default launch config")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config")
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--default", action="store_true", help="write defaults without prompting")
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser
+
+
+def config_command(args) -> int:
+    if args.default:
+        config = {"num_machines": 1, "mixed_precision": "bf16", "mesh_data": -1}
+    else:
+        config = interactive_config()
+    path = save_config(config, args.config_file)
+    print(f"Configuration saved to {path}")
+    return 0
+
+
+def main():
+    args = config_parser().parse_args()
+    raise SystemExit(config_command(args))
+
+
+if __name__ == "__main__":
+    main()

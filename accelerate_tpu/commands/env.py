@@ -1,0 +1,56 @@
+"""``accelerate-tpu env`` — platform diagnostic
+(reference: src/accelerate/commands/env.py, 131 LoC)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+
+def env_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", help="Print environment diagnostics")
+        parser.set_defaults(func=env_command)
+        return parser
+    return argparse.ArgumentParser("accelerate-tpu env")
+
+
+def env_command(args=None) -> int:
+    import accelerate_tpu
+    from accelerate_tpu.utils.imports import package_version
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "jax version": package_version("jax"),
+        "jaxlib version": package_version("jaxlib"),
+        "flax version": package_version("flax"),
+        "optax version": package_version("optax"),
+        "orbax version": package_version("orbax-checkpoint"),
+        "numpy version": package_version("numpy"),
+    }
+    try:
+        import jax
+
+        info["JAX backend"] = jax.default_backend()
+        info["Devices"] = ", ".join(str(d) for d in jax.devices())
+        info["Process count"] = jax.process_count()
+    except Exception as e:  # backend may be unreachable
+        info["JAX backend"] = f"unavailable ({e})"
+    info["ACCELERATE_* env"] = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")} or "none"
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, value in info.items():
+        print(f"- `{key}`: {value}")
+    return 0
+
+
+def main():
+    env_parser().parse_args()
+    raise SystemExit(env_command())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""``accelerate-tpu estimate-memory`` — HBM requirement estimator.
+
+Reference analogue: src/accelerate/commands/estimate.py (312 LoC — builds a
+meta-model from the Hub and prints a dtype table). Zero-egress version:
+estimates from a local safetensors checkpoint / config.json, or from a
+parameter count, and reports per-dtype totals for inference and Adam
+training (params + grads + 2 moments), plus how the total divides across a
+mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "float8": 1}
+
+
+def count_params_from_safetensors(path: str) -> int:
+    """Read tensor shapes from safetensors headers (no data loaded)."""
+    import struct
+
+    total = 0
+    files = []
+    if os.path.isdir(path):
+        files = [os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")]
+    elif path.endswith(".safetensors"):
+        files = [path]
+    for file in files:
+        with open(file, "rb") as f:
+            header_len = struct.unpack("<Q", f.read(8))[0]
+            header = json.loads(f.read(header_len))
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            n = 1
+            for d in meta["shape"]:
+                n *= d
+            total += n
+    return total
+
+
+def estimate_table(num_params: int, mesh_devices: int = 1, training: bool = True) -> list[dict]:
+    rows = []
+    for dtype, bytes_per in DTYPE_BYTES.items():
+        weights = num_params * bytes_per
+        # Adam training state: fp32 master + grads + 2 moments (fp32)
+        train = weights + num_params * 4 * 3 if training else None
+        rows.append(
+            {
+                "dtype": dtype,
+                "params": num_params,
+                "inference_bytes": weights,
+                "training_bytes": train,
+                "inference_per_device": weights / mesh_devices,
+                "training_per_device": (train / mesh_devices) if train else None,
+            }
+        )
+    return rows
+
+
+def _human(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024:
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} PB"
+
+
+def estimate_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", help="Estimate HBM requirements")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory")
+    parser.add_argument("source", help="safetensors file/dir, or a parameter count like 7B / 124M / 350000")
+    parser.add_argument("--num_devices", type=int, default=1, help="mesh size to divide across")
+    parser.add_argument("--inference_only", action="store_true")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def parse_param_count(text: str) -> int:
+    text = text.strip().upper()
+    mult = 1
+    if text.endswith("B"):
+        mult, text = 10**9, text[:-1]
+    elif text.endswith("M"):
+        mult, text = 10**6, text[:-1]
+    elif text.endswith("K"):
+        mult, text = 10**3, text[:-1]
+    return int(float(text) * mult)
+
+
+def estimate_command(args) -> int:
+    if os.path.exists(args.source):
+        num_params = count_params_from_safetensors(args.source)
+    else:
+        num_params = parse_param_count(args.source)
+    rows = estimate_table(num_params, args.num_devices, training=not args.inference_only)
+    print(f"Memory estimate for {num_params:,} parameters over {args.num_devices} device(s):")
+    header = f"{'dtype':>10} | {'inference':>12} | {'train(Adam)':>12} | {'inf/device':>12} | {'train/device':>12}"
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print(
+            f"{r['dtype']:>10} | {_human(r['inference_bytes']):>12} | {_human(r['training_bytes']):>12} | "
+            f"{_human(r['inference_per_device']):>12} | {_human(r['training_per_device']):>12}"
+        )
+    return 0
+
+
+def main():
+    raise SystemExit(estimate_command(estimate_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

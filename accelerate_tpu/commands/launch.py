@@ -1,0 +1,163 @@
+"""``accelerate-tpu launch`` — process spawner.
+
+Reference analogue: src/accelerate/commands/launch.py (1209 LoC): ~120 flags
+merged with YAML config, routed to torchrun / deepspeed / xmp.spawn / pod-SSH
+launchers. The TPU-native launcher is radically simpler because JAX SPMD
+needs **one process per host**, not one per accelerator:
+
+* single host (1 process, N chips): exec the script with the env protocol
+  set — no spawning at all;
+* multi-process on one machine (CPU fake-mesh testing / explicit
+  ``--num_processes``): spawn N processes with a local coordinator, each
+  pinned to its devices;
+* TPU pod: one process per pod host, discovered from GCE metadata or
+  ``--hosts``, launched over SSH re-invoking this launcher per host
+  (reference tpu_pod_launcher: commands/launch.py:909-965).
+
+Config channel stays env vars (``ACCELERATE_*`` protocol, reference:
+utils/launch.py:203-352).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from ..utils.environment import str_to_bool
+
+
+def launch_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("launch", help="Launch a training script on this host/pod")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu launch")
+    parser.add_argument("--num_processes", type=int, default=1, help="processes to spawn (hosts on a pod)")
+    parser.add_argument("--num_machines", type=int, default=1)
+    parser.add_argument("--machine_rank", type=int, default=0)
+    parser.add_argument("--main_process_ip", default="127.0.0.1")
+    parser.add_argument("--main_process_port", type=int, default=None)
+    parser.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    parser.add_argument("--mesh_data", type=int, default=None)
+    parser.add_argument("--mesh_fsdp", type=int, default=None)
+    parser.add_argument("--mesh_tensor", type=int, default=None)
+    parser.add_argument("--mesh_seq", type=int, default=None)
+    parser.add_argument("--mesh_pipe", type=int, default=None)
+    parser.add_argument("--mesh_expert", type=int, default=None)
+    parser.add_argument("--debug", action="store_true", help="enable collective shape verification")
+    parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    parser.add_argument("--fake_devices", type=int, default=None, help="CPU fake-mesh device count (testing)")
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--tpu_hosts", default=None, help="comma-separated pod host list for SSH fan-out")
+    parser.add_argument("--ssh_user", default=None)
+    parser.add_argument("training_script", help="script to launch")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER, default=[])
+    if subparsers is not None:
+        parser.set_defaults(func=launch_command)
+    return parser
+
+
+def build_env(args, process_id: int = 0, num_processes: int = 1) -> dict:
+    """The launcher->script env protocol (reference: utils/launch.py:203)."""
+    env = os.environ.copy()
+    if args.mixed_precision:
+        env["ACCELERATE_MIXED_PRECISION"] = args.mixed_precision
+    if args.gradient_accumulation_steps:
+        env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(args.gradient_accumulation_steps)
+    for axis in ("data", "fsdp", "tensor", "seq", "pipe", "expert"):
+        val = getattr(args, f"mesh_{axis}")
+        if val is not None:
+            env[f"ACCELERATE_MESH_{axis.upper()}"] = str(val)
+    if args.debug:
+        env["ACCELERATE_DEBUG_MODE"] = "1"
+    if num_processes > 1:
+        port = args.main_process_port or 7777
+        env["ACCELERATE_COORDINATOR_ADDRESS"] = f"{args.main_process_ip}:{port}"
+        env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
+        env["ACCELERATE_PROCESS_ID"] = str(process_id)
+    if args.cpu or args.fake_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        if args.fake_devices:
+            env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={args.fake_devices}"
+    return env
+
+
+def _load_config_into_args(args):
+    if args.config_file is None:
+        from .config import default_config_path
+
+        if os.path.isfile(default_config_path()):
+            args.config_file = default_config_path()
+        else:
+            return args
+    from .config import load_config
+
+    config = load_config(args.config_file)
+    for key, value in config.items():
+        if hasattr(args, key) and getattr(args, key) in (None, 1, False, "127.0.0.1"):
+            setattr(args, key, value)
+    return args
+
+
+def simple_launcher(args) -> int:
+    """One process for all local chips (reference simple_launcher:
+    commands/launch.py:778)."""
+    env = build_env(args)
+    cmd = [sys.executable, args.training_script, *args.training_script_args]
+    return subprocess.call(cmd, env=env)
+
+
+def multi_process_launcher(args) -> int:
+    """N local processes with a JAX coordinator (testing / multi-host-sim;
+    replaces torchrun — reference: commands/launch.py:790-822)."""
+    procs = []
+    for rank in range(args.num_processes):
+        env = build_env(args, process_id=rank, num_processes=args.num_processes)
+        cmd = [sys.executable, args.training_script, *args.training_script_args]
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def pod_ssh_launcher(args) -> int:
+    """SSH fan-out: each pod host re-invokes the launcher locally
+    (reference tpu_pod_launcher: commands/launch.py:909-965)."""
+    hosts = [h.strip() for h in args.tpu_hosts.split(",") if h.strip()]
+    coordinator = f"{hosts[0]}:{args.main_process_port or 7777}"
+    procs = []
+    for rank, host in enumerate(hosts):
+        remote_cmd = (
+            f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
+            f"ACCELERATE_NUM_PROCESSES={len(hosts)} ACCELERATE_PROCESS_ID={rank} "
+            f"{sys.executable} {args.training_script} {' '.join(args.training_script_args)}"
+        )
+        target = f"{args.ssh_user}@{host}" if args.ssh_user else host
+        procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_command(args) -> int:
+    args = _load_config_into_args(args)
+    if args.tpu_hosts:
+        return pod_ssh_launcher(args)
+    if args.num_processes > 1:
+        return multi_process_launcher(args)
+    return simple_launcher(args)
+
+
+def main():
+    parser = launch_parser()
+    args = parser.parse_args()
+    raise SystemExit(launch_command(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""``accelerate-tpu merge-weights`` — consolidate a sharded checkpoint into
+standalone safetensors (reference: src/accelerate/commands/merge.py ->
+utils/fsdp_utils.py:330-412 merging FSDP DCP shards).
+
+Orbax checkpoints are already resharding-capable, so "merge" = load the
+pytree (unsharded on host) and re-export via ``save_model``'s safetensors
+writer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def merge_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", help="Merge a sharded checkpoint into safetensors")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights")
+    parser.add_argument("checkpoint_dir", help="directory produced by Accelerator.save_state")
+    parser.add_argument("output_dir")
+    parser.add_argument("--max_shard_size", default="10GB")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_command(args) -> int:
+    from pathlib import Path
+
+    import orbax.checkpoint as ocp
+
+    from ..checkpointing import MODEL_NAME, save_model
+    from ..modeling import Model
+
+    model_path = Path(args.checkpoint_dir) / MODEL_NAME
+    if not model_path.exists():
+        raise FileNotFoundError(f"no model checkpoint at {model_path}")
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(model_path.absolute())
+    model = Model(lambda p: p, params, name="merged")
+    save_model(model, args.output_dir, max_shard_size=args.max_shard_size)
+    print(f"Merged weights written to {args.output_dir}")
+    return 0
+
+
+def main():
+    raise SystemExit(merge_command(merge_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""``accelerate-tpu test`` — run the in-package self-checking distributed
+script through the launcher (reference: src/accelerate/commands/test.py:45-55
+running test_utils/scripts/test_script.py)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def test_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", help="Verify the install with a self-checking run")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test")
+    parser.add_argument("--fake_devices", type=int, default=8, help="CPU fake-mesh size (0 = real backend)")
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args) -> int:
+    import accelerate_tpu.test_utils.scripts.test_script as _script
+
+    script = _script.__file__
+    from .launch import launch_command, launch_parser
+
+    largs = launch_parser().parse_args(
+        ([f"--fake_devices={args.fake_devices}", "--cpu"] if args.fake_devices else []) + [script]
+    )
+    rc = launch_command(largs)
+    print("Test is a success! You are ready for distributed training." if rc == 0 else "Test FAILED.")
+    return rc
+
+
+def main():
+    raise SystemExit(test_command(test_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

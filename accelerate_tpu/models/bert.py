@@ -23,7 +23,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
@@ -94,7 +93,14 @@ class BertSelfAttention(nn.Module):
         from ..ops.attention import dot_product_attention
 
         mask = attention_mask[:, None, None, :]  # [B,1,1,S] additive-ready bool
-        out = dot_product_attention(q, k, v, mask=mask)
+        out = dot_product_attention(
+            q,
+            k,
+            v,
+            mask=mask,
+            dropout_rate=0.0 if deterministic else cfg.attention_probs_dropout_prob,
+            dropout_rng=None if deterministic else self.make_rng("dropout"),
+        )
         out = out.reshape(*out.shape[:-2], cfg.hidden_size)
         out = nn.Dense(cfg.hidden_size, name="out", dtype=hidden.dtype)(out)
         if not deterministic:
@@ -191,9 +197,18 @@ def create_bert_model(
     return model
 
 
-def bert_classification_loss(params, batch, apply_fn):
-    """Cross-entropy loss for the fine-tune head (fp32 logits/loss)."""
-    logits = apply_fn(params, batch["input_ids"], batch["attention_mask"], batch.get("token_type_ids"))
+def bert_classification_loss(params, batch, apply_fn, rng=None):
+    """Cross-entropy loss for the fine-tune head (fp32 logits/loss).
+    Pass ``rng`` (e.g. from the Accelerator's per-step key) to train with
+    dropout; without it the model runs deterministically."""
+    logits = apply_fn(
+        params,
+        batch["input_ids"],
+        batch["attention_mask"],
+        batch.get("token_type_ids"),
+        deterministic=rng is None,
+        rngs=None if rng is None else {"dropout": rng},
+    )
     labels = batch["labels"]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]

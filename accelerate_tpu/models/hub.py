@@ -1,0 +1,239 @@
+"""Torch-free import of HuggingFace checkpoints into the model zoo.
+
+The reference runs torch models directly; this framework's models are JAX
+pytrees, so interop is a *weight import*: read safetensors (numpy, no torch
+runtime), rename HF parameter paths to ours, transpose torch ``[out, in]``
+linear weights to flax ``[in, out]`` kernels, and (for scanned models)
+stack per-layer weights along the leading scan dim.
+
+Entry points: :func:`load_hf_bert`, :func:`load_hf_llama`, or the low-level
+``convert_hf_*_state`` on an already-loaded ``{name: np.ndarray}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+
+def read_safetensors_state(path: str) -> dict[str, np.ndarray]:
+    """Load a safetensors file / shard-index / directory into numpy."""
+    from safetensors.numpy import load_file
+
+    state: dict[str, np.ndarray] = {}
+    if os.path.isdir(path):
+        index = [f for f in os.listdir(path) if f.endswith(".safetensors.index.json")]
+        if index:
+            with open(os.path.join(path, index[0])) as f:
+                weight_map = json.load(f)["weight_map"]
+            for shard in sorted(set(weight_map.values())):
+                state.update(load_file(os.path.join(path, shard)))
+        else:
+            for f in sorted(os.listdir(path)):
+                if f.endswith(".safetensors"):
+                    state.update(load_file(os.path.join(path, f)))
+    else:
+        state = load_file(path)
+    return state
+
+
+def _strip_prefix(state: dict, prefixes: tuple[str, ...]) -> dict:
+    out = {}
+    for key, value in state.items():
+        for prefix in prefixes:
+            if key.startswith(prefix):
+                key = key[len(prefix):]
+                break
+        out[key] = value
+    return out
+
+
+def _set(tree: dict, path: str, value: np.ndarray):
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+# --------------------------------------------------------------------- #
+# BERT
+# --------------------------------------------------------------------- #
+
+_BERT_FIXED = {
+    "embeddings.word_embeddings.weight": ("encoder/embeddings/word_embeddings/embedding", False),
+    "embeddings.position_embeddings.weight": ("encoder/embeddings/position_embeddings/embedding", False),
+    "embeddings.token_type_embeddings.weight": ("encoder/embeddings/token_type_embeddings/embedding", False),
+    "embeddings.LayerNorm.weight": ("encoder/embeddings/norm/scale", False),
+    "embeddings.LayerNorm.bias": ("encoder/embeddings/norm/bias", False),
+    "pooler.dense.weight": ("pooler/kernel", True),
+    "pooler.dense.bias": ("pooler/bias", False),
+    "classifier.weight": ("classifier/kernel", True),
+    "classifier.bias": ("classifier/bias", False),
+}
+
+_BERT_LAYER = {
+    "attention.self.query.weight": ("attention/query/kernel", True),
+    "attention.self.query.bias": ("attention/query/bias", False),
+    "attention.self.key.weight": ("attention/key/kernel", True),
+    "attention.self.key.bias": ("attention/key/bias", False),
+    "attention.self.value.weight": ("attention/value/kernel", True),
+    "attention.self.value.bias": ("attention/value/bias", False),
+    "attention.output.dense.weight": ("attention/out/kernel", True),
+    "attention.output.dense.bias": ("attention/out/bias", False),
+    "attention.output.LayerNorm.weight": ("attention_norm/scale", False),
+    "attention.output.LayerNorm.bias": ("attention_norm/bias", False),
+    "intermediate.dense.weight": ("ffn/intermediate/kernel", True),
+    "intermediate.dense.bias": ("ffn/intermediate/bias", False),
+    "output.dense.weight": ("ffn/output/kernel", True),
+    "output.dense.bias": ("ffn/output/bias", False),
+    "output.LayerNorm.weight": ("ffn_norm/scale", False),
+    "output.LayerNorm.bias": ("ffn_norm/bias", False),
+}
+
+
+def convert_hf_bert_state(state: dict[str, np.ndarray]) -> dict:
+    """HF ``bert-*`` (BertForSequenceClassification) -> our param pytree."""
+    state = _strip_prefix(state, ("bert.",))
+    tree: dict = {}
+    for hf_key, (ours, transpose) in _BERT_FIXED.items():
+        if hf_key in state:
+            value = state[hf_key]
+            _set(tree, ours, value.T if transpose else value)
+    layer_re = re.compile(r"encoder\.layer\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = layer_re.match(key)
+        if not m:
+            continue
+        idx, rest = int(m.group(1)), m.group(2)
+        if rest in _BERT_LAYER:
+            ours, transpose = _BERT_LAYER[rest]
+            _set(tree, f"encoder/layer_{idx}/{ours}", value.T if transpose else value)
+    return tree
+
+
+def load_hf_bert(checkpoint_path: str, config=None):
+    """Build a BERT Model and load HF weights into it."""
+    import jax
+
+    from .bert import BertConfig, create_bert_model
+
+    state = read_safetensors_state(checkpoint_path)
+    tree = convert_hf_bert_state(state)
+    model = create_bert_model(config or BertConfig.base())
+    _merge_into(model, tree)
+    return model
+
+
+# --------------------------------------------------------------------- #
+# Llama
+# --------------------------------------------------------------------- #
+
+_LLAMA_FIXED = {
+    "model.embed_tokens.weight": ("embed_tokens/embedding", False),
+    "model.norm.weight": ("final_norm/scale", False),
+    "lm_head.weight": ("lm_head/kernel", True),
+}
+
+_LLAMA_LAYER = {
+    "self_attn.q_proj.weight": ("attn/q_proj/kernel", True),
+    "self_attn.k_proj.weight": ("attn/k_proj/kernel", True),
+    "self_attn.v_proj.weight": ("attn/v_proj/kernel", True),
+    "self_attn.o_proj.weight": ("attn/o_proj/kernel", True),
+    "mlp.gate_proj.weight": ("mlp/gate_proj/kernel", True),
+    "mlp.up_proj.weight": ("mlp/up_proj/kernel", True),
+    "mlp.down_proj.weight": ("mlp/down_proj/kernel", True),
+    "input_layernorm.weight": ("input_norm/scale", False),
+    "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
+}
+
+
+def convert_hf_llama_state(state: dict[str, np.ndarray], scan_layers: bool = True) -> dict:
+    """HF ``*ForCausalLM`` Llama -> our param pytree. With ``scan_layers``
+    the per-layer weights are stacked along a leading layer dim to match
+    the scanned module layout (``layers/block/...``)."""
+    tree: dict = {}
+    for hf_key, (ours, transpose) in _LLAMA_FIXED.items():
+        if hf_key in state:
+            value = state[hf_key]
+            _set(tree, ours, value.T if transpose else value)
+    # lm_head may be tied to embeddings in some checkpoints
+    if "lm_head" not in tree and "model.embed_tokens.weight" in state:
+        _set(tree, "lm_head/kernel", state["model.embed_tokens.weight"].T)
+
+    layer_re = re.compile(r"model\.layers\.(\d+)\.(.+)")
+    per_layer: dict[int, dict[str, np.ndarray]] = {}
+    for key, value in state.items():
+        m = layer_re.match(key)
+        if not m:
+            continue
+        idx, rest = int(m.group(1)), m.group(2)
+        if rest in _LLAMA_LAYER:
+            ours, transpose = _LLAMA_LAYER[rest]
+            per_layer.setdefault(idx, {})[ours] = value.T if transpose else value
+    if not per_layer:
+        return tree
+    n_layers = max(per_layer) + 1
+    if scan_layers:
+        for ours in _LLAMA_LAYER.values():
+            name = ours[0]
+            stacked = np.stack([per_layer[i][name] for i in range(n_layers)])
+            _set(tree, f"layers/block/{name}", stacked)
+    else:
+        for i in range(n_layers):
+            for name, value in per_layer[i].items():
+                _set(tree, f"layer_{i}/{name}", value)
+    return tree
+
+
+def load_hf_llama(checkpoint_path: str, config=None):
+    import jax
+
+    from .llama import LlamaConfig, create_llama_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or LlamaConfig.llama2_7b()
+    tree = convert_hf_llama_state(state, scan_layers=config.scan_layers)
+    model = create_llama_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+def _merge_into(model, tree: dict):
+    """Replace model params with imported values (shape-checked; values not
+    present keep their initialisation)."""
+    import jax
+
+    from ..parallel.sharding import path_str
+
+    flat_imported = {}
+
+    def flatten(node, prefix=""):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                flatten(v, f"{prefix}{k}/")
+        else:
+            flat_imported[prefix[:-1]] = node
+
+    flatten(tree)
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(model.params)
+    new_leaves = []
+    imported = 0
+    for kp, old in leaves:
+        key = path_str(kp)
+        if key in flat_imported:
+            new = np.asarray(flat_imported[key])
+            if tuple(new.shape) != tuple(old.shape):
+                raise ValueError(f"shape mismatch importing {key}: {new.shape} vs {old.shape}")
+            new_leaves.append(new.astype(old.dtype))
+            imported += 1
+        else:
+            new_leaves.append(old)
+    model.params = jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(model.params), new_leaves)
+    model.imported_weight_count = imported
+    return model

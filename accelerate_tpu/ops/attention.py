@@ -26,16 +26,20 @@ FLASH_MIN_SEQ = 1024
 
 
 def dot_product_attention(
-    q: jax.Array,  # [B, S, H, D]
-    k: jax.Array,  # [B, S, H_kv, D]
-    v: jax.Array,  # [B, S, H_kv, D]
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, H_kv, D]
+    v: jax.Array,  # [B, Sk, H_kv, D]
     mask: Optional[jax.Array] = None,  # bool, broadcastable to [B, H, Sq, Sk]
     causal: bool = False,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jax.Array:
     """Multi-head attention with optional GQA (H_kv divides H) and
-    flash-kernel dispatch. Returns [B, S, H, D]."""
+    flash-kernel dispatch. Causal masking is bottom-right aligned when
+    Sq != Sk (decode/chunked attention: query i attends keys
+    ``0..Sk-Sq+i``). Returns [B, Sq, H, D]."""
     head_dim = q.shape[-1]
     scale = scale if scale is not None else head_dim**-0.5
     seq_len = q.shape[1]
@@ -45,8 +49,13 @@ def dot_product_attention(
             jax.default_backend() == "tpu"
             and seq_len >= FLASH_MIN_SEQ
             and mask is None  # kernel supports causal masking only
+            and dropout_rate == 0.0
         )
     if use_flash:
+        if mask is not None:
+            raise ValueError("flash attention supports causal masking only; pass mask=None or use_flash=False")
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            raise ValueError("flash attention does not support attention-prob dropout; use_flash=False")
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)
@@ -61,11 +70,16 @@ def dot_product_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)
     if causal:
-        q_pos = jnp.arange(seq_len)[:, None]
+        offset = k.shape[1] - seq_len  # bottom-right alignment
+        q_pos = jnp.arange(seq_len)[:, None] + offset
         k_pos = jnp.arange(k.shape[1])[None, :]
         causal_mask = q_pos >= k_pos
         logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
-    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
+    weights = weights.astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)

@@ -5,10 +5,15 @@ The flash-attention recurrence (running max + running normaliser) expressed
 as ``lax.scan`` over KV blocks: O(S) activation memory instead of the
 O(S^2) logits tensor, fully differentiable (AD through the scan yields the
 standard recompute-style backward), and XLA fuses each block's
-matmul+softmax chain onto the MXU. The reference framework has no
-long-context mechanism at all (SURVEY §5 long-context: only Megatron-SP);
-this op is the parity-plus path, and the hand-tiled Pallas kernel
-(same signature) can replace the scan body without touching callers.
+matmul+softmax chain onto the MXU. GQA is handled natively — K/V are never
+repeated; queries are grouped as [B, Sq, H_kv, G, D] and contracted against
+the unexpanded KV blocks, preserving GQA's KV-memory saving. Causal masking
+is bottom-right aligned when Sq != Sk (decode/chunked attention).
+
+The reference framework has no long-context mechanism at all (SURVEY §5
+long-context: only Megatron-SP); this op is the parity-plus path, and the
+hand-tiled Pallas kernel (same signature) can replace the scan body without
+touching callers.
 """
 
 from __future__ import annotations
@@ -30,13 +35,10 @@ def flash_attention(
     block_size: int = 512,
 ) -> jax.Array:
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    num_heads, num_kv = q.shape[-2], k.shape[-2]
-    if num_kv != num_heads:
-        k = jnp.repeat(k, num_heads // num_kv, axis=-2)
-        v = jnp.repeat(v, num_heads // num_kv, axis=-2)
-
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, h_kv = k.shape[1], k.shape[-2]
+    g = h // h_kv  # query groups per KV head (1 = vanilla MHA)
+
     blk = min(block_size, sk)
     if sk % blk != 0:
         # pad keys to a block multiple; padded positions are masked out
@@ -45,38 +47,39 @@ def flash_attention(
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     n_blocks = k.shape[1] // blk
 
-    qf = (q * scale).astype(q.dtype)
-    k_blocks = k.reshape(b, n_blocks, blk, h, d)
-    v_blocks = v.reshape(b, n_blocks, blk, h, d)
+    qf = (q * scale).reshape(b, sq, h_kv, g, d)
+    k_blocks = k.reshape(b, n_blocks, blk, h_kv, d)
+    v_blocks = v.reshape(b, n_blocks, blk, h_kv, d)
 
-    q_pos = jnp.arange(sq)
+    # bottom-right aligned absolute query positions (decode: Sq < Sk)
+    q_pos = jnp.arange(sq) + (sk - sq)
 
     def body(carry, inputs):
-        acc, m, l = carry  # [B,Sq,H,D], [B,H,Sq], [B,H,Sq]
+        acc, m, l = carry  # [B,Sq,Hkv,G,D], [B,Hkv,G,Sq], [B,Hkv,G,Sq]
         (k_blk, v_blk, blk_idx) = inputs
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk).astype(jnp.float32)  # [B,H,Sq,blk]
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk).astype(jnp.float32)  # [B,Hkv,G,Sq,blk]
         k_pos = blk_idx * blk + jnp.arange(blk)
         valid = k_pos < sk
         if causal:
             valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
-            s = jnp.where(valid[None, None], s, -jnp.inf)
+            s = jnp.where(valid[None, None, None], s, -jnp.inf)
         else:
-            s = jnp.where(valid[None, None, None, :], s, -jnp.inf)
-        m_blk = s.max(axis=-1)  # [B,H,Sq]
+            s = jnp.where(valid[None, None, None, None, :], s, -jnp.inf)
+        m_blk = s.max(axis=-1)  # [B,Hkv,G,Sq]
         m_new = jnp.maximum(m, m_blk)
         # guard fully-masked rows (all -inf): exp(-inf - -inf) -> use 0
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - safe_m[..., None])
         p = jnp.where(jnp.isfinite(s), p, 0.0)
-        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)  # [B,H,Sq]
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)  # [B,Hkv,G,Sq]
         l_new = l * correction + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
-        acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+        pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
+        acc = acc * correction.transpose(0, 3, 1, 2)[..., None] + pv
         return (acc, m_new, l_new), None
 
-    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
-    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, sq, h_kv, g, d), jnp.float32)
+    m0 = jnp.full((b, h_kv, g, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h_kv, g, sq), jnp.float32)
     (acc, m, l), _ = jax.lax.scan(
         jax.checkpoint(body),
         (acc0, m0, l0),
@@ -87,5 +90,5 @@ def flash_attention(
         ),
     )
     l = jnp.maximum(l, 1e-37)
-    out = acc / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    out = acc / l.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, sq, h, d).astype(q.dtype)

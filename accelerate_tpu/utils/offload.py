@@ -1,0 +1,85 @@
+"""Disk/host offload for over-HBM weights.
+
+Reference analogue: src/accelerate/utils/offload.py (213 LoC —
+``OffloadedWeightsLoader`` lazy mapping :127, ``offload_state_dict`` :85,
+numpy memmap writes :25). Same design: weights live in individual ``.dat``
+memmaps (or safetensors) with a JSON index; reads are lazy and zero-copy
+until device transfer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from typing import Optional
+
+import numpy as np
+
+
+def offload_weight(weight, weight_name: str, offload_folder: str, index: Optional[dict] = None) -> dict:
+    """(reference: utils/offload.py:25)."""
+    weight = np.asarray(weight)
+    dtype = str(weight.dtype)
+    array_path = os.path.join(offload_folder, f"{weight_name}.dat")
+    os.makedirs(os.path.dirname(array_path), exist_ok=True)  # names may contain '/'
+    if index is not None:
+        index[weight_name] = {"dtype": dtype, "shape": list(weight.shape)}
+    if weight.ndim == 0:
+        weight = weight[None]
+    mm = np.memmap(array_path, dtype=weight.dtype, mode="w+", shape=weight.shape)
+    mm[:] = weight[:]
+    mm.flush()
+    return index
+
+
+def load_offloaded_weight(weight_file: str, weight_info: dict):
+    """(reference: utils/offload.py:50)."""
+    shape = tuple(weight_info["shape"])
+    if len(shape) == 0:
+        return np.memmap(weight_file, dtype=weight_info["dtype"], mode="r", shape=(1,))[0]
+    return np.memmap(weight_file, dtype=weight_info["dtype"], mode="r", shape=shape)
+
+
+def offload_state_dict(save_dir: str, state_dict: Mapping) -> None:
+    """(reference: utils/offload.py:85)."""
+    os.makedirs(save_dir, exist_ok=True)
+    index = {}
+    for name, weight in state_dict.items():
+        index = offload_weight(weight, name, save_dir, index)
+    with open(os.path.join(save_dir, "index.json"), "w") as f:
+        json.dump(index, f, indent=2)
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Lazy ``{name: array}`` over memmap .dat files and/or safetensors
+    shards (reference: utils/offload.py:127)."""
+
+    def __init__(self, state_dict: Optional[dict] = None, save_folder: Optional[str] = None):
+        if state_dict is None and save_folder is None:
+            raise ValueError("need state_dict and/or save_folder")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        self.index = {}
+        if save_folder is not None:
+            index_path = os.path.join(save_folder, "index.json")
+            if os.path.isfile(index_path):
+                with open(index_path) as f:
+                    self.index = json.load(f)
+        self.all_keys = list(self.state_dict) + [k for k in self.index if k not in self.state_dict]
+
+    def __getitem__(self, key: str):
+        if key in self.state_dict:
+            return self.state_dict[key]
+        info = self.index[key]
+        if "safetensors_file" in info:
+            from safetensors.numpy import load_file
+
+            return load_file(info["safetensors_file"])[info.get("weight_name", key)]
+        return load_offloaded_weight(os.path.join(self.save_folder, f"{key}.dat"), info)
+
+    def __iter__(self):
+        return iter(self.all_keys)
+
+    def __len__(self):
+        return len(self.all_keys)

@@ -1,0 +1,141 @@
+"""Big-model stack tests (reference analogue: tests/test_big_modeling.py,
+1099 LoC — dispatch/offload with tiny models; tests/test_offload.py)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import (
+    DispatchedParams,
+    StreamedExecutor,
+    abstract_params,
+    compute_module_sizes,
+    dispatch_model,
+    infer_auto_device_map,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    load_checkpoint_in_model,
+)
+from accelerate_tpu.modeling import Model
+from accelerate_tpu.utils.offload import OffloadedWeightsLoader, offload_state_dict
+
+
+def tiny_flat():
+    return {
+        "layer_0/w": np.ones((64, 64), np.float32),  # 16 KB
+        "layer_0/b": np.ones((64,), np.float32),
+        "layer_1/w": np.ones((64, 64), np.float32),
+        "layer_1/b": np.ones((64,), np.float32),
+        "head/w": np.ones((64, 8), np.float32),
+    }
+
+
+def nested(flat):
+    out = {}
+    for k, v in flat.items():
+        a, b = k.split("/")
+        out.setdefault(a, {})[b] = v
+    return out
+
+
+def test_abstract_params_is_memoryless():
+    import jax.numpy as jnp
+
+    def init():
+        return {"w": jnp.zeros((10_000, 10_000))}  # 400 MB if real
+
+    abstract = abstract_params(init)
+    assert abstract["w"].shape == (10_000, 10_000)
+    assert not hasattr(abstract["w"], "addressable_shards")  # ShapeDtypeStruct
+
+
+def test_init_empty_weights_ctx():
+    import jax.numpy as jnp
+
+    with init_empty_weights() as empty:
+        abstract = empty(lambda: {"w": jnp.zeros((4, 4))})
+    assert abstract["w"].shape == (4, 4)
+
+
+def test_compute_module_sizes():
+    sizes = compute_module_sizes(nested(tiny_flat()), prefix_depth=1)
+    assert sizes["layer_0"] == 64 * 64 * 4 + 64 * 4
+    assert sizes["head"] == 64 * 8 * 4
+
+
+def test_infer_auto_device_map_tiers():
+    params = nested(tiny_flat())
+    # budget fits exactly one layer on device 0, one on cpu, rest disk
+    layer_bytes = 64 * 64 * 4 + 64 * 4
+    dm = infer_auto_device_map(params, max_memory={0: layer_bytes, "cpu": layer_bytes}, prefix_depth=1)
+    assert dm["layer_0"] == 0
+    assert dm["layer_1"] == "cpu"
+    assert dm["head"] == "disk"
+
+
+def test_infer_auto_device_map_tied_groups():
+    params = nested(tiny_flat())
+    dm = infer_auto_device_map(
+        params, max_memory={0: 10**9}, prefix_depth=1, tied_groups=[["layer_0", "head"]]
+    )
+    assert dm["head"] == dm["layer_0"]
+
+
+def test_dispatched_params_tiers(tmp_path):
+    flat = tiny_flat()
+    dm = {"layer_0": 0, "layer_1": "cpu", "head": "disk"}
+    dp = DispatchedParams(flat, dm, offload_dir=str(tmp_path / "offload"))
+    import jax
+
+    assert isinstance(dp["layer_0/w"], jax.Array)
+    assert isinstance(dp["layer_1/w"], np.ndarray)
+    head = dp["head/w"]
+    np.testing.assert_array_equal(np.asarray(head), flat["head/w"])
+    assert set(dp.keys()) == set(flat.keys())
+
+
+def test_streamed_executor_double_buffer():
+    import jax.numpy as jnp
+
+    layers = [{"w": np.full((4, 4), float(i + 1), np.float32)} for i in range(3)]
+
+    def layer_fn(params, x, i):
+        return x @ params["w"]
+
+    ex = StreamedExecutor(layers, layer_fn, jit=False)
+    out = ex(jnp.ones((2, 4)))
+    expected = np.ones((2, 4)) @ layers[0]["w"] @ layers[1]["w"] @ layers[2]["w"]
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_offloaded_weights_loader_roundtrip(tmp_path):
+    state = {"a": np.arange(6.0).reshape(2, 3), "s": np.float32(7)}
+    offload_state_dict(str(tmp_path), state)
+    loader = OffloadedWeightsLoader(save_folder=str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(loader["a"]), state["a"])
+    assert float(loader["s"]) == 7
+    assert len(loader) == 2
+
+
+def test_load_checkpoint_and_dispatch(tmp_path):
+    from accelerate_tpu.checkpointing import save_model
+
+    flat = tiny_flat()
+    model = Model(lambda p, x: x, nested(flat))
+    save_model(model, str(tmp_path / "export"))
+
+    fresh = Model(lambda p, x: x, nested({k: np.zeros_like(v) for k, v in flat.items()}))
+    dispatched = load_checkpoint_and_dispatch(
+        fresh,
+        str(tmp_path / "export"),
+        device_map={"layer_0": 0, "layer_1": "cpu", "head": "cpu"},
+    )
+    np.testing.assert_array_equal(np.asarray(dispatched.dispatched_params["head/w"]), flat["head/w"])
+
+
+def test_load_checkpoint_missing_key_raises(tmp_path):
+    from accelerate_tpu.checkpointing import save_model
+
+    model = Model(lambda p, x: x, {"a": {"w": np.ones(4, np.float32)}})
+    save_model(model, str(tmp_path / "export"))
+    with pytest.raises(KeyError):
+        load_checkpoint_in_model({"a/w": None, "b/missing": None}, str(tmp_path / "export"))

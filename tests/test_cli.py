@@ -1,0 +1,114 @@
+"""CLI + launcher tests (reference analogue: tests/test_cli.py, 643 LoC —
+config YAML round-trips through launch arg synthesis; and the tier-2
+subprocess-launch pattern from SURVEY §4)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+CPU_ENV = {
+    **os.environ,
+    "PALLAS_AXON_POOL_IPS": "",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+def run_cli(*args, env=None, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", *args],
+        capture_output=True,
+        text=True,
+        env=env or CPU_ENV,
+        timeout=timeout,
+    )
+
+
+def test_env_command():
+    result = run_cli("env")
+    assert result.returncode == 0
+    assert "accelerate_tpu version" in result.stdout
+    assert "JAX backend" in result.stdout
+
+
+def test_estimate_memory_param_count():
+    result = run_cli("estimate-memory", "124M", "--num_devices", "4")
+    assert result.returncode == 0
+    assert "124,000,000" in result.stdout
+    assert "bfloat16" in result.stdout
+
+
+def test_config_roundtrip(tmp_path):
+    cfg_path = tmp_path / "cfg.yaml"
+    result = run_cli("config", "--default", "--config_file", str(cfg_path))
+    assert result.returncode == 0
+    from accelerate_tpu.commands.config import load_config
+
+    config = load_config(str(cfg_path))
+    assert config["mixed_precision"] == "bf16"
+    assert config["mesh_data"] == -1
+
+
+def test_launch_env_protocol(tmp_path):
+    """Launcher flags surface as ACCELERATE_* env in the child
+    (reference env protocol: utils/launch.py:203)."""
+    script = tmp_path / "dump_env.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: v for k, v in os.environ.items() if k.startswith('ACCELERATE_')}))\n"
+    )
+    result = run_cli(
+        "launch",
+        "--mixed_precision", "bf16",
+        "--mesh_fsdp", "2",
+        "--gradient_accumulation_steps", "4",
+        "--debug",
+        str(script),
+    )
+    assert result.returncode == 0, result.stderr
+    env = json.loads(result.stdout.strip().splitlines()[-1])
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_MESH_FSDP"] == "2"
+    assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "4"
+    assert env["ACCELERATE_DEBUG_MODE"] == "1"
+
+
+def test_accelerator_reads_launcher_env(tmp_path):
+    """End-to-end: launch flags -> env -> AcceleratorState picks them up."""
+    script = tmp_path / "report.py"
+    script.write_text(
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "print('MESH', dict(acc.mesh.shape)['fsdp'], acc.mixed_precision, acc.gradient_accumulation_steps)\n"
+    )
+    result = run_cli(
+        "launch", "--cpu", "--fake_devices", "8",
+        "--mixed_precision", "bf16", "--mesh_fsdp", "4", "--gradient_accumulation_steps", "2",
+        str(script),
+    )
+    assert result.returncode == 0, result.stderr
+    assert "MESH 4 bf16 2" in result.stdout
+
+
+@pytest.mark.slow
+def test_multiprocess_launch(tmp_path):
+    """Two real processes with a JAX coordinator (the reference's
+    multi-process tier-2 pattern, tests/test_multigpu.py:49)."""
+    script = tmp_path / "mp.py"
+    script.write_text(
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "assert acc.num_processes == 2, acc.num_processes\n"
+        "objs = acc.gather_for_metrics([acc.process_index], use_gather_object=True)\n"
+        "assert sorted(objs) == [0, 1], objs\n"
+        "acc.wait_for_everyone()\n"
+        "print('MP_OK', acc.process_index)\n"
+    )
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4",
+        "--main_process_port", "7811", str(script),
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert result.stdout.count("MP_OK") >= 1

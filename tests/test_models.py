@@ -158,3 +158,125 @@ def test_causal_lm_loss_masks_final_position():
         causal_lm_loss(model.params, {"input_ids": ids, "labels": labels, "loss_mask": mask}, model.apply_fn)
     )
     np.testing.assert_allclose(base, explicit, rtol=1e-6)
+
+
+def test_hf_bert_weight_import(tmp_path):
+    """Synthetic HF-named checkpoint -> our pytree (transposes + renames)."""
+    from accelerate_tpu.models.hub import convert_hf_bert_state, load_hf_bert
+    from safetensors.numpy import save_file
+
+    cfg = BertConfig.tiny()
+    h, ffn, vocab = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    rng = np.random.default_rng(0)
+    state = {
+        "bert.embeddings.word_embeddings.weight": rng.normal(size=(vocab, h)).astype(np.float32),
+        "bert.embeddings.position_embeddings.weight": rng.normal(size=(cfg.max_position_embeddings, h)).astype(np.float32),
+        "bert.embeddings.token_type_embeddings.weight": rng.normal(size=(2, h)).astype(np.float32),
+        "bert.embeddings.LayerNorm.weight": np.ones(h, np.float32),
+        "bert.embeddings.LayerNorm.bias": np.zeros(h, np.float32),
+        "bert.pooler.dense.weight": rng.normal(size=(h, h)).astype(np.float32),
+        "bert.pooler.dense.bias": np.zeros(h, np.float32),
+        "classifier.weight": rng.normal(size=(2, h)).astype(np.float32),
+        "classifier.bias": np.zeros(2, np.float32),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"bert.encoder.layer.{i}."
+        state.update({
+            p + "attention.self.query.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "attention.self.query.bias": np.zeros(h, np.float32),
+            p + "attention.self.key.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "attention.self.key.bias": np.zeros(h, np.float32),
+            p + "attention.self.value.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "attention.self.value.bias": np.zeros(h, np.float32),
+            p + "attention.output.dense.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "attention.output.dense.bias": np.zeros(h, np.float32),
+            p + "attention.output.LayerNorm.weight": np.ones(h, np.float32),
+            p + "attention.output.LayerNorm.bias": np.zeros(h, np.float32),
+            p + "intermediate.dense.weight": rng.normal(size=(ffn, h)).astype(np.float32),
+            p + "intermediate.dense.bias": np.zeros(ffn, np.float32),
+            p + "output.dense.weight": rng.normal(size=(h, ffn)).astype(np.float32),
+            p + "output.dense.bias": np.zeros(h, np.float32),
+            p + "output.LayerNorm.weight": np.ones(h, np.float32),
+            p + "output.LayerNorm.bias": np.zeros(h, np.float32),
+        })
+    save_file(state, str(tmp_path / "model.safetensors"))
+    model = load_hf_bert(str(tmp_path / "model.safetensors"), config=cfg)
+    # transposition check: our kernel == HF weight.T
+    got = np.asarray(model.params["encoder"]["layer_0"]["attention"]["query"]["kernel"])
+    np.testing.assert_allclose(got, state["bert.encoder.layer.0.attention.self.query.weight"].T)
+    assert model.imported_weight_count == len(state)
+    # model runs with imported weights
+    logits = model(jnp.zeros((2, 16), jnp.int32), jnp.ones((2, 16), jnp.bool_))
+    assert logits.shape == (2, 2)
+
+
+def test_hf_llama_weight_import_scan_stacking(tmp_path):
+    from accelerate_tpu.models.hub import convert_hf_llama_state
+
+    cfg = LlamaConfig.tiny()
+    h, kv = cfg.hidden_size, cfg.num_key_value_heads * (cfg.hidden_size // cfg.num_attention_heads)
+    rng = np.random.default_rng(1)
+    state = {"model.embed_tokens.weight": rng.normal(size=(cfg.vocab_size, h)).astype(np.float32),
+             "model.norm.weight": np.ones(h, np.float32)}
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        state.update({
+            p + "self_attn.q_proj.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "self_attn.k_proj.weight": rng.normal(size=(kv, h)).astype(np.float32),
+            p + "self_attn.v_proj.weight": rng.normal(size=(kv, h)).astype(np.float32),
+            p + "self_attn.o_proj.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "mlp.gate_proj.weight": rng.normal(size=(cfg.intermediate_size, h)).astype(np.float32),
+            p + "mlp.up_proj.weight": rng.normal(size=(cfg.intermediate_size, h)).astype(np.float32),
+            p + "mlp.down_proj.weight": rng.normal(size=(h, cfg.intermediate_size)).astype(np.float32),
+            p + "input_layernorm.weight": np.ones(h, np.float32),
+            p + "post_attention_layernorm.weight": np.ones(h, np.float32),
+        })
+    tree = convert_hf_llama_state(state, scan_layers=True)
+    # stacked with leading layer dim, transposed
+    assert tree["layers"]["block"]["attn"]["q_proj"]["kernel"].shape == (cfg.num_hidden_layers, h, h)
+    np.testing.assert_allclose(
+        tree["layers"]["block"]["attn"]["q_proj"]["kernel"][1],
+        state["model.layers.1.self_attn.q_proj.weight"].T,
+    )
+    # tied lm_head fallback
+    np.testing.assert_allclose(tree["lm_head"]["kernel"], state["model.embed_tokens.weight"].T)
+
+
+def test_bert_dropout_trains_differently():
+    """Dropout actually fires when an rng is supplied."""
+    model = create_bert_model(BertConfig.tiny(), seq_len=16)
+    batch = {
+        "input_ids": jnp.zeros((4, 16), jnp.int32),
+        "attention_mask": jnp.ones((4, 16), jnp.bool_),
+        "labels": jnp.zeros((4,), jnp.int32),
+    }
+    det = bert_classification_loss(model.params, batch, model.apply_fn)
+    drop1 = bert_classification_loss(model.params, batch, model.apply_fn, rng=jax.random.key(1))
+    drop2 = bert_classification_loss(model.params, batch, model.apply_fn, rng=jax.random.key(2))
+    assert float(det) != float(drop1) or float(det) != float(drop2)
+
+
+def test_attention_mask_with_explicit_flash_raises():
+    from accelerate_tpu.ops.attention import dot_product_attention
+
+    q = jnp.ones((1, 8, 2, 4))
+    with pytest.raises(ValueError):
+        dot_product_attention(q, q, q, mask=jnp.ones((1, 1, 8, 8), bool), use_flash=True)
+
+
+def test_causal_alignment_decode_shape():
+    """Sq < Sk causal attention is bottom-right aligned in both paths."""
+    from accelerate_tpu.ops.attention import dot_product_attention
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 4, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True, use_flash=False)
+    out = flash_attention(q, k, v, causal=True, block_size=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    # bottom-right alignment: the LAST query sees every key, so its causal
+    # output equals unmasked attention of that single query
+    unmasked_last = dot_product_attention(q[:, -1:], k, v, causal=False, use_flash=False)
+    np.testing.assert_allclose(np.asarray(ref[:, -1:]), np.asarray(unmasked_last), atol=2e-5, rtol=2e-5)
