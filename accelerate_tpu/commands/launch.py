@@ -62,6 +62,11 @@ def launch_parser(subparsers=None):
 def build_env(args, process_id: int = 0, num_processes: int = 1) -> dict:
     """The launcher->script env protocol (reference: utils/launch.py:203)."""
     env = os.environ.copy()
+    # The framework may be run straight from a checkout (not pip-installed);
+    # the child script's sys.path[0] is its own directory, so make sure the
+    # package stays importable in the child.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else pkg_root
     if args.mixed_precision:
         env["ACCELERATE_MIXED_PRECISION"] = args.mixed_precision
     if args.gradient_accumulation_steps:
@@ -129,11 +134,15 @@ def pod_ssh_launcher(args) -> int:
     (reference tpu_pod_launcher: commands/launch.py:909-965)."""
     hosts = [h.strip() for h in args.tpu_hosts.split(",") if h.strip()]
     coordinator = f"{hosts[0]}:{args.main_process_port or 7777}"
+    # Pod hosts usually share the VM image / NFS checkout; keep the package
+    # importable there too when it isn't pip-installed.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     procs = []
     for rank, host in enumerate(hosts):
         remote_cmd = (
             f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
             f"ACCELERATE_NUM_PROCESSES={len(hosts)} ACCELERATE_PROCESS_ID={rank} "
+            f"PYTHONPATH={pkg_root}:$PYTHONPATH "
             f"{sys.executable} {args.training_script} {' '.join(args.training_script_args)}"
         )
         target = f"{args.ssh_user}@{host}" if args.ssh_user else host
