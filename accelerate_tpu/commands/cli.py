@@ -11,6 +11,7 @@ from .estimate import estimate_parser
 from .launch import launch_parser
 from .merge import merge_parser
 from .test import test_parser
+from .tpu import tpu_command_parser
 
 
 def main():
@@ -24,6 +25,7 @@ def main():
     test_parser(subparsers)
     estimate_parser(subparsers)
     merge_parser(subparsers)
+    tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)
 

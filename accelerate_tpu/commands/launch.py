@@ -28,6 +28,12 @@ import sys
 from ..utils.environment import str_to_bool
 
 
+def _pkg_root() -> str:
+    """Directory containing the ``accelerate_tpu`` package (the checkout
+    root when not pip-installed)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
 def launch_parser(subparsers=None):
     if subparsers is not None:
         parser = subparsers.add_parser("launch", help="Launch a training script on this host/pod")
@@ -65,8 +71,7 @@ def build_env(args, process_id: int = 0, num_processes: int = 1) -> dict:
     # The framework may be run straight from a checkout (not pip-installed);
     # the child script's sys.path[0] is its own directory, so make sure the
     # package stays importable in the child.
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    env["PYTHONPATH"] = pkg_root + os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else pkg_root
+    env["PYTHONPATH"] = _pkg_root() + os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else _pkg_root()
     if args.mixed_precision:
         env["ACCELERATE_MIXED_PRECISION"] = args.mixed_precision
     if args.gradient_accumulation_steps:
@@ -135,15 +140,18 @@ def pod_ssh_launcher(args) -> int:
     hosts = [h.strip() for h in args.tpu_hosts.split(",") if h.strip()]
     coordinator = f"{hosts[0]}:{args.main_process_port or 7777}"
     # Pod hosts usually share the VM image / NFS checkout; keep the package
-    # importable there too when it isn't pip-installed.
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # importable there too when it isn't pip-installed. ${PYTHONPATH:+:...}
+    # avoids a trailing empty entry (= cwd) when the remote var is unset.
+    import shlex
+
+    script_args = " ".join(shlex.quote(a) for a in args.training_script_args)
     procs = []
     for rank, host in enumerate(hosts):
         remote_cmd = (
             f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
             f"ACCELERATE_NUM_PROCESSES={len(hosts)} ACCELERATE_PROCESS_ID={rank} "
-            f"PYTHONPATH={pkg_root}:$PYTHONPATH "
-            f"{sys.executable} {args.training_script} {' '.join(args.training_script_args)}"
+            f'PYTHONPATH={_pkg_root()}"${{PYTHONPATH:+:$PYTHONPATH}}" '
+            f"{sys.executable} {shlex.quote(args.training_script)} {script_args}"
         )
         target = f"{args.ssh_user}@{host}" if args.ssh_user else host
         procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))

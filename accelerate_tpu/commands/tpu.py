@@ -1,0 +1,88 @@
+"""``accelerate-tpu tpu-config`` — run a setup command on every pod host.
+
+Reference analogue: src/accelerate/commands/tpu.py:15-157 (``tpu-config``):
+fans a command out to all workers of a GCP TPU pod via
+``gcloud compute tpus tpu-vm ssh --worker all``. Same here, with a plain
+``--hosts`` SSH fallback for non-GCP pods and ``--debug`` printing the
+command instead of running it (reference: commands/tpu.py:113-120).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+
+
+def tpu_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", help="Run commands on a TPU pod's hosts")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config")
+    parser.add_argument("--tpu_name", default=None, help="GCP TPU name (gcloud path)")
+    parser.add_argument("--tpu_zone", default=None, help="GCP zone of the TPU")
+    parser.add_argument("--hosts", default=None, help="comma-separated host list (plain-SSH path)")
+    parser.add_argument("--ssh_user", default=None)
+    parser.add_argument("--command", action="append", required=True, help="command to run (repeatable)")
+    parser.add_argument(
+        "--install_accelerate", action="store_true",
+        help="prepend an editable install of this checkout on each host",
+    )
+    parser.add_argument("--accelerate_version", default="latest")
+    parser.add_argument("--debug", action="store_true", help="print the fan-out command, do not run it")
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def _build_remote_command(args) -> str:
+    cmds = list(args.command)
+    if args.install_accelerate:
+        if args.accelerate_version == "latest":
+            # assumes the checkout is synced to the hosts at the same path
+            # (NFS/shared image); subshell so the user's commands keep their cwd
+            from .launch import _pkg_root
+
+            install = f"(cd {_pkg_root()} && pip install -e . --no-deps --no-build-isolation)"
+        else:
+            install = f"pip install accelerate-tpu=={args.accelerate_version}"
+        cmds.insert(0, install)
+    # `; ` join like the reference (commands/tpu.py:101-108)
+    return "; ".join(cmds)
+
+
+def tpu_command_launcher(args) -> int:
+    remote = _build_remote_command(args)
+    if args.tpu_name:
+        cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+            *(["--zone", args.tpu_zone] if args.tpu_zone else []),
+            "--command", remote, "--worker", "all",
+        ]
+        if args.debug:
+            print("Running:", " ".join(cmd))
+            return 0
+        return subprocess.call(cmd)
+    if not args.hosts:
+        raise SystemExit("tpu-config needs --tpu_name (GCP) or --hosts (plain SSH)")
+    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    rc = 0
+    procs = []
+    for host in hosts:
+        target = f"{args.ssh_user}@{host}" if args.ssh_user else host
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", target, remote]
+        if args.debug:
+            print("Running:", " ".join(cmd))
+            continue
+        procs.append(subprocess.Popen(cmd))
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main():
+    args = tpu_command_parser().parse_args()
+    raise SystemExit(tpu_command_launcher(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""``notebook_launcher`` — run a training function from a notebook/REPL.
+
+Reference analogue: src/accelerate/launchers.py (306 LoC): TPU path forks
+via ``xmp.spawn`` (launchers.py:135-150), multi-GPU via ``elastic_launch``
+with a pre-flight "has CUDA been initialised" fork-safety check
+(launchers.py:165-257).
+
+TPU-native: JAX SPMD needs **one process per host**, and a notebook on a
+TPU VM already is that process — so the TPU path is a plain call with the
+env protocol applied (no fork, no elastic agent). Spawning only exists for
+the CPU fake-mesh path (``num_processes > 1``) used to exercise multi-host
+code without hardware, mirroring the reference's ``debug_launcher``
+(launchers.py:260-306).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .logging import get_logger
+from .utils.environment import patch_environment
+
+logger = get_logger(__name__)
+
+
+def _worker(fn, args, env, rank, result_queue):
+    os.environ.update(env)
+    result = fn(*args)
+    if rank == 0 and result_queue is not None:
+        import pickle
+
+        # Queue serialisation happens in a background feeder thread, so an
+        # unpicklable result would fail there silently — probe here instead.
+        try:
+            pickle.dumps(result)
+        except Exception:
+            result = None
+        result_queue.put(result)
+
+
+def notebook_launcher(
+    function,
+    args=(),
+    num_processes: Optional[int] = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    master_addr: str = "127.0.0.1",
+    node_rank: int = 0,
+    num_nodes: int = 1,
+    rdzv_backend: str = "static",  # accepted for API parity; unused on TPU
+    rdzv_endpoint: str = "",
+    rdzv_conf=None,
+    rdzv_id: str = "none",
+    max_restarts: int = 0,
+    monitor_interval: float = 0.1,
+):
+    """(reference: launchers.py:40). On a TPU host this calls ``function``
+    in-process — SPMD drives every local chip from one Python process, so
+    the reference's 8-way ``xmp.spawn`` fork has no TPU-native counterpart.
+    ``num_processes > 1`` spawns CPU fake-mesh workers with a JAX
+    coordinator (testing / teaching path)."""
+    from .state import PartialState
+
+    if PartialState._shared_state.get("_initialized"):
+        raise ValueError(
+            "An Accelerator/PartialState is already live in this process. "
+            "Call notebook_launcher before creating the Accelerator inside `function` "
+            "(reference behavior: launchers.py:165-180)."
+        )
+
+    env = {}
+    if mixed_precision and mixed_precision != "no":
+        env["ACCELERATE_MIXED_PRECISION"] = mixed_precision
+
+    # Routing must NOT touch the JAX backend: `jax.devices()` here would
+    # initialize it before the user function's `jax.distributed.initialize`
+    # (one-shot; see state.py) and break real pods. Decide from env / jax
+    # config only (the config is readable without initialising the backend):
+    # spawning exists solely for the CPU fake-mesh path.
+    import sys
+
+    platforms = os.environ.get("JAX_PLATFORMS", "") or ""
+    if "jax" in sys.modules:
+        cfg_platforms = getattr(sys.modules["jax"].config, "jax_platforms", None)
+        if cfg_platforms:
+            platforms = cfg_platforms
+    spawn_on_cpu = num_processes and num_processes > 1 and platforms.startswith("cpu")
+    if not spawn_on_cpu:
+        if num_processes and num_processes > 1:
+            logger.warning(
+                "notebook_launcher: JAX SPMD uses one process per host on accelerator "
+                "backends — num_processes=%d ignored, running inline (all local chips "
+                "are driven by this process).", num_processes,
+            )
+        with patch_environment(**env):
+            return function(*args)
+
+    # CPU fake-mesh multi-process spawn (per-process coordinator rendezvous)
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("spawn")
+    result_queue = ctx.Queue()
+    procs = []
+    for rank in range(num_processes):
+        child_env = {
+            **env,
+            "JAX_PLATFORMS": "cpu",
+            "ACCELERATE_COORDINATOR_ADDRESS": f"{master_addr}:{use_port}",
+            "ACCELERATE_NUM_PROCESSES": str(num_processes),
+            "ACCELERATE_PROCESS_ID": str(rank),
+        }
+        p = ctx.Process(target=_worker, args=(function, args, child_env, rank, result_queue if rank == 0 else None))
+        p.start()
+        procs.append(p)
+    # Drain rank 0's result while it is alive (a plain blocking get() would
+    # hang forever if the worker crashes before putting).
+    from queue import Empty
+
+    result = None
+    while True:
+        try:
+            result = result_queue.get(timeout=0.2)
+            break
+        except Empty:
+            if not procs[0].is_alive():
+                # the worker may have put its result and exited between the
+                # timeout and the liveness check — drain once more
+                try:
+                    result = result_queue.get(timeout=0.2)
+                except Empty:
+                    pass
+                break
+    for p in procs:
+        p.join()
+    failed = [i for i, p in enumerate(procs) if p.exitcode != 0]
+    if failed:
+        raise RuntimeError(f"notebook_launcher worker(s) {failed} exited nonzero")
+    return result
+
+
+def debug_launcher(function, args=(), num_processes: int = 2):
+    """(reference: launchers.py:260). Run ``function`` under a CPU fake mesh
+    in-process — the cheapest way to smoke-test distributed code paths.
+
+    Must be called before any other JAX use in the process:
+    ``--xla_force_host_platform_device_count`` is read once at backend
+    initialisation."""
+    import jax
+
+    # Private but the only way to detect initialisation without causing it.
+    if getattr(jax._src.xla_bridge, "_backends", None):
+        import warnings
+
+        warnings.warn(
+            "debug_launcher called after the JAX backend was initialised; the "
+            f"{num_processes}-device fake mesh cannot be applied and `function` "
+            "will see the existing backend. Call debug_launcher first.",
+            stacklevel=2,
+        )
+    with patch_environment(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={num_processes}",
+    ):
+        return function(*args)

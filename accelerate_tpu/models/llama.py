@@ -38,6 +38,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     scan_layers: bool = True
     remat: bool = True
+    # "auto": ring attention when the mesh seq axis is non-trivial, else
+    # dense/flash; "ring" | "all_to_all" | "dense" force a path.
+    attention_impl: str = "auto"
 
     @classmethod
     def llama2_7b(cls, **kw) -> "LlamaConfig":
@@ -102,6 +105,36 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rotated.reshape(x.shape).astype(x.dtype)
 
 
+def _dispatch_attention(q, k, v, impl: str):
+    """Pick the attention path: context-parallel (ring / all-to-all) when
+    the active mesh has a non-trivial ``seq`` axis, else dense/flash. This
+    is where long-context becomes a *layout* decision rather than a model
+    rewrite (SURVEY §5)."""
+    if impl not in ("auto", "ring", "all_to_all", "dense"):
+        raise ValueError(f"attention_impl must be auto|ring|all_to_all|dense, got {impl!r}")
+    mesh = None
+    if impl != "dense":
+        from ..state import AcceleratorState
+
+        state = AcceleratorState._shared_state
+        mesh = state.get("mesh") if state.get("_initialized") else None
+    seq_ok = mesh is not None and "seq" in mesh.shape and mesh.shape["seq"] > 1
+    if impl in ("ring", "all_to_all") and not seq_ok:
+        # an explicit request must not silently fall back to the O(S^2) path
+        raise ValueError(
+            f"attention_impl={impl!r} requires an active mesh with a seq axis > 1 "
+            f"(got {dict(mesh.shape) if mesh is not None else None}); use 'auto' for adaptive dispatch"
+        )
+    if seq_ok:
+        from ..parallel.context import context_parallel_attention
+
+        method = "all_to_all" if impl == "all_to_all" else "ring"
+        return context_parallel_attention(q, k, v, mesh=mesh, causal=True, method=method)
+    from ..ops.attention import dot_product_attention
+
+    return dot_product_attention(q, k, v, causal=True)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -117,9 +150,7 @@ class LlamaAttention(nn.Module):
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        from ..ops.attention import dot_product_attention
-
-        out = dot_product_attention(q, k, v, causal=True)
+        out = _dispatch_attention(q, k, v, cfg.attention_impl)
         out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
         return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype)(out)
 

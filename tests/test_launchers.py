@@ -1,0 +1,82 @@
+"""notebook_launcher / debug_launcher / tpu-config tests
+(reference analogue: test_utils/scripts/test_notebook.py + tests/test_cli.py
+tpu-config section)."""
+
+import subprocess
+import sys
+
+from accelerate_tpu import debug_launcher, notebook_launcher
+
+
+def _train_fn(expected_procs):
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator()
+    assert acc.num_processes == expected_procs, acc.num_processes
+    return "ok"
+
+
+def test_notebook_launcher_in_process():
+    # single-process path: runs fn inline and returns its value
+    result = notebook_launcher(_train_fn, (1,), num_processes=1)
+    assert result == "ok"
+
+
+def test_notebook_launcher_rejects_live_state():
+    from accelerate_tpu import Accelerator
+
+    Accelerator()
+    try:
+        notebook_launcher(_train_fn, (1,), num_processes=1)
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
+
+
+def test_debug_launcher():
+    assert debug_launcher(_train_fn, (1,), num_processes=2) == "ok"
+
+
+def test_tpu_config_debug_print():
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.cli", "tpu-config",
+            "--hosts", "h1,h2", "--command", "echo hello", "--command", "echo world",
+            "--debug",
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stderr
+    assert result.stdout.count("Running: ssh") == 2
+    assert "echo hello; echo world" in result.stdout
+
+
+def test_tpu_config_gcloud_debug_print():
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.cli", "tpu-config",
+            "--tpu_name", "mypod", "--tpu_zone", "us-central2-b",
+            "--command", "pip list", "--install_accelerate", "--debug",
+        ],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "gcloud compute tpus tpu-vm ssh mypod" in result.stdout
+    assert "--worker all" in result.stdout
+    assert "pip install -e ." in result.stdout
+
+
+def _crashing_fn():
+    raise AssertionError("worker crash")
+
+
+def test_notebook_launcher_worker_crash_raises_not_hangs():
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        notebook_launcher(_crashing_fn, (), num_processes=2, use_port="29631")
+        raised = False
+    except RuntimeError as e:
+        raised = "nonzero" in str(e)
+    assert raised
