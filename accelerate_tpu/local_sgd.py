@@ -110,7 +110,12 @@ class LocalSGD:
             return jax.device_put(jnp.broadcast_to(p[None], (dp, *p.shape)), stack_shard)
 
         params_stacked = jax.tree_util.tree_map(stack, self.model.params)
-        opt_stacked = jax.jit(jax.vmap(tx.init))(params_stacked)
+        # carry the prepared optimizer's REAL state into the replicas
+        # (accumulated moments, step count) — re-initialising here would
+        # spike Adam's bias correction mid-run and reset count-keyed LR
+        # schedules on exit; the reference leaves optimizer state untouched
+        acc._ensure_opt_state(optimizer, self.model)
+        opt_stacked = jax.tree_util.tree_map(stack, optimizer.opt_state)
         self._stacked = [params_stacked, opt_stacked]
 
         import optax

@@ -56,6 +56,10 @@ def dot_product_attention(
             raise ValueError("flash attention supports causal masking only; pass mask=None or use_flash=False")
         if dropout_rate > 0.0 and dropout_rng is not None:
             raise ValueError("flash attention does not support attention-prob dropout; use_flash=False")
+        if jax.default_backend() == "tpu":
+            from .pallas_attention import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -99,7 +99,6 @@ def _ulysses_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optio
     re-shard back. Requires n | H_kv."""
     from ..ops.attention import dot_product_attention
 
-    n = lax.psum(1, axis_name)
     # [B, S/n, H, D] -> all_to_all over head dim -> [B, S, H/n, D]
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)

@@ -49,20 +49,23 @@ def main():
     }
     batch = jax.device_put(batch, batch_sharding(accelerator.mesh))
 
-    # compile + warmup
+    # compile + warmup. NOTE: synchronisation is via a host transfer
+    # (float(loss)), not block_until_ready — on tunneled backends the
+    # latter can return before device execution finishes, inflating
+    # throughput; a scalar D2H fetch is a true barrier.
     t_compile = time.perf_counter()
-    jax.block_until_ready(step(batch))
+    float(step(batch))
     compile_s = time.perf_counter() - t_compile
     for _ in range(3):
         loss = step(batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
     # steady state
     n_steps = 20
     t0 = time.perf_counter()
     for _ in range(n_steps):
         loss = step(batch)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     step_time_ms = dt / n_steps * 1000

@@ -102,3 +102,36 @@ def test_local_sgd_writes_back_optimizer_state():
     assert changed
     counts = [np.asarray(l) for l in after if np.asarray(l).dtype.kind in "iu"]
     assert any(c == 8 for c in counts), counts
+
+
+def test_local_sgd_carries_preexisting_optimizer_state():
+    """Entering a LocalSGD block mid-run must seed the replicas with the
+    optimizer's accumulated state (Adam moments + step count), not a fresh
+    init — and the count must keep increasing across the block."""
+    import jax
+
+    acc = _make_acc()
+    model, opt = acc.prepare(RegressionModel(), optax.adam(0.05))
+    pre_step = acc.build_train_step(linear_loss_fn)
+    for batch in _batches(5, 64):
+        pre_step(batch)
+    pre_counts = [
+        int(np.asarray(l)) for l in jax.tree_util.tree_leaves(opt.opt_state) if np.asarray(l).dtype.kind in "iu"
+    ]
+    assert any(c == 5 for c in pre_counts), pre_counts
+    pre_moments = [np.asarray(l) for l in jax.tree_util.tree_leaves(opt.opt_state) if np.asarray(l).dtype.kind == "f"]
+    with LocalSGD(accelerator=acc, model=model, local_sgd_steps=4) as lsgd:
+        step = lsgd.build_local_step(linear_loss_fn)
+        # the replica stacks start from the real state, not zeros
+        stacked_moments = [
+            np.asarray(l) for l in jax.tree_util.tree_leaves(lsgd._stacked[1]) if np.asarray(l).dtype.kind == "f"
+        ]
+        for pre, stk in zip(pre_moments, stacked_moments):
+            assert np.allclose(np.broadcast_to(pre, stk.shape), stk), "replicas re-initialised optimizer state"
+        for batch in _batches(4, 16):
+            step(batch)
+            lsgd.step()
+    counts = [
+        int(np.asarray(l)) for l in jax.tree_util.tree_leaves(opt.opt_state) if np.asarray(l).dtype.kind in "iu"
+    ]
+    assert any(c == 9 for c in counts), f"step count reset across LocalSGD block: {counts}"
