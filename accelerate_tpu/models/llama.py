@@ -132,7 +132,7 @@ def _dispatch_attention(q, k, v, impl: str):
         return context_parallel_attention(q, k, v, mesh=mesh, causal=True, method=method)
     from ..ops.attention import dot_product_attention
 
-    return dot_product_attention(q, k, v, causal=True)
+    return dot_product_attention(q, k, v, causal=True, mesh=mesh)
 
 
 class LlamaAttention(nn.Module):

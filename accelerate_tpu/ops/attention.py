@@ -35,6 +35,7 @@ def dot_product_attention(
     use_flash: Optional[bool] = None,
     dropout_rate: float = 0.0,
     dropout_rng=None,
+    mesh=None,  # pin the mesh for the sharded pallas path (else read from state at trace time)
 ) -> jax.Array:
     """Multi-head attention with optional GQA (H_kv divides H) and
     flash-kernel dispatch. Causal masking is bottom-right aligned when
@@ -57,13 +58,78 @@ def dot_product_attention(
         if dropout_rate > 0.0 and dropout_rng is not None:
             raise ValueError("flash attention does not support attention-prob dropout; use_flash=False")
         if jax.default_backend() == "tpu":
-            from .pallas_attention import pallas_flash_attention
-
-            return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+            return sharded_pallas_attention(q, k, v, causal=causal, scale=scale, mesh=mesh)
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)
 
+    return _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng)
+
+
+def sharded_pallas_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    mesh=None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas flash attention that stays partitioned under GSPMD.
+
+    ``pallas_call`` lowers to an opaque custom call, so jitting it directly
+    on sharded activations makes XLA all-gather q/k/v and replicate the
+    output (mesh-size multiple of memory + FLOPs). Attention is independent
+    per batch element and per head, so we wrap the kernel in ``shard_map``
+    over the batch (``data``/``fsdp``) and head (``tensor``) axes of the
+    active mesh — each device runs the kernel on exactly its local block and
+    no collective is emitted. Falls back to the bare kernel when no
+    non-trivial mesh is active or shapes don't divide."""
+    import functools
+
+    from .pallas_attention import pallas_flash_attention
+
+    kernel = functools.partial(
+        pallas_flash_attention, causal=causal, scale=scale, interpret=interpret
+    )
+    # Already inside a shard_map region (e.g. the GPipe trunk): inputs are
+    # per-shard blocks and axes are Manual — nesting another shard_map over
+    # the same mesh is an error; the bare kernel is exactly right here.
+    am = jax.sharding.get_abstract_mesh()
+    if any(t == jax.sharding.AxisType.Manual for t in getattr(am, "axis_types", ())):
+        return kernel(q, k, v)
+    if mesh is None:
+        # NOTE: resolved at trace time — a forward traced before the
+        # Accelerator initialises bakes in the unsharded path (pass ``mesh``
+        # explicitly to pin it; model code in models/ does).
+        from ..state import AcceleratorState
+
+        state = AcceleratorState._shared_state
+        mesh = state.get("mesh") if state.get("_initialized") else None
+    if mesh is None:
+        return kernel(q, k, v)
+
+    from ..parallel.mesh import BATCH_AXES, axis_size, axis_spec
+
+    bspec = axis_spec(mesh, BATCH_AXES)
+    hspec = axis_spec(mesh, "tensor")
+    n_b, n_h = axis_size(mesh, BATCH_AXES), axis_size(mesh, "tensor")
+    divisible = (
+        q.shape[0] % n_b == 0
+        and q.shape[2] % n_h == 0
+        and k.shape[2] % n_h == 0  # GQA: kv heads must split the same way
+    )
+    if (bspec is None and hspec is None) or not divisible:
+        return kernel(q, k, v)
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(bspec, None, hspec, None)
+    fn = jax.shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng):
+    seq_len = q.shape[1]
     num_heads, num_kv = q.shape[-2], k.shape[-2]
     if num_kv != num_heads:  # GQA: repeat kv groups
         reps = num_heads // num_kv

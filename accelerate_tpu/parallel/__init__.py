@@ -8,4 +8,5 @@ from .sharding import (
     shard_pytree,
     spec_for_path,
 )
+from .pipeline import PipelinedModel, pipeline_apply, prepare_pipeline, stage_sharding
 from . import collectives

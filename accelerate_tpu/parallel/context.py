@@ -150,16 +150,7 @@ def context_parallel_attention(
     return fn(q, k, v)
 
 
-def _batch_spec(mesh: Mesh, batch_axis):
-    """Normalise a batch-axis name or tuple to the subset of axes that are
-    actually non-trivial on this mesh (None when none are)."""
-    if batch_axis is None:
-        return None
-    axes = (batch_axis,) if isinstance(batch_axis, str) else tuple(batch_axis)
-    present = tuple(a for a in axes if a in mesh.shape and mesh.shape[a] > 1)
-    if not present:
-        return None
-    return present if len(present) > 1 else present[0]
+from .mesh import axis_spec as _batch_spec  # shared normaliser (mesh.py)
 
 
 def sequence_sharding(mesh: Mesh, axis_name: str = "seq", batch_axis=("data", "fsdp")) -> NamedSharding:

@@ -133,6 +133,29 @@ def replicated(mesh) -> "jax.sharding.NamedSharding":  # noqa: F821
     return NamedSharding(mesh, PartitionSpec())
 
 
+def axis_spec(mesh, axes):
+    """Normalise an axis name (or tuple of names) to the subset that is
+    actually non-trivial on ``mesh`` — ``None`` when none are, a bare name
+    for one, a tuple for several. This is the shared PartitionSpec-entry
+    builder for batch/head dims across context/pipeline/attention."""
+    if axes is None:
+        return None
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    present = tuple(a for a in axes if a in mesh.shape and mesh.shape[a] > 1)
+    if not present:
+        return None
+    return present if len(present) > 1 else present[0]
+
+
+def axis_size(mesh, axes) -> int:
+    """Product of the mesh sizes of ``axes`` (names absent from the mesh
+    count as 1)."""
+    if axes is None:
+        return 1
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    return int(np.prod([mesh.shape.get(a, 1) for a in axes]))
+
+
 def data_parallel_size(mesh) -> int:
     """Number of distinct data shards (product of the batch axes)."""
-    return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+    return axis_size(mesh, BATCH_AXES)

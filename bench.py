@@ -49,10 +49,8 @@ def main():
     }
     batch = jax.device_put(batch, batch_sharding(accelerator.mesh))
 
-    # compile + warmup. NOTE: synchronisation is via a host transfer
-    # (float(loss)), not block_until_ready — on tunneled backends the
-    # latter can return before device execution finishes, inflating
-    # throughput; a scalar D2H fetch is a true barrier.
+    # compile + warmup; float(loss) both synchronises (scalar D2H fetch)
+    # and surfaces NaNs immediately.
     t_compile = time.perf_counter()
     float(step(batch))
     compile_s = time.perf_counter() - t_compile

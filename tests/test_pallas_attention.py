@@ -85,3 +85,61 @@ def test_jit_and_scan_fallback_agree():
     out = fn(q, k, v)
     out_scan = scan_flash(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(out_scan), atol=2e-5, rtol=2e-5)
+
+
+def test_sharded_dispatch_stays_partitioned():
+    """sharded_pallas_attention must run the kernel per-shard under
+    shard_map: no all-gather in the HLO, output sharding preserved
+    (regression: bare pallas_call is opaque to GSPMD and forced a
+    mesh-wide all-gather + replicated output)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu import MeshConfig
+    from accelerate_tpu.ops.attention import sharded_pallas_attention
+
+    mesh = MeshConfig(data=2, tensor=4).build()
+    q, k, v = _make_qkv(jax.random.PRNGKey(3), 2, 128, 128, 8, 4, 32)
+    shard = NamedSharding(mesh, P("data", None, "tensor", None))
+    args = tuple(jax.device_put(x, shard) for x in (q, k, v))
+
+    fn = jax.jit(
+        functools.partial(sharded_pallas_attention, causal=True, mesh=mesh, interpret=True)
+    )
+    hlo = fn.lower(*args).compile().as_text()
+    assert "all-gather" not in hlo, "sharded pallas dispatch must not all-gather q/k/v"
+    out = fn(*args)
+    assert out.sharding.spec == P("data", None, "tensor", None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v, True)), atol=2e-3, rtol=2e-3)
+
+
+def test_sharded_dispatch_falls_back_without_mesh():
+    from accelerate_tpu.ops.attention import sharded_pallas_attention
+
+    q, k, v = _make_qkv(jax.random.PRNGKey(4), 1, 128, 128, 2, 2, 32)
+    out = sharded_pallas_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v, True)), atol=2e-3, rtol=2e-3)
+
+
+def test_sharded_dispatch_inside_shard_map():
+    """Calling the sharded dispatch from within an existing shard_map region
+    (e.g. the GPipe trunk) must use the bare kernel on the local block, not
+    nest another shard_map (regression: nested shard_map over the same mesh
+    raises a context-mesh mismatch at trace time)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu import MeshConfig
+    from accelerate_tpu.ops.attention import sharded_pallas_attention
+
+    mesh = MeshConfig(data=8).build()
+    q, k, v = _make_qkv(jax.random.PRNGKey(5), 8, 128, 128, 2, 2, 32)
+
+    def local(q, k, v):
+        return sharded_pallas_attention(q, k, v, causal=True, mesh=mesh, interpret=True)
+
+    spec = P("data")
+    fn = jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    )
+    shard = NamedSharding(mesh, spec)
+    out = fn(*(jax.device_put(x, shard) for x in (q, k, v)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v, True)), atol=2e-3, rtol=2e-3)
