@@ -407,7 +407,13 @@ class Accelerator:
         opt.opt_state = jax.jit(opt.optimizer.init)(model.params)
         opt._model = model
 
-    def prepare_data_loader(self, data_loader, device_placement: Optional[bool] = None, slice_fn_for_dispatch=None):
+    def prepare_data_loader(
+        self, data_loader, device_placement: Optional[bool] = None, slice_fn_for_dispatch=None, **kwargs
+    ):
+        """Extra ``kwargs`` (``batch_size``, ``shuffle``, ``seed``,
+        ``collate_fn``, ``drop_last``) pass through to
+        :func:`~accelerate_tpu.data_loader.prepare_data_loader` when the
+        input is a raw dataset rather than a built loader."""
         if isinstance(data_loader, BaseDataLoader):
             if data_loader not in self._dataloaders:
                 self._dataloaders.append(data_loader)
@@ -417,6 +423,7 @@ class Accelerator:
             put_on_device=device_placement if device_placement is not None else self.device_placement,
             data_loader_config=self.dataloader_config,
             rng_types=self.rng_types,
+            **kwargs,
         )
         self._dataloaders.append(prepared)
         return prepared

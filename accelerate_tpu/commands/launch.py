@@ -58,7 +58,13 @@ def launch_parser(subparsers=None):
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--tpu_hosts", default=None, help="comma-separated pod host list for SSH fan-out")
     parser.add_argument("--ssh_user", default=None)
-    parser.add_argument("training_script", help="script to launch")
+    parser.add_argument(
+        "-m",
+        "--module",
+        action="store_true",
+        help="interpret training_script as a python module path (python -m), reference: launch.py --module",
+    )
+    parser.add_argument("training_script", help="script (or module with -m) to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER, default=[])
     if subparsers is not None:
         parser.set_defaults(func=launch_command)
@@ -116,8 +122,14 @@ def simple_launcher(args) -> int:
     """One process for all local chips (reference simple_launcher:
     commands/launch.py:778)."""
     env = build_env(args)
-    cmd = [sys.executable, args.training_script, *args.training_script_args]
+    cmd = [sys.executable, *_script_argv(args)]
     return subprocess.call(cmd, env=env)
+
+
+def _script_argv(args) -> list:
+    if getattr(args, "module", False):
+        return ["-m", args.training_script, *args.training_script_args]
+    return [args.training_script, *args.training_script_args]
 
 
 def multi_process_launcher(args) -> int:
@@ -126,7 +138,7 @@ def multi_process_launcher(args) -> int:
     procs = []
     for rank in range(args.num_processes):
         env = build_env(args, process_id=rank, num_processes=args.num_processes)
-        cmd = [sys.executable, args.training_script, *args.training_script_args]
+        cmd = [sys.executable, *_script_argv(args)]
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
     for p in procs:
@@ -144,14 +156,14 @@ def pod_ssh_launcher(args) -> int:
     # avoids a trailing empty entry (= cwd) when the remote var is unset.
     import shlex
 
-    script_args = " ".join(shlex.quote(a) for a in args.training_script_args)
+    script_cmd = " ".join(shlex.quote(a) for a in _script_argv(args))
     procs = []
     for rank, host in enumerate(hosts):
         remote_cmd = (
             f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
             f"ACCELERATE_NUM_PROCESSES={len(hosts)} ACCELERATE_PROCESS_ID={rank} "
             f'PYTHONPATH={_pkg_root()}"${{PYTHONPATH:+:$PYTHONPATH}}" '
-            f"{sys.executable} {shlex.quote(args.training_script)} {script_args}"
+            f"{sys.executable} {script_cmd}"
         )
         target = f"{args.ssh_user}@{host}" if args.ssh_user else host
         procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))

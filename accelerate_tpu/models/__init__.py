@@ -5,6 +5,26 @@ from .bert import (
     bert_classification_loss,
     create_bert_model,
 )
+from .gpt2 import (
+    GPT2_SHARDING_RULES,
+    GPT2Config,
+    GPT2Model,
+    create_gpt2_model,
+)
+from .t5 import (
+    T5_SHARDING_RULES,
+    T5Config,
+    T5Model,
+    create_t5_model,
+    seq2seq_lm_loss,
+)
+from .mixtral import (
+    MIXTRAL_SHARDING_RULES,
+    MixtralConfig,
+    MixtralModel,
+    create_mixtral_model,
+    mixtral_lm_loss,
+)
 from .llama import (
     LLAMA_SHARDING_RULES,
     LlamaConfig,

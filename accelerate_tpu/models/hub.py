@@ -203,6 +203,141 @@ def load_hf_llama(checkpoint_path: str, config=None):
     return model
 
 
+# --------------------------------------------------------------------- #
+# GPT-2
+# --------------------------------------------------------------------- #
+
+_GPT2_LAYER = {
+    "ln_1.weight": "ln_1/scale",
+    "ln_1.bias": "ln_1/bias",
+    "ln_2.weight": "ln_2/scale",
+    "ln_2.bias": "ln_2/bias",
+    "attn.c_proj.weight": "attn/o_proj/kernel",
+    "attn.c_proj.bias": "attn/o_proj/bias",
+    "mlp.c_fc.weight": "mlp/fc_in/kernel",
+    "mlp.c_fc.bias": "mlp/fc_in/bias",
+    "mlp.c_proj.weight": "mlp/fc_out/kernel",
+    "mlp.c_proj.bias": "mlp/fc_out/bias",
+}
+
+
+def convert_hf_gpt2_state(state: dict[str, np.ndarray]) -> dict:
+    """HF ``gpt2`` -> our param pytree. HF GPT-2 uses Conv1D layers whose
+    weights are already ``[in, out]`` (no transpose), and a fused
+    ``c_attn`` that we split into q/k/v thirds."""
+    state = _strip_prefix(state, ("transformer.",))
+    tree: dict = {}
+    if "wte.weight" in state:
+        _set(tree, "wte/embedding", state["wte.weight"])
+    if "wpe.weight" in state:
+        _set(tree, "wpe/embedding", state["wpe.weight"])
+    if "ln_f.weight" in state:
+        _set(tree, "ln_f/scale", state["ln_f.weight"])
+        _set(tree, "ln_f/bias", state["ln_f.bias"])
+    # HF gpt2 ties the head to wte and ships no lm_head tensor; provide the
+    # tied fallback for untied configs (same pattern as llama, above)
+    if "wte.weight" in state:
+        _set(tree, "lm_head/kernel", state["wte.weight"].T)
+    layer_re = re.compile(r"h\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = layer_re.match(key)
+        if not m:
+            continue
+        idx, rest = int(m.group(1)), m.group(2)
+        if rest in _GPT2_LAYER:
+            _set(tree, f"layer_{idx}/{_GPT2_LAYER[rest]}", value)
+        elif rest == "attn.c_attn.weight":
+            d = value.shape[0]
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                _set(tree, f"layer_{idx}/attn/{name}/kernel", value[:, j * d:(j + 1) * d])
+        elif rest == "attn.c_attn.bias":
+            d = value.shape[0] // 3
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                _set(tree, f"layer_{idx}/attn/{name}/bias", value[j * d:(j + 1) * d])
+    return tree
+
+
+def load_hf_gpt2(checkpoint_path: str, config=None):
+    from .gpt2 import GPT2Config, create_gpt2_model
+
+    state = read_safetensors_state(checkpoint_path)
+    tree = convert_hf_gpt2_state(state)
+    model = create_gpt2_model(config or GPT2Config.small())
+    _merge_into(model, tree)
+    return model
+
+
+# --------------------------------------------------------------------- #
+# T5
+# --------------------------------------------------------------------- #
+
+_T5_SELF = {
+    "q.weight": ("q_proj/kernel", True),
+    "k.weight": ("k_proj/kernel", True),
+    "v.weight": ("v_proj/kernel", True),
+    "o.weight": ("o_proj/kernel", True),
+    "relative_attention_bias.weight": ("relative_bias/embedding", False),
+}
+
+_T5_FFN = {
+    "DenseReluDense.wi.weight": ("ffn/wi/kernel", True),
+    "DenseReluDense.wo.weight": ("ffn/wo/kernel", True),
+}
+
+
+def convert_hf_t5_state(state: dict[str, np.ndarray]) -> dict:
+    """HF ``t5-*`` -> our param pytree (encoder.block.N.layer.{0,1} /
+    decoder.block.N.layer.{0,1,2} structure flattened to our names)."""
+    tree: dict = {}
+    if "shared.weight" in state:
+        _set(tree, "shared/embedding", state["shared.weight"])
+    if "lm_head.weight" in state:
+        _set(tree, "lm_head/kernel", state["lm_head.weight"].T)
+    if "encoder.final_layer_norm.weight" in state:
+        _set(tree, "enc_final_norm/scale", state["encoder.final_layer_norm.weight"])
+    if "decoder.final_layer_norm.weight" in state:
+        _set(tree, "dec_final_norm/scale", state["decoder.final_layer_norm.weight"])
+
+    pat = re.compile(r"(encoder|decoder)\.block\.(\d+)\.layer\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = pat.match(key)
+        if not m:
+            continue
+        stack, idx, sub, rest = m.group(1), int(m.group(2)), int(m.group(3)), m.group(4)
+        enc = stack == "encoder"
+        prefix = f"{'enc' if enc else 'dec'}_layer_{idx}"
+        if enc:
+            # layer.0 = self-attn, layer.1 = ffn
+            attn_name, norms = "attn", {0: "ln_attn", 1: "ln_ffn"}
+        else:
+            # layer.0 = self-attn, layer.1 = cross-attn, layer.2 = ffn
+            attn_name = "self_attn" if sub == 0 else "cross_attn"
+            norms = {0: "ln_self", 1: "ln_cross", 2: "ln_ffn"}
+        if rest == "layer_norm.weight":
+            _set(tree, f"{prefix}/{norms[sub]}/scale", value)
+            continue
+        for hf_prefix in ("SelfAttention.", "EncDecAttention."):
+            if rest.startswith(hf_prefix):
+                name, transpose = _T5_SELF[rest[len(hf_prefix):]]
+                _set(tree, f"{prefix}/{attn_name}/{name}", value.T if transpose else value)
+                break
+        else:
+            if rest in _T5_FFN:
+                name, transpose = _T5_FFN[rest]
+                _set(tree, f"{prefix}/{name}", value.T if transpose else value)
+    return tree
+
+
+def load_hf_t5(checkpoint_path: str, config=None):
+    from .t5 import T5Config, create_t5_model
+
+    state = read_safetensors_state(checkpoint_path)
+    tree = convert_hf_t5_state(state)
+    model = create_t5_model(config or T5Config.small())
+    _merge_into(model, tree)
+    return model
+
+
 def _merge_into(model, tree: dict):
     """Replace model params with imported values (shape-checked; values not
     present keep their initialisation)."""

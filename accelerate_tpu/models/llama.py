@@ -114,10 +114,9 @@ def _dispatch_attention(q, k, v, impl: str):
         raise ValueError(f"attention_impl must be auto|ring|all_to_all|dense, got {impl!r}")
     mesh = None
     if impl != "dense":
-        from ..state import AcceleratorState
+        from ..ops.attention import active_mesh
 
-        state = AcceleratorState._shared_state
-        mesh = state.get("mesh") if state.get("_initialized") else None
+        mesh = active_mesh()
     seq_ok = mesh is not None and "seq" in mesh.shape and mesh.shape["seq"] > 1
     if impl in ("ring", "all_to_all") and not seq_ok:
         # an explicit request must not silently fall back to the O(S^2) path
@@ -244,7 +243,12 @@ def causal_lm_loss(params, batch, apply_fn):
     """Next-token cross entropy; labels = input shifted left, padding via
     ``loss_mask``. When labels are auto-derived, the final position (whose
     target would be fabricated) is masked out."""
-    logits = apply_fn(params, batch["input_ids"])
+    return next_token_cross_entropy(apply_fn(params, batch["input_ids"]), batch)
+
+
+def next_token_cross_entropy(logits, batch):
+    """The CE part of :func:`causal_lm_loss`, for callers that already have
+    logits (e.g. MoE losses that need the same forward's aux outputs)."""
     mask = batch.get("loss_mask")
     if "labels" in batch:
         labels = batch["labels"]

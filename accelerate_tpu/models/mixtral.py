@@ -1,0 +1,152 @@
+"""Mixtral-style sparse-MoE decoder: Llama attention + top-k routed experts.
+
+The expert-parallel flagship. Reference has no MoE model support at all
+(SURVEY §2.2 EP row: only DeepSpeed MoE leaf-class marking,
+utils/dataclasses.py); this model exists to exercise the ``expert`` mesh
+axis end-to-end: expert weights sharded one group per expert-axis slice,
+token dispatch via GSPMD all-to-all (see ops/moe.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+from ..ops.moe import MoEBlock
+from .llama import LlamaAttention, LlamaConfig, RMSNorm
+
+
+@dataclasses.dataclass
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 1e6
+    router_aux_loss_coef: float = 0.02
+    attention_impl: str = "auto"
+
+    @classmethod
+    def tiny(cls, **kw) -> "MixtralConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 96)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("num_local_experts", 4)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    def as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps,
+            rope_theta=self.rope_theta,
+            attention_impl=self.attention_impl,
+        )
+
+
+# Attention follows the Llama column/row TP splits; expert weights shard
+# their leading dim over ``expert`` and the ff dim over ``tensor``.
+MIXTRAL_SHARDING_RULES = [
+    (r"embed_tokens/embedding", P("tensor", None)),
+    (r"layer_\d+/attn/(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"layer_\d+/attn/o_proj/kernel", P("tensor", None)),
+    (r"layer_\d+/moe/experts/(gate|up)_proj", P("expert", None, "tensor")),
+    (r"layer_\d+/moe/experts/down_proj", P("expert", "tensor", None)),
+    (r"layer_\d+/moe/router/kernel", P(None, None)),
+    (r"lm_head/kernel", P(None, "tensor")),
+]
+
+
+class MixtralLayer(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.config
+        hidden = hidden + LlamaAttention(cfg.as_llama(), name="attn")(
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions
+        )
+        hidden = hidden + MoEBlock(
+            num_experts=cfg.num_local_experts,
+            intermediate_size=cfg.intermediate_size,
+            num_selected=cfg.num_experts_per_tok,
+            capacity_factor=cfg.capacity_factor,
+            name="moe",
+        )(RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden))
+        return hidden
+
+
+class MixtralModel(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
+        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[-1]), input_ids.shape)
+        from ..parallel.sharding import maybe_shard
+
+        hidden = maybe_shard(hidden, P(("data", "fsdp"), "seq", None))
+        for i in range(cfg.num_hidden_layers):
+            hidden = MixtralLayer(cfg, name=f"layer_{i}")(hidden, positions)
+        hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
+
+
+def create_mixtral_model(
+    config: Optional[MixtralConfig] = None, seed: int = 0, seq_len: int = 128
+) -> Model:
+    config = config or MixtralConfig.tiny()
+    module = MixtralModel(config)
+    dummy = jnp.zeros((2, seq_len), jnp.int32)
+    params = module.init(jax.random.key(seed), dummy)["params"]
+
+    def apply_fn(p, input_ids):
+        return module.apply({"params": p}, input_ids)
+
+    model = Model(apply_fn, params, sharding_rules=MIXTRAL_SHARDING_RULES, name="mixtral")
+    model.config = config
+    model.module = module
+    return model
+
+
+def mixtral_lm_loss(params, batch, apply_fn=None, module=None, aux_coef: Optional[float] = None):
+    """Causal-LM loss + router load-balance aux term (one forward pass:
+    aux losses come from the sown intermediates of the same apply).
+    ``aux_coef`` defaults to the module config's ``router_aux_loss_coef``."""
+    from .llama import causal_lm_loss, next_token_cross_entropy
+
+    if module is None:
+        return causal_lm_loss(params, batch, apply_fn)
+    if aux_coef is None:
+        aux_coef = module.config.router_aux_loss_coef
+    logits, inter = module.apply(
+        {"params": params}, batch["input_ids"], mutable=["intermediates"]
+    )
+    loss = next_token_cross_entropy(logits, batch)
+    leaves = jax.tree.leaves(inter["intermediates"])
+    if leaves:
+        loss = loss + aux_coef * sum(jnp.sum(l) for l in leaves) / len(leaves)
+    return loss

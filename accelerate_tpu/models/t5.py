@@ -1,0 +1,241 @@
+"""T5 encoder-decoder (flax.linen): relative position bias, RMS-style
+LayerNorm, ReLU/GeGLU FFN, cross-attention.
+
+Fourth model family of the reference's Megatron parser set (reference:
+src/accelerate/utils/dataclasses.py:2532-2662 — bert/gpt2/t5/llama). Same
+mesh conventions as the rest of the zoo; the encoder-decoder structure also
+exercises cross-attention sharding (kv from a different sequence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+from .llama import RMSNorm  # T5's LayerNorm is RMS (no mean subtraction)
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    intermediate_size: int = 2048
+    num_layers: int = 6  # per stack (encoder and decoder)
+    num_attention_heads: int = 8
+    head_dim: int = 64
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    remat: bool = False
+
+    @classmethod
+    def small(cls, **kw) -> "T5Config":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "T5Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("head_dim", 16)
+        return cls(**kw)
+
+
+T5_SHARDING_RULES = [
+    (r"shared/embedding", P("tensor", None)),
+    (r"(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"o_proj/kernel", P("tensor", None)),
+    (r"ffn/wi(_\d)?/kernel", P(None, "tensor")),
+    (r"ffn/wo/kernel", P("tensor", None)),
+    (r"lm_head/kernel", P(None, "tensor")),
+]
+
+
+def relative_position_buckets(
+    q_len: int, k_len: int, num_buckets: int, max_distance: int, bidirectional: bool
+) -> jax.Array:
+    """T5's log-binned relative position -> bucket id [q_len, k_len]."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    rel = mem - ctx
+    buckets = 0
+    if bidirectional:
+        num_buckets //= 2
+        buckets = jnp.where(rel > 0, num_buckets, 0)
+        rel = jnp.abs(rel)
+    else:
+        rel = -jnp.minimum(rel, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    log_bucket = max_exact + (
+        jnp.log(jnp.maximum(rel, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    log_bucket = jnp.minimum(log_bucket, num_buckets - 1)
+    return buckets + jnp.where(is_small, rel, log_bucket)
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+    causal: bool = False
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, kv=None, mask=None):
+        cfg = self.config
+        kv = hidden if kv is None else kv
+        inner = cfg.num_attention_heads * cfg.head_dim
+        q = nn.Dense(inner, use_bias=False, name="q_proj", dtype=hidden.dtype)(hidden)
+        k = nn.Dense(inner, use_bias=False, name="k_proj", dtype=hidden.dtype)(kv)
+        v = nn.Dense(inner, use_bias=False, name="v_proj", dtype=hidden.dtype)(kv)
+
+        def split(x):
+            return x.reshape(*x.shape[:-1], cfg.num_attention_heads, cfg.head_dim)
+
+        q, k, v = split(q), split(k), split(v)
+        # T5 does NOT scale by sqrt(d); fold relative bias into the logits
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        if self.has_relative_bias:
+            buckets = relative_position_buckets(
+                q.shape[1],
+                k.shape[1],
+                cfg.relative_attention_num_buckets,
+                cfg.relative_attention_max_distance,
+                bidirectional=not self.causal,
+            )
+            bias_table = self.param(
+                "relative_bias/embedding",
+                nn.initializers.normal(1.0),
+                (cfg.relative_attention_num_buckets, cfg.num_attention_heads),
+            )
+            logits = logits + bias_table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+        if self.causal:
+            cmask = jnp.arange(q.shape[1])[:, None] >= jnp.arange(k.shape[1])[None, :]
+            logits = jnp.where(cmask[None, None], logits, jnp.finfo(jnp.float32).min)
+        if mask is not None:
+            logits = jnp.where(mask[:, None, None, :], logits, jnp.finfo(jnp.float32).min)
+        weights = jax.nn.softmax(logits, axis=-1).astype(hidden.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        out = out.reshape(*out.shape[:-2], inner)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype)(out)
+
+
+class T5FFN(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        h = nn.Dense(cfg.intermediate_size, use_bias=False, name="wi", dtype=hidden.dtype)(hidden)
+        h = nn.relu(h)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="wo", dtype=hidden.dtype)(h)
+
+
+class T5EncoderLayer(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        hidden = hidden + T5Attention(
+            cfg, causal=False, has_relative_bias=self.has_relative_bias, name="attn"
+        )(RMSNorm(cfg.layer_norm_eps, name="ln_attn")(hidden), mask=mask)
+        hidden = hidden + T5FFN(cfg, name="ffn")(RMSNorm(cfg.layer_norm_eps, name="ln_ffn")(hidden))
+        return hidden
+
+
+class T5DecoderLayer(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, enc_out, enc_mask):
+        cfg = self.config
+        hidden = hidden + T5Attention(
+            cfg, causal=True, has_relative_bias=self.has_relative_bias, name="self_attn"
+        )(RMSNorm(cfg.layer_norm_eps, name="ln_self")(hidden))
+        hidden = hidden + T5Attention(cfg, causal=False, name="cross_attn")(
+            RMSNorm(cfg.layer_norm_eps, name="ln_cross")(hidden), kv=enc_out, mask=enc_mask
+        )
+        hidden = hidden + T5FFN(cfg, name="ffn")(RMSNorm(cfg.layer_norm_eps, name="ln_ffn")(hidden))
+        return hidden
+
+
+class T5Model(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+        cfg = self.config
+        shared = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="shared")
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids, jnp.bool_)
+
+        from ..parallel.sharding import maybe_shard
+
+        spec = P(("data", "fsdp"), "seq", None)
+        enc_layer = nn.remat(T5EncoderLayer, prevent_cse=False) if cfg.remat else T5EncoderLayer
+        dec_layer = nn.remat(T5DecoderLayer, prevent_cse=False) if cfg.remat else T5DecoderLayer
+
+        h = maybe_shard(shared(input_ids), spec)
+        for i in range(cfg.num_layers):
+            h = enc_layer(cfg, has_relative_bias=(i == 0), name=f"enc_layer_{i}")(h, attention_mask)
+        enc_out = RMSNorm(cfg.layer_norm_eps, name="enc_final_norm")(h)
+
+        d = maybe_shard(shared(decoder_input_ids), spec)
+        for i in range(cfg.num_layers):
+            d = dec_layer(cfg, has_relative_bias=(i == 0), name=f"dec_layer_{i}")(
+                d, enc_out, attention_mask
+            )
+        d = RMSNorm(cfg.layer_norm_eps, name="dec_final_norm")(d)
+        if cfg.tie_word_embeddings:
+            # T5 scales tied-logits by 1/sqrt(d) (HF modeling_t5 parity)
+            d = d * (cfg.hidden_size**-0.5)
+            return d.astype(jnp.float32) @ shared.embedding.T.astype(jnp.float32)
+        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(d)
+
+
+def create_t5_model(config: Optional[T5Config] = None, seed: int = 0, seq_len: int = 32) -> Model:
+    config = config or T5Config.tiny()
+    module = T5Model(config)
+    dummy = jnp.zeros((2, seq_len), jnp.int32)
+    params = module.init(jax.random.key(seed), dummy, dummy)["params"]
+
+    def apply_fn(p, input_ids, decoder_input_ids, attention_mask=None):
+        return module.apply({"params": p}, input_ids, decoder_input_ids, attention_mask)
+
+    model = Model(apply_fn, params, sharding_rules=T5_SHARDING_RULES, name="t5")
+    model.config = config
+    model.module = module
+    return model
+
+
+def seq2seq_lm_loss(params, batch, apply_fn):
+    """Teacher-forced seq2seq cross entropy. ``decoder_input_ids`` are the
+    labels shifted right (pad-start); positions with label==-100 or where
+    ``decoder_loss_mask`` is 0 are excluded."""
+    labels = batch["labels"]
+    dec_in = batch.get("decoder_input_ids")
+    if dec_in is None:
+        dec_in = jnp.pad(labels[:, :-1], ((0, 0), (1, 0)))
+        dec_in = jnp.where(dec_in == -100, 0, dec_in)
+    logits = apply_fn(params, batch["input_ids"], dec_in, batch.get("attention_mask"))
+    mask = (labels != -100).astype(jnp.float32)
+    if "decoder_loss_mask" in batch:
+        mask = mask * batch["decoder_loss_mask"].astype(jnp.float32)
+    safe_labels = jnp.where(labels == -100, 0, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -66,6 +66,15 @@ def dot_product_attention(
     return _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng)
 
 
+def active_mesh():
+    """The Accelerator's mesh if one is initialised, else None — for pinning
+    the sharded dispatch at trace time from model code."""
+    from ..state import AcceleratorState
+
+    state = AcceleratorState._shared_state
+    return state.get("mesh") if state.get("_initialized") else None
+
+
 def sharded_pallas_attention(
     q: jax.Array,
     k: jax.Array,
@@ -102,10 +111,7 @@ def sharded_pallas_attention(
         # NOTE: resolved at trace time — a forward traced before the
         # Accelerator initialises bakes in the unsharded path (pass ``mesh``
         # explicitly to pin it; model code in models/ does).
-        from ..state import AcceleratorState
-
-        state = AcceleratorState._shared_state
-        mesh = state.get("mesh") if state.get("_initialized") else None
+        mesh = active_mesh()
     if mesh is None:
         return kernel(q, k, v)
 

@@ -67,7 +67,12 @@ class AcceleratedOptimizer:
         return self._step_was_skipped
 
     def zero_grad(self, set_to_none: bool = True):
-        """Clear this optimizer's model's gradient buffer (imperative path)."""
+        """Clear this optimizer's model's gradient buffer (imperative path).
+        No-op mid-accumulation, like the reference (optimizer.py:112-113:
+        gated on ``sync_gradients``) — otherwise the user-loop idiom
+        ``backward; step; zero_grad`` would wipe buffered gradients."""
+        if not self.gradient_state.sync_gradients:
+            return
         if self.accelerator is not None:
             self.accelerator._zero_grad_buffer(getattr(self, "_model", None))
 

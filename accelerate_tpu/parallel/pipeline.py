@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -50,20 +50,33 @@ def _gpipe_local(
     axis_name: str,
     n_stages: int,
     num_microbatches: int,
+    batched_arg_mask: tuple,
     remat: bool,
 ):
     """Per-device GPipe body (runs under shard_map).
 
     layer_params: pytree, leaves [L_local, ...] — this stage's layers.
     x: [B_local, ...] this data-shard's batch (replicated over ``pipe``).
+    broadcast_args: extras for layer_fn; entries flagged in
+    ``batched_arg_mask`` share x's batch dim and are microbatched alongside
+    it (stage i works on microbatch t-i at tick t, so they are indexed by
+    that offset); the rest pass through whole.
     """
     m = num_microbatches
     idx = lax.axis_index(axis_name)
     mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
+    args_mb = tuple(
+        a.reshape(m, a.shape[0] // m, *a.shape[1:]) if batched else a
+        for a, batched in zip(broadcast_args, batched_arg_mask)
+    )
 
-    def apply_stage(h):
+    def apply_stage(h, mb_idx):
+        args = tuple(
+            a[mb_idx] if batched else a for a, batched in zip(args_mb, batched_arg_mask)
+        )
+
         def body(carry, p):
-            return layer_fn(p, carry, *broadcast_args), None
+            return layer_fn(p, carry, *args), None
 
         out, _ = lax.scan(body, h, layer_params)
         return out
@@ -75,12 +88,12 @@ def _gpipe_local(
 
     def tick(carry, t):
         state, out = carry
-        # stage 0 ingests microbatch t (clamped once the feed is exhausted —
-        # those ticks only flush the tail of the pipe and their stage-0
-        # output is never written)
+        # stage i works on microbatch t-i; clamp covers fill/drain ticks
+        # whose results are never written
+        mb_idx = jnp.clip(t - idx, 0, m - 1)
         feed = mb[jnp.minimum(t, m - 1)]
         h = jnp.where(idx == 0, feed, state)
-        y = apply_stage(h)
+        y = apply_stage(h, mb_idx)
         # the last stage finishes microbatch t-(S-1) at tick t
         w = t - (n_stages - 1)
         slot = jnp.clip(w, 0, m - 1)
@@ -110,6 +123,7 @@ def pipeline_apply(
     axis_name: str = "pipe",
     batch_axes: Sequence[str] = BATCH_AXES,
     broadcast_args: tuple = (),
+    batched_args: Optional[Sequence[bool]] = None,
     remat: bool = False,
 ) -> jax.Array:
     """Run ``x`` through a stack of layers pipelined over ``axis_name``.
@@ -118,7 +132,11 @@ def pipeline_apply(
     layout) and should be placed with :func:`stage_sharding`; ``L`` must
     divide by the pipe-axis size. ``layer_fn(p, h, *broadcast_args) -> h``
     applies one layer and must preserve ``h``'s shape. ``broadcast_args``
-    are replicated extras (e.g. position ids) visible to every stage.
+    are extras visible to every stage; by default args whose leading dim
+    equals the batch (e.g. position ids [B, S]) are sharded and
+    microbatched with ``x`` and anything else is replicated whole — pass
+    ``batched_args`` (one bool per extra) to pin it explicitly when the
+    shape heuristic would guess wrong (e.g. a replicated [B, k] table).
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -140,6 +158,16 @@ def pipeline_apply(
 
     param_specs = jax.tree.map(lambda l: P(axis_name), layer_params)
     x_spec = P(bspec)
+    # extras sharing x's batch dim are sharded/microbatched with it
+    if batched_args is not None:
+        if len(batched_args) != len(broadcast_args):
+            raise ValueError(f"batched_args has {len(batched_args)} entries for {len(broadcast_args)} broadcast_args")
+        batched_arg_mask = tuple(bool(b) for b in batched_args)
+    else:
+        batched_arg_mask = tuple(
+            getattr(a, "ndim", 0) >= 1 and a.shape[0] == x.shape[0] for a in broadcast_args
+        )
+    arg_specs = tuple(x_spec if b else P() for b in batched_arg_mask)
     fn = jax.shard_map(
         functools.partial(
             _gpipe_local,
@@ -147,10 +175,11 @@ def pipeline_apply(
             axis_name=axis_name,
             n_stages=n_stages,
             num_microbatches=num_microbatches,
+            batched_arg_mask=batched_arg_mask,
             remat=remat,
         ),
         mesh=mesh,
-        in_specs=(param_specs, x_spec, P()),
+        in_specs=(param_specs, x_spec, arg_specs),
         out_specs=x_spec,
         check_vma=False,
     )

@@ -1,0 +1,91 @@
+"""Self-checking collective-ops script.
+
+Reference analogue: src/accelerate/test_utils/scripts/test_ops.py (181 LoC)
+— gather / broadcast / reduce / pad correctness on real collectives. Runs
+single- or multi-process (the launcher's jax.distributed rendezvous);
+asserts internally and exits nonzero on failure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_gather(accelerator):
+    from accelerate_tpu.utils import gather
+
+    n = accelerator.num_processes
+    local = np.full((2, 3), accelerator.process_index, np.float32)
+    out = gather(local)
+    assert out.shape == (2 * n, 3), out.shape
+    assert sorted(set(out[:, 0].tolist())) == list(range(n))
+    # structure preservation
+    nested = gather({"a": local, "b": [local + 1]})
+    assert nested["a"].shape == (2 * n, 3)
+    assert nested["b"][0].shape == (2 * n, 3)
+    accelerator.print("gather OK")
+
+
+def check_gather_object(accelerator):
+    from accelerate_tpu.utils import gather_object
+
+    objs = gather_object([{"rank": accelerator.process_index}])
+    ranks = sorted(o["rank"] for o in objs)
+    assert ranks == list(range(accelerator.num_processes)), ranks
+    accelerator.print("gather_object OK")
+
+
+def check_broadcast(accelerator):
+    from accelerate_tpu.utils import broadcast, broadcast_object_list
+
+    value = np.arange(4, dtype=np.float32) * (accelerator.process_index + 1)
+    out = broadcast(value, from_process=0)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(4, dtype=np.float32))
+
+    objs = ["payload" if accelerator.is_main_process else None]
+    objs = broadcast_object_list(objs, from_process=0)
+    assert objs[0] == "payload"
+    accelerator.print("broadcast OK")
+
+
+def check_reduce(accelerator):
+    from accelerate_tpu.utils import reduce
+
+    n = accelerator.num_processes
+    local = np.full((3,), float(accelerator.process_index + 1), np.float32)
+    summed = reduce(local, reduction="sum")
+    np.testing.assert_allclose(np.asarray(summed), np.full(3, n * (n + 1) / 2))
+    mean = reduce(local, reduction="mean")
+    np.testing.assert_allclose(np.asarray(mean), np.full(3, (n + 1) / 2))
+    accelerator.print("reduce OK")
+
+
+def check_pad_across_processes(accelerator):
+    from accelerate_tpu.utils import pad_across_processes
+
+    # each rank holds a different-length row; pad must equalise to the max
+    length = 2 + accelerator.process_index
+    local = np.ones((1, length), np.float32)
+    padded = pad_across_processes(local, dim=1)
+    max_len = 2 + accelerator.num_processes - 1
+    assert padded.shape == (1, max_len), padded.shape
+    np.testing.assert_array_equal(np.asarray(padded)[0, :length], np.ones(length))
+    if length < max_len:
+        np.testing.assert_array_equal(np.asarray(padded)[0, length:], np.zeros(max_len - length))
+    accelerator.print("pad_across_processes OK")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    check_gather(accelerator)
+    check_gather_object(accelerator)
+    check_broadcast(accelerator)
+    check_reduce(accelerator)
+    check_pad_across_processes(accelerator)
+    accelerator.print("test_ops: ALL OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Shared bits for the by_feature examples: a tiny regression task that
+trains in seconds on CPU or one TPU chip.
+
+(The reference's by_feature scripts each re-derive from nlp_example.py and
+share `get_dataloaders`; here the shared piece is explicit —
+reference: examples/by_feature/README.md.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+
+def make_task(accelerator: Accelerator, batch_size: int = 16, length: int = 256, lr: float = 0.1):
+    """model, optimizer, dataloader, loss_fn for y = 2x + 3 regression."""
+    model = accelerator.prepare_model(RegressionModel())
+    optimizer = accelerator.prepare_optimizer(optax.sgd(lr))
+    dataloader = accelerator.prepare_data_loader(
+        RegressionDataset(length=length, seed=0), batch_size=batch_size, shuffle=True, seed=42
+    )
+
+    def loss_fn(params, batch):
+        pred = model.apply_fn(params, batch["x"])
+        return ((pred - batch["y"]) ** 2).mean()
+
+    return model, optimizer, dataloader, loss_fn
+
+
+def final_weights(model) -> tuple[float, float]:
+    import jax
+
+    leaves = jax.tree.leaves(model.params)
+    return float(np.asarray(leaves[0]).ravel()[0]), float(np.asarray(leaves[1]).ravel()[0])

@@ -1,0 +1,44 @@
+"""save_state / load_state round-trip + resume with skip_first_batches
+(reference analogue: examples/by_feature/checkpointing.py).
+"""
+
+import tempfile
+
+import numpy as np
+
+from accelerate_tpu import Accelerator, skip_first_batches
+
+from _common import final_weights, make_task
+
+
+def main():
+    accelerator = Accelerator()
+    model, optimizer, dataloader, loss_fn = make_task(accelerator)
+    step = accelerator.build_train_step(loss_fn)
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        # train 1 epoch + 3 batches of the second, checkpoint mid-epoch
+        for batch in dataloader:
+            step(batch)
+        for i, batch in enumerate(dataloader):
+            if i == 3:
+                break
+            step(batch)
+        accelerator.save_state(ckpt_dir)
+        a_saved, b_saved = final_weights(model)
+
+        # keep training, then roll back
+        for batch in dataloader:
+            step(batch)
+        accelerator.load_state(ckpt_dir)
+        a_loaded, b_loaded = final_weights(model)
+        assert (a_saved, b_saved) == (a_loaded, b_loaded), "load_state must restore params"
+
+        # resume the interrupted epoch where it left off
+        resumed = skip_first_batches(dataloader, num_batches=3)
+        n = sum(1 for _ in resumed)
+        accelerator.print(f"restored a={a_loaded:.3f} b={b_loaded:.3f}; resumed epoch has {n} batches left")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Cross-process early stopping with set_trigger/check_trigger
+(reference analogue: examples/by_feature/early_stopping.py — a flag tensor
+all-reduce so ANY rank can stop ALL ranks at the same step).
+"""
+
+from accelerate_tpu import Accelerator
+
+from _common import make_task
+
+
+def main():
+    accelerator = Accelerator()
+    model, optimizer, dataloader, loss_fn = make_task(accelerator)
+    step = accelerator.build_train_step(loss_fn)
+
+    target = 0.05
+    stopped_at = None
+    for epoch in range(20):
+        for batch in dataloader:
+            loss = float(step(batch))
+            if loss < target:
+                # any rank may trip the trigger...
+                accelerator.set_trigger()
+            # ...every rank sees it at the same point
+            if accelerator.check_trigger():
+                stopped_at = (epoch, loss)
+                break
+        if stopped_at:
+            break
+    accelerator.print(f"early-stopped at epoch {stopped_at[0]} with loss {stopped_at[1]:.4f}")
+    assert stopped_at is not None
+
+
+if __name__ == "__main__":
+    main()

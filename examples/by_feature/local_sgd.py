@@ -1,0 +1,31 @@
+"""LocalSGD: skip cross-replica grad sync for N steps, then average params
+(reference analogue: examples/by_feature/local_sgd.py).
+"""
+
+from accelerate_tpu import Accelerator, LocalSGD
+
+from _common import final_weights, make_task
+
+
+def main():
+    accelerator = Accelerator()
+    model, optimizer, dataloader, loss_fn = make_task(accelerator)
+
+    with LocalSGD(
+        accelerator=accelerator, model=model, local_sgd_steps=8, enabled=True
+    ) as local_sgd:
+        for epoch in range(10):
+            for batch in dataloader:
+                with accelerator.accumulate(model):
+                    accelerator.backward(loss_fn, batch)
+                    optimizer.step()
+                    optimizer.zero_grad()
+                    local_sgd.step()
+
+    a, b = final_weights(model)
+    accelerator.print(f"LocalSGD result: a={a:.3f} (want 2), b={b:.3f} (want 3)")
+    assert abs(a - 2) < 0.3 and abs(b - 3) < 0.3
+
+
+if __name__ == "__main__":
+    main()

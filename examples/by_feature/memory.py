@@ -1,0 +1,34 @@
+"""Automatic batch-size finding on OOM (reference analogue:
+examples/by_feature/memory.py — `find_executable_batch_size` halves the
+batch size and retries until training fits).
+"""
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import find_executable_batch_size
+
+from _common import final_weights, make_task
+
+
+def main():
+    accelerator = Accelerator()
+
+    @find_executable_batch_size(starting_batch_size=4096)
+    def train(batch_size):
+        accelerator.free_memory()
+        if batch_size > 64:
+            # stand-in for a real HBM OOM so the example runs anywhere
+            raise RuntimeError(f"RESOURCE_EXHAUSTED: pretend OOM at batch {batch_size}")
+        model, optimizer, dataloader, loss_fn = make_task(accelerator, batch_size=batch_size)
+        step = accelerator.build_train_step(loss_fn)
+        for epoch in range(3):
+            for batch in dataloader:
+                step(batch)
+        return batch_size, final_weights(model)
+
+    batch_size, (a, b) = train()
+    accelerator.print(f"trained at batch_size={batch_size}: a={a:.3f} b={b:.3f}")
+    assert batch_size == 64
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Every reference "strategy" as a mesh layout — the TPU-native replacement
+for DDP / FSDP / ZeRO / TP / Megatron config blocks (no reference analogue:
+the reference needs a different plugin + launcher config per strategy;
+here each is one MeshConfig line on the same script).
+
+Run under a fake 8-device mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python examples/by_feature/mesh_parallelism.py
+"""
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, MeshConfig, ParallelismPlugin
+from accelerate_tpu.models import BertConfig, bert_classification_loss, create_bert_model
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+LAYOUTS = {
+    "DDP (data parallel)": MeshConfig(data=-1),
+    "FSDP / ZeRO-3": MeshConfig(data=1, fsdp=-1),
+    "TP (Megatron splits)": MeshConfig(data=-1, tensor=2),
+    "SP (sequence parallel)": MeshConfig(data=-1, seq=2),
+    "3D hybrid": MeshConfig(data=2, fsdp=2, tensor=2),
+}
+
+
+def main():
+    import jax
+
+    ids = (np.arange(8 * 32).reshape(8, 32) % 1000).astype(np.int32)
+    batch = {
+        "input_ids": ids,
+        "attention_mask": np.ones((8, 32), np.bool_),
+        "labels": (np.arange(8) % 2).astype(np.int32),
+    }
+    for name, mesh_config in LAYOUTS.items():
+        if np.prod([v for v in vars(mesh_config).values() if v != -1]) > len(jax.devices()):
+            print(f"{name:24s} skipped (needs more devices)")
+            continue
+        for s in (AcceleratorState, GradientState, PartialState):
+            s._reset_state()
+        accelerator = Accelerator(
+            mixed_precision="bf16",
+            parallelism_plugin=ParallelismPlugin(mesh_config=mesh_config),
+        )
+        model = accelerator.prepare_model(create_bert_model(BertConfig.tiny(), seq_len=32))
+        accelerator.prepare_optimizer(optax.adamw(1e-3))
+        step = accelerator.build_train_step(
+            lambda p, b: bert_classification_loss(p, b, model.apply_fn)
+        )
+        loss = float(step(batch))
+        axes = {k: v for k, v in accelerator.mesh.shape.items() if v > 1}
+        print(f"{name:24s} mesh={axes or '{1 device}'} loss={loss:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Exact metrics across processes with gather_for_metrics
+(reference analogue: examples/by_feature/multi_process_metrics.py — the
+padded tail of the last uneven batch is dropped so every sample counts
+exactly once).
+"""
+
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+from _common import make_task
+
+
+def main():
+    accelerator = Accelerator()
+    model, optimizer, dataloader, loss_fn = make_task(accelerator, length=250)  # 250 !% 16
+    step = accelerator.build_train_step(loss_fn)
+    for epoch in range(3):
+        for batch in dataloader:
+            step(batch)
+
+    # eval: gather predictions from all ranks, dedup the padded tail
+    eval_ds = RegressionDataset(length=250, seed=7)
+    eval_dl = accelerator.prepare_data_loader(eval_ds, batch_size=16)
+    preds, targets = [], []
+    for batch in eval_dl:
+        pred = model.apply_fn(model.params, batch["x"])
+        pred, target = accelerator.gather_for_metrics((pred, batch["y"]))
+        preds.append(np.asarray(pred))
+        targets.append(np.asarray(target))
+    preds, targets = np.concatenate(preds), np.concatenate(targets)
+    assert preds.shape[0] == len(eval_ds), (preds.shape, len(eval_ds))
+    mse = float(((preds - targets) ** 2).mean())
+    accelerator.print(f"eval on exactly {preds.shape[0]} samples, MSE={mse:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Profiling a training step (reference analogue:
+examples/by_feature/profiler.py — torch.profiler Chrome traces;
+here `jax.profiler` TensorBoard/Perfetto traces via the same ctx API).
+"""
+
+import os
+import tempfile
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import ProfileKwargs
+
+from _common import make_task
+
+
+def main():
+    with tempfile.TemporaryDirectory() as trace_dir:
+        profile_kwargs = ProfileKwargs(output_trace_dir=trace_dir)
+        accelerator = Accelerator(kwargs_handlers=[profile_kwargs])
+        model, optimizer, dataloader, loss_fn = make_task(accelerator)
+        step = accelerator.build_train_step(loss_fn)
+
+        batch = next(iter(dataloader))
+        step(batch)  # compile outside the profiled region
+
+        with accelerator.profile() as prof:
+            for _ in range(10):
+                step(batch)
+        dumped = any(os.scandir(trace_dir))
+        accelerator.print(f"trace written to {trace_dir}: {dumped}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Experiment tracking (reference analogue: examples/by_feature/tracking.py).
+
+`init_trackers` starts every configured tracker (TensorBoard by default when
+available); `accelerator.log` fans metrics out to all of them on the main
+process only.
+"""
+
+import tempfile
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import ProjectConfiguration
+
+from _common import make_task
+
+
+def main():
+    with tempfile.TemporaryDirectory() as logdir:
+        accelerator = Accelerator(
+            log_with="all",
+            project_config=ProjectConfiguration(project_dir=logdir),
+        )
+        accelerator.init_trackers("by_feature_tracking", config={"lr": 0.1, "batch_size": 16})
+        model, optimizer, dataloader, loss_fn = make_task(accelerator)
+        step = accelerator.build_train_step(loss_fn)
+
+        global_step = 0
+        for epoch in range(2):
+            for batch in dataloader:
+                loss = step(batch)
+                accelerator.log({"train/loss": float(loss)}, step=global_step)
+                global_step += 1
+        accelerator.print(f"logged {global_step} steps to {len(accelerator.trackers)} tracker(s)")
+        accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

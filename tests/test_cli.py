@@ -112,3 +112,26 @@ def test_multiprocess_launch(tmp_path):
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert result.stdout.count("MP_OK") >= 1
+
+
+def test_sync_script_single_process():
+    """The self-checking sync-semantics script (reference analogue:
+    test_utils/scripts/test_sync.py) through the launcher."""
+    result = run_cli(
+        "launch", "--cpu", "--fake_devices", "8", "-m",
+        "accelerate_tpu.test_utils.scripts.test_sync",
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "test_sync: ALL OK" in result.stdout
+
+
+def test_ops_script_multiprocess():
+    """Collective-ops script on two real processes (reference analogue:
+    test_utils/scripts/test_ops.py)."""
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4",
+        "--main_process_port", "7813", "-m",
+        "accelerate_tpu.test_utils.scripts.test_ops",
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert result.stdout.count("test_ops: ALL OK") >= 1

@@ -1,0 +1,37 @@
+"""Run every by_feature example end-to-end on the CPU fake mesh
+(reference analogue: tests/test_examples.py, 308 LoC)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = pathlib.Path(__file__).parent.parent / "examples" / "by_feature"
+EXAMPLES = sorted(p.name for p in EXAMPLES_DIR.glob("*.py") if not p.name.startswith("_"))
+
+ENV = {
+    **os.environ,
+    "PALLAS_AXON_POOL_IPS": "",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+}
+
+
+@pytest.mark.parametrize("example", EXAMPLES)
+def test_example_runs(example):
+    result = subprocess.run(
+        [sys.executable, example],
+        cwd=EXAMPLES_DIR,
+        env=ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert result.returncode == 0, f"{example} failed:\n{result.stdout}\n{result.stderr}"
+
+
+def test_all_examples_discovered():
+    # guard against the glob silently matching nothing
+    assert len(EXAMPLES) >= 8, EXAMPLES

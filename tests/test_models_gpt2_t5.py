@@ -1,0 +1,175 @@
+"""GPT-2 + T5 model-family tests (reference's Megatron parsers cover
+bert/gpt2/t5/llama — dataclasses.py:2532-2662; this completes that set)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, MeshConfig, ParallelismPlugin
+from accelerate_tpu.models import (
+    GPT2Config,
+    T5Config,
+    causal_lm_loss,
+    create_gpt2_model,
+    create_t5_model,
+    seq2seq_lm_loss,
+)
+
+
+def test_gpt2_forward_and_tied_head():
+    cfg = GPT2Config.tiny()
+    model = create_gpt2_model(cfg, seq_len=16)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    logits = model(ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # tied head: no separate lm_head params
+    assert "lm_head" not in model.params
+
+
+def test_gpt2_train_step_tp_mesh():
+    cfg = GPT2Config.tiny()
+    acc = Accelerator(
+        mixed_precision="bf16",
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(data=2, tensor=4)),
+    )
+    model = acc.prepare_model(create_gpt2_model(cfg, seq_len=16))
+    from jax.sharding import PartitionSpec as P
+
+    assert model.params["layer_0"]["attn"]["q_proj"]["kernel"].sharding.spec == P(None, "tensor")
+    acc.prepare_optimizer(optax.adamw(1e-3))
+    step = acc.build_train_step(lambda p, b: causal_lm_loss(p, b, model.apply_fn))
+    ids = (np.arange(4 * 16).reshape(4, 16) % cfg.vocab_size).astype(np.int32)
+    l0 = float(step({"input_ids": ids}))
+    for _ in range(4):
+        l = float(step({"input_ids": ids}))
+    assert np.isfinite(l0) and l < l0
+
+
+def test_t5_forward_and_loss_decreases():
+    cfg = T5Config.tiny()
+    model = create_t5_model(cfg, seq_len=16)
+    ids = (np.arange(2 * 16).reshape(2, 16) % cfg.vocab_size).astype(np.int32)
+    logits = model(ids, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+    acc = Accelerator(
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(data=2, tensor=4))
+    )
+    model = acc.prepare_model(create_t5_model(cfg, seq_len=16))
+    acc.prepare_optimizer(optax.adam(1e-3))
+    step = acc.build_train_step(lambda p, b: seq2seq_lm_loss(p, b, model.apply_fn))
+    batch = {"input_ids": ids, "labels": ids}
+    l0 = float(step(batch))
+    for _ in range(5):
+        l = float(step(batch))
+    assert np.isfinite(l0) and l < l0
+
+
+def test_t5_label_masking():
+    cfg = T5Config.tiny()
+    model = create_t5_model(cfg, seq_len=8)
+    ids = (np.arange(2 * 8).reshape(2, 8) % cfg.vocab_size).astype(np.int32)
+    labels_full = ids.copy()
+    labels_masked = ids.copy()
+    labels_masked[:, 4:] = -100  # ignore second half
+    l_full = float(seq2seq_lm_loss(model.params, {"input_ids": ids, "labels": labels_full}, model.apply_fn))
+    l_masked = float(seq2seq_lm_loss(model.params, {"input_ids": ids, "labels": labels_masked}, model.apply_fn))
+    assert np.isfinite(l_full) and np.isfinite(l_masked)
+    assert abs(l_full - l_masked) > 1e-6  # masking changes the loss
+
+
+def test_hf_gpt2_import_split_qkv():
+    from accelerate_tpu.models.hub import convert_hf_gpt2_state
+
+    cfg = GPT2Config.tiny()
+    h = cfg.hidden_size
+    rng = np.random.default_rng(2)
+    state = {
+        "transformer.wte.weight": rng.normal(size=(cfg.vocab_size, h)).astype(np.float32),
+        "transformer.wpe.weight": rng.normal(size=(cfg.max_position_embeddings, h)).astype(np.float32),
+        "transformer.ln_f.weight": np.ones(h, np.float32),
+        "transformer.ln_f.bias": np.zeros(h, np.float32),
+    }
+    for i in range(cfg.num_hidden_layers):
+        p = f"transformer.h.{i}."
+        state.update({
+            p + "ln_1.weight": np.ones(h, np.float32),
+            p + "ln_1.bias": np.zeros(h, np.float32),
+            p + "ln_2.weight": np.ones(h, np.float32),
+            p + "ln_2.bias": np.zeros(h, np.float32),
+            p + "attn.c_attn.weight": rng.normal(size=(h, 3 * h)).astype(np.float32),
+            p + "attn.c_attn.bias": np.zeros(3 * h, np.float32),
+            p + "attn.c_proj.weight": rng.normal(size=(h, h)).astype(np.float32),
+            p + "attn.c_proj.bias": np.zeros(h, np.float32),
+            p + "mlp.c_fc.weight": rng.normal(size=(h, cfg.intermediate_size)).astype(np.float32),
+            p + "mlp.c_fc.bias": np.zeros(cfg.intermediate_size, np.float32),
+            p + "mlp.c_proj.weight": rng.normal(size=(cfg.intermediate_size, h)).astype(np.float32),
+            p + "mlp.c_proj.bias": np.zeros(h, np.float32),
+        })
+    tree = convert_hf_gpt2_state(state)
+    # fused qkv split into thirds, Conv1D orientation kept ([in, out])
+    np.testing.assert_allclose(
+        tree["layer_0"]["attn"]["k_proj"]["kernel"],
+        state["transformer.h.0.attn.c_attn.weight"][:, h:2 * h],
+    )
+    # imported tree loads into the model and it runs
+    model = create_gpt2_model(cfg, seq_len=8)
+    from accelerate_tpu.models.hub import _merge_into
+
+    _merge_into(model, tree)
+    assert model.imported_weight_count > 0
+    out = model(jnp.zeros((1, 8), jnp.int32))
+    assert out.shape == (1, 8, cfg.vocab_size)
+
+
+def test_hf_t5_import_structure():
+    from accelerate_tpu.models.hub import _merge_into, convert_hf_t5_state
+
+    cfg = T5Config.tiny()
+    h, ff, inner = cfg.hidden_size, cfg.intermediate_size, cfg.num_attention_heads * cfg.head_dim
+    rng = np.random.default_rng(3)
+    state = {
+        "shared.weight": rng.normal(size=(cfg.vocab_size, h)).astype(np.float32),
+        "encoder.final_layer_norm.weight": np.ones(h, np.float32),
+        "decoder.final_layer_norm.weight": np.ones(h, np.float32),
+    }
+    for stack, n_sub in (("encoder", 2), ("decoder", 3)):
+        for i in range(cfg.num_layers):
+            p = f"{stack}.block.{i}.layer."
+            attn0 = "SelfAttention"
+            state.update({
+                p + f"0.{attn0}.q.weight": rng.normal(size=(inner, h)).astype(np.float32),
+                p + f"0.{attn0}.k.weight": rng.normal(size=(inner, h)).astype(np.float32),
+                p + f"0.{attn0}.v.weight": rng.normal(size=(inner, h)).astype(np.float32),
+                p + f"0.{attn0}.o.weight": rng.normal(size=(h, inner)).astype(np.float32),
+                p + "0.layer_norm.weight": np.ones(h, np.float32),
+            })
+            if i == 0:
+                state[p + f"0.{attn0}.relative_attention_bias.weight"] = rng.normal(
+                    size=(cfg.relative_attention_num_buckets, cfg.num_attention_heads)
+                ).astype(np.float32)
+            if stack == "decoder":
+                state.update({
+                    p + "1.EncDecAttention.q.weight": rng.normal(size=(inner, h)).astype(np.float32),
+                    p + "1.EncDecAttention.k.weight": rng.normal(size=(inner, h)).astype(np.float32),
+                    p + "1.EncDecAttention.v.weight": rng.normal(size=(inner, h)).astype(np.float32),
+                    p + "1.EncDecAttention.o.weight": rng.normal(size=(h, inner)).astype(np.float32),
+                    p + "1.layer_norm.weight": np.ones(h, np.float32),
+                })
+            ffn_sub = n_sub - 1
+            state.update({
+                p + f"{ffn_sub}.DenseReluDense.wi.weight": rng.normal(size=(ff, h)).astype(np.float32),
+                p + f"{ffn_sub}.DenseReluDense.wo.weight": rng.normal(size=(h, ff)).astype(np.float32),
+                p + f"{ffn_sub}.layer_norm.weight": np.ones(h, np.float32),
+            })
+    tree = convert_hf_t5_state(state)
+    np.testing.assert_allclose(
+        tree["dec_layer_0"]["cross_attn"]["q_proj"]["kernel"],
+        state["decoder.block.0.layer.1.EncDecAttention.q.weight"].T,
+    )
+    model = create_t5_model(cfg, seq_len=8)
+    _merge_into(model, tree)
+    assert model.imported_weight_count == len(state)
+    out = model(jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 8), jnp.int32))
+    assert out.shape == (1, 8, cfg.vocab_size)

@@ -133,3 +133,30 @@ def test_trivial_pipe_axis():
         params, x
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(_sequential(params, x)), atol=1e-5, rtol=1e-5)
+
+
+def test_batch_shaped_broadcast_arg():
+    """broadcast_args sharing the batch dim (e.g. position ids) must be
+    microbatched per-stage alongside the activation."""
+    mesh = MeshConfig(pipe=4, data=2).build()
+    params = _stack(n_layers=8, width=16)
+    x = jax.random.normal(jax.random.PRNGKey(5), (16, 16))
+    pos = jax.random.normal(jax.random.PRNGKey(6), (16, 16))  # [B, W] extra
+
+    def layer_with_pos(p, h, pos):
+        return jnp.tanh(h @ p["w"] + p["b"] + pos) + h
+
+    def seq(params, x, pos):
+        def body(h, p):
+            return layer_with_pos(p, h, pos), None
+
+        out, _ = jax.lax.scan(body, x, params)
+        return out
+
+    sharded = jax.tree.map(lambda l: jax.device_put(l, stage_sharding(mesh)), params)
+    out = jax.jit(
+        lambda p, x, pos: pipeline_apply(
+            layer_with_pos, p, x, mesh=mesh, num_microbatches=4, broadcast_args=(pos,)
+        )
+    )(sharded, x, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq(params, x, pos)), atol=1e-5, rtol=1e-5)

@@ -1,0 +1,126 @@
+"""Weight-only quantization + fp8 tests (reference parity:
+tests/test_quantization.py for bnb int8/int4, utils/ao.py fp8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils.quantization import (
+    QTensor,
+    QuantizationConfig,
+    dequantize,
+    dequantize_params,
+    fp8_dot,
+    fp8_quantize,
+    load_and_quantize_model,
+    quantize,
+    quantize_params,
+    quantized_bytes,
+    quantized_matmul,
+)
+
+
+def _w(shape, seed=0, scale=0.05):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32) * scale
+
+
+@pytest.mark.parametrize("method,tol", [("int8", 1.5e-3), ("int4", 3e-2), ("nf4", 3e-2)])
+@pytest.mark.parametrize("group_size", [None, 32])
+def test_roundtrip_error(method, tol, group_size):
+    w = _w((128, 64))
+    cfg = QuantizationConfig(method=method, group_size=group_size)
+    qt = quantize(w, cfg)
+    back = dequantize(qt)
+    assert back.shape == w.shape and back.dtype == w.dtype
+    err = float(jnp.abs(back - w).max())
+    assert err < tol, f"{method} group={group_size}: max err {err}"
+
+
+def test_stacked_and_1d_shapes():
+    cfg = QuantizationConfig(method="int4", group_size=16)
+    for shape in [(4, 64, 32), (2, 3, 32, 16), (64,)]:
+        w = _w(shape, seed=1)
+        back = dequantize(quantize(w, cfg))
+        assert back.shape == w.shape
+        assert float(jnp.abs(back - w).max()) < 2e-2
+
+
+def test_memory_shrinks():
+    w = _w((256, 256))
+    q8 = quantize(w, QuantizationConfig(bits=8))
+    q4 = quantize(w, QuantizationConfig(bits=4, group_size=64))
+    assert q8.nbytes < w.nbytes * 0.6
+    assert q4.nbytes < w.nbytes * 0.35
+
+
+def test_qtensor_is_pytree_and_jittable():
+    qt = quantize(_w((64, 64)), QuantizationConfig())
+    leaves, treedef = jax.tree_util.tree_flatten(qt)
+    assert len(leaves) == 2
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    out = jax.jit(dequantize)(rebuilt)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(dequantize(qt)))
+
+
+@pytest.mark.parametrize("method,group_size", [("int8", None), ("int8", 32), ("nf4", 32)])
+def test_quantized_matmul_matches_dequant(method, group_size):
+    w = _w((128, 64))
+    x = _w((8, 128), seed=2, scale=1.0)
+    qt = quantize(w, QuantizationConfig(method=method, group_size=group_size))
+    y = quantized_matmul(x, qt)
+    ref = x @ dequantize(qt)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref, np.float32), atol=2e-2, rtol=2e-2)
+
+
+def test_quantize_params_skips_and_selects():
+    params = {
+        "embed_tokens": {"embedding": _w((100, 64))},
+        "layer_0": {"mlp": {"kernel": _w((64, 128))}, "norm": {"scale": jnp.ones(64)}},
+        "tiny": _w((4, 4)),
+    }
+    q = quantize_params(params, QuantizationConfig())
+    assert isinstance(q["layer_0"]["mlp"]["kernel"], QTensor)
+    assert not isinstance(q["embed_tokens"]["embedding"], QTensor)  # skip pattern
+    assert not isinstance(q["layer_0"]["norm"]["scale"], QTensor)
+    assert not isinstance(q["tiny"], QTensor)  # below min_size
+    assert quantized_bytes(q) > 0
+    back = dequantize_params(q)
+    assert back["layer_0"]["mlp"]["kernel"].shape == (64, 128)
+
+
+def test_load_and_quantize_model_end_to_end():
+    """Tiny Llama quantized to int8: logits close to fp32, params smaller."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    model = create_llama_model(LlamaConfig.tiny(scan_layers=True, remat=False), seq_len=16)
+    ids = (np.arange(2 * 16).reshape(2, 16) % 250).astype(np.int32)
+    ref = np.asarray(model(ids), np.float32)
+
+    qmodel = load_and_quantize_model(model, QuantizationConfig(bits=8))
+    out = np.asarray(jax.jit(qmodel.apply_fn)(qmodel.params, ids), np.float32)
+    # logits drift from weight rounding but ranking should broadly hold
+    assert np.mean(np.argmax(out, -1) == np.argmax(ref, -1)) > 0.9
+    np.testing.assert_allclose(out, ref, atol=0.35, rtol=0.5)
+    assert quantized_bytes(qmodel.params) < model.parameter_bytes() * 0.55
+
+
+def test_fp8_quantize_and_dot():
+    x = _w((32, 64), seed=3, scale=1.0)
+    x8, inv = fp8_quantize(x)
+    assert x8.dtype == jnp.float8_e4m3fn
+    np.testing.assert_allclose(np.asarray(x8, np.float32) * float(inv), np.asarray(x), atol=0.05, rtol=0.1)
+
+    a, b = _w((16, 64), seed=4, scale=1.0), _w((64, 32), seed=5, scale=1.0)
+    y = np.asarray(fp8_dot(a, b), np.float32)
+    ref = np.asarray(a @ b)
+    # e4m3 carries ~3 mantissa bits; bound the relative Frobenius error
+    rel = np.linalg.norm(y - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, f"fp8 matmul relative error {rel}"
+
+
+def test_rejects_bad_config():
+    with pytest.raises(ValueError):
+        QuantizationConfig(bits=3)
+    with pytest.raises(ValueError):
+        QuantizationConfig(method="int2")
