@@ -10,6 +10,7 @@ from .env import env_parser
 from .estimate import estimate_parser
 from .launch import launch_parser
 from .merge import merge_parser
+from .migrate import migrate_parser
 from .test import test_parser
 from .tpu import tpu_command_parser
 
@@ -25,6 +26,7 @@ def main():
     test_parser(subparsers)
     estimate_parser(subparsers)
     merge_parser(subparsers)
+    migrate_parser(subparsers)
     tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)

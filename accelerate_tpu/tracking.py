@@ -321,6 +321,110 @@ class ClearMLTracker(GeneralTracker):
         self.task.close()
 
 
+class TrackioTracker(GeneralTracker):
+    """(reference: tracking.py:431). HF trackio — wandb-compatible API."""
+
+    name = "trackio"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import trackio
+
+        self.run = trackio.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import trackio
+
+        trackio.config.update(values) if hasattr(trackio, "config") else self.run.config.update(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import trackio
+
+        trackio.log({**values, **({"step": step} if step is not None else {})})
+
+    @on_main_process
+    def finish(self):
+        import trackio
+
+        trackio.finish()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """(reference: tracking.py:1045)."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+class SwanLabTracker(GeneralTracker):
+    """(reference: LoggerType dataclasses.py:696-721 swanlab entry)."""
+
+    name = "swanlab"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import swanlab
+
+        self.run = swanlab.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.run.config.update(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        import swanlab
+
+        swanlab.log(values, step=step)
+
+    @on_main_process
+    def finish(self):
+        import swanlab
+
+        swanlab.finish()
+
+
 LOGGER_TYPE_TO_CLASS = {
     "jsonl": JSONLTracker,
     "tensorboard": TensorBoardTracker,
@@ -329,6 +433,9 @@ LOGGER_TYPE_TO_CLASS = {
     "aim": AimTracker,
     "comet_ml": CometMLTracker,
     "clearml": ClearMLTracker,
+    "trackio": TrackioTracker,
+    "dvclive": DVCLiveTracker,
+    "swanlab": SwanLabTracker,
 }
 
 _AVAILABILITY = {
@@ -339,6 +446,9 @@ _AVAILABILITY = {
     "aim": is_aim_available,
     "comet_ml": is_comet_ml_available,
     "clearml": is_clearml_available,
+    "trackio": is_trackio_available,
+    "dvclive": is_dvclive_available,
+    "swanlab": is_swanlab_available,
 }
 
 

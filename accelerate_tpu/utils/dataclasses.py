@@ -88,6 +88,7 @@ class LoggerType(BaseEnum):
     CLEARML = "clearml"
     DVCLIVE = "dvclive"
     SWANLAB = "swanlab"
+    TRACKIO = "trackio"
     JSONL = "jsonl"
 
 
@@ -219,6 +220,11 @@ class MixedPrecisionPolicy(KwargsHandler):
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     output_dtype: str = "float32"
+    # fp8 mode: the blanket cast stays bf16 (casting raw params/activations
+    # to e4m3 without per-tensor scaling destroys training); hot matmuls use
+    # the scaled e4m3 path (utils.quantization.fp8_dot — the TE-recipe
+    # equivalent, reference: utils/transformer_engine.py:26-163)
+    fp8: bool = False
 
     @classmethod
     def from_mixed_precision(cls, mixed_precision: str) -> "MixedPrecisionPolicy":
@@ -230,8 +236,7 @@ class MixedPrecisionPolicy(KwargsHandler):
         if mp == PrecisionType.FP16:
             return cls(compute_dtype="float16")
         if mp == PrecisionType.FP8:
-            # fp8 matmul inputs; accumulation stays bf16/fp32 (MXU semantics)
-            return cls(compute_dtype="float8_e4m3fn")
+            return cls(compute_dtype="bfloat16", fp8=True)
         raise ValueError(mixed_precision)
 
 

@@ -135,3 +135,47 @@ def test_ops_script_multiprocess():
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert result.stdout.count("test_ops: ALL OK") >= 1
+
+
+def test_migrate_command(tmp_path):
+    """Reference accelerate YAML -> our schema (reference analogue:
+    commands/to_fsdp2.py converter)."""
+    ref = tmp_path / "ref.yaml"
+    ref.write_text(
+        "compute_environment: LOCAL_MACHINE\n"
+        "distributed_type: FSDP\n"
+        "mixed_precision: bf16\n"
+        "num_processes: 8\n"
+        "num_machines: 2\n"
+        "fsdp_config:\n"
+        "  fsdp_sharding_strategy: FULL_SHARD\n"
+        "  fsdp_activation_checkpointing: true\n"
+    )
+    out = tmp_path / "ours.yaml"
+    result = run_cli("migrate", str(ref), "--output_file", str(out))
+    assert result.returncode == 0, result.stderr
+    text = out.read_text()
+    assert "mesh_fsdp: -1" in text
+    assert "mixed_precision: bf16" in text
+    assert "num_processes: 8" in text
+    # refuses to clobber without --overwrite
+    result = run_cli("migrate", str(ref), "--output_file", str(out))
+    assert result.returncode != 0
+    result = run_cli("migrate", str(ref), "--output_file", str(out), "--overwrite")
+    assert result.returncode == 0
+
+    # megatron tp/pp/sp mapping
+    ref2 = tmp_path / "ref2.yaml"
+    ref2.write_text(
+        "distributed_type: MEGATRON_LM\n"
+        "num_processes: 16\n"
+        "megatron_lm_config:\n"
+        "  tp_degree: 4\n"
+        "  pp_degree: 2\n"
+        "  sequence_parallelism: true\n"
+    )
+    result = run_cli("migrate", str(ref2))
+    assert result.returncode == 0
+    assert "mesh_tensor: 4" in result.stdout
+    assert "mesh_pipe: 2" in result.stdout
+    assert "mesh_seq" in result.stdout
