@@ -397,15 +397,40 @@ class Accelerator:
     def _ensure_opt_state(self, opt: AcceleratedOptimizer, model: Optional[Model] = None):
         """Bind the optimizer to a prepared model and init its (sharded)
         state. Deferred when no model has been prepared yet, so argument
-        order in ``prepare()`` doesn't matter."""
+        order in ``prepare()`` doesn't matter.
+
+        With ``ParallelismPlugin(shard_optimizer_state=True)`` (ZeRO-1/2;
+        reference: utils/deepspeed.py:253-294) the state is born sharded
+        over the ``data`` axis via ``out_shardings`` — params stay
+        replicated, per-device optimizer memory divides by the dp degree."""
         if opt.opt_state is not None:
             return
         model = model or getattr(opt, "_model", None) or (self._models[-1] if self._models else None)
         if model is None:
             return
         jax = _jax()
-        opt.opt_state = jax.jit(opt.optimizer.init)(model.params)
+        shardings = self._zero_state_shardings(opt.optimizer, model)
+        opt.opt_state = jax.jit(opt.optimizer.init, out_shardings=shardings)(model.params)
+        opt._zero_shardings = shardings
         opt._model = model
+
+    def _zero_state_shardings(self, optax_tx, model: Model):
+        """ZeRO-1/2 ``NamedSharding`` pytree for ``optax_tx``'s state, or
+        None when ``shard_optimizer_state`` is off / no data axis."""
+        plugin = self.state.parallelism_plugin
+        if plugin is None or not getattr(plugin, "shard_optimizer_state", False):
+            return None
+        from .parallel.mesh import data_parallel_size
+
+        if data_parallel_size(self.mesh) <= 1:
+            return None
+        jax = _jax()
+        from .parallel.sharding import zero_optimizer_shardings
+
+        state_shapes = jax.eval_shape(optax_tx.init, model.params)
+        return zero_optimizer_shardings(
+            state_shapes, getattr(model, "param_shardings", None), self.mesh
+        )
 
     def prepare_data_loader(
         self, data_loader, device_placement: Optional[bool] = None, slice_fn_for_dispatch=None, **kwargs
@@ -506,7 +531,7 @@ class Accelerator:
 
         wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
 
-        def step_fn(params, opt_state, grad_buf, batch, loss_scale, do_sync, rng):
+        def step_fn(params, opt_state, grad_buf, batch, loss_scale, do_sync, rng, clip_norm):
             def scaled_loss(p):
                 out = loss_fn(compute_cast(p), batch, rng) if wants_rng else loss_fn(compute_cast(p), batch)
                 loss, aux = (out if has_aux else (out, None))
@@ -521,19 +546,39 @@ class Accelerator:
                 return params, opt_state, grad_buf, jnp.float32(0.0), jnp.bool_(True)
 
             if accum == 1:
-                new_params, new_opt, new_buf, gnorm, finite = apply_gradients((params, opt_state, grad_buf))
+                new_params, new_opt, new_buf, gnorm, finite = apply_gradients(
+                    (params, opt_state, grad_buf), clip_norm
+                )
             else:
                 new_params, new_opt, new_buf, gnorm, finite = jax.lax.cond(
-                    do_sync, apply_gradients, hold, (params, opt_state, grad_buf)
+                    do_sync,
+                    lambda op: apply_gradients(op, clip_norm),
+                    hold,
+                    (params, opt_state, grad_buf),
                 )
+            if zero_shardings is not None:
+                # pin the ZeRO-1/2 layout so XLA keeps moments (and the
+                # accumulation buffer: ZeRO-2) data-sharded across steps
+                new_opt = jax.lax.with_sharding_constraint(new_opt, zero_shardings)
+                new_buf = jax.lax.with_sharding_constraint(new_buf, buf_shardings)
             return new_params, new_opt, new_buf, loss, gnorm, finite, aux
+
+        zero_shardings = getattr(optimizer, "_zero_shardings", None)
+        buf_shardings = None
+        if zero_shardings is not None:
+            from .parallel.sharding import zero_optimizer_shardings
+
+            buf_shardings = zero_optimizer_shardings(
+                model.params, getattr(model, "param_shardings", None), self.mesh
+            )
 
         donate_args = (0, 1, 2) if donate else ()
         jitted = jax.jit(step_fn, donate_argnums=donate_args)
 
-        grad_buf = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p))(
-            model.params
-        )
+        grad_buf = jax.jit(
+            lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p),
+            out_shardings=buf_shardings,
+        )(model.params)
         state_box = {"grad_buf": grad_buf, "micro": 0}
 
         def step(batch):
@@ -557,6 +602,7 @@ class Accelerator:
                 jnp.float32(self._loss_scale),
                 jnp.bool_(do_sync),
                 key_for_step(self.step),
+                jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
             )
             model.params = new_params
             optimizer.opt_state = new_opt
@@ -578,19 +624,25 @@ class Accelerator:
     def _make_gradient_applier(self, optax_tx):
         """The shared clip + finite-check + update + zero-buffer body used by
         both the fast path and the imperative path — one definition so the
-        two paths can never diverge."""
+        two paths can never diverge.
+
+        ``clip_norm`` is a *traced* scalar (negative = clipping disabled,
+        0.0 = zero all gradients, torch semantics), not a build-time
+        constant: calling ``clip_grad_norm_`` inside the training loop —
+        the reference idiom (accelerator.py:2677) — takes effect on the
+        very next step without rebuilding the jitted program."""
         jax = _jax()
         jnp = _jnp()
-        clip_norm = self._clip_max_norm
         use_fp16 = self.mixed_precision == "fp16"
 
-        def apply_gradients(operand):
+        def apply_gradients(operand, clip_norm):
             params, opt_state, grad_buf = operand
             g = grad_buf
             gnorm = optax_global_norm(g)
-            if clip_norm is not None:
-                scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
-                g = jax.tree_util.tree_map(lambda t: t * scale, g)
+            # clip_norm < 0 = clipping disabled; 0.0 zeroes gradients
+            # (torch clip_grad_norm_ semantics)
+            scale = jnp.where(clip_norm >= 0, jnp.minimum(1.0, clip_norm / (gnorm + 1e-6)), 1.0)
+            g = jax.tree_util.tree_map(lambda t: t * scale, g)
             finite = jnp.isfinite(gnorm)
 
             def do_update(_):
@@ -744,15 +796,20 @@ class Accelerator:
         _, grad_buffer = self._buffer_for(model)
         if grad_buffer is None:
             return True
-        cache_key = ("apply", id(opt), self._clip_max_norm)
+        cache_key = ("apply", id(opt))
         if cache_key not in self._jit_cache:
             apply_gradients = self._make_gradient_applier(opt.optimizer)
             self._jit_cache[cache_key] = jax.jit(
-                lambda params, opt_state, grad_buf: apply_gradients((params, opt_state, grad_buf)),
+                lambda params, opt_state, grad_buf, clip: apply_gradients(
+                    (params, opt_state, grad_buf), clip
+                ),
                 donate_argnums=(0, 1, 2),
             )
         new_params, new_opt, zero_buf, gnorm, finite = self._jit_cache[cache_key](
-            model.params, opt.opt_state, grad_buffer
+            model.params,
+            opt.opt_state,
+            grad_buffer,
+            _jnp().float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
         )
         model.params = new_params
         opt.opt_state = new_opt
@@ -765,15 +822,15 @@ class Accelerator:
         return ok
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: float = 2.0):
-        """(reference: accelerator.py:2677). Fast path: sets the norm used
-        inside the jitted step (rebuild the step to change it). Imperative
-        path: also clips the current buffer and returns its pre-clip norm."""
+        """(reference: accelerator.py:2677). Sets the max norm consumed by
+        the next gradient apply — the norm is a traced input of the jitted
+        step, so calling this inside the loop (the reference idiom) takes
+        effect immediately on both the fast and imperative paths. On the
+        imperative path the current buffer is also clipped in place and its
+        pre-clip norm returned."""
         if norm_type != 2.0:
             raise NotImplementedError("only the L2 global norm is supported on TPU")
-        rebuild = self._clip_max_norm != max_norm
         self._clip_max_norm = max_norm
-        if rebuild:
-            self._jit_cache = {k: v for k, v in self._jit_cache.items() if not (isinstance(k, tuple) and k and k[0] == "apply")}
         model = parameters if isinstance(parameters, Model) else None
         key, buf = self._buffer_for(model)
         if buf is not None:

@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from ..ops.fp8 import policy_dot_general as _pdg
 from jax.sharding import PartitionSpec as P
 
 from ..modeling import Model
@@ -81,7 +83,7 @@ class BertSelfAttention(nn.Module):
     def __call__(self, hidden, attention_mask, deterministic: bool = True):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
-        dense = lambda name: nn.Dense(cfg.hidden_size, name=name, dtype=hidden.dtype)
+        dense = lambda name: nn.Dense(cfg.hidden_size, name=name, dtype=hidden.dtype, dot_general=_pdg())
         q = dense("query")(hidden)
         k = dense("key")(hidden)
         v = dense("value")(hidden)
@@ -102,7 +104,7 @@ class BertSelfAttention(nn.Module):
             dropout_rng=None if deterministic else self.make_rng("dropout"),
         )
         out = out.reshape(*out.shape[:-2], cfg.hidden_size)
-        out = nn.Dense(cfg.hidden_size, name="out", dtype=hidden.dtype)(out)
+        out = nn.Dense(cfg.hidden_size, name="out", dtype=hidden.dtype, dot_general=_pdg())(out)
         if not deterministic:
             out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=False)
         return out
@@ -119,9 +121,9 @@ class BertLayer(nn.Module):
             hidden + attn_out
         ).astype(hidden.dtype)
 
-        ffn = nn.Dense(cfg.intermediate_size, name="ffn/intermediate", dtype=hidden.dtype)(hidden)
+        ffn = nn.Dense(cfg.intermediate_size, name="ffn/intermediate", dtype=hidden.dtype, dot_general=_pdg())(hidden)
         ffn = nn.gelu(ffn, approximate=False)
-        ffn = nn.Dense(cfg.hidden_size, name="ffn/output", dtype=hidden.dtype)(ffn)
+        ffn = nn.Dense(cfg.hidden_size, name="ffn/output", dtype=hidden.dtype, dot_general=_pdg())(ffn)
         if not deterministic:
             ffn = nn.Dropout(cfg.hidden_dropout_prob)(ffn, deterministic=False)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ffn_norm", dtype=jnp.float32)(

@@ -16,6 +16,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from ..ops.fp8 import policy_dot_general as _pdg
 from jax.sharding import PartitionSpec as P
 
 from ..modeling import Model
@@ -71,9 +73,9 @@ class GPT2Attention(nn.Module):
     def __call__(self, hidden):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
-        q = nn.Dense(cfg.hidden_size, name="q_proj", dtype=hidden.dtype)(hidden)
-        k = nn.Dense(cfg.hidden_size, name="k_proj", dtype=hidden.dtype)(hidden)
-        v = nn.Dense(cfg.hidden_size, name="v_proj", dtype=hidden.dtype)(hidden)
+        q = nn.Dense(cfg.hidden_size, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        k = nn.Dense(cfg.hidden_size, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        v = nn.Dense(cfg.hidden_size, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
 
         def split(x):
             return x.reshape(*x.shape[:-1], cfg.num_attention_heads, head_dim)
@@ -82,7 +84,7 @@ class GPT2Attention(nn.Module):
 
         out = dot_product_attention(split(q), split(k), split(v), causal=True, mesh=active_mesh())
         out = out.reshape(*out.shape[:-2], cfg.hidden_size)
-        return nn.Dense(cfg.hidden_size, name="o_proj", dtype=hidden.dtype)(out)
+        return nn.Dense(cfg.hidden_size, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
 
 
 class GPT2MLP(nn.Module):
@@ -91,9 +93,9 @@ class GPT2MLP(nn.Module):
     @nn.compact
     def __call__(self, hidden):
         cfg = self.config
-        h = nn.Dense(cfg.intermediate_size, name="fc_in", dtype=hidden.dtype)(hidden)
+        h = nn.Dense(cfg.intermediate_size, name="fc_in", dtype=hidden.dtype, dot_general=_pdg())(hidden)
         h = nn.gelu(h, approximate=True)
-        return nn.Dense(cfg.hidden_size, name="fc_out", dtype=hidden.dtype)(h)
+        return nn.Dense(cfg.hidden_size, name="fc_out", dtype=hidden.dtype, dot_general=_pdg())(h)
 
 
 class GPT2Block(nn.Module):

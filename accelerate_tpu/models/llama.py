@@ -20,6 +20,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from ..ops.fp8 import policy_dot_general as _pdg
 from jax.sharding import PartitionSpec as P
 
 from ..modeling import Model
@@ -141,9 +143,9 @@ class LlamaAttention(nn.Module):
     def __call__(self, hidden, positions):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
-        q = nn.Dense(cfg.num_attention_heads * head_dim, use_bias=False, name="q_proj", dtype=hidden.dtype)(hidden)
-        k = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="k_proj", dtype=hidden.dtype)(hidden)
-        v = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="v_proj", dtype=hidden.dtype)(hidden)
+        q = nn.Dense(cfg.num_attention_heads * head_dim, use_bias=False, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        k = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        v = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
         q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
         k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
@@ -151,7 +153,7 @@ class LlamaAttention(nn.Module):
         k = rope(k, positions, cfg.rope_theta)
         out = _dispatch_attention(q, k, v, cfg.attention_impl)
         out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype)(out)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
 
 
 class LlamaMLP(nn.Module):
@@ -160,9 +162,9 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, hidden):
         cfg = self.config
-        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj", dtype=hidden.dtype)(hidden)
-        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj", dtype=hidden.dtype)(hidden)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj", dtype=hidden.dtype)(
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj", dtype=hidden.dtype, dot_general=_pdg())(
             nn.silu(gate) * up
         )
 

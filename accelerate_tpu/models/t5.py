@@ -19,6 +19,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from ..modeling import Model
+from ..ops.fp8 import policy_dot_general as _pdg
 from .llama import RMSNorm  # T5's LayerNorm is RMS (no mean subtraction)
 
 
@@ -92,13 +93,17 @@ class T5Attention(nn.Module):
     has_relative_bias: bool = False
 
     @nn.compact
-    def __call__(self, hidden, kv=None, mask=None):
+    def __call__(self, hidden, kv=None, mask=None, position_bias=None):
+        """Returns ``(out, position_bias)``. Like HF ``T5Stack``, the bias
+        table lives only in the layer-0 attention (``has_relative_bias``);
+        every later layer receives the computed ``position_bias`` and adds
+        the same [1, H, Q, K] bias to its logits."""
         cfg = self.config
         kv = hidden if kv is None else kv
         inner = cfg.num_attention_heads * cfg.head_dim
-        q = nn.Dense(inner, use_bias=False, name="q_proj", dtype=hidden.dtype)(hidden)
-        k = nn.Dense(inner, use_bias=False, name="k_proj", dtype=hidden.dtype)(kv)
-        v = nn.Dense(inner, use_bias=False, name="v_proj", dtype=hidden.dtype)(kv)
+        q = nn.Dense(inner, use_bias=False, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        k = nn.Dense(inner, use_bias=False, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(kv)
+        v = nn.Dense(inner, use_bias=False, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(kv)
 
         def split(x):
             return x.reshape(*x.shape[:-1], cfg.num_attention_heads, cfg.head_dim)
@@ -106,7 +111,7 @@ class T5Attention(nn.Module):
         q, k, v = split(q), split(k), split(v)
         # T5 does NOT scale by sqrt(d); fold relative bias into the logits
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        if self.has_relative_bias:
+        if position_bias is None and self.has_relative_bias:
             buckets = relative_position_buckets(
                 q.shape[1],
                 k.shape[1],
@@ -119,7 +124,9 @@ class T5Attention(nn.Module):
                 nn.initializers.normal(1.0),
                 (cfg.relative_attention_num_buckets, cfg.num_attention_heads),
             )
-            logits = logits + bias_table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+            position_bias = bias_table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+        if position_bias is not None:
+            logits = logits + position_bias
         if self.causal:
             cmask = jnp.arange(q.shape[1])[:, None] >= jnp.arange(k.shape[1])[None, :]
             logits = jnp.where(cmask[None, None], logits, jnp.finfo(jnp.float32).min)
@@ -128,7 +135,8 @@ class T5Attention(nn.Module):
         weights = jax.nn.softmax(logits, axis=-1).astype(hidden.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(*out.shape[:-2], inner)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype)(out)
+        out = nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
+        return out, position_bias
 
 
 class T5FFN(nn.Module):
@@ -137,9 +145,9 @@ class T5FFN(nn.Module):
     @nn.compact
     def __call__(self, hidden):
         cfg = self.config
-        h = nn.Dense(cfg.intermediate_size, use_bias=False, name="wi", dtype=hidden.dtype)(hidden)
+        h = nn.Dense(cfg.intermediate_size, use_bias=False, name="wi", dtype=hidden.dtype, dot_general=_pdg())(hidden)
         h = nn.relu(h)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="wo", dtype=hidden.dtype)(h)
+        return nn.Dense(cfg.hidden_size, use_bias=False, name="wo", dtype=hidden.dtype, dot_general=_pdg())(h)
 
 
 class T5EncoderLayer(nn.Module):
@@ -147,13 +155,14 @@ class T5EncoderLayer(nn.Module):
     has_relative_bias: bool = False
 
     @nn.compact
-    def __call__(self, hidden, mask):
+    def __call__(self, hidden, mask, position_bias=None):
         cfg = self.config
-        hidden = hidden + T5Attention(
+        attn_out, position_bias = T5Attention(
             cfg, causal=False, has_relative_bias=self.has_relative_bias, name="attn"
-        )(RMSNorm(cfg.layer_norm_eps, name="ln_attn")(hidden), mask=mask)
+        )(RMSNorm(cfg.layer_norm_eps, name="ln_attn")(hidden), mask=mask, position_bias=position_bias)
+        hidden = hidden + attn_out
         hidden = hidden + T5FFN(cfg, name="ffn")(RMSNorm(cfg.layer_norm_eps, name="ln_ffn")(hidden))
-        return hidden
+        return hidden, position_bias
 
 
 class T5DecoderLayer(nn.Module):
@@ -161,16 +170,19 @@ class T5DecoderLayer(nn.Module):
     has_relative_bias: bool = False
 
     @nn.compact
-    def __call__(self, hidden, enc_out, enc_mask):
+    def __call__(self, hidden, enc_out, enc_mask, position_bias=None):
         cfg = self.config
-        hidden = hidden + T5Attention(
+        self_out, position_bias = T5Attention(
             cfg, causal=True, has_relative_bias=self.has_relative_bias, name="self_attn"
-        )(RMSNorm(cfg.layer_norm_eps, name="ln_self")(hidden))
-        hidden = hidden + T5Attention(cfg, causal=False, name="cross_attn")(
+        )(RMSNorm(cfg.layer_norm_eps, name="ln_self")(hidden), position_bias=position_bias)
+        hidden = hidden + self_out
+        # HF T5 cross-attention carries no position bias (zeros)
+        cross_out, _ = T5Attention(cfg, causal=False, name="cross_attn")(
             RMSNorm(cfg.layer_norm_eps, name="ln_cross")(hidden), kv=enc_out, mask=enc_mask
         )
+        hidden = hidden + cross_out
         hidden = hidden + T5FFN(cfg, name="ffn")(RMSNorm(cfg.layer_norm_eps, name="ln_ffn")(hidden))
-        return hidden
+        return hidden, position_bias
 
 
 class T5Model(nn.Module):
@@ -190,14 +202,18 @@ class T5Model(nn.Module):
         dec_layer = nn.remat(T5DecoderLayer, prevent_cse=False) if cfg.remat else T5DecoderLayer
 
         h = maybe_shard(shared(input_ids), spec)
+        enc_bias = None  # computed by layer 0, shared by layers 1..N (HF T5Stack)
         for i in range(cfg.num_layers):
-            h = enc_layer(cfg, has_relative_bias=(i == 0), name=f"enc_layer_{i}")(h, attention_mask)
+            h, enc_bias = enc_layer(cfg, has_relative_bias=(i == 0), name=f"enc_layer_{i}")(
+                h, attention_mask, enc_bias
+            )
         enc_out = RMSNorm(cfg.layer_norm_eps, name="enc_final_norm")(h)
 
         d = maybe_shard(shared(decoder_input_ids), spec)
+        dec_bias = None
         for i in range(cfg.num_layers):
-            d = dec_layer(cfg, has_relative_bias=(i == 0), name=f"dec_layer_{i}")(
-                d, enc_out, attention_mask
+            d, dec_bias = dec_layer(cfg, has_relative_bias=(i == 0), name=f"dec_layer_{i}")(
+                d, enc_out, attention_mask, dec_bias
             )
         d = RMSNorm(cfg.layer_norm_eps, name="dec_final_norm")(d)
         if cfg.tie_word_embeddings:

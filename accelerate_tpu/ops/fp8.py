@@ -1,0 +1,91 @@
+"""FP8 training path: scaled e4m3/e5m2 matmuls behind a policy switch.
+
+TPU-native collapse of the reference's three fp8 backends
+(TransformerEngine: src/accelerate/utils/transformer_engine.py:26-163,
+torchao: utils/ao.py:104-140, MS-AMP): instead of swapping ``nn.Linear``
+modules for backend-specific ones, every ``nn.Dense`` in the model zoo takes
+its ``dot_general`` from :func:`policy_dot_general` — ``lax.dot_general``
+normally, :func:`fp8_dot_general` when ``mixed_precision="fp8"``.
+
+Recipe (the TE "hybrid" default): forward activations/weights quantized
+per-tensor to e4m3, gradients to e5m2, fp32 accumulation, dynamic (amax)
+scaling. Scales are constants w.r.t. autodiff (custom VJP), matching TE's
+non-differentiable scale bookkeeping.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.quantization import fp8_quantize as _quantize
+
+
+@jax.custom_vjp
+def _fp8_matmul(lhs: jax.Array, rhs: jax.Array) -> jax.Array:
+    """``lhs[..., K] @ rhs[K, N]`` with e4m3 inputs, fp32 accumulation."""
+    l8, sl = _quantize(lhs, jnp.float8_e4m3fn)
+    r8, sr = _quantize(rhs, jnp.float8_e4m3fn)
+    y = jax.lax.dot_general(
+        l8, r8, (((lhs.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return (y * (sl * sr)).astype(lhs.dtype)
+
+
+def _fp8_matmul_fwd(lhs, rhs):
+    return _fp8_matmul(lhs, rhs), (lhs, rhs)
+
+
+def _fp8_matmul_bwd(res, g):
+    lhs, rhs = res
+    g8, sg = _quantize(g, jnp.float8_e5m2)  # gradients in e5m2 (TE hybrid)
+    r8, sr = _quantize(rhs, jnp.float8_e4m3fn)
+    l8, sl = _quantize(lhs, jnp.float8_e4m3fn)
+    # dlhs[..., K] = g[..., N] @ rhs.T[N, K]
+    dlhs = jax.lax.dot_general(
+        g8, r8, (((g.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (sg * sr)
+    # drhs[K, N] = lhs.T[K, B] @ g[B, N] with batch dims flattened
+    k, n = rhs.shape
+    l2 = l8.reshape(-1, k)
+    g2 = g8.reshape(-1, n)
+    drhs = jax.lax.dot_general(
+        l2, g2, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * (sl * sg)
+    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype)
+
+
+_fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
+def fp8_dot_general(lhs, rhs, dimension_numbers, precision=None, preferred_element_type=None):
+    """Drop-in ``lax.dot_general`` for the ``nn.Dense`` contraction pattern
+    (last dim of lhs x first dim of rhs, no batch dims). Other patterns fall
+    back to the plain dot — same behavior as the reference converting only
+    ``Linear`` layers (utils/transformer_engine.py:41)."""
+    ((lc, rc), (lb, rb)) = dimension_numbers
+    if tuple(lc) == (lhs.ndim - 1,) and tuple(rc) == (0,) and not lb and not rb and rhs.ndim == 2:
+        return _fp8_matmul(lhs, rhs)
+    return jax.lax.dot_general(
+        lhs, rhs, dimension_numbers, precision=precision,
+        preferred_element_type=preferred_element_type,
+    )
+
+
+def fp8_enabled() -> bool:
+    """True when the active Accelerator's dtype policy requests fp8."""
+    from ..state import AcceleratorState
+
+    state = AcceleratorState._shared_state
+    if not state.get("_initialized"):
+        return False
+    policy = state.get("dtype_policy")
+    return bool(policy is not None and getattr(policy, "fp8", False))
+
+
+def policy_dot_general():
+    """The ``dot_general`` the model zoo passes to every ``nn.Dense``.
+    Resolved at trace time (module ``__call__``), so the choice is burned
+    into the jitted program — set ``mixed_precision`` before building the
+    train step."""
+    return fp8_dot_general if fp8_enabled() else jax.lax.dot_general

@@ -117,7 +117,9 @@ def moe_ffn(
 class MoEBlock(nn.Module):
     """Sparse SwiGLU FFN block (Mixtral-style): top-k routed experts with a
     shared residual path for dropped tokens. Expects [B, S, d]; returns
-    ([B, S, d], aux_loss)."""
+    [B, S, d]. The load-balancing aux loss is exposed via
+    ``sow("intermediates", "moe_aux_loss")`` — read it from the mutable
+    ``intermediates`` collection after ``apply``."""
 
     num_experts: int
     intermediate_size: int

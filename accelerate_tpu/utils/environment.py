@@ -102,3 +102,36 @@ def purge_accelerate_environment(func):
             os.environ.update(saved)
 
     return wrapper
+
+
+def force_host_platform(n_devices: int = 8) -> None:
+    """Force the JAX CPU (host) platform with ``n_devices`` virtual devices.
+
+    The single authoritative bootstrap for every fake-mesh entry point
+    (tests/conftest.py, ``__graft_entry__.dryrun_multichip``, bench smoke
+    mode). Env vars alone are NOT enough: the axon TPU plugin registers at
+    interpreter start and wins over ``JAX_PLATFORMS``; only the
+    ``jax.config`` override reliably forces CPU. Must run before the first
+    backend use in this process; if a backend was already initialised it is
+    dropped so the CPU platform (re-)initialises with the requested count.
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", opt, flags)
+    else:
+        flags = f"{flags} {opt}"
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass

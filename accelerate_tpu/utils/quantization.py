@@ -71,6 +71,9 @@ class QuantizationConfig:
             raise ValueError(f"method must be int8|int4|nf4, got {self.method!r}")
         if self.method != "int8" and self.bits != 4:
             self.bits = 4
+        elif self.method == "int8" and self.bits != 8:
+            # int8 stores unpacked 8-bit codes; bits=4 would give no saving
+            raise ValueError('method="int8" requires bits=8; use method="int4"/"nf4" for 4-bit')
 
 
 @jax.tree_util.register_pytree_node_class
@@ -266,10 +269,8 @@ def fp8_quantize(x: jax.Array, dtype=jnp.float8_e4m3fn):
 def fp8_dot(a: jax.Array, b: jax.Array, out_dtype=jnp.bfloat16) -> jax.Array:
     """``a @ b`` computed in fp8 (e4m3 inputs, fp32 accumulation) with
     per-tensor dynamic scales — the hot-path op behind the fp8 mixed
-    precision mode (reference fp8 backends: SURVEY §2.6)."""
-    a8, sa = fp8_quantize(a)
-    b8, sb = fp8_quantize(b)
-    y = jax.lax.dot_general(
-        a8, b8, (((a.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    return (y * (sa * sb)).astype(out_dtype)
+    precision mode (reference fp8 backends: SURVEY §2.6). Delegates to the
+    custom-VJP matmul in :mod:`..ops.fp8` (single copy of the recipe)."""
+    from ..ops.fp8 import _fp8_matmul
+
+    return _fp8_matmul(a, b).astype(out_dtype)

@@ -7,14 +7,9 @@ test runs against a real 8-way mesh on CPU. Must run before any backend
 initialisation (the axon TPU plugin registers at interpreter start, so the
 platform override happens via jax.config, not env)."""
 
-import os
+from accelerate_tpu.utils.environment import force_host_platform
 
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_host_platform(8)
 
 import pytest
 

@@ -211,3 +211,47 @@ def test_fast_path_syncs_at_end_of_dataloader():
     for batch in loader:
         step(batch)
     assert float(model.params["a"]) != a0  # update applied at epoch end
+
+
+def test_clip_grad_norm_fast_path_after_build():
+    """Reference-shaped loop: clip_grad_norm_ called *inside* the loop,
+    after build_train_step, must actually cap the applied gradient (the
+    round-1 footgun — the norm is now a traced step input)."""
+    acc = make_accelerator()
+    ds = RegressionDataset(length=64)
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(1.0))
+    loader = acc.prepare_data_loader(ds)
+    step = acc.build_train_step(linear_loss_fn)  # built BEFORE any clip call
+    batch = next(iter(loader))
+
+    # unclipped step moves params by the raw gradient
+    a0 = float(np.asarray(model.params["a"]))
+    step(batch)
+    raw_delta = abs(float(np.asarray(model.params["a"])) - a0)
+    assert float(acc._last_grad_norm) > 1e-3
+
+    # now clip inside the loop to a tiny norm: the very next step's update
+    # magnitude must shrink to ~max_norm (sgd lr=1 → |delta| ≈ |grad|)
+    acc.clip_grad_norm_(max_norm=1e-4)
+    a1 = float(np.asarray(model.params["a"]))
+    step(batch)
+    clipped_delta = abs(float(np.asarray(model.params["a"])) - a1)
+    assert clipped_delta <= 1.2e-4, (raw_delta, clipped_delta)
+    assert clipped_delta < raw_delta
+
+
+def test_clip_grad_norm_zero_freezes_step():
+    """max_norm=0.0 scales gradients to zero (torch semantics), it does NOT
+    disable clipping."""
+    acc = make_accelerator()
+    ds = RegressionDataset(length=64)
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(1.0))
+    loader = acc.prepare_data_loader(ds)
+    step = acc.build_train_step(linear_loss_fn)
+    batch = next(iter(loader))
+    acc.clip_grad_norm_(max_norm=0.0)
+    a0 = float(np.asarray(model.params["a"]))
+    step(batch)
+    assert float(np.asarray(model.params["a"])) == pytest.approx(a0, abs=1e-12)

@@ -1,0 +1,101 @@
+"""FP8 training path (reference analogue: benchmarks/fp8/* loss-parity
+scripts + tests/test_fp8.py — accelerate's fp8 integration must track the
+bf16 loss curve)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.ops.fp8 import _fp8_matmul, fp8_dot_general, fp8_enabled, policy_dot_general
+
+
+def test_fp8_matmul_close_to_fp32():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+    exact = a @ b
+    approx = _fp8_matmul(a, b)
+    # e4m3 has ~2 decimal digits; relative error on a 64-deep dot stays small
+    rel = float(jnp.max(jnp.abs(approx - exact)) / jnp.max(jnp.abs(exact)))
+    assert rel < 0.05, rel
+
+
+def test_fp8_matmul_grads_close_to_fp32():
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+
+    def loss8(a, b):
+        return jnp.sum(_fp8_matmul(a, b) ** 2)
+
+    def loss32(a, b):
+        return jnp.sum((a @ b) ** 2)
+
+    g8 = jax.grad(loss8, argnums=(0, 1))(a, b)
+    g32 = jax.grad(loss32, argnums=(0, 1))(a, b)
+    for q, e in zip(g8, g32):
+        rel = float(jnp.max(jnp.abs(q - e)) / (jnp.max(jnp.abs(e)) + 1e-9))
+        assert rel < 0.1, rel
+
+
+def test_fp8_dot_general_fallback_patterns():
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.normal(size=(2, 3, 5)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(5, 7)).astype(np.float32))
+    # Dense pattern routes through fp8
+    dn = (((2,), (0,)), ((), ()))
+    out = fp8_dot_general(a, b, dn)
+    assert out.shape == (2, 3, 7)
+    # non-Dense pattern (batched) falls back to exact lax.dot_general
+    c = jnp.asarray(rng.normal(size=(2, 5, 3)).astype(np.float32))
+    d = jnp.asarray(rng.normal(size=(2, 3, 4)).astype(np.float32))
+    dn_b = (((2,), (1,)), ((0,), (0,)))
+    np.testing.assert_allclose(
+        fp8_dot_general(c, d, dn_b), jax.lax.dot_general(c, d, dn_b), rtol=1e-6
+    )
+
+
+def _train_bert_tiny(mixed_precision, steps=12):
+    from accelerate_tpu.models import BertConfig, bert_classification_loss, create_bert_model
+
+    acc = Accelerator(mixed_precision=mixed_precision)
+    model = acc.prepare_model(create_bert_model(BertConfig.tiny(), seq_len=16, seed=0))
+    acc.prepare_optimizer(optax.adamw(5e-4))
+    step = acc.build_train_step(lambda p, b: bert_classification_loss(p, b, model.apply_fn))
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, 64, size=(16, 16)).astype(np.int32),
+        "attention_mask": np.ones((16, 16), np.bool_),
+        "labels": rng.integers(0, 2, size=(16,)).astype(np.int32),
+    }
+    return [float(step(batch)) for _ in range(steps)]
+
+
+def test_fp8_policy_enabled_via_mixed_precision():
+    from accelerate_tpu.state import AcceleratorState
+
+    assert not fp8_enabled()
+    Accelerator(mixed_precision="fp8")
+    assert fp8_enabled()
+    assert policy_dot_general() is fp8_dot_general
+    AcceleratorState._reset_state()
+
+
+def test_fp8_loss_parity_vs_bf16():
+    """mixed_precision="fp8" must track the bf16 loss curve on BERT-tiny
+    (the reference's benchmarks/fp8 parity bar)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    losses_bf16 = _train_bert_tiny("bf16")
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    losses_fp8 = _train_bert_tiny("fp8")
+
+    # both converge and the curves stay close
+    assert losses_fp8[-1] < 0.5 * losses_fp8[0]
+    for lb, lf in zip(losses_bf16, losses_fp8):
+        assert abs(lb - lf) < 0.1, (losses_bf16, losses_fp8)

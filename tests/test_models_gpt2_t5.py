@@ -173,3 +173,38 @@ def test_hf_t5_import_structure():
     assert model.imported_weight_count == len(state)
     out = model(jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 8), jnp.int32))
     assert out.shape == (1, 8, cfg.vocab_size)
+
+
+def test_t5_position_bias_shared_across_layers():
+    """Every layer's self-attention must receive the layer-0 relative
+    position bias (HF T5Stack shares it); zeroing the table must change
+    the contribution of layers > 0, not just layer 0."""
+    cfg = T5Config.tiny()
+    model = create_t5_model(cfg, seq_len=8)
+    ids = (np.arange(2 * 8).reshape(2, 8) % cfg.vocab_size).astype(np.int32)
+
+    # gradient of the output w.r.t. the layer-0 bias table flows through
+    # layers 1..N iff the bias is threaded into them; compare against a
+    # 1-layer model where only layer 0 consumes it.
+    def out_sum(params):
+        return jnp.sum(model.apply_fn(params, ids, ids))
+
+    g = jax.grad(out_sum)(model.params)
+    g_table = g["enc_layer_0"]["attn"]["relative_bias/embedding"]
+    assert float(jnp.abs(g_table).sum()) > 0
+
+    # direct check of the threading: an encoder layer *without* its own
+    # table must respond to an externally supplied position_bias.
+    from accelerate_tpu.models.t5 import T5EncoderLayer
+
+    layer = T5EncoderLayer(cfg, has_relative_bias=False)
+    h = jax.random.normal(jax.random.key(1), (1, 8, cfg.hidden_size), jnp.float32)
+    mask = jnp.ones((1, 8), jnp.bool_)
+    params = layer.init(jax.random.key(0), h, mask)
+    out_none, bias_none = layer.apply(params, h, mask, None)
+    assert bias_none is None
+    big_bias = jnp.full((1, cfg.num_attention_heads, 8, 8), 5.0, jnp.float32)
+    bias = big_bias.at[..., 0].set(-5.0)
+    out_bias, bias_out = layer.apply(params, h, mask, bias)
+    assert bias_out is bias
+    assert float(jnp.abs(out_bias - out_none).max()) > 1e-6
