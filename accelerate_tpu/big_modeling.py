@@ -177,9 +177,12 @@ def infer_auto_device_map(
 
 
 def get_balanced_memory(params: Any, num_devices: int, prefix_depth: int = 2) -> dict:
-    """Even split targets (reference: utils/modeling.py:935)."""
-    total = sum(compute_module_sizes(params, prefix_depth).values())
-    per = int(total / num_devices * 1.15)  # slack for activations
+    """Even split targets (reference: utils/modeling.py:935). The per-device
+    budget is floored at the largest single group so one oversized block
+    (typically the embedding) cannot overflow every device in turn."""
+    sizes = compute_module_sizes(params, prefix_depth)
+    total = sum(sizes.values())
+    per = max(int(total / num_devices * 1.15), max(sizes.values(), default=0))
     return {i: per for i in range(num_devices)}
 
 
@@ -342,8 +345,16 @@ def load_checkpoint_and_dispatch(
     max_memory: Optional[dict] = None,
     offload_dir: Optional[str] = None,
 ):
-    """(reference: big_modeling.py:512)."""
+    """(reference: big_modeling.py:512). ``device_map`` may be a dict, or
+    "auto" (pack into measured HBM budgets) or "balanced" (even split across
+    local devices via :func:`get_balanced_memory`,
+    reference: utils/modeling.py:935)."""
     flat_target = {k: None for k in model.state_dict().keys()} if model.params is not None else {}
+    if device_map == "balanced":
+        import jax
+
+        max_memory = get_balanced_memory(model.params, len(jax.local_devices()))
+        device_map = "auto"
     if device_map == "auto":
         device_map = infer_auto_device_map(model.params, max_memory=max_memory)
     state = load_checkpoint_in_model(flat_target, checkpoint, device_map=None)

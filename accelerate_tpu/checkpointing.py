@@ -125,17 +125,9 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     if accelerator.is_main_process:
         for i, sched in enumerate(accelerator._schedulers):
             (out / f"{SCHEDULER_NAME}_{i}.json").write_text(json.dumps(sched.state_dict()))
-        # dataloader/sampler positions (reference: checkpointing.py:128-143)
-        samplers = []
-        for dl in accelerator._dataloaders:
-            samplers.append(
-                {
-                    "iteration": getattr(dl, "iteration", 0),
-                    "batch_size": getattr(dl, "batch_size", None),
-                    "sampler_epoch": getattr(getattr(dl, "sampler", None), "epoch", None),
-                    "sampler_seed": getattr(getattr(dl, "sampler", None), "seed", None),
-                }
-            )
+        # dataloader positions incl. exact mid-epoch offset (reference:
+        # StatefulDataLoader state dicts, checkpointing.py:139-143)
+        samplers = [dl.state_dict() if hasattr(dl, "state_dict") else {} for dl in accelerator._dataloaders]
         (out / "samplers.json").write_text(json.dumps(samplers))
         for i, obj in enumerate(accelerator._custom_objects):
             with open(out / f"custom_checkpoint_{i}.pkl", "wb") as f:
@@ -192,11 +184,12 @@ def load_accelerator_state(accelerator, input_dir: str, **kwargs):
     if samplers_path.exists():
         saved = json.loads(samplers_path.read_text())
         for dl, s in zip(accelerator._dataloaders, saved):
-            if s.get("iteration") is not None:
+            if hasattr(dl, "load_state_dict"):
+                # restores sampler epoch/seed AND the mid-epoch position:
+                # the next iteration skips the already-delivered batches
+                dl.load_state_dict(s)
+            elif s.get("iteration") is not None:
                 dl.iteration = s["iteration"]
-            sampler = getattr(dl, "sampler", None)
-            if sampler is not None and s.get("sampler_epoch") is not None:
-                sampler.set_epoch(s["sampler_epoch"])
     for i, obj in enumerate(accelerator._custom_objects):
         path = inp / f"custom_checkpoint_{i}.pkl"
         if path.exists():

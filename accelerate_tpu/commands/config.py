@@ -17,6 +17,7 @@ import json
 CONFIG_KEYS = {
     "num_processes": int,
     "num_machines": int,
+    "machine_rank": int,
     "mixed_precision": str,
     "mesh_data": int,
     "mesh_fsdp": int,

@@ -64,10 +64,38 @@ def launch_parser(subparsers=None):
         action="store_true",
         help="interpret training_script as a python module path (python -m), reference: launch.py --module",
     )
+    parser.add_argument(
+        "--no_pod_discovery",
+        action="store_true",
+        help="disable GCE TPU pod autodiscovery (forces a local launch on pod VMs)",
+    )
     parser.add_argument("training_script", help="script (or module with -m) to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER, default=[])
     if subparsers is not None:
         parser.set_defaults(func=launch_command)
+    return _track_explicit(parser)
+
+
+def _track_explicit(parser):
+    """Record which option dests were explicitly provided on the CLI, in
+    ``namespace._explicit``. argparse invokes an option's Action only when
+    the flag is actually present, so this is exact — unlike scanning
+    ``sys.argv`` it ignores the training script's own args and handles
+    ``--flag=value`` and prefix abbreviations."""
+
+    def tracked(cls):
+        class Tracked(cls):
+            def __call__(self, p, ns, values, option_string=None):
+                if getattr(ns, "_explicit", None) is None:
+                    ns._explicit = set()
+                ns._explicit.add(self.dest)
+                super().__call__(p, ns, values, option_string)
+
+        return Tracked
+
+    for action in parser._actions:
+        if action.option_strings and not isinstance(action, argparse._HelpAction):
+            action.__class__ = tracked(type(action))
     return parser
 
 
@@ -102,6 +130,10 @@ def build_env(args, process_id: int = 0, num_processes: int = 1) -> dict:
 
 
 def _load_config_into_args(args):
+    """Config-precedence contract: CLI > YAML > parser defaults
+    (reference: _validate_launch_command, commands/launch.py:988).
+    Explicitly-passed flags are tracked by the parser itself
+    (``args._explicit`` — see :func:`_track_explicit`)."""
     if args.config_file is None:
         from .config import default_config_path
 
@@ -111,11 +143,41 @@ def _load_config_into_args(args):
             return args
     from .config import load_config
 
+    explicit = getattr(args, "_explicit", None) or set()
     config = load_config(args.config_file)
     for key, value in config.items():
-        if hasattr(args, key) and getattr(args, key) in (None, 1, False, "127.0.0.1"):
+        if hasattr(args, key) and key not in explicit:
             setattr(args, key, value)
     return args
+
+
+def discover_pod_hosts() -> list | None:
+    """GCE TPU pod worker autodiscovery (reference: tpu_pod_launcher,
+    commands/launch.py:909-965 + SURVEY §2.5 "launch reads TPU pod
+    metadata"). Sources, in order: the ``TPU_WORKER_HOSTNAMES`` env the TPU
+    runtime sets on every pod VM, then the GCE metadata server. Returns the
+    host list when this machine is part of a multi-host pod, else None."""
+    names = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if not names:
+        try:  # metadata server: only reachable on GCE VMs; fail fast
+            import urllib.request
+
+            req = urllib.request.Request(
+                "http://metadata.google.internal/computeMetadata/v1/instance/attributes/worker-network-endpoints",
+                headers={"Metadata-Flavor": "Google"},
+            )
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                # format: "ip:port:...,ip:port:..." — keep the ip part
+                endpoints = resp.read().decode()
+            names = ",".join(e.split(":")[0] for e in endpoints.split(",") if e)
+        except Exception:
+            return None
+    hosts = [h.strip() for h in names.split(",") if h.strip()]
+    return hosts if len(hosts) > 1 else None
+
+
+def pod_worker_id() -> int:
+    return int(os.environ.get("TPU_WORKER_ID", "0"))
 
 
 def simple_launcher(args) -> int:
@@ -175,6 +237,25 @@ def pod_ssh_launcher(args) -> int:
 
 def launch_command(args) -> int:
     args = _load_config_into_args(args)
+    explicit = getattr(args, "_explicit", None) or set()
+    wants_local = bool(
+        args.cpu
+        or args.fake_devices
+        or getattr(args, "no_pod_discovery", False)
+        # an explicit topology request means the user is NOT asking for a
+        # bare pod fan-out — don't hijack it
+        or {"num_processes", "machine_rank", "main_process_ip", "num_machines"} & explicit
+    )
+    if not args.tpu_hosts and not wants_local:
+        # bare `accelerate-tpu launch script.py` on a TPU pod: discover the
+        # worker hostnames from the TPU runtime env / GCE metadata and fan
+        # out from worker 0 (reference: tpu_pod_launcher autodiscovery)
+        hosts = discover_pod_hosts()
+        if hosts is not None:
+            if pod_worker_id() != 0:
+                print("launch: pod worker != 0 defers to worker 0's SSH fan-out")
+                return 0
+            args.tpu_hosts = ",".join(hosts)
     if args.tpu_hosts:
         return pod_ssh_launcher(args)
     if args.num_processes > 1:

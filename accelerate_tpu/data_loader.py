@@ -125,6 +125,7 @@ class BaseDataLoader:
         self.remainder = -1
         self.iteration = 0
         self.skip_batches = 0
+        self.batches_yielded = 0
         self._is_accelerate_prepared = True
 
     def _mesh_sharding(self):
@@ -177,6 +178,31 @@ class BaseDataLoader:
             self.sampler.set_epoch(epoch)
         if hasattr(self, "dataset") and hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(epoch)
+
+    def state_dict(self) -> dict:
+        """Exact position for mid-epoch resume (reference analogue:
+        StatefulDataLoader state dicts persisted at checkpointing.py:139-143).
+        ``batches_yielded`` counts batches delivered this epoch; restoring
+        replays the same sampler permutation and skips exactly that many."""
+        sampler = getattr(self, "sampler", None)
+        return {
+            "iteration": self.iteration,
+            "batches_yielded": self.batches_yielded,
+            "sampler_epoch": getattr(sampler, "epoch", None),
+            "sampler_seed": getattr(sampler, "seed", None),
+        }
+
+    def load_state_dict(self, state: dict):
+        self.iteration = state.get("iteration", 0)
+        self.batches_yielded = state.get("batches_yielded", 0)
+        # resume position: the next iteration skips the delivered batches
+        self.skip_batches = self.batches_yielded
+        sampler = getattr(self, "sampler", None)
+        if sampler is not None:
+            if state.get("sampler_seed") is not None and hasattr(sampler, "seed"):
+                sampler.seed = state["sampler_seed"]
+            if state.get("sampler_epoch") is not None and hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(state["sampler_epoch"])
 
 
 class DataLoaderShard(BaseDataLoader):
@@ -259,6 +285,10 @@ class DataLoaderShard(BaseDataLoader):
             yield chunk, len(chunk)
 
     def _local_rows(self, index_batch: list) -> list:
+        if getattr(self, "_dispatch_source", False):
+            # dispatch mode: process 0 reads the FULL global batch; the
+            # dispatcher scatters per-process slices afterwards
+            return index_batch
         jax = _jax()
         pc, pi = jax.process_count(), jax.process_index()
         if pc == 1:
@@ -274,28 +304,41 @@ class DataLoaderShard(BaseDataLoader):
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.generator)
         self.begin()
+        # batches_yielded continues from skip_batches so a resumed epoch's
+        # position counter matches an uninterrupted run's
+        self.batches_yielded = self.skip_batches
+        completed = False
         try:
             # Prefetch window: device transfers (device_put is async) are
             # scheduled ``prefetch_size`` batches ahead, overlapping host
             # collate with device compute. Fetch-ahead also guarantees
-            # end_of_dataloader/remainder are set *before* the final batch
+            # end_of_dataloader/remainder are set *before* the last batch
             # is yielded (reference :558-592).
             window: deque = deque()
             for idx_batch, n_real in self._global_index_batches():
                 window.append((self._place(self._load(idx_batch)), n_real, len(idx_batch)))
                 if len(window) > self.prefetch_size:
+                    self.batches_yielded += 1
                     yield window.popleft()[0]
             while window:
                 batch, n_real, padded = window.popleft()
                 if not window:
                     self.end_of_dataloader = True
                     self.remainder = n_real if n_real != padded else -1
+                self.batches_yielded += 1
                 yield batch
+            completed = True
         finally:
             self.skip_batches = 0
-            self.iteration += 1
-            if hasattr(self.sampler, "set_epoch"):
-                self.sampler.set_epoch(self.iteration)
+            if completed:
+                # advance the epoch only on a full pass (torch semantics);
+                # on early break, iteration/sampler stay on the current
+                # epoch so a subsequent state_dict() save stays consistent
+                # with the recorded batches_yielded offset
+                self.batches_yielded = 0
+                self.iteration += 1
+                if hasattr(self.sampler, "set_epoch"):
+                    self.sampler.set_epoch(self.iteration)
             self.end()
 
 
@@ -362,20 +405,27 @@ class IterableDataLoaderShard(BaseDataLoader):
 
     def __iter__(self):
         self.begin()
+        self.batches_yielded = self.skip_batches
+        completed = False
         try:
             window: deque = deque()
             for host_batch, n_real in self._batched_samples():
                 window.append((self._place(host_batch), n_real))
                 if len(window) > self.prefetch_size:
+                    self.batches_yielded += 1
                     yield window.popleft()[0]
             while window:
                 batch, n_real = window.popleft()
                 if not window:
                     self.end_of_dataloader = True
                     self.remainder = n_real if n_real != self.total_batch_size else -1
+                self.batches_yielded += 1
                 yield batch
+            completed = True
         finally:
             self.skip_batches = 0
+            if completed:
+                self.batches_yielded = 0
             self.end()
 
 
@@ -391,8 +441,10 @@ class DataLoaderDispatcher(BaseDataLoader):
             prefetch_size=inner.prefetch_size,
         )
         self.inner = inner
-        # the inner loader runs host-unsharded on process 0
+        # the inner loader runs host-unsharded on process 0 and reads the
+        # full global batch (no per-process row slicing)
         self.inner.device_placement = False
+        self.inner._dispatch_source = True
 
     @property
     def total_batch_size(self) -> int:
@@ -408,43 +460,69 @@ class DataLoaderDispatcher(BaseDataLoader):
     def set_epoch(self, epoch: int):
         self.inner.set_epoch(epoch)
 
-    def __iter__(self):
-        from .utils.operations import broadcast_object_list
+    def state_dict(self) -> dict:
+        state = self.inner.state_dict()
+        state["batches_yielded"] = self.batches_yielded
+        return state
 
+    def load_state_dict(self, state: dict):
+        self.inner.load_state_dict(state)
+        self.batches_yielded = state.get("batches_yielded", 0)
+
+    def __iter__(self):
         jax = _jax()
         pc, pi = jax.process_count(), jax.process_index()
         self.begin()
+        self.batches_yielded = self.inner.skip_batches
         try:
             if pc == 1:
                 for batch in self.inner:
                     self.end_of_dataloader = self.inner.end_of_dataloader
                     self.remainder = self.inner.remainder
+                    self.batches_yielded += 1
                     yield self._place(batch)
+                self.batches_yielded = 0
                 return
+            from .utils.operations import scatter_object
+
             it = iter(self.inner) if pi == 0 else None
             while True:
-                payload = [None]
+                payloads = None
                 if pi == 0:
                     try:
                         batch = next(it)
-                        payload = [(batch, self.inner.end_of_dataloader, self.inner.remainder)]
+                        full = jax.tree_util.tree_map(_to_numpy, batch)
+
+                        # slice-before-send (reference: data_loader.py:786-850
+                        # sends per-rank slices): each process receives only
+                        # its own rows, never the full global batch
+                        def rows_for(p):
+                            def take(x):
+                                r = x.shape[0] // pc
+                                return x[p * r : (p + 1) * r]
+
+                            return jax.tree_util.tree_map(take, full)
+
+                        payloads = [
+                            (rows_for(p), self.inner.end_of_dataloader, self.inner.remainder)
+                            for p in range(pc)
+                        ]
                     except StopIteration:
-                        payload = [None]
-                broadcast_object_list(payload, from_process=0)
-                if payload[0] is None:
+                        payloads = [None] * pc
+                mine = scatter_object(payloads, from_process=0)
+                if mine is None:
                     return
-                full_batch, end, rem = payload[0]
+                local, end, rem = mine
                 self.end_of_dataloader = end
                 self.remainder = rem
-                # each process slices its rows, then assembles the global array
-
-                def slice_rows(x):
-                    rows = x.shape[0] // pc
-                    return x[pi * rows : (pi + 1) * rows]
-
-                local = jax.tree_util.tree_map(slice_rows, full_batch)
+                self.batches_yielded += 1
                 yield self._place(local)
+                if end:
+                    self.batches_yielded = 0
         finally:
+            # non-zero processes never run inner.__iter__, so the consumed
+            # skip offset must be cleared here on every process
+            self.inner.skip_batches = 0
             self.end()
 
 

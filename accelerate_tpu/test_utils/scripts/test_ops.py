@@ -48,6 +48,46 @@ def check_broadcast(accelerator):
     accelerator.print("broadcast OK")
 
 
+def check_scatter_object(accelerator):
+    from accelerate_tpu.utils.operations import scatter_object
+
+    n = accelerator.num_processes
+    payloads = [{"for": p, "rows": list(range(p * 2, p * 2 + 2))} for p in range(n)]
+    mine = scatter_object(payloads if accelerator.is_main_process else None, from_process=0)
+    assert mine["for"] == accelerator.process_index, mine
+    assert mine["rows"] == [accelerator.process_index * 2, accelerator.process_index * 2 + 1]
+    # repeated calls must stay in lockstep (sequence tags advance together)
+    for round_ in range(3):
+        got = scatter_object(
+            [f"r{round_}p{p}" for p in range(n)] if accelerator.is_main_process else None,
+            from_process=0,
+        )
+        assert got == f"r{round_}p{accelerator.process_index}", got
+    accelerator.print("scatter_object OK")
+
+
+def check_dispatch_loader(accelerator):
+    """Dispatch-mode loader: process 0 reads, everyone gets its slice only
+    (reference: DataLoaderDispatcher data_loader.py:704)."""
+    import numpy as np
+
+    from accelerate_tpu.data_loader import DataLoaderDispatcher, DataLoaderShard
+
+    n = accelerator.num_processes
+    data = [{"x": np.array([float(i)], np.float32)} for i in range(8 * n)]
+    inner = DataLoaderShard(data, batch_size=2, device_placement=True)
+    loader = DataLoaderDispatcher(inner)
+    seen = 0
+    for batch in loader:
+        # the global batch is assembled from per-process slices
+        assert batch["x"].shape[0] == 2 * accelerator.num_data_shards
+        local = sum(np.asarray(s.data).size for s in batch["x"].addressable_shards)
+        assert local * n == batch["x"].shape[0] or n == 1
+        seen += 1
+    assert seen == len(loader), (seen, len(loader))
+    accelerator.print("dispatch loader OK")
+
+
 def check_reduce(accelerator):
     from accelerate_tpu.utils import reduce
 
@@ -82,6 +122,8 @@ def main():
     check_gather(accelerator)
     check_gather_object(accelerator)
     check_broadcast(accelerator)
+    check_scatter_object(accelerator)
+    check_dispatch_loader(accelerator)
     check_reduce(accelerator)
     check_pad_across_processes(accelerator)
     accelerator.print("test_ops: ALL OK")

@@ -269,6 +269,74 @@ def broadcast_object_list(object_list: list, from_process: int = 0):
     return object_list
 
 
+_scatter_seq = 0
+
+
+def scatter_object(objects, from_process: int = 0):
+    """Deliver ``objects[p]`` to process ``p`` — a host-level scatter.
+
+    The slice-before-send primitive behind dispatch-mode data loading
+    (reference sends per-rank slices: data_loader.py:786-850): each
+    receiver pulls ONLY its own payload over the coordinator's key-value
+    store, so DCN traffic per step is O(global batch), not
+    O(global batch x hosts) as a full-batch broadcast would be. Falls back
+    to broadcast+index when no distributed client is attached (then the
+    traffic argument is moot anyway: single coordinator-less launch).
+
+    ``objects`` must be a list of length ``process_count`` on
+    ``from_process``; it may be None elsewhere. Returns this process's item.
+    """
+    global _scatter_seq
+    n = _num_processes()
+    if n == 1:
+        return objects[0]
+    jax = _jax()
+    pi = jax.process_index()
+    client = None
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+    except Exception:
+        client = None
+    if client is None:
+        payload = [objects] if pi == from_process else [None]
+        broadcast_object_list(payload, from_process=from_process)
+        return payload[0][pi]
+
+    import base64
+
+    # the coordinator KV store is a control-plane channel with a gRPC
+    # message-size ceiling — large payloads are split into chunks keyed
+    # chunk-by-chunk (receivers reassemble). Dispatch mode is a
+    # convenience path (dataset reachable from one host), not the
+    # high-throughput ingest path; shard-mode loaders read host-locally.
+    chunk_bytes = 1 << 20
+    tag = _scatter_seq  # every process calls in lockstep -> same tag
+    _scatter_seq += 1
+    if pi == from_process:
+        if objects is None or len(objects) != n:
+            raise ValueError(f"scatter_object needs a list of {n} payloads on the source process")
+        for p in range(n):
+            if p != from_process:
+                encoded = base64.b64encode(pickle.dumps(objects[p])).decode("ascii")
+                chunks = [encoded[i : i + chunk_bytes] for i in range(0, len(encoded), chunk_bytes)] or [""]
+                client.key_value_set(f"accelerate_scatter/{tag}/{p}/n", str(len(chunks)))
+                for ci, chunk in enumerate(chunks):
+                    client.key_value_set(f"accelerate_scatter/{tag}/{p}/{ci}", chunk)
+        return objects[from_process]
+    n_chunks = int(client.blocking_key_value_get(f"accelerate_scatter/{tag}/{pi}/n", 300_000))
+    parts = []
+    for ci in range(n_chunks):
+        parts.append(client.blocking_key_value_get(f"accelerate_scatter/{tag}/{pi}/{ci}", 300_000))
+    for key in [f"accelerate_scatter/{tag}/{pi}/n"] + [f"accelerate_scatter/{tag}/{pi}/{ci}" for ci in range(n_chunks)]:
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+    return pickle.loads(base64.b64decode("".join(parts)))
+
+
 @_verify_operation
 def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
     """Elementwise reduce across processes (reference: operations.py:723)."""

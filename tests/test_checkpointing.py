@@ -118,3 +118,89 @@ def test_save_model_sharding_splits(tmp_path):
 
     weight_map = json.loads(index.read_text())["weight_map"]
     assert len(set(weight_map.values())) >= 2
+
+
+def _batch_fingerprint(batch):
+    import jax
+
+    return tuple(float(np.asarray(l).sum()) for l in jax.tree_util.tree_leaves(batch))
+
+
+def test_exact_mid_epoch_resume(tmp_path):
+    """Kill-and-resume mid-epoch reproduces the exact batch sequence of an
+    uninterrupted run (reference: StatefulDataLoader state persisted at
+    checkpointing.py:139-143 + skip_first_batches data_loader.py:1371)."""
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    def fresh(seed=7):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator()
+        ds = RegressionDataset(length=64, seed=seed)
+        model, optimizer, loader = acc.prepare(RegressionModel(), optax.adam(0.05), ds)
+        loader.batch_size = 8 // acc.num_data_shards
+        loader.sampler = __import__("accelerate_tpu.data_loader", fromlist=["SeedableRandomSampler"]).SeedableRandomSampler(64, seed=3)
+        return acc, model, loader
+
+    # ---- uninterrupted run: record the full 2-epoch batch sequence ----
+    acc, model, loader = fresh()
+    reference_seq = []
+    for _epoch in range(2):
+        for batch in loader:
+            reference_seq.append(_batch_fingerprint(batch))
+
+    # ---- interrupted run: stop after 3 batches of epoch 0, save ----
+    acc, model, loader = fresh()
+    got = []
+    it = iter(loader)
+    for _ in range(3):
+        got.append(_batch_fingerprint(next(it)))
+    acc.save_state(str(tmp_path / "ckpt"))
+    del it  # simulate the process dying mid-epoch
+
+    # ---- resumed run: fresh process, load, continue to the end ----
+    acc, model, loader = fresh()
+    acc.load_state(str(tmp_path / "ckpt"))
+    assert loader.skip_batches == 3
+    for batch in loader:  # rest of epoch 0
+        got.append(_batch_fingerprint(batch))
+    for batch in loader:  # epoch 1
+        got.append(_batch_fingerprint(batch))
+
+    assert got == reference_seq, (len(got), len(reference_seq))
+
+
+def test_break_then_save_resume(tmp_path):
+    """The max-steps idiom: break out of the epoch, THEN save. The epoch /
+    sampler state must stay on the current epoch so the saved offset
+    attaches to the right permutation."""
+    from accelerate_tpu.data_loader import SeedableRandomSampler
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    def fresh():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator()
+        ds = RegressionDataset(length=64, seed=9)
+        model, optimizer, loader = acc.prepare(RegressionModel(), optax.adam(0.05), ds)
+        loader.batch_size = 8 // acc.num_data_shards
+        loader.sampler = SeedableRandomSampler(64, seed=5)
+        return acc, loader
+
+    acc, loader = fresh()
+    reference_seq = [_batch_fingerprint(b) for b in loader]  # epoch 0
+
+    acc, loader = fresh()
+    got = []
+    for i, b in enumerate(loader):
+        got.append(_batch_fingerprint(b))
+        if i == 2:
+            break  # the generator CLOSES here (max-steps pattern) ...
+    acc.save_state(str(tmp_path / "ckpt"))  # ... and only then we save
+
+    acc, loader = fresh()
+    acc.load_state(str(tmp_path / "ckpt"))
+    got.extend(_batch_fingerprint(b) for b in loader)
+    assert got == reference_seq, (len(got), len(reference_seq))

@@ -179,3 +179,69 @@ def test_migrate_command(tmp_path):
     assert "mesh_tensor: 4" in result.stdout
     assert "mesh_pipe: 2" in result.stdout
     assert "mesh_seq" in result.stdout
+
+
+def test_pod_autodiscovery_ssh_fanout(monkeypatch, tmp_path):
+    """Bare `launch script.py` on a pod: TPU_WORKER_HOSTNAMES drives the SSH
+    fan-out with correct coordinator/process-id wiring (reference:
+    tpu_pod_launcher, commands/launch.py:909-965)."""
+    from accelerate_tpu.commands import launch as L
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "tpu-w0,tpu-w1,tpu-w2")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    calls = []
+
+    class FakeProc:
+        def __init__(self, cmd, **kw):
+            calls.append(cmd)
+
+        def wait(self):
+            return 0
+
+    monkeypatch.setattr(L.subprocess, "Popen", FakeProc)
+    parser = L.launch_parser()
+    args = parser.parse_args(["train.py"])
+    rc = L.launch_command(args)
+    assert rc == 0
+    assert len(calls) == 3
+    for rank, cmd in enumerate(calls):
+        assert cmd[0] == "ssh"
+        remote = cmd[-1]
+        assert "ACCELERATE_COORDINATOR_ADDRESS=tpu-w0:7777" in remote
+        assert "ACCELERATE_NUM_PROCESSES=3" in remote
+        assert f"ACCELERATE_PROCESS_ID={rank}" in remote
+        assert f"tpu-w{rank}" in cmd[-2]
+
+    # a non-zero worker defers to worker 0's fan-out
+    calls.clear()
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    rc = L.launch_command(parser.parse_args(["train.py"]))
+    assert rc == 0 and calls == []
+
+
+def test_config_precedence_cli_wins(monkeypatch, tmp_path):
+    """Explicit CLI flags beat YAML even when they equal a parser default
+    (the round-1 sentinel bug: --num_processes 1 was overridden)."""
+    from accelerate_tpu.commands import launch as L
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("num_processes: 8\nmachine_rank: 3\nmixed_precision: bf16\n")
+    parser = L.launch_parser()
+
+    args = parser.parse_args(["--config_file", str(cfg), "train.py"])
+    monkeypatch.setattr(L.sys, "argv", ["accelerate-tpu", "launch", "--config_file", str(cfg), "train.py"])
+    L._load_config_into_args(args)
+    # not given on the CLI -> YAML fills them
+    assert args.num_processes == 8 and args.machine_rank == 3 and args.mixed_precision == "bf16"
+
+    args = parser.parse_args(
+        ["--config_file", str(cfg), "--num_processes", "1", "--machine_rank", "0", "train.py"]
+    )
+    monkeypatch.setattr(
+        L.sys, "argv",
+        ["accelerate-tpu", "launch", "--config_file", str(cfg), "--num_processes", "1", "--machine_rank", "0", "train.py"],
+    )
+    L._load_config_into_args(args)
+    # explicitly passed, equal to defaults -> must NOT be overridden
+    assert args.num_processes == 1 and args.machine_rank == 0
+    assert args.mixed_precision == "bf16"  # still filled from YAML
