@@ -1,0 +1,27 @@
+# Repo quality/test targets (reference analogue: the reference Makefile's
+# quality/style/test tiers).
+
+.PHONY: quality style test test-fast test-cli check-imports bench dryrun
+
+# lint if ruff is installed; the zero-dep AST/import gates always run
+quality:
+	@command -v ruff >/dev/null 2>&1 && ruff check accelerate_tpu tests examples || true
+	python scripts/check_repo.py
+
+style:
+	@command -v ruff >/dev/null 2>&1 && ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples || echo "ruff not installed; style target is a no-op here"
+
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+test-cli:
+	python -m pytest tests/test_cli.py -q
+
+bench:
+	python bench.py
+
+dryrun:
+	python __graft_entry__.py 8

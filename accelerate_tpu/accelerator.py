@@ -24,8 +24,6 @@ Two ways to drive training:
 from __future__ import annotations
 
 import contextlib
-import functools
-import math
 import os
 from typing import Any, Callable, Optional
 
@@ -35,10 +33,10 @@ from .data_loader import BaseDataLoader, prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .modeling import Model, as_model
 from .optimizer import AcceleratedOptimizer
-from .parallel.mesh import MeshConfig, batch_sharding, data_parallel_size, replicated
+from .parallel.mesh import data_parallel_size
 from .parallel.sharding import fsdp_rules_for, infer_shardings
 from .scheduler import AcceleratedScheduler
-from .state import AcceleratorState, GradientState, PartialState
+from .state import AcceleratorState, GradientState
 from .utils.dataclasses import (
     AutocastKwargs,
     DataLoaderConfiguration,
@@ -46,13 +44,11 @@ from .utils.dataclasses import (
     DistributedType,
     GradientAccumulationPlugin,
     GradScalerKwargs,
-    MixedPrecisionPolicy,
     ParallelismPlugin,
-    PrecisionType,
     ProfileKwargs,
     ProjectConfiguration,
 )
-from .utils.operations import convert_to_fp32, gather, gather_object, pad_across_processes, reduce, send_to_device
+from .utils.operations import gather, gather_object, pad_across_processes, reduce
 
 logger = get_logger(__name__)
 
@@ -492,6 +488,24 @@ class Accelerator:
 
         return jax.tree_util.tree_map_with_path(cast, params)
 
+    def build_eval_step(self, eval_fn: Callable, model: Optional[Model] = None) -> Callable:
+        """Jitted inference counterpart of :meth:`build_train_step`.
+
+        ``eval_fn(params, *args)`` — or ``eval_fn(params, state, *args)``
+        when the model carries mutable state (BatchNorm). Returns
+        ``step(*args)`` reading the model's CURRENT params/state each call.
+        The reference's eval loop just calls the module (torch eager is
+        fine there); in JAX an unjitted forward dispatches op-by-op, which
+        is pathological on TPU — always evaluate through a jitted step.
+        """
+        jax = _jax()
+        model = model or self._models[-1]
+        compute_cast = self._compute_cast
+        jitted = jax.jit(lambda p, *args, **kwargs: eval_fn(compute_cast(p), *args, **kwargs))
+        if getattr(model, "state", None) is not None:
+            return lambda *args, **kwargs: jitted(model.params, model.state, *args, **kwargs)
+        return lambda *args, **kwargs: jitted(model.params, *args, **kwargs)
+
     def build_train_step(
         self,
         loss_fn: Callable,
@@ -499,6 +513,7 @@ class Accelerator:
         optimizer: Optional[AcceleratedOptimizer] = None,
         scheduler: Optional[AcceleratedScheduler] = None,
         has_aux: bool = False,
+        has_state: bool = False,
         donate: bool = True,
     ) -> Callable:
         """Build the single jitted train step (reference hot loop §3.4
@@ -512,6 +527,14 @@ class Accelerator:
         buffer: every call accumulates; on sync boundaries the update
         applies and the buffer zeroes — ``1/accum``-weighted so the applied
         gradient is the mean over microbatches.
+
+        ``has_state=True`` threads non-trainable mutable collections
+        (flax ``batch_stats`` et al.) through the step: ``model.state`` is
+        passed as the second argument — ``loss_fn(params, state, batch[,
+        rng])`` — and the loss_fn returns ``(loss, new_state)`` (or
+        ``(loss, (new_state, aux))`` with ``has_aux``). The state updates
+        every microbatch, gradient-free. The reference has no analogue
+        (torch BN mutates buffers in place); in JAX the state is explicit.
         """
         jax = _jax()
         jnp = _jnp()
@@ -526,18 +549,55 @@ class Accelerator:
         compute_cast = self._compute_cast
         apply_gradients = self._make_gradient_applier(optimizer.optimizer)
         # loss_fn(params, batch) or loss_fn(params, batch, rng) — the rng
-        # variant gets a per-step folded key (dropout etc.)
+        # variant gets a per-step folded key (dropout etc.). With has_state
+        # the state slots in before batch: loss_fn(params, state, batch[, rng]).
+        # Opt-in is by arity (a required positional beyond batch) OR by a
+        # parameter literally named ``rng`` (covers optional-rng losses like
+        # functools.partial(bert_classification_loss, apply_fn=...), whose
+        # ``rng=None`` is keyword-with-default). Bound keyword arguments
+        # from partial must NOT count toward arity.
         import inspect
 
-        wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
+        try:
+            sig_params = inspect.signature(loss_fn).parameters
+            n_loss_args = sum(
+                1
+                for p in sig_params.values()
+                if p.default is inspect.Parameter.empty
+                and p.kind in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+            )
+            has_rng_param = "rng" in sig_params
+        except (TypeError, ValueError):  # builtins / C callables
+            n_loss_args, has_rng_param = (3 if has_state else 2), False
+        if n_loss_args >= (4 if has_state else 3):
+            rng_mode = "positional"
+        elif has_rng_param:
+            # optional rng must go by keyword: a partial that bound an
+            # earlier parameter by keyword rejects extra positionals
+            rng_mode = "keyword"
+        else:
+            rng_mode = "none"
 
-        def step_fn(params, opt_state, grad_buf, batch, loss_scale, do_sync, rng, clip_norm):
+        def call_loss(p, mstate, batch, rng):
+            lead = (p, mstate, batch) if has_state else (p, batch)
+            if rng_mode == "positional":
+                return loss_fn(*lead, rng)
+            if rng_mode == "keyword":
+                return loss_fn(*lead, rng=rng)
+            return loss_fn(*lead)
+
+        def step_fn(params, opt_state, grad_buf, mstate, batch, loss_scale, do_sync, rng, clip_norm):
             def scaled_loss(p):
-                out = loss_fn(compute_cast(p), batch, rng) if wants_rng else loss_fn(compute_cast(p), batch)
-                loss, aux = (out if has_aux else (out, None))
-                return loss.astype(jnp.float32) * loss_scale, (loss, aux)
+                out = call_loss(compute_cast(p), mstate, batch, rng)
+                if has_state:
+                    loss, rest = out
+                    new_state, aux = rest if has_aux else (rest, None)
+                else:
+                    loss, aux = (out if has_aux else (out, None))
+                    new_state = mstate
+                return loss.astype(jnp.float32) * loss_scale, (loss, new_state, aux)
 
-            grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
+            grads, (loss, new_state, aux) = jax.grad(scaled_loss, has_aux=True)(params)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / (loss_scale * accum), grads)
             grad_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
 
@@ -561,7 +621,7 @@ class Accelerator:
                 # accumulation buffer: ZeRO-2) data-sharded across steps
                 new_opt = jax.lax.with_sharding_constraint(new_opt, zero_shardings)
                 new_buf = jax.lax.with_sharding_constraint(new_buf, buf_shardings)
-            return new_params, new_opt, new_buf, loss, gnorm, finite, aux
+            return new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux
 
         zero_shardings = getattr(optimizer, "_zero_shardings", None)
         buf_shardings = None
@@ -572,7 +632,7 @@ class Accelerator:
                 model.params, getattr(model, "param_shardings", None), self.mesh
             )
 
-        donate_args = (0, 1, 2) if donate else ()
+        donate_args = ((0, 1, 2, 3) if has_state else (0, 1, 2)) if donate else ()
         jitted = jax.jit(step_fn, donate_argnums=donate_args)
 
         grad_buf = jax.jit(
@@ -594,10 +654,11 @@ class Accelerator:
             self.gradient_state._set_sync_gradients(do_sync)
             from .utils.random import key_for_step
 
-            new_params, new_opt, new_buf, loss, gnorm, finite, aux = jitted(
+            new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux = jitted(
                 model.params,
                 optimizer.opt_state,
                 state_box["grad_buf"],
+                getattr(model, "state", None) if has_state else None,
                 batch,
                 jnp.float32(self._loss_scale),
                 jnp.bool_(do_sync),
@@ -605,6 +666,8 @@ class Accelerator:
                 jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
             )
             model.params = new_params
+            if has_state:
+                model.state = new_state
             optimizer.opt_state = new_opt
             state_box["grad_buf"] = new_buf
             state_box["micro"] = 0 if do_sync else state_box["micro"] + 1

@@ -27,7 +27,6 @@ import contextlib
 import json
 import os
 import re
-from collections import defaultdict
 from typing import Any, Callable, Optional, Union
 
 import numpy as np

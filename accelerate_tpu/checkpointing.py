@@ -90,8 +90,6 @@ def _load_pytree(path: Path, like):
 def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True):
     """(reference: Accelerator.save_state accelerator.py:3308 +
     checkpointing.save_accelerator_state :61)."""
-    from .state import GradientState
-
     project = accelerator.project_configuration
     if project.automatic_checkpoint_naming:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
@@ -118,6 +116,11 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
     # models + optimizers: sharded orbax saves (every host participates)
     for i, model in enumerate(accelerator._models):
         _save_pytree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME)
+        # non-trainable mutable collections (BatchNorm running stats —
+        # build_train_step(has_state=True)); torch carries these as module
+        # buffers inside the state_dict, here they are a separate pytree
+        if getattr(model, "state", None) is not None:
+            _save_pytree(model.state, out / f"{MODEL_NAME}_state_{i}")
     for i, opt in enumerate(accelerator._optimizers):
         if opt.opt_state is not None:
             _save_pytree(opt.opt_state, out / f"{OPTIMIZER_NAME}_{i}" if i > 0 else out / OPTIMIZER_NAME)
@@ -172,6 +175,9 @@ def load_accelerator_state(accelerator, input_dir: str, **kwargs):
     for i, model in enumerate(accelerator._models):
         path = inp / (f"{MODEL_NAME}_{i}" if i > 0 else MODEL_NAME)
         model.params = _load_pytree(path, model.params)
+        state_path = inp / f"{MODEL_NAME}_state_{i}"
+        if state_path.exists() and getattr(model, "state", None) is not None:
+            model.state = _load_pytree(state_path, model.state)
     for i, opt in enumerate(accelerator._optimizers):
         path = inp / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
         if path.exists() and opt.opt_state is not None:

@@ -25,7 +25,6 @@ import os
 import subprocess
 import sys
 
-from ..utils.environment import str_to_bool
 
 
 def _pkg_root() -> str:
@@ -145,9 +144,14 @@ def _load_config_into_args(args):
 
     explicit = getattr(args, "_explicit", None) or set()
     config = load_config(args.config_file)
+    applied = set()
     for key, value in config.items():
         if hasattr(args, key) and key not in explicit:
             setattr(args, key, value)
+            applied.add(key)
+    # a topology configured in the YAML counts as a user topology request
+    # (launch_command must not hijack it into pod SSH fan-out)
+    args._from_config = applied
     return args
 
 
@@ -238,13 +242,26 @@ def pod_ssh_launcher(args) -> int:
 def launch_command(args) -> int:
     args = _load_config_into_args(args)
     explicit = getattr(args, "_explicit", None) or set()
+    # A topology request — CLI flag, or YAML value that DIFFERS from the
+    # parser default — means the user is NOT asking for a bare pod fan-out.
+    # Default-valued YAML keys must not count: the config wizard writes
+    # num_machines: 1 unconditionally, which would otherwise disable pod
+    # autodiscovery for everyone who ever ran `accelerate-tpu config`.
+    topology_defaults = {
+        "num_processes": 1,
+        "num_machines": 1,
+        "machine_rank": 0,
+        "main_process_ip": "127.0.0.1",
+    }
+    requested = {"num_processes", "machine_rank", "main_process_ip", "num_machines"} & explicit
+    for key in set(topology_defaults) & getattr(args, "_from_config", set()):
+        if getattr(args, key) != topology_defaults[key]:
+            requested.add(key)
     wants_local = bool(
         args.cpu
         or args.fake_devices
         or getattr(args, "no_pod_discovery", False)
-        # an explicit topology request means the user is NOT asking for a
-        # bare pod fan-out — don't hijack it
-        or {"num_processes", "machine_rank", "main_process_ip", "num_machines"} & explicit
+        or requested
     )
     if not args.tpu_hosts and not wants_local:
         # bare `accelerate-tpu launch script.py` on a TPU pod: discover the

@@ -10,7 +10,6 @@ writer.
 from __future__ import annotations
 
 import argparse
-import os
 
 
 def merge_parser(subparsers=None):

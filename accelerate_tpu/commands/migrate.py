@@ -12,7 +12,6 @@ layouts).
 
 from __future__ import annotations
 
-import argparse
 import os
 
 from .config import CONFIG_KEYS, _dump_yaml, _load_yaml

@@ -5,7 +5,6 @@ running test_utils/scripts/test_script.py)."""
 from __future__ import annotations
 
 import argparse
-import os
 
 
 def test_parser(subparsers=None):

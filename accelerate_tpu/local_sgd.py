@@ -26,7 +26,7 @@ Usage (API mirrors the reference)::
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 
 def _jax():

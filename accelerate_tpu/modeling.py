@@ -36,6 +36,9 @@ class Model:
         self.apply_fn = apply_fn
         self.eval_apply_fn = eval_apply_fn or apply_fn
         self.params = params
+        # non-trainable mutable collections (flax batch_stats etc.),
+        # threaded through build_train_step(has_state=True)
+        self.state = None
         self.sharding_rules = sharding_rules
         self.name = name or getattr(apply_fn, "__name__", "model")
         self._is_accelerate_prepared = False  # reference marker: accelerator.py:1470

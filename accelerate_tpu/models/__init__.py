@@ -1,3 +1,8 @@
+"""Model zoo: TPU-first flax implementations with mesh sharding rules
+(bert/gpt2/t5/llama/mixtral/resnet) + HF safetensors weight import.
+The reference delegates models to transformers; here they ship in-tree
+(SURVEY hard-part #3: torch-free model story)."""
+
 from .bert import (
     BERT_SHARDING_RULES,
     BertConfig,
@@ -11,12 +16,12 @@ from .gpt2 import (
     GPT2Model,
     create_gpt2_model,
 )
-from .t5 import (
-    T5_SHARDING_RULES,
-    T5Config,
-    T5Model,
-    create_t5_model,
-    seq2seq_lm_loss,
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    causal_lm_loss,
+    create_llama_model,
 )
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
@@ -25,10 +30,17 @@ from .mixtral import (
     create_mixtral_model,
     mixtral_lm_loss,
 )
-from .llama import (
-    LLAMA_SHARDING_RULES,
-    LlamaConfig,
-    LlamaModel,
-    causal_lm_loss,
-    create_llama_model,
+from .resnet import (
+    RESNET_SHARDING_RULES,
+    ResNet,
+    ResNetConfig,
+    create_resnet_model,
+    resnet_classification_loss,
+)
+from .t5 import (
+    T5_SHARDING_RULES,
+    T5Config,
+    T5Model,
+    create_t5_model,
+    seq2seq_lm_loss,
 )

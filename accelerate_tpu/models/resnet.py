@@ -1,0 +1,209 @@
+"""ResNet-v1.5 (flax.linen) — the CV-example model.
+
+Reference analogue: examples/cv_example.py trains a timm ResNet-50 on the
+Oxford-IIIT Pet dataset; BASELINE.json lists the CV example among the
+configs the framework must serve. This is a from-scratch TPU-first
+implementation, not a torchvision translation:
+
+* NHWC layout throughout — the TPU convolution layout (XLA:TPU tiles the
+  channel dim onto the 128-lane register; NCHW would transpose on every op);
+* v1.5 bottleneck (stride on the 3x3, not the 1x1 — the variant every
+  modern baseline actually measures);
+* BatchNorm running statistics are an explicit non-trainable state pytree
+  threaded through ``Accelerator.build_train_step(has_state=True)`` —
+  torch mutates BN buffers in place, JAX makes the state visible;
+* bf16-friendly: params fp32, compute dtype set by the Accelerator policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_filters: int = 64
+    num_classes: int = 1000
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+    remat: bool = False
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetConfig":
+        return cls(**kw)
+
+    @classmethod
+    def resnet18(cls, **kw) -> "ResNetConfig":
+        kw.setdefault("stage_sizes", (2, 2, 2, 2))
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ResNetConfig":
+        """Two stages of one block each — CI-sized."""
+        kw.setdefault("stage_sizes", (1, 1))
+        kw.setdefault("num_filters", 8)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+
+# The classifier head is the only matmul big enough to split; conv
+# out-channels shard over ``tensor`` so an 8-way TP mesh still packs the
+# MXU. (The reference delegates all TP to transformers/Megatron and has no
+# CV TP story at all — SURVEY §2.2.)
+RESNET_SHARDING_RULES = [
+    (r"head/kernel", P(None, "tensor")),
+    (r"conv_init/kernel", P(None, None, None, "tensor")),
+]
+
+
+class BottleneckBlock(nn.Module):
+    """v1.5 bottleneck: 1x1 reduce -> 3x3 (carries the stride) -> 1x1 expand."""
+
+    filters: int
+    strides: int
+    config: ResNetConfig
+    train: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = x.dtype
+        conv = lambda f, k, s, name: nn.Conv(f, (k, k), (s, s), padding="SAME", use_bias=False, dtype=dtype, name=name)
+        bn = lambda name: nn.BatchNorm(
+            use_running_average=not self.train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_epsilon,
+            dtype=dtype,
+            name=name,
+        )
+
+        residual = x
+        y = conv(self.filters, 1, 1, "conv1")(x)
+        y = nn.relu(bn("bn1")(y))
+        y = conv(self.filters, 3, self.strides, "conv2")(y)
+        y = nn.relu(bn("bn2")(y))
+        y = conv(self.filters * 4, 1, 1, "conv3")(y)
+        # zero-init the last BN scale: residual branch starts as identity
+        # (the standard trick every strong ResNet baseline uses)
+        y = nn.BatchNorm(
+            use_running_average=not self.train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_epsilon,
+            dtype=dtype,
+            scale_init=nn.initializers.zeros_init(),
+            name="bn3",
+        )(y)
+
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, 1, self.strides, "conv_proj")(residual)
+            residual = bn("bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    config: ResNetConfig
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, images):
+        """images: [B, H, W, 3] (NHWC, float). Returns [B, num_classes] fp32."""
+        cfg = self.config
+        x = images
+        x = nn.Conv(cfg.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False, dtype=x.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(
+            use_running_average=not self.train,
+            momentum=cfg.bn_momentum,
+            epsilon=cfg.bn_epsilon,
+            dtype=x.dtype,
+            name="bn_init",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        block_cls = BottleneckBlock
+        if cfg.remat:
+            block_cls = nn.remat(block_cls, static_argnums=())
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                x = block_cls(
+                    filters=cfg.num_filters * 2**i,
+                    strides=2 if j == 0 and i > 0 else 1,
+                    config=cfg,
+                    train=self.train,
+                    name=f"stage{i}_block{j}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def create_resnet_model(
+    config: Optional[ResNetConfig] = None,
+    seed: int = 0,
+    image_size: int = 224,
+    batch_size: int = 2,
+) -> Model:
+    """Initialise a :class:`~accelerate_tpu.modeling.Model` wrapping ResNet.
+
+    ``model.state`` holds the BatchNorm running statistics
+    (``{"batch_stats": ...}``); train with
+    ``build_train_step(resnet_classification_loss, has_state=True)``.
+    """
+    config = config or ResNetConfig.resnet50()
+    dummy = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+    variables = ResNet(config, train=False).init(jax.random.key(seed), dummy)
+    params = variables["params"]
+    batch_stats = variables["batch_stats"]
+
+    train_module = ResNet(config, train=True)
+    eval_module = ResNet(config, train=False)
+
+    def apply_fn(p, images, state=None, train=False, rngs=None):
+        """train=True returns (logits, new_state); eval returns logits."""
+        # the Accelerator's dtype policy casts PARAMS; convs derive their
+        # compute dtype from the activations, so the images must follow
+        # the params or fp32 inputs would upcast every layer back to fp32
+        leaf = jax.tree_util.tree_leaves(p)[0]
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            images = images.astype(leaf.dtype)
+        state = state if state is not None else model.state
+        if train:
+            logits, updates = train_module.apply(
+                {"params": p, **state}, images, mutable=["batch_stats"], rngs=rngs
+            )
+            return logits, updates
+        return eval_module.apply({"params": p, **state}, images)
+
+    model = Model(apply_fn, params, sharding_rules=RESNET_SHARDING_RULES, name="resnet")
+    model.state = {"batch_stats": batch_stats}
+    model.config = config
+    model.module = eval_module
+    return model
+
+
+def resnet_classification_loss(params, state, batch, apply_fn=None):
+    """``has_state`` loss contract: returns ``(loss, new_state)``.
+
+    ``batch``: ``{"images": [B,H,W,3], "labels": [B]}``.
+    Bind ``apply_fn`` with ``functools.partial(resnet_classification_loss,
+    apply_fn=model.apply_fn)`` or a lambda:
+    ``lambda p, s, b: resnet_classification_loss(p, s, b, model.apply_fn)``.
+    """
+    logits, new_state = apply_fn(params, batch["images"], state, train=True)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    if "loss_mask" in batch:
+        mask = batch["loss_mask"].astype(jnp.float32)
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0), new_state
+    return nll.mean(), new_state

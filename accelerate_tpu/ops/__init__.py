@@ -1,1 +1,4 @@
+"""Hot ops: attention dispatch (Pallas flash kernel on TPU, lax reference
+elsewhere), fp8 scaled matmuls, MoE routing."""
+
 from .attention import dot_product_attention

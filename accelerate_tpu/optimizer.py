@@ -16,7 +16,7 @@ state, scaler-aware ``step`` with overflow skip detection
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import numpy as np

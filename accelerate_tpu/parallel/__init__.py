@@ -1,3 +1,6 @@
+"""Parallelism machinery: mesh construction, sharding-rule engine,
+in-shard_map collectives, GPipe pipeline, ring/Ulysses context parallel."""
+
 from .mesh import AXIS_NAMES, BATCH_AXES, MeshConfig, batch_sharding, data_parallel_size, replicated
 from .sharding import (
     Rules,

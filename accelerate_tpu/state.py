@@ -16,15 +16,12 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 from contextlib import contextmanager
 from typing import Any, Callable, Optional
 
-import numpy as np
 
 from .utils.dataclasses import DistributedType, MixedPrecisionPolicy, ParallelismPlugin, PrecisionType
 from .utils.environment import parse_flag_from_env
-from .parallel.mesh import MeshConfig
 
 logger = logging.getLogger(__name__)
 
@@ -71,9 +68,12 @@ class PartialState:
             )
             os.environ["ACCELERATE_DISTRIBUTED_INITIALIZED"] = "1"
 
-        if cpu:
+        if cpu or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
             # force the CPU backend (test/debug path; also how the fake
-            # 8-device mesh CI mode runs)
+            # 8-device mesh CI mode runs). The env var alone is NOT enough:
+            # the axon TPU plugin can win over JAX_PLATFORMS and then wedge
+            # on an unreachable tunnel — the jax.config override is
+            # authoritative, so honor the env request here too.
             jax.config.update("jax_platforms", "cpu")
 
         self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")

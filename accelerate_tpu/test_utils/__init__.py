@@ -1,3 +1,6 @@
+"""In-package test utilities (shipped, like the reference package's
+src/accelerate/test_utils): fixtures, decorators, self-checking scripts."""
+
 from .training import RegressionDataset, RegressionModel, linear_loss_fn
 from .testing import (
     AccelerateTestCase,

@@ -29,66 +29,200 @@ def check_process_control(accelerator):
     accelerator.print("process control OK")
 
 
-def check_dataloader_sharding(accelerator):
-    from accelerate_tpu.data_loader import DataLoaderShard
+def _local_order(dl):
+    """Values yielded to THIS process, in order (loaders built with
+    device_placement=False so rows stay host-local numpy — safe under
+    multi-process where placed arrays span non-addressable devices)."""
+    return [float(v) for b in dl for v in np.asarray(b["x"]).ravel()]
+
+
+def make_ds(length: int):
+    """Toy dict-dataset: sample i is {"x": float(i)}."""
 
     class DS:
         def __len__(self):
-            return 40
+            return length
 
         def __getitem__(self, i):
             return {"x": np.float32(i)}
 
-    dl = DataLoaderShard(DS(), batch_size=2)
+    return DS()
+
+
+def check_dataloader_sharding(accelerator):
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.utils.operations import gather_object
+
+    DS = lambda: make_ds(40)
+    pc = max(1, accelerator.num_processes)
+    dl = DataLoaderShard(DS(), batch_size=2, device_placement=False)
     seen = []
     for batch in dl:
-        assert batch["x"].shape[0] == dl.total_batch_size
+        assert batch["x"].shape[0] == dl.total_batch_size // pc
         seen.extend(np.asarray(batch["x"]).ravel().tolist())
-    # all real samples appear; the padded tail duplicates batch-start rows
-    assert set(range(40)) <= set(int(v) for v in seen)
-    # shuffled loaders agree across processes (same seed -> same order)
-    dl_a = DataLoaderShard(DS(), batch_size=2, shuffle=True, seed=5)
-    dl_b = DataLoaderShard(DS(), batch_size=2, shuffle=True, seed=5)
-    order = lambda d: [v for b in d for v in np.asarray(b["x"]).ravel().tolist()]
-    assert order(dl_a) == order(dl_b)
+    # all real samples appear globally; the padded tail duplicates rows
+    global_seen = [v for chunk in gather_object([seen]) for v in chunk]
+    assert set(range(40)) <= set(int(v) for v in global_seen)
+    # same seed -> every process derives the same global permutation
+    dl_a = DataLoaderShard(DS(), batch_size=2, shuffle=True, seed=5, device_placement=False)
+    dl_b = DataLoaderShard(DS(), batch_size=2, shuffle=True, seed=5, device_placement=False)
+    assert _local_order(dl_a) == _local_order(dl_b)
     accelerator.print("dataloader sharding OK")
 
 
-def check_training_parity(accelerator):
-    """Distributed fast-path training must match the single-device loop
-    (reference training_check: test_script.py:455)."""
+def _single_device_baseline(ds, n_steps_per_epoch, epochs=2, lr=0.1, global_batch=16, skipped=()):
+    """The fp32 single-device reference loop every distributed mode must
+    match. ``skipped``: step indices the distributed run's fp16 GradScaler
+    rejected (overflow while the scale calibrates — torch GradScaler does
+    the same); the baseline must drop those batches too for step-for-step
+    parity."""
     import jax
     import optax
 
-    from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, linear_loss_fn
+    from accelerate_tpu.test_utils import linear_loss_fn
 
-    ds = RegressionDataset(length=64)
-    model = accelerator.prepare_model(RegressionModel())
-    optimizer = accelerator.prepare_optimizer(optax.sgd(0.1))
-    loader = accelerator.prepare_data_loader(ds)
-    loader.batch_size = max(1, 16 // accelerator.num_data_shards)
-    step = accelerator.build_train_step(linear_loss_fn)
-    for _ in range(2):
-        for batch in loader:
-            step(batch)
-
-    # single-device baseline
     params = {"a": np.float32(0.0), "b": np.float32(0.0)}
-    tx = optax.sgd(0.1)
+    tx = optax.sgd(lr)
     opt_state = tx.init(params)
     i = 0
-    for _ in range(2):
-        for _ in range(len(loader)):
-            idx = np.arange(i, i + 16) % 64
-            i += 16
+    step_idx = 0
+    for _ in range(epochs):
+        for _ in range(n_steps_per_epoch):
+            idx = np.arange(i, i + global_batch) % len(ds)
+            i += global_batch
+            if step_idx in skipped:
+                step_idx += 1
+                continue
+            step_idx += 1
             batch = {"x": ds.x[idx], "y": ds.y[idx]}
             g = jax.grad(linear_loss_fn)(params, batch)
             updates, opt_state = tx.update(g, opt_state, params)
             params = optax.apply_updates(params, updates)
+    return params
 
-    a_dist, a_base = float(model.params["a"]), float(params["a"])
-    assert abs(a_dist - a_base) < 1e-4, f"training diverged: {a_dist} vs {a_base}"
-    accelerator.print("training parity OK")
+
+def _fresh_accelerator(**kwargs):
+    """Reset the borg singletons and build a new Accelerator — the script's
+    equivalent of the reference constructing one Accelerator per
+    training_check mode (test_script.py:455)."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def check_training_parity(accelerator):
+    """Distributed fast-path training must match the single-device loop in
+    every precision mode (reference training_check: test_script.py:455
+    covers fp32/bf16/fp16)."""
+    import optax
+
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, linear_loss_fn
+
+    # tolerance per dtype policy: fp32 exact-ish; bf16/fp16 compute rounds
+    # the matmul but the 2-param regression still lands within ~1e-2
+    for mixed_precision, tol in (("no", 1e-4), ("bf16", 2e-2), ("fp16", 2e-2)):
+        acc = _fresh_accelerator(mixed_precision=mixed_precision)
+        ds = RegressionDataset(length=64)
+        model = acc.prepare_model(RegressionModel())
+        acc.prepare_optimizer(optax.sgd(0.1))
+        loader = acc.prepare_data_loader(ds)
+        loader.batch_size = max(1, 16 // acc.num_data_shards)
+        step = acc.build_train_step(linear_loss_fn)
+        optimizer = acc._optimizers[-1]
+        skipped = set()
+        step_idx = 0
+        for _ in range(2):
+            for batch in loader:
+                step(batch)
+                if optimizer.step_was_skipped:
+                    skipped.add(step_idx)
+                step_idx += 1
+
+        params = _single_device_baseline(ds, n_steps_per_epoch=len(loader), skipped=skipped)
+        a_dist, a_base = float(model.params["a"]), float(params["a"])
+        b_dist, b_base = float(model.params["b"]), float(params["b"])
+        assert abs(a_dist - a_base) < tol and abs(b_dist - b_base) < tol, (
+            f"[{mixed_precision}] training diverged: a {a_dist} vs {a_base}, b {b_dist} vs {b_base}"
+        )
+        acc.print(f"training parity [{mixed_precision}] OK")
+
+
+def check_split_batches(accelerator):
+    """``split_batches=True``: batch_size is the GLOBAL batch (each shard
+    sees batch_size // n rows); False: per-shard (global = batch_size * n).
+    Reference semantics: data_loader.py:110 BatchSamplerShard + the
+    split_batches field (dataclasses.py:773)."""
+    from accelerate_tpu.data_loader import DataLoaderShard
+
+    n = max(1, accelerator.num_data_shards)
+    pc = max(1, accelerator.num_processes)
+    if 16 % n:
+        accelerator.print("split batches SKIPPED (mesh does not divide 16)")
+        return
+    DS = lambda: make_ds(64)
+    dl_split = DataLoaderShard(DS(), batch_size=16, split_batches=True, device_placement=False)
+    assert dl_split.total_batch_size == 16, dl_split.total_batch_size
+    batch = next(iter(dl_split))
+    assert batch["x"].shape[0] == 16 // pc  # this process's rows of the global 16
+
+    dl_grow = DataLoaderShard(DS(), batch_size=16, split_batches=False)
+    assert dl_grow.total_batch_size == 16 * n
+    accelerator.print("split batches OK")
+
+
+def check_uneven_gather_exactness(accelerator):
+    """gather_for_metrics on a dataset length coprime with the mesh must
+    return EXACTLY the dataset — padded-tail rows dropped, no duplicates
+    (reference: accelerator.py:2799-2871 remainder truncation;
+    external_deps/test_metrics.py asserts sklearn-exactness on MRPC)."""
+    length = 61  # prime: never divides evenly into any mesh batch
+    acc = _fresh_accelerator()
+
+    loader = acc.prepare_data_loader(make_ds(length))
+    loader.batch_size = max(1, 8 // max(1, acc.num_data_shards))
+    seen = []
+    for batch in loader:
+        seen.append(np.asarray(acc.gather_for_metrics(batch["x"])))
+    flat = np.concatenate(seen)
+    assert len(flat) == length, f"expected exactly {length} rows, got {len(flat)}"
+    assert sorted(int(v) for v in flat) == list(range(length)), "gathered rows are not the dataset"
+    acc.print("uneven gather exactness OK")
+
+
+def check_epoch_reshuffle(accelerator):
+    """set_epoch reshuffles (different order per epoch) while staying
+    deterministic for a given (seed, epoch) — the reference's
+    SeedableRandomSampler contract (data_loader.py:73, test_script.py:364)."""
+    from accelerate_tpu.data_loader import DataLoaderShard
+
+    DS = lambda: make_ds(32)
+    dl = DataLoaderShard(DS(), batch_size=2, shuffle=True, seed=7, device_placement=False)
+    dl.set_epoch(0)
+    e0 = _local_order(dl)
+    dl.set_epoch(1)
+    e1 = _local_order(dl)
+    assert e0 != e1, "epochs must reshuffle"
+
+    dl2 = DataLoaderShard(DS(), batch_size=2, shuffle=True, seed=7, device_placement=False)
+    dl2.set_epoch(1)
+    assert _local_order(dl2) == e1, "same (seed, epoch) must give the same order on every process"
+    accelerator.print("epoch reshuffle OK")
+
+
+def check_trigger(accelerator):
+    """Early-stop flag semantics (reference: accelerator.py:2583-2640
+    set_trigger/check_trigger — a flag all-reduced across processes)."""
+    assert accelerator.check_trigger() is False
+    if accelerator.process_index == accelerator.num_processes - 1:
+        accelerator.set_trigger()
+    fired = accelerator.check_trigger()
+    assert fired is True, "trigger set on one rank must be visible on all"
+    assert accelerator.check_trigger() is False, "check_trigger must reset the flag"
+    accelerator.print("trigger OK")
 
 
 def check_gather_ops(accelerator):
@@ -113,7 +247,12 @@ def main():
     accelerator.print(f"state: mesh={dict(accelerator.mesh.shape)} procs={accelerator.num_processes}")
     check_process_control(accelerator)
     check_dataloader_sharding(accelerator)
+    check_split_batches(accelerator)
+    check_epoch_reshuffle(accelerator)
     check_gather_ops(accelerator)
+    check_trigger(accelerator)
+    # the singleton-resetting checks run last (they rebuild the Accelerator)
+    check_uneven_gather_exactness(accelerator)
     check_training_parity(accelerator)
     accelerator.print("ALL CHECKS PASSED")
 

@@ -5,7 +5,6 @@ execute_subprocess_async :753)."""
 
 from __future__ import annotations
 
-import asyncio
 import os
 import shutil
 import sys

@@ -16,7 +16,7 @@ import functools
 import json
 import os
 import time
-from typing import Optional, Union
+from typing import Optional
 
 from .logging import get_logger
 from .state import PartialState
@@ -52,7 +52,13 @@ def on_main_process(function):
 class GeneralTracker:
     """(reference: tracking.py:101). Subclass contract: class attrs ``name``
     and ``requires_logging_directory``; methods ``store_init_configuration``
-    and ``log``; optionally ``finish`` and a ``tracker`` property."""
+    and ``log``; optionally ``start``, ``finish`` and a ``tracker`` property.
+
+    Lifecycle (reference: tracking.py:318): ``__init__`` only records
+    arguments; the backend (wandb run, SummaryWriter, ...) is created in
+    ``start()``, which ``Accelerator.init_trackers`` calls on the main
+    process. Constructing a tracker on a worker rank is therefore free and
+    side-effect-less."""
 
     main_process_only = True
 
@@ -61,6 +67,10 @@ class GeneralTracker:
             for attr in ("name", "requires_logging_directory"):
                 if not hasattr(self, attr):
                     raise NotImplementedError(f"Tracker subclass must define `{attr}`")
+
+    def start(self):
+        """Initialise the tracking backend. Idempotence is the subclass's
+        concern; ``filter_trackers``/``init_trackers`` call it exactly once."""
 
     @property
     def tracker(self):
@@ -89,8 +99,11 @@ class JSONLTracker(GeneralTracker):
         super().__init__()
         self.run_name = run_name
         self.dir = os.path.join(logging_dir, run_name)
-        os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, "metrics.jsonl")
+
+    @on_main_process
+    def start(self):
+        os.makedirs(self.dir, exist_ok=True)
 
     @property
     def tracker(self):
@@ -121,13 +134,17 @@ class TensorBoardTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         try:
             from torch.utils.tensorboard import SummaryWriter
         except ImportError:
             from tensorboardX import SummaryWriter
-        self.run_name = run_name
-        self.logging_dir = os.path.join(logging_dir, run_name)
-        self.writer = SummaryWriter(self.logging_dir, **kwargs)
+        self.writer = SummaryWriter(self.logging_dir, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -165,9 +182,14 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         import wandb
 
-        self.run = wandb.init(project=run_name, **kwargs)
+        self.run = wandb.init(project=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -197,9 +219,14 @@ class MLflowTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         import mlflow
 
-        self.active_run = mlflow.start_run(run_name=run_name, **kwargs)
+        self.active_run = mlflow.start_run(run_name=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -234,10 +261,16 @@ class AimTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self.logging_dir = logging_dir
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         from aim import Run
 
-        self.writer = Run(repo=logging_dir, **kwargs)
-        self.writer.name = run_name
+        self.writer = Run(repo=self.logging_dir, **self._init_kwargs)
+        self.writer.name = self.run_name
 
     @property
     def tracker(self):
@@ -266,9 +299,14 @@ class CometMLTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         from comet_ml import Experiment
 
-        self.writer = Experiment(project_name=run_name, **kwargs)
+        self.writer = Experiment(project_name=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -298,9 +336,14 @@ class ClearMLTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         from clearml import Task
 
-        self.task = Task.init(project_name=run_name, **kwargs)
+        self.task = Task.init(project_name=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -333,9 +376,14 @@ class TrackioTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         import trackio
 
-        self.run = trackio.init(project=run_name, **kwargs)
+        self.run = trackio.init(project=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -369,9 +417,14 @@ class DVCLiveTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, live=None, **kwargs):
         super().__init__()
+        self._live = live
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         from dvclive import Live
 
-        self.live = live if live is not None else Live(**kwargs)
+        self.live = self._live if self._live is not None else Live(**self._init_kwargs)
 
     @property
     def tracker(self):
@@ -403,9 +456,14 @@ class SwanLabTracker(GeneralTracker):
     @on_main_process
     def __init__(self, run_name: str, **kwargs):
         super().__init__()
+        self.run_name = run_name
+        self._init_kwargs = kwargs
+
+    @on_main_process
+    def start(self):
         import swanlab
 
-        self.run = swanlab.init(project=run_name, **kwargs)
+        self.run = swanlab.init(project=self.run_name, **self._init_kwargs)
 
     @property
     def tracker(self):
@@ -479,9 +537,20 @@ def filter_trackers(log_with, logging_dir=None, project_name: str = "accelerate_
             names.append(value)
 
     trackers = []
+    def main_process_event(tracker, method, *event_args):
+        # start()/store_init_configuration() are main-process-only events
+        # for main_process_only trackers — enforced here so custom
+        # subclasses get the guarantee without decorating their methods
+        if getattr(tracker, "main_process_only", True) and not PartialState().is_main_process:
+            return
+        getattr(tracker, method)(*event_args)
+
     seen = set()
     for item in names:
         if isinstance(item, GeneralTracker):
+            main_process_event(item, "start")
+            if config:
+                main_process_event(item, "store_init_configuration", config)
             trackers.append(item)
             continue
         if item in seen:
@@ -495,7 +564,10 @@ def filter_trackers(log_with, logging_dir=None, project_name: str = "accelerate_
         if cls.requires_logging_directory:
             kwargs.setdefault("logging_dir", logging_dir or ".")
         tracker = cls(project_name, **kwargs)
+        # reference lifecycle (tracking.py:318): backend comes up in start(),
+        # not __init__ — so worker-rank construction stays side-effect-free
+        main_process_event(tracker, "start")
         if config:
-            tracker.store_init_configuration(config)
+            main_process_event(tracker, "store_init_configuration", config)
         trackers.append(tracker)
     return trackers

@@ -25,9 +25,8 @@ TPU-native design — no CUDA kernels, no module surgery:
 
 from __future__ import annotations
 
-import dataclasses
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import jax

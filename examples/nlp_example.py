@@ -77,6 +77,7 @@ def main():
 
     loss_fn = lambda p, b: bert_classification_loss(p, b, model.apply_fn)
     step = accelerator.build_train_step(loss_fn)
+    eval_step = accelerator.build_eval_step(lambda p, ids, mask: model.apply_fn(p, ids, mask))
 
     for epoch in range(args.num_epochs):
         t0, n_samples = time.perf_counter(), 0
@@ -91,7 +92,7 @@ def main():
         # eval pass with padded-tail truncation
         correct = total = 0
         for batch in loader:
-            logits = model(batch["input_ids"], batch["attention_mask"])
+            logits = eval_step(batch["input_ids"], batch["attention_mask"])
             preds = accelerator.gather_for_metrics(jnp.argmax(logits, -1))
             labels = accelerator.gather_for_metrics(batch["labels"])
             correct += int((np.asarray(preds) == np.asarray(labels)).sum())

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Zero-dependency repo quality gates (reference analogue: the Makefile
+quality targets + utils/check_copies.py-style repo checks; the image has no
+ruff/flake8, so the checks that matter are implemented directly):
+
+1. **import check** — every package module imports cleanly on the CPU
+   backend. This is the gate that would have caught round 1's
+   ``tracking.py`` module-level NameError.
+2. **unused-import check** — AST scan; names imported but never referenced.
+3. **docstring check** — every public module opens with a docstring (the
+   project convention: docstrings cite the reference file:line they cover).
+
+Exit code is nonzero on any finding. Run via ``make quality``.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).parent.parent
+PKG = REPO / "accelerate_tpu"
+
+
+def iter_modules():
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(REPO)
+        mod = ".".join(rel.with_suffix("").parts)
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        yield mod, path
+
+
+def check_imports() -> list[str]:
+    failures = []
+    for mod, _ in iter_modules():
+        try:
+            importlib.import_module(mod)
+        except Exception as e:  # noqa: BLE001 — report everything
+            failures.append(f"import {mod}: {type(e).__name__}: {e}")
+    return failures
+
+
+class _NameCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.used: set[str] = set()
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        # record the root name of dotted access (os.path -> os)
+        n = node
+        while isinstance(n, ast.Attribute):
+            n = n.value
+        if isinstance(n, ast.Name):
+            self.used.add(n.id)
+        self.generic_visit(node)
+
+
+def check_unused_imports() -> list[str]:
+    findings = []
+    for _, path in iter_modules():
+        tree = ast.parse(path.read_text(), filename=str(path))
+        imported: dict[str, int] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    imported[name] = node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imported[a.asname or a.name] = node.lineno
+        collector = _NameCollector()
+        collector.visit(tree)
+        # names re-exported via __all__ count as used
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                collector.used.add(node.value)
+        is_init = path.name == "__init__.py"
+        for name, lineno in imported.items():
+            if name not in collector.used and not is_init:
+                findings.append(f"{path.relative_to(REPO)}:{lineno}: unused import {name!r}")
+    return findings
+
+
+def check_docstrings() -> list[str]:
+    findings = []
+    for _, path in iter_modules():
+        if path.name == "__init__.py" and path.stat().st_size == 0:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if ast.get_docstring(tree) is None:
+            findings.append(f"{path.relative_to(REPO)}: missing module docstring")
+    return findings
+
+
+def main() -> int:
+    # force the CPU platform before anything imports jax — the import check
+    # must never touch (or wedge on) a real TPU
+    sys.path.insert(0, str(REPO))
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(1)
+
+    failures = []
+    for title, check in (
+        ("imports", check_imports),
+        ("unused imports", check_unused_imports),
+        ("module docstrings", check_docstrings),
+    ):
+        found = check()
+        status = "OK" if not found else f"{len(found)} finding(s)"
+        print(f"[{title}] {status}")
+        for f in found:
+            print(f"  {f}")
+        failures.extend(found)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

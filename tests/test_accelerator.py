@@ -255,3 +255,51 @@ def test_clip_grad_norm_zero_freezes_step():
     a0 = float(np.asarray(model.params["a"]))
     step(batch)
     assert float(np.asarray(model.params["a"])) == pytest.approx(a0, abs=1e-12)
+
+
+def test_loss_fn_optional_rng_gets_per_step_key():
+    """A loss whose ``rng`` parameter is keyword-with-default (the
+    functools.partial(bert_classification_loss, apply_fn=...) shape) still
+    receives the per-step key — dropout must not silently turn off."""
+    import functools
+
+    import optax
+
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+    seen_rngs = []
+
+    def loss_with_optional_rng(params, batch, apply_fn=None, rng=None):
+        assert rng is not None, "per-step rng was not delivered"
+        pred = apply_fn(params, batch["x"])
+        return ((pred - batch["y"]) ** 2).mean()
+
+    acc = Accelerator()
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.1))
+    step = acc.build_train_step(functools.partial(loss_with_optional_rng, apply_fn=model.apply_fn))
+    ds = RegressionDataset(length=16)
+    batch = {"x": ds.x, "y": ds.y}
+    loss = step(batch)
+    assert np.isfinite(float(loss))
+
+
+def test_build_eval_step_applies_dtype_policy():
+    """build_eval_step must run under the accelerator's compute dtype, not
+    raw fp32 params."""
+    import optax
+
+    acc = Accelerator(mixed_precision="bf16")
+    seen = {}
+
+    def apply_fn(p, x):
+        seen["dtype"] = p["w"].dtype
+        return x @ p["w"]
+
+    from accelerate_tpu.modeling import Model
+
+    model = acc.prepare_model(Model(apply_fn, {"w": np.eye(4, dtype=np.float32)}))
+    acc.prepare_optimizer(optax.sgd(0.1))
+    eval_step = acc.build_eval_step(apply_fn)
+    out = eval_step(np.ones((2, 4), np.float32))
+    assert str(seen["dtype"]) == "bfloat16", seen

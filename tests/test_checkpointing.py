@@ -204,3 +204,38 @@ def test_break_then_save_resume(tmp_path):
     acc.load_state(str(tmp_path / "ckpt"))
     got.extend(_batch_fingerprint(b) for b in loader)
     assert got == reference_seq, (len(got), len(reference_seq))
+
+
+def test_model_state_roundtrip(tmp_path):
+    """Non-trainable model.state (BatchNorm running stats) must survive
+    save_state/load_state — torch carries these as buffers in the module
+    state_dict; here they are a separate pytree."""
+    import jax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import ResNetConfig, create_resnet_model, resnet_classification_loss
+    from accelerate_tpu.parallel.mesh import batch_sharding
+
+    acc = Accelerator()
+    model = acc.prepare_model(create_resnet_model(ResNetConfig.tiny(), image_size=16))
+    acc.prepare_optimizer(optax.sgd(0.1))
+    step = acc.build_train_step(
+        lambda p, s, b: resnet_classification_loss(p, s, b, model.apply_fn), has_state=True
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": rng.normal(size=(16, 16, 16, 3)).astype(np.float32),
+        "labels": rng.integers(0, 10, size=(16,)).astype(np.int32),
+    }
+    batch = jax.device_put(batch, batch_sharding(acc.mesh))
+    for _ in range(3):
+        step(batch)
+    trained_stats = jax.tree_util.tree_map(np.asarray, model.state)
+    acc.save_state(str(tmp_path / "ckpt"))
+
+    # perturb the running stats, then restore
+    model.state = jax.tree_util.tree_map(lambda x: x * 0, model.state)
+    acc.load_state(str(tmp_path / "ckpt"))
+    restored = jax.tree_util.tree_map(np.asarray, model.state)
+    for a, b in zip(jax.tree_util.tree_leaves(trained_stats), jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)

@@ -219,6 +219,40 @@ def test_pod_autodiscovery_ssh_fanout(monkeypatch, tmp_path):
     assert rc == 0 and calls == []
 
 
+def test_pod_autodiscovery_respects_yaml_topology(monkeypatch, tmp_path):
+    """A topology configured in the YAML config file (not just CLI flags)
+    must suppress the pod SSH fan-out — the config is a user topology
+    request too."""
+    from accelerate_tpu.commands import launch as L
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "tpu-w0,tpu-w1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    ssh_calls = []
+    monkeypatch.setattr(
+        L, "pod_ssh_launcher", lambda args: ssh_calls.append(args) or 0
+    )
+    local_calls = []
+    monkeypatch.setattr(
+        L, "multi_process_launcher", lambda args: local_calls.append(args) or 0
+    )
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("num_processes: 2\n")
+    parser = L.launch_parser()
+    rc = L.launch_command(parser.parse_args(["--config_file", str(cfg), "train.py"]))
+    assert rc == 0
+    assert ssh_calls == [] and len(local_calls) == 1
+
+    # but DEFAULT-valued YAML topology keys (the config wizard writes
+    # num_machines: 1 unconditionally) must NOT suppress pod discovery
+    ssh_calls.clear()
+    local_calls.clear()
+    cfg2 = tmp_path / "cfg2.yaml"
+    cfg2.write_text("num_machines: 1\nmixed_precision: bf16\n")
+    rc = L.launch_command(parser.parse_args(["--config_file", str(cfg2), "train.py"]))
+    assert rc == 0
+    assert len(ssh_calls) == 1 and local_calls == []
+
+
 def test_config_precedence_cli_wins(monkeypatch, tmp_path):
     """Explicit CLI flags beat YAML even when they equal a parser default
     (the round-1 sentinel bug: --num_processes 1 was overridden)."""
@@ -229,17 +263,13 @@ def test_config_precedence_cli_wins(monkeypatch, tmp_path):
     parser = L.launch_parser()
 
     args = parser.parse_args(["--config_file", str(cfg), "train.py"])
-    monkeypatch.setattr(L.sys, "argv", ["accelerate-tpu", "launch", "--config_file", str(cfg), "train.py"])
     L._load_config_into_args(args)
     # not given on the CLI -> YAML fills them
     assert args.num_processes == 8 and args.machine_rank == 3 and args.mixed_precision == "bf16"
+    assert "num_processes" in args._from_config
 
     args = parser.parse_args(
         ["--config_file", str(cfg), "--num_processes", "1", "--machine_rank", "0", "train.py"]
-    )
-    monkeypatch.setattr(
-        L.sys, "argv",
-        ["accelerate-tpu", "launch", "--config_file", str(cfg), "--num_processes", "1", "--machine_rank", "0", "train.py"],
     )
     L._load_config_into_args(args)
     # explicitly passed, equal to defaults -> must NOT be overridden

@@ -155,3 +155,108 @@ def test_collate_tuples():
     out = default_collate([(np.float32(1), np.float32(2)), (np.float32(3), np.float32(4))])
     assert isinstance(out, tuple)
     np.testing.assert_array_equal(out[0], [1, 3])
+
+
+# ---------------------------------------------------------------------- #
+# Exhaustive index math (reference: tests/test_data_loader.py's
+# BatchSamplerShard sweeps across length x batch x drop_last x
+# even_batches — 897 LoC of explicit expectations; here the same space is
+# swept against invariants)
+# ---------------------------------------------------------------------- #
+
+
+def _host_batches(dl):
+    """Raw host-side batches (device_placement=False): pure index math."""
+    return [[int(v) for v in np.asarray(b["x"]).ravel()] for b in dl]
+
+
+@pytest.mark.parametrize("drop_last", [False, True])
+@pytest.mark.parametrize("even_batches", [False, True])
+@pytest.mark.parametrize("split_batches", [False, True])
+def test_index_math_sweep(drop_last, even_batches, split_batches):
+    import math
+
+    for length in (1, 2, 7, 16, 20, 31, 32, 33, 61):
+        for batch_size in (1, 2, 4, 8):
+            dl = DataLoaderShard(
+                ToyDataset(length),
+                batch_size=batch_size,
+                drop_last=drop_last,
+                even_batches=even_batches,
+                split_batches=split_batches,
+                device_placement=False,
+            )
+            g = dl.total_batch_size
+            assert g == batch_size  # single shard: split or not, g == batch_size
+            batches = _host_batches(dl)
+            ctx = f"len={length} bs={batch_size} drop={drop_last} even={even_batches} split={split_batches}"
+
+            # __len__ contract
+            assert len(batches) == len(dl), ctx
+            expected_n = length // g if drop_last else math.ceil(length / g)
+            assert len(batches) == expected_n, ctx
+
+            # every full batch is the exact consecutive index run
+            for bi, batch in enumerate(batches[:-1] if batches else []):
+                assert batch == list(range(bi * g, (bi + 1) * g)), ctx
+
+            if not batches:
+                continue
+            last = batches[-1]
+            rem = length % g
+            if drop_last or rem == 0:
+                assert last == list(range((len(batches) - 1) * g, len(batches) * g)), ctx
+            elif even_batches:
+                # wrap-around pad to the full global batch
+                tail = list(range(length - rem, length))
+                assert len(last) == g, ctx
+                assert last[:rem] == tail, ctx
+                if length >= g - rem:
+                    assert last[rem:] == list(range(g - rem)), ctx
+                else:
+                    # dataset smaller than the pad: wraparound cycles it
+                    assert set(last[rem:]) <= set(range(length)), ctx
+            else:
+                # minimal pad to a shard multiple (1 shard -> no pad)
+                assert last == list(range(length - rem, length)), ctx
+
+            # coverage: every real (non-dropped) index appears; padding may
+            # duplicate rows, so this is a subset check, not exact-once
+            covered = set(i for b in batches for i in b)
+            expect = set(range((length // g) * g if drop_last else length))
+            assert expect <= covered, ctx
+
+
+def test_index_math_sharded_mesh(mesh8):
+    """Same invariants with 8 data shards: global batch grows, padded tail
+    is a multiple of the shard count, remainder reports REAL rows."""
+    AcceleratorState()
+    gs = GradientState()
+    for length, batch_size in ((61, 2), (33, 1), (20, 2)):
+        dl = DataLoaderShard(ToyDataset(length), batch_size=batch_size)
+        g = dl.total_batch_size
+        assert g == batch_size * 8
+        seen = []
+        remainder = None
+        for b in dl:
+            assert b["x"].shape[0] == g  # never ragged
+            seen.extend(global_values(b))
+            if gs.end_of_dataloader:
+                remainder = gs.remainder
+        rem = length % g
+        assert remainder == (rem if rem else -1), (length, batch_size, remainder)
+        assert set(range(length)) <= set(int(v) for v in seen)
+
+
+def test_even_batches_false_pads_to_shard_multiple(mesh8):
+    """even_batches=False: the tail batch shrinks to ceil(rem/shards)*shards
+    (static shapes — never ragged) instead of the full global batch."""
+    import math
+
+    AcceleratorState()
+    dl = DataLoaderShard(ToyDataset(20), batch_size=2, even_batches=False)
+    batches = list(dl)
+    rem = 20 % dl.total_batch_size  # 4
+    expected_tail = math.ceil(rem / 8) * 8  # 8
+    assert batches[-1]["x"].shape[0] == expected_tail
+    assert batches[0]["x"].shape[0] == dl.total_batch_size

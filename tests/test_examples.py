@@ -11,11 +11,16 @@ import pytest
 EXAMPLES_DIR = pathlib.Path(__file__).parent.parent / "examples" / "by_feature"
 EXAMPLES = sorted(p.name for p in EXAMPLES_DIR.glob("*.py") if not p.name.startswith("_"))
 
+REPO_ROOT = str(pathlib.Path(__file__).parent.parent)
+
 ENV = {
     **os.environ,
     "PALLAS_AXON_POOL_IPS": "",
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+    # examples run from examples/by_feature; the package lives at the repo
+    # root, which is not on sys.path for a subprocess
+    "PYTHONPATH": os.pathsep.join(p for p in (REPO_ROOT, os.environ.get("PYTHONPATH", "")) if p),
 }
 
 
@@ -35,3 +40,18 @@ def test_example_runs(example):
 def test_all_examples_discovered():
     # guard against the glob silently matching nothing
     assert len(EXAMPLES) >= 8, EXAMPLES
+
+
+@pytest.mark.parametrize("example", ["nlp_example.py", "cv_example.py"])
+def test_root_example_runs_tiny(example):
+    """The two canonical examples (reference: examples/nlp_example.py,
+    examples/cv_example.py) in CI size."""
+    result = subprocess.run(
+        [sys.executable, example, "--tiny", "--num_epochs", "1"],
+        cwd=EXAMPLES_DIR.parent,
+        env=ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert result.returncode == 0, f"{example} failed:\n{result.stdout}\n{result.stderr}"

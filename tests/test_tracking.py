@@ -9,6 +9,7 @@ from accelerate_tpu.tracking import GeneralTracker, JSONLTracker, filter_tracker
 
 def test_jsonl_tracker_logs(tmp_path):
     t = JSONLTracker("run", logging_dir=str(tmp_path))
+    t.start()  # backend init is deferred to start() (reference: tracking.py:318)
     t.store_init_configuration({"lr": 0.1})
     t.log({"loss": 1.5}, step=0)
     t.log({"loss": 0.5}, step=1)
@@ -40,6 +41,10 @@ def test_custom_tracker_instance_passthrough(tmp_path):
         def __init__(self):
             super().__init__()
             self.logged = []
+            self.started = False
+
+        def start(self):
+            self.started = True
 
         def store_init_configuration(self, values):
             pass
@@ -50,3 +55,13 @@ def test_custom_tracker_instance_passthrough(tmp_path):
     mine = MyTracker()
     trackers = filter_trackers([mine], None, "p")
     assert trackers == [mine]
+    assert mine.started  # start() is called on passthrough instances too
+
+
+def test_start_deferred_until_filter(tmp_path):
+    """Constructing a tracker must not touch the filesystem/backend; only
+    start() (called by filter_trackers / init_trackers) does."""
+    t = JSONLTracker("run", logging_dir=str(tmp_path))
+    assert not (tmp_path / "run").exists()
+    t.start()
+    assert (tmp_path / "run").exists()
