@@ -3,13 +3,14 @@
 
 .PHONY: quality style test test-fast test-cli check-imports bench dryrun
 
-# lint if ruff is installed; the zero-dep AST/import gates always run
+# lint if ruff is installed (its exit code propagates); the zero-dep
+# AST/import gates always run
 quality:
-	@command -v ruff >/dev/null 2>&1 && ruff check accelerate_tpu tests examples || true
+	@if command -v ruff >/dev/null 2>&1; then ruff check accelerate_tpu tests examples; else echo "ruff not installed; skipping lint"; fi
 	python scripts/check_repo.py
 
 style:
-	@command -v ruff >/dev/null 2>&1 && ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples || echo "ruff not installed; style target is a no-op here"
+	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi
 
 test:
 	python -m pytest tests/ -q

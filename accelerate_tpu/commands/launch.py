@@ -52,6 +52,18 @@ def launch_parser(subparsers=None):
     parser.add_argument("--mesh_pipe", type=int, default=None)
     parser.add_argument("--mesh_expert", type=int, default=None)
     parser.add_argument("--debug", action="store_true", help="enable collective shape verification")
+    parser.add_argument(
+        "--max_restarts",
+        type=int,
+        default=0,
+        help="restart the run this many times on crash (checkpoint-based resume; torchelastic analogue)",
+    )
+    parser.add_argument(
+        "--monitor_interval",
+        type=float,
+        default=5,
+        help="seconds between process-group health polls / before a restart",
+    )
     parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
     parser.add_argument("--fake_devices", type=int, default=None, help="CPU fake-mesh device count (testing)")
     parser.add_argument("--config_file", default=None)
@@ -184,12 +196,47 @@ def pod_worker_id() -> int:
     return int(os.environ.get("TPU_WORKER_ID", "0"))
 
 
+def _supervised(run_once, args) -> int:
+    """Per-host process supervision — restart-on-crash up to
+    ``--max_restarts`` times (reference analogue: the torchelastic
+    ``max_restarts``/``monitor_interval`` args the reference forwards,
+    commands/launch.py elastic group; SURVEY §5 lists this as the
+    framework's failure-recovery story). Recovery is checkpoint-based: the
+    restarted script sees ``ACCELERATE_RESTART_COUNT`` and its own
+    ``load_state`` resumes from the last checkpoint."""
+    import time
+
+    max_restarts = getattr(args, "max_restarts", 0) or 0
+    attempt = 0
+    while True:
+        rc = run_once(attempt)
+        if rc == 0 or attempt >= max_restarts:
+            if rc != 0:
+                from ..utils.console import print_launch_failure
+
+                print_launch_failure(rc, attempt if max_restarts else None)
+            return rc
+        attempt += 1
+        delay = getattr(args, "monitor_interval", None)
+        delay = 5 if delay is None else delay
+        print(
+            f"launch: run failed (rc={rc}); restart {attempt}/{max_restarts} in {delay}s",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+
+
 def simple_launcher(args) -> int:
     """One process for all local chips (reference simple_launcher:
     commands/launch.py:778)."""
-    env = build_env(args)
-    cmd = [sys.executable, *_script_argv(args)]
-    return subprocess.call(cmd, env=env)
+
+    def run_once(attempt):
+        env = build_env(args)
+        env["ACCELERATE_RESTART_COUNT"] = str(attempt)
+        cmd = [sys.executable, *_script_argv(args)]
+        return subprocess.call(cmd, env=env)
+
+    return _supervised(run_once, args)
 
 
 def _script_argv(args) -> list:
@@ -200,21 +247,60 @@ def _script_argv(args) -> list:
 
 def multi_process_launcher(args) -> int:
     """N local processes with a JAX coordinator (testing / multi-host-sim;
-    replaces torchrun — reference: commands/launch.py:790-822)."""
-    procs = []
-    for rank in range(args.num_processes):
-        env = build_env(args, process_id=rank, num_processes=args.num_processes)
-        cmd = [sys.executable, *_script_argv(args)]
-        procs.append(subprocess.Popen(cmd, env=env))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+    replaces torchrun — reference: commands/launch.py:790-822). A process
+    crashing takes the whole group down (the collective would deadlock
+    anyway), then ``--max_restarts`` relaunches the group."""
+    import time
+
+    def run_once(attempt):
+        procs = []
+        for rank in range(args.num_processes):
+            env = build_env(args, process_id=rank, num_processes=args.num_processes)
+            env["ACCELERATE_RESTART_COUNT"] = str(attempt)
+            cmd = [sys.executable, *_script_argv(args)]
+            procs.append(subprocess.Popen(cmd, env=env))
+        interval = getattr(args, "monitor_interval", None)
+        interval = 5 if interval is None else interval
+        rc = 0
+        try:
+            while procs:
+                alive = []
+                for p in procs:
+                    code = p.poll()
+                    if code is None:
+                        alive.append(p)
+                    elif code != 0:
+                        # one rank died: the rest would hang on the next
+                        # collective — terminate the group (torchelastic
+                        # group-restart semantics)
+                        rc = code
+                        for q in procs:
+                            if q.poll() is None:
+                                q.terminate()
+                        return rc
+                procs = alive
+                if procs:
+                    time.sleep(min(interval, 1.0))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        return rc
+
+    return _supervised(run_once, args)
 
 
 def pod_ssh_launcher(args) -> int:
     """SSH fan-out: each pod host re-invokes the launcher locally
-    (reference tpu_pod_launcher: commands/launch.py:909-965)."""
+    (reference tpu_pod_launcher: commands/launch.py:909-965). Honors
+    ``--max_restarts`` like the local launchers: a failed fan-out is
+    re-dispatched whole (every host restarts together — the surviving
+    hosts' collectives would deadlock otherwise)."""
     hosts = [h.strip() for h in args.tpu_hosts.split(",") if h.strip()]
     coordinator = f"{hosts[0]}:{args.main_process_port or 7777}"
     # Pod hosts usually share the VM image / NFS checkout; keep the package
@@ -223,20 +309,25 @@ def pod_ssh_launcher(args) -> int:
     import shlex
 
     script_cmd = " ".join(shlex.quote(a) for a in _script_argv(args))
-    procs = []
-    for rank, host in enumerate(hosts):
-        remote_cmd = (
-            f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
-            f"ACCELERATE_NUM_PROCESSES={len(hosts)} ACCELERATE_PROCESS_ID={rank} "
-            f'PYTHONPATH={_pkg_root()}"${{PYTHONPATH:+:$PYTHONPATH}}" '
-            f"{sys.executable} {script_cmd}"
-        )
-        target = f"{args.ssh_user}@{host}" if args.ssh_user else host
-        procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+
+    def run_once(attempt):
+        procs = []
+        for rank, host in enumerate(hosts):
+            remote_cmd = (
+                f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
+                f"ACCELERATE_NUM_PROCESSES={len(hosts)} ACCELERATE_PROCESS_ID={rank} "
+                f"ACCELERATE_RESTART_COUNT={attempt} "
+                f'PYTHONPATH={_pkg_root()}"${{PYTHONPATH:+:$PYTHONPATH}}" '
+                f"{sys.executable} {script_cmd}"
+            )
+            target = f"{args.ssh_user}@{host}" if args.ssh_user else host
+            procs.append(subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+
+    return _supervised(run_once, args)
 
 
 def launch_command(args) -> int:

@@ -44,3 +44,10 @@ from .t5 import (
     create_t5_model,
     seq2seq_lm_loss,
 )
+from .vit import (
+    VIT_SHARDING_RULES,
+    ViT,
+    ViTConfig,
+    create_vit_model,
+    vit_classification_loss,
+)

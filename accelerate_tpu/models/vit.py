@@ -1,0 +1,164 @@
+"""Vision Transformer (flax.linen) — the transformer CV model.
+
+Reference analogue: the reference's CV path delegates to timm
+(examples/cv_example.py `create_model`); the in-tree zoo needs a
+transformer vision model next to ResNet. TPU-first choices:
+
+* patchify as a strided conv — one big matmul-shaped op for the MXU
+  (kernel = patch, stride = patch), NHWC;
+* pre-LN encoder blocks sharing the BERT Megatron TP layout (QKV/up
+  column-split, out/down row-split over ``tensor``);
+* no BatchNorm — LayerNorm only, so the model is stateless (no
+  ``has_state`` plumbing needed) and shards trivially;
+* optional ``remat`` per block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+from ..ops.fp8 import policy_dot_general as _pdg
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    num_classes: int = 1000
+    dropout_rate: float = 0.0
+    layer_norm_eps: float = 1e-6
+    remat: bool = False
+
+    @classmethod
+    def base(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_classes", 10)
+        return cls(**kw)
+
+
+VIT_SHARDING_RULES = [
+    (r"attention/(query|key|value)/kernel", P(None, "tensor")),
+    (r"attention/out/kernel", P("tensor", None)),
+    (r"mlp/up/kernel", P(None, "tensor")),
+    (r"mlp/down/kernel", P("tensor", None)),
+    (r"head/kernel", P(None, "tensor")),
+]
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        norm = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, name=name, dtype=jnp.float32)
+
+        x = norm("norm1")(hidden).astype(hidden.dtype)
+        dense = lambda name: nn.Dense(cfg.hidden_size, name=name, dtype=hidden.dtype, dot_general=_pdg())
+        q = dense("attention/query")(x)
+        k = dense("attention/key")(x)
+        v = dense("attention/value")(x)
+
+        def split(t):
+            return t.reshape(*t.shape[:-1], cfg.num_attention_heads, head_dim)
+
+        from ..ops.attention import dot_product_attention
+
+        out = dot_product_attention(split(q), split(k), split(v))
+        out = out.reshape(*out.shape[:-2], cfg.hidden_size)
+        out = dense("attention/out")(out)
+        if not deterministic and cfg.dropout_rate:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic=False)
+        hidden = hidden + out
+
+        x = norm("norm2")(hidden).astype(hidden.dtype)
+        x = nn.Dense(cfg.intermediate_size, name="mlp/up", dtype=hidden.dtype, dot_general=_pdg())(x)
+        x = nn.gelu(x)
+        x = nn.Dense(cfg.hidden_size, name="mlp/down", dtype=hidden.dtype, dot_general=_pdg())(x)
+        if not deterministic and cfg.dropout_rate:
+            x = nn.Dropout(cfg.dropout_rate)(x, deterministic=False)
+        return hidden + x
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        """images: [B, H, W, 3] NHWC float. Returns [B, num_classes] fp32."""
+        cfg = self.config
+        p = cfg.patch_size
+        x = nn.Conv(
+            cfg.hidden_size, (p, p), strides=(p, p), padding="VALID", dtype=images.dtype, name="patch_embed"
+        )(images)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+
+        cls = self.param("cls_token", nn.initializers.zeros_init(), (1, 1, cfg.hidden_size))
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(x.dtype), (b, 1, c)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, h * w + 1, cfg.hidden_size)
+        )
+        x = x + pos.astype(x.dtype)
+
+        block_cls = nn.remat(ViTBlock) if cfg.remat else ViTBlock
+        for i in range(cfg.num_hidden_layers):
+            x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_norm", dtype=jnp.float32)(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+def create_vit_model(
+    config: Optional[ViTConfig] = None,
+    seed: int = 0,
+    batch_size: int = 2,
+) -> Model:
+    """Initialise a :class:`~accelerate_tpu.modeling.Model` wrapping ViT."""
+    config = config or ViTConfig.base()
+    module = ViT(config)
+    dummy = jnp.zeros((batch_size, config.image_size, config.image_size, 3), jnp.float32)
+    params = module.init(jax.random.key(seed), dummy)["params"]
+
+    def apply_fn(p, images, deterministic=True, rngs=None):
+        # follow the casted params' dtype (see resnet.py: fp32 inputs would
+        # otherwise upcast every layer back to fp32)
+        leaf = jax.tree_util.tree_leaves(p)[0]
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            images = images.astype(leaf.dtype)
+        return module.apply({"params": p}, images, deterministic=deterministic, rngs=rngs)
+
+    model = Model(apply_fn, params, sharding_rules=VIT_SHARDING_RULES, name="vit")
+    model.config = config
+    model.module = module
+    return model
+
+
+def vit_classification_loss(params, batch, apply_fn=None):
+    """Cross-entropy on ``{"images", "labels"}`` (fp32 logits/loss)."""
+    logits = apply_fn(params, batch["images"])
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return nll.mean()

@@ -105,6 +105,110 @@ def check_no_sync_never_applies(accelerator):
     accelerator.print("no_sync OK")
 
 
+def _reset_singletons():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def check_fast_path_accumulation(accelerator):
+    """build_train_step with accum=2: params frozen off-boundary, and the
+    2-microbatch result equals one fused batch (the jitted mirror of the
+    imperative checks; reference: test_sync.py:455 step_model parity)."""
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel, linear_loss_fn
+
+    _reset_singletons()
+    acc = Accelerator(gradient_accumulation_steps=2)
+    ds = RegressionDataset(length=8, seed=3)
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.05))
+    step = acc.build_train_step(linear_loss_fn)
+
+    half_a = {"x": ds.x[:4], "y": ds.y[:4]}
+    half_b = {"x": ds.x[4:], "y": ds.y[4:]}
+    p0 = jax.tree.map(np.asarray, model.params)
+    step(half_a)
+    np.testing.assert_array_equal(_flat(model.params), _flat(p0))  # buffered
+    step(half_b)
+    p_accum = _flat(model.params)
+    assert np.abs(p_accum - _flat(p0)).max() > 0  # applied on the boundary
+
+    # fused single step at accum=1 from the same start
+    _reset_singletons()
+    acc2 = Accelerator()
+    model2 = acc2.prepare_model(RegressionModel())
+    acc2.prepare_optimizer(optax.sgd(0.05))
+    step2 = acc2.build_train_step(linear_loss_fn)
+    step2({"x": ds.x, "y": ds.y})
+    np.testing.assert_allclose(p_accum, _flat(model2.params), atol=1e-5, rtol=1e-5)
+    print("fast-path accumulation OK")
+
+
+def check_end_of_dataloader_forces_sync(accelerator):
+    """The LAST batch of an epoch applies the update even mid-accumulation
+    window (reference sync_with_dataloader semantics: accelerator.py:1123 +
+    GradientState end_of_dataloader)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel, linear_loss_fn
+
+    _reset_singletons()
+    acc = Accelerator(gradient_accumulation_steps=2)
+    # exactly 3 global batches (odd: the last lands mid-accumulation-window)
+    ds = RegressionDataset(length=3 * acc.num_data_shards, seed=4)
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.05))
+    loader = acc.prepare_data_loader(ds)
+    loader.batch_size = 1  # per-shard
+    step = acc.build_train_step(linear_loss_fn)
+    assert len(loader) == 3, len(loader)
+
+    p_after_two = None
+    for i, batch in enumerate(loader):
+        step(batch)
+        if i == 1:
+            p_after_two = _flat(model.params)
+    # batch 3 is both off-boundary (micro 1 of 2) AND end-of-epoch: the
+    # update must still apply
+    assert np.abs(_flat(model.params) - p_after_two).max() > 0, (
+        "end-of-dataloader did not force a gradient sync"
+    )
+    print("end-of-dataloader sync OK")
+
+
+def check_scheduler_steps_with_optimizer(accelerator):
+    """AcceleratedScheduler advances only when the optimizer really steps
+    (reference: scheduler.py:54-84)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel, linear_loss_fn
+
+    _reset_singletons()
+    acc = Accelerator(gradient_accumulation_steps=2)
+    ds = RegressionDataset(length=8, seed=5)
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(optax.linear_schedule(0.1, 0.0, 10)))
+    sched = acc.prepare_scheduler(optax.linear_schedule(0.1, 0.0, 10))
+    step = acc.build_train_step(linear_loss_fn)
+    half_a = {"x": ds.x[:4], "y": ds.y[:4]}
+    half_b = {"x": ds.x[4:], "y": ds.y[4:]}
+    assert sched.step_count == 0
+    step(half_a)  # buffered: no optimizer step -> no scheduler step
+    assert sched.step_count == 0, sched.step_count
+    step(half_b)  # boundary: both step (scaled by the data-parallel degree,
+    # reference scheduler.py:54-84 scales by num_processes)
+    assert sched.step_count == acc.num_data_shards, sched.step_count
+    print("scheduler-with-optimizer OK")
+
+
 def main():
     from accelerate_tpu import Accelerator
     from accelerate_tpu.utils import GradientAccumulationPlugin
@@ -115,7 +219,10 @@ def main():
     model, opt, loss_fn, batches = check_accumulate_applies_on_boundary(accelerator)
     check_accumulated_equals_fused(accelerator, model, opt, loss_fn, batches)
     check_no_sync_never_applies(accelerator)
-    accelerator.print("test_sync: ALL OK")
+    check_fast_path_accumulation(accelerator)
+    check_end_of_dataloader_forces_sync(accelerator)
+    check_scheduler_steps_with_optimizer(accelerator)
+    print("test_sync: ALL OK")
 
 
 if __name__ == "__main__":

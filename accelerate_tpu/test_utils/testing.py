@@ -38,6 +38,48 @@ def require_cpu_only(test_case):
     return unittest.skipUnless(jax.default_backend() == "cpu", "test requires CPU backend")(test_case)
 
 
+def require_device_count(n: int):
+    """Skip unless at least ``n`` devices are attached (reference analogue:
+    require_multi_device/require_multi_gpu with counts, testing.py:151+)."""
+
+    def decorator(test_case):
+        import jax
+
+        return unittest.skipUnless(len(jax.devices()) >= n, f"test requires >= {n} devices")(test_case)
+
+    return decorator
+
+
+def require_package(name: str, import_name: str | None = None):
+    """Generic availability gate (the reference ships ~60 hand-written
+    require_* decorators, testing.py:151-585; one factory covers them)."""
+    import importlib.util
+
+    def decorator(test_case):
+        found = importlib.util.find_spec(import_name or name) is not None
+        return unittest.skipUnless(found, f"test requires {name}")(test_case)
+
+    return decorator
+
+
+require_transformers = require_package("transformers")
+require_safetensors = require_package("safetensors")
+require_orbax = require_package("orbax-checkpoint", "orbax.checkpoint")
+require_tensorboard = require_package("tensorboard")
+require_wandb = require_package("wandb")
+require_torch = require_package("torch")
+
+
+def slow(test_case):
+    """Gate long tests behind ACCELERATE_RUN_SLOW=1 (reference:
+    testing.py slow decorator)."""
+    from ..utils.environment import parse_flag_from_env
+
+    return unittest.skipUnless(parse_flag_from_env("ACCELERATE_RUN_SLOW"), "slow test; set ACCELERATE_RUN_SLOW=1")(
+        test_case
+    )
+
+
 class AccelerateTestCase(unittest.TestCase):
     """Resets singleton state between tests (reference: testing.py:639-651)."""
 

@@ -275,3 +275,70 @@ def test_config_precedence_cli_wins(monkeypatch, tmp_path):
     # explicitly passed, equal to defaults -> must NOT be overridden
     assert args.num_processes == 1 and args.machine_rank == 0
     assert args.mixed_precision == "bf16"  # still filled from YAML
+
+
+def test_max_restarts_supervisor(tmp_path):
+    """Crash-once-then-succeed script: --max_restarts relaunches it with
+    ACCELERATE_RESTART_COUNT set (torchelastic analogue; checkpoint-based
+    recovery is the script's load_state)."""
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, pathlib, sys\n"
+        f"marker = pathlib.Path({str(tmp_path)!r}) / 'ran_once'\n"
+        "if not marker.exists():\n"
+        "    marker.write_text('1')\n"
+        "    sys.exit(3)\n"
+        "assert os.environ['ACCELERATE_RESTART_COUNT'] == '1'\n"
+        "print('RECOVERED')\n"
+    )
+    result = run_cli(
+        "launch", "--cpu", "--max_restarts", "1", "--monitor_interval", "0.1", str(script)
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "RECOVERED" in result.stdout
+
+    # without supervision the crash propagates
+    (tmp_path / "ran_once").unlink()
+    result = run_cli("launch", "--cpu", str(script))
+    assert result.returncode == 3
+
+
+def test_max_restarts_multiprocess_group_restart(tmp_path):
+    """One rank crashing takes the group down; the supervisor relaunches
+    the whole group and the retry succeeds."""
+    script = tmp_path / "flaky_mp.py"
+    script.write_text(
+        "import os, pathlib, sys\n"
+        f"base = pathlib.Path({str(tmp_path)!r})\n"
+        "rank = os.environ.get('ACCELERATE_PROCESS_ID', '0')\n"
+        "attempt = os.environ['ACCELERATE_RESTART_COUNT']\n"
+        "(base / f'saw_{rank}_{attempt}').write_text('1')\n"
+        "if attempt == '0' and rank == '1':\n"
+        "    sys.exit(5)\n"
+        "print('MP_RECOVERED', rank)\n"
+    )
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4",
+        "--main_process_port", "7917", "--max_restarts", "1",
+        "--monitor_interval", "0.1", str(script),
+        timeout=300,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    # both attempts ran both ranks
+    for rank in (0, 1):
+        for attempt in (0, 1):
+            assert (tmp_path / f"saw_{rank}_{attempt}").exists(), (rank, attempt)
+    assert result.stdout.count("MP_RECOVERED") >= 1
+
+
+def test_data_loop_script_multiprocess():
+    """Distributed data-loop script (reference analogue:
+    test_utils/scripts/test_distributed_data_loop.py) on two processes."""
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4",
+        "--main_process_port", "7815", "-m",
+        "accelerate_tpu.test_utils.scripts.test_data_loop",
+        timeout=300,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert result.stdout.count("test_data_loop: ALL OK") >= 1

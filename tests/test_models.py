@@ -340,3 +340,44 @@ def test_resnet_tp_sharding_rules_apply():
     assert head.sharding.spec == P(None, "tensor")
     conv = model.params["conv_init"]["kernel"]
     assert conv.sharding.spec == P(None, None, None, "tensor")
+
+
+# ---------------------------------------------------------------------- #
+# ViT (transformer CV model)
+# ---------------------------------------------------------------------- #
+
+
+def test_vit_forward_and_train_step():
+    from accelerate_tpu.models import ViTConfig, create_vit_model, vit_classification_loss
+    from accelerate_tpu.parallel.mesh import batch_sharding
+
+    acc = Accelerator(mixed_precision="bf16")
+    model = acc.prepare_model(create_vit_model(ViTConfig.tiny()))
+    acc.prepare_optimizer(optax.adamw(1e-3))
+    step = acc.build_train_step(lambda p, b: vit_classification_loss(p, b, model.apply_fn))
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": rng.normal(size=(16, 32, 32, 3)).astype(np.float32),
+        "labels": rng.integers(0, 10, size=(16,)).astype(np.int32),
+    }
+    batch = jax.device_put(batch, batch_sharding(acc.mesh))
+    losses = [float(step(batch)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+    eval_step = acc.build_eval_step(lambda p, x: model.apply_fn(p, x))
+    logits = eval_step(batch["images"])
+    assert logits.shape == (16, 10) and str(logits.dtype) == "float32"
+
+
+def test_vit_tp_rules_apply():
+    from jax.sharding import PartitionSpec as P
+
+    from accelerate_tpu.models import ViTConfig, create_vit_model
+
+    acc = Accelerator(
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(data=2, tensor=4)),
+    )
+    model = acc.prepare_model(create_vit_model(ViTConfig.tiny()))
+    q = model.params["block_0"]["attention/query"]["kernel"]
+    assert q.sharding.spec == P(None, "tensor")
+    up = model.params["block_0"]["mlp/up"]["kernel"]
+    assert up.sharding.spec == P(None, "tensor")
