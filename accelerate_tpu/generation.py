@@ -1,0 +1,142 @@
+"""Autoregressive generation with a jitted KV-cache decode loop.
+
+The reference has no in-framework generation — its big-model-inference
+benchmark (benchmarks/big_model_inference, per-token latency table in
+BASELINE.md) calls ``transformers`` ``generate`` over dispatched modules.
+Here decode is first-class and TPU-shaped:
+
+* the KV cache is a fixed-size pytree (``models/llama.py``
+  ``_cached_attention``) updated via ``dynamic_update_slice`` — static
+  shapes end to end;
+* prefill is ONE forward over the whole prompt (MXU-friendly), then the
+  per-token loop is a single ``lax.scan`` inside one jit: no per-token
+  dispatch, no host round-trips until the final token block returns;
+* sampling (greedy / temperature / top-k) happens on-device inside the
+  scan with an explicit folded key chain.
+
+Works with any model whose ``apply_fn`` supports
+``(params, ids, positions=..., decode=True, cache=...) -> (logits, cache)``
+(the zoo's llama; the same contract is the extension point for others).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def generate(
+    model,
+    input_ids,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    seed: int = 0,
+    eos_token_id: Optional[int] = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``input_ids`` [B, S].
+
+    ``temperature=0`` is greedy; otherwise softmax sampling at the given
+    temperature, optionally truncated to the ``top_k`` highest logits.
+    Returns int32 [B, S + max_new_tokens]. When ``eos_token_id`` is given,
+    positions after a sequence's EOS are filled with EOS (the loop still
+    runs to ``max_new_tokens`` — static shapes; early exit would retrace).
+    """
+    jax = _jax()
+    jnp = jax.numpy
+
+    apply_fn = model.apply_fn
+    params = model.params
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, prompt_len = input_ids.shape
+
+    max_pos = getattr(getattr(model, "config", None), "max_position_embeddings", None)
+    if max_pos is not None and prompt_len + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's cache size (max_position_embeddings={max_pos}); "
+            f"dynamic_update_slice would silently wrap and corrupt the output"
+        )
+
+    # the jitted runner is cached on the model: a fresh jit closure per
+    # call would retrace + recompile every generate() (and defeat
+    # per_token_latency's warm-up)
+    cache_key = (b, prompt_len, max_new_tokens, float(temperature), top_k, eos_token_id)
+    runners = model.__dict__.setdefault("_generate_runners", {})
+    if cache_key in runners:
+        return runners[cache_key](params, input_ids, jax.random.key(seed))
+
+    @jax.jit
+    def run(params, input_ids, key):
+        # prefill: one big forward primes the cache and yields the first
+        # next-token logits
+        positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+        logits, cache = apply_fn(params, input_ids, positions=positions, decode=True, cache=None)
+
+        def sample(logits_1, key):
+            logits_1 = logits_1.astype(jnp.float32)
+            if temperature <= 0.0:
+                return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
+            if top_k is not None:
+                kth = jax.lax.top_k(logits_1, top_k)[0][..., -1:]
+                logits_1 = jnp.where(logits_1 < kth, -jnp.inf, logits_1)
+            return jax.random.categorical(key, logits_1 / temperature, axis=-1).astype(jnp.int32)
+
+        key, sub = jax.random.split(key)
+        next_tok = sample(logits[:, -1], sub)
+        done = jnp.zeros((b,), bool) if eos_token_id is None else next_tok == eos_token_id
+
+        def step(carry, _):
+            cache, tok, pos, key, done = carry
+            positions = jnp.broadcast_to(pos[None, None], (b, 1))
+            logits, cache = apply_fn(params, tok[:, None], positions=positions, decode=True, cache=cache)
+            key, sub = jax.random.split(key)
+            nxt = sample(logits[:, -1], sub)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            return (cache, nxt, pos + 1, key, done), nxt
+
+        carry = (cache, next_tok, jnp.int32(prompt_len), key, done)
+        if max_new_tokens > 1:
+            _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
+            new_tokens = jnp.concatenate([next_tok[None], rest], axis=0).T  # [B, T]
+        else:
+            new_tokens = next_tok[:, None]
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+    runners[cache_key] = run
+    return run(params, input_ids, jax.random.key(seed))
+
+
+def per_token_latency(model, batch_size: int = 1, prompt_len: int = 32, n_tokens: int = 16) -> float:
+    """Measure steady-state per-token decode latency in seconds (the
+    reference's big-model-inference metric, benchmarks README "per-token").
+
+    The prefill forward is excluded: two warm runs differing only in token
+    count are timed and differenced, so the result is the marginal decode
+    step cost, not (prefill + decode) / n.
+    """
+    import time
+
+    jax = _jax()
+    ids = np.ones((batch_size, prompt_len), np.int32)
+
+    def timed(n):
+        out = generate(model, ids, max_new_tokens=n)  # first call compiles
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = generate(model, ids, max_new_tokens=n)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    t_long = timed(2 * n_tokens)
+    t_short = timed(n_tokens)
+    return max(t_long - t_short, 1e-9) / n_tokens

@@ -70,7 +70,7 @@ class GPT2Attention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, decode: bool = False):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         q = nn.Dense(cfg.hidden_size, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
@@ -80,9 +80,14 @@ class GPT2Attention(nn.Module):
         def split(x):
             return x.reshape(*x.shape[:-1], cfg.num_attention_heads, head_dim)
 
-        from ..ops.attention import active_mesh, dot_product_attention
+        if decode:
+            from ..ops.kv_cache import cached_attention
 
-        out = dot_product_attention(split(q), split(k), split(v), causal=True, mesh=active_mesh())
+            out = cached_attention(self, split(q), split(k), split(v), cfg.max_position_embeddings)
+        else:
+            from ..ops.attention import active_mesh, dot_product_attention
+
+            out = dot_product_attention(split(q), split(k), split(v), causal=True, mesh=active_mesh())
         out = out.reshape(*out.shape[:-2], cfg.hidden_size)
         return nn.Dense(cfg.hidden_size, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
 
@@ -102,10 +107,10 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, decode: bool = False):
         cfg = self.config
         hidden = hidden + GPT2Attention(cfg, name="attn")(
-            nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_1", dtype=hidden.dtype)(hidden)
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_1", dtype=hidden.dtype)(hidden), decode
         )
         hidden = hidden + GPT2MLP(cfg, name="mlp")(
             nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_2", dtype=hidden.dtype)(hidden)
@@ -117,21 +122,22 @@ class GPT2Model(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic: bool = True):
+    def __call__(self, input_ids, positions=None, deterministic: bool = True, decode: bool = False):
         cfg = self.config
         wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="wte")
         hidden = wte(input_ids)
-        positions = jnp.arange(input_ids.shape[-1])
+        if positions is None:
+            positions = jnp.arange(input_ids.shape[-1])[None]
         hidden = hidden + nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, name="wpe"
-        )(positions)[None]
+        )(positions)
         from ..parallel.sharding import maybe_shard
 
         hidden = maybe_shard(hidden, ACTIVATION_SPEC)
 
-        block = nn.remat(GPT2Block, prevent_cse=False) if cfg.remat else GPT2Block
+        block = nn.remat(GPT2Block, prevent_cse=False, static_argnums=(2,)) if cfg.remat else GPT2Block
         for i in range(cfg.num_hidden_layers):
-            hidden = block(cfg, name=f"layer_{i}")(hidden)
+            hidden = block(cfg, name=f"layer_{i}")(hidden, decode)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_f", dtype=hidden.dtype)(hidden)
         if cfg.tie_word_embeddings:
             return hidden.astype(jnp.float32) @ wte.embedding.T.astype(jnp.float32)
@@ -144,8 +150,18 @@ def create_gpt2_model(config: Optional[GPT2Config] = None, seed: int = 0, seq_le
     dummy = jnp.zeros((2, seq_len), jnp.int32)
     params = module.init(jax.random.key(seed), dummy)["params"]
 
-    def apply_fn(p, input_ids):
-        return module.apply({"params": p}, input_ids)
+    def apply_fn(p, input_ids, positions=None, decode=False, cache=None):
+        """decode=True threads the KV cache: pass ``cache`` (or None to
+        initialise) and receive ``(logits, new_cache)``."""
+        if decode:
+            variables = {"params": p}
+            if cache is not None:
+                variables["cache"] = cache
+            logits, mutated = module.apply(
+                variables, input_ids, positions, decode=True, mutable=["cache"]
+            )
+            return logits, mutated["cache"]
+        return module.apply({"params": p}, input_ids, positions)
 
     model = Model(apply_fn, params, sharding_rules=GPT2_SHARDING_RULES, name="gpt2")
     model.config = config

@@ -140,7 +140,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions):
+    def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         q = nn.Dense(cfg.num_attention_heads * head_dim, use_bias=False, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
@@ -151,9 +151,19 @@ class LlamaAttention(nn.Module):
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        out = _dispatch_attention(q, k, v, cfg.attention_impl)
+        if decode:
+            out = self._cached_attention(q, k, v)
+        else:
+            out = _dispatch_attention(q, k, v, cfg.attention_impl)
         out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
         return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
+
+    def _cached_attention(self, q, k, v):
+        """KV-cache incremental attention (generation path; shared cache
+        machinery in :mod:`accelerate_tpu.ops.kv_cache`)."""
+        from ..ops.kv_cache import cached_attention
+
+        return cached_attention(self, q, k, v, self.config.max_position_embeddings)
 
 
 class LlamaMLP(nn.Module):
@@ -173,10 +183,10 @@ class LlamaLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions):
+    def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
         hidden = hidden + LlamaAttention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions, decode
         )
         hidden = hidden + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden)
@@ -190,38 +200,39 @@ class _ScanLayer(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions):
-        return LlamaLayer(self.config, name="block")(hidden, positions), None
+    def __call__(self, hidden, positions, decode: bool = False):
+        return LlamaLayer(self.config, name="block")(hidden, positions, decode), None
 
 
 class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, positions=None, decode: bool = False):
         cfg = self.config
         hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
-        positions = jnp.broadcast_to(jnp.arange(input_ids.shape[-1]), input_ids.shape)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[-1]), input_ids.shape)
         # constrain activations onto the mesh (seq axis = Megatron-SP)
         from ..parallel.sharding import maybe_shard
 
         hidden = maybe_shard(hidden, ACTIVATION_SPEC)
 
         if cfg.scan_layers:
-            layer_cls = nn.remat(_ScanLayer, prevent_cse=False) if cfg.remat else _ScanLayer
+            layer_cls = nn.remat(_ScanLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else _ScanLayer
             scanned = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
-                in_axes=nn.broadcast,
+                in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            hidden, _ = scanned(cfg, name="layers")(hidden, positions)
+            hidden, _ = scanned(cfg, name="layers")(hidden, positions, decode)
         else:
-            layer_cls = nn.remat(LlamaLayer, prevent_cse=False) if cfg.remat else LlamaLayer
+            layer_cls = nn.remat(LlamaLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else LlamaLayer
             for i in range(cfg.num_hidden_layers):
-                hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, positions)
+                hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, positions, decode)
         hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
         return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
 
@@ -232,8 +243,16 @@ def create_llama_model(config: Optional[LlamaConfig] = None, seed: int = 0, seq_
     dummy = jnp.zeros((2, seq_len), jnp.int32)
     params = module.init(jax.random.key(seed), dummy)["params"]
 
-    def apply_fn(p, input_ids):
-        return module.apply({"params": p}, input_ids)
+    def apply_fn(p, input_ids, positions=None, decode=False, cache=None):
+        """decode=True threads the KV cache: pass ``cache`` (or None to
+        initialise) and receive ``(logits, new_cache)``."""
+        if decode:
+            variables = {"params": p}
+            if cache is not None:
+                variables["cache"] = cache
+            logits, mutated = module.apply(variables, input_ids, positions, True, mutable=["cache"])
+            return logits, mutated["cache"]
+        return module.apply({"params": p}, input_ids, positions)
 
     model = Model(apply_fn, params, sharding_rules=LLAMA_SHARDING_RULES, name="llama")
     model.config = config

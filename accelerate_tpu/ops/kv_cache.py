@@ -1,0 +1,54 @@
+"""Shared KV-cache incremental attention for the model zoo's decode path.
+
+The cache is a flax ``cache`` collection: fixed-size ``[B, max_len, H_kv,
+D]`` buffers updated in place with ``dynamic_update_slice`` — static
+shapes, so the whole decode loop jits into one XLA program
+(:mod:`accelerate_tpu.generation`). The reference has no in-framework
+decode (it delegates generation to transformers); on TPU the cache layout
+and the single-program loop ARE the per-token latency story.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def cached_attention(module, q, k, v, max_len: int):
+    """Incremental causal attention against a growing cache.
+
+    ``module``: the calling flax module (owns the ``cache`` variables).
+    ``q`` [B, S_new, H, D]; ``k``/``v`` [B, S_new, H_kv, D] (GQA when
+    H_kv < H). Returns [B, S_new, H, D]. Prefill (S_new = prompt) and
+    per-token decode (S_new = 1) share this path.
+    """
+    b, s_new, h_kv, d = k.shape
+    ck = module.variable("cache", "key", jnp.zeros, (b, max_len, h_kv, d), k.dtype)
+    cv = module.variable("cache", "value", jnp.zeros, (b, max_len, h_kv, d), v.dtype)
+    idx = module.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+    cur = idx.value
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+    idx.value = cur + s_new
+
+    k_all, v_all = ck.value, cv.value
+    groups = q.shape[2] // h_kv
+    # causal over absolute positions: new token i attends to <= cur+i
+    key_pos = jnp.arange(max_len)
+    q_pos = cur + jnp.arange(s_new)
+    if groups > 1:
+        # GQA: contract grouped queries against the UN-repeated cache —
+        # materializing jnp.repeat over [B, max_len, H, D] would 4x the
+        # cache's memory traffic on every decode step
+        qg = q.reshape(b, s_new, h_kv, groups, d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) / math.sqrt(d)
+        mask = key_pos[None, None, None, None, :] <= q_pos[None, None, None, :, None]
+        probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_all)
+        return out.reshape(b, s_new, h_kv * groups, d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) / math.sqrt(d)
+    mask = key_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
