@@ -100,6 +100,52 @@ def interactive_config() -> dict:
     return config
 
 
+# legacy key renames, oldest schema first (reference analogue:
+# commands/config/update.py migrating old YAMLs to the current schema)
+_LEGACY_KEY_RENAMES = {
+    "dp": "mesh_data",
+    "fsdp": "mesh_fsdp",
+    "tp": "mesh_tensor",
+    "sp": "mesh_seq",
+    "pp": "mesh_pipe",
+    "ep": "mesh_expert",
+    "precision": "mixed_precision",
+    "hosts": "tpu_hosts",
+}
+
+
+def update_config(path: str) -> dict:
+    """Migrate a config file written by an older version to the current
+    schema (reference: ``accelerate config update``,
+    commands/config/update.py): rename legacy keys, drop unknown ones
+    (reported), and rewrite the file."""
+    # raw read: load_config() filters unknown keys, which would eat the
+    # very legacy names this migration exists to rename
+    with open(path) as f:
+        config = _load_yaml(f.read())
+    migrated = {}
+    dropped = []
+    for raw_key, value in config.items():
+        key = _LEGACY_KEY_RENAMES.get(raw_key, raw_key)
+        if key not in CONFIG_KEYS:
+            dropped.append(raw_key)
+            continue
+        if key != raw_key and key in migrated:
+            # a stale legacy spelling must never clobber a value already
+            # present under the current name
+            dropped.append(raw_key)
+            continue
+        try:
+            migrated[key] = CONFIG_KEYS[key](value) if value is not None else None
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"config key {raw_key!r}: cannot cast {value!r} to {CONFIG_KEYS[key].__name__}") from e
+    with open(path, "w") as f:
+        f.write(_dump_yaml(migrated))
+    if dropped:
+        print(f"dropped keys: {', '.join(sorted(dropped))}")
+    return migrated
+
+
 def config_parser(subparsers=None):
     if subparsers is not None:
         parser = subparsers.add_parser("config", help="Create the default launch config")
@@ -107,12 +153,29 @@ def config_parser(subparsers=None):
         parser = argparse.ArgumentParser("accelerate-tpu config")
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--default", action="store_true", help="write defaults without prompting")
+    parser.add_argument(
+        "--update",
+        action="store_true",
+        help="migrate an existing config file to the current schema instead of creating one",
+    )
     if subparsers is not None:
         parser.set_defaults(func=config_command)
     return parser
 
 
 def config_command(args) -> int:
+    if getattr(args, "update", False):
+        path = args.config_file or default_config_path()
+        if not os.path.isfile(path):
+            print(f"no config file at {path}")
+            return 1
+        try:
+            update_config(path)
+        except ValueError as e:
+            print(f"cannot migrate {path}: {e}")
+            return 1
+        print(f"Configuration at {path} migrated to the current schema")
+        return 0
     if args.default:
         config = {"num_machines": 1, "mixed_precision": "bf16", "mesh_data": -1}
     else:

@@ -175,6 +175,10 @@ class GradientAccumulationPlugin(KwargsHandler):
     sync_with_dataloader: bool = True
     sync_each_batch: bool = False
 
+    def __post_init__(self):
+        if self.num_steps < 1:
+            raise ValueError(f"gradient accumulation num_steps must be >= 1, got {self.num_steps}")
+
 
 @dataclass
 class DataLoaderConfiguration(KwargsHandler):

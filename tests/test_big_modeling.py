@@ -10,6 +10,7 @@ from accelerate_tpu.big_modeling import (
     abstract_params,
     compute_module_sizes,
     dispatch_model,
+    get_balanced_memory,
     infer_auto_device_map,
     init_empty_weights,
     load_checkpoint_and_dispatch,
@@ -139,3 +140,73 @@ def test_load_checkpoint_missing_key_raises(tmp_path):
     save_model(model, str(tmp_path / "export"))
     with pytest.raises(KeyError):
         load_checkpoint_in_model({"a/w": None, "b/missing": None}, str(tmp_path / "export"))
+
+
+# ---------------------------------------------------------------------- #
+# device-map inference edge cases (reference: tests/test_modeling_utils.py,
+# 1067 LoC of infer_auto_device_map/module-size/tied-param math)
+# ---------------------------------------------------------------------- #
+
+
+def _params(sizes: dict):
+    """{'group/leaf': n_float32} -> pytree with those leaf sizes."""
+    tree = {}
+    for path, n in sizes.items():
+        node = tree
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.zeros(n, np.float32)
+    return tree
+
+
+def test_device_map_spill_order_is_device_then_cpu_then_disk():
+    params = _params({"a/w": 100, "b/w": 100, "c/w": 100, "d/w": 100})
+    nbytes = 100 * 4
+    dm = infer_auto_device_map(params, max_memory={0: nbytes, 1: nbytes, "cpu": nbytes}, prefix_depth=1)
+    assert dm == {"a": 0, "b": 1, "c": "cpu", "d": "disk"}
+
+
+def test_device_map_greedy_no_backtracking():
+    """The greedy cursor never returns to an earlier tier — the reference's
+    behavior (utils/modeling.py:1294): a big block can strand space."""
+    params = _params({"a/w": 60, "b/w": 100, "c/w": 30})
+    dm = infer_auto_device_map(params, max_memory={0: 100 * 4, "cpu": 100 * 4}, prefix_depth=1)
+    # b (400B) does not fit dev0's remaining 160B -> cpu (which it fills);
+    # c COULD fit dev0's leftover but the cursor moved on (greedy,
+    # matching the reference) -> disk
+    assert dm["a"] == 0 and dm["b"] == "cpu" and dm["c"] == "disk"
+
+
+def test_device_map_tied_groups_forced_together():
+    params = _params({"embed/w": 100, "mid/w": 100, "head/w": 100})
+    dm = infer_auto_device_map(
+        params,
+        max_memory={0: 150 * 4, "cpu": 1000 * 4},
+        tied_groups=[["embed", "head"]],
+        prefix_depth=1,
+    )
+    assert dm["head"] == dm["embed"]
+
+
+def test_device_map_zero_budget_all_spills():
+    params = _params({"a/w": 10, "b/w": 10})
+    dm = infer_auto_device_map(params, max_memory={0: 0, "cpu": 0}, prefix_depth=1)
+    assert set(dm.values()) == {"disk"}
+
+
+def test_balanced_memory_floors_at_largest_group():
+    params = _params({"embed/w": 1000, "l0/w": 10, "l1/w": 10})
+    budgets = get_balanced_memory(params, num_devices=4, prefix_depth=1)
+    # naive total/4 would be ~1020B; the floor must cover the 4000B embed
+    assert all(v >= 1000 * 4 for v in budgets.values())
+    dm = infer_auto_device_map(params, max_memory=budgets, prefix_depth=1)
+    assert all(isinstance(v, int) for v in dm.values()), dm
+
+
+def test_compute_module_sizes_prefix_depth():
+    params = _params({"enc/l0/w": 4, "enc/l1/w": 4, "dec/l0/w": 4})
+    s1 = compute_module_sizes(params, prefix_depth=1)
+    assert s1 == {"enc": 32, "dec": 16}
+    s2 = compute_module_sizes(params, prefix_depth=2)
+    assert s2 == {"enc/l0": 16, "enc/l1": 16, "dec/l0": 16}

@@ -342,3 +342,38 @@ def test_data_loop_script_multiprocess():
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert result.stdout.count("test_data_loop: ALL OK") >= 1
+
+
+def test_config_update_migrates_legacy_keys(tmp_path):
+    """`config --update` renames legacy keys and drops unknown ones
+    (reference analogue: accelerate config update)."""
+    cfg = tmp_path / "old.yaml"
+    cfg.write_text("dp: 4\nprecision: bf16\nmystery_key: 1\nnum_processes: 2\n")
+    result = run_cli("config", "--update", "--config_file", str(cfg))
+    assert result.returncode == 0, result.stderr
+    from accelerate_tpu.commands.config import load_config
+
+    migrated = load_config(str(cfg))
+    assert migrated == {"mesh_data": 4, "mixed_precision": "bf16", "num_processes": 2}
+    assert "mystery_key" in result.stdout
+
+    # missing file is a clean error
+    result = run_cli("config", "--update", "--config_file", str(tmp_path / "nope.yaml"))
+    assert result.returncode == 1
+
+
+def test_config_update_protects_current_keys_and_bad_casts(tmp_path):
+    cfg = tmp_path / "half.yaml"
+    cfg.write_text("mixed_precision: bf16\nprecision: fp16\n")
+    result = run_cli("config", "--update", "--config_file", str(cfg))
+    assert result.returncode == 0, result.stderr
+    from accelerate_tpu.commands.config import load_config
+
+    # the stale legacy spelling must not clobber the current value
+    assert load_config(str(cfg))["mixed_precision"] == "bf16"
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("dp: auto\n")
+    result = run_cli("config", "--update", "--config_file", str(bad))
+    assert result.returncode == 1
+    assert "cannot migrate" in result.stdout and "Traceback" not in result.stderr

@@ -124,3 +124,11 @@ def test_generate_runner_is_cached(tiny_llama):
     assert len(runners) == n  # same key reused
     generate(tiny_llama, ids, max_new_tokens=4)
     assert len(runners) == n + 1
+
+
+def test_zero_and_negative_max_new_tokens(tiny_llama):
+    ids = np.ones((2, 4), np.int32)
+    out = generate(tiny_llama, ids, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), ids)  # [B, S]: no extra token
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(tiny_llama, ids, max_new_tokens=-1)

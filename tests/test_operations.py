@@ -110,3 +110,96 @@ def test_concatenate_dicts():
 def test_recursively_apply_error_on_other_type():
     with pytest.raises(TypeError):
         recursively_apply(lambda x: x, {"a": object()}, error_on_other_type=True)
+
+
+# ---------------------------------------------------------------------- #
+# expanded op coverage (reference: tests/test_utils.py, 47 tests over the
+# ops surface — slice/concat/pad/init/structure helpers)
+# ---------------------------------------------------------------------- #
+
+
+def test_get_data_structure_and_initialize_roundtrip():
+    from accelerate_tpu.utils.operations import get_data_structure, initialize_tensors
+
+    data = {"a": np.ones((2, 3), np.float32), "b": [np.zeros((4,), np.int32)]}
+    skeleton = get_data_structure(data)
+    rebuilt = initialize_tensors(skeleton)
+    assert rebuilt["a"].shape == (2, 3) and rebuilt["a"].dtype == np.float32
+    assert rebuilt["b"][0].shape == (4,) and rebuilt["b"][0].dtype == np.int32
+
+
+def test_slice_tensors_per_process():
+    from accelerate_tpu.utils.operations import slice_tensors
+
+    data = {"x": np.arange(8).reshape(8, 1)}
+    out = slice_tensors(data, slice(2, 6))
+    np.testing.assert_array_equal(np.asarray(out["x"]).ravel(), [2, 3, 4, 5])
+
+
+def test_concatenate_nested_and_mismatch():
+    a = {"x": np.ones((2, 3)), "y": [np.zeros((2,))]}
+    b = {"x": np.ones((4, 3)), "y": [np.zeros((1,))]}
+    out = concatenate([a, b])
+    assert out["x"].shape == (6, 3) and out["y"][0].shape == (3,)
+
+
+def test_pad_across_processes_dim_and_pad_first():
+    x = jnp.arange(6.0).reshape(2, 3)
+    same = pad_across_processes(x, dim=0)
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(x))  # single process: no-op
+    # out-of-range dim is a no-op, matching the reference's guard
+    assert pad_across_processes(x, dim=5).shape == x.shape
+
+
+def test_pad_input_tensors_uneven_and_exact():
+    x = np.arange(10).reshape(10, 1)
+    padded = pad_input_tensors(x, batch_size=10, num_processes=4)
+    assert padded.shape[0] == 12  # ceil(10/4)*4
+    np.testing.assert_array_equal(np.asarray(padded[:10]), x)
+    exact = pad_input_tensors(x, batch_size=10, num_processes=5)
+    assert exact.shape[0] == 10  # already divisible
+
+
+def test_find_batch_size_priority_and_none():
+    assert find_batch_size({"a": np.ones((7, 2)), "b": np.ones((7,))}) == 7
+    assert find_batch_size([np.ones((3, 2))]) == 3
+    assert find_batch_size({"s": "str"}) is None
+
+
+def test_convert_to_fp32_leaves_ints_alone():
+    out = convert_to_fp32({"f": jnp.ones(2, jnp.bfloat16), "i": jnp.ones(2, jnp.int32)})
+    assert out["f"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32
+
+
+def test_broadcast_object_list_single_process():
+    objs = ["a", {"b": 1}]
+    out = broadcast_object_list(list(objs))
+    assert out == objs
+
+
+def test_reduce_sum_and_scale(mesh8):
+    AcceleratorState()
+    sharding = NamedSharding(AcceleratorState().mesh, P(("data",)))
+    x = jax.device_put(jnp.ones(8), sharding)
+    out = reduce(x, "sum", scale=0.5)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 0.5))
+
+
+def test_gather_preserves_structure(mesh8):
+    AcceleratorState()
+    sharding = NamedSharding(AcceleratorState().mesh, P(("data",)))
+    tree = {"a": jax.device_put(jnp.arange(8.0), sharding), "n": [jax.device_put(jnp.ones((8, 2)), sharding)]}
+    out = gather(tree)
+    assert set(out.keys()) == {"a", "n"}
+    assert np.asarray(out["n"][0]).shape == (8, 2)
+
+
+def test_recursively_apply_namedtuple():
+    import collections
+
+    Point = collections.namedtuple("Point", ["x", "y"])
+    p = Point(np.ones(2), np.zeros(3))
+    out = recursively_apply(lambda t: t + 1, p)
+    assert isinstance(out, Point)
+    np.testing.assert_array_equal(np.asarray(out.x), np.full(2, 2.0))
