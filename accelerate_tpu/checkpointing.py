@@ -70,14 +70,30 @@ def _save_pytree(tree, path: Path):
         ckptr.save(path.absolute(), tree, force=True)
 
 
-def _load_pytree(path: Path, like):
-    """Restore with the target's shardings/dtypes (reshard-on-load)."""
+def _load_pytree(path: Path, like, mesh=None):
+    """Restore with the target's shardings/dtypes (reshard-on-load).
+
+    Leaves without a ``NamedSharding`` (host numpy, or jit outputs committed
+    to a single device before any mesh-wide step ran) restore as replicated
+    over ``mesh`` — otherwise a resume that loads state before the first
+    step mixes device-0-committed and mesh-committed arguments in one jit
+    call, which jax rejects."""
     import orbax.checkpoint as ocp
     import jax
 
+    replicated = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(mesh, PartitionSpec())
+
     def to_abstract(x):
-        if hasattr(x, "sharding"):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        sharding = getattr(x, "sharding", None)
+        if not isinstance(sharding, jax.sharding.NamedSharding):
+            sharding = replicated
+        if hasattr(x, "shape") and sharding is not None:
+            dtype = x.dtype if hasattr(x, "dtype") else np.asarray(x).dtype
+            return jax.ShapeDtypeStruct(np.shape(x), dtype, sharding=sharding)
         if hasattr(x, "shape"):
             return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
         return x
@@ -172,16 +188,17 @@ def load_accelerator_state(accelerator, input_dir: str, **kwargs):
     for hook in accelerator._load_model_hooks:
         hook(accelerator._models, str(inp))
 
+    mesh = getattr(accelerator, "mesh", None)
     for i, model in enumerate(accelerator._models):
         path = inp / (f"{MODEL_NAME}_{i}" if i > 0 else MODEL_NAME)
-        model.params = _load_pytree(path, model.params)
+        model.params = _load_pytree(path, model.params, mesh=mesh)
         state_path = inp / f"{MODEL_NAME}_state_{i}"
         if state_path.exists() and getattr(model, "state", None) is not None:
-            model.state = _load_pytree(state_path, model.state)
+            model.state = _load_pytree(state_path, model.state, mesh=mesh)
     for i, opt in enumerate(accelerator._optimizers):
         path = inp / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
         if path.exists() and opt.opt_state is not None:
-            opt.opt_state = _load_pytree(path, opt.opt_state)
+            opt.opt_state = _load_pytree(path, opt.opt_state, mesh=mesh)
     for i, sched in enumerate(accelerator._schedulers):
         path = inp / f"{SCHEDULER_NAME}_{i}.json"
         if path.exists():

@@ -80,7 +80,10 @@ def save_config(config: dict, path: str | None = None) -> str:
 
 
 def interactive_config() -> dict:
-    """Compact questionnaire (reference: commands/config/cluster.py)."""
+    """Compact questionnaire (reference: commands/config/cluster.py; choice
+    questions go through the cursor menu, commands/menu.py)."""
+    from .menu import select
+
     config = {}
 
     def ask(key, prompt, default, caster=str):
@@ -88,7 +91,9 @@ def interactive_config() -> dict:
         config[key] = caster(raw) if raw else default
 
     ask("num_machines", "How many machines (pod hosts)?", 1, int)
-    ask("mixed_precision", "Mixed precision (no/bf16/fp16/fp8)?", "bf16")
+    config["mixed_precision"] = select(
+        "Mixed precision?", ["no", "bf16", "fp16", "fp8"], default="bf16"
+    )
     ask("mesh_data", "Data-parallel mesh axis size (-1 = all remaining)", -1, int)
     ask("mesh_fsdp", "FSDP mesh axis size", 1, int)
     ask("mesh_tensor", "Tensor-parallel mesh axis size", 1, int)
@@ -125,6 +130,7 @@ def update_config(path: str) -> dict:
         config = _load_yaml(f.read())
     migrated = {}
     dropped = []
+    legacy_source = {}  # current key -> the legacy spelling that filled it
     for raw_key, value in config.items():
         key = _LEGACY_KEY_RENAMES.get(raw_key, raw_key)
         if key not in CONFIG_KEYS:
@@ -135,10 +141,16 @@ def update_config(path: str) -> dict:
             # present under the current name
             dropped.append(raw_key)
             continue
+        if key == raw_key and key in legacy_source:
+            # current name wins over an earlier legacy spelling — report the
+            # legacy key as dropped regardless of file order
+            dropped.append(legacy_source.pop(key))
         try:
             migrated[key] = CONFIG_KEYS[key](value) if value is not None else None
         except (TypeError, ValueError) as e:
             raise ValueError(f"config key {raw_key!r}: cannot cast {value!r} to {CONFIG_KEYS[key].__name__}") from e
+        if key != raw_key:
+            legacy_source[key] = raw_key
     with open(path, "w") as f:
         f.write(_dump_yaml(migrated))
     if dropped:

@@ -131,16 +131,26 @@ def per_token_latency(model, batch_size: int = 1, prompt_len: int = 32, n_tokens
     """
     import time
 
-    jax = _jax()
     ids = np.ones((batch_size, prompt_len), np.int32)
 
+    def sync(out):
+        # value fetch, not block_until_ready: remote-attached backends (the
+        # axon tunnel) return from block_until_ready before execution
+        # finishes, which would time dispatch instead of decode. The last
+        # token depends on the full decode chain, so fetching it is a true
+        # barrier.
+        int(out[0, -1])
+
     def timed(n):
-        out = generate(model, ids, max_new_tokens=n)  # first call compiles
-        jax.block_until_ready(out)
         t0 = time.perf_counter()
         out = generate(model, ids, max_new_tokens=n)
-        jax.block_until_ready(out)
+        sync(out)
         return time.perf_counter() - t0
+
+    # compile/warm each token count once; the jitted runner is cached on
+    # the model, so the repeated pairs below time pure execution
+    for n in (2 * n_tokens, n_tokens):
+        sync(generate(model, ids, max_new_tokens=n))
 
     # median of repeated pairs: host jitter on tiny models can exceed the
     # marginal decode cost of a single pair

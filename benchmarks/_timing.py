@@ -1,0 +1,22 @@
+"""Shared timing helper: force device-side completion with a value fetch.
+
+``jax.block_until_ready`` is advisory on some remote-attached backends (the
+axon tunnel used in CI returns immediately), which silently turns timing
+loops into dispatch-overhead measurements. Fetching one element D2H cannot
+complete before the producing computation has, so it is the reliable sync
+point — and one scalar keeps the transfer cost negligible.
+"""
+
+from __future__ import annotations
+
+
+def force(x) -> None:
+    """Block until ``x`` (any pytree of jax arrays) has finished computing."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "ndim"):
+            idx = (0,) * leaf.ndim
+            np.asarray(jax.device_get(leaf[idx]))
+        break  # one leaf suffices: same program produced the whole tree

@@ -1,0 +1,118 @@
+"""Compile-time benchmark: scan-over-layers vs unrolled layer stack.
+
+Reference analogue: ``benchmarks/torch.compile`` (regional compilation —
+compile one repeated block, reuse it N times, 5-9x faster compile at equal
+inference speed). The TPU-native equivalent is ``lax.scan`` over stacked
+layer weights (models/llama.py scan_layers=True): XLA traces and compiles
+the block ONCE regardless of depth, where the unrolled stack re-lowers
+every layer.
+
+Prints one JSON line per (mode, config): compile seconds + steady-state
+forward latency, so the table shows compile-time savings AND that inference
+speed is not sacrificed — the same two columns the reference publishes.
+
+Usage: python benchmarks/compile_time.py [--small]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import time
+
+
+def bench_one(name: str, cfg, batch: int, seq: int):
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.models import create_llama_model
+
+    model = create_llama_model(cfg, seq_len=seq)
+    ids = np.ones((batch, seq), np.int32)
+
+    from _timing import force
+
+    fwd = jax.jit(lambda p, x: model.apply_fn(p, x))
+    t0 = time.perf_counter()
+    force(fwd(model.params, ids))
+    compile_s = time.perf_counter() - t0
+
+    for _ in range(3):
+        out = fwd(model.params, ids)
+    force(out)
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fwd(model.params, ids)
+    force(out)
+    latency_ms = (time.perf_counter() - t0) / n * 1000
+
+    print(
+        json.dumps(
+            {
+                "bench": "compile_time",
+                "mode": name,
+                "layers": cfg.num_hidden_layers,
+                "hidden": cfg.hidden_size,
+                "batch_x_seq": f"{batch}x{seq}",
+                "compile_s": round(compile_s, 2),
+                "forward_ms": round(latency_ms, 2),
+            }
+        ),
+        flush=True,
+    )
+    return compile_s, latency_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true", help="CPU smoke mode")
+    args = ap.parse_args()
+
+    from accelerate_tpu.models import LlamaConfig
+
+    if args.small:
+        sizes = [dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=8, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=128)]
+        batch, seq = 1, 64
+    else:
+        # deep-and-narrow: depth is what separates per-layer lowering
+        # (unrolled) from compile-once (scan); batch*seq large enough that
+        # the forward is compute-, not dispatch-, dominated
+        sizes = [dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                      num_hidden_layers=48, num_attention_heads=16,
+                      num_key_value_heads=4, max_position_embeddings=1024)]
+        batch, seq = 8, 512
+
+    # absorb the one-time backend/dispatch warmup so it doesn't land on
+    # whichever mode happens to compile first
+    import jax
+
+    from _timing import force
+
+    force(jax.jit(lambda x: x * 2)(jax.numpy.ones((8, 8))))
+
+    for size in sizes:
+        scan_c, scan_l = bench_one("scan (regional analogue)", LlamaConfig(scan_layers=True, remat=False, **size), batch, seq)
+        unroll_c, unroll_l = bench_one("unrolled (full-compile analogue)", LlamaConfig(scan_layers=False, remat=False, **size), batch, seq)
+        print(
+            json.dumps(
+                {
+                    "bench": "compile_time",
+                    "mode": "summary",
+                    "compile_speedup": round(unroll_c / scan_c, 2) if scan_c else None,
+                    "latency_ratio_scan_vs_unrolled": round(scan_l / unroll_l, 3) if unroll_l else None,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Long-context attention benchmark: Pallas flash kernel vs XLA einsum.
+
+No reference analogue exists — HF Accelerate has no attention kernels and
+no long-context story beyond the Megatron SP flag (SURVEY §5); this
+benchmark documents the parity-PLUS capability: O(S) memory causal flash
+attention (ops/pallas_attention.py) against the O(S^2) XLA softmax chain,
+fwd+bwd (training shape), across sequence lengths.
+
+Usage: python benchmarks/long_context.py [--small]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import time
+
+
+def bench_attention(seq: int, impl: str, batch: int, heads: int, head_dim: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import dot_product_attention
+
+    q = jax.random.normal(jax.random.key(0), (batch, seq, heads, head_dim), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (batch, seq, heads, head_dim), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (batch, seq, heads, head_dim), jnp.bfloat16)
+
+    use_flash = impl == "flash"
+
+    def loss(q, k, v):
+        if use_flash and interpret:
+            from accelerate_tpu.ops.attention import sharded_pallas_attention
+
+            out = sharded_pallas_attention(q, k, v, causal=True, interpret=True)
+        else:
+            out = dot_product_attention(q, k, v, causal=True, use_flash=use_flash)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    from _timing import force
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    t0 = time.perf_counter()
+    force(step(q, k, v))
+    compile_s = time.perf_counter() - t0
+    for _ in range(2):
+        out = step(q, k, v)
+    force(out)
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = step(q, k, v)
+    force(out)
+    ms = (time.perf_counter() - t0) / n * 1000
+    return compile_s, ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true", help="CPU smoke mode (interpret-mode Pallas)")
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.small:
+        seqs, batch, heads, head_dim = [256], 1, 2, 64
+    else:
+        seqs, batch, heads, head_dim = [2048, 4096, 8192], 4, 16, 64
+
+    for seq in seqs:
+        row = {"bench": "long_context_attention_fwd_bwd", "seq": seq, "batch": batch, "heads": heads}
+        try:
+            _, xla_ms = bench_attention(seq, "xla", batch, heads, head_dim, interpret=False)
+            row["xla_ms"] = round(xla_ms, 2)
+        except Exception as e:  # very long seqs can OOM the quadratic path — that IS the result
+            row["xla_ms"] = None
+            row["xla_error"] = f"{type(e).__name__}"
+        _, flash_ms = bench_attention(seq, "flash", batch, heads, head_dim, interpret=not on_tpu)
+        row["flash_ms"] = round(flash_ms, 2)
+        if row.get("xla_ms"):
+            row["flash_speedup"] = round(row["xla_ms"] / flash_ms, 2)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

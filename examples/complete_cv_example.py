@@ -1,0 +1,124 @@
+"""Complete CV example: ResNet classification with tracking, epoch/step
+checkpointing (including BatchNorm running statistics), resume, and
+gradient clipping.
+
+Reference analogue: examples/complete_cv_example.py (the kitchen-sink
+variant of cv_example.py: ``--checkpointing_steps``,
+``--resume_from_checkpoint``, ``--with_tracking``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import ResNetConfig, create_resnet_model, resnet_classification_loss
+
+from cv_example import SyntheticPets  # noqa: E402 — sibling script, same dataset
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mixed_precision", default="bf16")
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--num_epochs", type=int, default=2)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--max_grad_norm", type=float, default=1.0)
+    p.add_argument("--output_dir", default="complete_cv_out")
+    p.add_argument("--checkpointing_steps", default=None, help='"epoch", an int interval, or omitted')
+    p.add_argument("--resume_from_checkpoint", default=None)
+    p.add_argument("--with_tracking", action="store_true")
+    p.add_argument("--tiny", action="store_true", help="tiny config for CI")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="jsonl" if args.with_tracking else None,
+        project_dir=args.output_dir,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+
+    if args.tiny:
+        args.image_size = min(args.image_size, 32)
+    config = ResNetConfig.tiny() if args.tiny else ResNetConfig.resnet50(num_classes=37)
+    dataset = SyntheticPets(n=256 if args.tiny else 1024, image_size=args.image_size, num_classes=config.num_classes)
+
+    loader = accelerator.prepare_data_loader(
+        dataset,
+        batch_size=max(1, args.batch_size // accelerator.num_data_shards),
+        shuffle=True,
+        seed=42,
+        drop_last=True,
+    )
+    model = create_resnet_model(config, image_size=args.image_size)
+    total_steps = max(1, args.num_epochs * len(loader))
+    peak_lr = args.lr if args.lr is not None else (1e-1 if args.tiny else 3e-2)
+    schedule = optax.cosine_onecycle_schedule(total_steps, peak_lr, pct_start=0.25)
+    optimizer = optax.sgd(schedule, momentum=0.9)
+
+    model, optimizer = accelerator.prepare(model, optimizer)
+    accelerator.clip_grad_norm_(None, args.max_grad_norm)
+    step = accelerator.build_train_step(
+        lambda p, s, b: resnet_classification_loss(p, s, b, model.apply_fn), has_state=True
+    )
+    eval_step = accelerator.build_eval_step(lambda p, s, x: model.apply_fn(p, x, state=s, train=False))
+
+    start_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        start_epoch = loader.state_dict().get("sampler_epoch") or 0
+        accelerator.print(f"resumed from {args.resume_from_checkpoint} at epoch {start_epoch}")
+
+    ckpt_every = None
+    if args.checkpointing_steps and args.checkpointing_steps != "epoch":
+        ckpt_every = int(args.checkpointing_steps)
+
+    global_step = accelerator.step  # restored by load_state on resume
+    accuracy = 0.0
+    for epoch in range(start_epoch, args.num_epochs):
+        loader.set_epoch(epoch)
+        total_loss = 0.0
+        loss = None
+        for batch in loader:
+            loss = step(batch)
+            global_step += 1
+            if args.with_tracking:
+                total_loss += float(loss)
+            if ckpt_every and global_step % ckpt_every == 0:
+                accelerator.save_state(os.path.join(args.output_dir, f"step_{global_step}"))
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(os.path.join(args.output_dir, f"epoch_{epoch}"))
+
+        correct = total = 0
+        for batch in loader:
+            logits = eval_step(batch["images"])
+            preds = accelerator.gather_for_metrics(jnp.argmax(logits, -1))
+            labels = accelerator.gather_for_metrics(batch["labels"])
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        accuracy = correct / total
+        loss_str = f"{float(loss):.4f}" if loss is not None else "n/a (no train batches after resume skip)"
+        accelerator.print(f"epoch {epoch}: accuracy={accuracy:.3f} loss={loss_str}")
+        if args.with_tracking:
+            accelerator.log(
+                {"accuracy": accuracy, "train_loss": total_loss / max(1, len(loader)), "epoch": epoch},
+                step=global_step,
+            )
+
+    accelerator.save_state(os.path.join(args.output_dir, "final"))
+    accelerator.end_training()
+    return accuracy
+
+
+if __name__ == "__main__":
+    main()

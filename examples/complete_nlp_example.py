@@ -1,0 +1,123 @@
+"""Complete NLP example: every production knob in one training script —
+tracking, step/epoch checkpointing, exact mid-epoch resume, gradient
+clipping, LR schedule, metrics gather.
+
+Reference analogue: examples/complete_nlp_example.py (the "kitchen sink"
+variant of nlp_example.py whose CLI contract —
+``--checkpointing_steps epoch|N``, ``--resume_from_checkpoint``,
+``--with_tracking`` — the by_feature scripts each demonstrate in
+isolation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import BertConfig, bert_classification_loss, create_bert_model
+
+from nlp_example import SyntheticMRPC  # noqa: E402 — sibling script, same dataset
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mixed_precision", default="bf16")
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--num_epochs", type=int, default=2)
+    p.add_argument("--seq_len", type=int, default=64)
+    p.add_argument("--max_grad_norm", type=float, default=1.0)
+    p.add_argument("--output_dir", default="complete_nlp_out")
+    p.add_argument(
+        "--checkpointing_steps",
+        default=None,
+        help='"epoch", an integer step interval, or omitted for no mid-run checkpoints',
+    )
+    p.add_argument("--resume_from_checkpoint", default=None)
+    p.add_argument("--with_tracking", action="store_true")
+    p.add_argument("--tiny", action="store_true", help="tiny config for CI")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="jsonl" if args.with_tracking else None,
+        project_dir=args.output_dir,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config=vars(args))
+
+    config = BertConfig.tiny(num_labels=2) if args.tiny else BertConfig.base()
+    dataset = SyntheticMRPC(n=256 if args.tiny else 3668, seq_len=args.seq_len, vocab_size=config.vocab_size)
+    model = create_bert_model(config, seq_len=args.seq_len)
+    steps_per_epoch = max(1, len(dataset) // args.batch_size)
+    schedule = optax.linear_schedule(args.lr, 0.0, args.num_epochs * steps_per_epoch)
+    optimizer = optax.adamw(schedule, weight_decay=0.01)
+
+    loader = accelerator.prepare_data_loader(
+        dataset,
+        batch_size=max(1, args.batch_size // accelerator.num_data_shards),
+        shuffle=True,
+        seed=42,
+    )
+    model, optimizer = accelerator.prepare(model, optimizer)
+    accelerator.clip_grad_norm_(None, args.max_grad_norm)  # traced into the step
+    step = accelerator.build_train_step(lambda p, b: bert_classification_loss(p, b, model.apply_fn))
+    eval_step = accelerator.build_eval_step(lambda p, ids, mask: model.apply_fn(p, ids, mask))
+
+    start_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        # the dataloader's own state (batches_yielded / sampler epoch) is in
+        # the checkpoint, so iteration resumes mid-epoch exactly
+        start_epoch = loader.state_dict().get("sampler_epoch") or 0
+        accelerator.print(f"resumed from {args.resume_from_checkpoint} at epoch {start_epoch}")
+
+    ckpt_every = None
+    if args.checkpointing_steps and args.checkpointing_steps != "epoch":
+        ckpt_every = int(args.checkpointing_steps)
+
+    global_step = accelerator.step  # restored by load_state on resume
+    for epoch in range(start_epoch, args.num_epochs):
+        loader.set_epoch(epoch)
+        total_loss = 0.0
+        loss = None
+        for batch in loader:
+            loss = step(batch)
+            global_step += 1
+            if args.with_tracking:
+                total_loss += float(loss)
+            if ckpt_every and global_step % ckpt_every == 0:
+                accelerator.save_state(os.path.join(args.output_dir, f"step_{global_step}"))
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(os.path.join(args.output_dir, f"epoch_{epoch}"))
+
+        correct = total = 0
+        for batch in loader:
+            logits = eval_step(batch["input_ids"], batch["attention_mask"])
+            preds = accelerator.gather_for_metrics(jnp.argmax(logits, -1))
+            labels = accelerator.gather_for_metrics(batch["labels"])
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += len(np.asarray(labels))
+        accuracy = correct / total
+        loss_str = f"{float(loss):.4f}" if loss is not None else "n/a (no train batches after resume skip)"
+        accelerator.print(f"epoch {epoch}: accuracy={accuracy:.3f} loss={loss_str}")
+        if args.with_tracking:
+            accelerator.log(
+                {"accuracy": accuracy, "train_loss": total_loss / max(1, len(loader)), "epoch": epoch},
+                step=global_step,
+            )
+
+    accelerator.save_state(os.path.join(args.output_dir, "final"))
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

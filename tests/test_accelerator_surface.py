@@ -1,0 +1,134 @@
+"""API-surface tests for Accelerator methods the core suites don't reach:
+free_memory, autocast override, join_uneven_inputs, unwrap_model,
+register_for_checkpointing validation, save/load-state pre-hooks.
+
+Reference analogue: tests/test_accelerator.py (861 LoC) — the prepare
+idempotency / free_memory / hook-registration sections.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, linear_loss_fn
+from accelerate_tpu.utils.dataclasses import AutocastKwargs
+
+
+@pytest.fixture
+def acc():
+    return Accelerator()
+
+
+def test_free_memory_clears_prepared_objects(acc):
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.1))
+    acc.prepare_data_loader(RegressionDataset(length=8))
+    acc.step = 7
+    leftover = acc.free_memory(model)
+    assert acc._models == [] and acc._optimizers == [] and acc._schedulers == []
+    assert acc._dataloaders == [] and acc._jit_cache == {} and acc.step == 0
+    assert leftover == [None]  # release_memory nulls what it is handed
+
+
+def test_clear_aliases_free_memory(acc):
+    acc.prepare_model(RegressionModel())
+    acc.clear()
+    assert acc._models == []
+
+
+def test_autocast_context_overrides_policy():
+    acc = Accelerator(mixed_precision="bf16")
+    x = {"w": jnp.ones(3, jnp.float32)}
+    assert acc.cast_to_compute(x)["w"].dtype == jnp.bfloat16
+    with acc.autocast(AutocastKwargs(enabled=False)):
+        assert acc.cast_to_compute(x)["w"].dtype == jnp.float32
+    # restored on exit
+    assert acc.cast_to_compute(x)["w"].dtype == jnp.bfloat16
+
+
+def test_autocast_keep_fp32_patterns():
+    acc = Accelerator(mixed_precision="bf16")
+    tree = {"layernorm_scale": jnp.ones(2), "dense_kernel": jnp.ones(2)}
+    with acc.autocast(AutocastKwargs(keep_fp32_patterns=("layernorm",))):
+        out = acc.cast_to_compute(tree)
+    assert out["layernorm_scale"].dtype == jnp.float32
+    assert out["dense_kernel"].dtype == jnp.bfloat16
+
+
+def test_join_uneven_inputs_overrides_even_batches(acc):
+    dl = acc.prepare_data_loader(RegressionDataset(length=10), even_batches=True)
+    with acc.join_uneven_inputs([None], even_batches=False):
+        assert dl.even_batches is False
+    assert dl.even_batches is True
+
+
+def test_unwrap_model_identity(acc):
+    model = acc.prepare_model(RegressionModel())
+    assert acc.unwrap_model(model) is model
+
+
+def test_register_for_checkpointing_rejects_stateless(acc):
+    class NoState:
+        pass
+
+    with pytest.raises(ValueError, match="state_dict"):
+        acc.register_for_checkpointing(NoState())
+
+
+def test_save_load_state_pre_hooks_fire_and_remove(acc, tmp_path):
+    acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.1))
+    events = []
+    h1 = acc.register_save_state_pre_hook(lambda models, weights, out_dir: events.append(("save", out_dir)))
+    h2 = acc.register_load_state_pre_hook(lambda models, in_dir: events.append(("load", in_dir)))
+    out = str(tmp_path / "ckpt")
+    acc.save_state(out)
+    acc.load_state(out)
+    assert [e[0] for e in events] == ["save", "load"]
+    assert all(isinstance(e[1], str) for e in events)
+
+    h1.remove()
+    h2.remove()
+    events.clear()
+    acc.save_state(out)
+    acc.load_state(out)
+    assert events == []
+
+
+def test_no_sync_blocks_apply_until_exit(acc):
+    model = acc.prepare_model(RegressionModel())
+    opt = acc.prepare_optimizer(optax.sgd(0.5))
+    batch = {"x": np.ones((4, 1), np.float32), "y": np.ones((4, 1), np.float32) * 5}
+    before = float(model.params["a"])
+    with acc.no_sync():
+        acc.backward(linear_loss_fn, batch)
+        opt.step()
+        assert float(model.params["a"]) == before, "no_sync must suppress the apply"
+    acc.backward(linear_loss_fn, batch)
+    opt.step()
+    assert float(model.params["a"]) != before
+
+
+def test_skip_first_batches_applies_to_next_iteration_only(acc):
+    dl = acc.prepare_data_loader(RegressionDataset(length=32))
+    dl.batch_size = max(1, 4 // acc.num_data_shards)  # global batch 4 on any mesh
+    full = [np.asarray(b["x"]) for b in dl]
+    assert len(full) == 32 // dl.total_batch_size
+    skipped = acc.skip_first_batches(dl, 2)
+    assert skipped is dl  # in-place marker, same loader object
+    part = [np.asarray(b["x"]) for b in dl]
+    assert len(part) == len(full) - 2
+    np.testing.assert_array_equal(part[0], full[2])
+    # the skip is consumed: the following epoch is complete again
+    assert len([b for b in dl]) == len(full)
+
+
+def test_prepare_varargs_roundtrip(acc):
+    model, opt, dl = acc.prepare(RegressionModel(), optax.sgd(0.1), RegressionDataset(length=8))
+    assert model in acc._models
+    assert opt in acc._optimizers
+    assert dl in acc._dataloaders

@@ -239,3 +239,36 @@ def test_model_state_roundtrip(tmp_path):
     restored = jax.tree_util.tree_map(np.asarray, model.state)
     for a, b in zip(jax.tree_util.tree_leaves(trained_stats), jax.tree_util.tree_leaves(restored)):
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_load_state_before_first_step_commits_to_mesh(tmp_path):
+    """Resume regression: a fresh process that builds the train step and
+    calls load_state BEFORE stepping must not end up with params committed
+    to the mesh but optimizer state committed to device 0 (jax rejects the
+    mixed-device jit call)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.test_utils import RegressionModel, linear_loss_fn
+
+    batch = {"x": np.ones((8,), np.float32), "y": np.ones((8,), np.float32)}
+    acc = Accelerator()
+    acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.adamw(1e-2))
+    step = acc.build_train_step(linear_loss_fn)
+    step(batch)
+    ck = str(tmp_path / "ck")
+    acc.save_state(ck)
+    saved_a = float(acc._models[-1].params["a"])
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2 = Accelerator()
+    model2 = acc2.prepare_model(RegressionModel())
+    acc2.prepare_optimizer(optax.adamw(1e-2))
+    step2 = acc2.build_train_step(linear_loss_fn)
+    acc2.load_state(ck)  # before any step2() call
+    assert float(model2.params["a"]) == saved_a
+    step2(batch)  # must not raise "incompatible devices"

@@ -377,3 +377,18 @@ def test_config_update_protects_current_keys_and_bad_casts(tmp_path):
     result = run_cli("config", "--update", "--config_file", str(bad))
     assert result.returncode == 1
     assert "cannot migrate" in result.stdout and "Traceback" not in result.stderr
+
+
+def test_config_update_reports_dropped_legacy_regardless_of_order(tmp_path):
+    """When both the legacy and current spelling are present, the current
+    value wins AND the legacy key is reported dropped in either file
+    order."""
+    from accelerate_tpu.commands.config import load_config
+
+    for text in ("precision: fp16\nmixed_precision: bf16\n", "mixed_precision: bf16\nprecision: fp16\n"):
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text(text)
+        result = run_cli("config", "--update", "--config_file", str(cfg))
+        assert result.returncode == 0, result.stderr
+        assert load_config(str(cfg))["mixed_precision"] == "bf16"
+        assert "precision" in result.stdout and "dropped" in result.stdout, (text, result.stdout)

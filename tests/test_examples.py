@@ -55,3 +55,33 @@ def test_root_example_runs_tiny(example):
         timeout=420,
     )
     assert result.returncode == 0, f"{example} failed:\n{result.stdout}\n{result.stderr}"
+
+
+@pytest.mark.parametrize("example", ["complete_nlp_example.py", "complete_cv_example.py"])
+def test_complete_example_checkpoint_and_resume(example, tmp_path):
+    """Kitchen-sink examples (reference: examples/complete_*_example.py):
+    train with tracking + epoch checkpointing, then resume from the epoch-0
+    checkpoint and finish."""
+    out = tmp_path / "out"
+    common = ["--tiny", "--num_epochs", "2", "--with_tracking", "--output_dir", str(out)]
+    run = subprocess.run(
+        [sys.executable, example, *common, "--checkpointing_steps", "epoch"],
+        cwd=EXAMPLES_DIR.parent,
+        env=ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert run.returncode == 0, f"{example} failed:\n{run.stdout}\n{run.stderr}"
+    assert (out / "epoch_0").is_dir() and (out / "final").is_dir()
+
+    resume = subprocess.run(
+        [sys.executable, example, *common, "--resume_from_checkpoint", str(out / "epoch_0")],
+        cwd=EXAMPLES_DIR.parent,
+        env=ENV,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert resume.returncode == 0, f"{example} resume failed:\n{resume.stdout}\n{resume.stderr}"
+    assert "resumed from" in resume.stdout

@@ -1,0 +1,74 @@
+"""Cursor-menu widget (commands/menu.py; reference: commands/menu/)."""
+
+import io
+
+import pytest
+
+from accelerate_tpu.commands import menu
+
+
+def test_fallback_select_default():
+    idx = menu._fallback_select("pick", ["a", "b", "c"], 1, input_fn=lambda _: "")
+    assert idx == 1
+
+
+def test_fallback_select_number():
+    idx = menu._fallback_select("pick", ["a", "b", "c"], 0, input_fn=lambda _: "2")
+    assert idx == 2
+
+
+def test_fallback_select_prefix_match():
+    idx = menu._fallback_select("pick", ["no", "bf16", "fp16", "fp8"], 0, input_fn=lambda _: "b")
+    assert idx == 1
+
+
+def test_fallback_select_ambiguous_prefix_raises():
+    with pytest.raises(ValueError, match="invalid choice"):
+        menu._fallback_select("pick", ["fp16", "fp8"], 0, input_fn=lambda _: "fp")
+
+
+def test_fallback_select_out_of_range_raises():
+    with pytest.raises(ValueError, match="out of range"):
+        menu._fallback_select("pick", ["a", "b"], 0, input_fn=lambda _: "7")
+
+
+def test_select_non_tty_uses_fallback(monkeypatch, capsys):
+    monkeypatch.setattr("sys.stdin", io.StringIO("1\n"))
+    assert menu.select("pick", ["x", "y"]) == "y"
+    out = capsys.readouterr().out
+    assert "[0] x" in out and "[1] y" in out
+
+
+def test_interactive_select_arrow_keys(monkeypatch, capsys):
+    keys = iter(["down", "down", "up", "enter"])  # 0 -> 1 -> 2 -> 1 -> pick
+    monkeypatch.setattr(menu, "_read_key", lambda stdin=None: next(keys))
+    assert menu._interactive_select("pick", ["a", "b", "c"], 0) == 1
+
+
+def test_interactive_select_wraps_and_digit_jump(monkeypatch):
+    keys = iter(["up", "enter"])  # wraps 0 -> 2
+    monkeypatch.setattr(menu, "_read_key", lambda stdin=None: next(keys))
+    assert menu._interactive_select("pick", ["a", "b", "c"], 0) == 2
+    keys = iter(["2", "enter"])
+    monkeypatch.setattr(menu, "_read_key", lambda stdin=None: next(keys))
+    assert menu._interactive_select("pick", ["a", "b", "c"], 0) == 2
+
+
+def test_interactive_select_vim_keys_and_interrupt(monkeypatch):
+    keys = iter(["j", "j", "k", "enter"])
+    monkeypatch.setattr(menu, "_read_key", lambda stdin=None: next(keys))
+    assert menu._interactive_select("pick", ["a", "b", "c"], 0) == 1
+    keys = iter(["interrupt"])
+    monkeypatch.setattr(menu, "_read_key", lambda stdin=None: next(keys))
+    with pytest.raises(KeyboardInterrupt):
+        menu._interactive_select("pick", ["a", "b"], 0)
+
+
+def test_escape_sequence_keymap():
+    assert menu._ESCAPE_SEQUENCES["[A"] == "up"
+    assert menu._ESCAPE_SEQUENCES["[B"] == "down"
+
+
+def test_select_empty_choices_raises():
+    with pytest.raises(ValueError):
+        menu.select("pick", [])

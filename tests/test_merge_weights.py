@@ -1,0 +1,64 @@
+"""merge-weights CLI: sharded orbax checkpoint -> standalone safetensors.
+
+Reference analogue: test_utils/scripts/test_merge_weights.py (FSDP DCP
+shards merged offline via ``accelerate merge-weights``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.commands.merge import merge_command, merge_parser
+from accelerate_tpu.test_utils import RegressionModel
+
+
+def _flat_safetensors(directory):
+    from pathlib import Path
+
+    from safetensors.numpy import load_file
+
+    out = {}
+    for f in sorted(Path(directory).glob("*.safetensors")):
+        out.update(load_file(str(f)))
+    return out
+
+
+def test_merge_weights_roundtrip(tmp_path):
+    acc = Accelerator()
+    model = acc.prepare_model(RegressionModel(a=1.5, b=-2.0))
+    acc.prepare_optimizer(optax.sgd(0.1))
+    ckpt = tmp_path / "ckpt"
+    acc.save_state(str(ckpt))
+
+    out = tmp_path / "merged"
+    args = argparse.Namespace(checkpoint_dir=str(ckpt), output_dir=str(out), max_shard_size="10GB")
+    assert merge_command(args) == 0
+
+    tensors = _flat_safetensors(out)
+    assert tensors, "merge produced no safetensors"
+    by_suffix = {k.split("/")[-1]: v for k, v in tensors.items()}
+    np.testing.assert_allclose(by_suffix["a"], 1.5)
+    np.testing.assert_allclose(by_suffix["b"], -2.0)
+
+
+def test_merge_weights_missing_checkpoint_raises(tmp_path):
+    args = argparse.Namespace(checkpoint_dir=str(tmp_path), output_dir=str(tmp_path / "o"), max_shard_size="10GB")
+    with pytest.raises(FileNotFoundError):
+        merge_command(args)
+
+
+def test_merge_parser_standalone_and_subcommand():
+    p = merge_parser()
+    ns = p.parse_args(["ckpt", "out"])
+    assert ns.checkpoint_dir == "ckpt" and ns.output_dir == "out" and ns.max_shard_size == "10GB"
+
+    root = argparse.ArgumentParser()
+    sub = root.add_subparsers()
+    merge_parser(sub)
+    ns = root.parse_args(["merge-weights", "a", "b", "--max_shard_size", "1GB"])
+    assert ns.func is merge_command and ns.max_shard_size == "1GB"
