@@ -1,0 +1,133 @@
+"""Per-strategy accuracy regression gates.
+
+Reference analogue: test_utils/scripts/external_deps/test_performance.py
+(298 LoC — trains MRPC under each strategy and asserts minimum
+accuracy/F1 so a strategy that silently corrupts training fails CI, not
+just crashes). Here every reference "strategy" is a mesh layout, so the
+gate trains the same model/data under each layout and asserts the same
+accuracy floor — plus cross-layout agreement, which the reference cannot
+check (different backends) but one sharding engine can.
+
+Self-checking: exits nonzero on failure. Run via
+``python -m accelerate_tpu.test_utils.scripts.test_performance`` on the
+8-device fake mesh or through ``accelerate-tpu launch``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ACCURACY_FLOOR = 0.95  # planted-signal task: every healthy layout hits 1.0 with the warmup schedule
+CROSS_LAYOUT_TOLERANCE = 0.08  # layouts see different batch shards; small drift allowed
+
+
+def make_dataset(n=256, seq_len=32, vocab_size=256, seed=0):
+    """Binary classification with a planted signal token (the shape of
+    examples/nlp_example.py's SyntheticMRPC)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, vocab_size, size=(n, seq_len)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    ids[labels == 1, 3] = 4
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {
+                "input_ids": ids[i],
+                "attention_mask": np.ones((seq_len,), np.bool_),
+                "labels": labels[i],
+            }
+
+    return DS()
+
+
+def run_layout(name: str, mesh_kwargs: dict, epochs: int = 14, precision: str = "bf16", loss_trace: int = 0):
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import BertConfig, bert_classification_loss, create_bert_model
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import set_seed
+    from accelerate_tpu.utils.dataclasses import MeshConfig, ParallelismPlugin
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    set_seed(42)
+
+    acc = Accelerator(
+        mixed_precision=precision,
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(**mesh_kwargs)),
+    )
+    cfg = BertConfig.tiny(num_labels=2)
+    dataset = make_dataset(vocab_size=cfg.vocab_size)
+    model = acc.prepare_model(create_bert_model(cfg, seq_len=32))
+    acc.prepare_optimizer(optax.adamw(optax.linear_schedule(0.0, 1.5e-3, 8)))
+    loader = acc.prepare_data_loader(dataset, batch_size=max(1, 32 // acc.num_data_shards), shuffle=True, seed=7)
+    step = acc.build_train_step(lambda p, b: bert_classification_loss(p, b, model.apply_fn))
+    eval_step = acc.build_eval_step(lambda p, ids, mask: model.apply_fn(p, ids, mask))
+
+    losses = []
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            loss = step(batch)
+            if loss_trace and len(losses) < loss_trace:
+                losses.append(float(loss))
+    if loss_trace:
+        return losses
+
+    correct = total = 0
+    for batch in loader:
+        logits = eval_step(batch["input_ids"], batch["attention_mask"])
+        preds = acc.gather_for_metrics(jnp.argmax(logits, -1))
+        labels = acc.gather_for_metrics(batch["labels"])
+        correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+        total += len(np.asarray(labels))
+    accuracy = correct / total
+    acc.print(f"test_performance [{name}] accuracy={accuracy:.3f} mesh={dict(acc.mesh.shape)}")
+    return accuracy
+
+
+def main():
+    import jax
+
+    n_dev = len(jax.devices())
+    layouts = {"dp": {"data": -1}}
+    if n_dev >= 8:
+        layouts["fsdp"] = {"fsdp": 8}
+        layouts["dp_x_tp"] = {"data": 4, "tensor": 2}
+        layouts["hybrid_dp_fsdp_tp"] = {"data": 2, "fsdp": 2, "tensor": 2}
+    elif n_dev >= 2:
+        layouts["fsdp"] = {"fsdp": n_dev}
+
+    scores = {}
+    for name, mesh_kwargs in layouts.items():
+        scores[name] = run_layout(name, mesh_kwargs)
+
+    # The stronger invariant only one sharding engine can promise: in fp32
+    # every layout computes the SAME global-batch math, so short loss
+    # trajectories must agree bitwise-closely across layouts (bf16 is
+    # excluded: reduction order legitimately perturbs rounding).
+    traces = {
+        name: run_layout(name, mesh_kwargs, epochs=2, precision="no", loss_trace=8)
+        for name, mesh_kwargs in layouts.items()
+    }
+    base = traces.pop("dp")
+    for name, trace in traces.items():
+        np.testing.assert_allclose(trace, base, rtol=1e-5, err_msg=f"fp32 trajectory of {name} diverged from dp")
+
+    failures = [f"{k}: {v:.3f} < {ACCURACY_FLOOR}" for k, v in scores.items() if v < ACCURACY_FLOOR]
+    assert not failures, f"accuracy regression: {failures}"
+    spread = max(scores.values()) - min(scores.values())
+    assert spread <= CROSS_LAYOUT_TOLERANCE, (
+        f"layouts disagree beyond tolerance: {scores} (spread {spread:.3f})"
+    )
+    print(f"test_performance: ALL OK {scores}")
+
+
+if __name__ == "__main__":
+    main()

@@ -392,3 +392,15 @@ def test_config_update_reports_dropped_legacy_regardless_of_order(tmp_path):
         assert result.returncode == 0, result.stderr
         assert load_config(str(cfg))["mixed_precision"] == "bf16"
         assert "precision" in result.stdout and "dropped" in result.stdout, (text, result.stdout)
+
+
+def test_performance_gate_script():
+    """Accuracy-floor regression gates per mesh layout (reference analogue:
+    external_deps/test_performance.py MRPC thresholds per strategy)."""
+    result = run_cli(
+        "launch", "--cpu", "--fake_devices", "8", "-m",
+        "accelerate_tpu.test_utils.scripts.test_performance",
+        timeout=900,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "test_performance: ALL OK" in result.stdout
