@@ -466,6 +466,21 @@ class Accelerator:
     # the jitted train step (fast path)
     # ------------------------------------------------------------------ #
 
+    def _matmul_precision_ctx(self):
+        """``mixed_precision="no"`` must mean REAL fp32: JAX's DEFAULT
+        matmul precision decomposes fp32 operands into bf16 passes (TPU
+        MXU and oneDNN CPU alike), which silently injects ~1e-3 relative
+        error into every matmul. Tracing the jitted step inside this
+        context pins fp32-mode matmuls to full precision; bf16/fp16
+        policies keep the fast default. (The reference's fp32 is torch
+        fp32 — true fp32 — so this is a parity requirement, not a
+        preference.)"""
+        import contextlib
+
+        if self.mixed_precision == "no":
+            return _jax().default_matmul_precision("highest")
+        return contextlib.nullcontext()
+
     def _compute_cast(self, params):
         """fp32 master -> compute dtype, keeping norm-like params in fp32
         (the autocast policy; reference: accelerator.py:1590-1601)."""
@@ -502,9 +517,15 @@ class Accelerator:
         model = model or self._models[-1]
         compute_cast = self._compute_cast
         jitted = jax.jit(lambda p, *args, **kwargs: eval_fn(compute_cast(p), *args, **kwargs))
-        if getattr(model, "state", None) is not None:
-            return lambda *args, **kwargs: jitted(model.params, model.state, *args, **kwargs)
-        return lambda *args, **kwargs: jitted(model.params, *args, **kwargs)
+        ctx = self._matmul_precision_ctx
+
+        def run(*args, **kwargs):
+            with ctx():
+                if getattr(model, "state", None) is not None:
+                    return jitted(model.params, model.state, *args, **kwargs)
+                return jitted(model.params, *args, **kwargs)
+
+        return run
 
     def build_train_step(
         self,
@@ -654,17 +675,18 @@ class Accelerator:
             self.gradient_state._set_sync_gradients(do_sync)
             from .utils.random import key_for_step
 
-            new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux = jitted(
-                model.params,
-                optimizer.opt_state,
-                state_box["grad_buf"],
-                getattr(model, "state", None) if has_state else None,
-                batch,
-                jnp.float32(self._loss_scale),
-                jnp.bool_(do_sync),
-                key_for_step(self.step),
-                jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
-            )
+            with self._matmul_precision_ctx():
+                new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux = jitted(
+                    model.params,
+                    optimizer.opt_state,
+                    state_box["grad_buf"],
+                    getattr(model, "state", None) if has_state else None,
+                    batch,
+                    jnp.float32(self._loss_scale),
+                    jnp.bool_(do_sync),
+                    key_for_step(self.step),
+                    jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
+                )
             model.params = new_params
             if has_state:
                 model.state = new_state
@@ -822,9 +844,10 @@ class Accelerator:
             self._grad_buffers[id(model)] = jax.jit(
                 lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p)
             )(model.params)
-        self._grad_buffers[id(model)], loss = entry[1](
-            model.params, self._grad_buffers[id(model)], batch, jnp.float32(self._loss_scale)
-        )
+        with self._matmul_precision_ctx():
+            self._grad_buffers[id(model)], loss = entry[1](
+                model.params, self._grad_buffers[id(model)], batch, jnp.float32(self._loss_scale)
+            )
         self._grad_count += 1
         return loss
 
@@ -868,12 +891,13 @@ class Accelerator:
                 ),
                 donate_argnums=(0, 1, 2),
             )
-        new_params, new_opt, zero_buf, gnorm, finite = self._jit_cache[cache_key](
-            model.params,
-            opt.opt_state,
-            grad_buffer,
-            _jnp().float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
-        )
+        with self._matmul_precision_ctx():
+            new_params, new_opt, zero_buf, gnorm, finite = self._jit_cache[cache_key](
+                model.params,
+                opt.opt_state,
+                grad_buffer,
+                _jnp().float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
+            )
         model.params = new_params
         opt.opt_state = new_opt
         self._grad_buffers[id(model)] = zero_buf
@@ -1026,9 +1050,18 @@ class Accelerator:
         return _RemovableHandle(self._load_model_hooks, hook)
 
     def save_state(self, output_dir: Optional[str] = None, **save_model_func_kwargs):
+        """``async_save=True`` returns once device->host copies finish;
+        disk writes continue in the background (drained by
+        :meth:`wait_for_checkpoint` or the next save/load)."""
         from .checkpointing import save_accelerator_state
 
         return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+
+    def wait_for_checkpoint(self):
+        """Block until pending ``save_state(async_save=True)`` writes commit."""
+        from .checkpointing import wait_for_checkpoint
+
+        wait_for_checkpoint()
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
         from .checkpointing import load_accelerator_state

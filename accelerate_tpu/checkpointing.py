@@ -63,9 +63,44 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def _save_pytree(tree, path: Path):
+# in-flight async checkpointers; drained by wait_for_checkpoint() and
+# before any subsequent save/load touches the same process
+_PENDING_ASYNC: list = []
+
+
+def wait_for_checkpoint():
+    """Block until every async ``save_state(..., async_save=True)`` has
+    committed to disk (the orbax analogue of torch.distributed.checkpoint's
+    async_save future; the reference has no async checkpoint path). Safe to
+    call when nothing is pending."""
+    global _PENDING_ASYNC
+    pending, _PENDING_ASYNC = _PENDING_ASYNC, []
+    # drain every checkpointer even if one raises (a lost entry would let a
+    # later save/load touch a checkpoint still being written); the first
+    # error propagates after the sweep
+    first_error = None
+    for ckptr in pending:
+        try:
+            ckptr.wait_until_finished()
+            ckptr.close()
+        except Exception as e:  # noqa: PERF203
+            if first_error is None:
+                first_error = e
+    if first_error is not None:
+        raise first_error
+
+
+def _save_pytree(tree, path: Path, async_save: bool = False):
     import orbax.checkpoint as ocp
 
+    if async_save:
+        # one AsyncCheckpointer per pytree: device->host copies happen now
+        # (so training can step on donated buffers immediately), disk IO
+        # proceeds on a background thread until wait_for_checkpoint()
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        ckptr.save(path.absolute(), args=ocp.args.StandardSave(tree), force=True)
+        _PENDING_ASYNC.append(ckptr)
+        return
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path.absolute(), tree, force=True)
 
@@ -103,9 +138,17 @@ def _load_pytree(path: Path, like, mesh=None):
         return ckptr.restore(path.absolute(), abstract)
 
 
-def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True):
+def save_accelerator_state(
+    accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True, async_save: bool = False
+):
     """(reference: Accelerator.save_state accelerator.py:3308 +
-    checkpointing.save_accelerator_state :61)."""
+    checkpointing.save_accelerator_state :61).
+
+    ``async_save=True`` returns once device->host copies are done; array
+    writes continue on background threads (call
+    :func:`wait_for_checkpoint` or let the next save/load drain them).
+    The reference has no async path — this is the orbax-native upgrade."""
+    wait_for_checkpoint()  # a previous async save must fully commit first
     project = accelerator.project_configuration
     if project.automatic_checkpoint_naming:
         base = os.path.join(accelerator.project_dir or ".", "checkpoints")
@@ -131,15 +174,15 @@ def save_accelerator_state(accelerator, output_dir: Optional[str] = None, safe_s
 
     # models + optimizers: sharded orbax saves (every host participates)
     for i, model in enumerate(accelerator._models):
-        _save_pytree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME)
+        _save_pytree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME, async_save)
         # non-trainable mutable collections (BatchNorm running stats —
         # build_train_step(has_state=True)); torch carries these as module
         # buffers inside the state_dict, here they are a separate pytree
         if getattr(model, "state", None) is not None:
-            _save_pytree(model.state, out / f"{MODEL_NAME}_state_{i}")
+            _save_pytree(model.state, out / f"{MODEL_NAME}_state_{i}", async_save)
     for i, opt in enumerate(accelerator._optimizers):
         if opt.opt_state is not None:
-            _save_pytree(opt.opt_state, out / f"{OPTIMIZER_NAME}_{i}" if i > 0 else out / OPTIMIZER_NAME)
+            _save_pytree(opt.opt_state, out / f"{OPTIMIZER_NAME}_{i}" if i > 0 else out / OPTIMIZER_NAME, async_save)
 
     if accelerator.is_main_process:
         for i, sched in enumerate(accelerator._schedulers):
@@ -181,6 +224,7 @@ def load_accelerator_state(accelerator, input_dir: str, **kwargs):
     checkpointing.load_accelerator_state :179). Restores onto the *current*
     shardings — loading a checkpoint saved on a different mesh reshards
     transparently (reference needs FULL_STATE_DICT / merge tooling)."""
+    wait_for_checkpoint()  # never read past a checkpoint still being written
     inp = Path(input_dir)
     if not inp.is_dir():
         raise FileNotFoundError(f"checkpoint directory {input_dir} not found")

@@ -151,10 +151,34 @@ _LLAMA_LAYER = {
 }
 
 
-def convert_hf_llama_state(state: dict[str, np.ndarray], scan_layers: bool = True) -> dict:
+def _rope_interleave_permute(kernel: np.ndarray, head_dim: int) -> np.ndarray:
+    """Re-pair a q/k projection kernel from HF's half-split (``rotate_half``)
+    rope convention to this zoo's interleaved convention.
+
+    HF rotates dim pairs ``(j, j + D/2)``; our :func:`models.llama.rope`
+    rotates ``(2j, 2j + 1)`` — importing HF weights without re-pairing
+    silently rotates the WRONG coordinate pairs and attention logits
+    drift (the same class of bug as HF's own Meta->HF ``permute`` in
+    convert_llama_weights_to_hf.py). ``kernel`` is flax-layout
+    ``[in, heads * head_dim]``."""
+    in_dim, out_dim = kernel.shape
+    heads = out_dim // head_dim
+    k = kernel.reshape(in_dim, heads, head_dim)
+    half = head_dim // 2
+    perm = np.empty(head_dim, dtype=np.int64)
+    perm[0::2] = np.arange(half)        # new 2j   <- old j        (first half)
+    perm[1::2] = np.arange(half) + half  # new 2j+1 <- old j + D/2  (second half)
+    return k[:, :, perm].reshape(in_dim, out_dim)
+
+
+def convert_hf_llama_state(
+    state: dict[str, np.ndarray], scan_layers: bool, num_heads: int, num_kv_heads: int
+) -> dict:
     """HF ``*ForCausalLM`` Llama -> our param pytree. With ``scan_layers``
     the per-layer weights are stacked along a leading layer dim to match
-    the scanned module layout (``layers/block/...``)."""
+    the scanned module layout (``layers/block/...``). q/k kernels are
+    re-paired for the interleaved rope convention (see
+    :func:`_rope_interleave_permute`)."""
     tree: dict = {}
     for hf_key, (ours, transpose) in _LLAMA_FIXED.items():
         if hf_key in state:
@@ -173,7 +197,12 @@ def convert_hf_llama_state(state: dict[str, np.ndarray], scan_layers: bool = Tru
         idx, rest = int(m.group(1)), m.group(2)
         if rest in _LLAMA_LAYER:
             ours, transpose = _LLAMA_LAYER[rest]
-            per_layer.setdefault(idx, {})[ours] = value.T if transpose else value
+            converted = value.T if transpose else value
+            if rest == "self_attn.q_proj.weight":
+                converted = _rope_interleave_permute(converted, converted.shape[1] // num_heads)
+            elif rest == "self_attn.k_proj.weight":
+                converted = _rope_interleave_permute(converted, converted.shape[1] // num_kv_heads)
+            per_layer.setdefault(idx, {})[ours] = converted
     if not per_layer:
         return tree
     n_layers = max(per_layer) + 1
@@ -196,7 +225,12 @@ def load_hf_llama(checkpoint_path: str, config=None):
 
     state = read_safetensors_state(checkpoint_path)
     config = config or LlamaConfig.llama2_7b()
-    tree = convert_hf_llama_state(state, scan_layers=config.scan_layers)
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+    )
     model = create_llama_model(config)
     _merge_into(model, tree)
     return model

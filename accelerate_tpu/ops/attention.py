@@ -142,8 +142,13 @@ def _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng):
         k = jnp.repeat(k, reps, axis=-2)
         v = jnp.repeat(v, reps, axis=-2)
 
-    # [B,S,H,D] -> [B,H,Sq,Sk]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # [B,S,H,D] -> [B,H,Sq,Sk]. precision="highest": JAX's DEFAULT matmul
+    # precision decomposes fp32 operands to bf16 passes (on TPU MXU and on
+    # the oneDNN CPU backend), injecting ~1e-3 relative error into the
+    # logits — enough to break fp32 parity with reference implementations.
+    # bf16 operands are a single MXU pass either way, so the bf16 training
+    # path is not slowed.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, precision="highest") * scale
     logits = logits.astype(jnp.float32)
     if causal:
         offset = k.shape[1] - seq_len  # bottom-right alignment
@@ -158,4 +163,4 @@ def _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng):
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
         weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
     weights = weights.astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v, precision="highest")

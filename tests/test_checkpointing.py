@@ -272,3 +272,50 @@ def test_load_state_before_first_step_commits_to_mesh(tmp_path):
     acc2.load_state(ck)  # before any step2() call
     assert float(model2.params["a"]) == saved_a
     step2(batch)  # must not raise "incompatible devices"
+
+
+def test_async_save_state_roundtrip(tmp_path):
+    """async_save returns before disk IO completes; wait_for_checkpoint
+    commits, and the checkpoint restores exactly (parity-plus: the
+    reference has no async checkpoint path)."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModel, linear_loss_fn
+
+    batch = {"x": np.ones((8,), np.float32), "y": 2 * np.ones((8,), np.float32)}
+    acc = Accelerator()
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.1))
+    step = acc.build_train_step(linear_loss_fn)
+    step(batch)
+    saved_a = float(model.params["a"])
+
+    ck = str(tmp_path / "ck")
+    acc.save_state(ck, async_save=True)
+    # training continues while the write is in flight
+    step(batch)
+    assert float(model.params["a"]) != saved_a
+    acc.wait_for_checkpoint()
+
+    acc.load_state(ck)
+    assert float(model.params["a"]) == saved_a
+    step(batch)  # restored state still steps
+
+
+def test_async_save_drained_by_next_load(tmp_path):
+    """load_state must drain an in-flight async save rather than read a
+    half-written checkpoint."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionModel, linear_loss_fn
+
+    acc = Accelerator()
+    model = acc.prepare_model(RegressionModel(a=3.25))
+    acc.prepare_optimizer(optax.sgd(0.1))
+    acc.build_train_step(linear_loss_fn)
+    ck = str(tmp_path / "ck")
+    acc.save_state(ck, async_save=True)
+    acc.load_state(ck)  # no explicit wait: load drains the pending save
+    assert float(model.params["a"]) == 3.25

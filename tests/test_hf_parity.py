@@ -1,0 +1,169 @@
+"""Numerical parity of HF checkpoint importers against transformers.
+
+The round-1 advisor caught T5 position-bias divergence ("imported
+checkpoints silently produce wrong outputs"); these tests make that class
+of bug impossible to ship for any family: build a tiny random HF model,
+save safetensors, import with models/hub.py, and compare fp32 logits
+element-wise. Tracing under ``jax.default_matmul_precision("highest")``
+removes JAX's bf16-decomposed fp32 matmuls from the comparison (the
+framework applies the same policy when ``mixed_precision="no"``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+TOL = 2e-4  # fp32 elementwise tolerance across frameworks
+
+
+def _save(model, tmp_path):
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return str(tmp_path)
+
+
+def test_llama_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.models.hub import load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, scan_layers=False, remat=False,
+    )
+    model = load_hf_llama(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_llama_import_scan_layers_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.models.hub import load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32, rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 64, (1, 8))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=32, rms_norm_eps=1e-6, scan_layers=True, remat=False,
+    )
+    model = load_hf_llama(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_gpt2_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import GPT2Config
+    from accelerate_tpu.models.hub import load_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = GPT2Config(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+    )
+    model = load_hf_gpt2(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_bert_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import BertConfig
+    from accelerate_tpu.models.hub import load_hf_bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.BertForSequenceClassification(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 12))
+    mask = torch.ones_like(ids)
+    with torch.no_grad():
+        want = hf(ids, attention_mask=mask).logits.numpy()
+
+    cfg = BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64, num_labels=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    model = load_hf_bert(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(
+            model.apply_fn(model.params, ids.numpy().astype(np.int32), mask.numpy().astype(bool))
+        )
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_t5_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import T5Config
+    from accelerate_tpu.models.hub import load_hf_t5
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=96, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8, dropout_rate=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    enc = torch.randint(0, 96, (1, 10))
+    dec = torch.randint(0, 96, (1, 6))
+    with torch.no_grad():
+        want = hf(input_ids=enc, decoder_input_ids=dec).logits.numpy()
+
+    cfg = T5Config(
+        vocab_size=96, hidden_size=64, head_dim=16, intermediate_size=128,
+        num_layers=2, num_attention_heads=4, relative_attention_num_buckets=8,
+    )
+    model = load_hf_t5(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(
+            model.apply_fn(model.params, enc.numpy().astype(np.int32), dec.numpy().astype(np.int32))
+        )
+    np.testing.assert_allclose(got, want, atol=TOL)

@@ -231,12 +231,26 @@ def test_hf_llama_weight_import_scan_stacking(tmp_path):
             p + "input_layernorm.weight": np.ones(h, np.float32),
             p + "post_attention_layernorm.weight": np.ones(h, np.float32),
         })
-    tree = convert_hf_llama_state(state, scan_layers=True)
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=True,
+        num_heads=cfg.num_attention_heads,
+        num_kv_heads=cfg.num_key_value_heads,
+    )
     # stacked with leading layer dim, transposed
     assert tree["layers"]["block"]["attn"]["q_proj"]["kernel"].shape == (cfg.num_hidden_layers, h, h)
+    # v is untouched; q/k are re-paired for the interleaved rope convention
+    from accelerate_tpu.models.hub import _rope_interleave_permute
+
+    np.testing.assert_allclose(
+        tree["layers"]["block"]["attn"]["v_proj"]["kernel"][1],
+        state["model.layers.1.self_attn.v_proj.weight"].T,
+    )
     np.testing.assert_allclose(
         tree["layers"]["block"]["attn"]["q_proj"]["kernel"][1],
-        state["model.layers.1.self_attn.q_proj.weight"].T,
+        _rope_interleave_permute(
+            state["model.layers.1.self_attn.q_proj.weight"].T, h // cfg.num_attention_heads
+        ),
     )
     # tied lm_head fallback
     np.testing.assert_allclose(tree["lm_head"]["kernel"], state["model.embed_tokens.weight"].T)
