@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/t5/llama/mixtral/resnet) + HF safetensors weight import.
+(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit) + HF safetensors weight import.
 The reference delegates models to transformers; here they ship in-tree
 (SURVEY hard-part #3: torch-free model story)."""
 
@@ -9,6 +9,12 @@ from .bert import (
     BertForSequenceClassification,
     bert_classification_loss,
     create_bert_model,
+)
+from .gptneox import (
+    GPTNEOX_SHARDING_RULES,
+    GPTNeoXConfig,
+    GPTNeoXModel,
+    create_gptneox_model,
 )
 from .gpt2 import (
     GPT2_SHARDING_RULES,
@@ -50,4 +56,12 @@ from .vit import (
     ViTConfig,
     create_vit_model,
     vit_classification_loss,
+)
+from .hub import (  # noqa: E402 — HF safetensors importers
+    load_hf_bert,
+    load_hf_gpt2,
+    load_hf_gptneox,
+    load_hf_llama,
+    load_hf_t5,
+    read_safetensors_state,
 )

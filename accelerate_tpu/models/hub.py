@@ -371,6 +371,103 @@ def load_hf_t5(checkpoint_path: str, config=None):
     return model
 
 
+# --------------------------------------------------------------------- #
+# GPT-NeoX
+# --------------------------------------------------------------------- #
+
+_NEOX_LAYER = {
+    "input_layernorm.weight": ("input_norm/scale", False),
+    "input_layernorm.bias": ("input_norm/bias", False),
+    "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
+    "post_attention_layernorm.bias": ("post_attn_norm/bias", False),
+    "attention.dense.weight": ("attn/o_proj/kernel", True),
+    "attention.dense.bias": ("attn/o_proj/bias", False),
+    "mlp.dense_h_to_4h.weight": ("mlp/fc_in/kernel", True),
+    "mlp.dense_h_to_4h.bias": ("mlp/fc_in/bias", False),
+    "mlp.dense_4h_to_h.weight": ("mlp/fc_out/kernel", True),
+    "mlp.dense_4h_to_h.bias": ("mlp/fc_out/bias", False),
+}
+
+
+def _partial_rope_interleave_permute(kernel: np.ndarray, head_dim: int, rotary_dims: int) -> np.ndarray:
+    """:func:`_rope_interleave_permute` restricted to the first
+    ``rotary_dims`` of each head (GPT-NeoX ``rotary_pct``); the unrotated
+    tail keeps its order."""
+    if rotary_dims >= head_dim:
+        return _rope_interleave_permute(kernel, head_dim)
+    in_dim, out_dim = kernel.shape
+    heads = out_dim // head_dim
+    k = kernel.reshape(in_dim, heads, head_dim)
+    half = rotary_dims // 2
+    perm = np.arange(head_dim)
+    perm[0:rotary_dims:2] = np.arange(half)
+    perm[1:rotary_dims:2] = np.arange(half) + half
+    return k[:, :, perm].reshape(in_dim, out_dim)
+
+
+def convert_hf_gptneox_state(state: dict[str, np.ndarray], num_heads: int, rotary_pct: float) -> dict:
+    """HF ``GPTNeoXForCausalLM`` -> our param pytree. The fused
+    ``attention.query_key_value`` [3*hidden, hidden] is head-major
+    ([heads, 3, head_dim] on the out dim) and splits into q/k/v; q/k are
+    re-paired for the interleaved rope convention on the rotary prefix."""
+    state = _strip_prefix(state, ("gpt_neox.",))
+    tree: dict = {}
+    if "embed_in.weight" in state:
+        _set(tree, "embed_in/embedding", state["embed_in.weight"])
+    if "embed_out.weight" in state:
+        _set(tree, "embed_out/kernel", state["embed_out.weight"].T)
+    if "final_layer_norm.weight" in state:
+        _set(tree, "final_norm/scale", state["final_layer_norm.weight"])
+    if "final_layer_norm.bias" in state:
+        _set(tree, "final_norm/bias", state["final_layer_norm.bias"])
+
+    layer_re = re.compile(r"layers\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = layer_re.match(key)
+        if not m:
+            continue
+        idx, rest = int(m.group(1)), m.group(2)
+        prefix = f"layer_{idx}"
+        if rest in _NEOX_LAYER:
+            name, transpose = _NEOX_LAYER[rest]
+            _set(tree, f"{prefix}/{name}", value.T if transpose else value)
+        elif rest == "attention.query_key_value.weight":
+            hidden = value.shape[1]
+            head_dim = hidden // num_heads
+            rotary_dims = int(head_dim * rotary_pct)
+            # [3H, hidden] out-dim layout is [heads, 3, head_dim]
+            w = value.reshape(num_heads, 3, head_dim, hidden)
+            for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+                kernel = w[:, j].reshape(hidden, hidden).T  # -> [in, out]
+                if proj in ("q_proj", "k_proj"):
+                    kernel = _partial_rope_interleave_permute(kernel, head_dim, rotary_dims)
+                _set(tree, f"{prefix}/attn/{proj}/kernel", kernel)
+        elif rest == "attention.query_key_value.bias":
+            hidden = value.shape[0] // 3
+            head_dim = hidden // num_heads
+            rotary_dims = int(head_dim * rotary_pct)
+            b = value.reshape(num_heads, 3, head_dim)
+            for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+                bias = b[:, j].reshape(hidden)
+                if proj in ("q_proj", "k_proj"):
+                    bias = _partial_rope_interleave_permute(bias[None], head_dim, rotary_dims)[0]
+                _set(tree, f"{prefix}/attn/{proj}/bias", bias)
+    return tree
+
+
+def load_hf_gptneox(checkpoint_path: str, config=None):
+    from .gptneox import GPTNeoXConfig, create_gptneox_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or GPTNeoXConfig.neox_20b()
+    tree = convert_hf_gptneox_state(
+        state, num_heads=config.num_attention_heads, rotary_pct=config.rotary_pct
+    )
+    model = create_gptneox_model(config)
+    _merge_into(model, tree)
+    return model
+
+
 def _merge_into(model, tree: dict):
     """Replace model params with imported values (shape-checked; values not
     present keep their initialisation)."""

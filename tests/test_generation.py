@@ -132,3 +132,18 @@ def test_zero_and_negative_max_new_tokens(tiny_llama):
     np.testing.assert_array_equal(np.asarray(out), ids)  # [B, S]: no extra token
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(tiny_llama, ids, max_new_tokens=-1)
+
+
+def test_gptneox_greedy_matches_full_prefix():
+    """GPT-NeoX cached decode (partial rotary + parallel residual) equals
+    full-prefix argmax token-exactly."""
+    from accelerate_tpu.models import GPTNeoXConfig, create_gptneox_model
+
+    model = create_gptneox_model(GPTNeoXConfig.tiny(), seq_len=16)
+    ids = (np.arange(2 * 8).reshape(2, 8) % 256).astype(np.int32)
+    out = np.asarray(generate(model, ids, max_new_tokens=5))
+    full = ids
+    for _ in range(5):
+        logits = np.asarray(model(full))
+        full = np.concatenate([full, logits[:, -1].argmax(-1).astype(np.int32)[:, None]], 1)
+    np.testing.assert_array_equal(out, full)

@@ -167,3 +167,63 @@ def test_t5_import_matches_transformers(tmp_path):
             model.apply_fn(model.params, enc.numpy().astype(np.int32), dec.numpy().astype(np.int32))
         )
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_gptneox_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import GPTNeoXConfig
+    from accelerate_tpu.models.hub import load_hf_gptneox
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, rotary_pct=0.25,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        use_parallel_residual=True, layer_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64, rotary_pct=0.25,
+    )
+    model = load_hf_gptneox(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_gptneox_import_non_parallel_residual(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import GPTNeoXConfig
+    from accelerate_tpu.models.hub import load_hf_gptneox
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, rotary_pct=1.0,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        use_parallel_residual=False, tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 64, (1, 8))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = GPTNeoXConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, rotary_pct=1.0, use_parallel_residual=False,
+    )
+    model = load_hf_gptneox(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)

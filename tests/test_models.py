@@ -395,3 +395,17 @@ def test_vit_tp_rules_apply():
     assert q.sharding.spec == P(None, "tensor")
     up = model.params["block_0"]["mlp/up"]["kernel"]
     assert up.sharding.spec == P(None, "tensor")
+
+
+def test_gptneox_tp_sharding_applies():
+    """NeoX TP rules put attention/MLP kernels on the tensor axis."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import GPTNeoXConfig, create_gptneox_model
+    from accelerate_tpu.utils.dataclasses import MeshConfig, ParallelismPlugin
+
+    acc = Accelerator(parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(data=4, tensor=2)))
+    model = acc.prepare_model(create_gptneox_model(GPTNeoXConfig.tiny(), seq_len=8))
+    spec = model.param_shardings["layer_0"]["attn"]["q_proj"]["kernel"].spec
+    assert "tensor" in str(spec), spec
+    out = model(np.zeros((2, 8), np.int32))
+    assert out.shape == (2, 8, 256)
