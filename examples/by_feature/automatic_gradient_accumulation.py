@@ -1,0 +1,44 @@
+"""Automatic gradient accumulation (reference analogue:
+examples/by_feature/automatic_gradient_accumulation.py — combine
+`find_executable_batch_size` with gradient accumulation so the OBSERVED
+batch size stays constant when OOM forces the per-step batch down).
+"""
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.utils import find_executable_batch_size
+
+from _common import final_weights, make_task
+
+OBSERVED_BATCH_SIZE = 64
+
+
+def main():
+    accelerator = Accelerator()
+
+    @find_executable_batch_size(starting_batch_size=OBSERVED_BATCH_SIZE)
+    def train(batch_size):
+        accelerator.free_memory()
+        # keep the effective batch constant: what doesn't fit in one step
+        # is accumulated over OBSERVED/batch_size micro-steps
+        accelerator.gradient_accumulation_steps = OBSERVED_BATCH_SIZE // batch_size
+        if batch_size > 16:
+            raise RuntimeError(f"RESOURCE_EXHAUSTED: pretend OOM at batch {batch_size}")
+        model, optimizer, dataloader, loss_fn = make_task(accelerator, batch_size=batch_size, lr=0.4)
+        step = accelerator.build_train_step(loss_fn)
+        for epoch in range(24):
+            dataloader.set_epoch(epoch)
+            for batch in dataloader:
+                step(batch)
+        return batch_size, final_weights(model)
+
+    batch_size, (a, b) = train()
+    accum = accelerator.gradient_accumulation_steps
+    accelerator.print(
+        f"fits at batch_size={batch_size} x accum={accum} (observed {batch_size * accum}): a={a:.3f} b={b:.3f}"
+    )
+    assert batch_size == 16 and accum == 4
+    assert abs(a - 2.0) < 0.4 and abs(b - 3.0) < 0.4
+
+
+if __name__ == "__main__":
+    main()

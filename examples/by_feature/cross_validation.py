@@ -1,0 +1,64 @@
+"""K-fold cross validation under one Accelerator (reference analogue:
+examples/by_feature/cross_validation.py — train on k-1 folds, evaluate on
+the held-out fold, average metrics across folds with gather).
+"""
+
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset
+
+from _common import make_task
+
+
+class FoldView:
+    """A dataset view selecting a subset of indices (the reference uses
+    datasets.select; here plain index math keeps it dependency-free)."""
+
+    def __init__(self, base, indices):
+        self.base, self.indices = base, list(indices)
+
+    def __len__(self):
+        return len(self.indices)
+
+    def __getitem__(self, i):
+        return self.base[self.indices[i]]
+
+
+def main(k: int = 4):
+    accelerator = Accelerator()
+    base = RegressionDataset(length=128, seed=0)
+    folds = np.array_split(np.arange(len(base)), k)
+
+    fold_losses = []
+    for held_out in range(k):
+        train_idx = np.concatenate([f for i, f in enumerate(folds) if i != held_out])
+        model, optimizer, _, loss_fn = make_task(accelerator, batch_size=4)
+        train_loader = accelerator.prepare_data_loader(
+            FoldView(base, train_idx), batch_size=4, shuffle=True, seed=42
+        )
+        step = accelerator.build_train_step(loss_fn)
+        for epoch in range(8):
+            train_loader.set_epoch(epoch)
+            for batch in train_loader:
+                step(batch)
+
+        # held-out evaluation with padded-tail-exact gather
+        eval_loader = accelerator.prepare_data_loader(FoldView(base, folds[held_out]), batch_size=8)
+        sq_errors = []
+        for batch in eval_loader:
+            pred = model.apply_fn(model.params, batch["x"])
+            err = accelerator.gather_for_metrics((pred - batch["y"]) ** 2)
+            sq_errors.append(np.asarray(err))
+        fold_loss = float(np.concatenate(sq_errors).mean())
+        fold_losses.append(fold_loss)
+        accelerator.free_memory()
+        accelerator.print(f"fold {held_out}: held-out MSE {fold_loss:.4f}")
+
+    mean = float(np.mean(fold_losses))
+    accelerator.print(f"{k}-fold CV MSE: {mean:.4f} (+/- {float(np.std(fold_losses)):.4f})")
+    assert mean < 0.5, f"cross-validated model did not learn (MSE {mean})"
+
+
+if __name__ == "__main__":
+    main()
