@@ -62,6 +62,8 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_gpt2,
     load_hf_gptneox,
     load_hf_llama,
+    load_hf_mixtral,
     load_hf_t5,
+    load_hf_vit,
     read_safetensors_state,
 )

@@ -372,6 +372,145 @@ def load_hf_t5(checkpoint_path: str, config=None):
 
 
 # --------------------------------------------------------------------- #
+# ViT
+# --------------------------------------------------------------------- #
+
+_VIT_BLOCK = {
+    "attention.attention.query.weight": ("attention/query/kernel", True),
+    "attention.attention.query.bias": ("attention/query/bias", False),
+    "attention.attention.key.weight": ("attention/key/kernel", True),
+    "attention.attention.key.bias": ("attention/key/bias", False),
+    "attention.attention.value.weight": ("attention/value/kernel", True),
+    "attention.attention.value.bias": ("attention/value/bias", False),
+    "attention.output.dense.weight": ("attention/out/kernel", True),
+    "attention.output.dense.bias": ("attention/out/bias", False),
+    "intermediate.dense.weight": ("mlp/up/kernel", True),
+    "intermediate.dense.bias": ("mlp/up/bias", False),
+    "output.dense.weight": ("mlp/down/kernel", True),
+    "output.dense.bias": ("mlp/down/bias", False),
+    "layernorm_before.weight": ("norm1/scale", False),
+    "layernorm_before.bias": ("norm1/bias", False),
+    "layernorm_after.weight": ("norm2/scale", False),
+    "layernorm_after.bias": ("norm2/bias", False),
+}
+
+
+def convert_hf_vit_state(state: dict[str, np.ndarray]) -> dict:
+    """HF ``ViTForImageClassification`` -> our param pytree. The patch
+    conv transposes torch OIHW -> flax HWIO."""
+    state = _strip_prefix(state, ("vit.",))
+    tree: dict = {}
+    if "embeddings.cls_token" in state:
+        _set(tree, "cls_token", state["embeddings.cls_token"])
+    if "embeddings.position_embeddings" in state:
+        _set(tree, "pos_embed", state["embeddings.position_embeddings"])
+    if "embeddings.patch_embeddings.projection.weight" in state:
+        w = state["embeddings.patch_embeddings.projection.weight"]  # [d, 3, p, p]
+        _set(tree, "patch_embed/kernel", w.transpose(2, 3, 1, 0))
+    if "embeddings.patch_embeddings.projection.bias" in state:
+        _set(tree, "patch_embed/bias", state["embeddings.patch_embeddings.projection.bias"])
+    if "layernorm.weight" in state:
+        _set(tree, "final_norm/scale", state["layernorm.weight"])
+    if "layernorm.bias" in state:
+        _set(tree, "final_norm/bias", state["layernorm.bias"])
+    if "classifier.weight" in state:
+        _set(tree, "head/kernel", state["classifier.weight"].T)
+    if "classifier.bias" in state:
+        _set(tree, "head/bias", state["classifier.bias"])
+
+    layer_re = re.compile(r"encoder\.layer\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = layer_re.match(key)
+        if m and m.group(2) in _VIT_BLOCK:
+            name, transpose = _VIT_BLOCK[m.group(2)]
+            _set(tree, f"block_{int(m.group(1))}/{name}", value.T if transpose else value)
+    return tree
+
+
+def load_hf_vit(checkpoint_path: str, config=None):
+    from .vit import ViTConfig, create_vit_model
+
+    state = read_safetensors_state(checkpoint_path)
+    tree = convert_hf_vit_state(state)
+    model = create_vit_model(config or ViTConfig.base())
+    _merge_into(model, tree)
+    return model
+
+
+# --------------------------------------------------------------------- #
+# Mixtral
+# --------------------------------------------------------------------- #
+
+_MIXTRAL_ATTN = {
+    "self_attn.q_proj.weight": "attn/q_proj/kernel",
+    "self_attn.k_proj.weight": "attn/k_proj/kernel",
+    "self_attn.v_proj.weight": "attn/v_proj/kernel",
+    "self_attn.o_proj.weight": "attn/o_proj/kernel",
+}
+
+
+def convert_hf_mixtral_state(state: dict[str, np.ndarray], num_heads: int, num_kv_heads: int) -> dict:
+    """HF ``MixtralForCausalLM`` -> our param pytree: llama-style attention
+    (q/k re-paired for interleaved rope), per-expert w1/w3/w2 stacked into
+    ``experts/{gate,up,down}_proj`` with a leading expert dim, router
+    ``gate.weight`` transposed to ``router/kernel``."""
+    tree: dict = {}
+    if "model.embed_tokens.weight" in state:
+        _set(tree, "embed_tokens/embedding", state["model.embed_tokens.weight"])
+    if "model.norm.weight" in state:
+        _set(tree, "final_norm/scale", state["model.norm.weight"])
+    if "lm_head.weight" in state:
+        _set(tree, "lm_head/kernel", state["lm_head.weight"].T)
+    elif "model.embed_tokens.weight" in state:
+        _set(tree, "lm_head/kernel", state["model.embed_tokens.weight"].T)
+
+    layer_re = re.compile(r"model\.layers\.(\d+)\.(.+)")
+    experts: dict[tuple, dict[int, np.ndarray]] = {}
+    for key, value in state.items():
+        m = layer_re.match(key)
+        if not m:
+            continue
+        idx, rest = int(m.group(1)), m.group(2)
+        prefix = f"layer_{idx}"
+        if rest in _MIXTRAL_ATTN:
+            kernel = value.T
+            if rest == "self_attn.q_proj.weight":
+                kernel = _rope_interleave_permute(kernel, kernel.shape[1] // num_heads)
+            elif rest == "self_attn.k_proj.weight":
+                kernel = _rope_interleave_permute(kernel, kernel.shape[1] // num_kv_heads)
+            _set(tree, f"{prefix}/{_MIXTRAL_ATTN[rest]}", kernel)
+        elif rest == "input_layernorm.weight":
+            _set(tree, f"{prefix}/input_norm/scale", value)
+        elif rest == "post_attention_layernorm.weight":
+            _set(tree, f"{prefix}/post_attn_norm/scale", value)
+        elif rest == "block_sparse_moe.gate.weight":
+            _set(tree, f"{prefix}/moe/router/kernel", value.T)
+        else:
+            em = re.fullmatch(r"block_sparse_moe\.experts\.(\d+)\.(w[123])\.weight", rest)
+            if em:
+                # w1 = gate (silu branch), w3 = up, w2 = down; torch [out, in]
+                name = {"w1": "gate_proj", "w3": "up_proj", "w2": "down_proj"}[em.group(2)]
+                experts.setdefault((idx, name), {})[int(em.group(1))] = value.T
+    for (idx, name), per_expert in experts.items():
+        stacked = np.stack([per_expert[i] for i in range(len(per_expert))])
+        _set(tree, f"layer_{idx}/moe/experts/{name}", stacked)
+    return tree
+
+
+def load_hf_mixtral(checkpoint_path: str, config=None):
+    from .mixtral import MixtralConfig, create_mixtral_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or MixtralConfig()
+    tree = convert_hf_mixtral_state(
+        state, num_heads=config.num_attention_heads, num_kv_heads=config.num_key_value_heads
+    )
+    model = create_mixtral_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+# --------------------------------------------------------------------- #
 # GPT-NeoX
 # --------------------------------------------------------------------- #
 

@@ -227,3 +227,68 @@ def test_gptneox_import_non_parallel_residual(tmp_path):
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_mixtral_import_matches_transformers(tmp_path):
+    """MoE family parity: with generous expert capacity (no token drops)
+    our GShard-style dispatch computes exactly HF's top-2 renormalized
+    routing, so logits match element-wise."""
+    import jax
+
+    from accelerate_tpu.models import MixtralConfig
+    from accelerate_tpu.models.hub import load_hf_mixtral
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        attention_dropout=0.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, rope_theta=10000.0,
+        capacity_factor=8.0,  # no drops: every token keeps both experts
+    )
+    model = load_hf_mixtral(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_vit_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models import ViTConfig
+    from accelerate_tpu.models.hub import load_hf_vit
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, num_labels=10,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-6,
+    )
+    torch.manual_seed(0)
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+    images = torch.randn(2, 3, 32, 32)
+    with torch.no_grad():
+        want = hf(images).logits.numpy()
+
+    cfg = ViTConfig(
+        image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, num_classes=10,
+    )
+    model = load_hf_vit(_save(hf, tmp_path), cfg)
+    # our forward takes NHWC
+    x = images.numpy().transpose(0, 2, 3, 1)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, x))
+    np.testing.assert_allclose(got, want, atol=TOL)
