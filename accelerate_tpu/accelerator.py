@@ -1109,6 +1109,8 @@ class Accelerator:
 
     @contextlib.contextmanager
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
+        if isinstance(profile_handler, str):  # path shorthand
+            profile_handler = ProfileKwargs(output_trace_dir=profile_handler)
         handler = profile_handler or self.profile_handler
         import jax
 
