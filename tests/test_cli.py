@@ -404,3 +404,17 @@ def test_performance_gate_script():
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert "test_performance: ALL OK" in result.stdout
+
+
+def test_checkpoint_resume_script_multiprocess(tmp_path):
+    """2-process orbax checkpoint round-trip through the real launcher
+    (reference analogue: test_state_checkpointing.py, run distributed)."""
+    env = {**CPU_ENV, "ACCELERATE_TEST_CKPT_DIR": str(tmp_path / "ck")}
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4",
+        "--main_process_port", "7823", "-m",
+        "accelerate_tpu.test_utils.scripts.test_checkpoint_resume",
+        env=env, timeout=420,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "test_checkpoint_resume: ALL OK" in result.stdout
