@@ -332,6 +332,13 @@ def pod_ssh_launcher(args) -> int:
 
 def launch_command(args) -> int:
     args = _load_config_into_args(args)
+    if args.main_process_port is None and args.num_processes > 1:
+        # resolve ONCE before the per-rank env fan-out (each rank must get
+        # the same coordinator address); avoids collisions between
+        # concurrent local groups on the fixed default port
+        from ..utils.environment import get_free_port
+
+        args.main_process_port = get_free_port()
     explicit = getattr(args, "_explicit", None) or set()
     # A topology request — CLI flag, or YAML value that DIFFERS from the
     # parser default — means the user is NOT asking for a bare pod fan-out.

@@ -135,3 +135,14 @@ def force_host_platform(n_devices: int = 8) -> None:
         jax.extend.backend.clear_backends()
     except Exception:
         pass
+
+
+def get_free_port() -> int:
+    """An OS-assigned free TCP port (reference: utils/other.py:474
+    ``get_free_port``) — used by the launcher so concurrent local process
+    groups don't collide on the default coordinator port."""
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

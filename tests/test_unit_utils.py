@@ -171,3 +171,40 @@ def test_gradient_accumulation_plugin_validation():
     assert plugin.num_steps == 4
     with pytest.raises((ValueError, TypeError)):
         GradientAccumulationPlugin(num_steps=0)
+
+
+def test_get_free_port_is_bindable():
+    """get_free_port returns a port another socket can immediately bind
+    (reference: utils/other.py get_free_port)."""
+    import socket
+
+    from accelerate_tpu.utils.environment import get_free_port
+
+    port = get_free_port()
+    assert 1024 <= port <= 65535
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", port))  # must not raise
+
+
+def test_launch_resolves_port_once_for_multiprocess(tmp_path):
+    """A 2-process launch without --main_process_port picks one free port
+    for the whole group (per-rank resolution would deadlock rendezvous)."""
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "s.py"
+    script.write_text(
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "assert acc.num_processes == 2\n"
+        "print('PORT_OK', acc.process_index)\n"
+    )
+    env = {**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+         "--num_processes", "2", "--cpu", "--fake_devices", "4", str(script)],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert result.stdout.count("PORT_OK") >= 1
