@@ -607,7 +607,14 @@ class Accelerator:
                 return loss_fn(*lead, rng=rng)
             return loss_fn(*lead)
 
-        def step_fn(params, opt_state, grad_buf, mstate, batch, loss_scale, do_sync, rng, clip_norm):
+        h = self.scaler_handler
+        growth_factor = float(getattr(h, "growth_factor", 2.0))
+        backoff_factor = float(getattr(h, "backoff_factor", 0.5))
+        growth_interval = int(getattr(h, "growth_interval", 2000))
+
+        def step_fn(params, opt_state, grad_buf, mstate, batch, scale_state, do_sync, rng, clip_norm):
+            loss_scale = scale_state["scale"]
+
             def scaled_loss(p):
                 out = call_loss(compute_cast(p), mstate, batch, rng)
                 if has_state:
@@ -642,7 +649,25 @@ class Accelerator:
                 # accumulation buffer: ZeRO-2) data-sharded across steps
                 new_opt = jax.lax.with_sharding_constraint(new_opt, zero_shardings)
                 new_buf = jax.lax.with_sharding_constraint(new_buf, buf_shardings)
-            return new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux
+
+            new_scale_state = scale_state
+            if use_fp16:
+                # dynamic loss scale lives ON DEVICE (torch GradScaler
+                # semantics, applied only on sync boundaries): no host
+                # round-trip per boundary — the 5 MB/s-tunnel/stall fix
+                grown = scale_state["growth"] + 1
+                do_grow = grown >= growth_interval
+                upd_scale = jnp.where(
+                    finite,
+                    jnp.where(do_grow, loss_scale * growth_factor, loss_scale),
+                    jnp.maximum(1.0, loss_scale * backoff_factor),
+                )
+                upd_growth = jnp.where(finite & ~do_grow, grown, 0)
+                new_scale_state = {
+                    "scale": jnp.where(do_sync, upd_scale, loss_scale),
+                    "growth": jnp.where(do_sync, upd_growth, scale_state["growth"]),
+                }
+            return new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state
 
         zero_shardings = getattr(optimizer, "_zero_shardings", None)
         buf_shardings = None
@@ -660,7 +685,22 @@ class Accelerator:
             lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p),
             out_shardings=buf_shardings,
         )(model.params)
-        state_box = {"grad_buf": grad_buf, "micro": 0}
+        if not hasattr(self, "_fast_scale_boxes"):
+            self._fast_scale_boxes = []
+        state_box = {
+            "grad_buf": grad_buf,
+            "micro": 0,
+            # fp16 dynamic loss scale as carried device arrays (no host
+            # fetch per boundary); refreshed to the host copy every
+            # _SCALE_REFRESH boundaries for introspection/checkpointing
+            "scale_state": {
+                "scale": jnp.float32(self._loss_scale),
+                "growth": jnp.int32(self._scale_growth_tracker),
+            },
+            "boundaries": 0,
+        }
+        self._fast_scale_boxes.append(state_box)
+        _SCALE_REFRESH = 64
 
         def step(batch):
             # sync on the accumulation boundary OR at end-of-dataloader
@@ -676,13 +716,13 @@ class Accelerator:
             from .utils.random import key_for_step
 
             with self._matmul_precision_ctx():
-                new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux = jitted(
+                new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state = jitted(
                     model.params,
                     optimizer.opt_state,
                     state_box["grad_buf"],
                     getattr(model, "state", None) if has_state else None,
                     batch,
-                    jnp.float32(self._loss_scale),
+                    state_box["scale_state"],
                     jnp.bool_(do_sync),
                     key_for_step(self.step),
                     jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
@@ -692,13 +732,19 @@ class Accelerator:
                 model.state = new_state
             optimizer.opt_state = new_opt
             state_box["grad_buf"] = new_buf
+            state_box["scale_state"] = new_scale_state
             state_box["micro"] = 0 if do_sync else state_box["micro"] + 1
             self.step += 1
             self._last_grad_norm = gnorm
             if do_sync:
                 if use_fp16:
-                    self._update_loss_scale(bool(finite))
-                    optimizer._step_was_skipped = not bool(finite)
+                    # device value, coerced lazily by the property — reading
+                    # step_was_skipped is what forces the fetch, not the step
+                    optimizer._step_was_skipped = jnp.logical_not(finite)
+                    state_box["boundaries"] += 1
+                    if state_box["boundaries"] % _SCALE_REFRESH == 0:
+                        self._loss_scale = float(new_scale_state["scale"])
+                        self._scale_growth_tracker = int(new_scale_state["growth"])
                 if scheduler is not None:
                     scheduler.step()
             return (loss, aux) if has_aux else loss
@@ -1049,12 +1095,33 @@ class Accelerator:
         self._load_model_hooks.append(hook)
         return _RemovableHandle(self._load_model_hooks, hook)
 
+    def _sync_loss_scale_to_host(self):
+        """Pull the fast path's on-device fp16 scale into the host mirror
+        (the periodic refresh may lag by up to _SCALE_REFRESH boundaries —
+        a checkpoint must persist the TRUE current scale)."""
+        boxes = getattr(self, "_fast_scale_boxes", None)
+        if boxes and self.mixed_precision == "fp16":
+            ss = boxes[-1]["scale_state"]
+            self._loss_scale = float(ss["scale"])
+            self._scale_growth_tracker = int(ss["growth"])
+
+    def _seed_loss_scale_to_device(self):
+        """Push the host scale into every built train step's carried device
+        state (load_state must take effect on steps built BEFORE the load)."""
+        jnp = _jnp()
+        for box in getattr(self, "_fast_scale_boxes", []) or []:
+            box["scale_state"] = {
+                "scale": jnp.float32(self._loss_scale),
+                "growth": jnp.int32(self._scale_growth_tracker),
+            }
+
     def save_state(self, output_dir: Optional[str] = None, **save_model_func_kwargs):
         """``async_save=True`` returns once device->host copies finish;
         disk writes continue in the background (drained by
         :meth:`wait_for_checkpoint` or the next save/load)."""
         from .checkpointing import save_accelerator_state
 
+        self._sync_loss_scale_to_host()
         return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
 
     def wait_for_checkpoint(self):
@@ -1066,7 +1133,9 @@ class Accelerator:
     def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
         from .checkpointing import load_accelerator_state
 
-        return load_accelerator_state(self, input_dir, **load_model_func_kwargs)
+        out = load_accelerator_state(self, input_dir, **load_model_func_kwargs)
+        self._seed_loss_scale_to_device()
+        return out
 
     def save_model(self, model, save_directory: str, max_shard_size="10GB", safe_serialization: bool = True):
         from .checkpointing import save_model as _save_model

@@ -66,6 +66,7 @@ def _checkpointer():
 # in-flight async checkpointers; drained by wait_for_checkpoint() and
 # before any subsequent save/load touches the same process
 _PENDING_ASYNC: list = []
+_ATEXIT_REGISTERED = False
 
 
 def wait_for_checkpoint():
@@ -82,10 +83,17 @@ def wait_for_checkpoint():
     for ckptr in pending:
         try:
             ckptr.wait_until_finished()
-            ckptr.close()
         except Exception as e:  # noqa: PERF203
             if first_error is None:
                 first_error = e
+        finally:
+            # close even when the wait raised: an unclosed checkpointer
+            # leaks its background thread/executor
+            try:
+                ckptr.close()
+            except Exception as e:
+                if first_error is None:
+                    first_error = e
     if first_error is not None:
         raise first_error
 
@@ -99,6 +107,22 @@ def _save_pytree(tree, path: Path, async_save: bool = False):
         # proceeds on a background thread until wait_for_checkpoint()
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         ckptr.save(path.absolute(), args=ocp.args.StandardSave(tree), force=True)
+        global _ATEXIT_REGISTERED
+        if not _ATEXIT_REGISTERED:
+            # a script whose last action is an async save must still commit.
+            # Plain atexit is too late: CPython runs threading._shutdown
+            # (which stops concurrent.futures executors) BEFORE atexit
+            # callbacks, so orbax's background commit would die with
+            # "cannot schedule new futures after shutdown". The threading
+            # atexit hooks run before that shutdown.
+            import atexit
+            import threading
+
+            try:
+                threading._register_atexit(wait_for_checkpoint)
+            except Exception:  # very late in shutdown — best effort
+                atexit.register(wait_for_checkpoint)
+            _ATEXIT_REGISTERED = True
         _PENDING_ASYNC.append(ckptr)
         return
     with ocp.StandardCheckpointer() as ckptr:

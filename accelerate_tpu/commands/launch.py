@@ -332,10 +332,17 @@ def pod_ssh_launcher(args) -> int:
 
 def launch_command(args) -> int:
     args = _load_config_into_args(args)
-    if args.main_process_port is None and args.num_processes > 1:
+    if (
+        args.main_process_port is None
+        and args.num_processes > 1
+        and getattr(args, "num_machines", 1) == 1
+        and getattr(args, "main_process_ip", "127.0.0.1") in ("127.0.0.1", "localhost")
+    ):
         # resolve ONCE before the per-rank env fan-out (each rank must get
         # the same coordinator address); avoids collisions between
-        # concurrent local groups on the fixed default port
+        # concurrent local groups on the fixed default port. Multi-machine
+        # topologies keep the fixed default: every machine's launcher must
+        # independently resolve the SAME coordinator port
         from ..utils.environment import get_free_port
 
         args.main_process_port = get_free_port()

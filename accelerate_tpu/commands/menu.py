@@ -89,26 +89,36 @@ def _interactive_select(prompt: str, choices: list, default_index: int) -> int:
         render(first=False)
 
 
-def _fallback_select(prompt: str, choices: list, default_index: int, input_fn=input) -> int:
-    """Numbered-prompt fallback for non-TTY stdin; also the testable path."""
+def _fallback_select(prompt: str, choices: list, default_index: int, input_fn=input, max_retries: int = 5) -> int:
+    """Numbered-prompt fallback for non-TTY stdin; also the testable path.
+
+    Invalid input re-prompts (the reference's questionnaire loops rather
+    than aborting and discarding earlier answers); after ``max_retries``
+    bad inputs it raises so a mis-piped stdin can't spin forever."""
     print(prompt)
     for i, choice in enumerate(choices):
         print(f"  [{i}] {choice}")
-    raw = input_fn(f"choice [{default_index}]: ").strip()
-    if not raw:
-        return default_index
-    try:
-        index = int(raw)
-    except ValueError:
-        # accept the choice text itself (prefix-unique), like the reference's
-        # _convert_value validators accept the literal value
-        matches = [i for i, c in enumerate(choices) if str(c).startswith(raw)]
-        if len(matches) == 1:
-            return matches[0]
-        raise ValueError(f"invalid choice {raw!r}; expected 0..{len(choices) - 1} or a unique prefix")
-    if not 0 <= index < len(choices):
-        raise ValueError(f"choice {index} out of range 0..{len(choices) - 1}")
-    return index
+    last_error = None
+    for _ in range(max_retries):
+        raw = input_fn(f"choice [{default_index}]: ").strip()
+        if not raw:
+            return default_index
+        try:
+            index = int(raw)
+        except ValueError:
+            # accept the choice text itself (prefix-unique), like the
+            # reference's _convert_value validators accept the literal value
+            matches = [i for i, c in enumerate(choices) if str(c).startswith(raw)]
+            if len(matches) == 1:
+                return matches[0]
+            last_error = f"invalid choice {raw!r}; expected 0..{len(choices) - 1} or a unique prefix"
+            print(last_error)
+            continue
+        if 0 <= index < len(choices):
+            return index
+        last_error = f"choice {index} out of range 0..{len(choices) - 1}"
+        print(last_error)
+    raise ValueError(last_error or "no valid selection")
 
 
 def select(prompt: str, choices: list, default=None) -> object:
@@ -116,6 +126,8 @@ def select(prompt: str, choices: list, default=None) -> object:
     TTY, numbered prompt otherwise."""
     if not choices:
         raise ValueError("select() needs at least one choice")
+    if default is not None and default not in choices:
+        raise ValueError(f"default {default!r} is not one of the choices {choices!r}")
     default_index = 0 if default is None else choices.index(default)
     interactive = sys.stdin.isatty() and sys.stdout.isatty()
     if interactive:

@@ -373,22 +373,33 @@ class IterableDataLoaderShard(BaseDataLoader):
 
     def _batched_samples(self):
         jax = _jax()
-        pc, pi = jax.process_count(), jax.process_index()
+        if getattr(self, "_dispatch_source", False):
+            # dispatch mode: process 0 consumes the whole stream and yields
+            # FULL global batches; the dispatcher scatters per-process
+            # slices afterwards (reference: data_loader.py:704-786 serves
+            # IterableDataset through the dispatcher the same way)
+            pc, pi = 1, 0
+        else:
+            pc, pi = jax.process_count(), jax.process_index()
         g = self.total_batch_size
         buf, first = [], []
-        skipped = 0
+        n_full = 0  # every full batch, skipped or yielded: the tail's ordinal
         for sample in self.dataset:
             buf.append(sample)
             if len(first) < g:
                 first.append(sample)
             if len(buf) == g:
-                if skipped < self.skip_batches:
-                    skipped += 1
+                n_full += 1
+                if n_full <= self.skip_batches:
                     buf = []
                     continue
                 local = buf[pi * (g // pc) : (pi + 1) * (g // pc)] if pc > 1 else buf
-                yield self.collate_fn(local), g
+                yield self.collate_fn(local), g, g
                 buf = []
+        if buf and n_full < self.skip_batches:
+            # the resume offset lands on (or past) the tail batch: it was
+            # already delivered before the checkpoint, so don't replay it
+            return
         if buf and not self.drop_last:
             n_real = len(buf)
             if self.even_batches:
@@ -401,7 +412,7 @@ class IterableDataLoaderShard(BaseDataLoader):
                 buf.append(first[i % len(first)])
                 i += 1
             local = buf[pi * (target // pc) : (pi + 1) * (target // pc)] if pc > 1 else buf
-            yield self.collate_fn(local), n_real
+            yield self.collate_fn(local), n_real, target
 
     def __iter__(self):
         self.begin()
@@ -409,16 +420,18 @@ class IterableDataLoaderShard(BaseDataLoader):
         completed = False
         try:
             window: deque = deque()
-            for host_batch, n_real in self._batched_samples():
-                window.append((self._place(host_batch), n_real))
+            for host_batch, n_real, padded in self._batched_samples():
+                window.append((self._place(host_batch), n_real, padded))
                 if len(window) > self.prefetch_size:
                     self.batches_yielded += 1
                     yield window.popleft()[0]
             while window:
-                batch, n_real = window.popleft()
+                batch, n_real, padded = window.popleft()
                 if not window:
                     self.end_of_dataloader = True
-                    self.remainder = n_real if n_real != self.total_batch_size else -1
+                    # same contract as the map loader: REAL rows when the
+                    # tail was padded, -1 when nothing needs truncating
+                    self.remainder = n_real if n_real != padded else -1
                 self.batches_yielded += 1
                 yield batch
             completed = True
@@ -432,9 +445,12 @@ class IterableDataLoaderShard(BaseDataLoader):
 class DataLoaderDispatcher(BaseDataLoader):
     """Dispatch mode: process 0 reads every batch and broadcasts it over DCN
     (reference: data_loader.py:704, ``_fetch_batches`` :786-850). Useful when
-    the dataset is only reachable from one host."""
+    the dataset is only reachable from one host. Wraps either the map-style
+    :class:`DataLoaderShard` or the streaming
+    :class:`IterableDataLoaderShard` (reference serves IterableDataset
+    through the same dispatcher, data_loader.py:704-786)."""
 
-    def __init__(self, inner: DataLoaderShard):
+    def __init__(self, inner):
         super().__init__(
             batch_sharding=inner.batch_sharding_,
             device_placement=inner.device_placement,
@@ -455,7 +471,7 @@ class DataLoaderDispatcher(BaseDataLoader):
         return self.inner.total_dataset_length
 
     def __len__(self):
-        return len(self.inner)
+        return len(self.inner)  # TypeError for an iterable inner, as for torch
 
     def set_epoch(self, epoch: int):
         self.inner.set_epoch(epoch)
@@ -618,8 +634,6 @@ def prepare_data_loader(
         loader = IterableDataLoaderShard(dataloader, **common)
 
     if dispatch_batches:
-        if not isinstance(loader, DataLoaderShard):
-            raise ValueError("dispatch_batches requires a map-style dataset")
         loader = DataLoaderDispatcher(loader)
     return loader
 

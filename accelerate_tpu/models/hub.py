@@ -161,6 +161,11 @@ def _rope_interleave_permute(kernel: np.ndarray, head_dim: int) -> np.ndarray:
     drift (the same class of bug as HF's own Meta->HF ``permute`` in
     convert_llama_weights_to_hf.py). ``kernel`` is flax-layout
     ``[in, heads * head_dim]``."""
+    if head_dim % 2 != 0:
+        raise ValueError(
+            f"rope re-pairing requires an even head_dim, got {head_dim} "
+            f"(hidden_size / num_attention_heads in the HF config)"
+        )
     in_dim, out_dim = kernel.shape
     heads = out_dim // head_dim
     k = kernel.reshape(in_dim, heads, head_dim)
@@ -534,6 +539,11 @@ def _partial_rope_interleave_permute(kernel: np.ndarray, head_dim: int, rotary_d
     tail keeps its order."""
     if rotary_dims >= head_dim:
         return _rope_interleave_permute(kernel, head_dim)
+    if rotary_dims % 2 != 0:
+        raise ValueError(
+            f"rope re-pairing requires an even rotary prefix, got rotary_dims={rotary_dims} "
+            f"(int(head_dim * rotary_pct) in the HF GPT-NeoX config)"
+        )
     in_dim, out_dim = kernel.shape
     heads = out_dim // head_dim
     k = kernel.reshape(in_dim, heads, head_dim)

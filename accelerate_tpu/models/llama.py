@@ -15,6 +15,7 @@ fine-tunes Llama-2-7B — BASELINE.json configs). TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Optional
 
 import jax
@@ -43,6 +44,12 @@ class LlamaConfig:
     # "auto": ring attention when the mesh seq axis is non-trivial, else
     # dense/flash; "ring" | "all_to_all" | "dense" force a path.
     attention_impl: str = "auto"
+    # weight-only quantized block projections (int8|int4|nf4): every
+    # q/k/v/o/gate/up/down kernel becomes a QuantDense whose packed codes
+    # are the params — the decode-bandwidth win (set via
+    # ``load_and_quantize_model``, not by hand)
+    quant_method: Optional[str] = None
+    quant_group_size: Optional[int] = None
 
     @classmethod
     def llama2_7b(cls, **kw) -> "LlamaConfig":
@@ -80,8 +87,33 @@ LLAMA_SHARDING_RULES = [
     (r"layer_\d+/mlp/down_proj/kernel", P("tensor", None)),
 ]
 
+# Quantized variants: qdata/qscale are [*, n_groups, g(, packed), out] with
+# a leading layer dim when stacked — column-parallel splits the trailing
+# out dim; row-parallel splits the group dim of qdata and replicates the
+# scales (the per-channel scale commutes with the contraction psum).
+LLAMA_SHARDING_RULES += [
+    (r"layers/block/(attn/(q|k|v)_proj|mlp/(gate|up)_proj)/(qdata|qscale)", P(None, None, None, "tensor")),
+    (r"layers/block/(attn/o_proj|mlp/down_proj)/qdata", P(None, None, "tensor", None)),
+    (r"layers/block/(attn/o_proj|mlp/down_proj)/qscale", P(None, None, None, None)),
+    (r"layer_\d+/(attn/(q|k|v)_proj|mlp/(gate|up)_proj)/(qdata|qscale)", P(None, None, "tensor")),
+    (r"layer_\d+/(attn/o_proj|mlp/down_proj)/qdata", P(None, "tensor", None)),
+    (r"layer_\d+/(attn/o_proj|mlp/down_proj)/qscale", P(None, None, None)),
+]
+
 # Activation sharding (Megatron-SP equivalent): token dim over ``seq``.
 ACTIVATION_SPEC = P(("data", "fsdp"), "seq", None)
+
+
+def _dense(cfg: "LlamaConfig", features: int, name: str, dtype):
+    """Block projection factory: plain Dense, or QuantDense when the config
+    carries a weight-only quantization method."""
+    if cfg.quant_method is not None:
+        from ..ops.qdense import QuantDense
+
+        return QuantDense(
+            features, method=cfg.quant_method, group_size=cfg.quant_group_size, dtype=dtype, name=name
+        )
+    return nn.Dense(features, use_bias=False, name=name, dtype=dtype, dot_general=_pdg())
 
 
 class RMSNorm(nn.Module):
@@ -143,9 +175,9 @@ class LlamaAttention(nn.Module):
     def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
-        q = nn.Dense(cfg.num_attention_heads * head_dim, use_bias=False, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
-        k = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
-        v = nn.Dense(cfg.num_key_value_heads * head_dim, use_bias=False, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
+        q = _dense(cfg, cfg.num_attention_heads * head_dim, "q_proj", hidden.dtype)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * head_dim, "k_proj", hidden.dtype)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * head_dim, "v_proj", hidden.dtype)(hidden)
         q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
         k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
@@ -156,7 +188,7 @@ class LlamaAttention(nn.Module):
         else:
             out = _dispatch_attention(q, k, v, cfg.attention_impl)
         out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
+        return _dense(cfg, cfg.hidden_size, "o_proj", hidden.dtype)(out)
 
     def _cached_attention(self, q, k, v):
         """KV-cache incremental attention (generation path; shared cache
@@ -172,11 +204,9 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, hidden):
         cfg = self.config
-        gate = nn.Dense(cfg.intermediate_size, use_bias=False, name="gate_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
-        up = nn.Dense(cfg.intermediate_size, use_bias=False, name="up_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
-        return nn.Dense(cfg.hidden_size, use_bias=False, name="down_proj", dtype=hidden.dtype, dot_general=_pdg())(
-            nn.silu(gate) * up
-        )
+        gate = _dense(cfg, cfg.intermediate_size, "gate_proj", hidden.dtype)(hidden)
+        up = _dense(cfg, cfg.intermediate_size, "up_proj", hidden.dtype)(hidden)
+        return _dense(cfg, cfg.hidden_size, "down_proj", hidden.dtype)(nn.silu(gate) * up)
 
 
 class LlamaLayer(nn.Module):
@@ -237,12 +267,7 @@ class LlamaModel(nn.Module):
         return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
 
 
-def create_llama_model(config: Optional[LlamaConfig] = None, seed: int = 0, seq_len: int = 128) -> Model:
-    config = config or LlamaConfig.tiny()
-    module = LlamaModel(config)
-    dummy = jnp.zeros((2, seq_len), jnp.int32)
-    params = module.init(jax.random.key(seed), dummy)["params"]
-
+def _wrap_llama(module: LlamaModel, params, config: LlamaConfig) -> Model:
     def apply_fn(p, input_ids, positions=None, decode=False, cache=None):
         """decode=True threads the KV cache: pass ``cache`` (or None to
         initialise) and receive ``(logits, new_cache)``."""
@@ -258,6 +283,54 @@ def create_llama_model(config: Optional[LlamaConfig] = None, seed: int = 0, seq_
     model.config = config
     model.module = module
     return model
+
+
+def create_llama_model(config: Optional[LlamaConfig] = None, seed: int = 0, seq_len: int = 128) -> Model:
+    config = config or LlamaConfig.tiny()
+    module = LlamaModel(config)
+    dummy = jnp.zeros((2, seq_len), jnp.int32)
+    params = module.init(jax.random.key(seed), dummy)["params"]
+    return _wrap_llama(module, params, config)
+
+
+_PROJ_RE = re.compile(r"^(q|k|v|o|gate|up|down)_proj$")
+
+
+def quantize_llama_model(model: Model, qconfig=None) -> Model:
+    """Weight-only quantize every block projection of a llama :class:`Model`
+    into the in-scan :class:`~accelerate_tpu.ops.qdense.QuantDense` layout.
+
+    Unlike the generic wrap-and-dequantize fallback (which materialises the
+    full-precision stack outside the layer scan), the packed codes here ARE
+    the params, so per-decode-step HBM traffic is the int8/int4 bytes —
+    the TPU analogue of the reference's bnb layer replacement
+    (reference: src/accelerate/utils/bnb.py:276-373).
+    """
+    from ..utils.quantization import QuantizationConfig, quantize
+
+    qcfg = qconfig or QuantizationConfig()
+    if model.config.quant_method is not None:
+        # re-quantizing would find no 'kernel' leaves, rewrite quant_method,
+        # and silently reinterpret the packed codes under the new decoder
+        raise ValueError(
+            f"model is already quantized ({model.config.quant_method}); "
+            "quantize the original float model instead"
+        )
+    new_cfg = dataclasses.replace(model.config, quant_method=qcfg.method, quant_group_size=qcfg.group_size)
+
+    def convert(tree):
+        if not hasattr(tree, "items"):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            if hasattr(v, "items") and _PROJ_RE.match(k) and "kernel" in v:
+                qt = quantize(jnp.asarray(v["kernel"]), qcfg)
+                out[k] = {"qdata": qt.data, "qscale": qt.scale}
+            else:
+                out[k] = convert(v)
+        return out
+
+    return _wrap_llama(LlamaModel(new_cfg), convert(model.params), new_cfg)
 
 
 def causal_lm_loss(params, batch, apply_fn):

@@ -63,8 +63,10 @@ class AcceleratedOptimizer:
     @property
     def step_was_skipped(self) -> bool:
         """(reference: optimizer.py:188) True when the last ``step`` was
-        dropped due to non-finite gradients (fp16 overflow semantics)."""
-        return self._step_was_skipped
+        dropped due to non-finite gradients (fp16 overflow semantics).
+        The fast path stores a device scalar; coercion happens HERE, on
+        read, so the hot loop never blocks on a device->host fetch."""
+        return bool(self._step_was_skipped)
 
     def zero_grad(self, set_to_none: bool = True):
         """Clear this optimizer's model's gradient buffer (imperative path).

@@ -125,6 +125,7 @@ def pipeline_apply(
     broadcast_args: tuple = (),
     batched_args: Optional[Sequence[bool]] = None,
     remat: bool = False,
+    param_specs=None,
 ) -> jax.Array:
     """Run ``x`` through a stack of layers pipelined over ``axis_name``.
 
@@ -137,6 +138,12 @@ def pipeline_apply(
     microbatched with ``x`` and anything else is replicated whole — pass
     ``batched_args`` (one bool per extra) to pin it explicitly when the
     shape heuristic would guess wrong (e.g. a replicated [B, k] table).
+
+    ``param_specs`` (optional pytree of PartitionSpecs, leading entry
+    ``pipe``) composes the stage split with other axes — e.g.
+    ``P("pipe", None, "tensor")`` for Megatron column splits inside each
+    stage; ``layer_fn`` then sees per-device shards and must psum over
+    ``tensor`` itself (it runs under shard_map).
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -156,7 +163,8 @@ def pipeline_apply(
             f"per-shard batch {x.shape[0]}/{d_shards} must divide into {num_microbatches} microbatches"
         )
 
-    param_specs = jax.tree.map(lambda l: P(axis_name), layer_params)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda l: P(axis_name), layer_params)
     x_spec = P(bspec)
     # extras sharing x's batch dim are sharded/microbatched with it
     if batched_args is not None:

@@ -35,6 +35,12 @@ class AcceleratedScheduler:
 
         self.gradient_state = GradientState()
         self._num_data_shards = None
+        # fp16 fast path: skip flags arrive as DEVICE scalars; coercing one
+        # per boundary would stall the host on the in-flight step, so they
+        # queue here and drain in one batched fetch when someone actually
+        # reads the scheduler (get_last_lr/state_dict) or the queue fills
+        self._pending_skips: list = []
+        self._max_pending = 1024
 
     def _data_shards(self) -> int:
         if self._num_data_shards is None:
@@ -55,13 +61,35 @@ class AcceleratedScheduler:
         # only step when gradients were synced (reference: scheduler.py:62)
         if not self.gradient_state.sync_gradients:
             return
-        # skip when the optimizer skipped (fp16 overflow) — reference :69-75
-        for opt in self.optimizers:
-            if getattr(opt, "step_was_skipped", False):
-                return
+        # skip when the optimizer skipped (fp16 overflow) — reference :69-75.
+        # Device-array flags (fast path) are queued, not coerced: bool()
+        # here would force a per-boundary device->host fetch.
+        skips = [getattr(opt, "_step_was_skipped", False) for opt in self.optimizers]
+        if any(not isinstance(s, bool) for s in skips):
+            self._pending_skips.append(skips)
+            if len(self._pending_skips) >= self._max_pending:
+                self._drain()
+            return
+        if any(skips):
+            return
         # one optimizer step consumed num_data_shards batches worth of data
         # (reference multiplies by num_processes, scheduler.py:78-84)
         self._advance(1 if self.split_batches else self._data_shards())
+
+    def _drain(self):
+        """Resolve queued device skip-flags in one batched fetch and apply
+        the corresponding advances."""
+        if not self._pending_skips:
+            return
+        import jax
+        import numpy as np
+
+        pending, self._pending_skips = self._pending_skips, []
+        resolved = jax.device_get(pending)  # one transfer for the whole queue
+        n = 1 if self.split_batches else self._data_shards()
+        for skips in resolved:
+            if not any(bool(np.asarray(s)) for s in skips):
+                self._advance(n)
 
     def _advance(self, n: int):
         self.step_count += n
@@ -70,18 +98,22 @@ class AcceleratedScheduler:
                 self.scheduler.step()
 
     def get_last_lr(self):
+        self._drain()
         if hasattr(self.scheduler, "get_last_lr"):
             return self.scheduler.get_last_lr()
         return [float(self.scheduler(self.step_count))]
 
     def current_lr(self, step: Optional[int] = None) -> float:
+        self._drain()
         s = self.step_count if step is None else step
         if callable(self.scheduler):
             return float(self.scheduler(s))
         return self.get_last_lr()[0]
 
     def state_dict(self) -> dict:
+        self._drain()
         return {"step_count": self.step_count}
 
     def load_state_dict(self, state_dict: dict):
+        self._pending_skips = []
         self.step_count = int(state_dict["step_count"])

@@ -184,14 +184,46 @@ class PartialState:
         if self.is_local_main_process:
             self.wait_for_everyone()
 
+    @staticmethod
+    def _pad_tail(chunk, target: int, full):
+        """Grow ``chunk`` to ``target`` rows by repeating ``full``'s last row.
+        Arrays stay arrays (the reference pads tensors with torch.cat,
+        state.py:446-462); lists/tuples pad to a list."""
+        if target <= len(chunk) or not len(full):
+            return chunk
+        if hasattr(chunk, "shape") and hasattr(chunk, "dtype"):  # np/jax array
+            import numpy as _np
+
+            reps = target - len(chunk)
+            last = full[-1:]
+            if isinstance(chunk, _np.ndarray):
+                return _np.concatenate([chunk] + [_np.asarray(last)] * reps, axis=0)
+            import jax.numpy as jnp
+
+            return jnp.concatenate([chunk] + [jnp.asarray(last)] * reps, axis=0)
+        out = list(chunk)
+        while len(out) < target:
+            out.append(full[-1])
+        return out
+
     @contextmanager
     def split_between_processes(self, inputs, apply_padding: bool = False):
-        """Split a list/dict/array evenly across processes (reference:
-        state.py:417). Yields this process's slice."""
+        """Split a list/tuple/dict/array evenly across processes (reference:
+        state.py:417-506). Yields this process's slice; ``apply_padding``
+        repeats the last element/row so every process gets equal length —
+        tensor inputs are padded as tensors, matching the reference."""
         if self.num_processes == 1:
             yield inputs
             return
-        length = len(inputs)
+        if isinstance(inputs, dict):
+            # split dict VALUES row-wise (len(dict) would count keys);
+            # reference requires equal-length values (state.py:468-474)
+            lengths = {k: len(v) for k, v in inputs.items()}
+            if len(set(lengths.values())) > 1:
+                raise ValueError(f"cannot split dict with unequal value lengths: {lengths}")
+            length = next(iter(lengths.values())) if lengths else 0
+        else:
+            length = len(inputs)
         num_per = length // self.num_processes
         remainder = length % self.num_processes
         start = self.process_index * num_per + min(self.process_index, remainder)
@@ -200,10 +232,12 @@ class PartialState:
             chunk = {k: v[start:end] for k, v in inputs.items()}
         else:
             chunk = inputs[start:end]
-        if apply_padding and not isinstance(chunk, dict):
+        if apply_padding and length:
             target = num_per + (1 if remainder else 0)
-            while len(chunk) < target and length:
-                chunk = list(chunk) + [inputs[-1]]
+            if isinstance(chunk, dict):
+                chunk = {k: self._pad_tail(v, target, inputs[k]) for k, v in chunk.items()}
+            else:
+                chunk = self._pad_tail(chunk, target, inputs)
         yield chunk
 
     def on_main_process(self, function: Callable) -> Callable:

@@ -92,6 +92,95 @@ def run_layout(name: str, mesh_kwargs: dict, epochs: int = 14, precision: str = 
     return accuracy
 
 
+def run_moe_trace(mesh_kwargs: dict, steps: int = 8):
+    """fp32 loss trajectory of a tiny Mixtral under a mesh layout — the
+    expert-axis analogue of the BERT gate (routing + all-to-all dispatch
+    must compute the same global math as pure dp)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.mixtral import MixtralConfig, create_mixtral_model, mixtral_lm_loss
+    from accelerate_tpu.parallel.mesh import batch_sharding, data_parallel_size
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import set_seed
+    from accelerate_tpu.utils.dataclasses import MeshConfig, ParallelismPlugin
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    set_seed(11)
+    acc = Accelerator(
+        mixed_precision="no",
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(**mesh_kwargs)),
+    )
+    seq_len = 16
+    model = acc.prepare_model(create_mixtral_model(MixtralConfig.tiny(), seed=5, seq_len=seq_len))
+    acc.prepare_optimizer(optax.adamw(1e-3))
+    step = acc.build_train_step(lambda p, b: mixtral_lm_loss(p, b, module=model.module))
+    rng = np.random.default_rng(3)
+    global_batch = 16  # fixed GLOBAL batch so every layout sees identical data
+    assert global_batch % data_parallel_size(acc.mesh) == 0
+    losses = []
+    for _ in range(steps):
+        ids = rng.integers(0, 250, size=(global_batch, seq_len)).astype(np.int32)
+        batch = jax.device_put({"input_ids": ids}, batch_sharding(acc.mesh))
+        losses.append(float(step(batch)))
+    return losses
+
+
+def run_pipe_trace(mesh_kwargs: dict, steps: int = 8):
+    """fp32 loss trajectory of a stacked-MLP regression trained through
+    ``pipeline_apply`` — pipe=1 falls back to the plain layer scan, so the
+    {pipe: k} trace vs {data: n} trace is exactly 'pipelining must not
+    change the math'."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.parallel.mesh import MeshConfig, batch_sharding
+    from accelerate_tpu.parallel.pipeline import pipeline_apply, stage_sharding
+
+    mesh = MeshConfig(**mesh_kwargs).build()
+    width, layers, batch = 16, 4, 16
+    ks = jax.random.split(jax.random.key(0), 2)
+    params = {
+        "w": jax.random.normal(ks[0], (layers, width, width)) * 0.1,
+        "b": jnp.zeros((layers, width)),
+    }
+    n_pipe = mesh.shape.get("pipe", 1)
+    sharding = stage_sharding(mesh) if n_pipe > 1 else NamedSharding(mesh, P())
+    params = jax.tree.map(lambda l: jax.device_put(l, sharding), params)
+
+    def layer_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"]) + h
+
+    opt = optax.adamw(1e-2)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(4)
+
+    def loss_fn(p, x):
+        return jnp.mean((pipeline_apply(layer_fn, p, x, mesh=mesh, num_microbatches=2) - 1.0) ** 2)
+
+    @jax.jit
+    def train_step(p, s, x):
+        loss, g = jax.value_and_grad(loss_fn)(p, x)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    losses = []
+    for _ in range(steps):
+        x = jax.device_put(
+            rng.standard_normal((batch, width)).astype(np.float32), batch_sharding(mesh)
+        )
+        params, opt_state, loss = train_step(params, opt_state, x)
+        losses.append(float(loss))
+    return losses
+
+
 def main():
     import jax
 
@@ -119,6 +208,31 @@ def main():
     base = traces.pop("dp")
     for name, trace in traces.items():
         np.testing.assert_allclose(trace, base, rtol=1e-5, err_msg=f"fp32 trajectory of {name} diverged from dp")
+
+    # pipe and expert axes: same identical-trajectory contract, on the
+    # programs that actually use them (GPipe schedule; MoE dispatch)
+    if n_dev >= 8:
+        moe_dp = run_moe_trace({"data": 8})
+        for name, mesh_kwargs in {
+            "dp_x_ep": {"expert": 2, "data": 4},
+            "ep_x_tp": {"expert": 2, "tensor": 2, "data": 2},
+        }.items():
+            np.testing.assert_allclose(
+                run_moe_trace(mesh_kwargs), moe_dp, rtol=2e-4,
+                err_msg=f"fp32 MoE trajectory of {name} diverged from dp",
+            )
+        print(f"test_performance: MoE expert-axis trajectories match dp {moe_dp[:3]}...")
+
+        pipe_dp = run_pipe_trace({"data": 8})
+        for name, mesh_kwargs in {
+            "dp_x_pp2": {"pipe": 2, "data": 4},
+            "pp4": {"pipe": 4, "data": 2},
+        }.items():
+            np.testing.assert_allclose(
+                run_pipe_trace(mesh_kwargs), pipe_dp, rtol=1e-5,
+                err_msg=f"fp32 pipeline trajectory of {name} diverged from dp",
+            )
+        print(f"test_performance: GPipe pipe-axis trajectories match dp {pipe_dp[:3]}...")
 
     failures = [f"{k}: {v:.3f} < {ACCURACY_FLOOR}" for k, v in scores.items() if v < ACCURACY_FLOOR]
     assert not failures, f"accuracy regression: {failures}"

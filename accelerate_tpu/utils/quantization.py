@@ -132,19 +132,32 @@ def quantize(x: jax.Array, config: QuantizationConfig) -> QTensor:
         q = _pack4(q + 8)  # store as unsigned nibbles
     else:  # nf4
         norm = xg / scale
-        idx = jnp.argmin(jnp.abs(norm[..., None] - jnp.asarray(NF4_CODE)), axis=-1).astype(jnp.int8)
+        # nearest-code lookup via searchsorted over the midpoints between
+        # adjacent (sorted) codes: O(log 16) compares and no [..., 16]
+        # broadcast — an argmin over the codebook materialises a 16x copy
+        # of the weight tensor, which OOMs HBM on GB-scale conversions
+        mids = jnp.asarray((NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0)
+        idx = jnp.searchsorted(mids, norm).astype(jnp.int8)
         q = _pack4(idx)
     return QTensor(q, scale.astype(jnp.float32), orig_shape, orig_dtype, config.method, config.group_size)
 
 
+def grouped_dequantize(data: jax.Array, scale: jax.Array, method: str) -> jax.Array:
+    """Decode grouped codes ``[..., n_groups, g(, packed), out]`` + scales to
+    float ``[..., n_groups, g, out]`` — the single copy of the per-method
+    decode used by :func:`dequantize` and the in-scan ``QuantDense``."""
+    if method == "int8":
+        return data.astype(jnp.float32) * scale
+    if method == "int4":
+        return (_unpack4(data).astype(jnp.float32) - 8.0) * scale
+    if method == "nf4":
+        return jnp.asarray(NF4_CODE)[_unpack4(data)] * scale
+    raise ValueError(f"method must be int8|int4|nf4, got {method!r}")
+
+
 def dequantize(qt: QTensor, dtype=None) -> jax.Array:
     dtype = dtype or qt.dtype
-    if qt.method == "int8":
-        xg = qt.data.astype(jnp.float32) * qt.scale
-    elif qt.method == "int4":
-        xg = (_unpack4(qt.data).astype(jnp.float32) - 8.0) * qt.scale
-    else:  # nf4
-        xg = jnp.asarray(NF4_CODE)[_unpack4(qt.data)] * qt.scale
+    xg = grouped_dequantize(qt.data, qt.scale, qt.method)
     x = xg.reshape(*xg.shape[:-3], xg.shape[-3] * xg.shape[-2], xg.shape[-1])
     return x.reshape(qt.shape).astype(dtype)
 
@@ -227,13 +240,21 @@ def quantized_bytes(params: Any) -> int:
 
 def load_and_quantize_model(model, config: Optional[QuantizationConfig] = None):
     """Quantize a :class:`~accelerate_tpu.modeling.Model`'s params in place of
-    the fp copies (API parity: reference utils/bnb.py:44). The returned
-    model's ``apply_fn`` dequantizes on the fly inside jit; with
-    scan-over-layers models the stacked int weights stay packed in HBM and
-    XLA materialises at most one layer's fp weights at a time."""
+    the fp copies (API parity: reference utils/bnb.py:44).
+
+    Zoo models that support it (llama family) are rebuilt with in-scan
+    ``QuantDense`` layers — the packed codes are the params, dequant runs
+    per layer inside the scan, and decode HBM traffic drops to the packed
+    bytes. Other models fall back to a wrapped ``apply_fn`` that
+    dequantizes the tree on the fly inside jit."""
     from ..modeling import Model
 
     config = config or QuantizationConfig()
+    cfg_obj = getattr(model, "config", None)
+    if cfg_obj is not None and hasattr(cfg_obj, "quant_method") and getattr(model, "module", None) is not None:
+        from ..models.llama import quantize_llama_model
+
+        return quantize_llama_model(model, config)
     qparams = quantize_params(model.params, config)
     dtype = jnp.dtype(config.compute_dtype)
     base_apply = model.apply_fn

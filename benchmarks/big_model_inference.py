@@ -43,6 +43,7 @@ def hbm_used_bytes():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true", help="CPU smoke mode")
+    ap.add_argument("--decode-only", action="store_true", help="skip the save/load rows")
     args = ap.parse_args()
 
     import jax
@@ -74,28 +75,63 @@ def main():
     acc = Accelerator(mixed_precision="bf16")
 
     # --- save / load_checkpoint_and_dispatch ---------------------------- #
-    ckpt_model = acc.prepare_model(create_llama_model(ckpt_cfg, seed=1, seq_len=prompt_len))
-    ckpt_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(ckpt_model.params))
-    with tempfile.TemporaryDirectory() as td:
-        path = os.path.join(td, "model")
-        t0 = time.perf_counter()
-        acc.save_model(ckpt_model, path)
-        save_s = time.perf_counter() - t0
-        from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    ckpt_params, save_s, load_s = 0, 0.0, 0.0
+    if not args.decode_only:
+        ckpt_model = acc.prepare_model(create_llama_model(ckpt_cfg, seed=1, seq_len=prompt_len))
+        ckpt_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(ckpt_model.params))
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "model")
+            t0 = time.perf_counter()
+            acc.save_model(ckpt_model, path)
+            save_s = time.perf_counter() - t0
+            from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
 
-        t0 = time.perf_counter()
-        dispatched = load_checkpoint_and_dispatch(ckpt_model, path, device_map="auto")
-        load_s = time.perf_counter() - t0
-        assert dispatched is not None
+            t0 = time.perf_counter()
+            dispatched = load_checkpoint_and_dispatch(ckpt_model, path, device_map="auto")
+            load_s = time.perf_counter() - t0
+            assert dispatched is not None
+        # return the ckpt model's HBM before the decode model arrives
+        from accelerate_tpu.utils.memory import release_memory
 
-    # --- decode latency -------------------------------------------------- #
-    model = acc.prepare_model(create_llama_model(decode_cfg, seq_len=prompt_len))
+        ckpt_model, dispatched = release_memory(ckpt_model, dispatched)
+
+    # --- decode latency: bf16 vs weight-only quantized ------------------- #
+    # quantize AFTER prepare: the bf16 policy casts the float kernels, then
+    # conversion derives fresh fp32 scales from the cast weights
+    from accelerate_tpu.utils.quantization import QuantizationConfig, load_and_quantize_model
+
+    model = acc.prepare_model(create_llama_model(decode_cfg, seed=3, seq_len=prompt_len))
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(model.params))
     hbm = hbm_used_bytes()
     ids = np.ones((1, prompt_len), np.int32)
     out = generate(model, ids, max_new_tokens=new_tokens)  # compile + run
     assert out.shape == (1, prompt_len + new_tokens)
+    ref_logits = np.asarray(model.apply_fn(model.params, ids), np.float32)[0]
     tok_s = per_token_latency(model, batch_size=1, prompt_len=prompt_len, n_tokens=min(16, new_tokens))
+
+    quant_rows = {}
+    for method, bits, gs in [("int8", 8, None), ("nf4", 4, 64)]:
+        qmodel = load_and_quantize_model(model, QuantizationConfig(bits=bits, method=method, group_size=gs))
+        q_logits = np.asarray(qmodel.apply_fn(qmodel.params, ids), np.float32)[0]
+        # on the randomly-initialised bench model the top1-top2 gap is
+        # smaller than an honest 4-bit perturbation, so raw argmax
+        # agreement is degenerate; report the logit error relative to the
+        # logit scale AND relative to the decision gap (>1 gap units could
+        # flip a real model's argmax; << 1 could not)
+        rel = float(np.linalg.norm(q_logits - ref_logits) / max(np.linalg.norm(ref_logits), 1e-9))
+        sorted2 = np.sort(ref_logits, axis=-1)[..., -2:]
+        gap = float(np.mean(sorted2[..., 1] - sorted2[..., 0]))
+        err_vs_gap = float(np.mean(np.abs(q_logits - ref_logits)) / max(gap, 1e-9))
+        top1 = float(np.mean(q_logits.argmax(-1) == ref_logits.argmax(-1)))
+        q_tok_s = per_token_latency(qmodel, batch_size=1, prompt_len=prompt_len, n_tokens=min(16, new_tokens))
+        quant_rows[method] = {
+            "per_token_s": round(q_tok_s, 5),
+            "tokens_per_sec": round(1.0 / q_tok_s, 1) if q_tok_s else None,
+            "speedup_vs_bf16": round(tok_s / q_tok_s, 2) if q_tok_s else None,
+            "prefill_logits_rel_err": round(rel, 4),
+            "prefill_err_vs_argmax_gap": round(err_vs_gap, 3),
+            "prefill_top1_agreement": round(top1, 4),
+        }
 
     print(
         json.dumps(
@@ -107,6 +143,7 @@ def main():
                 "decode_params_b": round(n_params / 1e9, 3),
                 "per_token_s": round(tok_s, 5),
                 "tokens_per_sec": round(1.0 / tok_s, 1) if tok_s else None,
+                "quantized": quant_rows,
                 "hbm_gb": round(hbm / 2**30, 2),
                 "device": str(jax.devices()[0].device_kind),
                 "reference_baseline": "GPT-J-6B fp16 0.05 s/token (2x Titan RTX)",

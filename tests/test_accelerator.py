@@ -303,3 +303,29 @@ def test_build_eval_step_applies_dtype_policy():
     eval_step = acc.build_eval_step(apply_fn)
     out = eval_step(np.ones((2, 4), np.float32))
     assert str(seen["dtype"]) == "bfloat16", seen
+
+
+def test_fp16_scale_lives_on_device_and_backs_off():
+    """The fast path keeps the dynamic loss scale as a carried device array:
+    an overflow batch halves it ON DEVICE, the update is skipped (params
+    unmoved), and step_was_skipped is a device value coerced only on read."""
+    acc = Accelerator(mixed_precision="fp16")
+    model = acc.prepare_model(RegressionModel())
+    opt = acc.prepare_optimizer(optax.sgd(0.1))
+    step = acc.build_train_step(linear_loss_fn)
+    ds = RegressionDataset(length=16)
+    good = {"x": ds.x, "y": ds.y}
+    step(good)
+    params_before = jax.tree_util.tree_map(np.asarray, model.params)
+
+    bad = {"x": ds.x, "y": np.full_like(ds.y, np.float16(1e30))}  # overflow grads
+    step(bad)
+    # lazy device value: stored as a jax array, coerced by the property
+    assert not isinstance(opt._step_was_skipped, bool)
+    assert opt.step_was_skipped is True
+    for k, v in model.params.items():
+        np.testing.assert_array_equal(np.asarray(v), params_before[k])
+
+    # a later good step proceeds (scale backed off, update applies again)
+    step(good)
+    assert opt.step_was_skipped is False

@@ -248,6 +248,138 @@ def test_index_math_sharded_mesh(mesh8):
         assert set(range(length)) <= set(int(v) for v in seen)
 
 
+# ---------------------------------------------------------------------- #
+# Mode-equivalence matrix: the iterable loader and the dispatcher must
+# produce exactly the batches the map-style shard loader produces, across
+# batch_size x drop_last x even_batches x split_batches x skip, including
+# mid-epoch resume (reference: test_data_loader.py dispatcher/iterable
+# sweeps + test_sync.py resume).
+# ---------------------------------------------------------------------- #
+
+
+def _make_loader(kind, length, **kw):
+    """kind: map | iterable | dispatch_map | dispatch_iter — all host-only."""
+    from accelerate_tpu.data_loader import DataLoaderDispatcher
+
+    kw.setdefault("device_placement", False)
+    if kind == "map":
+        return DataLoaderShard(ToyDataset(length), **kw)
+    if kind == "iterable":
+        return IterableDataLoaderShard([{"x": np.float32(i)} for i in range(length)], **kw)
+    if kind == "dispatch_map":
+        return DataLoaderDispatcher(DataLoaderShard(ToyDataset(length), **kw))
+    if kind == "dispatch_iter":
+        return DataLoaderDispatcher(
+            IterableDataLoaderShard([{"x": np.float32(i)} for i in range(length)], **kw)
+        )
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("kind", ["iterable", "dispatch_map", "dispatch_iter"])
+@pytest.mark.parametrize("drop_last", [False, True])
+@pytest.mark.parametrize("even_batches", [False, True])
+def test_mode_equivalence_matrix(kind, drop_last, even_batches):
+    """Every non-map mode yields the same index stream as the map loader."""
+    for length in (1, 7, 16, 20, 33):
+        for batch_size in (1, 4, 8):
+            for split_batches in (False, True):
+                kw = dict(
+                    batch_size=batch_size,
+                    drop_last=drop_last,
+                    even_batches=even_batches,
+                    split_batches=split_batches,
+                )
+                ref = _host_batches(_make_loader("map", length, **kw))
+                got = _host_batches(_make_loader(kind, length, **kw))
+                assert got == ref, (
+                    f"{kind} len={length} bs={batch_size} drop={drop_last} "
+                    f"even={even_batches} split={split_batches}: {got} != {ref}"
+                )
+
+
+@pytest.mark.parametrize("kind", ["map", "iterable", "dispatch_map", "dispatch_iter"])
+@pytest.mark.parametrize("drop_last", [False, True])
+def test_skip_first_batches_matrix(kind, drop_last):
+    """skip_first_batches(k) == uninterrupted[k:], for every k through (and
+    past) the end, in every mode. The k-lands-on-the-tail corner included."""
+    for length, batch_size in ((20, 8), (33, 8), (16, 4)):
+        full = _host_batches(
+            _make_loader(kind, length, batch_size=batch_size, drop_last=drop_last)
+        )
+        for k in range(len(full) + 2):
+            dl = _make_loader(kind, length, batch_size=batch_size, drop_last=drop_last)
+            skip_first_batches(dl, k)
+            got = _host_batches(dl)
+            assert got == full[k:], f"{kind} len={length} drop={drop_last} skip={k}"
+            # skip is consumed: the next epoch is complete again
+            assert _host_batches(dl) == full, f"{kind} skip not reset after epoch"
+
+
+@pytest.mark.parametrize("kind", ["map", "iterable", "dispatch_map", "dispatch_iter"])
+def test_state_dict_resume_matrix(kind):
+    """Break mid-epoch, save state, rebuild, load: the resumed run must
+    deliver exactly the remaining batches (the dispatch+resume corner)."""
+    length, batch_size, stop_after = 33, 4, 3
+    full = _host_batches(_make_loader(kind, length, batch_size=batch_size))
+    dl = _make_loader(kind, length, batch_size=batch_size)
+    seen = []
+    for b in dl:
+        seen.append([int(v) for v in np.asarray(b["x"]).ravel()])
+        if len(seen) == stop_after:
+            break
+    state = dl.state_dict()
+    assert state["batches_yielded"] == stop_after
+
+    dl2 = _make_loader(kind, length, batch_size=batch_size)
+    dl2.load_state_dict(state)
+    resumed = _host_batches(dl2)
+    assert seen + resumed == full, f"{kind}: resume diverged"
+
+
+@pytest.mark.parametrize("kind", ["map", "iterable"])
+def test_remainder_matrix(kind):
+    """remainder reports REAL rows of the padded tail (or -1 when exact),
+    for both padding policies, in shard and dispatch modes."""
+    import math
+
+    gs = GradientState()
+    for length, batch_size, even in ((20, 8, True), (20, 8, False), (16, 8, True), (3, 8, True)):
+        dl = _make_loader(kind, length, batch_size=batch_size, even_batches=even)
+        tail_remainder = None
+        for _ in dl:
+            if gs.end_of_dataloader:
+                tail_remainder = gs.remainder
+        rem = length % dl.total_batch_size
+        # remainder = real rows of the tail, but only when the tail was
+        # actually padded (gather_for_metrics truncation); an unpadded short
+        # tail (even_batches=False on a shard-multiple) reports -1
+        padded_to = dl.total_batch_size if even else math.ceil(rem / dl._num_shards()) * dl._num_shards()
+        expect = rem if (rem and padded_to != rem) else -1
+        assert tail_remainder == expect, (kind, length, batch_size, even)
+
+
+def test_iterable_split_batches_means_global():
+    """split_batches: batch_size IS the global batch (reference
+    data_loader.py:996 semantics), identically for the iterable loader."""
+    dl = _make_loader("iterable", 16, batch_size=8, split_batches=True)
+    assert dl.total_batch_size == 8
+    assert [len(b) for b in _host_batches(dl)] == [8, 8]
+
+
+def test_prepare_data_loader_dispatch_iterable():
+    """prepare_data_loader(dispatch_batches=True) accepts a pure stream."""
+    from accelerate_tpu.data_loader import DataLoaderDispatcher
+
+    def gen():
+        for i in range(20):
+            yield {"x": np.float32(i)}
+
+    dl = prepare_data_loader(gen(), batch_size=4, dispatch_batches=True, put_on_device=False)
+    assert isinstance(dl, DataLoaderDispatcher)
+    batches = _host_batches(dl)
+    assert batches[0] == [0, 1, 2, 3]
+
+
 def test_even_batches_false_pads_to_shard_multiple(mesh8):
     """even_batches=False: the tail batch shrinks to ceil(rem/shards)*shards
     (static shapes — never ragged) instead of the full global batch."""

@@ -105,6 +105,80 @@ def test_load_and_quantize_model_end_to_end():
     assert quantized_bytes(qmodel.params) < model.parameter_bytes() * 0.55
 
 
+def test_load_and_quantize_model_uses_in_scan_qdense():
+    """Llama models convert to the QuantDense layout: packed codes ARE the
+    params (sliced per layer by nn.scan), not a wrapped dequantize."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    model = create_llama_model(LlamaConfig.tiny(scan_layers=True, remat=False), seq_len=16)
+    qmodel = load_and_quantize_model(model, QuantizationConfig(bits=8))
+    assert qmodel.config.quant_method == "int8"
+    blk = qmodel.params["layers"]["block"]
+    qdata = blk["attn"]["q_proj"]["qdata"]
+    assert qdata.dtype == jnp.int8
+    assert qdata.shape[0] == model.config.num_hidden_layers  # stacked layer dim
+    assert "kernel" not in blk["attn"]["q_proj"]
+    # non-projection leaves stay float
+    assert qmodel.params["embed_tokens"]["embedding"].dtype == model.params["embed_tokens"]["embedding"].dtype
+
+
+@pytest.mark.parametrize("method,group_size", [("int8", None), ("nf4", 16)])
+def test_qdense_matches_dequantized_matmul(method, group_size):
+    from accelerate_tpu.ops.qdense import QuantDense
+
+    w = _w((64, 48), seed=7)
+    x = _w((4, 64), seed=8, scale=1.0)
+    qt = quantize(w, QuantizationConfig(method=method, group_size=group_size, bits=8 if method == "int8" else 4))
+    layer = QuantDense(48, method=method, group_size=group_size, dtype=jnp.float32)
+    out = layer.apply({"params": {"qdata": qt.data, "qscale": qt.scale}}, x)
+    ref = x @ dequantize(qt)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_quantized_decode_matches_bf16_decode(scan_layers):
+    """generate() through QuantDense stays close to the unquantized model:
+    the prefill logits agree and greedy decode runs the full KV-cache loop."""
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    model = create_llama_model(LlamaConfig.tiny(scan_layers=scan_layers, remat=False), seq_len=16)
+    qmodel = load_and_quantize_model(model, QuantizationConfig(bits=8))
+    ids = (np.arange(2 * 8).reshape(2, 8) % 250).astype(np.int32)
+
+    ref_logits, _ = model.apply_fn(model.params, jnp.asarray(ids), decode=True, cache=None)
+    q_logits, _ = qmodel.apply_fn(qmodel.params, jnp.asarray(ids), decode=True, cache=None)
+    np.testing.assert_allclose(np.asarray(q_logits, np.float32), np.asarray(ref_logits, np.float32), atol=0.35, rtol=0.5)
+
+    out = generate(qmodel, ids, max_new_tokens=4)
+    assert out.shape == (2, 12)
+    assert np.array_equal(np.asarray(out[:, :8]), ids)
+
+
+def test_quantized_model_shards_on_tensor_axis():
+    """The qdata/qscale sharding rules put column-parallel splits on the
+    trailing (out) dim and row-parallel splits on the group dim."""
+    from accelerate_tpu import Accelerator, ParallelismPlugin
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    acc = Accelerator(parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(data=4, tensor=2)))
+    model = create_llama_model(LlamaConfig.tiny(scan_layers=True, remat=False), seq_len=16)
+    qmodel = load_and_quantize_model(model, QuantizationConfig(bits=8))
+    qmodel = acc.prepare_model(qmodel)
+    blk = qmodel.params["layers"]["block"]
+    q_spec = blk["attn"]["q_proj"]["qdata"].sharding.spec
+    o_spec = blk["attn"]["o_proj"]["qdata"].sharding.spec
+    assert q_spec[-1] == "tensor", q_spec
+    # row-parallel: the group (contraction) dim, index 2 of [L, n_g, g, out],
+    # carries ``tensor``; the out dim is unsharded (trailing Nones may be
+    # trimmed from the spec)
+    assert tuple(o_spec)[:3] == (None, None, "tensor") and (len(o_spec) < 4 or o_spec[3] is None), o_spec
+    ids = (np.arange(4 * 16).reshape(4, 16) % 250).astype(np.int32)
+    out = jax.jit(qmodel.apply_fn)(qmodel.params, ids)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
 def test_fp8_quantize_and_dot():
     x = _w((32, 64), seed=3, scale=1.0)
     x8, inv = fp8_quantize(x)

@@ -27,6 +27,35 @@ def test_split_between_processes_single():
         assert chunk == [1, 2, 3]
 
 
+def test_split_between_processes_padding_matrix():
+    """Reference state.py:417-506 semantics across faked ranks: uneven list
+    split, tensor inputs padded AS TENSORS with the last row, dict values
+    padded per-key."""
+    import numpy as np
+
+    state = PartialState()
+    state.num_processes_host = 4
+    arr = np.arange(10 * 3, dtype=np.float32).reshape(10, 3)
+    for rank, expect_rows in ((0, 3), (1, 3), (2, 2), (3, 2)):
+        state.process_index_host = rank
+        # list without padding: uneven split, first `remainder` ranks get +1
+        with state.split_between_processes(list(range(10))) as chunk:
+            assert len(chunk) == expect_rows, (rank, chunk)
+        # tensor with padding: equal rows everywhere, pad = repeated last row
+        with state.split_between_processes(arr, apply_padding=True) as chunk:
+            assert isinstance(chunk, np.ndarray), type(chunk)
+            assert chunk.shape == (3, 3), (rank, chunk.shape)
+            if expect_rows == 2:
+                np.testing.assert_array_equal(chunk[-1], arr[-1])
+        # dict of tensors with padding
+        with state.split_between_processes({"x": arr.copy()}, apply_padding=True) as chunk:
+            assert chunk["x"].shape == (3, 3)
+    # degenerate: fewer items than processes
+    state.process_index_host = 3
+    with state.split_between_processes([7, 8], apply_padding=True) as chunk:
+        assert chunk == [8], chunk  # empty slice padded with the last item
+
+
 def test_on_main_process_decorator():
     state = PartialState()
     calls = []
