@@ -12,11 +12,14 @@ quality:
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi
 
-test:
+test:  # fast tier (addopts excludes -m slow)
 	python -m pytest tests/ -q
 
-test-fast:
-	python -m pytest tests/ -q -m "not slow"
+test-slow:  # subprocess/integration tier
+	python -m pytest tests/ -q -m slow --override-ini addopts=""
+
+test-all:
+	python -m pytest tests/ -q -m "" --override-ini addopts=""
 
 test-cli:
 	python -m pytest tests/test_cli.py -q

@@ -90,6 +90,7 @@ class Accelerator:
         self.scaler_handler = GradScalerKwargs()
         self.profile_handler = ProfileKwargs()
         self.init_handler = DistributedInitKwargs()
+        self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, AutocastKwargs):
                 self.autocast_handler = handler
@@ -99,6 +100,11 @@ class Accelerator:
                 self.profile_handler = handler
             elif isinstance(handler, DistributedInitKwargs):
                 self.init_handler = handler
+            else:
+                from .utils.dataclasses import Fp8RecipeKwargs
+
+                if isinstance(handler, Fp8RecipeKwargs):
+                    self.fp8_recipe_handler = handler
 
         if gradient_accumulation_plugin is None:
             env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
@@ -126,6 +132,18 @@ class Accelerator:
             **init_kwargs,
         )
         self.gradient_state = GradientState(gradient_accumulation_plugin)
+        if getattr(self.state.dtype_policy, "fp8", False):
+            # attach the recipe where trace-time code (the zoo's dense
+            # factory) can reach it: the globally-visible dtype policy.
+            # Delayed scaling is OPT-IN via an explicit Fp8RecipeKwargs —
+            # bare mixed_precision="fp8" keeps the stateless dynamic recipe
+            # (delayed needs the fp8 collection threaded as model.state,
+            # which plain generate()/loss paths don't do)
+            from .utils.dataclasses import Fp8RecipeKwargs
+
+            self.state.dtype_policy.fp8_recipe = self.fp8_recipe_handler or Fp8RecipeKwargs(
+                delayed_scaling=False
+            )
         self.device_placement = device_placement
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
         self.rng_types = rng_types or ["numpy", "python"]
@@ -612,6 +630,17 @@ class Accelerator:
         backoff_factor = float(getattr(h, "backoff_factor", 0.5))
         growth_interval = int(getattr(h, "growth_interval", 2000))
 
+        compress_method = getattr(self.state.parallelism_plugin, "grad_compression", None)
+        if compress_method is not None:
+            if has_state or has_aux:
+                raise ValueError("grad_compression does not compose with has_state/has_aux yet")
+            bad = [a for a, s in dict(self.mesh.shape).items() if s > 1 and a != "data"]
+            if bad:
+                raise ValueError(
+                    f"grad_compression reduces over the 'data' axis only; shard-bearing axes {bad} "
+                    "would need their own reduction semantics"
+                )
+
         def step_fn(params, opt_state, grad_buf, mstate, batch, scale_state, do_sync, rng, clip_norm):
             loss_scale = scale_state["scale"]
 
@@ -625,7 +654,33 @@ class Accelerator:
                     new_state = mstate
                 return loss.astype(jnp.float32) * loss_scale, (loss, new_state, aux)
 
-            grads, (loss, new_state, aux) = jax.grad(scaled_loss, has_aux=True)(params)
+            if compress_method is not None:
+                # explicit per-shard grads + compressed psum (the DDP comm
+                # hook analogue) instead of XLA's implicit f32 reduction
+                from jax.sharding import PartitionSpec as P
+
+                from .parallel.compression import compressed_psum_mean
+
+                def local_grads(p, local_batch, ls, key):
+                    def local_loss(q):
+                        out = call_loss(compute_cast(q), None, local_batch, key)
+                        return out.astype(jnp.float32) * ls, out
+
+                    g, local_l = jax.grad(local_loss, has_aux=True)(p)
+                    g = compressed_psum_mean(g, "data", compress_method)
+                    return g, jax.lax.pmean(local_l, "data")
+
+                sm = jax.shard_map(
+                    local_grads,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(("data", "fsdp")), P(), P()),
+                    out_specs=(P(), P()),
+                    check_vma=False,
+                )
+                grads, loss = sm(params, batch, loss_scale, rng)
+                new_state, aux = mstate, None
+            else:
+                grads, (loss, new_state, aux) = jax.grad(scaled_loss, has_aux=True)(params)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / (loss_scale * accum), grads)
             grad_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
 

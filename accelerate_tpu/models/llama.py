@@ -105,13 +105,27 @@ ACTIVATION_SPEC = P(("data", "fsdp"), "seq", None)
 
 
 def _dense(cfg: "LlamaConfig", features: int, name: str, dtype):
-    """Block projection factory: plain Dense, or QuantDense when the config
-    carries a weight-only quantization method."""
+    """Block projection factory: plain Dense, QuantDense when the config
+    carries a weight-only quantization method, or FP8Dense when the active
+    precision policy requests the delayed-scaling fp8 recipe (amax
+    histories in the ``fp8`` collection -> ``model.state``)."""
     if cfg.quant_method is not None:
         from ..ops.qdense import QuantDense
 
         return QuantDense(
             features, method=cfg.quant_method, group_size=cfg.quant_group_size, dtype=dtype, name=name
+        )
+    from ..ops.fp8 import FP8Dense, fp8_recipe
+
+    recipe = fp8_recipe()
+    if recipe is not None and recipe.delayed_scaling:
+        return FP8Dense(
+            features,
+            name=name,
+            dtype=dtype,
+            amax_history_len=recipe.amax_history_len,
+            amax_compute_algo=recipe.amax_compute_algo,
+            margin=recipe.margin,
         )
     return nn.Dense(features, use_bias=False, name=name, dtype=dtype, dot_general=_pdg())
 
@@ -252,7 +266,7 @@ class LlamaModel(nn.Module):
             layer_cls = nn.remat(_ScanLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else _ScanLayer
             scanned = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "fp8": 0},
                 split_rngs={"params": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_hidden_layers,
@@ -267,21 +281,32 @@ class LlamaModel(nn.Module):
         return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
 
 
-def _wrap_llama(module: LlamaModel, params, config: LlamaConfig) -> Model:
-    def apply_fn(p, input_ids, positions=None, decode=False, cache=None):
+def _wrap_llama(module: LlamaModel, params, config: LlamaConfig, state=None) -> Model:
+    def apply_fn(p, input_ids, positions=None, decode=False, cache=None, state=None):
         """decode=True threads the KV cache: pass ``cache`` (or None to
-        initialise) and receive ``(logits, new_cache)``."""
+        initialise) and receive ``(logits, new_cache)``. ``state`` threads
+        non-param collections (the fp8 amax histories): returns
+        ``(logits, new_state)``."""
         if decode:
-            variables = {"params": p}
+            variables = {"params": p, **(state or {})}
             if cache is not None:
                 variables["cache"] = cache
-            logits, mutated = module.apply(variables, input_ids, positions, True, mutable=["cache"])
+            # non-param collections (fp8 amax histories) must be mutable
+            # too — their per-step updates are discarded during decode
+            logits, mutated = module.apply(
+                variables, input_ids, positions, True, mutable=["cache", *(state or {})]
+            )
             return logits, mutated["cache"]
+        if state:
+            variables = {"params": p, **state}
+            logits, new_state = module.apply(variables, input_ids, positions, mutable=list(state.keys()))
+            return logits, dict(new_state)
         return module.apply({"params": p}, input_ids, positions)
 
     model = Model(apply_fn, params, sharding_rules=LLAMA_SHARDING_RULES, name="llama")
     model.config = config
     model.module = module
+    model.state = state
     return model
 
 
@@ -289,8 +314,18 @@ def create_llama_model(config: Optional[LlamaConfig] = None, seed: int = 0, seq_
     config = config or LlamaConfig.tiny()
     module = LlamaModel(config)
     dummy = jnp.zeros((2, seq_len), jnp.int32)
-    params = module.init(jax.random.key(seed), dummy)["params"]
-    return _wrap_llama(module, params, config)
+    variables = module.init(jax.random.key(seed), dummy)
+    params = variables["params"]
+    state = {k: v for k, v in variables.items() if k != "params"} or None
+    return _wrap_llama(module, params, config, state=state)
+
+
+def causal_lm_loss_state(params, state, batch, apply_fn):
+    """:func:`causal_lm_loss` for stateful models (fp8 delayed scaling):
+    ``build_train_step(has_state=True)`` contract — returns
+    ``(loss, new_state)``."""
+    logits, new_state = apply_fn(params, batch["input_ids"], state=state)
+    return next_token_cross_entropy(logits, batch), new_state
 
 
 _PROJ_RE = re.compile(r"^(q|k|v|o|gate|up|down)_proj$")

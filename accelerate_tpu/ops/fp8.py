@@ -15,8 +15,11 @@ non-differentiable scale bookkeeping.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
+from flax import linen as nn
 
 from ..utils.quantization import fp8_quantize as _quantize
 
@@ -81,6 +84,114 @@ def fp8_enabled() -> bool:
         return False
     policy = state.get("dtype_policy")
     return bool(policy is not None and getattr(policy, "fp8", False))
+
+
+def fp8_recipe():
+    """The active :class:`~accelerate_tpu.utils.dataclasses.Fp8RecipeKwargs`
+    (None when fp8 is off)."""
+    from ..state import AcceleratorState
+
+    if not fp8_enabled():
+        return None
+    policy = AcceleratorState._shared_state.get("dtype_policy")
+    recipe = getattr(policy, "fp8_recipe", None)
+    if recipe is None:
+        from ..utils.dataclasses import Fp8RecipeKwargs
+
+        recipe = Fp8RecipeKwargs()
+    return recipe
+
+
+# --------------------------------------------------------------------------- #
+# delayed (amax-history) scaling — the TE "DelayedScaling" recipe
+# --------------------------------------------------------------------------- #
+
+E4M3_MAX = 448.0
+
+
+@jax.custom_vjp
+def _fp8_delayed_matmul(lhs, rhs, scale_l, scale_r):
+    """``lhs @ rhs`` quantized with PRE-COMPUTED scales (from the amax
+    history), e4m3 forward / fp32 accumulation. Out-of-range values clip —
+    the history absorbs the new amax so the next step's scale adapts."""
+    l8 = jnp.clip(lhs.astype(jnp.float32) * scale_l, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+    r8 = jnp.clip(rhs.astype(jnp.float32) * scale_r, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+    y = jax.lax.dot_general(
+        l8, r8, (((lhs.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return (y / (scale_l * scale_r)).astype(lhs.dtype)
+
+
+def _fp8_delayed_fwd(lhs, rhs, scale_l, scale_r):
+    return _fp8_delayed_matmul(lhs, rhs, scale_l, scale_r), (lhs, rhs)
+
+
+def _fp8_delayed_bwd(res, g):
+    # gradients keep the dynamic e5m2 path (grad magnitudes move too fast
+    # for a useful history; TE's hybrid format choice)
+    lhs, rhs = res
+    dlhs, drhs = _fp8_matmul_bwd((lhs, rhs), g)
+    return dlhs, drhs, None, None
+
+
+_fp8_delayed_matmul.defvjp(_fp8_delayed_fwd, _fp8_delayed_bwd)
+
+
+def scale_from_history(history: jax.Array, margin: int = 0, algo: str = "max") -> jax.Array:
+    """TE DelayedScaling: ``scale = fmax / (amax * 2**margin)`` with amax
+    taken over the rolling history (or its newest entry). A zero amax —
+    unwarmed history slots, or an all-zero tensor (the init dummy input) —
+    yields the neutral scale 1.0 rather than a ~1e14 blowup that clips
+    everything on the first real step."""
+    amax = jnp.max(history) if algo == "max" else history[0]
+    return jnp.where(amax > 0, E4M3_MAX / (jnp.maximum(amax, 1e-30) * (2.0**margin)), 1.0).astype(
+        jnp.float32
+    )
+
+
+class FP8Dense(nn.Module):
+    """``nn.Dense`` with TE-style delayed-scaling fp8 matmul.
+
+    The per-tensor amax histories live in a flax ``fp8`` collection (one
+    rolling [H] buffer each for the activation and the kernel), so they
+    stack per layer under ``nn.scan`` and thread through the train step as
+    ``model.state`` (``build_train_step(has_state=True)``). Step k
+    quantizes with scales derived from steps < k — the hot path has no
+    serial dependency on the current tensor's amax reduction."""
+
+    features: int
+    use_bias: bool = False
+    dtype: Any = None
+    amax_history_len: int = 16
+    amax_compute_algo: str = "max"
+    margin: int = 0
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features), jnp.float32
+        )
+        # zero-filled histories: unwarmed slots are neutral under both amax
+        # algos (scale_from_history maps zero amax to scale 1.0), unlike a
+        # ones-fill which pins the scale wrong while true amax < 1
+        hist_x = self.variable("fp8", "amax_history_x", jnp.zeros, (self.amax_history_len,), jnp.float32)
+        hist_k = self.variable("fp8", "amax_history_k", jnp.zeros, (self.amax_history_len,), jnp.float32)
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+        scale_x = scale_from_history(hist_x.value, self.margin, self.amax_compute_algo)
+        scale_k = scale_from_history(hist_k.value, self.margin, self.amax_compute_algo)
+        y = _fp8_delayed_matmul(x, kernel, scale_x, scale_k)
+        # roll the current amaxes into the histories (stop_gradient: scale
+        # bookkeeping is not differentiated, matching TE)
+        amax_x = jnp.max(jnp.abs(jax.lax.stop_gradient(x))).astype(jnp.float32)
+        amax_k = jnp.max(jnp.abs(jax.lax.stop_gradient(kernel))).astype(jnp.float32)
+        hist_x.value = jnp.concatenate([amax_x[None], hist_x.value[:-1]])
+        hist_k.value = jnp.concatenate([amax_k[None], hist_k.value[:-1]])
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+            y = y + bias.astype(dtype)
+        return y
 
 
 def policy_dot_general():

@@ -82,9 +82,19 @@ class QuantDense(nn.Module):
             )
             y = (y * qscale.reshape(-1)).astype(dtype)
         else:
-            wg = grouped_dequantize(qdata, qscale, self.method)
-            w = wg.reshape(in_features, self.features).astype(dtype)
-            y = x @ w
+            from .pallas_qmatmul import int4_matmul, pallas_int4_supported
+
+            if pallas_int4_supported(x, self.method, self.group_size, n_groups, self.features):
+                # fused dequant+matmul kernel: packed nibbles are the only
+                # HBM traffic (XLA materialises a full-precision W here)
+                lead = x.shape[:-1]
+                y = int4_matmul(
+                    x.reshape(-1, in_features), qdata, qscale, group_size=g
+                ).reshape(*lead, self.features)
+            else:
+                wg = grouped_dequantize(qdata, qscale, self.method)
+                w = wg.reshape(in_features, self.features).astype(dtype)
+                y = x @ w
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
             y = y + bias.astype(dtype)

@@ -1,0 +1,91 @@
+"""Gradient compression for the data-parallel reduction.
+
+Reference parity: DDP communication hooks — ``fp16_compress_hook`` /
+``bf16_compress_hook`` / PowerSGD registered on the wrapped module
+(reference: src/accelerate/utils/dataclasses.py:130-226
+``DDPCommunicationHookType`` + accelerator.py ``register_comm_hook``).
+
+On TPU the data-parallel gradient reduction is normally an XLA-inserted
+psum riding ICI, where compression would only add VPU work. The case that
+matters is **multi-host data parallelism over DCN** (pod-slice scale-out),
+where the wire is the bottleneck — exactly the reference's DDP-over-
+ethernet case. There the step computes per-shard gradients under
+``shard_map`` and reduces them explicitly through
+:func:`compressed_psum_mean`:
+
+* ``bf16``: cast each leaf to bfloat16, psum, cast back — 2x fewer bytes,
+  the reference's bf16_compress_hook.
+* ``int8``: per-leaf symmetric quantization reduced in two phases
+  (all_to_all codes -> local f32 segment sum -> re-quantize -> all_gather
+  codes) so int8 stays on the wire end to end: ~2 B/elem moved vs ~8 for
+  an f32 ring allreduce. Shared pmax'd scales keep every host's decode
+  identical.
+
+Enable via ``ParallelismPlugin(grad_compression="bf16"|"int8")`` or
+``ACCELERATE_GRAD_COMPRESSION``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+METHODS = ("bf16", "int8")
+
+
+def compressed_psum_mean(tree, axis_name, method: str):
+    """Mean-reduce a gradient pytree over ``axis_name`` with compressed
+    payloads. Must run inside ``shard_map`` (needs the bound axis name)."""
+    n = jax.lax.psum(1, axis_name)
+
+    if method == "bf16":
+        def reduce_leaf(g):
+            summed = jax.lax.psum(g.astype(jnp.bfloat16), axis_name)
+            return summed.astype(jnp.float32) / n
+
+    elif method == "int8":
+        def reduce_leaf(g):
+            # A psum of int32-widened codes would put 4 B/elem on the wire —
+            # no better than f32. Keeping int8 on the wire needs the
+            # two-phase shape every int-compressed allreduce uses (DeepSpeed
+            # 1-bit family): all_to_all the codes (1 B/elem), decode+sum
+            # each segment locally in f32, re-quantize the reduced segment,
+            # all_gather the segment codes (1 B/elem). ~2 B/elem total vs 8
+            # for an f32 ring allreduce.
+            g32 = g.astype(jnp.float32)
+            shape = g32.shape
+            pad = (-g32.size) % n
+            flat = jnp.pad(g32.reshape(-1), (0, pad))
+            k = flat.size // n
+
+            amax = jax.lax.pmax(jnp.max(jnp.abs(g32)), axis_name)
+            scale = jnp.maximum(amax, 1e-30) / 127.0
+            codes = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8).reshape(n, k)
+            # phase 1: shard i receives every peer's segment-i codes
+            recv = jax.lax.all_to_all(codes, axis_name, split_axis=0, concat_axis=0, tiled=True)
+            seg = jnp.sum(recv.reshape(n, k).astype(jnp.float32), axis=0) * scale / n
+            # phase 2: re-quantize the reduced segment, share it back
+            amax2 = jax.lax.pmax(jnp.max(jnp.abs(seg)), axis_name)
+            scale2 = jnp.maximum(amax2, 1e-30) / 127.0
+            codes2 = jnp.clip(jnp.round(seg / scale2), -127, 127).astype(jnp.int8)
+            full = jax.lax.all_gather(codes2, axis_name, tiled=True).astype(jnp.float32) * scale2
+            return full[: g32.size].reshape(shape)
+
+    else:
+        raise ValueError(f"grad_compression must be one of {METHODS}, got {method!r}")
+
+    return jax.tree.map(reduce_leaf, tree)
+
+
+def wire_bytes(tree, method: str | None) -> int:
+    """Wire bytes one gradient reduction moves per device for ``tree``
+    (ring-collective accounting, (N-1)/N ~ 1): f32 allreduce moves ~2
+    payload-sized transfers (reduce-scatter + all-gather); bf16 the same at
+    half width; int8 one all_to_all + one all_gather of code bytes."""
+    per_elem = {None: 2 * 4, "bf16": 2 * 2, "int8": 2 * 1}[method]
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += leaf.size * per_elem
+        if method == "int8":
+            total += 8  # the two pmax'd amax scalars
+    return int(total)

@@ -147,6 +147,29 @@ class GradScalerKwargs(KwargsHandler):
 
 
 @dataclass
+class Fp8RecipeKwargs(KwargsHandler):
+    """TE-style fp8 recipe knobs (reference: ``TERecipeKwargs``,
+    utils/dataclasses.py:317 + utils/transformer_engine.py:26-163).
+
+    ``delayed_scaling=True`` keeps an amax history per tensor (a flax
+    ``fp8`` collection threaded through the train step) and derives the
+    quantization scale from ``max(history) * 2**margin`` — the TE
+    "DelayedScaling" recipe; ``False`` recomputes per-tensor amax every
+    call (the dynamic recipe, no state)."""
+
+    delayed_scaling: bool = True
+    amax_history_len: int = 16
+    amax_compute_algo: str = "max"  # "max" | "most_recent"
+    margin: int = 0
+
+    def __post_init__(self):
+        if self.amax_compute_algo not in ("max", "most_recent"):
+            raise ValueError(f"amax_compute_algo must be max|most_recent, got {self.amax_compute_algo!r}")
+        if self.amax_history_len < 1:
+            raise ValueError(f"amax_history_len must be >= 1, got {self.amax_history_len}")
+
+
+@dataclass
 class ProfileKwargs(KwargsHandler):
     """``jax.profiler`` options (reference torch.profiler kwargs:
     utils/dataclasses.py:439-552). Traces are TensorBoard/Perfetto-viewable."""
@@ -262,6 +285,10 @@ class ParallelismPlugin(KwargsHandler):
     # activation rematerialisation policy name (see accelerator.build_train_step)
     remat_policy: Optional[str] = None
     donate_state: bool = True
+    # compress the data-parallel gradient reduction ("bf16" | "int8") — the
+    # reference's DDP comm hooks (utils/dataclasses.py:130-226), for
+    # multi-host data axes where DCN bytes are the bottleneck
+    grad_compression: Optional[str] = None
 
     @classmethod
     def from_env(cls) -> "ParallelismPlugin":
@@ -269,7 +296,12 @@ class ParallelismPlugin(KwargsHandler):
             mesh_config=MeshConfig.from_env(),
             shard_optimizer_state=parse_flag_from_env("ACCELERATE_SHARD_OPTIMIZER_STATE"),
             remat_policy=os.environ.get("ACCELERATE_REMAT_POLICY") or None,
+            grad_compression=os.environ.get("ACCELERATE_GRAD_COMPRESSION") or None,
         )
+
+    def __post_init__(self):
+        if self.grad_compression is not None and self.grad_compression not in ("bf16", "int8"):
+            raise ValueError(f"grad_compression must be bf16|int8, got {self.grad_compression!r}")
 
 
 def add_model_config_to_megatron_parser(*a, **k):  # pragma: no cover

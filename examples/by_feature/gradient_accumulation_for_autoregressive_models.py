@@ -1,0 +1,79 @@
+"""Gradient accumulation for autoregressive models
+(reference analogue:
+examples/by_feature/gradient_accumulation_for_autoregressive_models.py).
+
+The causal-LM subtlety the reference example exists to teach: microbatches
+carry different numbers of REAL (non-padded) tokens, so averaging each
+microbatch's mean loss over-weights short batches. The fix is the same
+here: scale each microbatch's summed loss by the number of real tokens in
+the WHOLE accumulation window (num_samples_in_epoch bookkeeping,
+reference :286-301). On TPU the window is still one jitted step per
+microbatch — only the loss normalisation changes.
+"""
+
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import LlamaConfig, create_llama_model
+from accelerate_tpu.models.llama import next_token_cross_entropy
+from accelerate_tpu.utils import GradientAccumulationPlugin, set_seed
+
+ACCUM = 4
+SEQ = 16
+BATCH = 8  # per-shard
+
+
+def make_batches(n_windows, vocab, rng):
+    """Variable-length sequences padded to SEQ: the loss_mask marks real
+    tokens (what the reference gets from the tokenizer's attention mask)."""
+    for _ in range(n_windows * ACCUM):
+        ids = rng.integers(5, vocab, size=(BATCH, SEQ)).astype(np.int32)
+        lengths = rng.integers(SEQ // 2, SEQ + 1, size=(BATCH,))
+        mask = (np.arange(SEQ)[None, :] < lengths[:, None]).astype(np.float32)
+        ids = np.where(mask > 0, ids, 0)
+        yield {"input_ids": ids, "loss_mask": mask}
+
+
+def main():
+    import jax.numpy as jnp
+    import optax
+
+    set_seed(7)
+    accelerator = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=ACCUM)
+    )
+    cfg = LlamaConfig.tiny()
+    model = accelerator.prepare_model(create_llama_model(cfg, seq_len=SEQ))
+    accelerator.prepare_optimizer(optax.adamw(2e-3))
+
+    def loss_fn(params, batch):
+        # token-SUM loss normalised by the window's total real tokens: every
+        # real token contributes equally regardless of its microbatch
+        # (reference :286-301). The per-microbatch mean xentropy is
+        # recovered by scaling with (microbatch tokens / window tokens)*ACCUM
+        # because build_train_step averages the ACCUM microbatch losses.
+        logits = model.apply_fn(params, batch["input_ids"])
+        mean_loss = next_token_cross_entropy(logits, batch)
+        mb_tokens = batch["loss_mask"].sum()
+        window_tokens = batch["window_tokens"][0]
+        return mean_loss * (mb_tokens / window_tokens) * ACCUM
+
+    step = accelerator.build_train_step(loss_fn)
+
+    rng = np.random.default_rng(0)
+    batches = list(make_batches(12, cfg.vocab_size, rng))
+    losses = []
+    for w in range(0, len(batches), ACCUM):
+        window = batches[w : w + ACCUM]
+        window_tokens = np.float32(sum(b["loss_mask"].sum() for b in window))
+        for b in window:
+            b = dict(b, window_tokens=np.full((b["input_ids"].shape[0],), window_tokens, np.float32))
+            losses.append(float(step(b)))
+
+    first, last = np.mean(losses[:ACCUM]), np.mean(losses[-ACCUM:])
+    accelerator.print(f"windowed CE: first={first:.3f} last={last:.3f}")
+    assert last < first, (first, last)
+
+
+if __name__ == "__main__":
+    main()

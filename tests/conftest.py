@@ -7,9 +7,23 @@ test runs against a real 8-way mesh on CPU. Must run before any backend
 initialisation (the axon TPU plugin registers at interpreter start, so the
 platform override happens via jax.config, not env)."""
 
+import os
+
 from accelerate_tpu.utils.environment import force_host_platform
 
 force_host_platform(8)
+
+# Persistent XLA compilation cache: the suite's wall-clock is dominated by
+# 8-device fake-mesh compiles, which are identical run to run. Exported via
+# os.environ too so subprocess-launched scripts (CLI/examples tests) share
+# the same cache.
+_CACHE_DIR = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/accelerate_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest
 

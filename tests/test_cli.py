@@ -125,6 +125,7 @@ def test_sync_script_single_process():
     assert "test_sync: ALL OK" in result.stdout
 
 
+@pytest.mark.slow
 def test_ops_script_multiprocess():
     """Collective-ops script on two real processes (reference analogue:
     test_utils/scripts/test_ops.py)."""
@@ -277,6 +278,7 @@ def test_config_precedence_cli_wins(monkeypatch, tmp_path):
     assert args.mixed_precision == "bf16"  # still filled from YAML
 
 
+@pytest.mark.slow
 def test_max_restarts_supervisor(tmp_path):
     """Crash-once-then-succeed script: --max_restarts relaunches it with
     ACCELERATE_RESTART_COUNT set (torchelastic analogue; checkpoint-based
@@ -303,6 +305,7 @@ def test_max_restarts_supervisor(tmp_path):
     assert result.returncode == 3
 
 
+@pytest.mark.slow
 def test_max_restarts_multiprocess_group_restart(tmp_path):
     """One rank crashing takes the group down; the supervisor relaunches
     the whole group and the retry succeeds."""
@@ -331,6 +334,7 @@ def test_max_restarts_multiprocess_group_restart(tmp_path):
     assert result.stdout.count("MP_RECOVERED") >= 1
 
 
+@pytest.mark.slow
 def test_data_loop_script_multiprocess():
     """Distributed data-loop script (reference analogue:
     test_utils/scripts/test_distributed_data_loop.py) on two processes."""
@@ -394,6 +398,7 @@ def test_config_update_reports_dropped_legacy_regardless_of_order(tmp_path):
         assert "precision" in result.stdout and "dropped" in result.stdout, (text, result.stdout)
 
 
+@pytest.mark.slow
 def test_performance_gate_script():
     """Accuracy-floor regression gates per mesh layout (reference analogue:
     external_deps/test_performance.py MRPC thresholds per strategy)."""
@@ -406,6 +411,7 @@ def test_performance_gate_script():
     assert "test_performance: ALL OK" in result.stdout
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_script_multiprocess(tmp_path):
     """2-process orbax checkpoint round-trip through the real launcher
     (reference analogue: test_state_checkpointing.py, run distributed)."""

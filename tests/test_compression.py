@@ -1,0 +1,111 @@
+"""Gradient-compression tests (reference parity: DDP comm hooks —
+fp16/bf16 compress + register_comm_hook, utils/dataclasses.py:130-226)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, MeshConfig, ParallelismPlugin
+from accelerate_tpu.parallel.compression import compressed_psum_mean, wire_bytes
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, linear_loss_fn
+
+
+def test_compressed_psum_mean_matches_plain(mesh8):
+    from jax.sharding import PartitionSpec as P
+
+    g = jax.random.normal(jax.random.key(0), (8, 16), jnp.float32)
+
+    def reduce(method):
+        def body(x):
+            local = jax.tree.map(lambda l: l, {"g": x})
+            if method is None:
+                return jax.tree.map(lambda l: jax.lax.pmean(l, "data"), local)
+            return compressed_psum_mean(local, "data", method)
+
+        fn = jax.shard_map(body, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False)
+        return np.asarray(fn(g)["g"])
+
+    exact = reduce(None)
+    np.testing.assert_allclose(reduce("bf16"), exact, atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(reduce("int8"), exact, atol=2e-2, rtol=5e-2)
+
+
+def test_wire_bytes_accounting():
+    tree = {"a": jnp.zeros((100, 10)), "b": jnp.zeros((50,))}
+    assert wire_bytes(tree, None) == 1050 * 8  # reduce-scatter + all-gather, f32
+    assert wire_bytes(tree, "bf16") == 1050 * 4
+    assert wire_bytes(tree, "int8") == 1050 * 2 + 2 * 8  # + per-leaf amax pair
+    assert wire_bytes(tree, "int8") < wire_bytes(tree, None) // 3
+
+
+def test_int8_keeps_int8_on_the_wire(mesh8):
+    """The compiled HLO must not contain an int32/f32 allreduce of the
+    gradient payload — the compression claim is about wire bytes."""
+    from jax.sharding import PartitionSpec as P
+
+    g = jax.random.normal(jax.random.key(0), (8, 64), jnp.float32)
+    fn = jax.jit(
+        jax.shard_map(
+            lambda x: compressed_psum_mean({"g": x}, "data", "int8")["g"],
+            mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False,
+        )
+    )
+    hlo = fn.lower(g).compile().as_text()
+    import re
+
+    for op in ("all-to-all", "all-gather"):
+        for m in re.finditer(rf"{op}[^=]*= \(?([a-z0-9]+)\[", hlo):
+            assert m.group(1) in ("s8", "u8"), f"{op} moves {m.group(1)}, not int8:\n{m.group(0)}"
+
+
+@pytest.mark.parametrize("method", ["bf16", "int8"])
+def test_compressed_training_converges_like_plain(method):
+    """Same model/data trained with and without compression: both converge,
+    trajectories stay within compression tolerance (reference done-bar:
+    identical convergence within tolerance)."""
+
+    def train(compression):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(
+            parallelism_plugin=ParallelismPlugin(
+                mesh_config=MeshConfig(data=8), grad_compression=compression
+            )
+        )
+        model = acc.prepare_model(RegressionModel())
+        acc.prepare_optimizer(optax.sgd(0.1))
+        step = acc.build_train_step(linear_loss_fn)
+        ds = RegressionDataset(length=64)
+        losses = []
+        for s in range(48):
+            idx = np.arange(s * 16, (s + 1) * 16) % 64
+            batch = {"x": ds.x[idx], "y": ds.y[idx]}
+            losses.append(float(step(batch)))
+        return losses, jax.tree.map(np.asarray, model.params)
+
+    plain_losses, plain_params = train(None)
+    comp_losses, comp_params = train(method)
+    assert comp_losses[-1] < 0.05, comp_losses[-5:]
+    # per-step trajectory stays inside compression rounding of the exact run
+    np.testing.assert_allclose(comp_losses, plain_losses, atol=0.02, rtol=0.1)
+    for k in plain_params:
+        np.testing.assert_allclose(comp_params[k], plain_params[k], atol=0.1, rtol=0.1)
+
+
+def test_compression_rejects_sharded_axes():
+    with pytest.raises(ValueError):
+        ParallelismPlugin(grad_compression="fp4")
+    acc = Accelerator(
+        parallelism_plugin=ParallelismPlugin(
+            mesh_config=MeshConfig(data=4, tensor=2), grad_compression="bf16"
+        )
+    )
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.1))
+    with pytest.raises(ValueError, match="data"):
+        acc.build_train_step(linear_loss_fn)

@@ -1,5 +1,9 @@
 """Run every by_feature example end-to-end on the CPU fake mesh
-(reference analogue: tests/test_examples.py, 308 LoC)."""
+(reference analogue: tests/test_examples.py, 308 LoC).
+
+The whole module is the ``slow`` tier: every test is a fresh subprocess
+(own jax init + compiles). Run with ``pytest -m slow`` / ``make test-all``.
+"""
 
 import os
 import pathlib
@@ -7,6 +11,8 @@ import subprocess
 import sys
 
 import pytest
+
+pytestmark = pytest.mark.slow
 
 EXAMPLES_DIR = pathlib.Path(__file__).parent.parent / "examples" / "by_feature"
 EXAMPLES = sorted(p.name for p in EXAMPLES_DIR.glob("*.py") if not p.name.startswith("_"))

@@ -84,6 +84,7 @@ def test_fp8_policy_enabled_via_mixed_precision():
     AcceleratorState._reset_state()
 
 
+@pytest.mark.slow
 def test_fp8_loss_parity_vs_bf16():
     """mixed_precision="fp8" must track the bf16 loss curve on BERT-tiny
     (the reference's benchmarks/fp8 parity bar)."""
@@ -99,3 +100,70 @@ def test_fp8_loss_parity_vs_bf16():
     assert losses_fp8[-1] < 0.5 * losses_fp8[0]
     for lb, lf in zip(losses_bf16, losses_fp8):
         assert abs(lb - lf) < 0.1, (losses_bf16, losses_fp8)
+
+
+def test_scale_from_history_recipe():
+    from accelerate_tpu.ops.fp8 import E4M3_MAX, scale_from_history
+
+    h = jnp.asarray([2.0, 8.0, 4.0])
+    assert float(scale_from_history(h)) == pytest.approx(E4M3_MAX / 8.0)
+    assert float(scale_from_history(h, algo="most_recent")) == pytest.approx(E4M3_MAX / 2.0)
+    assert float(scale_from_history(h, margin=1)) == pytest.approx(E4M3_MAX / 16.0)
+
+
+def test_fp8_dense_delayed_scaling_updates_history():
+    """FP8Dense: forward matches a plain dense within e4m3 tolerance and the
+    amax histories roll forward in the fp8 collection."""
+    from accelerate_tpu.ops.fp8 import FP8Dense
+
+    layer = FP8Dense(32, amax_history_len=4)
+    x = jax.random.normal(jax.random.key(0), (8, 16), jnp.float32)
+    variables = layer.init(jax.random.key(1), x)
+    ref = x @ variables["params"]["kernel"]
+
+    out, mutated = layer.apply(variables, x, mutable=["fp8"])
+    rel = float(jnp.linalg.norm(out.astype(jnp.float32) - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.06, rel
+    hist = mutated["fp8"]["amax_history_x"]
+    assert float(hist[0]) == pytest.approx(float(jnp.max(jnp.abs(x))), rel=1e-5)
+    # second apply rolls the newest amax to the front
+    out2, mutated2 = layer.apply({**variables, **mutated}, x * 2.0, mutable=["fp8"])
+    h2 = mutated2["fp8"]["amax_history_x"]
+    assert float(h2[0]) == pytest.approx(2 * float(jnp.max(jnp.abs(x))), rel=1e-5)
+    assert float(h2[1]) == pytest.approx(float(hist[0]), rel=1e-5)
+
+
+@pytest.mark.slow
+def test_fp8_delayed_llama_trains_with_state():
+    """mixed_precision='fp8' + Fp8RecipeKwargs(delayed_scaling=True): the
+    llama zoo builds FP8Dense blocks, the amax histories thread through
+    build_train_step(has_state=True), and a few steps reduce the loss."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.llama import LlamaConfig, causal_lm_loss_state, create_llama_model
+    from accelerate_tpu.utils.dataclasses import Fp8RecipeKwargs
+
+    acc = Accelerator(
+        mixed_precision="fp8", kwargs_handlers=[Fp8RecipeKwargs(amax_history_len=8, margin=0)]
+    )
+    model = acc.prepare_model(
+        create_llama_model(LlamaConfig.tiny(scan_layers=True, remat=False), seq_len=16)
+    )
+    assert model.state is not None and "fp8" in model.state
+    blk = model.state["fp8"]["layers"]["block"]
+    assert blk["attn"]["q_proj"]["amax_history_x"].shape == (2, 8)  # [layers, H]
+
+    acc.prepare_optimizer(optax.adamw(3e-3))
+    step = acc.build_train_step(
+        lambda p, s, b: causal_lm_loss_state(p, s, b, model.apply_fn), has_state=True
+    )
+    h_before = np.asarray(model.state["fp8"]["layers"]["block"]["attn"]["q_proj"]["amax_history_x"])
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 250, size=(4, 16)).astype(np.int32)
+    losses = [float(step({"input_ids": ids})) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    # the step must WRITE BACK the rolled histories into model.state
+    h_after = np.asarray(model.state["fp8"]["layers"]["block"]["attn"]["q_proj"]["amax_history_x"])
+    assert not np.array_equal(h_after, h_before), "fp8 state not threaded through the step"
+    assert np.count_nonzero(h_after) > np.count_nonzero(h_before)

@@ -198,3 +198,28 @@ def test_rejects_bad_config():
         QuantizationConfig(bits=3)
     with pytest.raises(ValueError):
         QuantizationConfig(method="int2")
+
+
+@pytest.mark.parametrize("b,infeat,out,g", [(1, 128, 256, 64), (4, 256, 384, 128), (3, 256, 128, 64)])
+def test_pallas_int4_matmul_matches_dequant(b, infeat, out, g):
+    """The fused dequant+matmul kernel (interpret mode on the CPU mesh)
+    must match dequantize-then-matmul to bf16 rounding."""
+    from accelerate_tpu.ops.pallas_qmatmul import int4_matmul
+
+    w = _w((infeat, out), seed=11)
+    x = jax.random.normal(jax.random.key(12), (b, infeat), jnp.bfloat16)
+    qt = quantize(w, QuantizationConfig(bits=4, method="int4", group_size=g))
+    ref = x.astype(jnp.float32) @ dequantize(qt, jnp.float32)
+    got = int4_matmul(x, qt.data, qt.scale, group_size=g, interpret=True).astype(jnp.float32)
+    err = float(jnp.abs(got - ref).max() / (jnp.abs(ref).max() + 1e-9))
+    assert err < 0.02, err
+
+
+def test_pallas_int4_rejects_bad_shapes():
+    from accelerate_tpu.ops.pallas_qmatmul import int4_matmul
+
+    w = _w((128, 256), seed=13)
+    qt = quantize(w, QuantizationConfig(bits=4, method="int4", group_size=32))
+    x = jnp.ones((1, 128), jnp.bfloat16)
+    with pytest.raises(ValueError):
+        int4_matmul(x, qt.data, qt.scale, group_size=32, interpret=True)  # group % 64
