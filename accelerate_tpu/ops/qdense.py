@@ -48,8 +48,8 @@ class QuantDense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        if self.method not in ("int8", "int4", "nf4"):
-            raise ValueError(f"method must be int8|int4|nf4, got {self.method!r}")
+        if self.method not in ("int8", "w8a8", "int4", "nf4"):
+            raise ValueError(f"method must be int8|w8a8|int4|nf4, got {self.method!r}")
         in_features = x.shape[-1]
         g = self.group_size or in_features
         if in_features % g != 0:
@@ -69,7 +69,21 @@ class QuantDense(nn.Module):
         dtype = self.dtype or x.dtype
         x = x.astype(dtype)
 
-        if self.method == "int8" and n_groups == 1:
+        if self.method == "w8a8" and n_groups > 1:
+            raise ValueError("w8a8 requires per-channel scales (group_size=None)")
+        if self.method == "w8a8":
+            # W8A8: per-row dynamic activation quant feeds the NATIVE int8
+            # MXU path — no per-weight convert at all, so decode's floor is
+            # HBM bandwidth rather than VPU convert throughput
+            w8 = qdata.reshape(in_features, self.features)
+            x32 = x.astype(jnp.float32)
+            sx = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True), 1e-12) / 127.0
+            xq = jnp.clip(jnp.round(x32 / sx), -127, 127).astype(jnp.int8)
+            y32 = jax.lax.dot_general(
+                xq, w8, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+            )
+            y = (y32.astype(jnp.float32) * sx * qscale.reshape(-1)).astype(dtype)
+        elif self.method == "int8" and n_groups == 1:
             # per-channel fast path: the matmul operand is a pure int8→bf16
             # convert (fuses into the dot); the per-out-channel scale
             # commutes with the contraction and applies to the output

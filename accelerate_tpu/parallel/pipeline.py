@@ -52,6 +52,7 @@ def _gpipe_local(
     num_microbatches: int,
     batched_arg_mask: tuple,
     remat: bool,
+    interleave: int = 1,
 ):
     """Per-device GPipe body (runs under shard_map).
 
@@ -61,18 +62,26 @@ def _gpipe_local(
     ``batched_arg_mask`` share x's batch dim and are microbatched alongside
     it (stage i works on microbatch t-i at tick t, so they are indexed by
     that offset); the rest pass through whole.
+
+    ``interleave > 1`` splits each microbatch into that many independent
+    row blocks per tick: block j's ppermute issues while block j+1
+    computes, so all but the last permute per tick hides behind compute
+    (the in-flight handoff cannot be carried across scan iterations in
+    JAX, so overlap has to come from within the tick).
     """
     m = num_microbatches
+    b_mb = x.shape[0] // m
+    k = interleave if interleave > 1 and b_mb % interleave == 0 else 1
     idx = lax.axis_index(axis_name)
-    mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
+    mb = x.reshape(m, k, b_mb // k, *x.shape[1:])
     args_mb = tuple(
-        a.reshape(m, a.shape[0] // m, *a.shape[1:]) if batched else a
+        a.reshape(m, k, b_mb // k, *a.shape[1:]) if batched else a
         for a, batched in zip(broadcast_args, batched_arg_mask)
     )
 
-    def apply_stage(h, mb_idx):
+    def apply_stage(h, mb_idx, j):
         args = tuple(
-            a[mb_idx] if batched else a for a, batched in zip(args_mb, batched_arg_mask)
+            a[mb_idx, j] if batched else a for a, batched in zip(args_mb, batched_arg_mask)
         )
 
         def body(carry, p):
@@ -82,26 +91,34 @@ def _gpipe_local(
         return out
 
     if remat:
-        apply_stage = jax.checkpoint(apply_stage)
+        apply_stage = jax.checkpoint(apply_stage, static_argnums=(2,))
 
     perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
 
     def tick(carry, t):
-        state, out = carry
+        state, out = carry  # state [k, b_mb/k, ...]
         # stage i works on microbatch t-i; clamp covers fill/drain ticks
         # whose results are never written
         mb_idx = jnp.clip(t - idx, 0, m - 1)
-        feed = mb[jnp.minimum(t, m - 1)]
-        h = jnp.where(idx == 0, feed, state)
-        y = apply_stage(h, mb_idx)
+        feed_idx = jnp.minimum(t, m - 1)
+        ys, sends = [], []
+        for j in range(k):  # static unroll: permute j overlaps compute j+1
+            h = jnp.where(idx == 0, mb[feed_idx, j], state[j])
+            y = apply_stage(h, mb_idx, j)
+            ys.append(y)
+            sends.append(lax.ppermute(y, axis_name, perm))
+        y_full = jnp.stack(ys)  # [k, b_mb/k, ...]
+        state = jnp.stack(sends)
         # the last stage finishes microbatch t-(S-1) at tick t
         w = t - (n_stages - 1)
         slot = jnp.clip(w, 0, m - 1)
         write = (idx == n_stages - 1) & (w >= 0)
         out = lax.dynamic_update_index_in_dim(
-            out, jnp.where(write, y, lax.dynamic_index_in_dim(out, slot, keepdims=False)), slot, 0
+            out,
+            jnp.where(write, y_full, lax.dynamic_index_in_dim(out, slot, keepdims=False)),
+            slot,
+            0,
         )
-        state = lax.ppermute(y, axis_name, perm)
         return (state, out), None
 
     state0 = jnp.zeros_like(mb[0])
@@ -110,7 +127,7 @@ def _gpipe_local(
     # result lives on the last stage; psum of the masked buffer replicates it
     # across ``pipe`` (matches the replicated out_spec)
     out = lax.psum(jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out)), axis_name)
-    return out.reshape(x.shape[0], *out.shape[2:])
+    return out.reshape(x.shape[0], *out.shape[3:])
 
 
 def pipeline_apply(
@@ -126,6 +143,7 @@ def pipeline_apply(
     batched_args: Optional[Sequence[bool]] = None,
     remat: bool = False,
     param_specs=None,
+    interleave: int = 1,
 ) -> jax.Array:
     """Run ``x`` through a stack of layers pipelined over ``axis_name``.
 
@@ -144,6 +162,11 @@ def pipeline_apply(
     ``P("pipe", None, "tensor")`` for Megatron column splits inside each
     stage; ``layer_fn`` then sees per-device shards and must psum over
     ``tensor`` itself (it runs under shard_map).
+
+    ``interleave=2`` splits each microbatch into two row blocks per tick so
+    each block's stage-handoff ppermute overlaps the other block's compute
+    (hides ICI latency when per-block compute >= permute time; ignored when
+    the per-device microbatch rows don't divide).
     """
     n_stages = mesh.shape[axis_name]
     if n_stages == 1:
@@ -185,6 +208,7 @@ def pipeline_apply(
             num_microbatches=num_microbatches,
             batched_arg_mask=batched_arg_mask,
             remat=remat,
+            interleave=interleave,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec, arg_specs),

@@ -197,8 +197,22 @@ class WandBTracker(GeneralTracker):
 
     @on_main_process
     def store_init_configuration(self, values: dict):
+        import os
+
         import wandb
 
+        offline = os.environ.get("WANDB_MODE") == "offline" or self._init_kwargs.get("mode") == "offline"
+        if offline:
+            # offline runs can't mutate config after init — restart the run
+            # with the config baked in (reference: tracking.py:343-352);
+            # merge over any config the tracker was constructed with
+            if getattr(self, "run", None):
+                self.run.finish()
+            init_kwargs = dict(self._init_kwargs)
+            base = init_kwargs.pop("config", None)
+            config = {**base, **values} if isinstance(base, dict) else values
+            self.run = wandb.init(project=self.run_name, config=config, **init_kwargs)
+            return
         wandb.config.update(values, allow_val_change=True)
 
     @on_main_process

@@ -53,7 +53,10 @@ class QuantizationConfig:
     skip_modules map to bits/method/skip_patterns)."""
 
     bits: int = 8  # 8 or 4
-    method: Optional[str] = None  # "int8" | "int4" | "nf4"; default by bits
+    # "int8" (weight-only, bf16 activations) | "w8a8" (int8 activations too:
+    # the matmul runs natively on the int8 MXU path — no per-weight convert,
+    # so decode reaches HBM-bandwidth-bound) | "int4" | "nf4"
+    method: Optional[str] = None  # default by bits
     group_size: Optional[int] = None  # None = one scale per output channel
     compute_dtype: str = "bfloat16"
     # leaves whose path matches any pattern stay un-quantized (the reference
@@ -66,13 +69,20 @@ class QuantizationConfig:
             raise ValueError(f"bits must be 8 or 4, got {self.bits}")
         if self.method is None:
             self.method = "int8" if self.bits == 8 else "nf4"
-        if self.method not in ("int8", "int4", "nf4"):
-            raise ValueError(f"method must be int8|int4|nf4, got {self.method!r}")
-        if self.method != "int8" and self.bits != 4:
+        if self.method not in ("int8", "w8a8", "int4", "nf4"):
+            raise ValueError(f"method must be int8|w8a8|int4|nf4, got {self.method!r}")
+        if self.method not in ("int8", "w8a8") and self.bits != 4:
             self.bits = 4
-        elif self.method == "int8" and self.bits != 8:
+        elif self.method in ("int8", "w8a8") and self.bits != 8:
             # int8 stores unpacked 8-bit codes; bits=4 would give no saving
-            raise ValueError('method="int8" requires bits=8; use method="int4"/"nf4" for 4-bit')
+            raise ValueError(
+                f'method="{self.method}" requires bits=8; use method="int4"/"nf4" for 4-bit'
+            )
+        if self.method == "w8a8" and self.group_size is not None:
+            # the native int8-MXU path needs per-channel scales (the scale
+            # must commute past the whole contraction); grouped w8a8 would
+            # silently degrade to the W8A16 dequantize path
+            raise ValueError('method="w8a8" requires group_size=None (per-channel scales)')
 
 
 @jax.tree_util.register_pytree_node_class
@@ -123,7 +133,7 @@ def quantize(x: jax.Array, config: QuantizationConfig) -> QTensor:
     absmax = jnp.max(jnp.abs(xg), axis=-2, keepdims=True)
     scale = jnp.maximum(absmax, 1e-12)
 
-    if config.method == "int8":
+    if config.method in ("int8", "w8a8"):
         q = jnp.clip(jnp.round(xg / scale * 127.0), -127, 127).astype(jnp.int8)
         scale = scale / 127.0
     elif config.method == "int4":
@@ -146,7 +156,7 @@ def grouped_dequantize(data: jax.Array, scale: jax.Array, method: str) -> jax.Ar
     """Decode grouped codes ``[..., n_groups, g(, packed), out]`` + scales to
     float ``[..., n_groups, g, out]`` — the single copy of the per-method
     decode used by :func:`dequantize` and the in-scan ``QuantDense``."""
-    if method == "int8":
+    if method in ("int8", "w8a8"):
         return data.astype(jnp.float32) * scale
     if method == "int4":
         return (_unpack4(data).astype(jnp.float32) - 8.0) * scale

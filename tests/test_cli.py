@@ -335,6 +335,19 @@ def test_max_restarts_multiprocess_group_restart(tmp_path):
 
 
 @pytest.mark.slow
+def test_compression_script_multiprocess():
+    """Compressed gradient reduction across two REAL processes (the
+    multi-host DCN case the comm-hook analogue exists for)."""
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4", "-m",
+        "accelerate_tpu.test_utils.scripts.test_compression",
+        timeout=420,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert result.stdout.count("test_compression: ALL OK") >= 1
+
+
+@pytest.mark.slow
 def test_data_loop_script_multiprocess():
     """Distributed data-loop script (reference analogue:
     test_utils/scripts/test_distributed_data_loop.py) on two processes."""

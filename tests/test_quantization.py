@@ -223,3 +223,30 @@ def test_pallas_int4_rejects_bad_shapes():
     x = jnp.ones((1, 128), jnp.bfloat16)
     with pytest.raises(ValueError):
         int4_matmul(x, qt.data, qt.scale, group_size=32, interpret=True)  # group % 64
+
+
+def test_w8a8_qdense_close_to_weight_only():
+    """w8a8 (native int8 MXU path) adds per-row activation rounding on top
+    of the weight rounding — output stays within ~1% of the W8A16 path."""
+    from accelerate_tpu.ops.qdense import QuantDense
+
+    w = _w((128, 96), seed=20)
+    x = jax.random.normal(jax.random.key(21), (4, 128), jnp.float32)
+    qt = quantize(w, QuantizationConfig(bits=8, method="w8a8"))
+    params = {"params": {"qdata": qt.data, "qscale": qt.scale}}
+    y_w8a8 = QuantDense(96, method="w8a8", dtype=jnp.float32).apply(params, x)
+    y_ref = QuantDense(96, method="int8", dtype=jnp.float32).apply(params, x)
+    rel = float(jnp.linalg.norm(y_w8a8 - y_ref) / jnp.linalg.norm(y_ref))
+    assert rel < 0.02, rel
+
+
+def test_w8a8_llama_end_to_end():
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    model = create_llama_model(LlamaConfig.tiny(scan_layers=True, remat=False), seq_len=16)
+    qmodel = load_and_quantize_model(model, QuantizationConfig(bits=8, method="w8a8"))
+    ids = (np.arange(2 * 8).reshape(2, 8) % 250).astype(np.int32)
+    ref = np.asarray(model(ids), np.float32)
+    out = np.asarray(jax.jit(qmodel.apply_fn)(qmodel.params, ids), np.float32)
+    rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+    assert rel < 0.1, rel

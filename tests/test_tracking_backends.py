@@ -57,6 +57,29 @@ def fake_module(monkeypatch):
     return _install
 
 
+def test_wandb_offline_mode_restarts_with_config(fake_module, monkeypatch):
+    """WANDB_MODE=offline: config can't be updated post-init, so the run is
+    restarted with the config baked in (reference: tracking.py:343-352)."""
+    init_calls = []
+    runs = []
+
+    def init(**kwargs):
+        init_calls.append(kwargs)
+        runs.append(Recorder("run"))
+        return runs[-1]
+
+    fake_module("wandb", init=init, config=Recorder("config"))
+    monkeypatch.setenv("WANDB_MODE", "offline")
+    t = tracking.WandBTracker("proj", entity="me")
+    t.start()
+    t.store_init_configuration({"lr": 0.1})
+    assert len(init_calls) == 2
+    assert init_calls[1]["config"] == {"lr": 0.1} and init_calls[1]["entity"] == "me"
+    assert runs[0].get("finish")  # first (config-less) run was closed
+    t.log({"loss": 1.0}, step=1)
+    assert runs[1].get("log")
+
+
 def test_wandb_tracker_calls(fake_module):
     run = Recorder("run")
     config = Recorder("config")
