@@ -32,6 +32,44 @@ def _jax():
     return jax
 
 
+def _make_sampler(temperature: float, top_k: Optional[int]):
+    """Greedy / temperature / top-k token sampler shared by the decoder-only
+    and encoder-decoder loops."""
+    jax = _jax()
+    jnp = jax.numpy
+
+    def sample(logits_1, key):
+        logits_1 = logits_1.astype(jnp.float32)
+        if temperature <= 0.0:
+            return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
+        if top_k is not None:
+            kth = jax.lax.top_k(logits_1, top_k)[0][..., -1:]
+            logits_1 = jnp.where(logits_1 < kth, -jnp.inf, logits_1)
+        return jax.random.categorical(key, logits_1 / temperature, axis=-1).astype(jnp.int32)
+
+    return sample
+
+
+def _freeze_after_eos(nxt, done, eos_token_id):
+    """EOS semantics shared by both loops: finished rows keep emitting EOS."""
+    jnp = _jax().numpy
+    if eos_token_id is None:
+        return nxt, done
+    nxt = jnp.where(done, eos_token_id, nxt)
+    return nxt, done | (nxt == eos_token_id)
+
+
+def _scan_new_tokens(step, carry, next_tok, max_new_tokens: int):
+    """Run the per-token scan and assemble [B, max_new_tokens] including the
+    already-sampled first token."""
+    jax = _jax()
+    jnp = jax.numpy
+    if max_new_tokens > 1:
+        _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
+        return jnp.concatenate([next_tok[None], rest], axis=0).T
+    return next_tok[:, None]
+
+
 def generate(
     model,
     input_ids,
@@ -85,15 +123,7 @@ def generate(
         positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
         logits, cache = apply_fn(params, input_ids, positions=positions, decode=True, cache=None)
 
-        def sample(logits_1, key):
-            logits_1 = logits_1.astype(jnp.float32)
-            if temperature <= 0.0:
-                return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
-            if top_k is not None:
-                kth = jax.lax.top_k(logits_1, top_k)[0][..., -1:]
-                logits_1 = jnp.where(logits_1 < kth, -jnp.inf, logits_1)
-            return jax.random.categorical(key, logits_1 / temperature, axis=-1).astype(jnp.int32)
-
+        sample = _make_sampler(temperature, top_k)
         key, sub = jax.random.split(key)
         next_tok = sample(logits[:, -1], sub)
         done = jnp.zeros((b,), bool) if eos_token_id is None else next_tok == eos_token_id
@@ -103,22 +133,92 @@ def generate(
             positions = jnp.broadcast_to(pos[None, None], (b, 1))
             logits, cache = apply_fn(params, tok[:, None], positions=positions, decode=True, cache=cache)
             key, sub = jax.random.split(key)
-            nxt = sample(logits[:, -1], sub)
-            if eos_token_id is not None:
-                nxt = jnp.where(done, eos_token_id, nxt)
-                done = done | (nxt == eos_token_id)
+            nxt, done = _freeze_after_eos(sample(logits[:, -1], sub), done, eos_token_id)
             return (cache, nxt, pos + 1, key, done), nxt
 
         carry = (cache, next_tok, jnp.int32(prompt_len), key, done)
-        if max_new_tokens > 1:
-            _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
-            new_tokens = jnp.concatenate([next_tok[None], rest], axis=0).T  # [B, T]
-        else:
-            new_tokens = next_tok[:, None]
+        new_tokens = _scan_new_tokens(step, carry, next_tok, max_new_tokens)
         return jnp.concatenate([input_ids, new_tokens], axis=1)
 
     runners[cache_key] = run
     return run(params, input_ids, jax.random.key(seed))
+
+
+def generate_seq2seq(
+    model,
+    input_ids,
+    max_new_tokens: int = 32,
+    decoder_start_token_id: int = 0,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    seed: int = 0,
+    eos_token_id: Optional[int] = None,
+    attention_mask=None,
+):
+    """Encoder-decoder generation (T5 contract): encode once, then a jitted
+    ``lax.scan`` decode loop against the decoder KV cache — the encoder
+    output persists in the cache, so per-token steps never touch it.
+
+    ``apply_fn(params, input_ids, decoder_input_ids, attention_mask=...,
+    decode=True, cache=...) -> (logits, cache)``. Returns int32
+    ``[B, 1 + max_new_tokens]`` starting with ``decoder_start_token_id``.
+    """
+    jax = _jax()
+    jnp = jax.numpy
+
+    apply_fn = model.apply_fn
+    params = model.params
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, src_len = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, src_len), bool)
+
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    start = jnp.full((b, 1), decoder_start_token_id, jnp.int32)
+    if max_new_tokens == 0:
+        return start
+
+    # exactly max_new_tokens cache slots are written (start token at 0, then
+    # the scan's max_new_tokens - 1 steps; the final sample is never cached)
+    max_dec = getattr(getattr(model, "config", None), "max_decode_len", None)
+    if max_dec is not None and max_new_tokens > max_dec:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds the decoder cache "
+            f"(max_decode_len={max_dec})"
+        )
+
+    cache_key = ("s2s", b, src_len, max_new_tokens, decoder_start_token_id,
+                 float(temperature), top_k, eos_token_id)
+    runners = model.__dict__.setdefault("_generate_runners", {})
+    if cache_key in runners:
+        return runners[cache_key](params, input_ids, attention_mask, jax.random.key(seed))
+
+    @jax.jit
+    def run(params, input_ids, attention_mask, key):
+        # prefill: encoder + first decoder step on the start token
+        logits, cache = apply_fn(
+            params, input_ids, start, attention_mask=attention_mask, decode=True, cache=None
+        )
+
+        sample = _make_sampler(temperature, top_k)
+        key, sub = jax.random.split(key)
+        next_tok = sample(logits[:, -1], sub)
+        done = jnp.zeros((b,), bool) if eos_token_id is None else next_tok == eos_token_id
+
+        def step(carry, _):
+            cache, tok, key, done = carry
+            logits, cache = apply_fn(params, input_ids, tok[:, None], decode=True, cache=cache)
+            key, sub = jax.random.split(key)
+            nxt, done = _freeze_after_eos(sample(logits[:, -1], sub), done, eos_token_id)
+            return (cache, nxt, key, done), nxt
+
+        carry = (cache, next_tok, key, done)
+        new_tokens = _scan_new_tokens(step, carry, next_tok, max_new_tokens)
+        return jnp.concatenate([start, new_tokens], axis=1)
+
+    runners[cache_key] = run
+    return run(params, input_ids, attention_mask, jax.random.key(seed))
 
 
 def per_token_latency(model, batch_size: int = 1, prompt_len: int = 32, n_tokens: int = 16) -> float:

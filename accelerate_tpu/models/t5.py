@@ -36,6 +36,8 @@ class T5Config:
     layer_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
     remat: bool = False
+    # decoder KV-cache length for incremental generation
+    max_decode_len: int = 128
 
     @classmethod
     def small(cls, **kw) -> "T5Config":
@@ -62,13 +64,10 @@ T5_SHARDING_RULES = [
 ]
 
 
-def relative_position_buckets(
-    q_len: int, k_len: int, num_buckets: int, max_distance: int, bidirectional: bool
-) -> jax.Array:
-    """T5's log-binned relative position -> bucket id [q_len, k_len]."""
-    ctx = jnp.arange(q_len)[:, None]
-    mem = jnp.arange(k_len)[None, :]
-    rel = mem - ctx
+def _bucketize(rel: jax.Array, num_buckets: int, max_distance: int, bidirectional: bool) -> jax.Array:
+    """T5's log-binned bucketing of a relative-position array ``rel =
+    mem_pos - ctx_pos`` — the ONE copy of the formula, shared by the
+    teacher-forced path and the absolute-position cached-decode path."""
     buckets = 0
     if bidirectional:
         num_buckets //= 2
@@ -87,56 +86,127 @@ def relative_position_buckets(
     return buckets + jnp.where(is_small, rel, log_bucket)
 
 
+def relative_position_buckets(
+    q_len: int, k_len: int, num_buckets: int, max_distance: int, bidirectional: bool
+) -> jax.Array:
+    """T5's log-binned relative position -> bucket id [q_len, k_len]."""
+    rel = jnp.arange(k_len)[None, :] - jnp.arange(q_len)[:, None]
+    return _bucketize(rel, num_buckets, max_distance, bidirectional)
+
+
 class T5Attention(nn.Module):
     config: T5Config
     causal: bool = False
     has_relative_bias: bool = False
 
+    def _bias_table(self):
+        return self.param(
+            "relative_bias/embedding",
+            nn.initializers.normal(1.0),
+            (self.config.relative_attention_num_buckets, self.config.num_attention_heads),
+        )
+
     @nn.compact
-    def __call__(self, hidden, kv=None, mask=None, position_bias=None):
+    def __call__(self, hidden, kv=None, mask=None, position_bias=None, decode=False, prime=True):
         """Returns ``(out, position_bias)``. Like HF ``T5Stack``, the bias
         table lives only in the layer-0 attention (``has_relative_bias``);
         every later layer receives the computed ``position_bias`` and adds
-        the same [1, H, Q, K] bias to its logits."""
+        the same [1, H, Q, K] bias to its logits.
+
+        ``decode=True`` on the causal self-attention switches to a fixed
+        [B, max_decode_len] KV cache updated with dynamic_update_slice —
+        prefill (full prefix) and per-token steps share the path. On
+        CROSS-attention, decode mode projects the encoder output to K/V
+        once at prefill (``prime=True``) and reuses the cached projections
+        on every step (HF caches cross-attn K/V the same way)."""
         cfg = self.config
+        cross = kv is not None
         kv = hidden if kv is None else kv
         inner = cfg.num_attention_heads * cfg.head_dim
         q = nn.Dense(inner, use_bias=False, name="q_proj", dtype=hidden.dtype, dot_general=_pdg())(hidden)
-        k = nn.Dense(inner, use_bias=False, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(kv)
-        v = nn.Dense(inner, use_bias=False, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(kv)
 
         def split(x):
             return x.reshape(*x.shape[:-1], cfg.num_attention_heads, cfg.head_dim)
 
-        q, k, v = split(q), split(k), split(v)
-        # T5 does NOT scale by sqrt(d); fold relative bias into the logits
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        if position_bias is None and self.has_relative_bias:
-            buckets = relative_position_buckets(
-                q.shape[1],
-                k.shape[1],
-                cfg.relative_attention_num_buckets,
-                cfg.relative_attention_max_distance,
-                bidirectional=not self.causal,
+        q = split(q)
+        if decode and cross and not self.causal:
+            b, s_enc = kv.shape[:2]
+            ck = self.variable(
+                "cache", "cross_key", jnp.zeros, (b, s_enc, cfg.num_attention_heads, cfg.head_dim), jnp.float32
             )
-            bias_table = self.param(
-                "relative_bias/embedding",
-                nn.initializers.normal(1.0),
-                (cfg.relative_attention_num_buckets, cfg.num_attention_heads),
+            cv = self.variable(
+                "cache", "cross_value", jnp.zeros, (b, s_enc, cfg.num_attention_heads, cfg.head_dim), jnp.float32
             )
-            position_bias = bias_table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
-        if position_bias is not None:
-            logits = logits + position_bias
-        if self.causal:
-            cmask = jnp.arange(q.shape[1])[:, None] >= jnp.arange(k.shape[1])[None, :]
-            logits = jnp.where(cmask[None, None], logits, jnp.finfo(jnp.float32).min)
-        if mask is not None:
-            logits = jnp.where(mask[:, None, None, :], logits, jnp.finfo(jnp.float32).min)
-        weights = jax.nn.softmax(logits, axis=-1).astype(hidden.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+            if prime:
+                ck.value = split(
+                    nn.Dense(inner, use_bias=False, name="k_proj", dtype=kv.dtype, dot_general=_pdg())(kv)
+                ).astype(jnp.float32)
+                cv.value = split(
+                    nn.Dense(inner, use_bias=False, name="v_proj", dtype=kv.dtype, dot_general=_pdg())(kv)
+                ).astype(jnp.float32)
+            k, v = ck.value.astype(q.dtype), cv.value.astype(q.dtype)
+        else:
+            k = split(nn.Dense(inner, use_bias=False, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(kv))
+            v = split(nn.Dense(inner, use_bias=False, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(kv))
+
+        if decode and self.causal:
+            out, position_bias = self._cached_causal(q, k, v, position_bias)
+        else:
+            # T5 does NOT scale by sqrt(d); fold relative bias into logits
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            if position_bias is None and self.has_relative_bias:
+                buckets = relative_position_buckets(
+                    q.shape[1],
+                    k.shape[1],
+                    cfg.relative_attention_num_buckets,
+                    cfg.relative_attention_max_distance,
+                    bidirectional=not self.causal,
+                )
+                position_bias = self._bias_table()[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+            if position_bias is not None:
+                logits = logits + position_bias
+            if self.causal:
+                cmask = jnp.arange(q.shape[1])[:, None] >= jnp.arange(k.shape[1])[None, :]
+                logits = jnp.where(cmask[None, None], logits, jnp.finfo(jnp.float32).min)
+            if mask is not None:
+                logits = jnp.where(mask[:, None, None, :], logits, jnp.finfo(jnp.float32).min)
+            weights = jax.nn.softmax(logits, axis=-1).astype(hidden.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
         out = out.reshape(*out.shape[:-2], inner)
         out = nn.Dense(cfg.hidden_size, use_bias=False, name="o_proj", dtype=hidden.dtype, dot_general=_pdg())(out)
         return out, position_bias
+
+    def _cached_causal(self, q, k, v, position_bias):
+        """Incremental self-attention over a fixed-size cache; relative
+        bias computed from ABSOLUTE positions (query t vs keys 0..max)."""
+        cfg = self.config
+        b, s_new, h, d = k.shape
+        max_len = cfg.max_decode_len
+        ck = self.variable("cache", "key", jnp.zeros, (b, max_len, h, d), k.dtype)
+        cv = self.variable("cache", "value", jnp.zeros, (b, max_len, h, d), v.dtype)
+        idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        cur = idx.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        idx.value = cur + s_new
+
+        key_pos = jnp.arange(max_len)
+        q_pos = cur + jnp.arange(s_new)
+        if position_bias is None and self.has_relative_bias:
+            buckets = _bucketize(
+                key_pos[None, :] - q_pos[:, None],
+                cfg.relative_attention_num_buckets,
+                cfg.relative_attention_max_distance,
+                bidirectional=False,
+            )
+            position_bias = self._bias_table()[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value).astype(jnp.float32)
+        if position_bias is not None:
+            logits = logits + position_bias
+        amask = key_pos[None, :] <= q_pos[:, None]  # [s_new, max_len] absolute causal
+        logits = jnp.where(amask[None, None], logits, jnp.finfo(jnp.float32).min)
+        weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, cv.value), position_bias
 
 
 class T5FFN(nn.Module):
@@ -170,15 +240,19 @@ class T5DecoderLayer(nn.Module):
     has_relative_bias: bool = False
 
     @nn.compact
-    def __call__(self, hidden, enc_out, enc_mask, position_bias=None):
+    def __call__(self, hidden, enc_out, enc_mask, position_bias=None, decode=False, prime=True):
         cfg = self.config
         self_out, position_bias = T5Attention(
             cfg, causal=True, has_relative_bias=self.has_relative_bias, name="self_attn"
-        )(RMSNorm(cfg.layer_norm_eps, name="ln_self")(hidden), position_bias=position_bias)
+        )(RMSNorm(cfg.layer_norm_eps, name="ln_self")(hidden), position_bias=position_bias, decode=decode)
         hidden = hidden + self_out
         # HF T5 cross-attention carries no position bias (zeros)
         cross_out, _ = T5Attention(cfg, causal=False, name="cross_attn")(
-            RMSNorm(cfg.layer_norm_eps, name="ln_cross")(hidden), kv=enc_out, mask=enc_mask
+            RMSNorm(cfg.layer_norm_eps, name="ln_cross")(hidden),
+            kv=enc_out,
+            mask=enc_mask,
+            decode=decode,
+            prime=prime,
         )
         hidden = hidden + cross_out
         hidden = hidden + T5FFN(cfg, name="ffn")(RMSNorm(cfg.layer_norm_eps, name="ln_ffn")(hidden))
@@ -189,7 +263,11 @@ class T5Model(nn.Module):
     config: T5Config
 
     @nn.compact
-    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None, decode=False, encode=True):
+        """``decode=True`` runs the decoder incrementally against its KV
+        cache. The encoder runs once at prefill (``encode=True``) and its
+        output + mask persist in the cache collection; later steps pass
+        ``encode=False`` and skip the encoder stack entirely."""
         cfg = self.config
         shared = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="shared")
         if attention_mask is None:
@@ -199,21 +277,40 @@ class T5Model(nn.Module):
 
         spec = P(("data", "fsdp"), "seq", None)
         enc_layer = nn.remat(T5EncoderLayer, prevent_cse=False) if cfg.remat else T5EncoderLayer
-        dec_layer = nn.remat(T5DecoderLayer, prevent_cse=False) if cfg.remat else T5DecoderLayer
+        dec_layer = (
+            nn.remat(T5DecoderLayer, prevent_cse=False, static_argnums=(5, 6)) if cfg.remat else T5DecoderLayer
+        )
 
-        h = maybe_shard(shared(input_ids), spec)
-        enc_bias = None  # computed by layer 0, shared by layers 1..N (HF T5Stack)
-        for i in range(cfg.num_layers):
-            h, enc_bias = enc_layer(cfg, has_relative_bias=(i == 0), name=f"enc_layer_{i}")(
-                h, attention_mask, enc_bias
+        if not decode or encode:
+            h = maybe_shard(shared(input_ids), spec)
+            enc_bias = None  # computed by layer 0, shared by layers 1..N (HF T5Stack)
+            for i in range(cfg.num_layers):
+                h, enc_bias = enc_layer(cfg, has_relative_bias=(i == 0), name=f"enc_layer_{i}")(
+                    h, attention_mask, enc_bias
+                )
+            enc_out = RMSNorm(cfg.layer_norm_eps, name="enc_final_norm")(h)
+        else:
+            enc_out = None
+
+        if decode:
+            # persist encoder activations + mask for the per-token steps
+            b = decoder_input_ids.shape[0]
+            s_enc = input_ids.shape[1]
+            enc_store = self.variable(
+                "cache", "enc_out", jnp.zeros, (b, s_enc, cfg.hidden_size), jnp.float32
             )
-        enc_out = RMSNorm(cfg.layer_norm_eps, name="enc_final_norm")(h)
+            mask_store = self.variable("cache", "enc_mask", jnp.zeros, (b, s_enc), jnp.bool_)
+            if encode:
+                enc_store.value = enc_out.astype(jnp.float32)
+                mask_store.value = attention_mask
+            enc_out = enc_store.value.astype(shared.embedding.dtype)
+            attention_mask = mask_store.value
 
         d = maybe_shard(shared(decoder_input_ids), spec)
         dec_bias = None
         for i in range(cfg.num_layers):
             d, dec_bias = dec_layer(cfg, has_relative_bias=(i == 0), name=f"dec_layer_{i}")(
-                d, enc_out, attention_mask, dec_bias
+                d, enc_out, attention_mask, dec_bias, decode, encode
             )
         d = RMSNorm(cfg.layer_norm_eps, name="dec_final_norm")(d)
         if cfg.tie_word_embeddings:
@@ -229,7 +326,24 @@ def create_t5_model(config: Optional[T5Config] = None, seed: int = 0, seq_len: i
     dummy = jnp.zeros((2, seq_len), jnp.int32)
     params = module.init(jax.random.key(seed), dummy, dummy)["params"]
 
-    def apply_fn(p, input_ids, decoder_input_ids, attention_mask=None):
+    def apply_fn(p, input_ids, decoder_input_ids, attention_mask=None, decode=False, cache=None):
+        """decode=True threads the decoder KV cache (+ stored encoder
+        output): pass ``cache`` (None primes it — the encoder runs once)
+        and receive ``(logits, new_cache)``."""
+        if decode:
+            variables = {"params": p}
+            if cache is not None:
+                variables["cache"] = cache
+            logits, mutated = module.apply(
+                variables,
+                input_ids,
+                decoder_input_ids,
+                attention_mask,
+                decode=True,
+                encode=cache is None,
+                mutable=["cache"],
+            )
+            return logits, mutated["cache"]
         return module.apply({"params": p}, input_ids, decoder_input_ids, attention_mask)
 
     model = Model(apply_fn, params, sharding_rules=T5_SHARDING_RULES, name="t5")

@@ -39,6 +39,15 @@ def main():
     dt = per_token_latency(model, batch_size=1, prompt_len=16, n_tokens=8)
     accelerator.print(f"per-token decode latency: {dt * 1e3:.2f} ms")
 
+    # encoder-decoder generation: encode once, cached decoder steps
+    from accelerate_tpu import generate_seq2seq
+    from accelerate_tpu.models import T5Config, create_t5_model
+
+    t5 = create_t5_model(T5Config.tiny(max_decode_len=32), seed=0, seq_len=16)
+    src = rng.integers(5, 250, size=(1, 16)).astype(np.int32)
+    summary = generate_seq2seq(t5, src, max_new_tokens=8)
+    accelerator.print(f"seq2seq: {np.asarray(summary)[0].tolist()}")
+
 
 if __name__ == "__main__":
     main()

@@ -147,3 +147,47 @@ def test_gptneox_greedy_matches_full_prefix():
         logits = np.asarray(model(full))
         full = np.concatenate([full, logits[:, -1].argmax(-1).astype(np.int32)[:, None]], 1)
     np.testing.assert_array_equal(out, full)
+
+
+def test_t5_seq2seq_greedy_matches_full_rerun():
+    """Cached encoder-decoder generation must equal greedy decoding via
+    full teacher-forced re-runs (the same gold standard as the decoder-only
+    tests): encoder runs ONCE, decoder steps hit the KV cache + stored
+    encoder output."""
+    from accelerate_tpu.generation import generate_seq2seq
+    from accelerate_tpu.models.t5 import T5Config, create_t5_model
+
+    m = create_t5_model(T5Config.tiny(max_decode_len=32), seed=0, seq_len=8)
+    src = (np.arange(2 * 8).reshape(2, 8) % 250).astype(np.int32)
+
+    dec = np.zeros((2, 1), np.int32)
+    for _ in range(6):
+        logits = m.apply_fn(m.params, src, dec)
+        nxt = np.asarray(logits)[:, -1].argmax(-1).astype(np.int32)
+        dec = np.concatenate([dec, nxt[:, None]], axis=1)
+
+    out = np.asarray(generate_seq2seq(m, src, max_new_tokens=6))
+    np.testing.assert_array_equal(out, dec)
+
+
+def test_t5_seq2seq_respects_attention_mask_and_eos():
+    from accelerate_tpu.generation import generate_seq2seq
+    from accelerate_tpu.models.t5 import T5Config, create_t5_model
+
+    m = create_t5_model(T5Config.tiny(max_decode_len=16), seed=1, seq_len=8)
+    src = (np.arange(2 * 8).reshape(2, 8) % 250).astype(np.int32)
+    mask = np.ones((2, 8), bool)
+    mask[:, 5:] = False  # padded tail must not change with its content
+    out_a = np.asarray(generate_seq2seq(m, src, max_new_tokens=4, attention_mask=mask))
+    src_b = src.copy()
+    src_b[:, 5:] = 7  # garbage under the mask
+    out_b = np.asarray(generate_seq2seq(m, src_b, max_new_tokens=4, attention_mask=mask))
+    np.testing.assert_array_equal(out_a, out_b)
+
+    # eos freezes a finished sequence
+    eos = int(out_a[0, 1])
+    out_eos = np.asarray(generate_seq2seq(m, src, max_new_tokens=6, attention_mask=mask, eos_token_id=eos))
+    assert (out_eos[0, 1:] == eos).all()
+
+    with pytest.raises(ValueError, match="max_decode_len"):
+        generate_seq2seq(m, src, max_new_tokens=99)
