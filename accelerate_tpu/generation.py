@@ -168,8 +168,11 @@ def generate_seq2seq(
 
     apply_fn = model.apply_fn
     params = model.params
-    input_ids = jnp.asarray(input_ids, jnp.int32)
-    b, src_len = input_ids.shape
+    # token ids for text encoders; float features (e.g. log-mels) pass as-is
+    input_ids = jnp.asarray(input_ids)
+    if jnp.issubdtype(input_ids.dtype, jnp.integer):
+        input_ids = input_ids.astype(jnp.int32)
+    b, src_len = input_ids.shape[:2]
     if attention_mask is None:
         attention_mask = jnp.ones((b, src_len), bool)
 

@@ -1,7 +1,7 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit) + HF safetensors weight import.
-The reference delegates models to transformers; here they ship in-tree
-(SURVEY hard-part #3: torch-free model story)."""
+(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit/whisper) + HF safetensors
+weight import. The reference delegates models to transformers; here they
+ship in-tree (SURVEY hard-part #3: torch-free model story)."""
 
 from .bert import (
     BERT_SHARDING_RULES,
@@ -57,6 +57,12 @@ from .vit import (
     create_vit_model,
     vit_classification_loss,
 )
+from .whisper import (
+    WHISPER_SHARDING_RULES,
+    WhisperConfig,
+    WhisperModel,
+    create_whisper_model,
+)
 from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_bert,
     load_hf_gpt2,
@@ -65,5 +71,6 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_mixtral,
     load_hf_t5,
     load_hf_vit,
+    load_hf_whisper,
     read_safetensors_state,
 )

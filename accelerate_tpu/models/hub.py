@@ -651,3 +651,84 @@ def _merge_into(model, tree: dict):
     model.params = jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(model.params), new_leaves)
     model.imported_weight_count = imported
     return model
+
+
+# --------------------------------------------------------------------- #
+# Whisper
+# --------------------------------------------------------------------- #
+
+_WHISPER_ATTN = {
+    "q_proj.weight": ("q_proj/kernel", True),
+    "q_proj.bias": ("q_proj/bias", False),
+    "k_proj.weight": ("k_proj/kernel", True),
+    "v_proj.weight": ("v_proj/kernel", True),
+    "v_proj.bias": ("v_proj/bias", False),
+    "out_proj.weight": ("out_proj/kernel", True),
+    "out_proj.bias": ("out_proj/bias", False),
+}
+
+_WHISPER_NORMS = {
+    "self_attn_layer_norm": "ln_self",
+    "encoder_attn_layer_norm": "ln_cross",
+    "final_layer_norm": "ln_ffn",
+}
+
+
+def convert_hf_whisper_state(state: dict[str, np.ndarray]) -> dict:
+    """HF ``WhisperForConditionalGeneration`` -> our param pytree. Torch
+    Conv1d weights [out, in, k] transpose to flax [k, in, out]; the decoder
+    output projection is tied to ``embed_tokens`` (proj_out has no weight
+    of its own in the checkpoint)."""
+    state = _strip_prefix(state, ("model.",))
+    tree: dict = {}
+    for conv in ("conv1", "conv2"):
+        if f"encoder.{conv}.weight" in state:
+            _set(tree, f"{conv}/kernel", state[f"encoder.{conv}.weight"].transpose(2, 1, 0))
+            _set(tree, f"{conv}/bias", state[f"encoder.{conv}.bias"])
+    # encoder.embed_positions is the frozen sinusoid table — our model
+    # computes it (models/whisper.py sinusoids), so it is not imported
+    if "decoder.embed_positions.weight" in state:
+        _set(tree, "dec_pos/embedding", state["decoder.embed_positions.weight"])
+    if "decoder.embed_tokens.weight" in state:
+        _set(tree, "embed_tokens/embedding", state["decoder.embed_tokens.weight"])
+    for stack, out_name in (("encoder", "enc_final_norm"), ("decoder", "dec_final_norm")):
+        if f"{stack}.layer_norm.weight" in state:
+            _set(tree, f"{out_name}/scale", state[f"{stack}.layer_norm.weight"])
+            _set(tree, f"{out_name}/bias", state[f"{stack}.layer_norm.bias"])
+
+    pat = re.compile(r"(encoder|decoder)\.layers\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = pat.match(key)
+        if not m:
+            continue
+        stack, idx, rest = m.group(1), int(m.group(2)), m.group(3)
+        prefix = f"{'enc' if stack == 'encoder' else 'dec'}_layer_{idx}"
+        for hf_attn, our_attn in (("self_attn.", "self_attn"), ("encoder_attn.", "cross_attn")):
+            if rest.startswith(hf_attn):
+                name, transpose = _WHISPER_ATTN[rest[len(hf_attn):]]
+                _set(tree, f"{prefix}/{our_attn}/{name}", value.T if transpose else value)
+                break
+        else:
+            for hf_norm, our_norm in _WHISPER_NORMS.items():
+                if rest.startswith(hf_norm + "."):
+                    part = "scale" if rest.endswith("weight") else "bias"
+                    _set(tree, f"{prefix}/{our_norm}/{part}", value)
+                    break
+            else:
+                for fc in ("fc1", "fc2"):
+                    if rest == f"{fc}.weight":
+                        _set(tree, f"{prefix}/{fc}/kernel", value.T)
+                    elif rest == f"{fc}.bias":
+                        _set(tree, f"{prefix}/{fc}/bias", value)
+    return tree
+
+
+def load_hf_whisper(checkpoint_path: str, config=None):
+    from .whisper import WhisperConfig, create_whisper_model
+
+    state = read_safetensors_state(checkpoint_path)
+    tree = convert_hf_whisper_state(state)
+    cfg = config or WhisperConfig()
+    model = create_whisper_model(cfg, n_frames=2 * cfg.max_source_positions, dec_len=8)
+    _merge_into(model, tree)
+    return model

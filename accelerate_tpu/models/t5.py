@@ -130,21 +130,18 @@ class T5Attention(nn.Module):
 
         q = split(q)
         if decode and cross and not self.causal:
-            b, s_enc = kv.shape[:2]
-            ck = self.variable(
-                "cache", "cross_key", jnp.zeros, (b, s_enc, cfg.num_attention_heads, cfg.head_dim), jnp.float32
+            from ..ops.kv_cache import cached_cross_kv
+
+            k, v = cached_cross_kv(
+                self,
+                kv,
+                cfg.num_attention_heads,
+                cfg.head_dim,
+                lambda: split(nn.Dense(inner, use_bias=False, name="k_proj", dtype=kv.dtype, dot_general=_pdg())(kv)),
+                lambda: split(nn.Dense(inner, use_bias=False, name="v_proj", dtype=kv.dtype, dot_general=_pdg())(kv)),
+                prime,
             )
-            cv = self.variable(
-                "cache", "cross_value", jnp.zeros, (b, s_enc, cfg.num_attention_heads, cfg.head_dim), jnp.float32
-            )
-            if prime:
-                ck.value = split(
-                    nn.Dense(inner, use_bias=False, name="k_proj", dtype=kv.dtype, dot_general=_pdg())(kv)
-                ).astype(jnp.float32)
-                cv.value = split(
-                    nn.Dense(inner, use_bias=False, name="v_proj", dtype=kv.dtype, dot_general=_pdg())(kv)
-                ).astype(jnp.float32)
-            k, v = ck.value.astype(q.dtype), cv.value.astype(q.dtype)
+            k, v = k.astype(q.dtype), v.astype(q.dtype)
         else:
             k = split(nn.Dense(inner, use_bias=False, name="k_proj", dtype=hidden.dtype, dot_general=_pdg())(kv))
             v = split(nn.Dense(inner, use_bias=False, name="v_proj", dtype=hidden.dtype, dot_general=_pdg())(kv))
@@ -177,36 +174,29 @@ class T5Attention(nn.Module):
         return out, position_bias
 
     def _cached_causal(self, q, k, v, position_bias):
-        """Incremental self-attention over a fixed-size cache; relative
-        bias computed from ABSOLUTE positions (query t vs keys 0..max)."""
-        cfg = self.config
-        b, s_new, h, d = k.shape
-        max_len = cfg.max_decode_len
-        ck = self.variable("cache", "key", jnp.zeros, (b, max_len, h, d), k.dtype)
-        cv = self.variable("cache", "value", jnp.zeros, (b, max_len, h, d), v.dtype)
-        idx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
-        cur = idx.value
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-        idx.value = cur + s_new
+        """Incremental self-attention over the shared fixed-size cache
+        (ops/kv_cache.py); T5 specifics enter as ``scale=1.0`` (no sqrt(d))
+        and a relative-bias callback over ABSOLUTE positions."""
+        from ..ops.kv_cache import cached_attention
 
-        key_pos = jnp.arange(max_len)
-        q_pos = cur + jnp.arange(s_new)
-        if position_bias is None and self.has_relative_bias:
-            buckets = _bucketize(
-                key_pos[None, :] - q_pos[:, None],
-                cfg.relative_attention_num_buckets,
-                cfg.relative_attention_max_distance,
-                bidirectional=False,
-            )
-            position_bias = self._bias_table()[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value).astype(jnp.float32)
-        if position_bias is not None:
-            logits = logits + position_bias
-        amask = key_pos[None, :] <= q_pos[:, None]  # [s_new, max_len] absolute causal
-        logits = jnp.where(amask[None, None], logits, jnp.finfo(jnp.float32).min)
-        weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", weights, cv.value), position_bias
+        cfg = self.config
+        computed = {"bias": position_bias}
+
+        def bias_fn(q_pos, key_pos):
+            if computed["bias"] is None and self.has_relative_bias:
+                buckets = _bucketize(
+                    key_pos[None, :] - q_pos[:, None],
+                    cfg.relative_attention_num_buckets,
+                    cfg.relative_attention_max_distance,
+                    bidirectional=False,
+                )
+                computed["bias"] = (
+                    self._bias_table()[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+                )
+            return computed["bias"]
+
+        out = cached_attention(self, q, k, v, cfg.max_decode_len, scale=1.0, bias_fn=bias_fn)
+        return out, computed["bias"]
 
 
 class T5FFN(nn.Module):

@@ -1,0 +1,259 @@
+"""Whisper-style speech encoder-decoder (flax.linen): conv frontend over
+log-mel features, sinusoidal encoder positions, learned decoder positions,
+pre-LN transformer blocks, cached incremental decoding.
+
+Extends the zoo beyond text (reference parity: the reference is
+model-agnostic over torch modules — SURVEY §2.1's "works with any
+nn.Module"; the TPU zoo demonstrates the same reach family by family).
+Structure matches HF ``WhisperForConditionalGeneration`` so
+``models/hub.py`` imports checkpoints element-for-element: conv1/conv2
+(stride 2) + GELU, q/v/out projections biased and k unbiased, per-layer
+pre-norms, tied decoder output embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+
+
+@dataclasses.dataclass
+class WhisperConfig:
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384
+    encoder_layers: int = 4
+    decoder_layers: int = 4
+    encoder_attention_heads: int = 6
+    decoder_attention_heads: int = 6
+    encoder_ffn_dim: int = 1536
+    decoder_ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    layer_norm_eps: float = 1e-5
+    max_decode_len: int = 128
+
+    def __post_init__(self):
+        if self.max_decode_len > self.max_target_positions:
+            # positions past the table would silently clamp (JAX OOB gather)
+            raise ValueError(
+                f"max_decode_len ({self.max_decode_len}) exceeds max_target_positions "
+                f"({self.max_target_positions})"
+            )
+
+    @classmethod
+    def tiny(cls, **kw) -> "WhisperConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("num_mel_bins", 8)
+        kw.setdefault("d_model", 32)
+        kw.setdefault("encoder_layers", 2)
+        kw.setdefault("decoder_layers", 2)
+        kw.setdefault("encoder_attention_heads", 4)
+        kw.setdefault("decoder_attention_heads", 4)
+        kw.setdefault("encoder_ffn_dim", 64)
+        kw.setdefault("decoder_ffn_dim", 64)
+        kw.setdefault("max_source_positions", 32)
+        kw.setdefault("max_target_positions", 32)
+        kw.setdefault("max_decode_len", 32)
+        return cls(**kw)
+
+
+WHISPER_SHARDING_RULES = [
+    (r"embed_tokens/embedding", P("tensor", None)),
+    (r"(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"out_proj/kernel", P("tensor", None)),
+    (r"fc1/kernel", P(None, "tensor")),
+    (r"fc2/kernel", P("tensor", None)),
+]
+
+
+def sinusoids(length: int, channels: int) -> np.ndarray:
+    """Whisper's fixed sinusoidal table: [length, channels] with sin | cos
+    halves over log-spaced timescales."""
+    if channels % 2 != 0:
+        raise ValueError(f"channels must be even, got {channels}")
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(np.float32)
+
+
+class WhisperAttention(nn.Module):
+    """MHA with HF Whisper's bias pattern (q/v/out biased, k unbiased) and
+    the zoo's shared cache machinery for causal decode / cross K-V reuse."""
+
+    d_model: int
+    num_heads: int
+    causal: bool = False
+    max_decode_len: int = 448
+
+    @nn.compact
+    def __call__(self, hidden, kv=None, mask=None, decode=False, prime=True):
+        cross = kv is not None
+        kv_in = hidden if kv is None else kv
+        head_dim = self.d_model // self.num_heads
+
+        def split(x):
+            return x.reshape(*x.shape[:-1], self.num_heads, head_dim)
+
+        q = split(nn.Dense(self.d_model, name="q_proj", dtype=hidden.dtype)(hidden))
+        if decode and cross and not self.causal:
+            from ..ops.kv_cache import cached_cross_kv
+
+            k, v = cached_cross_kv(
+                self,
+                kv_in,
+                self.num_heads,
+                head_dim,
+                lambda: split(nn.Dense(self.d_model, use_bias=False, name="k_proj", dtype=kv_in.dtype)(kv_in)),
+                lambda: split(nn.Dense(self.d_model, name="v_proj", dtype=kv_in.dtype)(kv_in)),
+                prime,
+            )
+            k, v = k.astype(q.dtype), v.astype(q.dtype)
+        else:
+            k = split(nn.Dense(self.d_model, use_bias=False, name="k_proj", dtype=hidden.dtype)(kv_in))
+            v = split(nn.Dense(self.d_model, name="v_proj", dtype=hidden.dtype)(kv_in))
+
+        if decode and self.causal:
+            from ..ops.kv_cache import cached_attention
+
+            out = cached_attention(self, q, k, v, self.max_decode_len)
+        else:
+            from ..ops.attention import dot_product_attention
+
+            out = dot_product_attention(
+                q, k, v, mask=None if mask is None else mask[:, None, None, :], causal=self.causal
+            )
+        out = out.reshape(*out.shape[:-2], self.d_model)
+        return nn.Dense(self.d_model, name="out_proj", dtype=hidden.dtype)(out)
+
+
+class WhisperEncoderLayer(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_self", dtype=hidden.dtype)(hidden)
+        hidden = hidden + WhisperAttention(cfg.d_model, cfg.encoder_attention_heads, name="self_attn")(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_ffn", dtype=hidden.dtype)(hidden)
+        h = nn.gelu(nn.Dense(cfg.encoder_ffn_dim, name="fc1", dtype=hidden.dtype)(h), approximate=False)
+        return hidden + nn.Dense(cfg.d_model, name="fc2", dtype=hidden.dtype)(h)
+
+
+class WhisperDecoderLayer(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, hidden, enc_out, decode=False, prime=True):
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_self", dtype=hidden.dtype)(hidden)
+        hidden = hidden + WhisperAttention(
+            cfg.d_model, cfg.decoder_attention_heads, causal=True,
+            max_decode_len=cfg.max_decode_len, name="self_attn"
+        )(h, decode=decode)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_cross", dtype=hidden.dtype)(hidden)
+        hidden = hidden + WhisperAttention(
+            cfg.d_model, cfg.decoder_attention_heads, name="cross_attn"
+        )(h, kv=enc_out, decode=decode, prime=prime)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln_ffn", dtype=hidden.dtype)(hidden)
+        h = nn.gelu(nn.Dense(cfg.decoder_ffn_dim, name="fc1", dtype=hidden.dtype)(h), approximate=False)
+        return hidden + nn.Dense(cfg.d_model, name="fc2", dtype=hidden.dtype)(h)
+
+
+class WhisperModel(nn.Module):
+    config: WhisperConfig
+
+    @nn.compact
+    def __call__(self, input_features, decoder_input_ids, attention_mask=None, decode=False, encode=True):
+        """``input_features`` [B, frames, num_mel_bins] (feature-last; HF's
+        [B, mel, frames] transposed). ``decode=True`` runs the decoder
+        incrementally; the encoder runs once at prefill."""
+        cfg = self.config
+
+        if not decode or encode:
+            x = input_features
+            x = nn.gelu(
+                nn.Conv(cfg.d_model, (3,), padding=((1, 1),), name="conv1", dtype=x.dtype)(x),
+                approximate=False,
+            )
+            x = nn.gelu(
+                nn.Conv(cfg.d_model, (3,), strides=(2,), padding=((1, 1),), name="conv2", dtype=x.dtype)(x),
+                approximate=False,
+            )
+            # fixed (NON-trainable) sinusoids, like HF's frozen
+            # embed_positions: computed, not a param — fine-tuning must not
+            # drift the table (checkpoints store exactly this formula)
+            enc_pos = jnp.asarray(sinusoids(cfg.max_source_positions, cfg.d_model))
+            x = x + enc_pos[None, : x.shape[1]].astype(x.dtype)
+            for i in range(cfg.encoder_layers):
+                x = WhisperEncoderLayer(cfg, name=f"enc_layer_{i}")(x)
+            enc_out = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="enc_final_norm", dtype=x.dtype)(x)
+        else:
+            enc_out = None
+
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, name="embed_tokens")
+        if decode:
+            b = decoder_input_ids.shape[0]
+            s_enc = (input_features.shape[1] + 1) // 2  # conv2 stride halves frames
+            store = self.variable("cache", "enc_out", jnp.zeros, (b, s_enc, cfg.d_model), jnp.float32)
+            pos_idx = self.variable("cache", "dec_pos", lambda: jnp.zeros((), jnp.int32))
+            if encode:
+                store.value = enc_out.astype(jnp.float32)
+            enc_out = store.value.astype(embed.embedding.dtype)
+            positions = pos_idx.value + jnp.arange(decoder_input_ids.shape[1])
+            pos_idx.value = pos_idx.value + decoder_input_ids.shape[1]
+        else:
+            positions = jnp.arange(decoder_input_ids.shape[1])
+
+        dec_pos = self.param(
+            "dec_pos/embedding",
+            nn.initializers.normal(0.02),
+            (cfg.max_target_positions, cfg.d_model),
+        )
+        d = embed(decoder_input_ids) + dec_pos[positions][None].astype(embed.embedding.dtype)
+        for i in range(cfg.decoder_layers):
+            d = WhisperDecoderLayer(cfg, name=f"dec_layer_{i}")(d, enc_out, decode, encode)
+        d = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="dec_final_norm", dtype=d.dtype)(d)
+        return d.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+
+
+def create_whisper_model(
+    config: Optional[WhisperConfig] = None, seed: int = 0, n_frames: int = 16, dec_len: int = 8
+) -> Model:
+    config = config or WhisperConfig.tiny()
+    module = WhisperModel(config)
+    feats = jnp.zeros((2, n_frames, config.num_mel_bins), jnp.float32)
+    ids = jnp.zeros((2, dec_len), jnp.int32)
+    params = module.init(jax.random.key(seed), feats, ids)["params"]
+
+    def apply_fn(p, input_features, decoder_input_ids, attention_mask=None, decode=False, cache=None):
+        """decode=True threads the decoder KV cache (+ stored encoder
+        output): pass ``cache`` (None primes it) -> ``(logits, new_cache)``."""
+        if decode:
+            variables = {"params": p}
+            if cache is not None:
+                variables["cache"] = cache
+            logits, mutated = module.apply(
+                variables,
+                input_features,
+                decoder_input_ids,
+                decode=True,
+                encode=cache is None,
+                mutable=["cache"],
+            )
+            return logits, mutated["cache"]
+        return module.apply({"params": p}, input_features, decoder_input_ids)
+
+    model = Model(apply_fn, params, sharding_rules=WHISPER_SHARDING_RULES, name="whisper")
+    model.config = config
+    model.module = module
+    return model

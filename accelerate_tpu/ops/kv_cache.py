@@ -16,15 +16,21 @@ import jax
 import jax.numpy as jnp
 
 
-def cached_attention(module, q, k, v, max_len: int):
+def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
     """Incremental causal attention against a growing cache.
 
     ``module``: the calling flax module (owns the ``cache`` variables).
     ``q`` [B, S_new, H, D]; ``k``/``v`` [B, S_new, H_kv, D] (GQA when
     H_kv < H). Returns [B, S_new, H, D]. Prefill (S_new = prompt) and
     per-token decode (S_new = 1) share this path.
+
+    ``scale``: logit multiplier (default ``1/sqrt(D)``; T5 passes 1.0).
+    ``bias_fn(q_pos [S_new], key_pos [max_len]) -> [1, H, S_new, max_len]``
+    adds a position-dependent logit bias (T5's relative bias) — computed
+    from ABSOLUTE positions so prefill and steps agree.
     """
     b, s_new, h_kv, d = k.shape
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
     ck = module.variable("cache", "key", jnp.zeros, (b, max_len, h_kv, d), k.dtype)
     cv = module.variable("cache", "value", jnp.zeros, (b, max_len, h_kv, d), v.dtype)
     idx = module.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
@@ -38,17 +44,37 @@ def cached_attention(module, q, k, v, max_len: int):
     # causal over absolute positions: new token i attends to <= cur+i
     key_pos = jnp.arange(max_len)
     q_pos = cur + jnp.arange(s_new)
+    bias = bias_fn(q_pos, key_pos) if bias_fn is not None else None
     if groups > 1:
         # GQA: contract grouped queries against the UN-repeated cache —
         # materializing jnp.repeat over [B, max_len, H, D] would 4x the
         # cache's memory traffic on every decode step
         qg = q.reshape(b, s_new, h_kv, groups, d)
-        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) / math.sqrt(d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) * scale
+        if bias is not None:
+            scores = scores + bias.reshape(1, h_kv, groups, s_new, max_len)
         mask = key_pos[None, None, None, None, :] <= q_pos[None, None, None, :, None]
         probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_all)
         return out.reshape(b, s_new, h_kv * groups, d)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias
     mask = key_pos[None, None, None, :] <= q_pos[None, None, :, None]
     probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
+
+def cached_cross_kv(module, kv, num_heads: int, head_dim: int, make_k, make_v, prime: bool):
+    """Cross-attention K/V cache shared by the encoder-decoder zoo: project
+    the encoder output ONCE at prefill (``prime=True``) and reuse the
+    stored projections on every decode step. ``make_k``/``make_v`` are
+    zero-arg closures running the projection submodules (only invoked when
+    priming, so step traces skip the projection entirely)."""
+    b, s_enc = kv.shape[:2]
+    ck = module.variable("cache", "cross_key", jnp.zeros, (b, s_enc, num_heads, head_dim), jnp.float32)
+    cv = module.variable("cache", "cross_value", jnp.zeros, (b, s_enc, num_heads, head_dim), jnp.float32)
+    if prime:
+        ck.value = make_k().astype(jnp.float32)
+        cv.value = make_v().astype(jnp.float32)
+    return ck.value, cv.value

@@ -292,3 +292,59 @@ def test_vit_import_matches_transformers(tmp_path):
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, x))
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_whisper_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models.whisper import WhisperConfig
+    from accelerate_tpu.models.hub import load_hf_whisper
+
+    hf_cfg = transformers.WhisperConfig(
+        vocab_size=128, num_mel_bins=8, d_model=32,
+        encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_source_positions=16, max_target_positions=16,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2, decoder_start_token_id=1,
+        suppress_tokens=[], begin_suppress_tokens=[],
+    )
+    torch.manual_seed(0)
+    hf = transformers.WhisperForConditionalGeneration(hf_cfg).eval()
+    feats = torch.randn(2, 8, 32)  # [B, mel, frames]; frames = 2*max_source_positions
+    dec_ids = torch.randint(0, 128, (2, 6))
+    with torch.no_grad():
+        want = hf(input_features=feats, decoder_input_ids=dec_ids).logits.numpy()
+
+    cfg = WhisperConfig(
+        vocab_size=128, num_mel_bins=8, d_model=32,
+        encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64,
+        max_source_positions=16, max_target_positions=16, max_decode_len=16,
+    )
+    model = load_hf_whisper(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(
+            model.apply_fn(
+                model.params,
+                feats.numpy().transpose(0, 2, 1),  # feature-last
+                dec_ids.numpy().astype(np.int32),
+            )
+        )
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_whisper_cached_generation_matches_full_rerun():
+    from accelerate_tpu.generation import generate_seq2seq
+    from accelerate_tpu.models.whisper import create_whisper_model
+
+    m = create_whisper_model(seed=3)
+    feats = np.random.default_rng(5).standard_normal((2, 16, 8)).astype(np.float32)
+    dec = np.zeros((2, 1), np.int32)
+    for _ in range(5):
+        logits = m.apply_fn(m.params, feats, dec)
+        nxt = np.asarray(logits)[:, -1].argmax(-1).astype(np.int32)
+        dec = np.concatenate([dec, nxt[:, None]], axis=1)
+    out = np.asarray(generate_seq2seq(m, feats, max_new_tokens=5))
+    np.testing.assert_array_equal(out, dec)

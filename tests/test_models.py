@@ -409,3 +409,27 @@ def test_gptneox_tp_sharding_applies():
     assert "tensor" in str(spec), spec
     out = model(np.zeros((2, 8), np.int32))
     assert out.shape == (2, 8, 256)
+
+
+def test_whisper_forward_and_train_step():
+    """Whisper family: conv frontend + enc-dec transformer trains through
+    the standard prepare/build_train_step path with the seq2seq loss."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import WhisperConfig, create_whisper_model
+    from accelerate_tpu.models.t5 import seq2seq_lm_loss
+
+    acc = Accelerator()
+    model = acc.prepare_model(create_whisper_model(seed=0))
+    acc.prepare_optimizer(optax.adamw(3e-3))
+    step = acc.build_train_step(lambda p, b: seq2seq_lm_loss(p, b, model.apply_fn))
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.standard_normal((8, 16, 8)).astype(np.float32),  # log-mels
+        "labels": rng.integers(0, 250, size=(8, 6)).astype(np.int32),
+    }
+    losses = [float(step(batch)) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
