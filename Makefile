@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style test test-fast test-cli check-imports bench dryrun
+.PHONY: quality style test test-slow test-all test-cli check-imports bench dryrun api-docs
 
 # lint if ruff is installed (its exit code propagates); the zero-dep
 # AST/import gates always run
@@ -23,6 +23,9 @@ test-all:
 
 test-cli:
 	python -m pytest tests/test_cli.py -q
+
+api-docs:
+	python scripts/gen_api_docs.py
 
 bench:
 	python bench.py

@@ -208,3 +208,23 @@ def test_launch_resolves_port_once_for_multiprocess(tmp_path):
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert result.stdout.count("PORT_OK") >= 1
+
+
+def test_api_docs_generator_is_deterministic():
+    """scripts/gen_api_docs.py must be reproducible (no memory-address
+    reprs) and cover the core public surface."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "gen_api_docs", pathlib.Path(__file__).parent.parent / "scripts" / "gen_api_docs.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    page = mod.render_module("accelerate_tpu.accelerator")
+    assert page == mod.render_module("accelerate_tpu.accelerator")  # deterministic
+    assert "0x" not in page
+    assert "build_train_step" in page and "gather_for_metrics" in page
+    ops_page = mod.render_module("accelerate_tpu.ops.qdense")
+    assert "QuantDense" in ops_page and "0x" not in ops_page
