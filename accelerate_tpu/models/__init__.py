@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit/whisper) + HF safetensors
+(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit/whisper/clip) + HF safetensors
 weight import. The reference delegates models to transformers; here they
 ship in-tree (SURVEY hard-part #3: torch-free model story)."""
 
@@ -57,6 +57,13 @@ from .vit import (
     create_vit_model,
     vit_classification_loss,
 )
+from .clip import (
+    CLIP_SHARDING_RULES,
+    CLIPConfig,
+    CLIPModel,
+    clip_contrastive_loss,
+    create_clip_model,
+)
 from .whisper import (
     WHISPER_SHARDING_RULES,
     WhisperConfig,
@@ -71,6 +78,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_mixtral,
     load_hf_t5,
     load_hf_vit,
+    load_hf_clip,
     load_hf_whisper,
     read_safetensors_state,
 )

@@ -1,0 +1,208 @@
+"""CLIP dual encoder (flax.linen): ViT vision tower + causal text tower,
+projection heads, learned logit scale, contrastive loss.
+
+Multi-modal family of the zoo (structure matches HF ``CLIPModel`` for
+element-wise checkpoint import). The TPU-interesting part is the
+contrastive loss: torch implementations must all-gather embeddings across
+data-parallel ranks by hand (open_clip's ``gather_with_grad``) to score
+global-batch negatives; under GSPMD the loss is written over the global
+batch and XLA inserts the gathers — ``clip_contrastive_loss`` is the
+plain similarity matrix, sharded in, replicated math out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+
+
+@dataclasses.dataclass
+class CLIPConfig:
+    # vision tower
+    image_size: int = 224
+    patch_size: int = 32
+    vision_hidden_size: int = 768
+    vision_layers: int = 12
+    vision_heads: int = 12
+    vision_ffn_dim: int = 3072
+    # text tower
+    vocab_size: int = 49408
+    max_text_positions: int = 77
+    text_hidden_size: int = 512
+    text_layers: int = 12
+    text_heads: int = 8
+    text_ffn_dim: int = 2048
+    eos_token_id: int = 49407
+    # joint space
+    projection_dim: int = 512
+    logit_scale_init: float = 2.6592  # ln(1/0.07), HF default
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def tiny(cls, **kw) -> "CLIPConfig":
+        kw.setdefault("image_size", 16)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("vision_hidden_size", 32)
+        kw.setdefault("vision_layers", 2)
+        kw.setdefault("vision_heads", 4)
+        kw.setdefault("vision_ffn_dim", 64)
+        kw.setdefault("vocab_size", 128)
+        kw.setdefault("max_text_positions", 16)
+        kw.setdefault("text_hidden_size", 32)
+        kw.setdefault("text_layers", 2)
+        kw.setdefault("text_heads", 4)
+        kw.setdefault("text_ffn_dim", 64)
+        kw.setdefault("eos_token_id", 2)
+        kw.setdefault("projection_dim", 32)
+        return cls(**kw)
+
+
+CLIP_SHARDING_RULES = [
+    (r"(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"out_proj/kernel", P("tensor", None)),
+    (r"fc1/kernel", P(None, "tensor")),
+    (r"fc2/kernel", P("tensor", None)),
+    (r"token_embed/embedding", P("tensor", None)),
+    (r"(visual|text)_projection/kernel", P(None, "tensor")),
+]
+
+
+def quick_gelu(x):
+    """CLIP's activation: x * sigmoid(1.702 x) (HF ``quick_gelu``)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class CLIPBlock(nn.Module):
+    d_model: int
+    num_heads: int
+    ffn_dim: int
+    eps: float
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, hidden):
+        head_dim = self.d_model // self.num_heads
+
+        def split(x):
+            return x.reshape(*x.shape[:-1], self.num_heads, head_dim)
+
+        h = nn.LayerNorm(epsilon=self.eps, name="ln1", dtype=hidden.dtype)(hidden)
+        q = split(nn.Dense(self.d_model, name="q_proj", dtype=h.dtype)(h))
+        k = split(nn.Dense(self.d_model, name="k_proj", dtype=h.dtype)(h))
+        v = split(nn.Dense(self.d_model, name="v_proj", dtype=h.dtype)(h))
+        from ..ops.attention import dot_product_attention
+
+        out = dot_product_attention(q, k, v, causal=self.causal)
+        out = out.reshape(*out.shape[:-2], self.d_model)
+        hidden = hidden + nn.Dense(self.d_model, name="out_proj", dtype=h.dtype)(out)
+
+        h = nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=hidden.dtype)(hidden)
+        h = quick_gelu(nn.Dense(self.ffn_dim, name="fc1", dtype=h.dtype)(h))
+        return hidden + nn.Dense(self.d_model, name="fc2", dtype=h.dtype)(h)
+
+
+class CLIPModel(nn.Module):
+    config: CLIPConfig
+
+    @nn.compact
+    def __call__(self, pixel_values=None, input_ids=None):
+        """Returns ``(image_embeds, text_embeds, logit_scale)`` — embeds are
+        L2-normalised rows in the joint space; either input may be None to
+        run one tower. ``pixel_values`` [B, H, W, 3] NHWC."""
+        cfg = self.config
+        image_embeds = text_embeds = None
+
+        if pixel_values is not None:
+            p = cfg.patch_size
+            x = nn.Conv(
+                cfg.vision_hidden_size, (p, p), strides=(p, p), padding="VALID",
+                use_bias=False, name="vision/patch_embed", dtype=pixel_values.dtype,
+            )(pixel_values)
+            b, gh, gw, c = x.shape
+            x = x.reshape(b, gh * gw, c)
+            cls = self.param("vision/class_embedding", nn.initializers.normal(0.02), (c,))
+            x = jnp.concatenate([jnp.broadcast_to(cls.astype(x.dtype), (b, 1, c)), x], axis=1)
+            pos = self.param(
+                "vision/pos_embed/embedding", nn.initializers.normal(0.02), (gh * gw + 1, c)
+            )
+            x = x + pos[None].astype(x.dtype)
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="vision/pre_norm", dtype=x.dtype)(x)
+            for i in range(cfg.vision_layers):
+                x = CLIPBlock(
+                    cfg.vision_hidden_size, cfg.vision_heads, cfg.vision_ffn_dim,
+                    cfg.layer_norm_eps, name=f"vision/block_{i}",
+                )(x)
+            pooled = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="vision/post_norm", dtype=x.dtype)(x)[:, 0]
+            image_embeds = nn.Dense(
+                cfg.projection_dim, use_bias=False, name="visual_projection", dtype=pooled.dtype
+            )(pooled)
+            image_embeds = image_embeds / jnp.linalg.norm(image_embeds, axis=-1, keepdims=True)
+
+        if input_ids is not None:
+            tok = nn.Embed(cfg.vocab_size, cfg.text_hidden_size, name="text/token_embed")
+            t = tok(input_ids)
+            tpos = self.param(
+                "text/pos_embed/embedding", nn.initializers.normal(0.02),
+                (cfg.max_text_positions, cfg.text_hidden_size),
+            )
+            t = t + tpos[None, : t.shape[1]].astype(t.dtype)
+            for i in range(cfg.text_layers):
+                t = CLIPBlock(
+                    cfg.text_hidden_size, cfg.text_heads, cfg.text_ffn_dim,
+                    cfg.layer_norm_eps, causal=True, name=f"text/block_{i}",
+                )(t)
+            t = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="text/final_norm", dtype=t.dtype)(t)
+            # pooled = hidden state at the (first) EOS token, HF semantics
+            eos_pos = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+            pooled = t[jnp.arange(t.shape[0]), eos_pos]
+            text_embeds = nn.Dense(
+                cfg.projection_dim, use_bias=False, name="text_projection", dtype=pooled.dtype
+            )(pooled)
+            text_embeds = text_embeds / jnp.linalg.norm(text_embeds, axis=-1, keepdims=True)
+
+        logit_scale = self.param(
+            "logit_scale", lambda key: jnp.asarray(cfg.logit_scale_init, jnp.float32)
+        )
+        return image_embeds, text_embeds, logit_scale
+
+
+def create_clip_model(config: Optional[CLIPConfig] = None, seed: int = 0, batch_size: int = 2) -> Model:
+    config = config or CLIPConfig.tiny()
+    module = CLIPModel(config)
+    pix = jnp.zeros((batch_size, config.image_size, config.image_size, 3), jnp.float32)
+    ids = jnp.zeros((batch_size, config.max_text_positions), jnp.int32)
+    params = module.init(jax.random.key(seed), pix, ids)["params"]
+
+    def apply_fn(p, pixel_values=None, input_ids=None):
+        leaf = jax.tree_util.tree_leaves(p)[0]
+        if pixel_values is not None and jnp.issubdtype(leaf.dtype, jnp.floating):
+            pixel_values = pixel_values.astype(leaf.dtype)
+        return module.apply({"params": p}, pixel_values, input_ids)
+
+    model = Model(apply_fn, params, sharding_rules=CLIP_SHARDING_RULES, name="clip")
+    model.config = config
+    model.module = module
+    return model
+
+
+def clip_contrastive_loss(params, batch, apply_fn):
+    """Symmetric InfoNCE over the GLOBAL batch: similarity of every image
+    against every text. Written as plain global-batch math — with the batch
+    sharded over ``data``/``fsdp``, GSPMD inserts the all-gathers that
+    torch CLIP implementations hand-write (open_clip ``gather_with_grad``),
+    and the negatives span all shards automatically."""
+    img, txt, logit_scale = apply_fn(params, batch["pixel_values"], batch["input_ids"])
+    logits = img.astype(jnp.float32) @ txt.astype(jnp.float32).T * jnp.exp(logit_scale)
+    labels = jnp.arange(logits.shape[0])
+    logp_i = jax.nn.log_softmax(logits, axis=-1)
+    logp_t = jax.nn.log_softmax(logits.T, axis=-1)
+    nll_i = -jnp.take_along_axis(logp_i, labels[:, None], axis=-1).mean()
+    nll_t = -jnp.take_along_axis(logp_t, labels[:, None], axis=-1).mean()
+    return 0.5 * (nll_i + nll_t)

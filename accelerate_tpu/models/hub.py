@@ -732,3 +732,79 @@ def load_hf_whisper(checkpoint_path: str, config=None):
     model = create_whisper_model(cfg, n_frames=2 * cfg.max_source_positions, dec_len=8)
     _merge_into(model, tree)
     return model
+
+
+# --------------------------------------------------------------------- #
+# CLIP
+# --------------------------------------------------------------------- #
+
+_CLIP_BLOCK = {
+    "self_attn.q_proj.weight": ("q_proj/kernel", True),
+    "self_attn.q_proj.bias": ("q_proj/bias", False),
+    "self_attn.k_proj.weight": ("k_proj/kernel", True),
+    "self_attn.k_proj.bias": ("k_proj/bias", False),
+    "self_attn.v_proj.weight": ("v_proj/kernel", True),
+    "self_attn.v_proj.bias": ("v_proj/bias", False),
+    "self_attn.out_proj.weight": ("out_proj/kernel", True),
+    "self_attn.out_proj.bias": ("out_proj/bias", False),
+    "layer_norm1.weight": ("ln1/scale", False),
+    "layer_norm1.bias": ("ln1/bias", False),
+    "layer_norm2.weight": ("ln2/scale", False),
+    "layer_norm2.bias": ("ln2/bias", False),
+    "mlp.fc1.weight": ("fc1/kernel", True),
+    "mlp.fc1.bias": ("fc1/bias", False),
+    "mlp.fc2.weight": ("fc2/kernel", True),
+    "mlp.fc2.bias": ("fc2/bias", False),
+}
+
+_CLIP_FIXED = {
+    "vision_model.embeddings.class_embedding": ("vision/class_embedding", False),
+    "vision_model.embeddings.position_embedding.weight": ("vision/pos_embed/embedding", False),
+    # yes, HF really spells it "pre_layrnorm"
+    "vision_model.pre_layrnorm.weight": ("vision/pre_norm/scale", False),
+    "vision_model.pre_layrnorm.bias": ("vision/pre_norm/bias", False),
+    "vision_model.post_layernorm.weight": ("vision/post_norm/scale", False),
+    "vision_model.post_layernorm.bias": ("vision/post_norm/bias", False),
+    "text_model.embeddings.token_embedding.weight": ("text/token_embed/embedding", False),
+    "text_model.embeddings.position_embedding.weight": ("text/pos_embed/embedding", False),
+    "text_model.final_layer_norm.weight": ("text/final_norm/scale", False),
+    "text_model.final_layer_norm.bias": ("text/final_norm/bias", False),
+    "visual_projection.weight": ("visual_projection/kernel", True),
+    "text_projection.weight": ("text_projection/kernel", True),
+    "logit_scale": ("logit_scale", False),
+}
+
+
+def convert_hf_clip_state(state: dict[str, np.ndarray]) -> dict:
+    """HF ``CLIPModel`` -> our param pytree. Conv patch embedding
+    [d, 3, p, p] transposes to flax [p, p, 3, d]."""
+    tree: dict = {}
+    if "vision_model.embeddings.patch_embedding.weight" in state:
+        _set(
+            tree,
+            "vision/patch_embed/kernel",
+            state["vision_model.embeddings.patch_embedding.weight"].transpose(2, 3, 1, 0),
+        )
+    for hf_key, (ours, transpose) in _CLIP_FIXED.items():
+        if hf_key in state:
+            _set(tree, ours, state[hf_key].T if transpose else state[hf_key])
+    pat = re.compile(r"(vision|text)_model\.encoder\.layers\.(\d+)\.(.+)")
+    for key, value in state.items():
+        m = pat.match(key)
+        if not m:
+            continue
+        tower, idx, rest = m.group(1), int(m.group(2)), m.group(3)
+        if rest in _CLIP_BLOCK:
+            ours, transpose = _CLIP_BLOCK[rest]
+            _set(tree, f"{tower}/block_{idx}/{ours}", value.T if transpose else value)
+    return tree
+
+
+def load_hf_clip(checkpoint_path: str, config=None):
+    from .clip import CLIPConfig, create_clip_model
+
+    state = read_safetensors_state(checkpoint_path)
+    tree = convert_hf_clip_state(state)
+    model = create_clip_model(config or CLIPConfig())
+    _merge_into(model, tree)
+    return model

@@ -348,3 +348,50 @@ def test_whisper_cached_generation_matches_full_rerun():
         dec = np.concatenate([dec, nxt[:, None]], axis=1)
     out = np.asarray(generate_seq2seq(m, feats, max_new_tokens=5))
     np.testing.assert_array_equal(out, dec)
+
+
+def test_clip_import_matches_transformers(tmp_path):
+    import jax
+
+    from accelerate_tpu.models.clip import CLIPConfig
+    from accelerate_tpu.models.hub import load_hf_clip
+
+    hf_cfg = transformers.CLIPConfig(
+        text_config={
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "max_position_embeddings": 16, "eos_token_id": 2,
+            "bos_token_id": 1, "pad_token_id": 0,
+        },
+        vision_config={
+            "hidden_size": 32, "intermediate_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "image_size": 16, "patch_size": 8,
+        },
+        projection_dim=32,
+    )
+    torch.manual_seed(0)
+    hf = transformers.CLIPModel(hf_cfg).eval()
+    pix = torch.randn(2, 3, 16, 16)
+    ids = torch.randint(3, 120, (2, 16))
+    ids[:, 10] = 2  # eos
+    with torch.no_grad():
+        out = hf(input_ids=ids, pixel_values=pix)
+        want_img = out.image_embeds.numpy()
+        want_txt = out.text_embeds.numpy()
+
+    cfg = CLIPConfig(
+        image_size=16, patch_size=8, vision_hidden_size=32, vision_layers=2,
+        vision_heads=4, vision_ffn_dim=64, vocab_size=128, max_text_positions=16,
+        text_hidden_size=32, text_layers=2, text_heads=4, text_ffn_dim=64,
+        eos_token_id=2, projection_dim=32,
+    )
+    model = load_hf_clip(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        img, txt, scale = model.apply_fn(
+            model.params,
+            pix.numpy().transpose(0, 2, 3, 1),  # NHWC
+            ids.numpy().astype(np.int32),
+        )
+    np.testing.assert_allclose(np.asarray(img), want_img, atol=TOL)
+    np.testing.assert_allclose(np.asarray(txt), want_txt, atol=TOL)
+    assert float(scale) == pytest.approx(float(hf.logit_scale.item()), rel=1e-6)
