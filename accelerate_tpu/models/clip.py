@@ -159,8 +159,16 @@ class CLIPModel(nn.Module):
                     cfg.layer_norm_eps, causal=True, name=f"text/block_{i}",
                 )(t)
             t = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="text/final_norm", dtype=t.dtype)(t)
-            # pooled = hidden state at the (first) EOS token, HF semantics
-            eos_pos = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+            # pooled = hidden state at the EOS token, HF semantics
+            # (modeling_clip.py CLIPTextTransformer.forward): legacy configs
+            # with eos_token_id==2 pool at argmax(input_ids) — OpenAI CLIP's
+            # real EOT (49407) is the highest vocab id, so argmax finds it
+            # even though the config says 2. Newer configs pool at the first
+            # occurrence of eos_token_id.
+            if cfg.eos_token_id == 2:
+                eos_pos = jnp.argmax(input_ids, axis=-1)
+            else:
+                eos_pos = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
             pooled = t[jnp.arange(t.shape[0]), eos_pos]
             text_embeds = nn.Dense(
                 cfg.projection_dim, use_bias=False, name="text_projection", dtype=pooled.dtype
