@@ -293,6 +293,50 @@ def dispatch_model(
     return model
 
 
+def shard_model(model, mesh=None, rules=None, dtype=None):
+    """Mesh-shard a Model's params for multi-device inference — the TP
+    answer to the reference's ``dispatch_model`` across GPUs
+    (reference: big_modeling.py:309, inference.py:124-184): instead of one
+    layer per device with per-layer H2D hops, every device holds a
+    column/row slice of every layer (the zoo's Megatron sharding rules) and
+    ``generate`` decodes in place with the KV cache laid out on the same
+    mesh (ops/kv_cache.CACHE_KV_SPEC). A model larger than one chip's HBM
+    fits as long as params/mesh-size does.
+
+    ``mesh``: target mesh (default: all local devices on the ``tensor``
+    axis). ``rules``: override the model's own ``sharding_rules``.
+    ``dtype``: optional cast (e.g. ``jnp.bfloat16``) applied to floating
+    leaves before placement.
+    """
+    import jax
+
+    from .modeling import as_model
+    from .parallel.mesh import MeshConfig
+    from .parallel.sharding import infer_shardings
+
+    model = as_model(model)
+    if mesh is None:
+        mesh = MeshConfig(data=1, tensor=len(jax.local_devices())).build()
+    rules = rules if rules is not None else (model.sharding_rules or [])
+    params = model.params
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        # dtype read from the leaf attribute only: jnp.asarray here would
+        # commit every host leaf to device 0 before the sharded placement
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(dtype)
+            if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            params,
+        )
+    shardings = infer_shardings(params, rules, mesh)
+    model.params = jax.device_put(params, shardings)
+    model.param_shardings = shardings
+    model.mesh = mesh
+    return model
+
+
 def load_checkpoint_in_model(
     flat_target: dict[str, Any],
     checkpoint: str,

@@ -32,6 +32,49 @@ def _jax():
     return jax
 
 
+def _params_mesh(params):
+    """The mesh the model's params live on, if they are mesh-sharded.
+
+    This is what makes ``generate`` multi-device (the reference's headline
+    big-model story: inference.py:124-184 prepare_pippy, big_modeling.py:309
+    dispatch_model): a model prepared with TP/FSDP rules — or sharded by
+    hand — decodes in place, params never leave their shards, and the KV
+    cache is laid out on the same mesh (ops/kv_cache.CACHE_KV_SPEC).
+    """
+    jax = _jax()
+    for leaf in jax.tree_util.tree_leaves(params):
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, jax.sharding.NamedSharding) and s.mesh.size > 1:
+            return s.mesh
+    return None
+
+
+def _shard_batch(x, mesh):
+    """Lay a [B, ...] host batch out over the mesh's data-parallel axes
+    (replicated if B doesn't divide them, or on meshes without those axes)."""
+    jax = _jax()
+    from .parallel.mesh import BATCH_AXES
+    from .parallel.sharding import _prune_spec
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = _prune_spec(
+        PartitionSpec(BATCH_AXES), getattr(x, "ndim", 1), getattr(x, "shape", (1,)), mesh
+    )
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def _trace_ctx(mesh):
+    """Context under which the decode program is traced: pins ``mesh`` for
+    the cache/activation sharding constraints inside model code."""
+    import contextlib
+
+    if mesh is None:
+        return contextlib.nullcontext()
+    from .parallel.sharding import mesh_context
+
+    return mesh_context(mesh)
+
+
 def _make_sampler(temperature: float, top_k: Optional[int]):
     """Greedy / temperature / top-k token sampler shared by the decoder-only
     and encoder-decoder loops."""
@@ -108,13 +151,25 @@ def generate(
             f"dynamic_update_slice would silently wrap and corrupt the output"
         )
 
+    # mesh-sharded decode: if the params live on a multi-device mesh, the
+    # batch is laid out over its data axes and the decode program is traced
+    # with that mesh pinned (KV cache sharded over tensor/data inside)
+    mesh = _params_mesh(params)
+    if mesh is not None:
+        input_ids = _shard_batch(input_ids, mesh)
+
     # the jitted runner is cached on the model: a fresh jit closure per
     # call would retrace + recompile every generate() (and defeat
     # per_token_latency's warm-up)
-    cache_key = (b, prompt_len, max_new_tokens, float(temperature), top_k, eos_token_id)
+    mesh_key = None if mesh is None else tuple(sorted(mesh.shape.items()))
+    cache_key = (b, prompt_len, max_new_tokens, float(temperature), top_k, eos_token_id, mesh_key)
     runners = model.__dict__.setdefault("_generate_runners", {})
     if cache_key in runners:
-        return runners[cache_key](params, input_ids, jax.random.key(seed))
+        # still under the mesh context: jit may retrace on new avals (e.g.
+        # params re-cast), and a retrace without the mesh pinned would drop
+        # the KV-cache sharding constraints
+        with _trace_ctx(mesh):
+            return runners[cache_key](params, input_ids, jax.random.key(seed))
 
     @jax.jit
     def run(params, input_ids, key):
@@ -140,8 +195,10 @@ def generate(
         new_tokens = _scan_new_tokens(step, carry, next_tok, max_new_tokens)
         return jnp.concatenate([input_ids, new_tokens], axis=1)
 
-    runners[cache_key] = run
-    return run(params, input_ids, jax.random.key(seed))
+    with _trace_ctx(mesh):
+        out = run(params, input_ids, jax.random.key(seed))
+    runners[cache_key] = run  # register only after a successful first trace
+    return out
 
 
 def generate_seq2seq(
@@ -191,11 +248,18 @@ def generate_seq2seq(
             f"(max_decode_len={max_dec})"
         )
 
+    mesh = _params_mesh(params)
+    if mesh is not None:
+        input_ids = _shard_batch(input_ids, mesh)
+        attention_mask = _shard_batch(attention_mask, mesh)
+
+    mesh_key = None if mesh is None else tuple(sorted(mesh.shape.items()))
     cache_key = ("s2s", b, src_len, max_new_tokens, decoder_start_token_id,
-                 float(temperature), top_k, eos_token_id)
+                 float(temperature), top_k, eos_token_id, mesh_key)
     runners = model.__dict__.setdefault("_generate_runners", {})
     if cache_key in runners:
-        return runners[cache_key](params, input_ids, attention_mask, jax.random.key(seed))
+        with _trace_ctx(mesh):
+            return runners[cache_key](params, input_ids, attention_mask, jax.random.key(seed))
 
     @jax.jit
     def run(params, input_ids, attention_mask, key):
@@ -220,8 +284,10 @@ def generate_seq2seq(
         new_tokens = _scan_new_tokens(step, carry, next_tok, max_new_tokens)
         return jnp.concatenate([start, new_tokens], axis=1)
 
-    runners[cache_key] = run
-    return run(params, input_ids, attention_mask, jax.random.key(seed))
+    with _trace_ctx(mesh):
+        out = run(params, input_ids, attention_mask, jax.random.key(seed))
+    runners[cache_key] = run  # register only after a successful first trace
+    return out
 
 
 def per_token_latency(model, batch_size: int = 1, prompt_len: int = 32, n_tokens: int = 16) -> float:

@@ -69,8 +69,14 @@ def dot_product_attention(
 
 
 def active_mesh():
-    """The Accelerator's mesh if one is initialised, else None — for pinning
-    the sharded dispatch at trace time from model code."""
+    """The mesh model code should trace against: a ``mesh_context``
+    override (generation.py pins the params' mesh there) wins over the
+    Accelerator singleton's mesh; None when neither is set."""
+    from ..parallel.sharding import context_mesh
+
+    mesh = context_mesh()
+    if mesh is not None:
+        return mesh
     from ..state import AcceleratorState
 
     state = AcceleratorState._shared_state

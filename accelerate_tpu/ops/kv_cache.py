@@ -14,6 +14,26 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES
+
+# Mesh layout of the cache buffers [B, max_len, H(_kv), D]: batch over the
+# data-parallel axes (same BATCH_AXES as the training data path), heads
+# over ``tensor`` — the TP decode layout. With q/k/v projections
+# column-split over ``tensor`` (the zoo's Megatron rules) this keeps the
+# whole decode loop partitioned: each tensor shard attends with its own
+# heads against its own cache slice and only the o_proj row-parallel
+# reduction communicates. ``maybe_shard`` drops axes that don't divide
+# (e.g. GQA with fewer kv heads than tensor shards) or that the active
+# mesh doesn't have, and is a no-op when no mesh is active.
+CACHE_KV_SPEC = P(BATCH_AXES, None, "tensor", None)
+
+
+def _constrain(x):
+    from ..parallel.sharding import maybe_shard
+
+    return maybe_shard(x, CACHE_KV_SPEC)
 
 
 def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
@@ -35,8 +55,8 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
     cv = module.variable("cache", "value", jnp.zeros, (b, max_len, h_kv, d), v.dtype)
     idx = module.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
     cur = idx.value
-    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+    ck.value = _constrain(jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0)))
+    cv.value = _constrain(jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0)))
     idx.value = cur + s_new
 
     k_all, v_all = ck.value, cv.value
@@ -75,6 +95,6 @@ def cached_cross_kv(module, kv, num_heads: int, head_dim: int, make_k, make_v, p
     ck = module.variable("cache", "cross_key", jnp.zeros, (b, s_enc, num_heads, head_dim), jnp.float32)
     cv = module.variable("cache", "cross_value", jnp.zeros, (b, s_enc, num_heads, head_dim), jnp.float32)
     if prime:
-        ck.value = make_k().astype(jnp.float32)
-        cv.value = make_v().astype(jnp.float32)
+        ck.value = _constrain(make_k().astype(jnp.float32))
+        cv.value = _constrain(make_v().astype(jnp.float32))
     return ck.value, cv.value

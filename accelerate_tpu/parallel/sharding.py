@@ -16,6 +16,7 @@ which replaces the reference's size/transformer auto-wrap policies
 
 from __future__ import annotations
 
+import contextlib
 import re
 from typing import Any, Sequence
 
@@ -24,6 +25,29 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 Rules = Sequence[tuple[str, PartitionSpec]]
+
+# Trace-time mesh override stack: lets standalone entry points (the jitted
+# decode loop in generation.py, tests) pin the mesh that ``maybe_shard``
+# constraints resolve against without requiring the Accelerator singleton —
+# a model sharded by hand still gets its KV cache laid out on ITS mesh.
+_MESH_STACK: list = []
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh):
+    """Pin ``mesh`` as the active mesh for ``maybe_shard`` /
+    ``active_mesh`` during tracing. Constraints are baked into the traced
+    program, so the context only needs to wrap the FIRST (tracing) call of
+    a jitted function."""
+    _MESH_STACK.append(mesh)
+    try:
+        yield
+    finally:
+        _MESH_STACK.pop()
+
+
+def context_mesh() -> Mesh | None:
+    return _MESH_STACK[-1] if _MESH_STACK else None
 
 
 def leaf_path_strings(tree: Any) -> list[str]:
@@ -64,6 +88,12 @@ def _prune_spec(spec: PartitionSpec, ndim: int, shape, mesh: Mesh) -> PartitionS
             cleaned.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
+        # axes absent from the mesh are dropped, not a crash: framework specs
+        # (batch/cache layouts) must be harmless on hand-built meshes with
+        # other axis names
+        if any(a not in mesh.shape for a in axes):
+            cleaned.append(None)
+            continue
         size = int(np.prod([mesh.shape[a] for a in axes]))
         cleaned.append(entry if size > 0 and dim % size == 0 else None)
     while cleaned and cleaned[-1] is None:
@@ -180,6 +210,8 @@ def maybe_shard(x: Any, spec: PartitionSpec, mesh: Mesh | None = None):
     """``with_sharding_constraint`` against the active Accelerator mesh;
     no-op when no mesh is initialised (so model code can carry layout
     annotations without requiring the framework)."""
+    if mesh is None:
+        mesh = context_mesh()
     if mesh is None:
         from ..state import AcceleratorState
 
