@@ -249,13 +249,32 @@ def multi_process_launcher(args) -> int:
     """N local processes with a JAX coordinator (testing / multi-host-sim;
     replaces torchrun — reference: commands/launch.py:790-822). A process
     crashing takes the whole group down (the collective would deadlock
-    anyway), then ``--max_restarts`` relaunches the group."""
+    anyway), then ``--max_restarts`` relaunches the group.
+
+    Manual multi-machine topology (GKE jobs, clusters without SSH trust —
+    reference: multi_gpu_launcher node-rank offsets, commands/launch.py:790
+    + utils/launch.py:203-352): the user runs this launcher once per
+    machine with the same ``--num_processes`` (GLOBAL total), the same
+    ``--main_process_ip``/``--main_process_port`` (machine 0 = coordinator)
+    and that machine's ``--machine_rank``; each machine spawns its local
+    share with ``process_id = machine_rank * procs_per_machine +
+    local_rank``."""
     import time
+
+    num_machines = getattr(args, "num_machines", 1) or 1
+    total = args.num_processes
+    if total % num_machines != 0:
+        raise ValueError(
+            f"--num_processes ({total}) is the GLOBAL process count and must be "
+            f"divisible by --num_machines ({num_machines})"
+        )
+    procs_per_machine = total // num_machines
+    rank_base = getattr(args, "machine_rank", 0) * procs_per_machine
 
     def run_once(attempt):
         procs = []
-        for rank in range(args.num_processes):
-            env = build_env(args, process_id=rank, num_processes=args.num_processes)
+        for local_rank in range(procs_per_machine):
+            env = build_env(args, process_id=rank_base + local_rank, num_processes=total)
             env["ACCELERATE_RESTART_COUNT"] = str(attempt)
             cmd = [sys.executable, *_script_argv(args)]
             procs.append(subprocess.Popen(cmd, env=env))
@@ -380,7 +399,9 @@ def launch_command(args) -> int:
             args.tpu_hosts = ",".join(hosts)
     if args.tpu_hosts:
         return pod_ssh_launcher(args)
-    if args.num_processes > 1:
+    if args.num_processes > 1 or getattr(args, "num_machines", 1) > 1:
+        # covers manual multi-machine (this launcher run once per machine
+        # with --machine_rank): each invocation spawns its local share
         return multi_process_launcher(args)
     return simple_launcher(args)
 
