@@ -25,6 +25,7 @@ TPU-native design — no CUDA kernels, no module surgery:
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass
 from typing import Any, Optional
@@ -32,6 +33,34 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# nf4 decode is a 16-entry codebook gather; at GB scale that gather
+# KERNEL-FAULTS the TPU worker (measured on v5e: the XLA gather path crashes
+# the runtime outright — worse than slow, unrecoverable). Guard every nf4
+# decode on TPU: per-leaf at trace time (grouped_dequantize) and aggregate
+# at quantize time (quantize_params), raising an actionable error pointing
+# at int4 (whose Pallas fused dequant-matmul is measured FASTER than nf4
+# could be, ops/pallas_qmatmul.py) long before the faulting op runs.
+# Override (at your own risk) via ACCELERATE_NF4_MAX_ELEMENTS.
+_NF4_DEFAULT_MAX_ELEMENTS = 2**26  # 67M decoded elements per tensor
+
+
+def _nf4_max_elements() -> int:
+    return int(os.environ.get("ACCELERATE_NF4_MAX_ELEMENTS", _NF4_DEFAULT_MAX_ELEMENTS))
+
+
+def _nf4_guard(n_elements: int, what: str):
+    if jax.default_backend() != "tpu":
+        return
+    limit = _nf4_max_elements()
+    if n_elements > limit:
+        raise ValueError(
+            f"nf4 {what} of {n_elements:,} elements exceeds the TPU safety limit "
+            f"({limit:,}): the XLA 16-entry-codebook gather kernel-faults the TPU "
+            f"worker at this scale. Use method='int4' (grouped; Pallas fused "
+            f"dequant-matmul, same accuracy envelope and faster) or 'int8'. "
+            f"If you must, raise ACCELERATE_NF4_MAX_ELEMENTS."
+        )
 
 # QLoRA NF4 codebook (16 quantiles of N(0,1), normalised to [-1, 1]).
 NF4_CODE = np.array(
@@ -161,7 +190,9 @@ def grouped_dequantize(data: jax.Array, scale: jax.Array, method: str) -> jax.Ar
     if method == "int4":
         return (_unpack4(data).astype(jnp.float32) - 8.0) * scale
     if method == "nf4":
-        return jnp.asarray(NF4_CODE)[_unpack4(data)] * scale
+        codes = _unpack4(data)
+        _nf4_guard(int(np.prod(codes.shape)), "decode")
+        return jnp.asarray(NF4_CODE)[codes] * scale
     raise ValueError(f"method must be int8|int4|nf4, got {method!r}")
 
 
@@ -218,17 +249,28 @@ def quantize_params(params: Any, config: Optional[QuantizationConfig] = None) ->
     config = config or QuantizationConfig()
     skip = [re.compile(p) for p in config.skip_patterns]
 
+    def eligible(path, leaf):
+        return (
+            hasattr(leaf, "ndim")
+            and leaf.ndim >= 2
+            and leaf.size >= config.min_size
+            and jnp.issubdtype(leaf.dtype, jnp.floating)
+            and not any(p.search(_path_str(path)) for p in skip)
+        )
+
+    if config.method == "nf4":
+        # the generic wrapped apply (load_and_quantize_model fallback)
+        # decodes EVERY leaf inside one program per forward — guard the
+        # aggregate before quantizing, not at first run
+        total = sum(
+            int(leaf.size)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+            if eligible(path, leaf)
+        )
+        _nf4_guard(total, "model decode (all leaves per forward)")
+
     def maybe_q(path, leaf):
-        name = _path_str(path)
-        if (
-            not hasattr(leaf, "ndim")
-            or leaf.ndim < 2
-            or leaf.size < config.min_size
-            or not jnp.issubdtype(leaf.dtype, jnp.floating)
-            or any(p.search(name) for p in skip)
-        ):
-            return leaf
-        return quantize(leaf, config)
+        return quantize(leaf, config) if eligible(path, leaf) else leaf
 
     return jax.tree_util.tree_map_with_path(maybe_q, params)
 
