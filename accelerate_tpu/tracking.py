@@ -234,13 +234,24 @@ class MLflowTracker(GeneralTracker):
     def __init__(self, run_name: str, logging_dir: Optional[str] = None, **kwargs):
         super().__init__()
         self.run_name = run_name
+        self.logging_dir = logging_dir
         self._init_kwargs = kwargs
 
     @on_main_process
     def start(self):
         import mlflow
 
-        self.active_run = mlflow.start_run(run_name=self.run_name, **self._init_kwargs)
+        # file-store support (reference: tracking.py:705 MLflowTracker uses
+        # MLFLOW_TRACKING_URI / the logging dir): a logging_dir routes runs
+        # to a local file store; ``experiment_name`` selects/creates the
+        # experiment before the run starts.
+        if self.logging_dir:
+            mlflow.set_tracking_uri("file://" + os.path.abspath(self.logging_dir))
+        init_kwargs = dict(self._init_kwargs)
+        experiment = init_kwargs.pop("experiment_name", None)
+        if experiment:
+            mlflow.set_experiment(experiment)
+        self.active_run = mlflow.start_run(run_name=self.run_name, **init_kwargs)
 
     @property
     def tracker(self):

