@@ -53,6 +53,7 @@ def _gpipe_local(
     batched_arg_mask: tuple,
     remat: bool,
     interleave: int = 1,
+    scatter_output: bool = False,
 ):
     """Per-device GPipe body (runs under shard_map).
 
@@ -124,9 +125,20 @@ def _gpipe_local(
     state0 = jnp.zeros_like(mb[0])
     out0 = jnp.zeros_like(mb)
     (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(m + n_stages - 1))
-    # result lives on the last stage; psum of the masked buffer replicates it
-    # across ``pipe`` (matches the replicated out_spec)
-    out = lax.psum(jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out)), axis_name)
+    # the result lives on the last stage only
+    masked = jnp.where(idx == n_stages - 1, out, jnp.zeros_like(out))
+    if scatter_output:
+        # reduce-scatter over the microbatch dim: each stage keeps its
+        # contiguous m-block — HALF the wire traffic of the old full-buffer
+        # psum (ring reduce-scatter moves (S-1)/S vs all-reduce's
+        # 2(S-1)/S), no replicated [M,...] buffer, and downstream consumers
+        # see a pipe-sharded batch layout (better, not just equal: the loss
+        # then reduces over pipe shards too instead of recomputing on
+        # identical replicas)
+        out = lax.psum_scatter(masked, axis_name, scatter_dimension=0, tiled=True)
+        return out.reshape(out.shape[0] * out.shape[1] * out.shape[2], *out.shape[3:])
+    # fallback (microbatches don't divide over stages): replicate via psum
+    out = lax.psum(masked, axis_name)
     return out.reshape(x.shape[0], *out.shape[3:])
 
 
@@ -189,6 +201,15 @@ def pipeline_apply(
     if param_specs is None:
         param_specs = jax.tree.map(lambda l: P(axis_name), layer_params)
     x_spec = P(bspec)
+    # when microbatches divide over stages, the output comes back
+    # reduce-scattered: batch dim sharded (data-major, then pipe) instead of
+    # replicated across pipe — see _gpipe_local
+    scatter_output = num_microbatches % n_stages == 0
+    if scatter_output:
+        batch_axes_t = () if bspec is None else (bspec if isinstance(bspec, tuple) else (bspec,))
+        out_spec = P(batch_axes_t + (axis_name,))
+    else:
+        out_spec = x_spec
     # extras sharing x's batch dim are sharded/microbatched with it
     if batched_args is not None:
         if len(batched_args) != len(broadcast_args):
@@ -209,10 +230,11 @@ def pipeline_apply(
             batched_arg_mask=batched_arg_mask,
             remat=remat,
             interleave=interleave,
+            scatter_output=scatter_output,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec, arg_specs),
-        out_specs=x_spec,
+        out_specs=out_spec,
         check_vma=False,
     )
     return fn(layer_params, x, broadcast_args)

@@ -13,7 +13,9 @@ inherent bubble.
 ALSO verifies the schedule structurally from the compiled HLO: exactly ONE
 while-loop of M+S-1 ticks (the bound's tick count — each device performs M
 useful stage-applies plus the unavoidable S-1 bubble ticks), neighbor-only
-collective-permute, and a single full-buffer replication psum.
+collective-permute, and the output collective: a reduce-scatter when M
+divides over S (each stage keeps its microbatch block — half the wire
+bytes of an all-reduce), the fallback replication psum otherwise.
 
 CAVEAT on the numbers: on the CPU fake mesh the S "devices" share host
 cores and collectives are emulated, so wall-clock overhead_vs_bound is an
@@ -22,6 +24,12 @@ multi-chip TPU the per-tick constant is one collective-permute launch,
 hidden whenever microbatch compute >> ICI latency. The structural checks
 are platform-independent; re-run the timing rows on a pod slice for real
 efficiency numbers.
+
+Run under the real 2-process launcher for a pipe=8 wall-clock row whose
+collectives cross an actual process boundary (the CI gate does this):
+
+    accelerate-tpu launch --num_processes 2 --cpu --fake_devices 4 \
+        -m benchmarks.pipeline_bubble -- --stages 8
 
 Usage: python benchmarks/pipeline_bubble.py [--width 512] [--layers 16]
 """
@@ -45,27 +53,55 @@ def main():
     ap.add_argument("--layers", type=int, default=16)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--stages", type=int, default=None,
+                    help="run only this stage count (multi-process gate uses --stages 8)")
     args = ap.parse_args()
 
-    force_host_platform(args.devices)
+    multiprocess = bool(os.environ.get("ACCELERATE_COORDINATOR_ADDRESS"))
+    if multiprocess:
+        # launched by the real launcher: jax.distributed init via the env
+        # protocol; devices = all processes' fake devices combined
+        from accelerate_tpu.state import PartialState
+
+        PartialState()
+    else:
+        force_host_platform(args.devices)
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from accelerate_tpu.parallel.mesh import MeshConfig
     from accelerate_tpu.parallel.pipeline import pipeline_apply, stage_sharding
 
+    n_dev = len(jax.devices())
+    is_main = not multiprocess or jax.process_index() == 0
     w, L = args.width, args.layers
-    ks = jax.random.split(jax.random.key(0), 2)
-    params = {
-        "w": jax.random.normal(ks[0], (L, w, w)) * 0.05,
-        "b": jax.random.normal(ks[1], (L, w)) * 0.01,
-    }
-    x = jax.random.normal(jax.random.key(2), (args.batch, w))
 
     def layer_fn(p, h):
         return jnp.tanh(h @ p["w"] + p["b"]) + h
+
+    def make_arrays(mesh, param_spec):
+        """Create params/x as GLOBAL arrays via jit out_shardings — works
+        identically single- and multi-process (device_put of host data to
+        non-addressable shards does not)."""
+
+        def build():
+            ks = jax.random.split(jax.random.key(0), 2)
+            params = {
+                "w": jax.random.normal(ks[0], (L, w, w)) * 0.05,
+                "b": jax.random.normal(ks[1], (L, w)) * 0.01,
+            }
+            x = jax.random.normal(jax.random.key(2), (args.batch, w))
+            return params, x
+
+        shardings = (
+            {"w": NamedSharding(mesh, param_spec), "b": NamedSharding(mesh, param_spec)},
+            NamedSharding(mesh, P()),
+        )
+        return jax.jit(build, out_shardings=shardings)()
 
     def timeit(fn, *a, iters=20):
         jax.block_until_ready(fn(*a))
@@ -75,19 +111,26 @@ def main():
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / iters
 
-    # sequential baseline: all layers on one device (pipe=1 fallback path)
-    mesh1 = MeshConfig(data=1, fsdp=1, tensor=1, seq=1, pipe=1, expert=1).build(jax.devices()[:1])
+    # sequential baseline: all layers on one device (pipe=1 fallback path).
+    # In multiprocess mode a 1-device mesh spanning only process 0 can't be
+    # driven from every controller; use a pipe=1 mesh over ALL devices
+    # (same program: the n_stages==1 scan path, replicated).
+    mesh1 = MeshConfig(data=n_dev if multiprocess else 1, fsdp=1, tensor=1, seq=1, pipe=1, expert=1).build(
+        jax.devices() if multiprocess else jax.devices()[:1]
+    )
+    params1, x1 = make_arrays(mesh1, P())
     seq_fn = jax.jit(lambda p, x: pipeline_apply(layer_fn, p, x, mesh=mesh1, num_microbatches=1))
-    t_seq = timeit(seq_fn, params, x)
+    t_seq = timeit(seq_fn, params1, x1)
 
     import re
 
+    stage_counts = (args.stages,) if args.stages else (2, 4, 8)
     rows = []
-    for s in (2, 4, 8):
-        if args.devices < s or L % s:
+    for s in stage_counts:
+        if n_dev < s or L % s:
             continue
         mesh = MeshConfig(pipe=s, data=1, fsdp=1, tensor=1, seq=1, expert=1).build(jax.devices()[:s])
-        sharded = jax.tree.map(lambda l: jax.device_put(l, stage_sharding(mesh)), params)
+        sharded, x = make_arrays(mesh, P("pipe"))
         for m in (4, 8, 16):
             if args.batch % m:
                 continue
@@ -106,33 +149,53 @@ def main():
                 {tuple(map(int, p.split(","))) for p in re.findall(r"\{(\d+,\d+)\}", block)}
                 for block in re.findall(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}", hlo)
             ]
+            if m % s == 0:
+                # reduce-scatter output path: NO replication all-reduce at
+                # all — the old full-buffer psum is gone (round-4 change)
+                out_collective_ok = n_psum == 0 and "reduce-scatter" in hlo
+            else:
+                out_collective_ok = n_psum <= 1  # fallback replication psum
             structural_ok = bool(
                 re.search(rf"constant\({m + s - 1}\)", hlo)  # trip-count constant present
                 and pair_sets
                 and all(ps == ring for ps in pair_sets)
-                and n_psum <= 1  # one replication psum, nothing else
+                and out_collective_ok
                 and "all-gather" not in hlo  # params never gathered
             )
+            # Two bounds:
+            # * t_ideal assumes S devices compute in parallel — the REAL
+            #   hardware bound, unattainable on the fake mesh where the S
+            #   "devices" share host cores (t_seq/S of wall-clock parallel
+            #   speedup cannot exist), so overhead_vs_bound ~ S at best.
+            # * serialized bound t_seq*(M+S-1)/M assumes zero parallel
+            #   speedup (shared cores) and charges only the schedule's tick
+            #   structure — the emulation-meaningful number: it approaches
+            #   1 when per-tick compute dominates schedule overhead.
+            t_serial_bound = t_seq * (m + s - 1) / m
             rows.append({
                 "stages": s, "microbatches": m,
                 "ticks": m + s - 1,
+                "multiprocess": multiprocess,
                 "t_seq_ms": round(t_seq * 1e3, 2),
                 "t_pipe_ms": round(t_pipe * 1e3, 2),
                 "t_ideal_ms": round(t_ideal * 1e3, 2),
                 "overhead_vs_bound": round(t_pipe / t_ideal, 3),
+                "overhead_vs_serialized_bound": round(t_pipe / t_serial_bound, 3),
                 "structural_ok": structural_ok,
             })
-            print(json.dumps(rows[-1]), flush=True)
+            if is_main:
+                print(json.dumps(rows[-1]), flush=True)
 
     if not rows:
         print(json.dumps({"bench": "pipeline_bubble",
-                          "error": f"no runnable (stages, microbatches) for devices={args.devices}, "
+                          "error": f"no runnable (stages, microbatches) for devices={n_dev}, "
                                    f"layers={L}, batch={args.batch}"}), flush=True)
         raise SystemExit(2)
     worst = max(r["overhead_vs_bound"] for r in rows)
     assert all(r["structural_ok"] for r in rows), "schedule structure violates the bubble bound"
-    print(json.dumps({"bench": "pipeline_bubble", "worst_overhead_vs_bound": worst,
-                      "structural_bound_ok": True}), flush=True)
+    if is_main:
+        print(json.dumps({"bench": "pipeline_bubble", "worst_overhead_vs_bound": worst,
+                          "structural_bound_ok": True}), flush=True)
 
 
 if __name__ == "__main__":

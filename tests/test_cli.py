@@ -495,6 +495,29 @@ def test_script_multiprocess():
 
 
 @pytest.mark.slow
+def test_pipeline_bubble_pipe8_multiprocess():
+    """pipe=8 GPipe rows measured under the REAL 2-process launcher
+    (collective-permutes cross the process boundary) with the structural
+    HLO checks green: reduce-scatter output (no replication psum) when
+    microbatches divide over stages."""
+    result = run_cli(
+        "launch", "--num_processes", "2", "--cpu", "--fake_devices", "4",
+        "--main_process_port", "7833", "-m", "benchmarks.pipeline_bubble",
+        "--", "--stages", "8", "--width", "1024", "--layers", "8", "--batch", "64",
+        timeout=600,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    rows = [json.loads(line) for line in result.stdout.splitlines() if line.startswith("{")]
+    pipe8 = [r for r in rows if r.get("stages") == 8]
+    assert pipe8 and all(r["structural_ok"] for r in pipe8), rows
+    assert all(r["multiprocess"] for r in pipe8)
+    # schedule waste beyond the tick structure stays bounded (the
+    # fake-mesh-meaningful bound; t_seq/S parallel speedup cannot exist on
+    # shared host cores — documented in the benchmark)
+    assert min(r["overhead_vs_serialized_bound"] for r in pipe8) <= 1.25, pipe8
+
+
+@pytest.mark.slow
 def test_checkpoint_resume_script_multiprocess(tmp_path):
     """2-process orbax checkpoint round-trip through the real launcher
     (reference analogue: test_state_checkpointing.py, run distributed)."""
