@@ -101,10 +101,14 @@ class Accelerator:
             elif isinstance(handler, DistributedInitKwargs):
                 self.init_handler = handler
             else:
-                from .utils.dataclasses import Fp8RecipeKwargs
+                from .utils.dataclasses import Fp8RecipeKwargs, MixedPrecisionPolicy
 
                 if isinstance(handler, Fp8RecipeKwargs):
                     self.fp8_recipe_handler = handler
+                elif isinstance(handler, MixedPrecisionPolicy):
+                    # full dtype-policy override (e.g. softmax_dtype="bfloat16"
+                    # — the HBM-bandwidth lever, see the policy's docstring)
+                    self._dtype_policy_override = handler
 
         if gradient_accumulation_plugin is None:
             env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
@@ -131,6 +135,8 @@ class Accelerator:
             _from_accelerator=True,
             **init_kwargs,
         )
+        if getattr(self, "_dtype_policy_override", None) is not None:
+            self.state.dtype_policy = self._dtype_policy_override
         self.gradient_state = GradientState(gradient_accumulation_plugin)
         if getattr(self.state.dtype_policy, "fp8", False):
             # attach the recipe where trace-time code (the zoo's dense

@@ -247,6 +247,14 @@ class MixedPrecisionPolicy(KwargsHandler):
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     output_dtype: str = "float32"
+    # Attention softmax math dtype. None (default) keeps the f32 logits /
+    # softmax chain — the numerically conservative choice. "bfloat16" skips
+    # the f32 materialisation of the [B, H, S, S] logits: measured 1.10x on
+    # the BERT-base v5e step (170.4 -> 154.8 ms, loss trajectory within
+    # 1.5e-4 after 20 steps; benchmarks/README.md "step breakdown") — the
+    # step is HBM-bound and the f32 score tensors are its biggest
+    # avoidable traffic. Opt in when your convergence gates pass with it.
+    softmax_dtype: Optional[str] = None
     # fp8 mode: the blanket cast stays bf16 (casting raw params/activations
     # to e4m3 without per-tensor scaling destroys training); hot matmuls use
     # the scaled e4m3 path (utils.quantization.fp8_dot — the TE-recipe
