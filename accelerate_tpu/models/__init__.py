@@ -70,6 +70,12 @@ from .whisper import (
     WhisperModel,
     create_whisper_model,
 )
+from .unet import (
+    UNET_SHARDING_RULES,
+    UNet2D,
+    UNetConfig,
+    create_unet_model,
+)
 from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_bert,
     load_hf_gpt2,

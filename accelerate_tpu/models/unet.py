@@ -1,0 +1,214 @@
+"""UNet2D diffusion denoiser (flax.linen) + sinusoidal time conditioning.
+
+The diffusion family of the zoo — the reference's distributed-inference
+examples generate images with diffusers pipelines
+(reference: examples/inference/distributed/stable_diffusion.py,
+distributed_image_generation.py); here the denoiser itself is in-tree and
+TPU-shaped:
+
+* NHWC layout end-to-end (TPU conv layout; torch diffusers is NCHW);
+* GroupNorm statistics in fp32 under the bf16 policy (same stance as
+  RMSNorm in the llama family);
+* the sampling loop lives in :mod:`.diffusion` as one ``lax.scan`` —
+  static shapes, one compile, no per-step dispatch (the decode-loop
+  design of generation.py, applied to denoising steps);
+* optional class conditioning via a label embedding added to the time
+  embedding (classifier-free guidance ready: pass ``num_classes`` and
+  reserve the last id as the null token).
+
+Sharding rules split conv output channels / attention heads over
+``tensor`` — the Megatron column/row pattern applied to convs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    sample_size: int = 32  # H = W
+    base_channels: int = 64
+    channel_mults: Sequence[int] = (1, 2, 2)
+    layers_per_block: int = 1
+    attention_levels: Sequence[int] = (2,)  # indices into channel_mults
+    num_heads: int = 4
+    num_groups: int = 8
+    num_classes: Optional[int] = None  # class-conditional when set
+    dropout: float = 0.0
+
+    @classmethod
+    def tiny(cls, **kw) -> "UNetConfig":
+        kw.setdefault("sample_size", 8)
+        kw.setdefault("base_channels", 16)
+        kw.setdefault("channel_mults", (1, 2))
+        kw.setdefault("attention_levels", (1,))
+        kw.setdefault("num_groups", 4)
+        kw.setdefault("num_heads", 2)
+        return cls(**kw)
+
+
+UNET_SHARDING_RULES = [
+    # conv kernels [kh, kw, in, out]: column-split the out channels
+    (r"conv_(in|1|2)/kernel", P(None, None, None, "tensor")),
+    (r"conv_out/kernel", P(None, None, "tensor", None)),
+    # attention projections
+    (r"(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"out_proj/kernel", P("tensor", None)),
+    # time/label embedding MLPs
+    (r"time_mlp_[12]/kernel", P(None, "tensor")),
+]
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
+    """Sinusoidal embedding [B] -> [B, dim] (DDPM convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class _GroupNorm(nn.Module):
+    groups: int
+
+    @nn.compact
+    def __call__(self, x):
+        # statistics in fp32, output back in the stream dtype
+        return nn.GroupNorm(num_groups=self.groups, dtype=jnp.float32, name="gn")(
+            x.astype(jnp.float32)
+        ).astype(x.dtype)
+
+
+class ResBlock(nn.Module):
+    channels: int
+    groups: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, temb, deterministic: bool = True):
+        h = nn.silu(_GroupNorm(self.groups, name="norm_1")(x))
+        h = nn.Conv(self.channels, (3, 3), padding="SAME", name="conv_1", dtype=x.dtype)(h)
+        # FiLM-style scale/shift from the time embedding
+        ss = nn.Dense(2 * self.channels, name="temb_proj", dtype=x.dtype)(nn.silu(temb))
+        scale, shift = jnp.split(ss[:, None, None, :], 2, axis=-1)
+        h = _GroupNorm(self.groups, name="norm_2")(h) * (1 + scale) + shift
+        h = nn.silu(h)
+        if self.dropout > 0.0:
+            h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
+        h = nn.Conv(self.channels, (3, 3), padding="SAME", name="conv_2", dtype=x.dtype)(h)
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), name="skip_proj", dtype=x.dtype)(x)
+        return x + h
+
+
+class AttnBlock(nn.Module):
+    num_heads: int
+    groups: int
+
+    @nn.compact
+    def __call__(self, x):
+        b, hh, ww, c = x.shape
+        h = _GroupNorm(self.groups, name="norm")(x).reshape(b, hh * ww, c)
+        head_dim = c // self.num_heads
+
+        def split(y):
+            return y.reshape(b, hh * ww, self.num_heads, head_dim)
+
+        q = split(nn.Dense(c, name="q_proj", dtype=x.dtype)(h))
+        k = split(nn.Dense(c, name="k_proj", dtype=x.dtype)(h))
+        v = split(nn.Dense(c, name="v_proj", dtype=x.dtype)(h))
+        from ..ops.attention import dot_product_attention
+
+        out = dot_product_attention(q, k, v, causal=False)
+        out = out.reshape(b, hh * ww, c)
+        out = nn.Dense(c, name="out_proj", dtype=x.dtype)(out)
+        return x + out.reshape(b, hh, ww, c)
+
+
+class UNet2D(nn.Module):
+    config: UNetConfig
+
+    @nn.compact
+    def __call__(self, sample, timesteps, class_labels=None, deterministic: bool = True):
+        """``sample`` [B, H, W, C] (NHWC), ``timesteps`` [B] int/float,
+        optional ``class_labels`` [B]. Returns the predicted noise
+        [B, H, W, out_channels]."""
+        cfg = self.config
+        temb_dim = cfg.base_channels * 4
+        temb = timestep_embedding(timesteps, cfg.base_channels).astype(sample.dtype)
+        temb = nn.Dense(temb_dim, name="time_mlp_1", dtype=sample.dtype)(temb)
+        temb = nn.Dense(temb_dim, name="time_mlp_2", dtype=sample.dtype)(nn.silu(temb))
+        if cfg.num_classes is not None:
+            if class_labels is None:
+                raise ValueError("class-conditional UNet needs class_labels")
+            temb = temb + nn.Embed(cfg.num_classes, temb_dim, name="label_embed")(class_labels).astype(temb.dtype)
+
+        h = nn.Conv(cfg.base_channels, (3, 3), padding="SAME", name="conv_in", dtype=sample.dtype)(sample)
+        skips = [h]
+        # down path
+        for lvl, mult in enumerate(cfg.channel_mults):
+            ch = cfg.base_channels * mult
+            for i in range(cfg.layers_per_block):
+                h = ResBlock(ch, cfg.num_groups, cfg.dropout, name=f"down_{lvl}_{i}")(h, temb, deterministic)
+                if lvl in cfg.attention_levels:
+                    h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"down_attn_{lvl}_{i}")(h)
+                skips.append(h)
+            if lvl != len(cfg.channel_mults) - 1:
+                h = nn.Conv(ch, (3, 3), (2, 2), padding="SAME", name=f"downsample_{lvl}", dtype=h.dtype)(h)
+                skips.append(h)
+        # mid
+        ch = cfg.base_channels * cfg.channel_mults[-1]
+        h = ResBlock(ch, cfg.num_groups, cfg.dropout, name="mid_1")(h, temb, deterministic)
+        h = AttnBlock(cfg.num_heads, cfg.num_groups, name="mid_attn")(h)
+        h = ResBlock(ch, cfg.num_groups, cfg.dropout, name="mid_2")(h, temb, deterministic)
+        # up path (skip concats, mirror order)
+        for lvl, mult in reversed(list(enumerate(cfg.channel_mults))):
+            ch = cfg.base_channels * mult
+            for i in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResBlock(ch, cfg.num_groups, cfg.dropout, name=f"up_{lvl}_{i}")(h, temb, deterministic)
+                if lvl in cfg.attention_levels:
+                    h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"up_attn_{lvl}_{i}")(h)
+            if lvl != 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = nn.Conv(ch, (3, 3), padding="SAME", name=f"upsample_{lvl}", dtype=h.dtype)(h)
+        h = nn.silu(_GroupNorm(cfg.num_groups, name="norm_out")(h))
+        return nn.Conv(cfg.out_channels, (3, 3), padding="SAME", name="conv_out", dtype=jnp.float32)(h)
+
+
+def create_unet_model(config: Optional[UNetConfig] = None, seed: int = 0, batch_size: int = 2) -> Model:
+    config = config or UNetConfig.tiny()
+    module = UNet2D(config)
+    sample = jnp.zeros((batch_size, config.sample_size, config.sample_size, config.in_channels), jnp.float32)
+    t = jnp.zeros((batch_size,), jnp.int32)
+    labels = jnp.zeros((batch_size,), jnp.int32) if config.num_classes else None
+    args = (sample, t, labels) if config.num_classes else (sample, t)
+    params = module.init(jax.random.key(seed), *args)["params"]
+
+    def apply_fn(p, sample, timesteps, class_labels=None, deterministic=True):
+        leaf = jax.tree_util.tree_leaves(p)[0]
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            sample = sample.astype(leaf.dtype)
+        kwargs = {"deterministic": deterministic}
+        if class_labels is not None:
+            kwargs["class_labels"] = class_labels
+        return module.apply({"params": p}, sample, timesteps, **kwargs)
+
+    model = Model(apply_fn, params, sharding_rules=UNET_SHARDING_RULES, name="unet2d")
+    model.config = config
+    model.module = module
+    return model

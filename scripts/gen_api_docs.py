@@ -31,6 +31,7 @@ MODULES = [
     "accelerate_tpu.optimizer",
     "accelerate_tpu.scheduler",
     "accelerate_tpu.generation",
+    "accelerate_tpu.diffusion",
     "accelerate_tpu.big_modeling",
     "accelerate_tpu.checkpointing",
     "accelerate_tpu.tracking",
