@@ -136,7 +136,21 @@ class Accelerator:
             **init_kwargs,
         )
         if getattr(self, "_dtype_policy_override", None) is not None:
-            self.state.dtype_policy = self._dtype_policy_override
+            # the handler must AGREE with mixed_precision on the core dtype
+            # fields — a wholesale override that silently flips them (e.g.
+            # dropping fp8, or bf16 compute under mixed_precision="no")
+            # would be a footgun for users adding the handler just for
+            # softmax_dtype
+            derived, override = self.state.dtype_policy, self._dtype_policy_override
+            for field_name in ("param_dtype", "compute_dtype", "output_dtype", "fp8"):
+                if getattr(override, field_name) != getattr(derived, field_name):
+                    raise ValueError(
+                        f"MixedPrecisionPolicy({field_name}={getattr(override, field_name)!r}) "
+                        f"conflicts with mixed_precision={self.state.mixed_precision!r} "
+                        f"(which implies {field_name}={getattr(derived, field_name)!r}); "
+                        f"set the field to match, or change mixed_precision"
+                    )
+            self.state.dtype_policy = override
         self.gradient_state = GradientState(gradient_accumulation_plugin)
         if getattr(self.state.dtype_policy, "fp8", False):
             # attach the recipe where trace-time code (the zoo's dense

@@ -268,6 +268,20 @@ def multi_process_launcher(args) -> int:
             f"--num_processes ({total}) is the GLOBAL process count and must be "
             f"divisible by --num_machines ({num_machines})"
         )
+    if num_machines > 1 and (getattr(args, "max_restarts", 0) or 0) > 0:
+        # this launcher only supervises ITS machine's share: restarting one
+        # machine's ranks while the other machines' ranks still block in
+        # collectives (and the coordinator holds the old group) hangs the
+        # job instead of recovering it. Coordinated multi-machine restart
+        # needs an external supervisor (k8s Job restartPolicy etc.) that
+        # relaunches EVERY machine; recovery is then checkpoint-based
+        # (ACCELERATE_RESTART_COUNT + load_state) like the single-machine
+        # path.
+        raise ValueError(
+            "--max_restarts is per-machine and cannot coordinate a group "
+            "restart across --num_machines > 1; restart the launcher on "
+            "every machine (e.g. via your job scheduler) instead"
+        )
     procs_per_machine = total // num_machines
     rank_base = getattr(args, "machine_rank", 0) * procs_per_machine
 

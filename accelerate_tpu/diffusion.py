@@ -119,8 +119,14 @@ def sample(
             raise ValueError("class-conditional UNet needs class_labels")
         labels = jnp.asarray(class_labels, jnp.int32)
 
-    cache_key = ("diffusion", batch_size, num_steps, method, float(eta),
-                 guidance_scale, None if mesh is None else tuple(sorted(mesh.shape.items())))
+    # the schedule's arrays are closure-captured by the jitted runner, so
+    # its CONTENT must be part of the cache key — a different schedule with
+    # the same shape would otherwise silently reuse the old constants
+    import hashlib
+
+    sched_key = (T, hashlib.sha1(np.asarray(schedule["alphas_bar"]).tobytes()).hexdigest()[:12])
+    cache_key = ("diffusion", batch_size, num_steps, method, float(eta), guidance_scale,
+                 sched_key, None if mesh is None else tuple(sorted(mesh.shape.items())))
     runners = model.__dict__.setdefault("_generate_runners", {})
 
     ab = jnp.asarray(schedule["alphas_bar"])

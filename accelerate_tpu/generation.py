@@ -58,7 +58,7 @@ def _shard_batch(x, mesh):
     from jax.sharding import NamedSharding, PartitionSpec
 
     spec = _prune_spec(
-        PartitionSpec(BATCH_AXES), getattr(x, "ndim", 1), getattr(x, "shape", (1,)), mesh
+        PartitionSpec(BATCH_AXES), getattr(x, "ndim", 1), getattr(x, "shape", (1,)), mesh, lenient=True
     )
     return jax.device_put(x, NamedSharding(mesh, spec))
 

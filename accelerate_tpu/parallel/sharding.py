@@ -77,9 +77,15 @@ def spec_for_path(path: str, rules: Rules) -> PartitionSpec | None:
     return None
 
 
-def _prune_spec(spec: PartitionSpec, ndim: int, shape, mesh: Mesh) -> PartitionSpec:
+def _prune_spec(spec: PartitionSpec, ndim: int, shape, mesh: Mesh, *, lenient: bool = False) -> PartitionSpec:
     """Trim a spec to the leaf's rank and drop axes that don't divide the
-    dimension (so one rule set works for fused/unfused variants)."""
+    dimension (so one rule set works for fused/unfused variants).
+
+    ``lenient=True`` additionally drops axis NAMES absent from the mesh —
+    for framework-internal specs (batch/cache layouts referencing
+    data/fsdp/tensor) that must be harmless on hand-built meshes with
+    other axis names. User-provided rules stay strict: a typo'd axis
+    raises instead of silently replicating the param."""
     entries = list(spec)[:ndim]
     entries += [None] * (ndim - len(entries))
     cleaned = []
@@ -88,12 +94,15 @@ def _prune_spec(spec: PartitionSpec, ndim: int, shape, mesh: Mesh) -> PartitionS
             cleaned.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
-        # axes absent from the mesh are dropped, not a crash: framework specs
-        # (batch/cache layouts) must be harmless on hand-built meshes with
-        # other axis names
-        if any(a not in mesh.shape for a in axes):
-            cleaned.append(None)
-            continue
+        missing = [a for a in axes if a not in mesh.shape]
+        if missing:
+            if lenient:
+                cleaned.append(None)
+                continue
+            raise ValueError(
+                f"unknown mesh axis {missing[0]!r} in PartitionSpec {tuple(spec)} "
+                f"(mesh axes: {tuple(mesh.shape)})"
+            )
         size = int(np.prod([mesh.shape[a] for a in axes]))
         cleaned.append(entry if size > 0 and dim % size == 0 else None)
     while cleaned and cleaned[-1] is None:
@@ -219,7 +228,7 @@ def maybe_shard(x: Any, spec: PartitionSpec, mesh: Mesh | None = None):
         mesh = state.get("mesh") if state.get("_initialized") else None
     if mesh is None:
         return x
-    spec = _prune_spec(spec, getattr(x, "ndim", 0), getattr(x, "shape", ()), mesh)
+    spec = _prune_spec(spec, getattr(x, "ndim", 0), getattr(x, "shape", ()), mesh, lenient=True)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 

@@ -178,3 +178,24 @@ def test_softmax_dtype_policy_override():
     assert acc.state.dtype_policy.softmax_dtype == "bfloat16"
     np.testing.assert_allclose(fast, base, atol=0.02)
     assert fast != base  # the dtype actually changed the math
+
+
+def test_mixed_precision_policy_conflict_raises():
+    """A MixedPrecisionPolicy handler whose core dtype fields disagree with
+    mixed_precision must raise instead of silently flipping the mode."""
+    import pytest
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import MixedPrecisionPolicy
+
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    with pytest.raises(ValueError, match="conflicts with mixed_precision"):
+        Accelerator(mixed_precision="no", kwargs_handlers=[MixedPrecisionPolicy(softmax_dtype="bfloat16")])
+    AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+    # matching fields are accepted
+    acc = Accelerator(
+        mixed_precision="no",
+        kwargs_handlers=[MixedPrecisionPolicy(compute_dtype="float32", softmax_dtype="bfloat16")],
+    )
+    assert acc.state.dtype_policy.softmax_dtype == "bfloat16"

@@ -115,3 +115,11 @@ def test_sharded_sampling_matches_single_device():
     shard_model(model, mesh)
     got = np.asarray(sample(model, 2, num_steps=3, schedule=s, seed=5))
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_schedule_change_is_not_served_from_cache(tiny_unet):
+    """The runner cache must key on schedule CONTENT: same num_steps with a
+    different schedule must re-trace, not reuse baked-in alphas."""
+    a = np.asarray(sample(tiny_unet, 1, num_steps=3, schedule=make_schedule(64), seed=0))
+    b = np.asarray(sample(tiny_unet, 1, num_steps=3, schedule=make_schedule(256), seed=0))
+    assert not np.array_equal(a, b)

@@ -140,3 +140,12 @@ class TestZeroOptimizerSharding:
             if getattr(l, "ndim", 0) >= 2:
                 spec = getattr(l.sharding, "spec", None)
                 assert spec is None or "data" not in str(spec)
+
+
+def test_unknown_axis_in_user_rule_raises():
+    """A typo'd axis name in user sharding rules must raise, not silently
+    replicate (framework-internal specs stay lenient: _prune_spec lenient=True)."""
+    mesh = MeshConfig(data=2, tensor=4).build()
+    tree = {"w": jax.ShapeDtypeStruct((8, 8), jax.numpy.float32)}
+    with pytest.raises(ValueError, match="tesnor"):
+        infer_shardings(tree, [(r"w", P(None, "tesnor"))], mesh)
