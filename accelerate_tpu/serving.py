@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Callable, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -70,6 +70,9 @@ class ServingEngine:
         max_len: Optional[int] = None,
         eos_token_id: Optional[int] = None,
         tick_block: int = 8,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        seed: int = 0,
     ):
         jax = _jax()
         jnp = jax.numpy
@@ -83,6 +86,13 @@ class ServingEngine:
                 f"(max_position_embeddings={model.config.max_position_embeddings})"
             )
         self.eos_token_id = eos_token_id
+        self.temperature = temperature
+        self._base_key = None  # lazily created per-slot key array
+        self._seed = seed
+
+        from .generation import _make_sampler
+
+        sampler = _make_sampler(temperature, top_k)
 
         params = model.params
         apply_fn = model.apply_fn
@@ -109,13 +119,14 @@ class ServingEngine:
         self._uid = 0
 
         # ---- jitted programs (compiled once each) ----
-        def prefill(params, ids, true_len):
+        def prefill(params, ids, true_len, key):
             """[1, B] padded prompt -> (first next-token, per-row cache with
-            write index reset to true_len)."""
+            write index reset to true_len, advanced key)."""
             b_len = ids.shape[1]
             positions = jnp.broadcast_to(jnp.arange(b_len), (1, b_len))
             logits, cache = apply_fn(params, ids, positions=positions, decode=True, cache=None)
-            next_tok = jnp.argmax(logits[0, true_len - 1].astype(jnp.float32), axis=-1).astype(jnp.int32)
+            key, sub = jax.random.split(key)
+            next_tok = sampler(logits[0, true_len - 1][None], sub)[0]
 
             def fix_index(path, leaf):
                 name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
@@ -124,11 +135,13 @@ class ServingEngine:
                 return leaf
 
             cache = jax.tree_util.tree_map_with_path(fix_index, cache)
-            return next_tok, cache
+            return next_tok, cache, key
 
+        key_aval = jax.eval_shape(lambda: jax.random.key(0))
         self._prefill = {
             b: jax.jit(prefill).lower(
-                params, jax.ShapeDtypeStruct((1, b), jnp.int32), jax.ShapeDtypeStruct((), jnp.int32)
+                params, jax.ShapeDtypeStruct((1, b), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32), key_aval
             ).compile()
             for b in self.prompt_buckets
         }
@@ -153,26 +166,32 @@ class ServingEngine:
             raise ValueError(f"tick_block must be >= 1, got {tick_block}")
         self.tick_block = tick_block
 
-        def one_step(cache_row, tok, pos):
+        def one_step(cache_row, tok, pos, key):
             logits, cache_row = apply_fn(
                 params, tok.reshape(1, 1), positions=pos.reshape(1, 1), decode=True, cache=cache_row
             )
-            nxt = jnp.argmax(logits[0, -1].astype(jnp.float32), axis=-1).astype(jnp.int32)
-            return cache_row, nxt
+            key, sub = jax.random.split(key)
+            nxt = sampler(logits[0, -1][None], sub)[0]
+            return cache_row, nxt, key
 
         @jax.jit
-        def decode_tick(slot_caches, toks, poss):
+        def decode_tick(slot_caches, toks, poss, keys):
             def block_step(carry, _):
-                caches, toks, poss = carry
-                caches, nxt = jax.vmap(one_step)(caches, toks, poss)
-                return (caches, nxt, poss + 1), nxt
+                caches, toks, poss, keys = carry
+                caches, nxt, keys = jax.vmap(one_step)(caches, toks, poss, keys)
+                return (caches, nxt, poss + 1, keys), nxt
 
-            (slot_caches, _, _), toks_k = jax.lax.scan(
-                block_step, (slot_caches, toks, poss), None, length=tick_block
+            (slot_caches, _, _, keys), toks_k = jax.lax.scan(
+                block_step, (slot_caches, toks, poss, keys), None, length=tick_block
             )
-            return slot_caches, toks_k  # [K, slots]
+            return slot_caches, toks_k, keys  # toks_k [K, slots]
 
         self._decode_tick = decode_tick
+        # independent sampling chain per slot (re-folded with the request
+        # uid at each admit, so retries/new requests don't replay a chain)
+        self._slot_keys = jax.vmap(jax.random.fold_in, (None, 0))(
+            jax.random.key(seed), jnp.arange(num_slots)
+        )
 
     # ---- public API ----------------------------------------------------
 
@@ -219,9 +238,11 @@ class ServingEngine:
             bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
             padded = np.zeros((1, bucket), np.int32)
             padded[0, : len(req.prompt)] = req.prompt
-            next_tok, row_cache = self._prefill[bucket](
-                self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt))
+            key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
+            next_tok, row_cache, key = self._prefill[bucket](
+                self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), key
             )
+            self._slot_keys = self._slot_keys.at[slot].set(key)
             self.slot_caches = self._insert(self.slot_caches, row_cache, jnp.int32(slot))
             tok = int(next_tok)
             self.slot_req[slot] = req
@@ -235,8 +256,8 @@ class ServingEngine:
         if self.active_count == 0:
             return 0
 
-        self.slot_caches, toks_k = self._decode_tick(
-            self.slot_caches, jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos)
+        self.slot_caches, toks_k, self._slot_keys = self._decode_tick(
+            self.slot_caches, jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos), self._slot_keys
         )
         toks_k = np.asarray(toks_k)  # [K, slots] — ONE host sync per block
         for slot, req in enumerate(self.slot_req):

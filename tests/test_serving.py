@@ -84,3 +84,27 @@ def test_gpt2_family_works_too():
     eng = ServingEngine(model, num_slots=2, prompt_buckets=(8,))
     [got] = eng.generate_many([prompt], max_new_tokens=4)
     np.testing.assert_array_equal(got, _reference(model, prompt, 4))
+
+
+def test_sampling_deterministic_per_seed(tiny_llama):
+    """Temperature sampling: same seed -> identical outputs, different
+    seed -> different; greedy engines are unaffected by seed."""
+    prompts = [np.arange(1, 7, dtype=np.int32), np.arange(30, 38, dtype=np.int32)]
+
+    def run(seed, temperature=1.0):
+        eng = ServingEngine(
+            tiny_llama, num_slots=2, prompt_buckets=(8,), temperature=temperature, top_k=8, seed=seed
+        )
+        return eng.generate_many(prompts, max_new_tokens=6)
+
+    a, b, c = run(1), run(1), run(2)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+
+
+def test_top_k1_collapses_to_greedy(tiny_llama):
+    prompt = (np.arange(8) % 250).astype(np.int32)
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,), temperature=5.0, top_k=1)
+    [got] = eng.generate_many([prompt], max_new_tokens=5)
+    np.testing.assert_array_equal(got, _reference(tiny_llama, prompt, 5))
