@@ -108,3 +108,22 @@ def test_top_k1_collapses_to_greedy(tiny_llama):
     eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,), temperature=5.0, top_k=1)
     [got] = eng.generate_many([prompt], max_new_tokens=5)
     np.testing.assert_array_equal(got, _reference(tiny_llama, prompt, 5))
+
+
+def test_serving_with_tp_sharded_model(tiny_llama):
+    """The engine composes with mesh-sharded params (serving a model too
+    big for one chip): TP-sharded slots produce the single-device tokens."""
+    import jax
+
+    from accelerate_tpu.big_modeling import shard_model
+    from accelerate_tpu.models import LlamaConfig, create_llama_model
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    prompt = (np.arange(8) % 250).astype(np.int32)
+    want = _reference(tiny_llama, prompt, 5)
+
+    model = create_llama_model(LlamaConfig.tiny(), seq_len=16)
+    shard_model(model, MeshConfig(data=1, tensor=4).build(jax.devices()[:4]))
+    eng = ServingEngine(model, num_slots=2, prompt_buckets=(8,))
+    [got] = eng.generate_many([prompt], max_new_tokens=5)
+    np.testing.assert_array_equal(got, want)
