@@ -56,10 +56,10 @@ class ServingEngine:
 
     ``prompt_buckets``: ascending prefill sizes; each distinct bucket
     compiles one prefill program. ``max_len``: cache capacity per slot
-    (default: the model's ``max_position_embeddings``). Greedy decoding
-    (temperature 0) — the deterministic setting used for the parity
-    tests; sampling plugs into ``_decode_tick`` the same way as
-    generation.py's sampler.
+    (default: the model's ``max_position_embeddings``). Decoding is
+    greedy at ``temperature=0`` (the token-exact-vs-generate setting) or
+    temperature/top-k sampling with an independent per-slot key chain
+    folded on the request uid (deterministic per ``seed``).
     """
 
     def __init__(
@@ -85,9 +85,12 @@ class ServingEngine:
                 f"max_len {self.max_len} exceeds the model cache "
                 f"(max_position_embeddings={model.config.max_position_embeddings})"
             )
+        if max(self.prompt_buckets) > self.max_len:
+            raise ValueError(
+                f"prompt bucket {max(self.prompt_buckets)} exceeds the slot cache "
+                f"(max_len={self.max_len})"
+            )
         self.eos_token_id = eos_token_id
-        self.temperature = temperature
-        self._base_key = None  # lazily created per-slot key array
         self._seed = seed
 
         from .generation import _make_sampler
@@ -103,8 +106,6 @@ class ServingEngine:
             params,
             jnp.zeros((1, 1), jnp.int32),
         )
-        self._cache_template = cache0
-
         # slot pool: leading slot axis over the per-row cache pytree
         self.slot_caches = jax.tree.map(
             lambda l: jnp.zeros((num_slots, *l.shape), l.dtype), cache0
@@ -166,7 +167,7 @@ class ServingEngine:
             raise ValueError(f"tick_block must be >= 1, got {tick_block}")
         self.tick_block = tick_block
 
-        def one_step(cache_row, tok, pos, key):
+        def one_step(params, cache_row, tok, pos, key):
             logits, cache_row = apply_fn(
                 params, tok.reshape(1, 1), positions=pos.reshape(1, 1), decode=True, cache=cache_row
             )
@@ -175,10 +176,12 @@ class ServingEngine:
             return cache_row, nxt, key
 
         @jax.jit
-        def decode_tick(slot_caches, toks, poss, keys):
+        def decode_tick(params, slot_caches, toks, poss, keys):
             def block_step(carry, _):
                 caches, toks, poss, keys = carry
-                caches, nxt, keys = jax.vmap(one_step)(caches, toks, poss, keys)
+                caches, nxt, keys = jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(
+                    params, caches, toks, poss, keys
+                )
                 return (caches, nxt, poss + 1, keys), nxt
 
             (slot_caches, _, _, keys), toks_k = jax.lax.scan(
@@ -200,6 +203,8 @@ class ServingEngine:
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if len(prompt) > max(self.prompt_buckets):
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds the largest prompt bucket "
@@ -257,7 +262,8 @@ class ServingEngine:
             return 0
 
         self.slot_caches, toks_k, self._slot_keys = self._decode_tick(
-            self.slot_caches, jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos), self._slot_keys
+            self.model.params, self.slot_caches,
+            jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos), self._slot_keys
         )
         toks_k = np.asarray(toks_k)  # [K, slots] — ONE host sync per block
         for slot, req in enumerate(self.slot_req):
@@ -291,9 +297,7 @@ class ServingEngine:
     def _finished(self, req: _Request, tok: int) -> bool:
         if self.eos_token_id is not None and tok == self.eos_token_id:
             return True
-        if len(req.out_tokens) >= req.max_new_tokens:
-            return True
-        return len(req.prompt) + len(req.out_tokens) >= self.max_len
+        return len(req.out_tokens) >= req.max_new_tokens
 
     def _retire(self, slot: int):
         req = self.slot_req[slot]

@@ -127,3 +127,30 @@ def test_serving_with_tp_sharded_model(tiny_llama):
     eng = ServingEngine(model, num_slots=2, prompt_buckets=(8,))
     [got] = eng.generate_many([prompt], max_new_tokens=5)
     np.testing.assert_array_equal(got, want)
+
+
+def test_params_update_after_construction_is_used(tiny_llama):
+    """decode ticks read self.model.params at call time — swapping weights
+    after engine construction changes outputs (no stale closure)."""
+    import jax
+
+    prompt = (np.arange(8) % 250).astype(np.int32)
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
+    [before] = eng.generate_many([prompt], max_new_tokens=5)
+    old = tiny_llama.params
+    try:
+        tiny_llama.params = jax.tree.map(lambda p: p * 1.5, old)
+        [after] = eng.generate_many([prompt], max_new_tokens=5)
+    finally:
+        tiny_llama.params = old
+    assert not np.array_equal(before, after)
+
+
+def test_bucket_and_budget_validation(tiny_llama):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="bucket"):
+        ServingEngine(tiny_llama, prompt_buckets=(8, 999))
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
+    with _pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.ones((4,), np.int32), max_new_tokens=0)
