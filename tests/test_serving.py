@@ -154,3 +154,13 @@ def test_bucket_and_budget_validation(tiny_llama):
     eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
     with _pytest.raises(ValueError, match="max_new_tokens"):
         eng.submit(np.ones((4,), np.int32), max_new_tokens=0)
+
+
+def test_gptneox_family_works_too():
+    from accelerate_tpu.models import GPTNeoXConfig, create_gptneox_model
+
+    model = create_gptneox_model(GPTNeoXConfig.tiny(), seq_len=16)
+    prompt = (np.arange(6) % 200).astype(np.int32)
+    eng = ServingEngine(model, num_slots=2, prompt_buckets=(8,))
+    [got] = eng.generate_many([prompt], max_new_tokens=4)
+    np.testing.assert_array_equal(got, _reference(model, prompt, 4))
