@@ -1,0 +1,192 @@
+"""Speculative decoding: draft-proposed tokens verified by the target in
+one forward — fewer target passes per emitted token, token-exact output.
+
+No reference analogue (the reference delegates generation); parity-plus
+inference performance surface alongside quantized decode and continuous
+batching. Greedy acceptance: the draft proposes ``gamma`` tokens
+autoregressively, the target scores all of them in ONE forward, the
+longest prefix where the draft matched the target's own argmax is
+accepted, and the target's argmax at the first mismatch is emitted as
+the correction — so every iteration emits ``accepted + 1`` tokens for
+one target forward, and the output equals plain greedy decode of the
+target exactly.
+
+Cache bookkeeping uses the same frontier argument as the serving
+engine's padded prefill: rejected positions leave stale rows in both
+models' caches, but the write index is reset to the accepted frontier,
+and every stale row is overwritten by the next iteration's tokens
+before the causal frontier reaches it — verified token-exact in
+``tests/test_speculative.py``.
+
+Both models run inside a handful of fixed-shape jitted programs (one
+per (prompt_bucket, gamma)); the host loop only reads the per-iteration
+accept count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _reset_index(cache, new_index):
+    """Set every cache write index to ``new_index`` (frontier reset)."""
+    jax = _jax()
+    jnp = jax.numpy
+
+    def fix(path, leaf):
+        name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
+        if name == "index":
+            return jnp.full(leaf.shape, new_index, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def speculative_generate(
+    target_model,
+    draft_model,
+    input_ids,
+    max_new_tokens: int = 32,
+    gamma: int = 4,
+    eos_token_id: Optional[int] = None,
+    return_stats: bool = False,
+):
+    """Greedy speculative decode of ``input_ids`` [1, S] (batch 1).
+
+    ``draft_model`` must share the target's vocabulary (typically a
+    smaller model of the same family). Returns int32 [1, S + n] with
+    n <= max_new_tokens (exactly max_new_tokens without EOS). With
+    ``return_stats``: (tokens, {"target_forwards", "accept_rate", ...}).
+    """
+    jax = _jax()
+    jnp = jax.numpy
+
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if input_ids.ndim != 2 or input_ids.shape[0] != 1:
+        raise ValueError(f"speculative_generate is batch-1 ([1, S]); got {input_ids.shape}")
+    prompt_len = input_ids.shape[1]
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    cap = min(
+        target_model.config.max_position_embeddings,
+        draft_model.config.max_position_embeddings,
+    )
+    # +gamma headroom: the last iteration may write gamma speculative rows
+    # past the budget before the host truncates
+    if prompt_len + max_new_tokens + gamma > cap:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) + gamma "
+            f"({gamma}) exceeds the smaller cache (max_position_embeddings={cap})"
+        )
+
+    key = ("spec", prompt_len, gamma, id(draft_model))
+    runners = target_model.__dict__.setdefault("_generate_runners", {})
+    if key not in runners:
+        t_apply, d_apply = target_model.apply_fn, draft_model.apply_fn
+
+        @jax.jit
+        def prefill(t_params, d_params, ids):
+            positions = jnp.broadcast_to(jnp.arange(prompt_len), (1, prompt_len))
+            t_logits, t_cache = t_apply(t_params, ids, positions=positions, decode=True, cache=None)
+            _, d_cache = d_apply(d_params, ids, positions=positions, decode=True, cache=None)
+            first = jnp.argmax(t_logits[0, -1].astype(jnp.float32)).astype(jnp.int32)
+            return first, t_cache, d_cache
+
+        @jax.jit
+        def spec_step(t_params, d_params, t_cache, d_cache, last_tok, pos):
+            """One iteration at frontier ``pos`` (= entries valid in both
+            caches; ``last_tok`` is the emitted-but-not-yet-cached token).
+            Returns (tokens [gamma+1], n_emit, t_cache, d_cache)."""
+
+            # 1) draft proposes gamma tokens autoregressively
+            def draft_one(carry, _):
+                d_cache, tok, p = carry
+                logits, d_cache = d_apply(
+                    d_params, tok.reshape(1, 1), positions=p.reshape(1, 1), decode=True, cache=d_cache
+                )
+                nxt = jnp.argmax(logits[0, -1].astype(jnp.float32)).astype(jnp.int32)
+                return (d_cache, nxt, p + 1), nxt
+
+            (d_cache, d_last, _), drafts = jax.lax.scan(
+                draft_one, (d_cache, last_tok, pos), None, length=gamma
+            )  # drafts [gamma] = tokens for positions pos+1..pos+gamma
+            # one extra draft pass caches d_gamma's row (needed when every
+            # draft is accepted — the next iteration's frontier includes it)
+            _, d_cache = d_apply(
+                d_params, d_last.reshape(1, 1), positions=(pos + gamma).reshape(1, 1),
+                decode=True, cache=d_cache,
+            )
+
+            # 2) target scores last_tok + ALL gamma drafts in ONE pass:
+            # logits[j] is the target's token for position pos+j+1, so
+            # t_argmax[gamma] is the bonus token when every draft matches
+            fed = jnp.concatenate([last_tok[None], drafts])  # [gamma+1]
+            positions = (pos + jnp.arange(gamma + 1))[None]
+            t_logits, t_cache = t_apply(
+                t_params, fed[None], positions=positions, decode=True, cache=t_cache
+            )
+            t_argmax = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1).astype(jnp.int32)  # [gamma+1]
+
+            # 3) longest matching prefix; correction (or bonus) appended
+            matches = drafts == t_argmax[:gamma]  # [gamma]
+            n_acc = jnp.argmin(jnp.concatenate([matches, jnp.array([False])])).astype(jnp.int32)
+            emit = jnp.where(
+                jnp.arange(gamma + 1) < n_acc, jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]), 0
+            )
+            emit = emit.at[n_acc].set(t_argmax[n_acc])
+            n_emit = n_acc + 1
+
+            # 4) frontier reset: pos+n_emit entries are now valid; stale
+            # rows beyond get overwritten before the causal frontier
+            # reaches them (serving.py prefill argument)
+            new_frontier = pos + n_emit
+            t_cache = _reset_index(t_cache, new_frontier)
+            d_cache = _reset_index(d_cache, new_frontier)
+            return emit, n_emit, t_cache, d_cache
+
+        runners[key] = (prefill, spec_step)
+    prefill, spec_step = runners[key]
+
+    first, t_cache, d_cache = prefill(target_model.params, draft_model.params, input_ids)
+    out = [int(first)]
+    target_forwards = 1
+    pos = prompt_len
+    last = first
+    accepted_total = 0
+    while len(out) < max_new_tokens and (eos_token_id is None or out[-1] != eos_token_id):
+        emit, n_emit, t_cache, d_cache = spec_step(
+            target_model.params, draft_model.params, t_cache, d_cache, last, jnp.int32(pos)
+        )
+        target_forwards += 1
+        n = int(n_emit)
+        toks = np.asarray(emit)[:n].tolist()
+        accepted_total += n - 1
+        if eos_token_id is not None and eos_token_id in toks:
+            toks = toks[: toks.index(eos_token_id) + 1]
+            out.extend(toks)
+            break
+        out.extend(toks)
+        pos += n
+        last = jnp.int32(out[-1])
+
+    out = out[:max_new_tokens]
+    tokens = jnp.concatenate([input_ids, jnp.asarray(out, jnp.int32)[None]], axis=1)
+    if not return_stats:
+        return tokens
+    stats = {
+        "target_forwards": target_forwards,
+        "emitted": len(out),
+        "tokens_per_target_forward": len(out) / target_forwards,
+        "accept_rate": accepted_total / max(1, (target_forwards - 1) * gamma),
+    }
+    return tokens, stats

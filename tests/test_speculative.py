@@ -1,0 +1,66 @@
+"""Speculative decoding: token-exactness vs plain greedy decode, accept
+accounting, cache-frontier correctness (speculative.py)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import LlamaConfig, create_llama_model
+from accelerate_tpu.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def target():
+    return create_llama_model(LlamaConfig.tiny(), seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # different weights (seed) = a realistic imperfect draft
+    return create_llama_model(LlamaConfig.tiny(), seed=7, seq_len=16)
+
+
+def test_token_exact_with_imperfect_draft(target, draft):
+    """The whole point: whatever the draft proposes, the output equals the
+    target's own greedy decode exactly."""
+    ids = (np.arange(8) % 250).astype(np.int32)[None]
+    want = np.asarray(generate(target, ids, max_new_tokens=10))
+    for gamma in (1, 2, 4):
+        got = np.asarray(speculative_generate(target, draft, ids, max_new_tokens=10, gamma=gamma))
+        np.testing.assert_array_equal(got, want), gamma
+
+
+def test_perfect_draft_accepts_everything(target):
+    """Draft == target: every proposal accepted — gamma+1 tokens per
+    target forward (the speedup upper bound) and still token-exact."""
+    ids = np.ones((1, 4), np.int32)
+    want = np.asarray(generate(target, ids, max_new_tokens=9))
+    got, stats = speculative_generate(
+        target, target, ids, max_new_tokens=9, gamma=2, return_stats=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["accept_rate"] == 1.0, stats
+    # 1 prefill + ceil(8/3) spec steps = 4 target forwards for 9 tokens
+    assert stats["target_forwards"] < 9, stats
+    assert stats["tokens_per_target_forward"] > 2.0, stats
+
+
+def test_eos_stops_early(target, draft):
+    ids = np.ones((1, 4), np.int32)
+    full = np.asarray(generate(target, ids, max_new_tokens=8))[0]
+    eos = int(full[6])
+    got = np.asarray(
+        speculative_generate(target, draft, ids, max_new_tokens=8, gamma=2, eos_token_id=eos)
+    )[0]
+    assert got[-1] == eos
+    np.testing.assert_array_equal(got, full[: len(got)])
+
+
+def test_validation(target, draft):
+    ids = np.ones((1, 4), np.int32)
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(target, draft, np.ones((2, 4), np.int32))
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(target, draft, ids, gamma=0)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        speculative_generate(target, draft, ids, max_new_tokens=140)
