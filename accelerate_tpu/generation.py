@@ -290,6 +290,135 @@ def generate_seq2seq(
     return out
 
 
+def beam_search(
+    model,
+    input_ids,
+    max_new_tokens: int = 32,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    eos_token_id: Optional[int] = None,
+    return_scores: bool = False,
+):
+    """Beam-search decode of ``input_ids`` [B, S] (the remaining decode
+    mode of the transformers ``generate`` surface; reference delegates it).
+
+    One jitted program: prefill → expand the KV cache to ``num_beams``
+    rows per batch element → ``lax.scan`` steps that (a) score every
+    (beam, token) continuation, (b) keep the top ``num_beams`` per batch,
+    and (c) REORDER the cache rows along the chosen beams. EOS beams are
+    frozen (score fixed, forced EOS continuation); the returned sequence
+    per batch element maximises ``score / len(new_tokens)**length_penalty``.
+    Returns int32 [B, S + max_new_tokens] (plus [B] normalised scores when
+    ``return_scores``).
+    """
+    jax = _jax()
+    jnp = jax.numpy
+
+    apply_fn = model.apply_fn
+    params = model.params
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, prompt_len = input_ids.shape
+    k = num_beams
+    if k < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    max_pos = getattr(getattr(model, "config", None), "max_position_embeddings", None)
+    if max_pos is not None and prompt_len + max_new_tokens > max_pos:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's cache size (max_position_embeddings={max_pos})"
+        )
+
+    mesh = _params_mesh(params)
+    if mesh is not None:
+        input_ids = _shard_batch(input_ids, mesh)
+
+    cache_key = ("beam", b, prompt_len, max_new_tokens, k, float(length_penalty),
+                 eos_token_id, None if mesh is None else tuple(sorted(mesh.shape.items())))
+    runners = model.__dict__.setdefault("_generate_runners", {})
+    if cache_key in runners:
+        with _trace_ctx(mesh):
+            out = runners[cache_key](params, input_ids)
+            return out if return_scores else out[0]
+
+    NEG = jnp.float32(-1e9)
+
+    @jax.jit
+    def run(params, input_ids):
+        positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+        logits, cache = apply_fn(params, input_ids, positions=positions, decode=True, cache=None)
+        logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)  # [B, V]
+        vocab = logp0.shape[-1]
+
+        # distinct first tokens seed the beams. Cache k/v buffers are
+        # [..., B, max_len, H, D] (a leading layer dim when scanned), so the
+        # batch axis is ndim-4; scalar index leaves have no batch dim.
+        scores, tok0 = jax.lax.top_k(logp0, k)  # [B, K]
+
+        def batch_repeat(l):
+            return jnp.repeat(l, k, axis=l.ndim - 4) if l.ndim >= 4 else l
+
+        def batch_gather(l, rows):
+            return jnp.take(l, rows, axis=l.ndim - 4) if l.ndim >= 4 else l
+
+        cache = jax.tree.map(batch_repeat, cache)  # [.., B*K, ...]
+
+        done = (tok0 == eos_token_id) if eos_token_id is not None else jnp.zeros((b, k), bool)
+        lengths = jnp.ones((b, k), jnp.int32)
+        tokens = jnp.zeros((b, k, max_new_tokens), jnp.int32).at[:, :, 0].set(tok0)
+
+        def step(carry, t):
+            cache, last, scores, done, lengths, tokens = carry
+            # ``last`` was emitted at scan step t-1 and occupies sequence
+            # position prompt_len + t - 1
+            positions = jnp.broadcast_to(prompt_len + t - 1, (b * k, 1))
+            logits, cache = apply_fn(
+                params, last.reshape(b * k, 1), positions=positions, decode=True, cache=cache
+            )
+            logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1).reshape(b, k, vocab)
+            # live beams extend by any token; done beams may only "extend"
+            # by EOS at unchanged score (frozen)
+            cand = scores[:, :, None] + logp
+            if eos_token_id is not None:
+                frozen = jnp.full((b, k, vocab), NEG).at[:, :, eos_token_id].set(0.0) + scores[:, :, None]
+                cand = jnp.where(done[:, :, None], frozen, cand)
+            flat = cand.reshape(b, k * vocab)
+            scores, idx = jax.lax.top_k(flat, k)  # [B, K]
+            beam_idx = idx // vocab  # [B, K] source beam
+            tok = (idx % vocab).astype(jnp.int32)
+
+            batch_arange = jnp.arange(b)[:, None]
+            rows = (batch_arange * k + beam_idx).reshape(-1)  # [B*K] cache row gather
+            cache = jax.tree.map(lambda l: batch_gather(l, rows), cache)
+            done = jnp.take_along_axis(done, beam_idx, axis=1)
+            lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+            tokens = jnp.take_along_axis(tokens, beam_idx[:, :, None], axis=1)
+
+            lengths = lengths + (~done).astype(jnp.int32)
+            if eos_token_id is not None:
+                done = done | (tok == eos_token_id)
+            tokens = tokens.at[:, :, t].set(tok)
+            return (cache, tok, scores, done, lengths, tokens), None
+
+        if max_new_tokens > 1:
+            carry = (cache, tok0, scores, done, lengths, tokens)
+            (cache, _, scores, done, lengths, tokens), _ = jax.lax.scan(
+                step, carry, jnp.arange(1, max_new_tokens)
+            )
+
+        norm = scores / (lengths.astype(jnp.float32) ** length_penalty)
+        best = jnp.argmax(norm, axis=1)  # [B]
+        best_tokens = jnp.take_along_axis(tokens, best[:, None, None], axis=1)[:, 0]  # [B, T]
+        out = jnp.concatenate([input_ids, best_tokens], axis=1)
+        return out, jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+
+    with _trace_ctx(mesh):
+        out = run(params, input_ids)
+    runners[cache_key] = run
+    return out if return_scores else out[0]
+
+
 def per_token_latency(model, batch_size: int = 1, prompt_len: int = 32, n_tokens: int = 16) -> float:
     """Measure steady-state per-token decode latency in seconds (the
     reference's big-model-inference metric, benchmarks README "per-token").

@@ -1,0 +1,84 @@
+"""Beam search (generation.py beam_search): greedy equivalence at beam 1,
+score dominance over greedy, EOS freezing, batching."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import beam_search, generate
+from accelerate_tpu.models import LlamaConfig, create_llama_model
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    return create_llama_model(LlamaConfig.tiny(), seq_len=16)
+
+
+def _seq_logprob(model, ids, prompt_len):
+    """fp32 log-prob of the generated suffix under the model (teacher-forced)."""
+    import jax
+
+    logits = np.asarray(model.apply_fn(model.params, ids))
+    logp = np.asarray(jax.nn.log_softmax(logits.astype(np.float32), axis=-1))
+    total = 0.0
+    for t in range(prompt_len - 1, ids.shape[1] - 1):
+        total += logp[0, t, ids[0, t + 1]]
+    return total
+
+
+def test_beam1_equals_greedy(tiny_llama):
+    ids = (np.arange(2 * 6).reshape(2, 6) % 250).astype(np.int32)
+    want = np.asarray(generate(tiny_llama, ids, max_new_tokens=5))
+    got = np.asarray(beam_search(tiny_llama, ids, max_new_tokens=5, num_beams=1))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beams_never_score_below_greedy(tiny_llama):
+    """The selected beam's sequence log-prob must be >= greedy's (with
+    length_penalty 1 and no EOS both have the same length)."""
+    ids = (np.arange(7) % 250).astype(np.int32)[None]
+    greedy = np.asarray(generate(tiny_llama, ids, max_new_tokens=6))
+    beam = np.asarray(beam_search(tiny_llama, ids, max_new_tokens=6, num_beams=4))
+    lp_greedy = _seq_logprob(tiny_llama, greedy, 7)
+    lp_beam = _seq_logprob(tiny_llama, beam, 7)
+    assert lp_beam >= lp_greedy - 1e-4, (lp_beam, lp_greedy)
+
+
+def test_reported_score_matches_recomputed(tiny_llama):
+    ids = np.ones((1, 5), np.int32)
+    out, score = beam_search(tiny_llama, ids, max_new_tokens=4, num_beams=3, return_scores=True)
+    lp = _seq_logprob(tiny_llama, np.asarray(out), 5)
+    np.testing.assert_allclose(float(score[0]), lp / 4.0, atol=2e-3)  # /len**1.0
+
+
+def test_eos_freezes_beam(tiny_llama):
+    ids = np.ones((1, 4), np.int32)
+    greedy = np.asarray(generate(tiny_llama, ids, max_new_tokens=8))[0]
+    eos = int(greedy[6])
+    out = np.asarray(
+        beam_search(tiny_llama, ids, max_new_tokens=8, num_beams=3, eos_token_id=eos)
+    )[0]
+    gen = out[4:]
+    if eos in gen.tolist():
+        after = gen.tolist()[gen.tolist().index(eos):]
+        assert all(t == eos for t in after), gen
+
+
+def test_batched_rows_independent(tiny_llama):
+    """Each batch row's beam result equals its solo run."""
+    a = (np.arange(6) % 250).astype(np.int32)
+    c = (np.arange(50, 56) % 250).astype(np.int32)
+    both = np.asarray(beam_search(tiny_llama, np.stack([a, c]), max_new_tokens=4, num_beams=3))
+    solo_a = np.asarray(beam_search(tiny_llama, a[None], max_new_tokens=4, num_beams=3))
+    solo_c = np.asarray(beam_search(tiny_llama, c[None], max_new_tokens=4, num_beams=3))
+    np.testing.assert_array_equal(both[0], solo_a[0])
+    np.testing.assert_array_equal(both[1], solo_c[0])
+
+
+def test_validation(tiny_llama):
+    ids = np.ones((1, 4), np.int32)
+    with pytest.raises(ValueError, match="num_beams"):
+        beam_search(tiny_llama, ids, num_beams=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        beam_search(tiny_llama, ids, max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        beam_search(tiny_llama, ids, max_new_tokens=999)
