@@ -85,6 +85,22 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 
 
+def reset_cache_index(cache, new_index):
+    """Set every ``index`` leaf of a cache pytree to ``new_index`` — the
+    frontier reset shared by the serving engine's padded prefill and
+    speculative decoding's accept/reject step: rows past the new frontier
+    are stale but sit beyond the causal mask until overwritten."""
+    import jax
+
+    def fix(path, leaf):
+        name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
+        if name == "index":
+            return jnp.full(leaf.shape, new_index, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
 def cached_cross_kv(module, kv, num_heads: int, head_dim: int, make_k, make_v, prime: bool):
     """Cross-attention K/V cache shared by the encoder-decoder zoo: project
     the encoder output ONCE at prefill (``prime=True``) and reuse the

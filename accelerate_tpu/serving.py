@@ -128,14 +128,9 @@ class ServingEngine:
             logits, cache = apply_fn(params, ids, positions=positions, decode=True, cache=None)
             key, sub = jax.random.split(key)
             next_tok = sampler(logits[0, true_len - 1][None], sub)[0]
+            from .ops.kv_cache import reset_cache_index
 
-            def fix_index(path, leaf):
-                name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
-                if name == "index":
-                    return jnp.full(leaf.shape, true_len, leaf.dtype)
-                return leaf
-
-            cache = jax.tree_util.tree_map_with_path(fix_index, cache)
+            cache = reset_cache_index(cache, true_len)
             return next_tok, cache, key
 
         key_aval = jax.eval_shape(lambda: jax.random.key(0))

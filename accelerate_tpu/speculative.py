@@ -36,18 +36,6 @@ def _jax():
     return jax
 
 
-def _reset_index(cache, new_index):
-    """Set every cache write index to ``new_index`` (frontier reset)."""
-    jax = _jax()
-    jnp = jax.numpy
-
-    def fix(path, leaf):
-        name = str(path[-1].key) if hasattr(path[-1], "key") else str(path[-1])
-        if name == "index":
-            return jnp.full(leaf.shape, new_index, leaf.dtype)
-        return leaf
-
-    return jax.tree_util.tree_map_with_path(fix, cache)
 
 
 def speculative_generate(
@@ -89,9 +77,19 @@ def speculative_generate(
             f"({gamma}) exceeds the smaller cache (max_position_embeddings={cap})"
         )
 
-    key = ("spec", prompt_len, gamma, id(draft_model))
+    from .generation import _params_mesh, _shard_batch, _trace_ctx
+
+    mesh = _params_mesh(target_model.params)
+    if mesh is not None:
+        input_ids = _shard_batch(input_ids, mesh)
+    mesh_key = None if mesh is None else tuple(sorted(mesh.shape.items()))
+    key = ("spec", prompt_len, gamma, mesh_key)
     runners = target_model.__dict__.setdefault("_generate_runners", {})
-    if key not in runners:
+    # the jitted closures capture the DRAFT's apply_fn: a cache hit is only
+    # valid for the same draft function (id() of a dead model can be
+    # recycled, so the value itself carries the identity check)
+    hit = runners.get(key)
+    if hit is None or hit[2] is not draft_model.apply_fn:
         t_apply, d_apply = target_model.apply_fn, draft_model.apply_fn
 
         @jax.jit
@@ -149,28 +147,33 @@ def speculative_generate(
             # 4) frontier reset: pos+n_emit entries are now valid; stale
             # rows beyond get overwritten before the causal frontier
             # reaches them (serving.py prefill argument)
+            from .ops.kv_cache import reset_cache_index
+
             new_frontier = pos + n_emit
-            t_cache = _reset_index(t_cache, new_frontier)
-            d_cache = _reset_index(d_cache, new_frontier)
+            t_cache = reset_cache_index(t_cache, new_frontier)
+            d_cache = reset_cache_index(d_cache, new_frontier)
             return emit, n_emit, t_cache, d_cache
 
-        runners[key] = (prefill, spec_step)
-    prefill, spec_step = runners[key]
+        runners[key] = (prefill, spec_step, d_apply)
+    prefill, spec_step, _ = runners[key]
 
-    first, t_cache, d_cache = prefill(target_model.params, draft_model.params, input_ids)
+    with _trace_ctx(mesh):
+        first, t_cache, d_cache = prefill(target_model.params, draft_model.params, input_ids)
     out = [int(first)]
     target_forwards = 1
     pos = prompt_len
     last = first
     accepted_total = 0
+    n_steps = 0
     while len(out) < max_new_tokens and (eos_token_id is None or out[-1] != eos_token_id):
-        emit, n_emit, t_cache, d_cache = spec_step(
-            target_model.params, draft_model.params, t_cache, d_cache, last, jnp.int32(pos)
-        )
+        with _trace_ctx(mesh):
+            emit, n_emit, t_cache, d_cache = spec_step(
+                target_model.params, draft_model.params, t_cache, d_cache, last, jnp.int32(pos)
+            )
         target_forwards += 1
+        n_steps += 1
         n = int(n_emit)
         toks = np.asarray(emit)[:n].tolist()
-        accepted_total += n - 1
         if eos_token_id is not None and eos_token_id in toks:
             toks = toks[: toks.index(eos_token_id) + 1]
             out.extend(toks)
@@ -183,10 +186,13 @@ def speculative_generate(
     tokens = jnp.concatenate([input_ids, jnp.asarray(out, jnp.int32)[None]], axis=1)
     if not return_stats:
         return tokens
+    # stats count only USABLE tokens (post eos/budget truncation): each spec
+    # step contributes one correction; everything else it kept was accepted
+    accepted_usable = max(0, len(out) - 1 - n_steps)
     stats = {
         "target_forwards": target_forwards,
         "emitted": len(out),
         "tokens_per_target_forward": len(out) / target_forwards,
-        "accept_rate": accepted_total / max(1, (target_forwards - 1) * gamma),
+        "accept_rate": accepted_usable / max(1, n_steps * gamma),
     }
     return tokens, stats

@@ -51,16 +51,18 @@ def test_reported_score_matches_recomputed(tiny_llama):
 
 
 def test_eos_freezes_beam(tiny_llama):
+    """Non-vacuous: pick the eos from the BEAM's own output so the freeze
+    path is always exercised."""
     ids = np.ones((1, 4), np.int32)
-    greedy = np.asarray(generate(tiny_llama, ids, max_new_tokens=8))[0]
-    eos = int(greedy[6])
+    free = np.asarray(beam_search(tiny_llama, ids, max_new_tokens=8, num_beams=3))[0]
+    eos = int(free[6])  # a token the winning beam actually emits mid-sequence
     out = np.asarray(
         beam_search(tiny_llama, ids, max_new_tokens=8, num_beams=3, eos_token_id=eos)
     )[0]
-    gen = out[4:]
-    if eos in gen.tolist():
-        after = gen.tolist()[gen.tolist().index(eos):]
-        assert all(t == eos for t in after), gen
+    gen = out[4:].tolist()
+    assert eos in gen, (eos, gen)
+    after = gen[gen.index(eos):]
+    assert all(t == eos for t in after), gen
 
 
 def test_batched_rows_independent(tiny_llama):
