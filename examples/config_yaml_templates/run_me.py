@@ -1,0 +1,15 @@
+"""Print the accelerator state the active config produces.
+
+Reference analogue: examples/config_yaml_templates/run_me.py — a base
+script that outputs the accelerate config for the given environment. Run
+it with each template to see what the keys do:
+
+    accelerate-tpu launch --config_file examples/config_yaml_templates/hybrid_mesh.yaml \
+        examples/config_yaml_templates/run_me.py
+"""
+
+from accelerate_tpu import Accelerator
+
+accelerator = Accelerator()
+accelerator.print(f"Accelerator state from the current environment:\n{accelerator.state}")
+accelerator.end_training()

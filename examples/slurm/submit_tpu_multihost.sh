@@ -1,0 +1,30 @@
+#!/bin/bash
+# Multi-host TPU slice under Slurm (reference: examples/slurm/submit_multinode.sh).
+# One launcher per node; each node runs its local share of the processes
+# with a global rank offset of SLURM_NODEID * procs-per-node, all
+# rendezvousing at the head node's coordinator.
+#SBATCH --job-name=tpu-multihost
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                   # TPU VMs in the slice
+#SBATCH --ntasks-per-node=1         # ONE launcher per node (it spawns local procs)
+#SBATCH --time=01:59:00
+
+export PROCS_PER_NODE="${PROCS_PER_NODE:-1}"   # chips driven per VM
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+
+export REPO_DIR="${REPO_DIR:-$PWD}"
+export SCRIPT="${SCRIPT:-$REPO_DIR/examples/complete_nlp_example.py}"
+
+# SLURM_NODEID becomes --machine_rank on each node; the launcher computes
+# global process ids as machine_rank * procs_per_machine + local_rank.
+srun bash -c "accelerate-tpu launch \
+    --num_processes $((SLURM_NNODES * PROCS_PER_NODE)) \
+    --num_machines \$SLURM_NNODES \
+    --machine_rank \$SLURM_NODEID \
+    --main_process_ip $head_node_ip \
+    --main_process_port 29500 \
+    --mixed_precision bf16 \
+    --mesh_data $((SLURM_NNODES * PROCS_PER_NODE)) \
+    $SCRIPT --output_dir $REPO_DIR/examples/output"

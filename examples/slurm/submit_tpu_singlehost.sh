@@ -1,0 +1,15 @@
+#!/bin/bash
+# Single TPU VM (reference: examples/slurm/submit_multigpu.sh).
+#SBATCH --job-name=tpu-single
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=01:59:00
+
+export REPO_DIR="${REPO_DIR:-$PWD}"
+export SCRIPT="${SCRIPT:-$REPO_DIR/examples/complete_nlp_example.py}"
+
+srun accelerate-tpu launch --mixed_precision bf16 "$SCRIPT" \
+    --output_dir "$REPO_DIR/examples/output"

@@ -530,3 +530,39 @@ def test_checkpoint_resume_script_multiprocess(tmp_path):
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert "test_checkpoint_resume: ALL OK" in result.stdout
+
+
+def test_config_yaml_templates_are_valid():
+    """Every shipped template (examples/config_yaml_templates/, reference
+    analogue: the same directory upstream) round-trips through the real
+    loader with no key silently dropped."""
+    import pathlib
+
+    from accelerate_tpu.commands.config import CONFIG_KEYS, load_config, _load_yaml
+
+    tdir = pathlib.Path(__file__).parent.parent / "examples" / "config_yaml_templates"
+    templates = sorted(tdir.glob("*.yaml"))
+    assert len(templates) >= 6, templates
+    for path in templates:
+        raw = _load_yaml(path.read_text())
+        unknown = set(raw) - set(CONFIG_KEYS)
+        assert not unknown, f"{path.name}: unknown keys {unknown}"
+        loaded = load_config(str(path))
+        assert set(loaded) == set(raw), f"{path.name}: keys dropped by loader"
+        assert loaded["num_processes"] >= 1 and loaded["num_machines"] >= 1
+
+
+@pytest.mark.slow
+def test_config_template_run_me():
+    """run_me.py launches under a template with CLI overrides winning
+    (reference: config_yaml_templates/run_me.py)."""
+    import pathlib
+
+    tdir = pathlib.Path(__file__).parent.parent / "examples" / "config_yaml_templates"
+    result = run_cli(
+        "launch", "--config_file", str(tdir / "hybrid_mesh.yaml"),
+        "--num_processes", "1", "--cpu", "--fake_devices", "8",
+        str(tdir / "run_me.py"), timeout=300,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "Accelerator state" in result.stdout
