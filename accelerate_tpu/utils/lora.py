@@ -1,0 +1,230 @@
+"""LoRA — low-rank adaptation as a functional transform on param pytrees.
+
+The reference is PEFT-aware rather than PEFT-implementing (reference:
+src/accelerate/utils/modeling.py:73 ``is_peft_model``, the kbit-training
+prep in utils/bnb.py): torch users bring ``peft`` and Accelerate unwraps /
+checkpoints around it. On TPU the idiomatic shape is different — params
+are a pytree, so LoRA is a *pure function of trees*, not a module
+surgery: ``lora_init`` builds an adapter tree mirroring the target
+kernels, the train step merges ``W + (alpha/r)·A@B`` inside ``jit`` (XLA
+fuses the add into the consumer matmul), and only the adapter tree is
+trainable — the base params are frozen by construction, so the optimizer,
+checkpointing, and every parallelism layout work on adapters unchanged.
+
+Supports 2-D kernels and scan-stacked ``[L, in, out]`` kernels (the
+``a @ b`` contraction broadcasts over leading layer dims). Quantized base
+weights (``QTensor`` leaves) are rejected at init with a pointer to the
+fine-tune recipe: dequantize targets, train, re-quantize on export.
+
+Example::
+
+    cfg = LoRAConfig(rank=8)
+    adapters = lora_init(jax.random.key(0), model.params, cfg)
+    def loss_fn(adapters, batch):
+        params = lora_merge(model.params, adapters, cfg)
+        return loss(model.apply_fn(params, **batch), batch["labels"])
+    grads = jax.grad(loss_fn)(adapters, batch)       # adapters only
+    merged = lora_merge(model.params, adapters, cfg) # export
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.sharding import path_str, spec_for_path
+
+# classic LoRA targets: the attention q/v projections, across the zoo's
+# two naming families (bert-style attention/query, llama-style attn/q_proj)
+DEFAULT_TARGETS = r"(attention|attn)/(query|value|q_proj|v_proj)/kernel$"
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """What to adapt and how.
+
+    ``targets`` is a regex matched (``re.search``) against ``/``-joined
+    leaf paths, the same convention as sharding rules. ``alpha`` defaults
+    to ``rank`` (scale 1.0, the PEFT default of r == lora_alpha).
+    """
+
+    rank: int = 8
+    alpha: float | None = None
+    targets: str = DEFAULT_TARGETS
+    init_std: float = 0.02
+    dtype: Any | None = None
+
+    @property
+    def scaling(self) -> float:
+        return (self.alpha if self.alpha is not None else float(self.rank)) / self.rank
+
+
+def _path_tuple(key_path) -> tuple[str, ...]:
+    return tuple(path_str(key_path).split("/"))
+
+
+def lora_targets(params: Any, config: LoRAConfig = LoRAConfig()) -> list[str]:
+    """Paths in ``params`` the config will adapt (>=2-D leaves matching
+    ``targets``)."""
+    out = []
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = path_str(key_path)
+        if re.search(config.targets, path) and getattr(leaf, "ndim", 0) >= 2:
+            out.append(path)
+    return out
+
+
+def lora_init(rng, params: Any, config: LoRAConfig = LoRAConfig()) -> Any:
+    """Build the trainable adapter tree.
+
+    Mirrors ``params``' nesting, with each target kernel replaced by
+    ``{"lora_a": [.., in, r], "lora_b": [.., r, out]}``. A is
+    normal(init_std), B is zeros — so at init the adapted model computes
+    exactly the base model. Raises if nothing matches, or if a match is
+    an integer (quantized) leaf.
+    """
+    adapters: dict = {}
+    matched = False
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = path_str(key_path)
+        if not re.search(config.targets, path) or getattr(leaf, "ndim", 0) < 2:
+            # a quantized kernel is not a leaf: QTensor children flatten to
+            # `<kernel-path>/0`, `/1` (or qdata/qscale naming), so the
+            # kernel-anchored target regex sees the PARENT path — detect and
+            # refuse rather than silently skipping the layer
+            quant_parent = re.sub(r"/(qdata|qscale|\d+)$", "", path)
+            if quant_parent != path and re.search(config.targets, quant_parent):
+                raise ValueError(
+                    f"LoRA target {quant_parent!r} is quantized — adapters cannot attach to "
+                    "quantized weights. Dequantize the target layers for fine-tuning and "
+                    "re-quantize the merged result on export (see docs/usage_guides/lora.md)."
+                )
+            continue
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            raise ValueError(
+                f"LoRA target {path!r} has dtype {leaf.dtype} — adapters cannot attach to "
+                "quantized weights. Dequantize the target layers for fine-tuning and "
+                "re-quantize the merged result on export (see docs/usage_guides/lora.md)."
+            )
+        matched = True
+        lead, in_dim, out_dim = leaf.shape[:-2], leaf.shape[-2], leaf.shape[-1]
+        dtype = config.dtype or leaf.dtype
+        rng, key = jax.random.split(rng)
+        pair = {
+            "lora_a": config.init_std * jax.random.normal(key, lead + (in_dim, config.rank), dtype),
+            "lora_b": jnp.zeros(lead + (config.rank, out_dim), dtype),
+        }
+        node = adapters
+        for part in _path_tuple(key_path):
+            node = node.setdefault(part, {})
+        node.update(pair)
+    if not matched:
+        sample = [path_str(kp) for kp, _ in jax.tree_util.tree_flatten_with_path(params)[0][:8]]
+        raise ValueError(f"LoRA targets {config.targets!r} matched no parameter; paths look like {sample}")
+    return adapters
+
+
+def _adapter_pairs(adapters: Any) -> dict[tuple[str, ...], dict]:
+    """Flatten the adapter tree to {kernel-path-tuple: {"lora_a","lora_b"}}."""
+    pairs: dict[tuple[str, ...], dict] = {}
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(adapters)[0]:
+        parts = _path_tuple(key_path)
+        pairs.setdefault(parts[:-1], {})[parts[-1]] = leaf
+    return pairs
+
+
+def lora_merge(params: Any, adapters: Any, config: LoRAConfig) -> Any:
+    """``W + scaling * A @ B`` on every adapted kernel; other leaves pass
+    through untouched. Safe inside ``jit`` — this is the per-step path
+    (XLA fuses the add), and also the export path (``merge_and_unload``).
+
+    ``config`` is required because it carries the merge scale
+    (``alpha/rank``): merging with a default config would silently
+    mis-scale adapters trained with ``alpha != rank``. Use the config you
+    trained with, or the one :func:`load_lora` returns.
+    """
+    pairs = _adapter_pairs(adapters)
+
+    def merge_leaf(key_path, leaf):
+        pair = pairs.get(_path_tuple(key_path))
+        if pair is None:
+            return leaf
+        delta = jnp.matmul(pair["lora_a"], pair["lora_b"]) * config.scaling
+        return (leaf + delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(merge_leaf, params)
+
+
+merge_and_unload = lora_merge
+
+
+def lora_num_params(params: Any, adapters: Any) -> tuple[int, int, float]:
+    """(trainable, total, trainable %) — the PEFT ``print_trainable_parameters`` numbers."""
+    trainable = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(adapters))
+    total = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+    return trainable, total, 100.0 * trainable / max(total + trainable, 1)
+
+
+def lora_shardings(adapters: Any, rules, mesh) -> Any:
+    """``NamedSharding`` tree for the adapters, derived from the BASE
+    kernel's rule: A inherits the kernel's input-dim sharding (its rank
+    dim is replicated), B its output-dim sharding — so under tensor
+    parallelism ``A @ B`` lands sharded exactly like ``W`` and the merge
+    add needs no resharding.
+    """
+
+    def to_sharding(key_path, leaf):
+        parts = _path_tuple(key_path)
+        base_spec = spec_for_path("/".join(parts[:-1]), rules) or PartitionSpec()
+        base = list(base_spec) + [None] * (leaf.ndim - len(tuple(base_spec)))
+        if parts[-1] == "lora_a":
+            spec = base[:-1] + [None]
+        else:
+            spec = base[:-2] + [None, base[-1]]
+        spec = [s if s in (None,) or s in mesh.axis_names else None for s in spec]
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return jax.tree_util.tree_map_with_path(to_sharding, adapters)
+
+
+def save_lora(adapters: Any, path: str, config: LoRAConfig = LoRAConfig()) -> None:
+    """Adapters + their config to one ``.npz`` keyed by ``/``-joined paths
+    (the adapter tree is small; no need for sharded orbax here). The
+    config rides along so the merge scale (alpha/rank) and target regex
+    survive the round-trip — merging reloaded adapters with a default
+    config would silently mis-scale the delta."""
+    flat = {
+        path_str(kp): np.asarray(leaf)
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(adapters)[0]
+    }
+    flat["__lora_rank__"] = np.asarray(config.rank)
+    flat["__lora_alpha__"] = np.asarray(np.nan if config.alpha is None else config.alpha)
+    flat["__lora_targets__"] = np.asarray(config.targets)
+    np.savez(path, **flat)
+
+
+def load_lora(path: str) -> tuple[Any, LoRAConfig]:
+    """Returns ``(adapters, config)`` — pass both to :func:`lora_merge`."""
+    with np.load(path) as data:
+        alpha = float(data["__lora_alpha__"]) if "__lora_alpha__" in data.files else None
+        config = LoRAConfig(
+            rank=int(data["__lora_rank__"]) if "__lora_rank__" in data.files else 8,
+            alpha=None if alpha is None or np.isnan(alpha) else alpha,
+            targets=str(data["__lora_targets__"]) if "__lora_targets__" in data.files else DEFAULT_TARGETS,
+        )
+        adapters: dict = {}
+        for key in data.files:
+            if key.startswith("__lora_"):
+                continue
+            node = adapters
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = jnp.asarray(data[key])
+    return adapters, config

@@ -56,6 +56,7 @@ MODULES = [
     "accelerate_tpu.ops.qdense",
     "accelerate_tpu.utils.dataclasses",
     "accelerate_tpu.utils.operations",
+    "accelerate_tpu.utils.lora",
     "accelerate_tpu.utils.quantization",
     "accelerate_tpu.utils.memory",
     "accelerate_tpu.utils.random",

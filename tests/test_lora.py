@@ -1,0 +1,187 @@
+"""LoRA functional-transform tests (reference analogue: the PEFT-model
+handling asserted around utils/modeling.py:73 ``is_peft_model``; the LoRA
+math itself has no reference analogue — torch users bring ``peft``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.models.bert import BertConfig, bert_classification_loss, create_bert_model
+from accelerate_tpu.utils.lora import (
+    LoRAConfig,
+    load_lora,
+    lora_init,
+    lora_merge,
+    lora_num_params,
+    lora_shardings,
+    lora_targets,
+    save_lora,
+)
+
+TINY = BertConfig(
+    vocab_size=97,
+    hidden_size=32,
+    num_hidden_layers=2,
+    num_attention_heads=2,
+    intermediate_size=64,
+    num_labels=2,
+)
+
+
+@pytest.fixture(scope="module")
+def bert():
+    return create_bert_model(TINY, seq_len=16)
+
+
+def _batch(rng, batch=4, seq=16):
+    return {
+        "input_ids": jax.random.randint(rng, (batch, seq), 0, TINY.vocab_size),
+        "attention_mask": jnp.ones((batch, seq), jnp.int32),
+        "labels": jax.random.randint(rng, (batch,), 0, 2),
+    }
+
+
+def test_targets_and_param_fraction(bert):
+    cfg = LoRAConfig(rank=4)
+    targets = lora_targets(bert.params, cfg)
+    # q and v of both layers, nothing else
+    assert len(targets) == 4 and all(("query" in t or "value" in t) for t in targets)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    trainable, total, pct = lora_num_params(bert.params, adapters)
+    assert trainable == 4 * 2 * (32 * 4)  # 4 kernels x (A + B) x (32x4)
+    assert pct < 5.0
+
+
+def test_init_is_identity(bert):
+    """B starts at zero, so merge(params, init_adapters) == params and the
+    adapted model computes exactly the base model."""
+    cfg = LoRAConfig(rank=4)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    merged = lora_merge(bert.params, adapters, cfg)
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_array_equal(a, b), bert.params, merged)
+
+
+def test_training_moves_only_adapters(bert):
+    """A short adapter-only fine-tune: loss decreases, adapters leave
+    zero, and the base params are untouched (frozen by construction)."""
+    cfg = LoRAConfig(rank=4, alpha=8.0)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    batch = _batch(jax.random.key(1))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(adapters)
+    base = bert.params
+
+    @jax.jit
+    def step(adapters, opt_state):
+        def loss_fn(ad):
+            return bert_classification_loss(lora_merge(base, ad, cfg), batch, bert.apply_fn)
+
+        loss, grads = jax.value_and_grad(loss_fn)(adapters)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(adapters, updates), opt_state, loss
+
+    losses = []
+    for _ in range(8):
+        adapters, opt_state, loss = step(adapters, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    b_norms = [float(jnp.abs(v).max()) for k, v in _flat(adapters).items() if k.endswith("lora_b")]
+    assert all(n > 0 for n in b_norms)
+    # export path: the merged model scores the batch identically to the
+    # runtime-merge the step trained with
+    merged = lora_merge(base, adapters, cfg)
+    np.testing.assert_allclose(
+        float(bert_classification_loss(merged, batch, bert.apply_fn)), losses[-1], rtol=0.5
+    )
+
+
+def _flat(tree):
+    from accelerate_tpu.parallel.sharding import path_str
+
+    return {path_str(kp): leaf for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def test_stacked_scan_kernels():
+    """Scan-stacked [L, in, out] kernels get [L, in, r]/[L, r, out]
+    adapters and a broadcasted contraction."""
+    from accelerate_tpu.models.llama import LlamaConfig, create_llama_model
+
+    cfg = LlamaConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        scan_layers=True,
+    )
+    model = create_llama_model(cfg, seq_len=8)
+    lcfg = LoRAConfig(rank=2)
+    adapters = lora_init(jax.random.key(0), model.params, lcfg)
+    flat = _flat(adapters)
+    a = next(v for k, v in flat.items() if "q_proj" in k and k.endswith("lora_a"))
+    assert a.shape == (2, 32, 2)
+    merged = lora_merge(model.params, adapters, lcfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(model.apply_fn(merged, ids)), np.asarray(model.apply_fn(model.params, ids)), rtol=1e-6
+    )
+
+
+def test_rejects_quantized_and_no_match(bert):
+    with pytest.raises(ValueError, match="matched no parameter"):
+        lora_init(jax.random.key(0), bert.params, LoRAConfig(targets="nonexistent_layer"))
+    qparams = {"attn": {"q_proj": {"kernel": jnp.zeros((8, 8), jnp.int8)}}}
+    with pytest.raises(ValueError, match="quantized"):
+        lora_init(jax.random.key(0), qparams, LoRAConfig(targets=r"q_proj/kernel"))
+
+
+def test_rejects_real_qtensor_targets():
+    """A real quantized model: QTensor children flatten to kernel/0,
+    kernel/1 — the target regex must still refuse, not silently skip."""
+    from accelerate_tpu.utils.quantization import QuantizationConfig, quantize_params
+
+    params = {"attn": {"q_proj": {"kernel": jnp.ones((64, 64), jnp.float32)}}}
+    qparams = quantize_params(params, QuantizationConfig(min_size=1))
+    with pytest.raises(ValueError, match="quantized"):
+        lora_init(jax.random.key(0), qparams, LoRAConfig(targets=r"q_proj/kernel$"))
+
+
+def test_save_load_roundtrip(bert, tmp_path):
+    cfg = LoRAConfig(rank=4, alpha=16.0)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    path = str(tmp_path / "adapters.npz")
+    save_lora(adapters, path, cfg)
+    loaded, loaded_cfg = load_lora(path)
+    jax.tree_util.tree_map(lambda a, b: np.testing.assert_array_equal(a, b), adapters, loaded)
+    # the config rides along so the merge scale survives the round-trip
+    assert loaded_cfg.rank == 4 and loaded_cfg.alpha == 16.0 and loaded_cfg.targets == cfg.targets
+    assert loaded_cfg.scaling == cfg.scaling
+
+
+def test_sharded_lora_matches_single_device(bert):
+    """tensor2 x data2: the adapter shardings derived from the base rules
+    produce the same loss trajectory as unsharded training."""
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devices, ("data", "tensor"))
+    cfg = LoRAConfig(rank=4)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    shardings = lora_shardings(adapters, bert.sharding_rules, mesh)
+    placed = jax.tree_util.tree_map(jax.device_put, adapters, shardings)
+    batch = _batch(jax.random.key(1))
+    base = bert.params
+
+    def loss_fn(ad):
+        return bert_classification_loss(lora_merge(base, ad, cfg), batch, bert.apply_fn)
+
+    grads_ref = jax.grad(loss_fn)(adapters)
+    with mesh:
+        grads_sharded = jax.jit(jax.grad(loss_fn))(placed)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        grads_ref,
+        grads_sharded,
+    )
