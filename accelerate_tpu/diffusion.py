@@ -54,6 +54,20 @@ def make_schedule(num_train_steps: int = 1000, beta_start: float = 1e-4, beta_en
     }
 
 
+def _add_noise(schedule, x0, rng):
+    """Forward-noise ``x0`` at a uniform random timestep per sample:
+    returns ``(x_t, t, noise)`` — the shared front half of every
+    noise-prediction objective."""
+    jax = _jax()
+    jnp = jax.numpy
+    t_key, n_key = jax.random.split(rng)
+    t = jax.random.randint(t_key, (x0.shape[0],), 0, schedule["num_train_steps"])
+    noise = jax.random.normal(n_key, x0.shape, x0.dtype)
+    sab = jnp.asarray(schedule["sqrt_alphas_bar"])[t][:, None, None, None]
+    somab = jnp.asarray(schedule["sqrt_one_minus_alphas_bar"])[t][:, None, None, None]
+    return sab * x0 + somab * noise, t, noise
+
+
 def diffusion_loss(params, batch, apply_fn, schedule, rng):
     """Noise-prediction MSE (DDPM simple loss): sample t ~ U, add noise,
     predict it. ``batch = {"images": [B,H,W,C](, "labels": [B])}``. Use
@@ -61,14 +75,7 @@ def diffusion_loss(params, batch, apply_fn, schedule, rng):
     the rng argument receives the step's folded key."""
     jax = _jax()
     jnp = jax.numpy
-    x0 = batch["images"]
-    b = x0.shape[0]
-    t_key, n_key = jax.random.split(rng)
-    t = jax.random.randint(t_key, (b,), 0, schedule["num_train_steps"])
-    noise = jax.random.normal(n_key, x0.shape, x0.dtype)
-    sab = jnp.asarray(schedule["sqrt_alphas_bar"])[t][:, None, None, None]
-    somab = jnp.asarray(schedule["sqrt_one_minus_alphas_bar"])[t][:, None, None, None]
-    x_t = sab * x0 + somab * noise
+    x_t, t, noise = _add_noise(schedule, batch["images"], rng)
     pred = apply_fn(params, x_t, t, batch.get("labels"))
     return jnp.mean((pred.astype(jnp.float32) - noise.astype(jnp.float32)) ** 2)
 
@@ -83,14 +90,19 @@ def sample(
     class_labels=None,
     guidance_scale: Optional[float] = None,
     seed: int = 0,
+    encoder_hidden_states=None,
+    uncond_hidden_states=None,
 ):
     """Generate ``[B, H, W, C]`` images with a jitted denoising scan.
 
     ``method="ddim"`` (deterministic when ``eta=0``) or ``"ddpm"``
     (ancestral, uses the full posterior variance). ``guidance_scale``
-    enables classifier-free guidance: the model must be class-conditional
-    with the LAST class id reserved as the null token; each step runs the
-    denoiser on both the labels and the null token and extrapolates.
+    enables classifier-free guidance; the null branch is the reserved
+    LAST class id (class-conditional models) or ``uncond_hidden_states``
+    (text-conditional models — the empty-prompt encoding, zeros when
+    omitted); each step runs the denoiser on both and extrapolates.
+    Text-conditional models (``config.context_dim``) condition every step
+    on ``encoder_hidden_states`` [B, T, D].
     """
     jax = _jax()
     jnp = jax.numpy
@@ -103,8 +115,11 @@ def sample(
         raise ValueError(f"num_steps must be in [1, {T}], got {num_steps}")
     if method not in ("ddim", "ddpm"):
         raise ValueError(f"method must be ddim|ddpm, got {method!r}")
-    if guidance_scale is not None and cfg.num_classes is None:
-        raise ValueError("guidance needs a class-conditional UNet (num_classes set)")
+    text_conditional = getattr(cfg, "context_dim", None) is not None
+    if text_conditional and encoder_hidden_states is None:
+        raise ValueError("text-conditional UNet needs encoder_hidden_states")
+    if guidance_scale is not None and cfg.num_classes is None and not text_conditional:
+        raise ValueError("guidance needs a class-conditional or text-conditional UNet")
     # evenly spaced timestep subsequence, descending
     ts = np.linspace(0, T - 1, num_steps).round().astype(np.int32)[::-1].copy()
     ts_prev = np.concatenate([ts[1:], [-1]]).astype(np.int32)
@@ -119,37 +134,52 @@ def sample(
             raise ValueError("class-conditional UNet needs class_labels")
         labels = jnp.asarray(class_labels, jnp.int32)
 
+    ctx = uctx = None
+    if text_conditional:
+        ctx = jnp.asarray(encoder_hidden_states)
+        if guidance_scale is not None:
+            uctx = jnp.zeros_like(ctx) if uncond_hidden_states is None else jnp.asarray(uncond_hidden_states)
+
     # the schedule's arrays are closure-captured by the jitted runner, so
     # its CONTENT must be part of the cache key — a different schedule with
     # the same shape would otherwise silently reuse the old constants
     import hashlib
 
     sched_key = (T, hashlib.sha1(np.asarray(schedule["alphas_bar"]).tobytes()).hexdigest()[:12])
+    ctx_key = None if ctx is None else ctx.shape
     cache_key = ("diffusion", batch_size, num_steps, method, float(eta), guidance_scale,
-                 sched_key, None if mesh is None else tuple(sorted(mesh.shape.items())))
+                 sched_key, ctx_key, None if mesh is None else tuple(sorted(mesh.shape.items())))
     runners = model.__dict__.setdefault("_generate_runners", {})
 
     ab = jnp.asarray(schedule["alphas_bar"])
 
-    def denoise(params, x, t_b, labels):
+    def apply(params, x, t_b, labels, ctx):
+        if text_conditional:
+            return model.apply_fn(params, x, t_b, labels, encoder_hidden_states=ctx)
+        return model.apply_fn(params, x, t_b, labels)
+
+    def denoise(params, x, t_b, labels, ctx, uctx):
         if guidance_scale is None:
-            return model.apply_fn(params, x, t_b, labels)
-        null = jnp.full_like(labels, cfg.num_classes - 1)
+            return apply(params, x, t_b, labels, ctx)
         both = jnp.concatenate([x, x])
         t2 = jnp.concatenate([t_b, t_b])
-        lab2 = jnp.concatenate([labels, null])
-        eps = model.apply_fn(params, both, t2, lab2)
+        lab2 = None
+        if labels is not None:
+            null = jnp.full_like(labels, cfg.num_classes - 1)
+            lab2 = jnp.concatenate([labels, null])
+        ctx2 = None if ctx is None else jnp.concatenate([ctx, uctx])
+        eps = apply(params, both, t2, lab2, ctx2)
         cond, uncond = jnp.split(eps, 2)
         return uncond + guidance_scale * (cond - uncond)
 
-    def run(params, labels, key):
+    def run(params, labels, ctx, uctx, key):
         x = jax.random.normal(key, shape, jnp.float32)
 
         def step(carry, t_pair):
             x, key = carry
             t, t_prev = t_pair
             t_b = jnp.full((batch_size,), t, jnp.int32)
-            eps = denoise(params, x, t_b, labels).astype(jnp.float32)
+            eps = denoise(params, x, t_b, labels, ctx, uctx).astype(jnp.float32)
             a_t = ab[t]
             a_prev = jnp.where(t_prev >= 0, ab[jnp.maximum(t_prev, 0)], 1.0)
             x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
@@ -169,10 +199,115 @@ def sample(
 
     if cache_key in runners:
         with _trace_ctx(mesh):
-            return runners[cache_key](model.params, labels, jax.random.key(seed))
+            return runners[cache_key](model.params, labels, ctx, uctx, jax.random.key(seed))
 
     jitted = jax.jit(run)
     with _trace_ctx(mesh):
-        out = jitted(model.params, labels, jax.random.key(seed))
+        out = jitted(model.params, labels, ctx, uctx, jax.random.key(seed))
     runners[cache_key] = jitted
     return out
+
+
+def latent_diffusion_loss(
+    params,
+    batch,
+    apply_fn,
+    schedule,
+    rng,
+    *,
+    vae,
+    vae_params=None,
+    text_encoder=None,
+    text_params=None,
+    cond_drop_prob: float = 0.1,
+):
+    """Noise-prediction MSE in VAE latent space (the stable-diffusion
+    training objective — reference pipelines train this inside diffusers;
+    here it is one pure function fit for ``build_train_step``).
+
+    ``params`` are the UNet's (the only trainable tree); the VAE and text
+    encoder are frozen conditioning machinery (``stop_gradient``).
+    ``batch = {"pixel_values": [B,H,W,C], "encoder_hidden_states": [B,T,D]}``
+    or with ``input_ids`` + ``text_encoder``/``text_params`` to encode
+    in-step. ``cond_drop_prob`` zeroes the conditioning per-sample so the
+    model learns the unconditional branch classifier-free guidance needs.
+    """
+    jax = _jax()
+    jnp = jax.numpy
+    enc_key, noise_key, drop_key = jax.random.split(rng, 3)
+
+    latents, _, _ = vae.encode_fn(vae.params if vae_params is None else vae_params, batch["pixel_values"], enc_key)
+    latents = jax.lax.stop_gradient(latents.astype(jnp.float32))
+
+    ctx = batch.get("encoder_hidden_states")
+    if ctx is None:
+        if text_encoder is None:
+            raise ValueError("need encoder_hidden_states in the batch or a text_encoder")
+        ctx = text_encoder(text_params, batch["input_ids"])
+    ctx = jax.lax.stop_gradient(ctx)
+    if cond_drop_prob > 0.0:
+        keep = jax.random.bernoulli(drop_key, 1.0 - cond_drop_prob, (latents.shape[0],))
+        ctx = jnp.where(keep[:, None, None], ctx, jnp.zeros_like(ctx))
+
+    z_t, t, noise = _add_noise(schedule, latents, noise_key)
+    pred = apply_fn(params, z_t, t, None, ctx)
+    return jnp.mean((pred.astype(jnp.float32) - noise.astype(jnp.float32)) ** 2)
+
+
+def text_to_image(
+    unet,
+    vae,
+    text_model,
+    prompt_ids,
+    uncond_ids=None,
+    guidance_scale: Optional[float] = 7.5,
+    num_steps: int = 50,
+    schedule=None,
+    method: str = "ddim",
+    eta: float = 0.0,
+    seed: int = 0,
+):
+    """Prompts → images: encode text, denoise latents under
+    classifier-free guidance, decode with the VAE.
+
+    The in-tree equivalent of the reference's flagship diffusion example
+    (reference: examples/inference/distributed/stable_diffusion.py — a
+    diffusers ``StableDiffusionPipeline`` driven under process splits).
+    Data-parallel prompt fan-out composes the same way there as here:
+    split ``prompt_ids`` between processes/``data`` axis.
+
+    ``text_model`` is a CLIP-family Model exposing
+    ``encode_text(params, ids) -> [B,T,D]`` (``models/clip.py``);
+    ``uncond_ids`` is the tokenized empty prompt (zeros when omitted —
+    training's dropped-conditioning token).
+    """
+    jax = _jax()
+    jnp = jax.numpy
+
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    if prompt_ids.ndim == 1:  # single unbatched prompt
+        prompt_ids = prompt_ids[None]
+    ctx = text_model.encode_text(text_model.params, prompt_ids)
+    uctx = None
+    if guidance_scale is not None:
+        if uncond_ids is None:
+            uctx = jnp.zeros_like(ctx)
+        else:
+            uncond_ids = jnp.asarray(uncond_ids, jnp.int32)
+            if uncond_ids.ndim == 1:
+                uncond_ids = jnp.broadcast_to(uncond_ids[None], prompt_ids.shape)
+            uctx = text_model.encode_text(text_model.params, uncond_ids)
+
+    latents = sample(
+        unet,
+        batch_size=prompt_ids.shape[0],
+        num_steps=num_steps,
+        schedule=schedule,
+        method=method,
+        eta=eta,
+        guidance_scale=guidance_scale,
+        seed=seed,
+        encoder_hidden_states=ctx,
+        uncond_hidden_states=uctx,
+    )
+    return vae.decode_fn(vae.params, latents)

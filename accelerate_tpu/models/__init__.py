@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit/whisper/clip) + HF safetensors
+(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit/whisper/clip/unet/vae) + HF safetensors
 weight import. The reference delegates models to transformers; here they
 ship in-tree (SURVEY hard-part #3: torch-free model story)."""
 
@@ -75,6 +75,13 @@ from .unet import (
     UNet2D,
     UNetConfig,
     create_unet_model,
+)
+from .vae import (
+    VAE_SHARDING_RULES,
+    VAE,
+    VAEConfig,
+    create_vae_model,
+    vae_loss,
 )
 from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_bert,

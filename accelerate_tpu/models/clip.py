@@ -112,12 +112,15 @@ class CLIPModel(nn.Module):
     config: CLIPConfig
 
     @nn.compact
-    def __call__(self, pixel_values=None, input_ids=None):
+    def __call__(self, pixel_values=None, input_ids=None, output_hidden: bool = False):
         """Returns ``(image_embeds, text_embeds, logit_scale)`` — embeds are
         L2-normalised rows in the joint space; either input may be None to
-        run one tower. ``pixel_values`` [B, H, W, 3] NHWC."""
+        run one tower. ``pixel_values`` [B, H, W, 3] NHWC. With
+        ``output_hidden=True`` a 4th element is appended: the text tower's
+        final-norm per-token states [B, T, D] (what latent-diffusion
+        cross-attention conditions on — HF `CLIPTextModel.last_hidden_state`)."""
         cfg = self.config
-        image_embeds = text_embeds = None
+        image_embeds = text_embeds = text_hidden = None
 
         if pixel_values is not None:
             p = cfg.patch_size
@@ -159,6 +162,7 @@ class CLIPModel(nn.Module):
                     cfg.layer_norm_eps, causal=True, name=f"text/block_{i}",
                 )(t)
             t = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="text/final_norm", dtype=t.dtype)(t)
+            text_hidden = t
             # pooled = hidden state at the EOS token, HF semantics
             # (modeling_clip.py CLIPTextTransformer.forward): legacy configs
             # with eos_token_id==2 pool at argmax(input_ids) — OpenAI CLIP's
@@ -178,6 +182,8 @@ class CLIPModel(nn.Module):
         logit_scale = self.param(
             "logit_scale", lambda key: jnp.asarray(cfg.logit_scale_init, jnp.float32)
         )
+        if output_hidden:
+            return image_embeds, text_embeds, logit_scale, text_hidden
         return image_embeds, text_embeds, logit_scale
 
 
@@ -197,6 +203,12 @@ def create_clip_model(config: Optional[CLIPConfig] = None, seed: int = 0, batch_
     model = Model(apply_fn, params, sharding_rules=CLIP_SHARDING_RULES, name="clip")
     model.config = config
     model.module = module
+
+    def encode_text(p, input_ids):
+        """Per-token text states [B, T, D] for cross-attention conditioning."""
+        return module.apply({"params": p}, None, input_ids, output_hidden=True)[3]
+
+    model.encode_text = encode_text
     return model
 
 

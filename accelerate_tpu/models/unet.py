@@ -45,6 +45,7 @@ class UNetConfig:
     num_heads: int = 4
     num_groups: int = 8
     num_classes: Optional[int] = None  # class-conditional when set
+    context_dim: Optional[int] = None  # cross-attention text conditioning when set
     dropout: float = 0.0
 
     @classmethod
@@ -62,9 +63,9 @@ UNET_SHARDING_RULES = [
     # conv kernels [kh, kw, in, out]: column-split the out channels
     (r"conv_(in|1|2)/kernel", P(None, None, None, "tensor")),
     (r"conv_out/kernel", P(None, None, "tensor", None)),
-    # attention projections
-    (r"(q|k|v)_proj/kernel", P(None, "tensor")),
-    (r"out_proj/kernel", P("tensor", None)),
+    # attention projections (self and cross)
+    (r"(cross_)?(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"(cross_)?out_proj/kernel", P("tensor", None)),
     # time/label embedding MLPs
     (r"time_mlp_[12]/kernel", P(None, "tensor")),
 ]
@@ -115,38 +116,55 @@ class ResBlock(nn.Module):
 
 
 class AttnBlock(nn.Module):
+    """Self-attention over spatial positions; with ``context`` also a
+    cross-attention sub-block whose keys/values come from the conditioning
+    sequence (the latent-diffusion transformer block — reference pipelines
+    get this from diffusers' ``Transformer2DModel``)."""
+
     num_heads: int
     groups: int
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, context=None):
         b, hh, ww, c = x.shape
-        h = _GroupNorm(self.groups, name="norm")(x).reshape(b, hh * ww, c)
         head_dim = c // self.num_heads
+        from ..ops.attention import dot_product_attention
 
         def split(y):
-            return y.reshape(b, hh * ww, self.num_heads, head_dim)
+            return y.reshape(b, -1, self.num_heads, head_dim)
 
+        h = _GroupNorm(self.groups, name="norm")(x).reshape(b, hh * ww, c)
         q = split(nn.Dense(c, name="q_proj", dtype=x.dtype)(h))
         k = split(nn.Dense(c, name="k_proj", dtype=x.dtype)(h))
         v = split(nn.Dense(c, name="v_proj", dtype=x.dtype)(h))
-        from ..ops.attention import dot_product_attention
+        out = dot_product_attention(q, k, v, causal=False).reshape(b, hh * ww, c)
+        x = x + nn.Dense(c, name="out_proj", dtype=x.dtype)(out).reshape(b, hh, ww, c)
 
-        out = dot_product_attention(q, k, v, causal=False)
-        out = out.reshape(b, hh * ww, c)
-        out = nn.Dense(c, name="out_proj", dtype=x.dtype)(out)
-        return x + out.reshape(b, hh, ww, c)
+        if context is not None:
+            ctx = context.astype(x.dtype)
+            h = _GroupNorm(self.groups, name="cross_norm")(x).reshape(b, hh * ww, c)
+            q = split(nn.Dense(c, name="cross_q_proj", dtype=x.dtype)(h))
+            k = split(nn.Dense(c, name="cross_k_proj", dtype=x.dtype)(ctx))
+            v = split(nn.Dense(c, name="cross_v_proj", dtype=x.dtype)(ctx))
+            out = dot_product_attention(q, k, v, causal=False).reshape(b, hh * ww, c)
+            x = x + nn.Dense(c, name="cross_out_proj", dtype=x.dtype)(out).reshape(b, hh, ww, c)
+        return x
 
 
 class UNet2D(nn.Module):
     config: UNetConfig
 
     @nn.compact
-    def __call__(self, sample, timesteps, class_labels=None, deterministic: bool = True):
+    def __call__(self, sample, timesteps, class_labels=None, encoder_hidden_states=None, deterministic: bool = True):
         """``sample`` [B, H, W, C] (NHWC), ``timesteps`` [B] int/float,
-        optional ``class_labels`` [B]. Returns the predicted noise
+        optional ``class_labels`` [B], optional ``encoder_hidden_states``
+        [B, T, context_dim] (per-token text states for cross-attention —
+        requires ``config.context_dim``). Returns the predicted noise
         [B, H, W, out_channels]."""
         cfg = self.config
+        if cfg.context_dim is not None and encoder_hidden_states is None:
+            raise ValueError("text-conditional UNet needs encoder_hidden_states")
+        ctx = encoder_hidden_states if cfg.context_dim is not None else None
         temb_dim = cfg.base_channels * 4
         temb = timestep_embedding(timesteps, cfg.base_channels).astype(sample.dtype)
         temb = nn.Dense(temb_dim, name="time_mlp_1", dtype=sample.dtype)(temb)
@@ -164,7 +182,7 @@ class UNet2D(nn.Module):
             for i in range(cfg.layers_per_block):
                 h = ResBlock(ch, cfg.num_groups, cfg.dropout, name=f"down_{lvl}_{i}")(h, temb, deterministic)
                 if lvl in cfg.attention_levels:
-                    h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"down_attn_{lvl}_{i}")(h)
+                    h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"down_attn_{lvl}_{i}")(h, ctx)
                 skips.append(h)
             if lvl != len(cfg.channel_mults) - 1:
                 h = nn.Conv(ch, (3, 3), (2, 2), padding="SAME", name=f"downsample_{lvl}", dtype=h.dtype)(h)
@@ -172,7 +190,7 @@ class UNet2D(nn.Module):
         # mid
         ch = cfg.base_channels * cfg.channel_mults[-1]
         h = ResBlock(ch, cfg.num_groups, cfg.dropout, name="mid_1")(h, temb, deterministic)
-        h = AttnBlock(cfg.num_heads, cfg.num_groups, name="mid_attn")(h)
+        h = AttnBlock(cfg.num_heads, cfg.num_groups, name="mid_attn")(h, ctx)
         h = ResBlock(ch, cfg.num_groups, cfg.dropout, name="mid_2")(h, temb, deterministic)
         # up path (skip concats, mirror order)
         for lvl, mult in reversed(list(enumerate(cfg.channel_mults))):
@@ -181,7 +199,7 @@ class UNet2D(nn.Module):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
                 h = ResBlock(ch, cfg.num_groups, cfg.dropout, name=f"up_{lvl}_{i}")(h, temb, deterministic)
                 if lvl in cfg.attention_levels:
-                    h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"up_attn_{lvl}_{i}")(h)
+                    h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"up_attn_{lvl}_{i}")(h, ctx)
             if lvl != 0:
                 b, hh, ww, c = h.shape
                 h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
@@ -195,17 +213,22 @@ def create_unet_model(config: Optional[UNetConfig] = None, seed: int = 0, batch_
     module = UNet2D(config)
     sample = jnp.zeros((batch_size, config.sample_size, config.sample_size, config.in_channels), jnp.float32)
     t = jnp.zeros((batch_size,), jnp.int32)
-    labels = jnp.zeros((batch_size,), jnp.int32) if config.num_classes else None
-    args = (sample, t, labels) if config.num_classes else (sample, t)
-    params = module.init(jax.random.key(seed), *args)["params"]
+    kwargs = {}
+    if config.num_classes:
+        kwargs["class_labels"] = jnp.zeros((batch_size,), jnp.int32)
+    if config.context_dim:
+        kwargs["encoder_hidden_states"] = jnp.zeros((batch_size, 4, config.context_dim), jnp.float32)
+    params = module.init(jax.random.key(seed), sample, t, **kwargs)["params"]
 
-    def apply_fn(p, sample, timesteps, class_labels=None, deterministic=True):
+    def apply_fn(p, sample, timesteps, class_labels=None, encoder_hidden_states=None, deterministic=True):
         leaf = jax.tree_util.tree_leaves(p)[0]
         if jnp.issubdtype(leaf.dtype, jnp.floating):
             sample = sample.astype(leaf.dtype)
         kwargs = {"deterministic": deterministic}
         if class_labels is not None:
             kwargs["class_labels"] = class_labels
+        if encoder_hidden_states is not None:
+            kwargs["encoder_hidden_states"] = encoder_hidden_states
         return module.apply({"params": p}, sample, timesteps, **kwargs)
 
     model = Model(apply_fn, params, sharding_rules=UNET_SHARDING_RULES, name="unet2d")

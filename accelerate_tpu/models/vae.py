@@ -1,0 +1,167 @@
+"""Convolutional VAE (AutoencoderKL shape) for latent diffusion.
+
+Completes the diffusion family: the reference's flagship diffusion
+example drives a diffusers *latent*-diffusion pipeline
+(reference: examples/inference/distributed/stable_diffusion.py — VAE +
+text-conditioned UNet + CLIP text encoder); the VAE itself lives in the
+diffusers package there. Here it is in-tree and TPU-shaped: NHWC convs,
+GroupNorm statistics in fp32 (the UNet's stance), and the
+encode/decode entry points are pure functions fit for ``jit``/``scan``.
+
+* Encoder: conv_in → per-level ResBlocks with stride-2 downsample →
+  mid block → 2·latent_channels head (mean, logvar).
+* Decoder: mirror with nearest-neighbour upsample.
+* ``scaling_factor`` follows the SD convention (latents are scaled to
+  ~unit variance before the diffusion model sees them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from ..modeling import Model
+from .unet import ResBlock, _GroupNorm
+
+
+@dataclasses.dataclass
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    sample_size: int = 32  # H = W of the image
+    base_channels: int = 32
+    channel_mults: Sequence[int] = (1, 2)  # len = number of levels; stride-2 between levels
+    num_groups: int = 8
+    scaling_factor: float = 0.18215  # SD latents convention
+    kl_weight: float = 1e-4
+
+    @property
+    def downsample_factor(self) -> int:
+        return 2 ** (len(self.channel_mults) - 1)
+
+    @property
+    def latent_size(self) -> int:
+        return self.sample_size // self.downsample_factor
+
+    @classmethod
+    def tiny(cls, **kw) -> "VAEConfig":
+        kw.setdefault("sample_size", 16)
+        kw.setdefault("base_channels", 16)
+        kw.setdefault("channel_mults", (1, 2))
+        kw.setdefault("num_groups", 4)
+        kw.setdefault("latent_channels", 2)
+        return cls(**kw)
+
+
+VAE_SHARDING_RULES = [
+    # conv kernels [kh, kw, in, out]: column-split output channels over tensor
+    (r"conv_(in|1|2)/kernel", P(None, None, None, "tensor")),
+    (r"(latent_head|conv_out)/kernel", P(None, None, "tensor", None)),
+]
+
+
+class VAEEncoder(nn.Module):
+    config: VAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        # the VAE has no time conditioning; feed a zero embedding to reuse
+        # the UNet ResBlock (its FiLM projection learns a plain bias)
+        temb = jnp.zeros((x.shape[0], cfg.base_channels), x.dtype)
+        h = nn.Conv(cfg.base_channels, (3, 3), padding="SAME", name="conv_in", dtype=x.dtype)(x)
+        for lvl, mult in enumerate(cfg.channel_mults):
+            ch = cfg.base_channels * mult
+            h = ResBlock(ch, cfg.num_groups, name=f"down_{lvl}")(h, temb)
+            if lvl != len(cfg.channel_mults) - 1:
+                h = nn.Conv(ch, (3, 3), (2, 2), padding="SAME", name=f"downsample_{lvl}", dtype=h.dtype)(h)
+        h = ResBlock(cfg.base_channels * cfg.channel_mults[-1], cfg.num_groups, name="mid")(h, temb)
+        h = nn.silu(_GroupNorm(cfg.num_groups, name="norm_out")(h))
+        # fp32 head: logvar exponentiation is precision-sensitive
+        moments = nn.Conv(2 * cfg.latent_channels, (3, 3), padding="SAME", name="latent_head", dtype=jnp.float32)(h)
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+
+class VAEDecoder(nn.Module):
+    config: VAEConfig
+
+    @nn.compact
+    def __call__(self, z):
+        cfg = self.config
+        temb = jnp.zeros((z.shape[0], cfg.base_channels), z.dtype)
+        ch = cfg.base_channels * cfg.channel_mults[-1]
+        h = nn.Conv(ch, (3, 3), padding="SAME", name="conv_in", dtype=z.dtype)(z)
+        h = ResBlock(ch, cfg.num_groups, name="mid")(h, temb)
+        for lvl, mult in reversed(list(enumerate(cfg.channel_mults))):
+            ch = cfg.base_channels * mult
+            h = ResBlock(ch, cfg.num_groups, name=f"up_{lvl}")(h, temb)
+            if lvl != 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = nn.Conv(c, (3, 3), padding="SAME", name=f"upsample_{lvl}", dtype=h.dtype)(h)
+        h = nn.silu(_GroupNorm(cfg.num_groups, name="norm_out")(h))
+        return nn.Conv(cfg.in_channels, (3, 3), padding="SAME", name="conv_out", dtype=jnp.float32)(h)
+
+
+class VAE(nn.Module):
+    """Reconstruction path (what ``init`` traces; encode/decode are
+    exposed as separate apply methods on the created Model)."""
+
+    config: VAEConfig
+
+    def setup(self):
+        self.encoder = VAEEncoder(self.config)
+        self.decoder = VAEDecoder(self.config)
+
+    def __call__(self, x, rng=None):
+        mean, logvar = self.encoder(x)
+        z = mean if rng is None else mean + jnp.exp(0.5 * logvar) * jax.random.normal(rng, mean.shape)
+        return self.decoder(z.astype(x.dtype)), mean, logvar
+
+    def encode(self, x, rng=None):
+        """Image [B,H,W,C] → scaled latents [B,h,w,latent] (+ moments)."""
+        mean, logvar = self.encoder(x)
+        z = mean if rng is None else mean + jnp.exp(0.5 * logvar) * jax.random.normal(rng, mean.shape)
+        return z * self.config.scaling_factor, mean, logvar
+
+    def decode(self, z):
+        """Scaled latents → image."""
+        return self.decoder(z / self.config.scaling_factor)
+
+
+def vae_loss(params, batch, apply_fn, rng, kl_weight: Optional[float] = None, config: Optional[VAEConfig] = None):
+    """ELBO: MSE reconstruction + KL(q(z|x) ‖ N(0,1)) (fp32, per-element
+    means so the weight is resolution-independent)."""
+    recon, mean, logvar = apply_fn(params, batch["pixel_values"], rng)
+    x = batch["pixel_values"].astype(jnp.float32)
+    rec = jnp.mean((recon - x) ** 2)
+    kl = 0.5 * jnp.mean(jnp.exp(logvar) + mean**2 - 1.0 - logvar)
+    weight = kl_weight if kl_weight is not None else (config.kl_weight if config else 1e-4)
+    return rec + weight * kl
+
+
+def create_vae_model(config: Optional[VAEConfig] = None, seed: int = 0, batch_size: int = 2) -> Model:
+    config = config or VAEConfig.tiny()
+    module = VAE(config)
+    x = jnp.zeros((batch_size, config.sample_size, config.sample_size, config.in_channels), jnp.float32)
+    params = module.init(jax.random.key(seed), x)["params"]
+
+    def _cast(p, x):
+        leaf = jax.tree_util.tree_leaves(p)[0]
+        return x.astype(leaf.dtype) if jnp.issubdtype(leaf.dtype, jnp.floating) else x
+
+    def apply_fn(p, pixel_values, rng=None):
+        return module.apply({"params": p}, _cast(p, pixel_values), rng)
+
+    model = Model(apply_fn, params, sharding_rules=VAE_SHARDING_RULES, name="vae")
+    model.config = config
+    model.module = module
+    model.encode_fn = lambda p, x, rng=None: module.apply({"params": p}, _cast(p, x), rng, method=VAE.encode)
+    model.decode_fn = lambda p, z: module.apply({"params": p}, z, method=VAE.decode)
+    return model
