@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -171,26 +171,109 @@ def lora_num_params(params: Any, adapters: Any) -> tuple[int, int, float]:
     return trainable, total, 100.0 * trainable / max(total + trainable, 1)
 
 
+def _derived_spec(parts: tuple[str, ...], leaf_ndim: int, base_spec) -> PartitionSpec:
+    """The adapter spec for one A/B leaf from its base kernel's spec:
+    A inherits the kernel's input-dim sharding (rank dim replicated),
+    B its output-dim sharding — so under tensor parallelism ``A @ B``
+    lands sharded exactly like ``W`` and the merge add needs no
+    resharding. Shared by :func:`lora_shardings` and
+    :func:`lora_adapter_rules` so the derivation cannot diverge."""
+    base = list(tuple(base_spec)) + [None] * (leaf_ndim - len(tuple(base_spec)))
+    if parts[-1] == "lora_a":
+        spec = base[:-1] + [None]
+    else:
+        spec = base[:-2] + [None, base[-1]]
+    return PartitionSpec(*spec)
+
+
 def lora_shardings(adapters: Any, rules, mesh) -> Any:
     """``NamedSharding`` tree for the adapters, derived from the BASE
-    kernel's rule: A inherits the kernel's input-dim sharding (its rank
-    dim is replicated), B its output-dim sharding — so under tensor
-    parallelism ``A @ B`` lands sharded exactly like ``W`` and the merge
-    add needs no resharding.
-    """
+    kernel's rule (see :func:`_derived_spec`)."""
 
     def to_sharding(key_path, leaf):
         parts = _path_tuple(key_path)
         base_spec = spec_for_path("/".join(parts[:-1]), rules) or PartitionSpec()
-        base = list(base_spec) + [None] * (leaf.ndim - len(tuple(base_spec)))
-        if parts[-1] == "lora_a":
-            spec = base[:-1] + [None]
-        else:
-            spec = base[:-2] + [None, base[-1]]
-        spec = [s if s in (None,) or s in mesh.axis_names else None for s in spec]
-        return NamedSharding(mesh, PartitionSpec(*spec))
+        spec = _derived_spec(parts, leaf.ndim, base_spec)
+        spec = PartitionSpec(*(s if s is None or s in mesh.axis_names else None for s in tuple(spec)))
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(to_sharding, adapters)
+
+
+def lora_adapter_rules(adapters: Any, base_rules, base_specs: Optional[dict] = None) -> list:
+    """Exact ``(regex, PartitionSpec)`` rules for an adapter tree —
+    one fully-anchored (``^...$``) rule per concrete leaf path, so they
+    drop into the rules engine and cannot shadow sibling paths. The base
+    kernel's spec comes from ``base_specs`` (a ``{kernel-path: spec}``
+    map of the base's ACTUAL placements, e.g. from a prepared model's
+    ``param_shardings`` — this captures fsdp auto-rules the regex rules
+    don't carry) with ``base_rules`` as the fallback. This is what lets
+    :func:`lora_model` ride ``Accelerator.prepare``.
+    """
+    rules = []
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(adapters)[0]:
+        parts = _path_tuple(key_path)
+        parent = "/".join(parts[:-1])
+        base_spec = (base_specs or {}).get(parent)
+        if base_spec is None:
+            base_spec = spec_for_path(parent, base_rules) or PartitionSpec()
+        spec = _derived_spec(parts, leaf.ndim, base_spec)
+        rules.append(("^" + re.escape("/".join(parts)) + "$", spec))
+    return rules
+
+
+def lora_model(model, config: LoRAConfig = LoRAConfig(), rng=None):
+    """Wrap a zoo ``Model`` so its trainable params ARE the adapter tree.
+
+    The returned Model's ``apply_fn(adapters, ...)`` merges into the
+    frozen base inside the call, so the whole Accelerator stack —
+    ``prepare`` (adapter shardings derived from the base rules),
+    ``build_train_step``, ``save_state`` (adapter-only checkpoints,
+    the PEFT pattern), trackers — works on adapters with zero special
+    casing. Prepare the BASE model first if it should be sharded; its
+    current placement is captured as the frozen closure.
+
+        model = accelerator.prepare_model(create_bert_model(cfg))
+        lora = lora_model(model, LoRAConfig(rank=8))
+        lora = accelerator.prepare_model(lora)     # shards the adapters
+        step = accelerator.build_train_step(loss_fn)   # trains adapters only
+    """
+    from ..modeling import Model
+
+    rng = jax.random.key(0) if rng is None else rng
+    adapters = lora_init(rng, model.params, config)
+    base = model.params
+
+    def apply_fn(ad, *args, **kwargs):
+        return model.apply_fn(lora_merge(base, ad, config), *args, **kwargs)
+
+    def eval_apply_fn(ad, *args, **kwargs):
+        return model.eval_apply_fn(lora_merge(base, ad, config), *args, **kwargs)
+
+    # prefer the base's ACTUAL placements (set by prepare_model) over its
+    # regex rules — a prepared base may carry fsdp auto-shardings the
+    # rules don't express, and the adapters must match W's real layout
+    base_specs = None
+    if getattr(model, "param_shardings", None) is not None:
+        base_specs = {
+            path_str(kp): sh.spec
+            for kp, sh in jax.tree_util.tree_flatten_with_path(model.param_shardings)[0]
+            if hasattr(sh, "spec")
+        }
+
+    wrapped = Model(
+        apply_fn,
+        adapters,
+        sharding_rules=lora_adapter_rules(adapters, model.sharding_rules or [], base_specs),
+        name=f"{model.name}+lora",
+        eval_apply_fn=eval_apply_fn,
+    )
+    wrapped.state = model.state  # non-trainable collections ride along
+    wrapped.config = getattr(model, "config", None)
+    wrapped.lora_config = config
+    wrapped.base_model = model
+    wrapped.merged_params = lambda: lora_merge(base, wrapped.params, config)
+    return wrapped
 
 
 def save_lora(adapters: Any, path: str, config: LoRAConfig = LoRAConfig()) -> None:

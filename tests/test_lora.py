@@ -185,3 +185,95 @@ def test_sharded_lora_matches_single_device(bert):
         grads_ref,
         grads_sharded,
     )
+
+
+def test_lora_model_rides_the_accelerator(bert):
+    """lora_model: the wrapped Model's params ARE the adapters, so
+    prepare/build_train_step/checkpoint machinery works unchanged and
+    trains adapters only."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState
+    from accelerate_tpu.utils.lora import lora_model
+
+    AcceleratorState._reset_state() if hasattr(AcceleratorState, "_reset_state") else None
+    accelerator = Accelerator()
+    cfg = LoRAConfig(rank=4, alpha=8.0)
+    lora = lora_model(bert, cfg, rng=jax.random.key(0))
+    lora = accelerator.prepare_model(lora)
+    optimizer = accelerator.prepare_optimizer(optax.adam(5e-3))
+    batch = _batch(jax.random.key(1))
+    base_before = jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), bert.params)
+
+    def loss_fn(adapters, b):
+        return bert_classification_loss(adapters, b, lora.apply_fn)
+
+    step = accelerator.build_train_step(loss_fn, model=lora, optimizer=optimizer)
+    losses = [float(step(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    # the base stayed frozen; only adapters moved
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b), bert.params, base_before
+    )
+    flat = _flat(lora.params)
+    assert any(float(jnp.abs(v).max()) > 0 for k, v in flat.items() if k.endswith("lora_b"))
+    # merged export from the wrapper
+    merged = lora.merged_params()
+    out = bert.apply_fn(merged, batch["input_ids"], batch["attention_mask"])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_lora_model_prepares_sharded(bert):
+    """Under a tensor mesh, prepare_model shards the adapters by the
+    derived per-path rules (B output-dim over tensor where the base
+    kernel is column-split)."""
+    from accelerate_tpu.parallel.sharding import infer_shardings
+    from accelerate_tpu.utils.lora import lora_adapter_rules, lora_init
+
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devices, ("data", "tensor"))
+    cfg = LoRAConfig(rank=4)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    rules = lora_adapter_rules(adapters, bert.sharding_rules or [])
+    shardings = infer_shardings(adapters, rules, mesh)
+    flat_sh = _flat(shardings)
+    # base query kernel is column-split P(None, "tensor") -> B shards its
+    # output dim over tensor, A's rank dim stays replicated
+    b_spec = next(v.spec for k, v in flat_sh.items() if "query" in k and k.endswith("lora_b"))
+    a_spec = next(v.spec for k, v in flat_sh.items() if "query" in k and k.endswith("lora_a"))
+    assert tuple(b_spec) == (None, "tensor"), b_spec
+    assert "tensor" not in tuple(a_spec), a_spec
+    placed = jax.tree_util.tree_map(jax.device_put, adapters, shardings)
+    assert all(leaf.sharding.mesh.shape == mesh.shape for leaf in jax.tree_util.tree_leaves(placed))
+
+
+def test_adapter_rules_use_actual_base_placements(bert):
+    """base_specs (a prepared model's real placements, e.g. fsdp
+    auto-shardings) take precedence over the regex rules, and rules are
+    fully anchored so sibling paths cannot shadow each other."""
+    from accelerate_tpu.utils.lora import lora_adapter_rules
+    import re as _re
+
+    cfg = LoRAConfig(rank=4)
+    adapters = lora_init(jax.random.key(0), bert.params, cfg)
+    qpath = "encoder/layer_0/attention/query/kernel"
+    rules = lora_adapter_rules(adapters, bert.sharding_rules, {qpath: P("fsdp", None)})
+    by_path = {r: s for r, s in rules}
+    a_rule = "^" + _re.escape(qpath + "/lora_a") + "$"
+    b_rule = "^" + _re.escape(qpath + "/lora_b") + "$"
+    assert tuple(by_path[a_rule]) == ("fsdp", None)   # A follows W's input-dim fsdp split
+    assert tuple(by_path[b_rule]) == (None, None)
+    # an un-overridden sibling still derives from the regex rules
+    v_rule = "^" + _re.escape("encoder/layer_0/attention/value/kernel/lora_b") + "$"
+    assert tuple(by_path[v_rule]) == (None, "tensor")
+
+
+def test_lora_model_propagates_state(bert):
+    """Non-trainable collections (model.state) ride through the wrapper."""
+    from accelerate_tpu.utils.lora import lora_model
+
+    bert.state = {"marker": jnp.ones((1,))}
+    try:
+        lora = lora_model(bert, LoRAConfig(rank=2), rng=jax.random.key(0))
+        assert lora.state is bert.state
+    finally:
+        bert.state = None
