@@ -12,9 +12,19 @@ trainable — the base params are frozen by construction, so the optimizer,
 checkpointing, and every parallelism layout work on adapters unchanged.
 
 Supports 2-D kernels and scan-stacked ``[L, in, out]`` kernels (the
-``a @ b`` contraction broadcasts over leading layer dims). Quantized base
-weights (``QTensor`` leaves) are rejected at init with a pointer to the
-fine-tune recipe: dequantize targets, train, re-quantize on export.
+``a @ b`` contraction broadcasts over leading layer dims).
+
+**QLoRA** (reference: the bnb kbit-training prep in utils/bnb.py + PEFT's
+4-bit fine-tune path): a ``QTensor`` base kernel is a first-class target.
+The adapter pair is float (the QTensor's original dtype by default), the
+packed codes stay frozen AND quantized in HBM, and the per-step merge is
+``dequantize(W_q) + (alpha/r)·A@B`` inside ``jit`` — the dequantized copy
+is transient (XLA fuses the decode+add into the consumer matmul), so
+resident memory is codes + adapters + adapter optimizer state: the QLoRA
+budget. Only the in-scan ``QuantDense`` rebuilt models (plain
+``qdata``/``qscale`` array params, e.g. ``quantize_llama_model``) cannot
+take adapters — their kernels are gone from the tree; use the generic
+``quantize_params``/``load_and_quantize_model`` tree path for QLoRA.
 
 Example::
 
@@ -39,6 +49,18 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..parallel.sharding import path_str, spec_for_path
+from .quantization import QTensor
+
+
+def _is_q(leaf) -> bool:
+    return isinstance(leaf, QTensor)
+
+
+def _flatten_kernels(params):
+    """Flatten with ``QTensor`` treated as ONE leaf at its kernel path (so a
+    quantized kernel is targetable by the same regex as a dense one, rather
+    than flattening into ``<kernel>/0``, ``/1`` data/scale children)."""
+    return jax.tree_util.tree_flatten_with_path(params, is_leaf=_is_q)[0]
 
 # classic LoRA targets: the attention q/v projections, across the zoo's
 # two naming families (bert-style attention/query, llama-style attn/q_proj)
@@ -73,9 +95,10 @@ def lora_targets(params: Any, config: LoRAConfig = LoRAConfig()) -> list[str]:
     """Paths in ``params`` the config will adapt (>=2-D leaves matching
     ``targets``)."""
     out = []
-    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+    for key_path, leaf in _flatten_kernels(params):
         path = path_str(key_path)
-        if re.search(config.targets, path) and getattr(leaf, "ndim", 0) >= 2:
+        ndim = len(leaf.shape) if _is_q(leaf) else getattr(leaf, "ndim", 0)
+        if re.search(config.targets, path) and ndim >= 2:
             out.append(path)
     return out
 
@@ -86,31 +109,37 @@ def lora_init(rng, params: Any, config: LoRAConfig = LoRAConfig()) -> Any:
     Mirrors ``params``' nesting, with each target kernel replaced by
     ``{"lora_a": [.., in, r], "lora_b": [.., r, out]}``. A is
     normal(init_std), B is zeros — so at init the adapted model computes
-    exactly the base model. Raises if nothing matches, or if a match is
-    an integer (quantized) leaf.
+    exactly the base model. A ``QTensor`` target gets float adapters in its
+    original dtype (QLoRA — the codes stay frozen+packed; see module
+    docstring). Raises if nothing matches, or if a match is a plain
+    integer leaf (an in-scan ``QuantDense`` model's ``qdata``).
     """
     adapters: dict = {}
     matched = False
-    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+    for key_path, leaf in _flatten_kernels(params):
         path = path_str(key_path)
-        if not re.search(config.targets, path) or getattr(leaf, "ndim", 0) < 2:
-            # a quantized kernel is not a leaf: QTensor children flatten to
-            # `<kernel-path>/0`, `/1` (or qdata/qscale naming), so the
-            # kernel-anchored target regex sees the PARENT path — detect and
+        ndim = len(leaf.shape) if _is_q(leaf) else getattr(leaf, "ndim", 0)
+        if not re.search(config.targets, path) or ndim < 2:
+            # an in-scan QuantDense kernel is not in the tree: its codes are
+            # plain `<layer>/qdata`, `/qscale` array params, so a target
+            # regex naming the LAYER sees the parent path — detect and
             # refuse rather than silently skipping the layer
             quant_parent = re.sub(r"/(qdata|qscale|\d+)$", "", path)
             if quant_parent != path and re.search(config.targets, quant_parent):
                 raise ValueError(
-                    f"LoRA target {quant_parent!r} is quantized — adapters cannot attach to "
-                    "quantized weights. Dequantize the target layers for fine-tuning and "
-                    "re-quantize the merged result on export (see docs/usage_guides/lora.md)."
+                    f"LoRA target {quant_parent!r} is an in-scan QuantDense layer — its "
+                    "kernel exists only as packed qdata/qscale params, so adapters cannot "
+                    "attach. For QLoRA, quantize with quantize_params/load_and_quantize_model "
+                    "(QTensor tree) instead of the rebuilt-module path "
+                    "(see docs/usage_guides/lora.md)."
                 )
             continue
-        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        if not _is_q(leaf) and not jnp.issubdtype(leaf.dtype, jnp.floating):
             raise ValueError(
                 f"LoRA target {path!r} has dtype {leaf.dtype} — adapters cannot attach to "
-                "quantized weights. Dequantize the target layers for fine-tuning and "
-                "re-quantize the merged result on export (see docs/usage_guides/lora.md)."
+                "raw integer codes. For QLoRA, quantize with quantize_params/"
+                "load_and_quantize_model (QTensor tree) so the kernel stays a targetable "
+                "leaf (see docs/usage_guides/lora.md)."
             )
         matched = True
         lead, in_dim, out_dim = leaf.shape[:-2], leaf.shape[-2], leaf.shape[-1]
@@ -156,9 +185,16 @@ def lora_merge(params: Any, adapters: Any, config: LoRAConfig) -> Any:
         if pair is None:
             return leaf
         delta = jnp.matmul(pair["lora_a"], pair["lora_b"]) * config.scaling
+        if _is_q(leaf):
+            # QLoRA merge: decode the frozen codes (a constant — gradients
+            # flow only through delta) and add. Inside jit the decoded copy
+            # is transient (fused into the consumer matmul); on export this
+            # IS the dense merged weight — re-quantize it if you want a
+            # quantized serving artifact.
+            return (leaf.dequantize(jnp.float32) + delta.astype(jnp.float32)).astype(leaf.dtype)
         return (leaf + delta).astype(leaf.dtype)
 
-    return jax.tree_util.tree_map_with_path(merge_leaf, params)
+    return jax.tree_util.tree_map_with_path(merge_leaf, params, is_leaf=_is_q)
 
 
 merge_and_unload = lora_merge
@@ -167,7 +203,11 @@ merge_and_unload = lora_merge
 def lora_num_params(params: Any, adapters: Any) -> tuple[int, int, float]:
     """(trainable, total, trainable %) — the PEFT ``print_trainable_parameters`` numbers."""
     trainable = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(adapters))
-    total = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+    # QTensor counts its LOGICAL element count (shape is the original shape)
+    total = sum(
+        int(np.prod(x.shape))
+        for x in jax.tree_util.tree_leaves(params, is_leaf=_is_q)
+    )
     return trainable, total, 100.0 * trainable / max(total + trainable, 1)
 
 

@@ -131,23 +131,103 @@ def test_stacked_scan_kernels():
     )
 
 
-def test_rejects_quantized_and_no_match(bert):
+def test_rejects_raw_codes_and_no_match(bert):
     with pytest.raises(ValueError, match="matched no parameter"):
         lora_init(jax.random.key(0), bert.params, LoRAConfig(targets="nonexistent_layer"))
+    # a plain integer leaf (in-scan QuantDense qdata style) still refuses
     qparams = {"attn": {"q_proj": {"kernel": jnp.zeros((8, 8), jnp.int8)}}}
-    with pytest.raises(ValueError, match="quantized"):
+    with pytest.raises(ValueError, match="integer codes"):
         lora_init(jax.random.key(0), qparams, LoRAConfig(targets=r"q_proj/kernel"))
+    # a target regex naming a QuantDense LAYER (kernel gone, only qdata/qscale
+    # params remain) gets the actionable in-scan error, not a silent skip
+    qd = {"layer_0": {"q_proj": {"qdata": jnp.zeros((1, 8, 8), jnp.int8),
+                                 "qscale": jnp.ones((1, 1, 8), jnp.float32)}}}
+    with pytest.raises(ValueError, match="QuantDense"):
+        lora_init(jax.random.key(0), qd, LoRAConfig(targets=r"q_proj$"))
+    # an unanchored regex hits the codes directly — still an actionable error
+    with pytest.raises(ValueError, match="quantize_params"):
+        lora_init(jax.random.key(0), qd, LoRAConfig(targets=r"q_proj"))
 
 
-def test_rejects_real_qtensor_targets():
-    """A real quantized model: QTensor children flatten to kernel/0,
-    kernel/1 — the target regex must still refuse, not silently skip."""
-    from accelerate_tpu.utils.quantization import QuantizationConfig, quantize_params
+def test_qlora_init_identity_and_frozen_codes():
+    """QLoRA: a QTensor kernel is a first-class target — adapters attach at
+    the kernel path, merge at init reproduces the dequantized base exactly,
+    and the packed codes never leave the tree (frozen by construction)."""
+    from accelerate_tpu.utils.quantization import (
+        QTensor, QuantizationConfig, dequantize_params, quantize_params,
+    )
 
-    params = {"attn": {"q_proj": {"kernel": jnp.ones((64, 64), jnp.float32)}}}
+    params = {"attn": {"q_proj": {"kernel": jax.random.normal(jax.random.key(1), (64, 64))},
+                       "o_proj": {"kernel": jax.random.normal(jax.random.key(2), (64, 64))}}}
     qparams = quantize_params(params, QuantizationConfig(min_size=1))
-    with pytest.raises(ValueError, match="quantized"):
-        lora_init(jax.random.key(0), qparams, LoRAConfig(targets=r"q_proj/kernel$"))
+    cfg = LoRAConfig(rank=4, targets=r"q_proj/kernel$")
+    assert lora_targets(qparams, cfg) == ["attn/q_proj/kernel"]
+    adapters = lora_init(jax.random.key(0), qparams, cfg)
+    a = adapters["attn"]["q_proj"]["kernel"]["lora_a"]
+    assert a.shape == (64, 4) and jnp.issubdtype(a.dtype, jnp.floating)
+    merged = lora_merge(qparams, adapters, cfg)
+    # target kernel is dense after merge; the untargeted one stays quantized
+    assert not isinstance(merged["attn"]["q_proj"]["kernel"], QTensor)
+    assert isinstance(merged["attn"]["o_proj"]["kernel"], QTensor)
+    np.testing.assert_allclose(
+        np.asarray(merged["attn"]["q_proj"]["kernel"]),
+        np.asarray(dequantize_params(qparams)["attn"]["q_proj"]["kernel"]),
+        rtol=1e-6,
+    )
+
+
+def test_qlora_trains_adapters_on_quantized_base(bert):
+    """End-to-end QLoRA: int8 base + float adapters; only adapters get
+    gradients, loss decreases, and the merged export can be re-quantized."""
+    from accelerate_tpu.utils.quantization import (
+        QTensor, QuantizationConfig, dequantize_params, quantize_params,
+    )
+
+    from accelerate_tpu.utils.quantization import load_and_quantize_model
+
+    qmodel = load_and_quantize_model(bert, QuantizationConfig(bits=8, min_size=1, skip_patterns=(
+        "embed", "lm_head", "norm", "bias", "scale", "pooler", "classifier")))
+    qparams = qmodel.params
+    cfg = LoRAConfig(rank=4)
+    target_paths = lora_targets(qparams, cfg)
+    assert target_paths, "quantized q/v kernels must still be targetable"
+    adapters = lora_init(jax.random.key(0), qparams, cfg)
+
+    def loss_fn(ad, batch):
+        # qmodel.apply_fn dequantizes the REMAINING QTensor leaves in-jit;
+        # merged target kernels are already dense
+        return bert_classification_loss(lora_merge(qparams, ad, cfg), batch, qmodel.apply_fn)
+
+    opt = optax.adam(5e-2)
+    opt_state = opt.init(adapters)
+    batch = _batch(jax.random.key(3))
+
+    @jax.jit
+    def step(ad, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(ad, batch)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(ad, updates), opt_state, loss
+
+    losses = []
+    for _ in range(8):
+        adapters, opt_state, loss = step(adapters, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.05, losses
+
+    def leaf_at(tree, path):
+        node = tree
+        for part in path.split("/"):
+            node = node[part]
+        return node
+
+    # base stayed quantized+frozen through training; export re-quantizes fine
+    assert isinstance(leaf_at(qparams, target_paths[0]), QTensor)
+    merged = lora_merge(qparams, adapters, cfg)
+    assert not isinstance(leaf_at(merged, target_paths[0]), QTensor)
+    requant = quantize_params(
+        dequantize_params(merged), QuantizationConfig(bits=8, min_size=1))
+    assert any(isinstance(l, QTensor) for l in jax.tree.leaves(
+        requant, is_leaf=lambda l: isinstance(l, QTensor)))
 
 
 def test_save_load_roundtrip(bert, tmp_path):
