@@ -651,6 +651,7 @@ class Accelerator:
         growth_interval = int(getattr(h, "growth_interval", 2000))
 
         compress_method = getattr(self.state.parallelism_plugin, "grad_compression", None)
+        psgd_rank = None
         if compress_method is not None:
             if has_state or has_aux:
                 raise ValueError("grad_compression does not compose with has_state/has_aux yet")
@@ -660,9 +661,13 @@ class Accelerator:
                     f"grad_compression reduces over the 'data' axis only; shard-bearing axes {bad} "
                     "would need their own reduction semantics"
                 )
+            from .parallel.compression import powersgd_rank
 
-        def step_fn(params, opt_state, grad_buf, mstate, batch, scale_state, do_sync, rng, clip_norm):
+            psgd_rank = powersgd_rank(compress_method)
+
+        def step_fn(params, opt_state, grad_buf, mstate, batch, scale_state, do_sync, rng, clip_norm, comp_state):
             loss_scale = scale_state["scale"]
+            new_comp_state = comp_state
 
             def scaled_loss(p):
                 out = call_loss(compute_cast(p), mstate, batch, rng)
@@ -679,29 +684,60 @@ class Accelerator:
                 # hook analogue) instead of XLA's implicit f32 reduction
                 from jax.sharding import PartitionSpec as P
 
-                from .parallel.compression import compressed_psum_mean
+                from .parallel.compression import compressed_psum_mean, powersgd_psum_mean
 
-                def local_grads(p, local_batch, ls, key):
+                def local_grads(p, local_batch, ls, key, cstate):
                     def local_loss(q):
                         out = call_loss(compute_cast(q), None, local_batch, key)
                         return out.astype(jnp.float32) * ls, out
 
                     g, local_l = jax.grad(local_loss, has_aux=True)(p)
-                    g = compressed_psum_mean(g, "data", compress_method)
-                    return g, jax.lax.pmean(local_l, "data")
+                    # unscale BEFORE compressing: the PowerSGD residual (and
+                    # the int8 quantization error) must live in true gradient
+                    # units, or every dynamic loss-scale change mis-weights
+                    # the carried/rounded feedback by scale_old/scale_new
+                    g = jax.tree_util.tree_map(lambda l: l.astype(jnp.float32) / ls, g)
+                    if psgd_rank is None:
+                        g = compressed_psum_mean(g, "data", compress_method)
+                        return g, jax.lax.pmean(local_l, "data"), cstate
+                    # PowerSGD: one non-finite microbatch (fp16 overflow)
+                    # must not poison the carried residual/Q — keep the old
+                    # state and let the non-finite reduced gradient trip the
+                    # sync-boundary finite gate (params held, buffer zeroed,
+                    # scale backed off) exactly like the uncompressed path
+                    ok = jnp.bool_(True)
+                    for l in jax.tree_util.tree_leaves(g):
+                        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(l)))
+                    ok = jax.lax.psum(ok.astype(jnp.int32), "data") == jax.lax.psum(1, "data")
+                    local = {
+                        "error": jax.tree_util.tree_map(lambda e: e[0], cstate["error"]),
+                        "q": cstate["q"],
+                    }
+                    g, new_local = powersgd_psum_mean(g, "data", local, psgd_rank)
+                    new_local = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(ok, new, old), new_local, local
+                    )
+                    new_cstate = {
+                        "error": jax.tree_util.tree_map(lambda e: e[None], new_local["error"]),
+                        "q": new_local["q"],
+                    }
+                    return g, jax.lax.pmean(local_l, "data"), new_cstate
 
+                comp_spec = {"error": P("data"), "q": P()} if psgd_rank is not None else {}
                 sm = jax.shard_map(
                     local_grads,
                     mesh=self.mesh,
-                    in_specs=(P(), P(("data", "fsdp")), P(), P()),
-                    out_specs=(P(), P()),
+                    in_specs=(P(), P(("data", "fsdp")), P(), P(), comp_spec),
+                    out_specs=(P(), P(), comp_spec),
                     check_vma=False,
                 )
-                grads, loss = sm(params, batch, loss_scale, rng)
+                grads, loss, new_comp_state = sm(params, batch, loss_scale, rng, comp_state)
                 new_state, aux = mstate, None
             else:
                 grads, (loss, new_state, aux) = jax.grad(scaled_loss, has_aux=True)(params)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / (loss_scale * accum), grads)
+            # compressed grads are already unscaled inside local_grads
+            denom = accum if compress_method is not None else (loss_scale * accum)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / denom, grads)
             grad_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
 
             def hold(operand):
@@ -742,7 +778,7 @@ class Accelerator:
                     "scale": jnp.where(do_sync, upd_scale, loss_scale),
                     "growth": jnp.where(do_sync, upd_growth, scale_state["growth"]),
                 }
-            return new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state
+            return new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state, new_comp_state
 
         zero_shardings = getattr(optimizer, "_zero_shardings", None)
         buf_shardings = None
@@ -754,6 +790,8 @@ class Accelerator:
             )
 
         donate_args = ((0, 1, 2, 3) if has_state else (0, 1, 2)) if donate else ()
+        if donate and psgd_rank is not None:
+            donate_args = donate_args + (9,)  # the params-sized error-feedback carry
         jitted = jax.jit(step_fn, donate_argnums=donate_args)
 
         grad_buf = jax.jit(
@@ -762,6 +800,27 @@ class Accelerator:
         )(model.params)
         if not hasattr(self, "_fast_scale_boxes"):
             self._fast_scale_boxes = []
+        comp_state0 = {}
+        if psgd_rank is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .parallel.compression import powersgd_init_state
+
+            n_data = int(dict(self.mesh.shape).get("data", 1))
+            # build the params-sized error carry ALREADY sharded (jit +
+            # out_shardings, the grad_buf pattern above): materializing it
+            # replicated first would put n_data x params f32 on one device
+            comp_state0 = jax.jit(
+                lambda p: powersgd_init_state(p, psgd_rank, n_data),
+                out_shardings={
+                    "error": jax.tree_util.tree_map(
+                        lambda _: NamedSharding(self.mesh, P("data")), model.params
+                    ),
+                    "q": jax.tree_util.tree_map(
+                        lambda _: NamedSharding(self.mesh, P()), model.params
+                    ),
+                },
+            )(model.params)
         state_box = {
             "grad_buf": grad_buf,
             "micro": 0,
@@ -773,6 +832,9 @@ class Accelerator:
                 "growth": jnp.int32(self._scale_growth_tracker),
             },
             "boundaries": 0,
+            # PowerSGD error-feedback + warm-start factors (empty unless
+            # grad_compression="powersgd[:r]")
+            "comp_state": comp_state0,
         }
         self._fast_scale_boxes.append(state_box)
         _SCALE_REFRESH = 64
@@ -791,7 +853,7 @@ class Accelerator:
             from .utils.random import key_for_step
 
             with self._matmul_precision_ctx():
-                new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state = jitted(
+                new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state, new_comp = jitted(
                     model.params,
                     optimizer.opt_state,
                     state_box["grad_buf"],
@@ -801,6 +863,7 @@ class Accelerator:
                     jnp.bool_(do_sync),
                     key_for_step(self.step),
                     jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
+                    state_box["comp_state"],
                 )
             model.params = new_params
             if has_state:
@@ -808,6 +871,7 @@ class Accelerator:
             optimizer.opt_state = new_opt
             state_box["grad_buf"] = new_buf
             state_box["scale_state"] = new_scale_state
+            state_box["comp_state"] = new_comp
             state_box["micro"] = 0 if do_sync else state_box["micro"] + 1
             self.step += 1
             self._last_grad_norm = gnorm

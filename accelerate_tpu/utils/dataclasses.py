@@ -293,9 +293,10 @@ class ParallelismPlugin(KwargsHandler):
     # activation rematerialisation policy name (see accelerator.build_train_step)
     remat_policy: Optional[str] = None
     donate_state: bool = True
-    # compress the data-parallel gradient reduction ("bf16" | "int8") — the
-    # reference's DDP comm hooks (utils/dataclasses.py:130-226), for
-    # multi-host data axes where DCN bytes are the bottleneck
+    # compress the data-parallel gradient reduction ("bf16" | "int8" |
+    # "powersgd[:rank]") — the reference's DDP comm hooks incl. PowerSGD
+    # (utils/dataclasses.py:130-226), for multi-host data axes where DCN
+    # bytes are the bottleneck
     grad_compression: Optional[str] = None
 
     @classmethod
@@ -309,7 +310,12 @@ class ParallelismPlugin(KwargsHandler):
 
     def __post_init__(self):
         if self.grad_compression is not None and self.grad_compression not in ("bf16", "int8"):
-            raise ValueError(f"grad_compression must be bf16|int8, got {self.grad_compression!r}")
+            from ..parallel.compression import powersgd_rank
+
+            if powersgd_rank(self.grad_compression) is None:
+                raise ValueError(
+                    f"grad_compression must be bf16|int8|powersgd[:rank], got {self.grad_compression!r}"
+                )
 
 
 def add_model_config_to_megatron_parser(*a, **k):  # pragma: no cover
