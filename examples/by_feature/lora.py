@@ -65,6 +65,45 @@ def main():
     accelerator.print(f"merged export: {changed} kernels changed, base params untouched")
     assert changed == 4  # q and v kernels of both layers
 
+    # ---- QLoRA: the same transform over a QUANTIZED frozen base ----------
+    from accelerate_tpu.utils.quantization import (
+        QTensor, QuantizationConfig, load_and_quantize_model, quantized_bytes,
+    )
+
+    qmodel = load_and_quantize_model(
+        model,
+        QuantizationConfig(bits=8, min_size=1, skip_patterns=(
+            "embed", "lm_head", "norm", "bias", "scale", "pooler", "classifier")),
+    )
+    q_adapters = lora_init(jax.random.key(2), qmodel.params, cfg)
+    accelerator.print(
+        f"QLoRA: base packed to {quantized_bytes(qmodel.params):,} bytes; "
+        f"adapters {sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(q_adapters)):,} params"
+    )
+    q_opt_state = opt.init(q_adapters)
+
+    @jax.jit
+    def q_step(ad, opt_state):
+        def loss_fn(ad):
+            return bert_classification_loss(
+                lora_merge(qmodel.params, ad, cfg), batch, qmodel.apply_fn)
+
+        loss, grads = jax.value_and_grad(loss_fn)(ad)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(ad, updates), opt_state, loss
+
+    q_first = None
+    for _ in range(30):
+        q_adapters, q_opt_state, q_loss = q_step(q_adapters, q_opt_state)
+        q_first = q_first if q_first is not None else float(q_loss)
+    accelerator.print(f"QLoRA loss {q_first:.4f} -> {float(q_loss):.4f}")
+    assert float(q_loss) < q_first, "QLoRA training did not reduce the loss"
+    q_merged = lora_merge(qmodel.params, q_adapters, cfg)
+    still_q = sum(isinstance(l, QTensor)
+                  for l in jax.tree_util.tree_leaves(q_merged, is_leaf=lambda l: isinstance(l, QTensor)))
+    accelerator.print(f"QLoRA merged export: {still_q} untargeted kernels still quantized")
+    assert still_q > 0
+
 
 if __name__ == "__main__":
     main()
