@@ -23,7 +23,17 @@ parity-plus. Design notes:
   after prefill;
 * inactive slots still compute in the tick (static shapes; masking out
   their tokens is host-side bookkeeping). Their caches accumulate
-  garbage that the next prefill-insert fully replaces.
+  garbage that the next prefill-insert fully replaces;
+* **chunked prefill**: a prompt longer than the largest bucket streams
+  through the decode path in largest-bucket-sized chunks against the
+  growing cache (``cached_attention`` is the same program for S_new = 1
+  and S_new = C) — so prompt length is bounded by cache capacity, not by
+  the compiled bucket set, and the compile count stays O(buckets);
+* **prefix caching**: :meth:`register_prefix` prefills a shared prompt
+  prefix (e.g. a system prompt) ONCE and stores the row cache;
+  ``submit(..., prefix_id=...)`` requests copy it and prefill only their
+  suffix — the vLLM prefix-reuse win, token-exact by construction because
+  the copied cache is bit-identical to what a full prefill would write.
 """
 
 from __future__ import annotations
@@ -47,6 +57,7 @@ class _Request:
     prompt: np.ndarray  # [S] int32
     max_new_tokens: int
     out_tokens: list
+    prefix_id: Optional[int] = None
 
 
 class ServingEngine:
@@ -142,6 +153,40 @@ class ServingEngine:
             for b in self.prompt_buckets
         }
 
+        # ---- chunked-prefill programs (long prompts / prefix suffixes) ----
+        # one chunk size (the largest bucket) x {cold, warm}: compile count
+        # stays O(buckets), prompt length is bounded only by max_len
+        chunk = max(self.prompt_buckets)
+        self._chunk = chunk
+
+        def chunk_cold(params, ids):
+            positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+            return apply_fn(params, ids, positions=positions, decode=True, cache=None)
+
+        def chunk_warm(params, ids, pos0, cache):
+            positions = pos0 + jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+            return apply_fn(params, ids, positions=positions, decode=True, cache=cache)
+
+        self._chunk_cold = jax.jit(chunk_cold)
+        self._chunk_warm = jax.jit(chunk_warm)
+
+        def sample_at(logits, offset, key):
+            key, sub = jax.random.split(key)
+            return sampler(logits[0, offset][None], sub)[0], key
+
+        self._sample_at = jax.jit(sample_at)
+
+        def reset_idx(cache, n):
+            from .ops.kv_cache import reset_cache_index
+
+            return reset_cache_index(cache, n)
+
+        self._reset_idx = jax.jit(reset_idx)
+
+        # registered shared prefixes: id -> {"len", "cache", "tokens"}
+        self._prefixes: dict[int, dict] = {}
+        self._prefix_uid = 0
+
         @jax.jit
         def insert(slot_caches, row_cache, slot):
             return jax.tree.map(
@@ -191,28 +236,110 @@ class ServingEngine:
             jax.random.key(seed), jnp.arange(num_slots)
         )
 
+    # ---- chunked prefill (host driver) ----------------------------------
+
+    def _chunked_prefill(self, full_tokens: np.ndarray, row_cache=None, done_upto: int = 0, key=None):
+        """Stream ``full_tokens[done_upto:]`` through the decode path in
+        ``self._chunk``-sized end-aligned windows against ``row_cache``
+        (None = fresh, ``done_upto`` must then be 0).
+
+        Windows are END-aligned: a window covering new tokens ``[s, e)``
+        runs as ``[max(0, e - C), e)`` — never past ``e`` — so cache writes
+        stay inside ``[0, max_len)`` (a forward-padded tail would exceed it
+        and ``dynamic_update_slice``'s start-clamping would silently corrupt
+        the earliest rows). The overlapped head of a window recomputes
+        bit-identical K/V from the true tokens (positions are absolute), so
+        overlap is token-exact by construction; only a ``T < C`` window has
+        a pad tail, whose garbage rows sit beyond the causal frontier and
+        are overwritten by decode, exactly as in bucket prefill. Returns
+        ``(next_tok | None, cache, key)`` with the cache write index reset
+        to ``len(full_tokens)``; sampling happens only when ``key`` is given
+        (prefix registration skips it)."""
+        jax = _jax()
+        jnp = jax.numpy
+        c = self._chunk
+        t = len(full_tokens)
+        logits, s_last = None, 0
+        s = done_upto
+        while s < t:
+            # window width = smallest bucket covering the remainder (a short
+            # suffix after a long prefix runs a suffix-sized program, not a
+            # full chunk), else the largest; jit specializes per width, so
+            # the compile count stays O(buckets)
+            w = next((b for b in self.prompt_buckets if b >= t - s), c)
+            e = min(s + w, t)
+            s_adj = max(0, e - w)  # end-aligned window [s_adj, s_adj + w)
+            window = np.zeros((1, w), np.int32)
+            real = full_tokens[s_adj : s_adj + w]
+            window[0, : len(real)] = real
+            if row_cache is None:
+                logits, row_cache = self._chunk_cold(self.model.params, jnp.asarray(window))
+            else:
+                row_cache = self._reset_idx(row_cache, jnp.int32(s_adj))
+                logits, row_cache = self._chunk_warm(
+                    self.model.params, jnp.asarray(window), jnp.int32(s_adj), row_cache
+                )
+            s_last, s = s_adj, e
+        row_cache = self._reset_idx(row_cache, jnp.int32(t))
+        next_tok = None
+        if key is not None:
+            next_tok, key = self._sample_at(logits, jnp.int32(t - 1 - s_last), key)
+        return next_tok, row_cache, key
+
     # ---- public API ----------------------------------------------------
 
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
-        """Queue a prompt; returns a request id resolved via :meth:`poll`."""
+    def register_prefix(self, prefix_ids) -> int:
+        """Prefill a shared prompt prefix ONCE; requests submitted with the
+        returned ``prefix_id`` copy its KV cache and prefill only their
+        suffix. The finished output includes the prefix tokens."""
+        toks = np.asarray(prefix_ids, np.int32).ravel()
+        if len(toks) == 0:
+            raise ValueError("empty prefix")
+        if len(toks) + 1 > self.max_len:
+            raise ValueError(
+                f"prefix length {len(toks)} leaves no room in the slot cache "
+                f"(max_len={self.max_len})"
+            )
+        _, cache, _ = self._chunked_prefill(toks)
+        pid = self._prefix_uid
+        self._prefix_uid += 1
+        self._prefixes[pid] = {"len": len(toks), "cache": cache, "tokens": toks}
+        return pid
+
+    def unregister_prefix(self, prefix_id: int) -> None:
+        """Release a registered prefix's device cache (each prefix pins a
+        full per-row KV pytree in HBM — long-running servers should evict
+        prefixes they no longer route requests to)."""
+        if prefix_id not in self._prefixes:
+            raise ValueError(f"unknown prefix_id {prefix_id}")
+        if any(r is not None and r.prefix_id == prefix_id for r in self.slot_req) or any(
+            r.prefix_id == prefix_id for r in self.queue
+        ):
+            raise ValueError(f"prefix_id {prefix_id} still referenced by active/queued requests")
+        del self._prefixes[prefix_id]
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32, prefix_id: Optional[int] = None) -> int:
+        """Queue a prompt; returns a request id resolved via :meth:`poll`.
+        With ``prefix_id``, ``prompt_ids`` is the SUFFIX after the registered
+        prefix (at least one token — its logits seed the first sample)."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         if len(prompt) == 0:
-            raise ValueError("empty prompt")
+            raise ValueError("empty prompt" + (" suffix" if prefix_id is not None else ""))
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        if len(prompt) > max(self.prompt_buckets):
+        plen = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}; call register_prefix first")
+            plen = self._prefixes[prefix_id]["len"]
+        if plen + len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest prompt bucket "
-                f"{max(self.prompt_buckets)}"
-            )
-        if len(prompt) + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the slot cache ({self.max_len})"
+                f"prefix ({plen}) + prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot cache ({self.max_len})"
             )
         uid = self._uid
         self._uid += 1
-        self.queue.append(_Request(uid, prompt, max_new_tokens, []))
+        self.queue.append(_Request(uid, prompt, max_new_tokens, [], prefix_id))
         return uid
 
     def poll(self, uid: int):
@@ -235,13 +362,29 @@ class ServingEngine:
             if self.slot_req[slot] is not None or not self.queue:
                 continue
             req = self.queue.popleft()
-            bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, : len(req.prompt)] = req.prompt
             key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
-            next_tok, row_cache, key = self._prefill[bucket](
-                self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), key
-            )
+            if req.prefix_id is None and len(req.prompt) <= max(self.prompt_buckets):
+                # short prompt, no prefix: the one-shot fused program
+                bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, : len(req.prompt)] = req.prompt
+                next_tok, row_cache, key = self._prefill[bucket](
+                    self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), key
+                )
+                total = len(req.prompt)
+            else:
+                # prefix-seeded and/or long prompt: chunked prefill. The
+                # stored prefix cache is never mutated — jax arrays are
+                # immutable, each request builds on its own copy
+                pre = self._prefixes[req.prefix_id] if req.prefix_id is not None else None
+                full = req.prompt if pre is None else np.concatenate([pre["tokens"], req.prompt])
+                next_tok, row_cache, key = self._chunked_prefill(
+                    full,
+                    row_cache=None if pre is None else pre["cache"],
+                    done_upto=0 if pre is None else pre["len"],
+                    key=key,
+                )
+                total = len(full)
             self._slot_keys = self._slot_keys.at[slot].set(key)
             self.slot_caches = self._insert(self.slot_caches, row_cache, jnp.int32(slot))
             tok = int(next_tok)
@@ -251,7 +394,7 @@ class ServingEngine:
                 self._retire(slot)
                 continue
             self.slot_tok[slot] = tok
-            self.slot_pos[slot] = len(req.prompt)
+            self.slot_pos[slot] = total
 
         if self.active_count == 0:
             return 0
@@ -296,5 +439,8 @@ class ServingEngine:
 
     def _retire(self, slot: int):
         req = self.slot_req[slot]
-        self.done[req.uid] = np.concatenate([req.prompt, np.asarray(req.out_tokens, np.int32)])
+        parts = [req.prompt, np.asarray(req.out_tokens, np.int32)]
+        if req.prefix_id is not None:
+            parts.insert(0, self._prefixes[req.prefix_id]["tokens"])
+        self.done[req.uid] = np.concatenate(parts)
         self.slot_req[slot] = None

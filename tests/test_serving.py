@@ -66,14 +66,83 @@ def test_eos_retires_slot(tiny_llama):
 
 def test_validation_errors(tiny_llama):
     eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(4,), max_len=16)
-    with pytest.raises(ValueError, match="bucket"):
-        eng.submit(np.ones((9,), np.int32))
     with pytest.raises(ValueError, match="cache"):
         eng.submit(np.ones((4,), np.int32), max_new_tokens=99)
     with pytest.raises(ValueError, match="empty"):
         eng.submit(np.zeros((0,), np.int32))
     with pytest.raises(ValueError, match="max_position_embeddings"):
         ServingEngine(tiny_llama, max_len=999)
+
+
+def test_long_prompt_chunked_prefill(tiny_llama):
+    """A prompt longer than the largest bucket streams through end-aligned
+    chunk windows — output still token-exact vs static generate()."""
+    prompt = (np.arange(12) % 250 + 1).astype(np.int32)
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(4, 8))
+    [got] = eng.generate_many([prompt], max_new_tokens=4)
+    np.testing.assert_array_equal(got, _reference(tiny_llama, prompt, 4))
+
+
+def test_long_prompt_unaligned_overlap(tiny_llama):
+    """Length not a multiple of the chunk: the final window overlaps the
+    previous one (end-aligned) and recomputes identical K/V."""
+    prompt = (np.arange(13) % 250 + 1).astype(np.int32)  # C=8 -> windows [0,8), [5,13)
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
+    [got] = eng.generate_many([prompt], max_new_tokens=3)
+    np.testing.assert_array_equal(got, _reference(tiny_llama, prompt, 3))
+
+
+def test_prefix_cache_token_exact(tiny_llama):
+    """Two requests share a registered prefix: each copies the prefix KV
+    row and prefills only its suffix; outputs equal full-prompt generate()."""
+    prefix = (np.arange(6) % 250 + 3).astype(np.int32)
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(4, 8))
+    pid = eng.register_prefix(prefix)
+    sufa = np.asarray([9, 8, 7], np.int32)
+    sufb = np.asarray([11, 12], np.int32)
+    a = eng.submit(sufa, max_new_tokens=5, prefix_id=pid)
+    b = eng.submit(sufb, max_new_tokens=5, prefix_id=pid)
+    eng.run()
+    np.testing.assert_array_equal(
+        eng.poll(a), _reference(tiny_llama, np.concatenate([prefix, sufa]), 5))
+    np.testing.assert_array_equal(
+        eng.poll(b), _reference(tiny_llama, np.concatenate([prefix, sufb]), 5))
+
+
+def test_prefix_with_overlapping_window_into_prefix(tiny_llama):
+    """A short suffix after a mid-length prefix: the single warm window
+    starts INSIDE the prefix region and rewrites identical K/V there."""
+    prefix = (np.arange(5) + 1).astype(np.int32)
+    suffix = (np.arange(9) + 40).astype(np.int32)  # 5+9=14, C=8: windows [5,13)->[6,14)
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
+    pid = eng.register_prefix(prefix)
+    uid = eng.submit(suffix, max_new_tokens=2, prefix_id=pid)
+    eng.run()
+    np.testing.assert_array_equal(
+        eng.poll(uid), _reference(tiny_llama, np.concatenate([prefix, suffix]), 2))
+
+
+def test_prefix_validation_and_eviction(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(4,), max_len=16)
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.submit(np.ones((2,), np.int32), prefix_id=7)
+    with pytest.raises(ValueError, match="empty"):
+        eng.register_prefix(np.zeros((0,), np.int32))
+    pid = eng.register_prefix(np.ones((6,), np.int32))
+    with pytest.raises(ValueError, match="cache"):
+        eng.submit(np.ones((4,), np.int32), max_new_tokens=8, prefix_id=pid)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros((0,), np.int32), prefix_id=pid)
+    # eviction: refused while a queued request references it, ok after drain
+    uid = eng.submit(np.asarray([3, 4], np.int32), max_new_tokens=2, prefix_id=pid)
+    with pytest.raises(ValueError, match="still referenced"):
+        eng.unregister_prefix(pid)
+    eng.run()
+    assert eng.poll(uid) is not None
+    eng.unregister_prefix(pid)
+    assert pid not in eng._prefixes
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        eng.unregister_prefix(pid)
 
 
 def test_gpt2_family_works_too():
