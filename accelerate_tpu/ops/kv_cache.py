@@ -36,7 +36,7 @@ def _constrain(x):
     return maybe_shard(x, CACHE_KV_SPEC)
 
 
-def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
+def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None, sliding_window=None):
     """Incremental causal attention against a growing cache.
 
     ``module``: the calling flax module (owns the ``cache`` variables).
@@ -48,7 +48,20 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
     ``bias_fn(q_pos [S_new], key_pos [max_len]) -> [1, H, S_new, max_len]``
     adds a position-dependent logit bias (T5's relative bias) — computed
     from ABSOLUTE positions so prefill and steps agree.
+    ``sliding_window``: Mistral-style band — each query attends only the
+    last ``sliding_window`` keys (the cache still stores ``max_len`` rows;
+    out-of-window rows are masked, matching the non-decode band mask).
     """
+    from . import paged_kv
+
+    pcfg = paged_kv.active_paged_config()
+    if pcfg is not None:
+        # serving engine's paged mode: block-pool cache layout instead of
+        # dense per-row buffers (trace-time switch; see ops/paged_kv.py)
+        return paged_kv.paged_cached_attention(
+            module, q, k, v, max_len, scale=scale, bias_fn=bias_fn,
+            sliding_window=sliding_window, cfg=pcfg,
+        )
     b, s_new, h_kv, d = k.shape
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     ck = module.variable("cache", "key", jnp.zeros, (b, max_len, h_kv, d), k.dtype)
@@ -61,9 +74,13 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
 
     k_all, v_all = ck.value, cv.value
     groups = q.shape[2] // h_kv
-    # causal over absolute positions: new token i attends to <= cur+i
+    # causal over absolute positions: new token i attends to <= cur+i;
+    # with a sliding window, also to > cur+i - W (the Mistral band)
     key_pos = jnp.arange(max_len)
     q_pos = cur + jnp.arange(s_new)
+    live = key_pos[None, :] <= q_pos[:, None]  # [S_new, max_len]
+    if sliding_window is not None:
+        live &= key_pos[None, :] > q_pos[:, None] - sliding_window
     bias = bias_fn(q_pos, key_pos) if bias_fn is not None else None
     if groups > 1:
         # GQA: contract grouped queries against the UN-repeated cache —
@@ -73,14 +90,14 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None):
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) * scale
         if bias is not None:
             scores = scores + bias.reshape(1, h_kv, groups, s_new, max_len)
-        mask = key_pos[None, None, None, None, :] <= q_pos[None, None, None, :, None]
+        mask = live[None, None, None]
         probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_all)
         return out.reshape(b, s_new, h_kv * groups, d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) * scale
     if bias is not None:
         scores = scores + bias
-    mask = key_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    mask = live[None, None]
     probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
 

@@ -1,0 +1,261 @@
+"""Paged KV cache: a shared block pool + per-sequence block tables.
+
+The dense serving cache reserves ``max_len`` rows per slot, so HBM caps
+the slot count at ``pool_bytes = slots x max_len`` even when most
+requests are short. Paging (the vLLM design, shaped for XLA's static
+shapes) allocates cache in fixed-size *blocks* from one shared pool:
+
+* ``key_pool`` / ``value_pool``: ``[num_blocks, block_size, H_kv, D]``
+  per layer — the only large buffers, sized by *expected total tokens in
+  flight*, not ``slots x max_len``;
+* ``block_table``: ``[B, max_blocks]`` int32 per row — position ``p`` of
+  row ``b`` lives at ``pool[table[b, p // bs], p % bs]``;
+* block 0 is a reserved **trash sink**: padded table entries and the
+  post-retirement overshoot writes of a static decode tick land there,
+  so a retired slot can never corrupt a block that was freed and
+  reallocated to another request (see ``ServingEngine._retire``, which
+  also re-points the whole retired row at the sink);
+* shared prompt prefixes alias their *full* blocks into many tables
+  (refcounted host-side) — prefix reuse without copying cache rows.
+
+Everything stays static-shape: the gather ``pool[table]`` reads
+``max_blocks * block_size >= max_len`` rows per row per step — the same
+bytes the dense cache reads — so paging trades nothing on the decode
+roofline and wins pool *capacity* (more concurrent slots per GB).
+
+The reference has no serving/paged-cache analogue (it delegates
+generation entirely — SURVEY §2.2/§7); this is parity-plus. The paged
+branch is selected at *trace time* by :func:`paged_mode`, so the model
+zoo's ``cached_attention`` call sites need no changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    block_size: int
+    num_blocks: int  # total pool blocks INCLUDING the reserved trash block 0
+
+
+_ACTIVE: Optional[PagedConfig] = None
+
+
+def active_paged_config() -> Optional[PagedConfig]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def paged_mode(cfg: PagedConfig):
+    """Trace-time switch: while active, ``cached_attention`` declares and
+    updates the paged cache layout instead of dense ``[B, max_len]``
+    buffers. Only the *tracing* of a program needs the context (the
+    serving engine compiles its paged programs eagerly inside it);
+    replaying compiled programs does not."""
+    global _ACTIVE
+    prev, _ACTIVE = _ACTIVE, cfg
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+# Pool layout on a mesh: heads over ``tensor`` (same TP decode layout as
+# the dense CACHE_KV_SPEC); the block axis is NOT batch — the pool is
+# shared by every row — so it stays unsharded.
+POOL_KV_SPEC = P(None, None, "tensor", None)
+
+
+def _constrain_pool(x):
+    from ..parallel.sharding import maybe_shard
+
+    return maybe_shard(x, POOL_KV_SPEC)
+
+
+def paged_cached_attention(
+    module, q, k, v, max_len: int, scale=None, bias_fn=None, sliding_window=None, cfg: PagedConfig = None
+):
+    """Single-token incremental attention against the paged pool.
+
+    Declares (per layer) ``key_pool``/``value_pool`` ``[NB, bs, H_kv, D]``,
+    ``block_table`` ``[B, MB]`` and a PER-ROW ``index`` ``[B]`` — ragged
+    row positions are native here (the dense branch's scalar frontier
+    forces the serving engine to vmap row-wise; the paged tick runs one
+    batched program instead). Prefill always runs dense and is pasted
+    into the pool by :func:`paste_row`, so only ``S_new == 1`` decode
+    steps ever trace this branch.
+    """
+    b, s_new, h_kv, d = k.shape
+    if s_new != 1:
+        raise ValueError(
+            f"paged attention is decode-only (S_new == 1, got {s_new}); "
+            "prefill runs the dense path and is pasted into the pool"
+        )
+    if bias_fn is not None:
+        raise NotImplementedError("paged attention does not support bias_fn (T5-style relative bias)")
+    bs_, nb = cfg.block_size, cfg.num_blocks
+    mb = -(-max_len // bs_)
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    kp = module.variable("cache", "key_pool", jnp.zeros, (nb, bs_, h_kv, d), k.dtype)
+    vp = module.variable("cache", "value_pool", jnp.zeros, (nb, bs_, h_kv, d), v.dtype)
+    bt = module.variable("cache", "block_table", jnp.zeros, (b, mb), jnp.int32)
+    idx = module.variable("cache", "index", jnp.zeros, (b,), jnp.int32)
+
+    cur = idx.value  # [B] per-row write positions
+    rows = jnp.arange(b)
+    # overshoot clamp: a slot that finished mid-tick keeps computing with
+    # growing cur; past the table it clamps to the last entry (its own
+    # reserved block or the trash sink — never another row's block)
+    blk = jnp.minimum(cur // bs_, mb - 1)
+    dest = bt.value[rows, blk]  # [B] pool block ids
+    off = cur % bs_
+    kp.value = _constrain_pool(kp.value.at[dest, off].set(k[:, 0]))
+    vp.value = _constrain_pool(vp.value.at[dest, off].set(v[:, 0]))
+    idx.value = cur + 1
+
+    # gather each row's pages: [B, MB, bs, H_kv, D] -> [B, L, H_kv, D]
+    k_all = kp.value[bt.value].reshape(b, mb * bs_, h_kv, d)
+    v_all = vp.value[bt.value].reshape(b, mb * bs_, h_kv, d)
+    key_pos = jnp.arange(mb * bs_)
+    live = key_pos[None, :] <= cur[:, None]  # [B, L] causal frontier per row
+    if sliding_window is not None:
+        live &= key_pos[None, :] > cur[:, None] - sliding_window  # Mistral band
+
+    groups = q.shape[2] // h_kv
+    if groups > 1:
+        # GQA: contract grouped queries against the un-repeated pool rows
+        # (same traffic argument as the dense branch)
+        qg = q.reshape(b, 1, h_kv, groups, d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) * scale
+        mask = live[:, None, None, None, :]
+        probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_all)
+        return out.reshape(b, 1, h_kv * groups, d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(jnp.where(live[:, None, None, :], scores, -jnp.inf), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
+
+def _path_names(path):
+    return tuple(p.key if hasattr(p, "key") else str(p) for p in path)
+
+
+def _scatter_pools(paged_cache, row_cache, write_row, table_updates):
+    """Blockify a dense per-row cache and scatter it into the pools at
+    ``write_row``'s block ids; apply ``table_updates(name, leaf)`` to the
+    ``block_table``/``index`` leaves (or leave them untouched if it
+    returns None)."""
+    dense = {_path_names(p): leaf for p, leaf in jax.tree_util.tree_flatten_with_path(row_cache)[0]}
+
+    def write(path, leaf):
+        names = _path_names(path)
+        name, prefix = names[-1], names[:-1]
+        if name in ("key_pool", "value_pool"):
+            row = dense[prefix + (name[: -len("_pool")],)]  # key_pool -> key
+            lead = leaf.ndim - 4  # leading layer-scan axes (0 or 1)
+            bs_ = leaf.shape[lead + 1]
+            mb = write_row.shape[0]
+            max_len = row.shape[lead + 1]
+            pad = mb * bs_ - max_len
+            if pad:
+                widths = [(0, 0)] * (lead + 1) + [(0, pad), (0, 0), (0, 0)]
+                row = jnp.pad(row, widths)
+            # absorb the B=1 row axis while blockifying
+            blocks = row.reshape(*leaf.shape[:lead], mb, bs_, *leaf.shape[-2:])
+            sel = (slice(None),) * lead + (write_row,)
+            return leaf.at[sel].set(blocks.astype(leaf.dtype))
+        if name in ("block_table", "index"):
+            out = table_updates(name, leaf)
+            return leaf if out is None else out
+        raise ValueError(f"unexpected paged cache leaf {'/'.join(names)}")
+
+    return jax.tree_util.tree_map_with_path(write, paged_cache)
+
+
+def paste_row(paged_cache, row_cache, write_row, table_row, slot, new_index):
+    """Install a dense prefill row cache into the pool for ``slot``.
+
+    ``row_cache`` is the ordinary dense per-row cache a prefill program
+    produced (leaves ``key``/``value`` ``[..., 1, max_len, H_kv, D]``);
+    every leaf is blockified and scattered at ``write_row``'s pool ids,
+    and ``slot``'s table row / frontier index are set to ``table_row`` /
+    ``new_index``. ``write_row`` and ``table_row`` differ exactly on
+    entries the admit must NOT write: pad entries and shared prefix
+    blocks point at the trash sink in ``write_row`` (shared content is
+    written once, at registration — rewriting it per admit would race
+    other slots decoding against it and waste the write traffic), while
+    ``table_row`` keeps the real ids for reads. Pure — jit once.
+    """
+
+    def tables(name, leaf):
+        if name == "block_table":
+            sel = (slice(None),) * (leaf.ndim - 2) + (slot,)
+            return leaf.at[sel].set(table_row.astype(leaf.dtype))
+        sel = (slice(None),) * (leaf.ndim - 1) + (slot,)
+        return leaf.at[sel].set(jnp.asarray(new_index, leaf.dtype))
+
+    return _scatter_pools(paged_cache, row_cache, write_row, tables)
+
+
+def paste_blocks(paged_cache, row_cache, write_row):
+    """Write pool content only (no slot table/index): used once per
+    registered prefix to install its full blocks as the canonical shared
+    content every aliasing request reads. Pure — jit once."""
+    return _scatter_pools(paged_cache, row_cache, write_row, lambda name, leaf: None)
+
+
+def clear_slot(paged_cache, slot):
+    """Re-point ``slot``'s table row at the trash sink and zero its
+    frontier. MUST run when a slot retires: the static decode tick keeps
+    computing (and writing) for every slot, and a stale table would
+    corrupt blocks after they are freed and reallocated. Pure — jit it."""
+
+    def write(path, leaf):
+        name = _path_names(path)[-1]
+        if name == "block_table":
+            sel = (slice(None),) * (leaf.ndim - 2) + (slot,)
+            return leaf.at[sel].set(jnp.zeros((leaf.shape[-1],), leaf.dtype))
+        if name == "index":
+            sel = (slice(None),) * (leaf.ndim - 1) + (slot,)
+            return leaf.at[sel].set(jnp.zeros((), leaf.dtype))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(write, paged_cache)
+
+
+class BlockAllocator:
+    """Host-side free list over pool blocks ``1..num_blocks-1`` (block 0
+    is the trash sink and is never handed out)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"pool needs >= 2 blocks (one is the trash sink), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int):
+        """``n`` block ids, or None if the pool can't satisfy the request
+        (callers keep the request queued and retry after a retirement)."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids) -> None:
+        for i in ids:
+            if not 0 < i < self.num_blocks:
+                raise ValueError(f"bad block id {i}")
+            self._free.append(i)

@@ -33,7 +33,15 @@ parity-plus. Design notes:
   prefix (e.g. a system prompt) ONCE and stores the row cache;
   ``submit(..., prefix_id=...)`` requests copy it and prefill only their
   suffix — the vLLM prefix-reuse win, token-exact by construction because
-  the copied cache is bit-identical to what a full prefill would write.
+  the copied cache is bit-identical to what a full prefill would write;
+* **paged KV cache** (``paged_block_size=...``): slot caches live in one
+  shared block pool addressed through per-slot block tables
+  (:mod:`accelerate_tpu.ops.paged_kv`) instead of ``slots x max_len``
+  dense rows — pool capacity is sized by expected tokens in flight
+  (``pool_blocks``), admission waits when the pool is exhausted, and
+  prefix blocks are refcount-shared across requests rather than copied.
+  The decode tick becomes ONE batched program (per-row frontiers are
+  native to the paged layout) and outputs stay token-exact vs dense.
 """
 
 from __future__ import annotations
@@ -71,6 +79,13 @@ class ServingEngine:
     greedy at ``temperature=0`` (the token-exact-vs-generate setting) or
     temperature/top-k sampling with an independent per-slot key chain
     folded on the request uid (deterministic per ``seed``).
+
+    ``paged_block_size``: enable the paged KV cache with this block size
+    (rows per pool block; 16-64 keeps tables small and pool granularity
+    useful). ``pool_blocks``: total pool blocks including the reserved
+    trash sink (default ``num_slots * ceil(max_len / block_size) + 1``,
+    i.e. dense-equivalent capacity — pass less to oversubscribe HBM and
+    let admission control queue requests when the pool is full).
     """
 
     def __init__(
@@ -84,6 +99,8 @@ class ServingEngine:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         seed: int = 0,
+        paged_block_size: Optional[int] = None,
+        pool_blocks: Optional[int] = None,
     ):
         jax = _jax()
         jnp = jax.numpy
@@ -111,16 +128,50 @@ class ServingEngine:
         params = model.params
         apply_fn = model.apply_fn
 
-        # empty per-row cache template from a 1-token dummy prefill
-        _, cache0 = jax.eval_shape(
-            lambda p, i: apply_fn(p, i, positions=jnp.zeros((1, 1), jnp.int32), decode=True, cache=None),
-            params,
-            jnp.zeros((1, 1), jnp.int32),
-        )
-        # slot pool: leading slot axis over the per-row cache pytree
-        self.slot_caches = jax.tree.map(
-            lambda l: jnp.zeros((num_slots, *l.shape), l.dtype), cache0
-        )
+        # Cache layout: dense = leading slot axis over the per-row cache
+        # pytree (each slot reserves max_len rows); paged = one shared
+        # block pool + per-slot block tables (ops/paged_kv.py) — same
+        # decode roofline, pool capacity decoupled from slots x max_len.
+        self.paged = paged_block_size is not None
+        if self.paged:
+            from .ops.paged_kv import BlockAllocator, PagedConfig, paged_mode
+
+            bs_ = int(paged_block_size)
+            if bs_ < 1:
+                raise ValueError(f"paged_block_size must be >= 1, got {paged_block_size}")
+            # table width follows the MODEL's cache horizon: the zoo's
+            # cached_attention declares [B, ceil(max_position_embeddings /
+            # bs)] tables regardless of the engine's (possibly smaller)
+            # max_len — but reservations and the default pool are budgeted
+            # by max_len, which submit() enforces
+            self._mb = -(-model.config.max_position_embeddings // bs_)
+            nb = int(pool_blocks) if pool_blocks is not None else num_slots * (-(-self.max_len // bs_)) + 1
+            self._pcfg = PagedConfig(block_size=bs_, num_blocks=nb)
+            self._alloc = BlockAllocator(nb)
+            self._shared_refs: dict[int, int] = {}  # prefix block id -> refcount
+            self._slot_blocks: list[list] = [[] for _ in range(num_slots)]
+            self._slot_shared: list[list] = [[] for _ in range(num_slots)]
+            with paged_mode(self._pcfg):
+                _, pcache = jax.eval_shape(
+                    lambda p, i, pos: apply_fn(p, i, positions=pos, decode=True, cache=None),
+                    params,
+                    jnp.zeros((num_slots, 1), jnp.int32),
+                    jnp.zeros((num_slots, 1), jnp.int32),
+                )
+            self.slot_caches = jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype), pcache)
+        elif pool_blocks is not None:
+            raise ValueError("pool_blocks requires paged_block_size (paged mode)")
+        else:
+            # empty per-row cache template from a 1-token dummy prefill,
+            # then a leading slot axis over the per-row cache pytree
+            _, cache0 = jax.eval_shape(
+                lambda p, i: apply_fn(p, i, positions=jnp.zeros((1, 1), jnp.int32), decode=True, cache=None),
+                params,
+                jnp.zeros((1, 1), jnp.int32),
+            )
+            self.slot_caches = jax.tree.map(
+                lambda l: jnp.zeros((num_slots, *l.shape), l.dtype), cache0
+            )
 
         # host-side slot state
         self.slot_req: list[Optional[_Request]] = [None] * num_slots
@@ -129,6 +180,7 @@ class ServingEngine:
         self.queue: collections.deque[_Request] = collections.deque()
         self.done: dict[int, np.ndarray] = {}
         self._uid = 0
+        self._pool_blocked = False  # last admit pass hit pool exhaustion
 
         # ---- jitted programs (compiled once each) ----
         def prefill(params, ids, true_len, key):
@@ -207,34 +259,70 @@ class ServingEngine:
             raise ValueError(f"tick_block must be >= 1, got {tick_block}")
         self.tick_block = tick_block
 
-        def one_step(params, cache_row, tok, pos, key):
-            logits, cache_row = apply_fn(
-                params, tok.reshape(1, 1), positions=pos.reshape(1, 1), decode=True, cache=cache_row
-            )
-            key, sub = jax.random.split(key)
-            nxt = sampler(logits[0, -1][None], sub)[0]
-            return cache_row, nxt, key
-
-        @jax.jit
-        def decode_tick(params, slot_caches, toks, poss, keys):
-            def block_step(carry, _):
-                caches, toks, poss, keys = carry
-                caches, nxt, keys = jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(
-                    params, caches, toks, poss, keys
-                )
-                return (caches, nxt, poss + 1, keys), nxt
-
-            (slot_caches, _, _, keys), toks_k = jax.lax.scan(
-                block_step, (slot_caches, toks, poss, keys), None, length=tick_block
-            )
-            return slot_caches, toks_k, keys  # toks_k [K, slots]
-
-        self._decode_tick = decode_tick
         # independent sampling chain per slot (re-folded with the request
         # uid at each admit, so retries/new requests don't replay a chain)
         self._slot_keys = jax.vmap(jax.random.fold_in, (None, 0))(
             jax.random.key(seed), jnp.arange(num_slots)
         )
+
+        def make_tick(step_body):
+            """K-step tick scaffold shared by both cache layouts:
+            ``step_body(params, caches, toks, poss, keys) -> (caches,
+            next_toks, keys)`` advances every slot one token."""
+
+            def decode_tick(params, slot_caches, toks, poss, keys):
+                def block_step(carry, _):
+                    caches, toks, poss, keys = carry
+                    caches, nxt, keys = step_body(params, caches, toks, poss, keys)
+                    return (caches, nxt, poss + 1, keys), nxt
+
+                (slot_caches, _, _, keys), toks_k = jax.lax.scan(
+                    block_step, (slot_caches, toks, poss, keys), None, length=tick_block
+                )
+                return slot_caches, toks_k, keys  # toks_k [K, slots]
+
+            return decode_tick
+
+        if self.paged:
+            # Per-row frontiers are native to the paged layout (index is
+            # [B], not a scalar), so the tick is ONE batched program — no
+            # per-row vmap. Same key-split order as the dense one_step,
+            # so outputs stay token-exact across layouts.
+            def paged_step(params, cache, toks, poss, keys):
+                logits, cache = apply_fn(
+                    params, toks[:, None], positions=poss[:, None], decode=True, cache=cache
+                )
+                split = jax.vmap(jax.random.split)(keys)
+                keys, subs = split[:, 0], split[:, 1]
+                nxt = jax.vmap(lambda lg, s: sampler(lg[None], s)[0])(logits[:, -1], subs)
+                return cache, nxt, keys
+
+            from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row
+
+            zi = jnp.zeros((num_slots,), jnp.int32)
+            with paged_mode(self._pcfg):
+                # compile eagerly: only TRACING needs the paged context
+                self._decode_tick = (
+                    jax.jit(make_tick(paged_step))
+                    .lower(params, self.slot_caches, zi, zi, self._slot_keys)
+                    .compile()
+                )
+            self._paste = jax.jit(paste_row)
+            self._paste_blocks = jax.jit(paste_blocks)
+            self._clear_slot = jax.jit(clear_slot)
+        else:
+            def one_step(params, cache_row, tok, pos, key):
+                logits, cache_row = apply_fn(
+                    params, tok.reshape(1, 1), positions=pos.reshape(1, 1), decode=True, cache=cache_row
+                )
+                key, sub = jax.random.split(key)
+                nxt = sampler(logits[0, -1][None], sub)[0]
+                return cache_row, nxt, key
+
+            def dense_step(params, caches, toks, poss, keys):
+                return jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(params, caches, toks, poss, keys)
+
+            self._decode_tick = jax.jit(make_tick(dense_step))
 
     # ---- chunked prefill (host driver) ----------------------------------
 
@@ -303,7 +391,30 @@ class ServingEngine:
         _, cache, _ = self._chunked_prefill(toks)
         pid = self._prefix_uid
         self._prefix_uid += 1
-        self._prefixes[pid] = {"len": len(toks), "cache": cache, "tokens": toks}
+        entry = {"len": len(toks), "cache": cache, "tokens": toks}
+        if self.paged:
+            # reserve the prefix's FULL blocks and write their content ONCE
+            # — this registration-time paste is the canonical shared bytes
+            # every aliasing request reads; admits never rewrite them (a
+            # rewrite would race slots actively decoding against the
+            # blocks, and cross-program recomputes of the same K/V are not
+            # guaranteed bit-identical)
+            n_full = len(toks) // self._pcfg.block_size
+            ids = self._alloc.alloc(n_full)
+            if ids is None:
+                raise ValueError(
+                    f"prefix needs {n_full} pool blocks but only "
+                    f"{self._alloc.free_count} are free; raise pool_blocks or unregister prefixes"
+                )
+            for i in ids:
+                self._shared_refs[i] = 1  # registration's own reference
+            entry["block_ids"] = ids
+            if ids:
+                jnp = _jax().numpy
+                write_row = np.zeros((self._mb,), np.int32)  # pad -> trash sink
+                write_row[:n_full] = ids
+                self.slot_caches = self._paste_blocks(self.slot_caches, cache, jnp.asarray(write_row))
+        self._prefixes[pid] = entry
         return pid
 
     def unregister_prefix(self, prefix_id: int) -> None:
@@ -316,7 +427,12 @@ class ServingEngine:
             r.prefix_id == prefix_id for r in self.queue
         ):
             raise ValueError(f"prefix_id {prefix_id} still referenced by active/queued requests")
-        del self._prefixes[prefix_id]
+        entry = self._prefixes.pop(prefix_id)
+        if self.paged:
+            for i in entry.get("block_ids", []):
+                refs = self._shared_refs.pop(i)
+                assert refs == 1, f"shared block {i} still referenced ({refs})"
+                self._alloc.free([i])
 
     def submit(self, prompt_ids, max_new_tokens: int = 32, prefix_id: Optional[int] = None) -> int:
         """Queue a prompt; returns a request id resolved via :meth:`poll`.
@@ -337,6 +453,13 @@ class ServingEngine:
                 f"prefix ({plen}) + prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the slot cache ({self.max_len})"
             )
+        if self.paged:
+            need, shared_n = self._blocks_needed(plen, len(prompt), max_new_tokens)
+            if need - shared_n > self._pcfg.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {need - shared_n} pool blocks but the pool has "
+                    f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
+                )
         uid = self._uid
         self._uid += 1
         self.queue.append(_Request(uid, prompt, max_new_tokens, [], prefix_id))
@@ -358,9 +481,34 @@ class ServingEngine:
         jnp = jax.numpy
 
         # admit queued requests into free slots
+        self._pool_blocked = False
         for slot in range(self.num_slots):
             if self.slot_req[slot] is not None or not self.queue:
                 continue
+            table = new_ids = shared_ids = None
+            if self.paged:
+                # reserve pool blocks BEFORE dequeuing; if the pool can't
+                # satisfy the head request, the whole queue waits (FIFO —
+                # no starvation of large requests by later small ones)
+                head = self.queue[0]
+                need, shared_n = self._head_blocks()
+                new_ids = self._alloc.alloc(need - shared_n)
+                if new_ids is None:
+                    self._pool_blocked = True
+                    break
+                shared_ids = (
+                    self._prefixes[head.prefix_id]["block_ids"][:shared_n] if shared_n else []
+                )
+                for i in shared_ids:
+                    self._shared_refs[i] += 1
+                table = np.zeros((self._mb,), np.int32)  # pad entries -> trash sink
+                table[:shared_n] = shared_ids
+                table[shared_n:need] = new_ids
+                # the paste writes ONLY this request's own blocks: shared
+                # prefix entries go to the trash sink in the write row
+                # (their canonical content was written at registration)
+                write_row = table.copy()
+                write_row[:shared_n] = 0
             req = self.queue.popleft()
             key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
             if req.prefix_id is None and len(req.prompt) <= max(self.prompt_buckets):
@@ -386,7 +534,14 @@ class ServingEngine:
                 )
                 total = len(full)
             self._slot_keys = self._slot_keys.at[slot].set(key)
-            self.slot_caches = self._insert(self.slot_caches, row_cache, jnp.int32(slot))
+            if self.paged:
+                self._slot_blocks[slot], self._slot_shared[slot] = new_ids, shared_ids
+                self.slot_caches = self._paste(
+                    self.slot_caches, row_cache, jnp.asarray(write_row), jnp.asarray(table),
+                    jnp.int32(slot), jnp.int32(total),
+                )
+            else:
+                self.slot_caches = self._insert(self.slot_caches, row_cache, jnp.int32(slot))
             tok = int(next_tok)
             self.slot_req[slot] = req
             req.out_tokens.append(tok)
@@ -420,7 +575,21 @@ class ServingEngine:
     def run(self) -> dict:
         """Drive ticks until queue and slots drain; returns {uid: tokens}."""
         while self.queue or self.active_count:
-            self.step()
+            if self.step() == 0 and self.queue and self._pool_blocked:
+                # admission hit pool exhaustion and NOTHING is active any
+                # more — every block that can ever be free is free NOW. If
+                # the head still doesn't fit, it is unsatisfiable
+                # (registered prefixes hold the rest of the pool) and
+                # raising beats the silent busy-loop; if it fits, the
+                # blocking was transient (the tick's retirements freed
+                # blocks after the admit pass) and the next step admits it.
+                need, shared_n = self._head_blocks()
+                if need - shared_n > self._alloc.free_count:
+                    raise RuntimeError(
+                        f"request {self.queue[0].uid} needs {need - shared_n} pool blocks but "
+                        f"only {self._alloc.free_count} can ever be free (registered prefixes "
+                        "hold the rest); raise pool_blocks or unregister unused prefixes"
+                    )
         return dict(self.done)
 
     def generate_many(self, prompts, max_new_tokens: int = 32) -> list:
@@ -437,6 +606,32 @@ class ServingEngine:
             return True
         return len(req.out_tokens) >= req.max_new_tokens
 
+    def _blocks_needed(self, plen: int, prompt_len: int, max_new: int):
+        """(total blocks for a request's table, of which shared prefix
+        blocks). Reserves through the last *kept* write — position
+        total + max_new - 2 (the token hitting max_new is sampled from
+        that write's step). A finished slot's discarded overshoot writes
+        within the rest of its tick land in trash-sink table entries or
+        its own last block, never a neighbour's, so they need no
+        reservation."""
+        bs_ = self._pcfg.block_size
+        total = plen + prompt_len
+        need = min(self._mb, -(-(total + max_new - 1) // bs_))
+        shared_n = min(plen // bs_, need)
+        return need, shared_n
+
+    def _head_blocks(self):
+        """(need, shared_n) for the queue's head request — shared by the
+        admission path and run()'s unsatisfiable-head diagnostic."""
+        head = self.queue[0]
+        plen = self._prefixes[head.prefix_id]["len"] if head.prefix_id is not None else 0
+        return self._blocks_needed(plen, len(head.prompt), head.max_new_tokens)
+
+    @property
+    def pool_free_blocks(self) -> Optional[int]:
+        """Free blocks in the paged pool (None in dense mode)."""
+        return self._alloc.free_count if self.paged else None
+
     def _retire(self, slot: int):
         req = self.slot_req[slot]
         parts = [req.prompt, np.asarray(req.out_tokens, np.int32)]
@@ -444,3 +639,16 @@ class ServingEngine:
             parts.insert(0, self._prefixes[req.prefix_id]["tokens"])
         self.done[req.uid] = np.concatenate(parts)
         self.slot_req[slot] = None
+        if self.paged:
+            # free this request's blocks and re-point the whole row at the
+            # trash sink — the static tick keeps computing for every slot,
+            # and a stale table would corrupt blocks once they're
+            # reallocated to another request
+            jnp = _jax().numpy
+            self._alloc.free(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            for i in self._slot_shared[slot]:
+                self._shared_refs[i] -= 1
+                assert self._shared_refs[i] >= 1, f"shared block {i} over-freed"
+            self._slot_shared[slot] = []
+            self.slot_caches = self._clear_slot(self.slot_caches, jnp.int32(slot))
