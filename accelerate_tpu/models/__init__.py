@@ -1,7 +1,8 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mixtral/resnet/vit/whisper/clip/unet/vae) + HF safetensors
-weight import. The reference delegates models to transformers; here they
-ship in-tree (SURVEY hard-part #3: torch-free model story)."""
+(bert/gpt2/gptneox/t5/llama/mistral/mixtral/resnet/vit/whisper/clip/unet/vae)
++ HF safetensors weight import. The reference delegates models to
+transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
+model story)."""
 
 from .bert import (
     BERT_SHARDING_RULES,
@@ -28,6 +29,12 @@ from .llama import (
     LlamaModel,
     causal_lm_loss,
     create_llama_model,
+)
+from .mistral import (
+    MISTRAL_SHARDING_RULES,
+    MistralConfig,
+    MistralModel,
+    create_mistral_model,
 )
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
@@ -88,6 +95,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_gpt2,
     load_hf_gptneox,
     load_hf_llama,
+    load_hf_mistral,
     load_hf_mixtral,
     load_hf_t5,
     load_hf_vit,

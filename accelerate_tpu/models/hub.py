@@ -241,6 +241,27 @@ def load_hf_llama(checkpoint_path: str, config=None):
     return model
 
 
+def load_hf_mistral(checkpoint_path: str, config=None):
+    """HF Mistral checkpoints use the llama state-dict layout verbatim
+    (model.layers.N.self_attn/mlp/...); only the config differs — the
+    band width rides in ``MistralConfig.sliding_window``. Default config
+    is Mistral-7B-**v0.1**; pass ``MistralConfig.mistral_7b_v3()`` for
+    v0.2/v0.3 weights (different theta, no window)."""
+    from .mistral import MistralConfig, create_mistral_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or MistralConfig.mistral_7b_v1()
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+    )
+    model = create_mistral_model(config)
+    _merge_into(model, tree)
+    return model
+
+
 # --------------------------------------------------------------------- #
 # GPT-2
 # --------------------------------------------------------------------- #

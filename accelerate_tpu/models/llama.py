@@ -44,6 +44,12 @@ class LlamaConfig:
     # "auto": ring attention when the mesh seq axis is non-trivial, else
     # dense/flash; "ring" | "all_to_all" | "dense" force a path.
     attention_impl: str = "auto"
+    # Mistral-style sliding-window attention: each position attends to at
+    # most the last `sliding_window` keys (itself included). None = full
+    # causal. Windowed models route to the dense XLA path (the band mask
+    # rules out the causal-only flash kernel and seq-sharded context
+    # parallelism for now).
+    sliding_window: Optional[int] = None
     # weight-only quantized block projections (int8|int4|nf4): every
     # q/k/v/o/gate/up/down kernel becomes a QuantDense whose packed codes
     # are the params — the decode-bandwidth win (set via
@@ -153,11 +159,13 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rotated.reshape(x.shape).astype(x.dtype)
 
 
-def _dispatch_attention(q, k, v, impl: str):
+def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None):
     """Pick the attention path: context-parallel (ring / all-to-all) when
     the active mesh has a non-trivial ``seq`` axis, else dense/flash. This
     is where long-context becomes a *layout* decision rather than a model
-    rewrite (SURVEY §5)."""
+    rewrite (SURVEY §5). ``sliding_window`` adds a Mistral-style band
+    mask and pins the dense XLA path (the causal-only flash kernel and
+    the context-parallel schedules don't support the band yet)."""
     if impl not in ("auto", "ring", "all_to_all", "dense"):
         raise ValueError(f"attention_impl must be auto|ring|all_to_all|dense, got {impl!r}")
     mesh = None
@@ -172,6 +180,18 @@ def _dispatch_attention(q, k, v, impl: str):
             f"attention_impl={impl!r} requires an active mesh with a seq axis > 1 "
             f"(got {dict(mesh.shape) if mesh is not None else None}); use 'auto' for adaptive dispatch"
         )
+    if sliding_window is not None:
+        if impl in ("ring", "all_to_all") or seq_ok:
+            raise NotImplementedError(
+                "sliding-window attention does not compose with seq-axis context "
+                "parallelism yet; run windowed models without a seq mesh axis"
+            )
+        from ..ops.attention import dot_product_attention
+
+        s = q.shape[1]
+        q_pos = jnp.arange(s)[:, None]
+        band = jnp.arange(s)[None, :] > q_pos - sliding_window  # keys newer than q-W
+        return dot_product_attention(q, k, v, mask=band[None, None], causal=True, mesh=mesh)
     if seq_ok:
         from ..parallel.context import context_parallel_attention
 
@@ -200,7 +220,7 @@ class LlamaAttention(nn.Module):
         if decode:
             out = self._cached_attention(q, k, v)
         else:
-            out = _dispatch_attention(q, k, v, cfg.attention_impl)
+            out = _dispatch_attention(q, k, v, cfg.attention_impl, cfg.sliding_window)
         out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
         return _dense(cfg, cfg.hidden_size, "o_proj", hidden.dtype)(out)
 
@@ -209,7 +229,10 @@ class LlamaAttention(nn.Module):
         machinery in :mod:`accelerate_tpu.ops.kv_cache`)."""
         from ..ops.kv_cache import cached_attention
 
-        return cached_attention(self, q, k, v, self.config.max_position_embeddings)
+        return cached_attention(
+            self, q, k, v, self.config.max_position_embeddings,
+            sliding_window=self.config.sliding_window,
+        )
 
 
 class LlamaMLP(nn.Module):

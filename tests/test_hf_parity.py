@@ -229,6 +229,39 @@ def test_gptneox_import_non_parallel_residual(tmp_path):
     np.testing.assert_allclose(got, want, atol=TOL)
 
 
+def test_mistral_import_matches_transformers(tmp_path):
+    """Mistral = llama weights + sliding-window band. window 4 < seq 16,
+    so any off-by-one in the band mask (ours vs HF's eager sliding-window
+    path) breaks element-wise logits parity."""
+    import jax
+
+    from accelerate_tpu.models import MistralConfig
+    from accelerate_tpu.models.hub import load_hf_mistral
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        sliding_window=4, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        sliding_window=4, scan_layers=False, remat=False,
+    )
+    model = load_hf_mistral(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
 def test_mixtral_import_matches_transformers(tmp_path):
     """MoE family parity: with generous expert capacity (no token drops)
     our GShard-style dispatch computes exactly HF's top-2 renormalized
