@@ -18,10 +18,15 @@ shapes) allocates cache in fixed-size *blocks* from one shared pool:
 * shared prompt prefixes alias their *full* blocks into many tables
   (refcounted host-side) — prefix reuse without copying cache rows.
 
-Everything stays static-shape: the gather ``pool[table]`` reads
-``max_blocks * block_size >= max_len`` rows per row per step — the same
-bytes the dense cache reads — so paging trades nothing on the decode
-roofline and wins pool *capacity* (more concurrent slots per GB).
+Everything stays static-shape. On TPU the decode step dispatches to the
+Pallas kernel in :mod:`.pallas_paged_attention`, which DMAs each page
+into VMEM exactly once via scalar-prefetched table indexing (under a
+``shard_map`` over ``tensor`` when the pool is TP-sharded — a
+``pallas_call`` can't be auto-partitioned). The XLA fallback (CPU, or
+head counts the tensor axis can't split) gathers ``pool[table]`` into a
+contiguous ``[B, L, H_kv, D]`` copy — dense-equivalent read bytes plus
+the gather write. Either way paging wins pool *capacity* (more
+concurrent slots per GB); the kernel also wins decode traffic.
 
 The reference has no serving/paged-cache analogue (it delegates
 generation entirely — SURVEY §2.2/§7); this is parity-plus. The paged
@@ -48,6 +53,11 @@ class PagedConfig:
 
 
 _ACTIVE: Optional[PagedConfig] = None
+
+# Route the off-TPU paged path through the Pallas kernel in interpret
+# mode instead of the XLA gather — CI's hook for exercising the exact
+# kernel-in-engine composition TPU serving runs, without a chip.
+FORCE_KERNEL_INTERPRET = False
 
 
 def active_paged_config() -> Optional[PagedConfig]:
@@ -123,6 +133,22 @@ def paged_cached_attention(
     vp.value = _constrain_pool(vp.value.at[dest, off].set(v[:, 0]))
     idx.value = cur + 1
 
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu or FORCE_KERNEL_INTERPRET:
+        # Pallas kernel: reads each page once via scalar-prefetched table
+        # indexing — no [B, L, H_kv, D] gather materialisation (the XLA
+        # fallback below writes+rereads one; ~3x the attention traffic)
+        import functools
+
+        from .pallas_paged_attention import paged_decode_attention
+
+        fn = functools.partial(
+            paged_decode_attention, sliding_window=sliding_window, scale=scale, interpret=not on_tpu
+        )
+        run = _kernel_runner(fn, q.shape[2], h_kv)
+        if run is not None:  # None: TP mesh the heads can't split -> XLA path
+            return run(q[:, 0], kp.value, vp.value, bt.value, cur)[:, None]
+
     # gather each row's pages: [B, MB, bs, H_kv, D] -> [B, L, H_kv, D]
     k_all = kp.value[bt.value].reshape(b, mb * bs_, h_kv, d)
     v_all = vp.value[bt.value].reshape(b, mb * bs_, h_kv, d)
@@ -144,6 +170,42 @@ def paged_cached_attention(
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) * scale
     probs = jax.nn.softmax(jnp.where(live[:, None, None, :], scores, -jnp.inf), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
+
+def _kernel_runner(fn, heads: int, kv_heads: int):
+    """How to invoke the paged kernel under the active mesh. A
+    ``pallas_call`` is an opaque custom call XLA's partitioner cannot
+    split, so a tensor-parallel pool must be fed per-shard via
+    ``shard_map`` over the ``tensor`` axis (heads are independent in
+    attention; the table/frontier are replicated) — the same treatment
+    as ``sharded_pallas_attention``. Returns ``fn`` directly when no
+    non-trivial tensor axis is active (or we're already inside a
+    shard_map region), and None when heads don't divide the axis — the
+    caller then uses the XLA gather path, which partitions naturally."""
+    am = jax.sharding.get_abstract_mesh()
+    if any(t == jax.sharding.AxisType.Manual for t in getattr(am, "axis_types", ())):
+        return fn
+    from .attention import active_mesh
+
+    mesh = active_mesh()
+    if mesh is None:
+        return fn
+    from ..parallel.mesh import axis_size
+
+    n_t = axis_size(mesh, "tensor")
+    if n_t <= 1:
+        return fn
+    if heads % n_t or kv_heads % n_t:
+        return None
+    qspec = P(None, "tensor", None)
+    pspec = P(None, None, "tensor", None)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(qspec, pspec, pspec, P(None, None), P(None)),
+        out_specs=qspec,
+        check_vma=False,
+    )
 
 
 def _path_names(path):

@@ -1,0 +1,163 @@
+"""Pallas TPU kernel: paged-attention decode over a shared block pool.
+
+The XLA paged path (:func:`accelerate_tpu.ops.paged_kv.paged_cached_attention`)
+gathers each row's pages into a contiguous ``[B, L, H_kv, D]`` copy
+every step — the gather WRITES a full cache-sized array and the two
+attention einsums read it back, roughly tripling the per-step HBM
+traffic of the (bandwidth-bound) decode attention. This kernel reads
+each page exactly once: the grid walks ``(row, table_entry)``, the
+block table is a scalar-prefetch operand so each step's ``index_map``
+DMAs the right pool block directly into VMEM, and an online-softmax
+accumulator (flash-attention style) folds every page into ``[H, D]``
+scratch without materialising the gathered cache.
+
+* pages fully beyond the row's frontier (or entirely outside the
+  sliding-window band) are skipped with ``pl.when`` — and because pad
+  table entries all point at the trash-sink block, their repeated index
+  elides the DMA as well;
+* GQA runs as one batched ``dot_general`` over KV heads (queries
+  reshaped ``[H_kv, G, D]``), never repeating K/V;
+* the decode contract matches the XLA branch bit-for-bit in masking:
+  keys at positions ``> cur - W`` and ``<= cur``.
+
+The public paged-attention kernel in ``jax.experimental`` follows the
+same scalar-prefetch shape; this one is written for THIS engine's
+layout (trash-sink block 0, per-row frontiers, optional band) and is
+dispatched from ``paged_cached_attention`` on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(
+    tbl_ref,  # [B, MB] int32 (scalar prefetch)
+    cur_ref,  # [B] int32 (scalar prefetch)
+    q_ref,  # [1, H, D]
+    k_ref,  # [1, bs, Hkv, D]
+    v_ref,  # [1, bs, Hkv, D]
+    o_ref,  # [1, H, D]
+    m_ref,  # [H, 1] f32 scratch
+    l_ref,  # [H, 1] f32 scratch
+    acc_ref,  # [H, D] f32 scratch
+    *,
+    block_size: int,
+    kv_heads: int,
+    window: Optional[int],
+    scale: float,
+):
+    b, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cur = cur_ref[b]
+    lo = j * block_size
+    live = lo <= cur  # any causal-live key in this page
+    if window is not None:
+        live &= lo + block_size - 1 > cur - window  # any in-band key
+
+    @pl.when(live)
+    def _page():
+        q = q_ref[0].astype(jnp.float32)  # [H, D]
+        k = k_ref[0].astype(jnp.float32)  # [bs, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)
+        heads, dim = q.shape
+        groups = heads // kv_heads
+        # [Hkv, G, D] x [Hkv, bs, D] -> [Hkv, G, bs]: one batched matmul,
+        # K/V never repeated (the GQA traffic argument, in-kernel)
+        qg = q.reshape(kv_heads, groups, dim)
+        kt = k.transpose(1, 0, 2)  # [Hkv, bs, D]
+        s = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        s = s.reshape(heads, block_size) * scale
+        pos = lo + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)  # [1, bs]
+        mask = pos <= cur
+        if window is not None:
+            mask &= pos > cur - window
+        s = jnp.where(mask, s, -jnp.inf)
+
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))  # finite: live page has a live key
+        alpha = jnp.exp(m_prev - m_new)  # 0 when m_prev == -inf
+        p = jnp.exp(s - m_new[:, None])  # [H, bs]
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p.reshape(kv_heads, groups, block_size),
+            v.transpose(1, 0, 2),  # [Hkv, bs, D]
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ).reshape(heads, dim)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv
+        m_ref[:, 0] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finalize():
+        # l > 0 always: the page holding `cur` itself is live for any row
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window", "scale", "interpret"))
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, D]
+    key_pool: jax.Array,  # [NB, bs, Hkv, D]
+    value_pool: jax.Array,  # [NB, bs, Hkv, D]
+    block_table: jax.Array,  # [B, MB] int32
+    cur: jax.Array,  # [B] int32 — per-row frontier (attend to <= cur)
+    *,
+    sliding_window: Optional[int] = None,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """One decode step of attention for every row against its paged KV.
+
+    Returns ``[B, H, D]`` in ``q.dtype``. The caller has already written
+    the step's K/V into the pool at position ``cur`` (the engine's
+    scatter), so the frontier key is included.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, heads, dim = q.shape
+    nb, block_size, kv_heads, _ = key_pool.shape
+    mb = block_table.shape[1]
+    scale = (1.0 / math.sqrt(dim)) if scale is None else scale
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, heads, dim), lambda b, j, tbl, cur: (b, 0, 0)),
+            pl.BlockSpec((1, block_size, kv_heads, dim), lambda b, j, tbl, cur: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, kv_heads, dim), lambda b, j, tbl, cur: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, heads, dim), lambda b, j, tbl, cur: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((heads, 1), jnp.float32),
+            pltpu.VMEM((heads, 1), jnp.float32),
+            pltpu.VMEM((heads, dim), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _kernel,
+        block_size=block_size,
+        kv_heads=kv_heads,
+        window=sliding_window,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, heads, dim), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), cur.astype(jnp.int32), q, key_pool, value_pool)

@@ -125,6 +125,19 @@ class ServingEngine:
 
         sampler = _make_sampler(temperature, top_k)
 
+        def ctx_jit(fn):
+            """jit + re-enter the model's mesh context around every call:
+            a shard_model'ed model pins ITS mesh for the cache sharding
+            constraints and the paged kernel's shard_map (constraints
+            bake in at the first trace; later calls hit the jit cache)."""
+            jitted = jax.jit(fn)
+
+            def call(*args):
+                with self._trace_ctx():
+                    return jitted(*args)
+
+            return call
+
         params = model.params
         apply_fn = model.apply_fn
 
@@ -197,13 +210,14 @@ class ServingEngine:
             return next_tok, cache, key
 
         key_aval = jax.eval_shape(lambda: jax.random.key(0))
-        self._prefill = {
-            b: jax.jit(prefill).lower(
-                params, jax.ShapeDtypeStruct((1, b), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32), key_aval
-            ).compile()
-            for b in self.prompt_buckets
-        }
+        with self._trace_ctx():
+            self._prefill = {
+                b: jax.jit(prefill).lower(
+                    params, jax.ShapeDtypeStruct((1, b), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32), key_aval
+                ).compile()
+                for b in self.prompt_buckets
+            }
 
         # ---- chunked-prefill programs (long prompts / prefix suffixes) ----
         # one chunk size (the largest bucket) x {cold, warm}: compile count
@@ -219,27 +233,26 @@ class ServingEngine:
             positions = pos0 + jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
             return apply_fn(params, ids, positions=positions, decode=True, cache=cache)
 
-        self._chunk_cold = jax.jit(chunk_cold)
-        self._chunk_warm = jax.jit(chunk_warm)
+        self._chunk_cold = ctx_jit(chunk_cold)
+        self._chunk_warm = ctx_jit(chunk_warm)
 
         def sample_at(logits, offset, key):
             key, sub = jax.random.split(key)
             return sampler(logits[0, offset][None], sub)[0], key
 
-        self._sample_at = jax.jit(sample_at)
+        self._sample_at = ctx_jit(sample_at)
 
         def reset_idx(cache, n):
             from .ops.kv_cache import reset_cache_index
 
             return reset_cache_index(cache, n)
 
-        self._reset_idx = jax.jit(reset_idx)
+        self._reset_idx = ctx_jit(reset_idx)
 
         # registered shared prefixes: id -> {"len", "cache", "tokens"}
         self._prefixes: dict[int, dict] = {}
         self._prefix_uid = 0
 
-        @jax.jit
         def insert(slot_caches, row_cache, slot):
             return jax.tree.map(
                 lambda big, row: jax.lax.dynamic_update_index_in_dim(big, row.astype(big.dtype), slot, 0),
@@ -247,7 +260,7 @@ class ServingEngine:
                 row_cache,
             )
 
-        self._insert = insert
+        self._insert = ctx_jit(insert)
 
         # Decode K steps per host round-trip: one sync per TOKEN would be
         # latency-bound (10s of ms on tunnel-attached backends); the block
@@ -300,16 +313,16 @@ class ServingEngine:
             from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row
 
             zi = jnp.zeros((num_slots,), jnp.int32)
-            with paged_mode(self._pcfg):
+            with paged_mode(self._pcfg), self._trace_ctx():
                 # compile eagerly: only TRACING needs the paged context
                 self._decode_tick = (
                     jax.jit(make_tick(paged_step))
                     .lower(params, self.slot_caches, zi, zi, self._slot_keys)
                     .compile()
                 )
-            self._paste = jax.jit(paste_row)
-            self._paste_blocks = jax.jit(paste_blocks)
-            self._clear_slot = jax.jit(clear_slot)
+            self._paste = ctx_jit(paste_row)
+            self._paste_blocks = ctx_jit(paste_blocks)
+            self._clear_slot = ctx_jit(clear_slot)
         else:
             def one_step(params, cache_row, tok, pos, key):
                 logits, cache_row = apply_fn(
@@ -322,7 +335,7 @@ class ServingEngine:
             def dense_step(params, caches, toks, poss, keys):
                 return jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(params, caches, toks, poss, keys)
 
-            self._decode_tick = jax.jit(make_tick(dense_step))
+            self._decode_tick = ctx_jit(make_tick(dense_step))
 
     # ---- chunked prefill (host driver) ----------------------------------
 
@@ -605,6 +618,13 @@ class ServingEngine:
         if self.eos_token_id is not None and tok == self.eos_token_id:
             return True
         return len(req.out_tokens) >= req.max_new_tokens
+
+    def _trace_ctx(self):
+        """Mesh context for tracing engine programs: a sharded model's
+        mesh (shard_model sets ``model.mesh``), else a no-op."""
+        from .generation import _trace_ctx
+
+        return _trace_ctx(getattr(self.model, "mesh", None))
 
     def _blocks_needed(self, plen: int, prompt_len: int, max_new: int):
         """(total blocks for a request's table, of which shared prefix

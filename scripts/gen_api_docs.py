@@ -52,6 +52,7 @@ MODULES = [
     "accelerate_tpu.ops.pallas_qmatmul",
     "accelerate_tpu.ops.kv_cache",
     "accelerate_tpu.ops.paged_kv",
+    "accelerate_tpu.ops.pallas_paged_attention",
     "accelerate_tpu.ops.moe",
     "accelerate_tpu.ops.fp8",
     "accelerate_tpu.ops.qdense",

@@ -1,0 +1,113 @@
+"""Paged-attention decode kernel (ops/pallas_paged_attention.py) vs an
+XLA gather reference, in Pallas interpret mode on CPU: MHA/GQA, ragged
+per-row frontiers, trash-sink pad entries, sliding-window bands, and
+bf16 inputs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.ops.pallas_paged_attention import paged_decode_attention
+
+
+def _reference(q, kp, vp, tbl, cur, window=None):
+    """The XLA paged math from ops/paged_kv.py, inlined: gather pages,
+    mask to (cur - W, cur], softmax, weighted sum."""
+    b, h, d = q.shape
+    nb, bs, hkv, _ = kp.shape
+    mb = tbl.shape[1]
+    k_all = kp[tbl].reshape(b, mb * bs, hkv, d).astype(jnp.float32)
+    v_all = vp[tbl].reshape(b, mb * bs, hkv, d).astype(jnp.float32)
+    pos = jnp.arange(mb * bs)
+    live = pos[None, :] <= cur[:, None]
+    if window is not None:
+        live &= pos[None, :] > cur[:, None] - window
+    g = h // hkv
+    qg = q.astype(jnp.float32).reshape(b, hkv, g, d)
+    s = jnp.einsum("bhgd,bkhd->bhgk", qg, k_all) / np.sqrt(d)
+    s = jnp.where(live[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgk,bkhd->bhgd", p, v_all)
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def _setup(rng, b, h, hkv, d, bs, mb, nb, max_cur, dtype=jnp.float32):
+    keys = jax.random.split(rng, 4)
+    q = jax.random.normal(keys[0], (b, h, d), dtype)
+    kp = jax.random.normal(keys[1], (nb, bs, hkv, d), dtype)
+    vp = jax.random.normal(keys[2], (nb, bs, hkv, d), dtype)
+    # each row gets a distinct random set of non-trash blocks for its
+    # live region; entries beyond are the trash sink (0), as the engine
+    # builds them
+    rng_np = np.random.default_rng(0)
+    cur = rng_np.integers(0, max_cur + 1, size=b).astype(np.int32)
+    tbl = np.zeros((b, mb), np.int32)
+    avail = list(range(1, nb))
+    for i in range(b):
+        used = cur[i] // bs + 1
+        picks = rng_np.choice(avail, size=used, replace=False)
+        for blk in picks:
+            avail.remove(blk)
+        tbl[i, :used] = picks
+    return q, kp, vp, jnp.asarray(tbl), jnp.asarray(cur)
+
+
+CASES = [
+    # b, h, hkv, d, bs, mb, window
+    pytest.param(3, 4, 4, 32, 8, 4, None, id="mha"),
+    pytest.param(3, 4, 2, 32, 8, 4, None, id="gqa"),
+    pytest.param(2, 4, 2, 32, 8, 4, 5, id="gqa-window"),
+    pytest.param(4, 2, 1, 16, 4, 8, None, id="many-pages"),
+    pytest.param(2, 4, 2, 32, 8, 4, 100, id="window-wider-than-history"),
+]
+
+
+@pytest.mark.parametrize("b,h,hkv,d,bs,mb,window", CASES)
+def test_kernel_matches_gather_reference(b, h, hkv, d, bs, mb, window):
+    nb = b * mb + 1
+    q, kp, vp, tbl, cur = _setup(jax.random.PRNGKey(1), b, h, hkv, d, bs, mb, nb, max_cur=mb * bs - 1)
+    out = paged_decode_attention(q, kp, vp, tbl, cur, sliding_window=window, interpret=True)
+    want = _reference(q, kp, vp, tbl, cur, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_zero_frontier_rows():
+    """cur=0 (a fresh or inactive slot): only position 0 attends —
+    never a NaN from an empty softmax."""
+    b, h, hkv, d, bs, mb = 2, 2, 2, 16, 4, 2
+    q, kp, vp, tbl, _ = _setup(jax.random.PRNGKey(2), b, h, hkv, d, bs, mb, b * mb + 1, max_cur=0)
+    cur = jnp.zeros((b,), jnp.int32)
+    out = paged_decode_attention(q, kp, vp, tbl, cur, interpret=True)
+    want = _reference(q, kp, vp, tbl, cur)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs():
+    b, h, hkv, d, bs, mb = 2, 4, 2, 32, 8, 3
+    q, kp, vp, tbl, cur = _setup(
+        jax.random.PRNGKey(3), b, h, hkv, d, bs, mb, b * mb + 1, max_cur=mb * bs - 1, dtype=jnp.bfloat16
+    )
+    out = paged_decode_attention(q, kp, vp, tbl, cur, interpret=True)
+    want = _reference(q, kp, vp, tbl, cur)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(want, np.float32), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_window_excludes_old_pages_exactly():
+    """A hand-checkable case: window 4 at cur=10 keeps positions 7..10
+    only — the kernel must match a dense softmax over exactly those."""
+    b, h, hkv, d, bs, mb = 1, 2, 2, 16, 4, 3
+    q, kp, vp, tbl, _ = _setup(jax.random.PRNGKey(4), b, h, hkv, d, bs, mb, b * mb + 1, max_cur=11)
+    cur = jnp.asarray([10], jnp.int32)
+    out = paged_decode_attention(q, kp, vp, tbl, cur, sliding_window=4, interpret=True)
+    k_all = kp[tbl].reshape(1, mb * bs, hkv, d)
+    v_all = vp[tbl].reshape(1, mb * bs, hkv, d)
+    sl = slice(7, 11)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), k_all[:, sl].astype(jnp.float32)) / np.sqrt(d)
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bhk,bkhd->bhd", p, v_all[:, sl].astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)

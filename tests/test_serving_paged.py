@@ -165,6 +165,54 @@ def test_busy_slots_then_drain_is_not_deadlock(tiny_llama):
         np.testing.assert_array_equal(eng.poll(b), _reference(tiny_llama, np.arange(5, 9), 3))
 
 
+def test_kernel_in_engine_matches_dense(tiny_llama):
+    """The exact composition TPU serving runs — ServingEngine paged tick
+    through the Pallas paged-attention kernel — in interpret mode on
+    CPU, token-exact vs the dense engine (tiny shapes: interpret mode
+    executes the grid in Python)."""
+    import accelerate_tpu.ops.paged_kv as pkv
+
+    prompts = [np.arange(1, 1 + n, dtype=np.int32) for n in (3, 6)]
+    dense = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,), tick_block=2)
+    want = dense.generate_many(prompts, max_new_tokens=3)
+    pkv.FORCE_KERNEL_INTERPRET = True
+    try:
+        eng = ServingEngine(
+            tiny_llama, num_slots=2, prompt_buckets=(8,), tick_block=2, paged_block_size=4
+        )
+        got = eng.generate_many(prompts, max_new_tokens=3)
+    finally:
+        pkv.FORCE_KERNEL_INTERPRET = False
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_kernel_in_engine_tp_sharded(tiny_llama):
+    """TP-sharded paged serving through the kernel: the pool is sharded
+    over `tensor` (heads), the kernel runs per-shard under shard_map
+    (a pallas_call can't be auto-partitioned), and tokens equal the
+    unsharded dense engine's."""
+    import jax
+
+    import accelerate_tpu.ops.paged_kv as pkv
+    from accelerate_tpu.big_modeling import shard_model
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    prompt = (np.arange(8) % 250).astype(np.int32)
+    dense = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,), tick_block=2)
+    [want] = dense.generate_many([prompt], max_new_tokens=3)
+
+    model = create_llama_model(LlamaConfig.tiny(), seq_len=16)
+    shard_model(model, MeshConfig(data=1, tensor=2).build(jax.devices()[:2]))
+    pkv.FORCE_KERNEL_INTERPRET = True
+    try:
+        eng = ServingEngine(model, num_slots=2, prompt_buckets=(8,), tick_block=2, paged_block_size=4)
+        [got] = eng.generate_many([prompt], max_new_tokens=3)
+    finally:
+        pkv.FORCE_KERNEL_INTERPRET = False
+    np.testing.assert_array_equal(got, want)
+
+
 def test_block_allocator():
     alloc = BlockAllocator(5)
     assert alloc.free_count == 4
