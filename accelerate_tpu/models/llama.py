@@ -46,9 +46,9 @@ class LlamaConfig:
     attention_impl: str = "auto"
     # Mistral-style sliding-window attention: each position attends to at
     # most the last `sliding_window` keys (itself included). None = full
-    # causal. Windowed models route to the dense XLA path (the band mask
-    # rules out the causal-only flash kernel and seq-sharded context
-    # parallelism for now).
+    # causal. Short sequences mask the band in XLA; flash-length TPU
+    # sequences run the banded flash kernel (O(S*W)). Seq-sharded context
+    # parallelism doesn't support the band yet.
     sliding_window: Optional[int] = None
     # weight-only quantized block projections (int8|int4|nf4): every
     # q/k/v/o/gate/up/down kernel becomes a QuantDense whose packed codes
@@ -163,9 +163,10 @@ def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None
     """Pick the attention path: context-parallel (ring / all-to-all) when
     the active mesh has a non-trivial ``seq`` axis, else dense/flash. This
     is where long-context becomes a *layout* decision rather than a model
-    rewrite (SURVEY §5). ``sliding_window`` adds a Mistral-style band
-    mask and pins the dense XLA path (the causal-only flash kernel and
-    the context-parallel schedules don't support the band yet)."""
+    rewrite (SURVEY §5). ``sliding_window`` adds a Mistral-style band:
+    the XLA mask at short lengths, the banded flash kernel (O(S*W)) at
+    flash lengths on TPU; the context-parallel schedules don't support
+    the band yet."""
     if impl not in ("auto", "ring", "all_to_all", "dense"):
         raise ValueError(f"attention_impl must be auto|ring|all_to_all|dense, got {impl!r}")
     mesh = None
@@ -188,10 +189,9 @@ def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None
             )
         from ..ops.attention import dot_product_attention
 
-        s = q.shape[1]
-        q_pos = jnp.arange(s)[:, None]
-        band = jnp.arange(s)[None, :] > q_pos - sliding_window  # keys newer than q-W
-        return dot_product_attention(q, k, v, mask=band[None, None], causal=True, mesh=mesh)
+        # the op folds the band into the XLA mask at short lengths and
+        # runs the banded flash kernel (O(S*W)) at flash lengths on TPU
+        return dot_product_attention(q, k, v, causal=True, mesh=mesh, window=sliding_window)
     if seq_ok:
         from ..parallel.context import context_parallel_attention
 

@@ -38,33 +38,57 @@ def dot_product_attention(
     dropout_rate: float = 0.0,
     dropout_rng=None,
     mesh=None,  # pin the mesh for the sharded pallas path (else read from state at trace time)
+    window: Optional[int] = None,  # Mistral band: keys <= q_pos - window are masked
 ) -> jax.Array:
     """Multi-head attention with optional GQA (H_kv divides H) and
     flash-kernel dispatch. Causal masking is bottom-right aligned when
     Sq != Sk (decode/chunked attention: query i attends keys
-    ``0..Sk-Sq+i``). Returns [B, Sq, H, D]."""
+    ``0..Sk-Sq+i``). ``window`` adds the sliding-window band (requires
+    ``causal``); on TPU at flash lengths it runs the banded kernel —
+    O(S*W) — else the band folds into the XLA mask. Returns
+    [B, Sq, H, D]."""
     head_dim = q.shape[-1]
     scale = scale if scale is not None else head_dim**-0.5
     seq_len = q.shape[1]
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window is a causal band)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1 (got {window}); a 0-width band masks everything")
 
+    explicit_flash = use_flash is not None
     if use_flash is None:
         use_flash = (
             jax.default_backend() == "tpu"
             and seq_len >= FLASH_MIN_SEQ
-            and mask is None  # kernel supports causal masking only
+            and mask is None  # kernel supports causal/banded masking only
             and dropout_rate == 0.0
         )
+    if use_flash and window is not None and jax.default_backend() != "tpu":
+        # the scan fallback has no band support: refuse an explicit
+        # request (consistent with the mask/dropout guards below); the
+        # auto path quietly takes the XLA band instead
+        if explicit_flash:
+            raise ValueError("banded flash (window=) runs on the TPU kernel only; drop use_flash=True off-TPU")
+        use_flash = False
     if use_flash:
         if mask is not None:
-            raise ValueError("flash attention supports causal masking only; pass mask=None or use_flash=False")
+            raise ValueError(
+                "flash attention supports causal (optionally banded via window=) masking only; "
+                "pass mask=None or use_flash=False"
+            )
         if dropout_rate > 0.0 and dropout_rng is not None:
             raise ValueError("flash attention does not support attention-prob dropout; use_flash=False")
         if jax.default_backend() == "tpu":
-            return sharded_pallas_attention(q, k, v, causal=causal, scale=scale, mesh=mesh)
+            return sharded_pallas_attention(q, k, v, causal=causal, scale=scale, mesh=mesh, window=window)
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)
 
+    if window is not None:
+        s = seq_len
+        q_pos = jnp.arange(s)[:, None] + (k.shape[1] - s)
+        band = (jnp.arange(k.shape[1])[None, :] > q_pos - window)[None, None]
+        mask = band if mask is None else (mask & band)
     return _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng, _softmax_dtype())
 
 
@@ -103,6 +127,7 @@ def sharded_pallas_attention(
     scale: Optional[float] = None,
     mesh=None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Pallas flash attention that stays partitioned under GSPMD.
 
@@ -119,7 +144,7 @@ def sharded_pallas_attention(
     from .pallas_attention import pallas_flash_attention
 
     kernel = functools.partial(
-        pallas_flash_attention, causal=causal, scale=scale, interpret=interpret
+        pallas_flash_attention, causal=causal, scale=scale, interpret=interpret, window=window
     )
     # Already inside a shard_map region (e.g. the GPipe trunk): inputs are
     # per-shard blocks and axes are Manual — nesting another shard_map over

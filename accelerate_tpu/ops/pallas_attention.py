@@ -56,15 +56,29 @@ def _scratch(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
-def _mask(sq, sk, q_start, k_start, block_q, block_k, causal):
+def _mask(sq, sk, q_start, k_start, block_q, block_k, causal, window=None):
     """Validity mask for one (Q block, K block) tile; positions beyond the
-    true lengths and (optionally) above the bottom-right diagonal are off."""
+    true lengths and (optionally) above the bottom-right diagonal are off.
+    ``window`` adds the Mistral band: keys older than ``window`` positions
+    below the (aligned) query are off too."""
     row = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     col = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     valid = (col < sk) & (row < sq)
     if causal:
         valid &= row + (sk - sq) >= col
+    if window is not None:
+        valid &= col > row + (sk - sq) - window
     return valid
+
+
+def _block_live(q_start, k_start, block_q, block_k, offset, causal, window):
+    """Whether any element of this (Q, K) tile can be unmasked: K blocks
+    strictly above the causal diagonal OR entirely below the band are
+    skipped (the band skip makes banded attention O(S*W), not O(S^2))."""
+    live = (q_start + block_q - 1 + offset >= k_start) if causal else True
+    if window is not None:
+        live &= k_start + block_k - 1 > q_start + offset - window
+    return live
 
 
 # ---------------------------------------------------------------------------
@@ -72,7 +86,7 @@ def _mask(sq, sk, q_start, k_start, block_q, block_k, causal):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, sq, sk, block_q, block_k, causal, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, sq, sk, block_q, block_k, causal, scale, window):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     offset = sk - sq  # bottom-right causal alignment (decode: sq < sk)
@@ -84,16 +98,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, s
         m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # skip KV blocks strictly above the causal diagonal for every row of
-    # this Q block: the highest query position is q_start+block_q-1+offset
-    run = (q_start + block_q - 1 + offset >= k_start) if causal else True
+    run = _block_live(q_start, k_start, block_q, block_k, offset, causal, window)
 
     @pl.when(run)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        valid = _mask(sq, sk, q_start, k_start, block_q, block_k, causal)
+        valid = _mask(sq, sk, q_start, k_start, block_q, block_k, causal, window)
         s = jnp.where(valid, s, -jnp.inf)
 
         m_prev = m_ref[:, :1]
@@ -118,7 +130,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, s
         lse_ref[0, 0] = jnp.broadcast_to(lse, (block_q, _STAT_LANES))
 
 
-def _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret):
+def _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret, window=None):
     """q [B,H,Sqp,D], k/v [B,Hkv,Skp,D], padded to block multiples; sq/sk
     are the true (unpadded) lengths. Returns out [B,H,Sqp,D] and the
     lane-replicated logsumexp [B,H,Sqp,_STAT_LANES]."""
@@ -128,7 +140,8 @@ def _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret):
     nq, nk = sqp // block_q, skp // block_k
 
     kernel = functools.partial(
-        _fwd_kernel, sq=sq, sk=sk, block_q=block_q, block_k=block_k, causal=causal, scale=scale
+        _fwd_kernel, sq=sq, sk=sk, block_q=block_q, block_k=block_k, causal=causal, scale=scale,
+        window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -161,7 +174,7 @@ def _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, sq, sk, block_q, block_k, causal, scale):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, sq, sk, block_q, block_k, causal, scale, window):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
     offset = sk - sq
@@ -171,14 +184,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (q_start + block_q - 1 + offset >= k_start) if causal else True
+    run = _block_live(q_start, k_start, block_q, block_k, offset, causal, window)
 
     @pl.when(run)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        valid = _mask(sq, sk, q_start, k_start, block_q, block_k, causal)
+        valid = _mask(sq, sk, q_start, k_start, block_q, block_k, causal, window)
         lse = lse_ref[0, 0][:, :1]
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
         p = jnp.where(valid & jnp.isfinite(lse), jnp.exp(s - lse_safe), 0.0)
@@ -194,7 +207,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
         dq_ref[0, 0] = dq_acc[:]
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, sq, sk, block_q, block_k, causal, scale):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, sq, sk, block_q, block_k, causal, scale, window):
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
     offset = sk - sq
@@ -205,14 +218,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = (q_start + block_q - 1 + offset >= k_start) if causal else True
+    run = _block_live(q_start, k_start, block_q, block_k, offset, causal, window)
 
     @pl.when(run)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        valid = _mask(sq, sk, q_start, k_start, block_q, block_k, causal)
+        valid = _mask(sq, sk, q_start, k_start, block_q, block_k, causal, window)
         lse = lse_ref[0, 0][:, :1]
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
         p = jnp.where(valid & jnp.isfinite(lse), jnp.exp(s - lse_safe), 0.0)
@@ -231,7 +244,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0, 0] = dv_acc[:]
 
 
-def _run_bwd(q, k, v, out, lse, do, sq, sk, causal, scale, block_q, block_k, interpret):
+def _run_bwd(q, k, v, out, lse, do, sq, sk, causal, scale, block_q, block_k, interpret, window=None):
     b, h, sqp, d = q.shape
     h_kv, skp = k.shape[1], k.shape[2]
     g = h // h_kv
@@ -242,7 +255,7 @@ def _run_bwd(q, k, v, out, lse, do, sq, sk, causal, scale, block_q, block_k, int
     delta = jnp.einsum("bhqd,bhqd->bhq", do.astype(jnp.float32), out.astype(jnp.float32))
     delta = jnp.broadcast_to(delta[..., None], (b, h, sqp, _STAT_LANES))
 
-    static = dict(sq=sq, sk=sk, block_q=block_q, block_k=block_k, causal=causal, scale=scale)
+    static = dict(sq=sq, sk=sk, block_q=block_q, block_k=block_k, causal=causal, scale=scale, window=window)
     q_spec = _vmem_spec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
     kv_spec = _vmem_spec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_ // g, ki, 0))
     row_spec = _vmem_spec((1, 1, block_q, _STAT_LANES), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
@@ -289,20 +302,20 @@ def _run_bwd(q, k, v, out, lse, do, sq, sk, causal, scale, block_q, block_k, int
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6))
-def _flash(causal, scale, block_q, block_k, interpret, sq, sk, q, k, v):
-    out, _ = _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _flash(causal, scale, block_q, block_k, interpret, sq, sk, window, q, k, v):
+    out, _ = _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret, window)
     return out
 
 
-def _flash_fwd(causal, scale, block_q, block_k, interpret, sq, sk, q, k, v):
-    out, lse = _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret)
+def _flash_fwd(causal, scale, block_q, block_k, interpret, sq, sk, window, q, k, v):
+    out, lse = _run_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k, interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, sq, sk, residuals, do):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, sq, sk, window, residuals, do):
     q, k, v, out, lse = residuals
-    dq, dk, dv = _run_bwd(q, k, v, out, lse, do, sq, sk, causal, scale, block_q, block_k, interpret)
+    dq, dk, dv = _run_bwd(q, k, v, out, lse, do, sq, sk, causal, scale, block_q, block_k, interpret, window)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -326,13 +339,20 @@ def pallas_flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on the Pallas TPU kernel. Same contract as
     :func:`accelerate_tpu.ops.flash_attention.flash_attention`: GQA when
     ``H_kv`` divides ``H``, bottom-right-aligned causal masking for
-    ``Sq != Sk``, output ``[B, Sq, H, D]`` in ``q.dtype``."""
+    ``Sq != Sk``, output ``[B, Sq, H, D]`` in ``q.dtype``. ``window``
+    (requires ``causal``) adds the Mistral sliding-window band and skips
+    K blocks entirely below it — O(S*W) work instead of O(S^2)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window is a causal band)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1 (got {window}); a 0-width band masks everything")
     sq, sk = q.shape[1], k.shape[1]
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     block_q = min(block_q, _pow2_ge(sq))
@@ -340,7 +360,7 @@ def pallas_flash_attention(
     qt = _pad_seq(q.transpose(0, 2, 1, 3), block_q)
     kt = _pad_seq(k.transpose(0, 2, 1, 3), block_k)
     vt = _pad_seq(v.transpose(0, 2, 1, 3), block_k)
-    out = _flash(causal, float(scale), block_q, block_k, interpret, sq, sk, qt, kt, vt)
+    out = _flash(causal, float(scale), block_q, block_k, interpret, sq, sk, window, qt, kt, vt)
     return out[:, :, :sq].transpose(0, 2, 1, 3)
 
 

@@ -76,6 +76,79 @@ def test_gradients_match_reference(b, sq, sk, h, h_kv, d, causal):
         )
 
 
+def _ref_banded(q, k, v, window):
+    """Banded reference: causal + Mistral band via the XLA mask path."""
+    sq, sk = q.shape[1], k.shape[1]
+    q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+    band = (jnp.arange(sk)[None, :] > q_pos - window)[None, None]
+    return dot_product_attention(q, k, v, mask=band, causal=True, use_flash=False)
+
+
+@pytest.mark.parametrize(
+    "b,s,h,h_kv,d,window",
+    [
+        pytest.param(2, 128, 2, 2, 32, 40, id="mha-band"),
+        pytest.param(1, 128, 4, 2, 32, 64, id="gqa-band-blockmult"),
+        pytest.param(1, 100, 2, 2, 32, 17, id="odd-seq-odd-band"),
+        pytest.param(1, 128, 2, 2, 32, 500, id="band-wider-than-seq"),
+        pytest.param(1, 128, 2, 2, 32, 1, id="self-only-band"),
+    ],
+)
+def test_banded_forward_matches_reference(b, s, h, h_kv, d, window):
+    q, k, v = _make_qkv(jax.random.PRNGKey(5), b, s, s, h, h_kv, d)
+    out = pallas_flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True, window=window)
+    want = _ref_banded(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_banded_decode_alignment_sq_lt_sk():
+    """Band + bottom-right alignment (chunked prefill / decode shapes):
+    the `sk - sq` offset threads through the band mask, the block skip,
+    and the XLA fold identically."""
+    q, k, v = _make_qkv(jax.random.PRNGKey(8), 1, 32, 128, 2, 2, 32)
+    out = pallas_flash_attention(q, k, v, causal=True, block_q=32, block_k=32, interpret=True, window=40)
+    want = _ref_banded(q, k, v, 40)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_banded_gradients_match_reference():
+    b, s, h, h_kv, d, window = 1, 128, 4, 2, 32, 40
+    q, k, v = _make_qkv(jax.random.PRNGKey(6), b, s, s, h, h_kv, d)
+
+    def loss_kernel(q, k, v):
+        out = pallas_flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=32, interpret=True, window=window
+        )
+        return (out**2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_banded(q, k, v, window) ** 2).sum()
+
+    grads = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    expected = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, e, name in zip(grads, expected, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(e), atol=2e-3, rtol=2e-3, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_banded_requires_causal():
+    q, k, v = _make_qkv(jax.random.PRNGKey(7), 1, 64, 64, 2, 2, 32)
+    with pytest.raises(ValueError, match="causal"):
+        pallas_flash_attention(q, k, v, causal=False, interpret=True, window=8)
+    from accelerate_tpu.ops.attention import dot_product_attention as dpa
+
+    with pytest.raises(ValueError, match="causal"):
+        dpa(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match=">= 1"):
+        dpa(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        pallas_flash_attention(q, k, v, causal=True, interpret=True, window=0)
+    # explicit flash + band off-TPU must refuse, not silently go quadratic
+    with pytest.raises(ValueError, match="TPU"):
+        dpa(q, k, v, causal=True, window=8, use_flash=True)
+
+
 def test_jit_and_scan_fallback_agree():
     """The jitted Pallas path and the lax.scan fallback agree bitwise-ish."""
     from accelerate_tpu.ops.flash_attention import flash_attention as scan_flash
