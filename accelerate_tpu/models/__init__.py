@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -35,6 +35,12 @@ from .mistral import (
     MistralConfig,
     MistralModel,
     create_mistral_model,
+)
+from .qwen2 import (
+    QWEN2_SHARDING_RULES,
+    Qwen2Config,
+    Qwen2Model,
+    create_qwen2_model,
 )
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
@@ -97,6 +103,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_llama,
     load_hf_mistral,
     load_hf_mixtral,
+    load_hf_qwen2,
     load_hf_t5,
     load_hf_vit,
     load_hf_clip,

@@ -142,6 +142,10 @@ _LLAMA_LAYER = {
     "self_attn.q_proj.weight": ("attn/q_proj/kernel", True),
     "self_attn.k_proj.weight": ("attn/k_proj/kernel", True),
     "self_attn.v_proj.weight": ("attn/v_proj/kernel", True),
+    # qkv biases (Qwen2); absent in llama/mistral checkpoints
+    "self_attn.q_proj.bias": ("attn/q_proj/bias", False),
+    "self_attn.k_proj.bias": ("attn/k_proj/bias", False),
+    "self_attn.v_proj.bias": ("attn/v_proj/bias", False),
     "self_attn.o_proj.weight": ("attn/o_proj/kernel", True),
     "mlp.gate_proj.weight": ("mlp/gate_proj/kernel", True),
     "mlp.up_proj.weight": ("mlp/up_proj/kernel", True),
@@ -207,13 +211,30 @@ def convert_hf_llama_state(
                 converted = _rope_interleave_permute(converted, converted.shape[1] // num_heads)
             elif rest == "self_attn.k_proj.weight":
                 converted = _rope_interleave_permute(converted, converted.shape[1] // num_kv_heads)
+            elif rest == "self_attn.q_proj.bias":
+                # biases rotate with their output channels: same re-pairing
+                converted = _rope_interleave_permute(converted[None], len(converted) // num_heads)[0]
+            elif rest == "self_attn.k_proj.bias":
+                converted = _rope_interleave_permute(converted[None], len(converted) // num_kv_heads)[0]
             per_layer.setdefault(idx, {})[ours] = converted
     if not per_layer:
         return tree
     n_layers = max(per_layer) + 1
+    # fail loudly on partial checkpoints (e.g. one shard of a sharded
+    # save): the core weight families must be present in every layer —
+    # a silent skip here would return a model with random kernels
+    required = {ours for ours, _ in _LLAMA_LAYER.values() if not ours.endswith("/bias")}
+    for i in range(n_layers):
+        missing = required - set(per_layer.get(i, {}))
+        if missing:
+            raise ValueError(
+                f"layer {i} is missing {sorted(missing)} — partial checkpoint? "
+                "pass the checkpoint directory (or its index), not a single shard"
+            )
     if scan_layers:
-        for ours in _LLAMA_LAYER.values():
-            name = ours[0]
+        # stack only params the checkpoint actually has (biases are
+        # family-dependent)
+        for name in per_layer[0]:
             stacked = np.stack([per_layer[i][name] for i in range(n_layers)])
             _set(tree, f"layers/block/{name}", stacked)
     else:
@@ -237,6 +258,25 @@ def load_hf_llama(checkpoint_path: str, config=None):
         num_kv_heads=config.num_key_value_heads,
     )
     model = create_llama_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+def load_hf_qwen2(checkpoint_path: str, config=None):
+    """HF Qwen2/Qwen2.5 checkpoints are llama-layout plus q/k/v bias
+    vectors (re-paired for the rope convention like their kernels);
+    small variants tie lm_head to the embeddings (importer fallback)."""
+    from .qwen2 import Qwen2Config, create_qwen2_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Qwen2Config.qwen2_7b()
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+    )
+    model = create_qwen2_model(config)
     _merge_into(model, tree)
     return model
 

@@ -50,6 +50,9 @@ class LlamaConfig:
     # sequences run the banded flash kernel (O(S*W)). Seq-sharded context
     # parallelism doesn't support the band yet.
     sliding_window: Optional[int] = None
+    # Qwen2-style bias on the q/k/v projections only (o_proj stays
+    # bias-free); importer re-pairs q/k biases for the rope convention
+    qkv_bias: bool = False
     # weight-only quantized block projections (int8|int4|nf4): every
     # q/k/v/o/gate/up/down kernel becomes a QuantDense whose packed codes
     # are the params — the decode-bandwidth win (set via
@@ -82,12 +85,14 @@ LLAMA_SHARDING_RULES = [
     (r"embed_tokens/embedding", P("tensor", None)),
     # stacked (scan) variants: [L, in, out]-shaped kernels
     (r"layers/block/attn/(q|k|v)_proj/kernel", P(None, None, "tensor")),
+    (r"layers/block/attn/(q|k|v)_proj/bias", P(None, "tensor")),
     (r"layers/block/attn/o_proj/kernel", P(None, "tensor", None)),
     (r"layers/block/mlp/(gate|up)_proj/kernel", P(None, None, "tensor")),
     (r"layers/block/mlp/down_proj/kernel", P(None, "tensor", None)),
     (r"lm_head/kernel", P(None, "tensor")),
     # unstacked variants (scan_layers=False): [in, out]-shaped kernels
     (r"layer_\d+/attn/(q|k|v)_proj/kernel", P(None, "tensor")),
+    (r"layer_\d+/attn/(q|k|v)_proj/bias", P("tensor")),
     (r"layer_\d+/attn/o_proj/kernel", P("tensor", None)),
     (r"layer_\d+/mlp/(gate|up)_proj/kernel", P(None, "tensor")),
     (r"layer_\d+/mlp/down_proj/kernel", P("tensor", None)),
@@ -110,7 +115,7 @@ LLAMA_SHARDING_RULES += [
 ACTIVATION_SPEC = P(("data", "fsdp"), "seq", None)
 
 
-def _dense(cfg: "LlamaConfig", features: int, name: str, dtype):
+def _dense(cfg: "LlamaConfig", features: int, name: str, dtype, use_bias: bool = False):
     """Block projection factory: plain Dense, QuantDense when the config
     carries a weight-only quantization method, or FP8Dense when the active
     precision policy requests the delayed-scaling fp8 recipe (amax
@@ -119,12 +124,15 @@ def _dense(cfg: "LlamaConfig", features: int, name: str, dtype):
         from ..ops.qdense import QuantDense
 
         return QuantDense(
-            features, method=cfg.quant_method, group_size=cfg.quant_group_size, dtype=dtype, name=name
+            features, method=cfg.quant_method, group_size=cfg.quant_group_size, dtype=dtype,
+            name=name, use_bias=use_bias,
         )
     from ..ops.fp8 import FP8Dense, fp8_recipe
 
     recipe = fp8_recipe()
     if recipe is not None and recipe.delayed_scaling:
+        if use_bias:
+            raise NotImplementedError("FP8Dense (delayed scaling) has no bias; qkv_bias models need the bf16 path")
         return FP8Dense(
             features,
             name=name,
@@ -133,7 +141,7 @@ def _dense(cfg: "LlamaConfig", features: int, name: str, dtype):
             amax_compute_algo=recipe.amax_compute_algo,
             margin=recipe.margin,
         )
-    return nn.Dense(features, use_bias=False, name=name, dtype=dtype, dot_general=_pdg())
+    return nn.Dense(features, use_bias=use_bias, name=name, dtype=dtype, dot_general=_pdg())
 
 
 class RMSNorm(nn.Module):
@@ -209,9 +217,9 @@ class LlamaAttention(nn.Module):
     def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
-        q = _dense(cfg, cfg.num_attention_heads * head_dim, "q_proj", hidden.dtype)(hidden)
-        k = _dense(cfg, cfg.num_key_value_heads * head_dim, "k_proj", hidden.dtype)(hidden)
-        v = _dense(cfg, cfg.num_key_value_heads * head_dim, "v_proj", hidden.dtype)(hidden)
+        q = _dense(cfg, cfg.num_attention_heads * head_dim, "q_proj", hidden.dtype, cfg.qkv_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * head_dim, "k_proj", hidden.dtype, cfg.qkv_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * head_dim, "v_proj", hidden.dtype, cfg.qkv_bias)(hidden)
         q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
         k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)

@@ -1,0 +1,68 @@
+"""Qwen2: the llama architecture + biased q/k/v projections.
+
+Qwen2/Qwen2.5 decoders are structurally llama (RMSNorm pre-norm,
+rotary, GQA, SwiGLU) with bias vectors on the q/k/v projections only
+(``LlamaConfig.qkv_bias``) and their own widths/theta; small variants
+tie the LM head to the embeddings (the importer's existing fallback).
+Sliding-window attention exists in the family but ships disabled
+(``use_sliding_window=False``) — pass ``sliding_window=`` explicitly to
+enable the band, which then rides the same dense/banded-flash/paged
+paths as Mistral.
+
+Like :mod:`.mistral`, the module/sharding/loss surfaces are the llama
+ones; only the config and the checkpoint importer differ. The reference
+has no in-tree models (SURVEY §2.2); importer parity is tested against
+``transformers.Qwen2ForCausalLM`` in tests/test_hf_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+QWEN2_SHARDING_RULES = LLAMA_SHARDING_RULES
+Qwen2Model = LlamaModel
+
+
+@dataclasses.dataclass
+class Qwen2Config(LlamaConfig):
+    """Llama config with Qwen2-7B defaults (qkv bias on, window off)."""
+
+    vocab_size: int = 152064
+    hidden_size: int = 3584
+    intermediate_size: int = 18944
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 28
+    num_key_value_heads: int = 4
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    qkv_bias: bool = True
+
+    @classmethod
+    def tiny(cls, **kw) -> "Qwen2Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    @classmethod
+    def qwen2_7b(cls, **kw) -> "Qwen2Config":
+        return cls(**kw)
+
+
+def create_qwen2_model(config: Optional[Qwen2Config] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with Qwen2's biased q/k/v projections."""
+    return create_llama_model(config or Qwen2Config.tiny(), seed=seed, seq_len=seq_len)

@@ -63,13 +63,11 @@ def dot_product_attention(
             and mask is None  # kernel supports causal/banded masking only
             and dropout_rate == 0.0
         )
-    if use_flash and window is not None and jax.default_backend() != "tpu":
-        # the scan fallback has no band support: refuse an explicit
-        # request (consistent with the mask/dropout guards below); the
-        # auto path quietly takes the XLA band instead
-        if explicit_flash:
-            raise ValueError("banded flash (window=) runs on the TPU kernel only; drop use_flash=True off-TPU")
-        use_flash = False
+    if explicit_flash and use_flash and window is not None and jax.default_backend() != "tpu":
+        # the scan fallback has no band support: refuse the explicit
+        # request (consistent with the mask/dropout guards below). The
+        # auto path never picks flash off-TPU, so it needs no fallback.
+        raise ValueError("banded flash (window=) runs on the TPU kernel only; drop use_flash=True off-TPU")
     if use_flash:
         if mask is not None:
             raise ValueError(

@@ -262,6 +262,71 @@ def test_mistral_import_matches_transformers(tmp_path):
     np.testing.assert_allclose(got, want, atol=TOL)
 
 
+def test_qwen2_import_matches_transformers(tmp_path):
+    """Qwen2 = llama + q/k/v bias vectors; the biases rotate with their
+    output channels, so a missed rope re-pairing on the BIAS (not just
+    the kernel) breaks element-wise parity."""
+    import jax
+
+    from accelerate_tpu.models import Qwen2Config
+    from accelerate_tpu.models.hub import load_hf_qwen2
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        scan_layers=False, remat=False,
+    )
+    model = load_hf_qwen2(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_qwen2_import_scan_layers_and_tied_head(tmp_path):
+    """Scan-stacked import (biases stack along the layer dim) with a tied
+    LM head (the small-variant config)."""
+    import jax
+
+    from accelerate_tpu.models import Qwen2Config
+    from accelerate_tpu.models.hub import load_hf_qwen2
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(1)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (1, 12))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        scan_layers=True, remat=False,
+    )
+    model = load_hf_qwen2(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
 def test_mixtral_import_matches_transformers(tmp_path):
     """MoE family parity: with generous expert capacity (no token drops)
     our GShard-style dispatch computes exactly HF's top-2 renormalized
