@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/gemma/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -35,6 +35,12 @@ from .mistral import (
     MistralConfig,
     MistralModel,
     create_mistral_model,
+)
+from .gemma import (
+    GEMMA_SHARDING_RULES,
+    GemmaConfig,
+    GemmaModel,
+    create_gemma_model,
 )
 from .qwen2 import (
     QWEN2_SHARDING_RULES,
@@ -98,6 +104,7 @@ from .vae import (
 )
 from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_bert,
+    load_hf_gemma,
     load_hf_gpt2,
     load_hf_gptneox,
     load_hf_llama,

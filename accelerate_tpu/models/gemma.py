@@ -1,0 +1,87 @@
+"""Gemma: the llama skeleton with Google's four deviations.
+
+Gemma decoders differ from llama in exactly the knobs
+:class:`~accelerate_tpu.models.llama.LlamaConfig` now carries:
+
+* an explicit ``head_dim`` (256) decoupled from ``hidden / heads`` —
+  gemma-2b even runs MQA (1 KV head, 8 query heads);
+* GeGLU MLP (tanh-approximated gelu on the gate, ``mlp_activation``);
+* RMSNorm stores a zero-centred OFFSET applied as ``1 + scale``
+  (``norm_plus_one``) — checkpoints import verbatim;
+* embeddings multiplied by ``sqrt(hidden)`` (``scale_embeddings``), and
+  the LM head is ALWAYS tied to the embedding table
+  (``tie_word_embeddings`` — true weight sharing, not a copy).
+
+The HF state-dict layout is the llama one, so the importer reuses
+``convert_hf_llama_state`` — the rope re-pairing derives the head width
+from the projection shapes, so the explicit head_dim needs no special
+handling. Parity vs ``transformers.GemmaForCausalLM`` in
+tests/test_hf_parity.py. The reference has no in-tree models
+(SURVEY §2.2); this family is zoo surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+GEMMA_SHARDING_RULES = LLAMA_SHARDING_RULES
+GemmaModel = LlamaModel
+
+
+@dataclasses.dataclass
+class GemmaConfig(LlamaConfig):
+    """Llama config with gemma-2b defaults (MQA, head_dim 256, GeGLU,
+    (1+scale) norms, scaled embeddings)."""
+
+    vocab_size: int = 256000
+    hidden_size: int = 2048
+    intermediate_size: int = 16384
+    num_hidden_layers: int = 18
+    num_attention_heads: int = 8
+    num_key_value_heads: int = 1
+    head_dim: Optional[int] = 256
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    mlp_activation: str = "gelu_tanh"
+    norm_plus_one: bool = True
+    scale_embeddings: bool = True
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def tiny(cls, **kw) -> "GemmaConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 1)  # MQA like gemma-2b
+        kw.setdefault("head_dim", 32)  # != hidden/heads on purpose
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    @classmethod
+    def gemma_2b(cls, **kw) -> "GemmaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def gemma_7b(cls, **kw) -> "GemmaConfig":
+        kw.setdefault("hidden_size", 3072)
+        kw.setdefault("intermediate_size", 24576)
+        kw.setdefault("num_hidden_layers", 28)
+        kw.setdefault("num_attention_heads", 16)
+        kw.setdefault("num_key_value_heads", 16)
+        return cls(**kw)
+
+
+def create_gemma_model(config: Optional[GemmaConfig] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with Gemma's head width, GeGLU, (1+scale) norms and scaled embeddings."""
+    return create_llama_model(config or GemmaConfig.tiny(), seed=seed, seq_len=seq_len)

@@ -262,6 +262,26 @@ def load_hf_llama(checkpoint_path: str, config=None):
     return model
 
 
+def load_hf_gemma(checkpoint_path: str, config=None):
+    """HF Gemma checkpoints are llama-layout (the rope re-pairing derives
+    head width from the projection shapes, covering the explicit
+    head_dim); the LM head is always tied (importer fallback) and the
+    (1+scale) norm offsets import verbatim."""
+    from .gemma import GemmaConfig, create_gemma_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or GemmaConfig.gemma_2b()
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+    )
+    model = create_gemma_model(config)
+    _merge_into(model, tree)
+    return model
+
+
 def load_hf_qwen2(checkpoint_path: str, config=None):
     """HF Qwen2/Qwen2.5 checkpoints are llama-layout plus q/k/v bias
     vectors (re-paired for the rope convention like their kernels);

@@ -53,6 +53,17 @@ class LlamaConfig:
     # Qwen2-style bias on the q/k/v projections only (o_proj stays
     # bias-free); importer re-pairs q/k biases for the rope convention
     qkv_bias: bool = False
+    # Gemma-family knobs: an explicit per-head width (None = hidden/heads),
+    # the MLP gate activation, RMSNorm's (1 + scale) variant, and the
+    # sqrt(hidden) embedding multiplier
+    head_dim: Optional[int] = None
+    mlp_activation: str = "silu"  # silu | gelu_tanh
+    norm_plus_one: bool = False
+    scale_embeddings: bool = False
+    # share the embedding table with the LM head (Gemma always; small
+    # Qwen2 variants): no separate lm_head param exists, so fine-tuning
+    # cannot drift the two apart and the 256k-vocab table isn't duplicated
+    tie_word_embeddings: bool = False
     # weight-only quantized block projections (int8|int4|nf4): every
     # q/k/v/o/gate/up/down kernel becomes a QuantDense whose packed codes
     # are the params — the decode-bandwidth win (set via
@@ -146,14 +157,24 @@ def _dense(cfg: "LlamaConfig", features: int, name: str, dtype, use_bias: bool =
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    # Gemma convention: zero-initialised param applied as (1 + scale) —
+    # checkpoints store the OFFSET, so the importer maps weights verbatim
+    plus_one: bool = False
 
     @nn.compact
     def __call__(self, x):
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        init = nn.initializers.zeros if self.plus_one else nn.initializers.ones
+        scale = self.param("scale", init, (x.shape[-1],))
         var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-        # norm math in fp32, output back in the residual-stream dtype (the
-        # scale param may be fp32 under the autocast keep-list)
-        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale.astype(x.dtype)
+        if self.plus_one:
+            # Gemma keeps normalize AND (1 + scale) in fp32, casting only
+            # the result — matching HF's rounding so bf16 runs agree
+            out = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps) * (1.0 + scale.astype(jnp.float32))
+            return out.astype(x.dtype)
+        # llama convention: cast the normalized stream first, multiply in
+        # the stream dtype (HF LlamaRMSNorm order)
+        normed = (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
+        return normed * scale.astype(x.dtype)
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -216,7 +237,7 @@ class LlamaAttention(nn.Module):
     @nn.compact
     def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
-        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        head_dim = cfg.head_dim or cfg.hidden_size // cfg.num_attention_heads
         q = _dense(cfg, cfg.num_attention_heads * head_dim, "q_proj", hidden.dtype, cfg.qkv_bias)(hidden)
         k = _dense(cfg, cfg.num_key_value_heads * head_dim, "k_proj", hidden.dtype, cfg.qkv_bias)(hidden)
         v = _dense(cfg, cfg.num_key_value_heads * head_dim, "v_proj", hidden.dtype, cfg.qkv_bias)(hidden)
@@ -251,7 +272,13 @@ class LlamaMLP(nn.Module):
         cfg = self.config
         gate = _dense(cfg, cfg.intermediate_size, "gate_proj", hidden.dtype)(hidden)
         up = _dense(cfg, cfg.intermediate_size, "up_proj", hidden.dtype)(hidden)
-        return _dense(cfg, cfg.hidden_size, "down_proj", hidden.dtype)(nn.silu(gate) * up)
+        if cfg.mlp_activation == "silu":
+            act = nn.silu(gate)
+        elif cfg.mlp_activation == "gelu_tanh":
+            act = nn.gelu(gate, approximate=True)
+        else:
+            raise ValueError(f"mlp_activation must be silu|gelu_tanh, got {cfg.mlp_activation!r}")
+        return _dense(cfg, cfg.hidden_size, "down_proj", hidden.dtype)(act * up)
 
 
 class LlamaLayer(nn.Module):
@@ -261,10 +288,10 @@ class LlamaLayer(nn.Module):
     def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
         hidden = hidden + LlamaAttention(cfg, name="attn")(
-            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions, decode
+            RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="input_norm")(hidden), positions, decode
         )
         hidden = hidden + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden)
+            RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="post_attn_norm")(hidden)
         )
         return hidden
 
@@ -285,7 +312,13 @@ class LlamaModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, positions=None, decode: bool = False):
         cfg = self.config
-        hidden = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")(input_ids)
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens")
+        hidden = embed(input_ids)
+        if cfg.scale_embeddings:
+            # Gemma multiplies embeddings by sqrt(hidden); the constant is
+            # cast to the stream dtype FIRST (HF casts to bf16 there, and
+            # matching the rounding keeps fp32 parity tests exact)
+            hidden = hidden * jnp.asarray(cfg.hidden_size**0.5, hidden.dtype)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[-1]), input_ids.shape)
         # constrain activations onto the mesh (seq axis = Megatron-SP)
@@ -308,7 +341,12 @@ class LlamaModel(nn.Module):
             layer_cls = nn.remat(LlamaLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else LlamaLayer
             for i in range(cfg.num_hidden_layers):
                 hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, positions, decode)
-        hidden = RMSNorm(cfg.rms_norm_eps, name="final_norm")(hidden)
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="final_norm")(hidden)
+        if cfg.tie_word_embeddings:
+            # true weight tying: reuse the embedding table (no lm_head
+            # param at all), matching HF tied-head semantics under
+            # fine-tuning and halving the head+table HBM
+            return hidden.astype(jnp.float32) @ embed.embedding.astype(jnp.float32).T
         return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
 
 

@@ -319,9 +319,43 @@ def test_qwen2_import_scan_layers_and_tied_head(tmp_path):
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
-        scan_layers=True, remat=False,
+        scan_layers=True, remat=False, tie_word_embeddings=True,
     )
     model = load_hf_qwen2(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_gemma_import_matches_transformers(tmp_path):
+    """Gemma = llama skeleton + explicit head_dim (!= hidden/heads here,
+    on purpose) + MQA + GeGLU + (1+scale) norms + sqrt(hidden) embedding
+    scaling + always-tied LM head — each deviation breaks element-wise
+    parity on its own if mis-imported."""
+    import jax
+
+    from accelerate_tpu.models import GemmaConfig
+    from accelerate_tpu.models.hub import load_hf_gemma
+
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, scan_layers=False, remat=False,
+    )
+    model = load_hf_gemma(_save(hf, tmp_path), cfg)
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
     np.testing.assert_allclose(got, want, atol=TOL)
