@@ -68,9 +68,13 @@ def active_paged_config() -> Optional[PagedConfig]:
 def paged_mode(cfg: PagedConfig):
     """Trace-time switch: while active, ``cached_attention`` declares and
     updates the paged cache layout instead of dense ``[B, max_len]``
-    buffers. Only the *tracing* of a program needs the context (the
-    serving engine compiles its paged programs eagerly inside it);
-    replaying compiled programs does not."""
+    buffers. Only the *tracing* of a program needs the context — the
+    serving engine re-enters it around every (lazily jitted) tick call,
+    which is free on cache hits and lets jit re-trace when GSPMD
+    propagates new shardings onto the pool. Do NOT eagerly
+    ``.lower().compile()`` under this context: that pins the input
+    shardings seen at construction and rejects the runtime arrays on
+    data-sharded meshes (see tests/test_serving_paged.py)."""
     global _ACTIVE
     prev, _ACTIVE = _ACTIVE, cfg
     try:

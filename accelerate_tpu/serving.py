@@ -312,14 +312,20 @@ class ServingEngine:
 
             from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row
 
-            zi = jnp.zeros((num_slots,), jnp.int32)
-            with paged_mode(self._pcfg), self._trace_ctx():
-                # compile eagerly: only TRACING needs the paged context
-                self._decode_tick = (
-                    jax.jit(make_tick(paged_step))
-                    .lower(params, self.slot_caches, zi, zi, self._slot_keys)
-                    .compile()
-                )
+            # Lazy jit wrapped in BOTH trace contexts (paged layout +
+            # model mesh), re-entered every call: contexts only matter at
+            # trace time, and lazy tracing lets jit adapt to whatever
+            # input shardings GSPMD propagates onto the pool between
+            # pastes — an eagerly .lower()ed program would pin the
+            # shardings it saw at construction and reject the real ones.
+            tick = jax.jit(make_tick(paged_step))
+            pcfg = self._pcfg
+
+            def decode_tick(*args):
+                with paged_mode(pcfg), self._trace_ctx():
+                    return tick(*args)
+
+            self._decode_tick = decode_tick
             self._paste = ctx_jit(paste_row)
             self._paste_blocks = ctx_jit(paste_blocks)
             self._clear_slot = ctx_jit(clear_slot)

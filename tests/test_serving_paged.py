@@ -213,6 +213,28 @@ def test_kernel_in_engine_tp_sharded(tiny_llama):
     np.testing.assert_array_equal(got, want)
 
 
+def test_paged_engine_on_data_sharded_mesh(tiny_llama):
+    """A mesh with data > 1: GSPMD propagates shardings onto the pool
+    between pastes, so the tick must adapt instead of pinning the
+    shardings it saw at construction (regression: the eagerly-compiled
+    tick rejected the runtime arrays with a sharding mismatch)."""
+    import jax
+
+    from accelerate_tpu.big_modeling import shard_model
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    prompts = [np.arange(1, 1 + n, dtype=np.int32) for n in (3, 6, 9)]
+    dense = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8, 16), tick_block=2)
+    want = dense.generate_many(prompts, max_new_tokens=3)
+
+    model = create_llama_model(LlamaConfig.tiny(), seq_len=16)
+    shard_model(model, MeshConfig(data=2, tensor=2).build(jax.devices()[:4]))
+    eng = ServingEngine(model, num_slots=2, prompt_buckets=(8, 16), tick_block=2, paged_block_size=4)
+    got = eng.generate_many(prompts, max_new_tokens=3)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+
+
 def test_block_allocator():
     alloc = BlockAllocator(5)
     assert alloc.free_count == 4
