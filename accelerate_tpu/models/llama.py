@@ -47,8 +47,8 @@ class LlamaConfig:
     # Mistral-style sliding-window attention: each position attends to at
     # most the last `sliding_window` keys (itself included). None = full
     # causal. Short sequences mask the band in XLA; flash-length TPU
-    # sequences run the banded flash kernel (O(S*W)). Seq-sharded context
-    # parallelism doesn't support the band yet.
+    # sequences run the banded flash kernel (O(S*W)); seq-sharded meshes
+    # apply the band inside ring / all-to-all context parallelism.
     sliding_window: Optional[int] = None
     # Qwen2-style bias on the q/k/v projections only (o_proj stays
     # bias-free); importer re-pairs q/k biases for the rope convention
@@ -192,10 +192,10 @@ def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None
     """Pick the attention path: context-parallel (ring / all-to-all) when
     the active mesh has a non-trivial ``seq`` axis, else dense/flash. This
     is where long-context becomes a *layout* decision rather than a model
-    rewrite (SURVEY §5). ``sliding_window`` adds a Mistral-style band:
-    the XLA mask at short lengths, the banded flash kernel (O(S*W)) at
-    flash lengths on TPU; the context-parallel schedules don't support
-    the band yet."""
+    rewrite (SURVEY §5). ``sliding_window`` adds a Mistral-style band on
+    EVERY path: the XLA mask at short lengths, the banded flash kernel
+    (O(S*W)) at flash lengths on TPU, and absolute-position masking
+    inside the ring / all-to-all schedules on seq-sharded meshes."""
     if impl not in ("auto", "ring", "all_to_all", "dense"):
         raise ValueError(f"attention_impl must be auto|ring|all_to_all|dense, got {impl!r}")
     mesh = None
@@ -210,25 +210,18 @@ def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None
             f"attention_impl={impl!r} requires an active mesh with a seq axis > 1 "
             f"(got {dict(mesh.shape) if mesh is not None else None}); use 'auto' for adaptive dispatch"
         )
-    if sliding_window is not None:
-        if impl in ("ring", "all_to_all") or seq_ok:
-            raise NotImplementedError(
-                "sliding-window attention does not compose with seq-axis context "
-                "parallelism yet; run windowed models without a seq mesh axis"
-            )
-        from ..ops.attention import dot_product_attention
-
-        # the op folds the band into the XLA mask at short lengths and
-        # runs the banded flash kernel (O(S*W)) at flash lengths on TPU
-        return dot_product_attention(q, k, v, causal=True, mesh=mesh, window=sliding_window)
     if seq_ok:
         from ..parallel.context import context_parallel_attention
 
         method = "all_to_all" if impl == "all_to_all" else "ring"
-        return context_parallel_attention(q, k, v, mesh=mesh, causal=True, method=method)
+        return context_parallel_attention(
+            q, k, v, mesh=mesh, causal=True, method=method, window=sliding_window
+        )
     from ..ops.attention import dot_product_attention
 
-    return dot_product_attention(q, k, v, causal=True, mesh=mesh)
+    # the op folds the band (if any) into the XLA mask at short lengths
+    # and runs the banded flash kernel (O(S*W)) at flash lengths on TPU
+    return dot_product_attention(q, k, v, causal=True, mesh=mesh, window=sliding_window)
 
 
 class LlamaAttention(nn.Module):

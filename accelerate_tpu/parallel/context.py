@@ -32,16 +32,25 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _block_update(qf, k_blk, v_blk, acc, m, l, q_pos, k_pos, causal: bool):
+def _block_update(qf, k_blk, v_blk, acc, m, l, q_pos, k_pos, causal: bool, window=None):
     """One online-softmax accumulation step (the flash-attention merge).
 
     qf: [B,Sq,Hkv,G,D] pre-scaled queries; k_blk/v_blk: [B,Sk,Hkv,D];
     acc: [B,Sq,Hkv,G,D] fp32; m/l: [B,Hkv,G,Sq] fp32 running max/normaliser;
-    q_pos/k_pos: absolute positions for causal masking.
+    q_pos/k_pos: absolute positions for causal masking; ``window`` adds
+    the sliding-window band (keys older than ``window`` below the query
+    are off) — positions are absolute, so the band composes with the
+    ring rotation for free.
     """
-    s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk).astype(jnp.float32)
-    if causal:
+    # precision="highest": fp32 operands would otherwise decompose to
+    # bf16 MXU passes at DEFAULT precision (~1e-3 relative error in the
+    # logits — same rationale as _xla_attention); bf16 operands are a
+    # single pass either way, so training speed is unaffected
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk, precision="highest").astype(jnp.float32)
+    if causal or window is not None:
         valid = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk_blk]
+        if window is not None:
+            valid &= k_pos[None, :] > q_pos[:, None] - window
         s = jnp.where(valid[None, None, None], s, -jnp.inf)
     m_blk = s.max(axis=-1)
     m_new = jnp.maximum(m, m_blk)
@@ -50,12 +59,12 @@ def _block_update(qf, k_blk, v_blk, acc, m, l, q_pos, k_pos, causal: bool):
     p = jnp.where(jnp.isfinite(s), p, 0.0)
     correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
     l_new = l * correction + p.sum(axis=-1)
-    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_blk.dtype), v_blk).astype(jnp.float32)
+    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_blk.dtype), v_blk, precision="highest").astype(jnp.float32)
     acc_new = acc * correction.transpose(0, 3, 1, 2)[..., None] + pv
     return acc_new, m_new, l_new
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optional[float]):
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optional[float], window=None):
     """Per-shard body (runs under shard_map). q/k/v: [B, S_loc, H(.kv), D]
     contiguous sequence blocks; block i of the ring lives on mesh position i
     of ``axis_name``."""
@@ -76,7 +85,10 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optional
         # at step t this device holds the KV block originating on (my_idx - t)
         src = (my_idx - t) % n
         k_pos = src * s_loc + jnp.arange(s_loc)
-        acc, m, l = _block_update(qf, k_blk, v_blk, acc, m, l, q_pos, k_pos, causal)
+        # fully-masked blocks (above the diagonal / below the band) are
+        # masked, not skipped — every ring step computes, like the
+        # full-causal schedule; a cond-skip is a future FLOP optimisation
+        acc, m, l = _block_update(qf, k_blk, v_blk, acc, m, l, q_pos, k_pos, causal, window)
         # rotate AFTER computing so the last step needs no extra hop
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -93,23 +105,24 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optional
     return out.reshape(b, s_loc, h, d).astype(q.dtype)
 
 
-def _ulysses_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optional[float]):
+def _ulysses_attention_local(q, k, v, axis_name: str, causal: bool, scale: Optional[float], window=None):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style): re-shard
     seq->heads, run full-sequence local attention on 1/n of the heads,
-    re-shard back. Requires n | H_kv."""
+    re-shard back. Requires n | H_kv. The band (``window``) applies in
+    the full-sequence local attention."""
     from ..ops.attention import dot_product_attention
 
     # [B, S/n, H, D] -> all_to_all over head dim -> [B, S, H/n, D]
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = dot_product_attention(q, k, v, causal=causal, scale=scale, use_flash=False)
+    out = dot_product_attention(q, k, v, causal=causal, scale=scale, use_flash=False, window=window)
     # back: [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis_name", "causal", "scale", "method", "batch_axis")
+    jax.jit, static_argnames=("mesh", "axis_name", "causal", "scale", "method", "batch_axis", "window")
 )
 def context_parallel_attention(
     q: jax.Array,  # [B, S, H, D] global view, S sharded over `axis_name`
@@ -121,16 +134,21 @@ def context_parallel_attention(
     scale: Optional[float] = None,
     method: str = "ring",  # "ring" | "all_to_all"
     batch_axis=("data", "fsdp"),  # axis name or tuple of names for the batch dim
+    window: Optional[int] = None,  # Mistral band over absolute positions
 ) -> jax.Array:
     """Sequence-parallel attention entry point. Takes/returns the *global*
     [B, S, H, D] arrays; S is laid out over the mesh ``axis_name`` (and B
     over ``batch_axis`` when that axis exists), and the per-shard body only
     ever touches S/n positions at once."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window is a causal band)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     axis_size = mesh.shape[axis_name]
     if axis_size == 1:
         from ..ops.attention import dot_product_attention
 
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return dot_product_attention(q, k, v, causal=causal, scale=scale, window=window)
     if q.shape[1] % axis_size != 0:
         raise ValueError(f"sequence length {q.shape[1]} must divide over {axis_name}={axis_size}")
     if method == "all_to_all" and k.shape[-2] % axis_size != 0:
@@ -141,7 +159,7 @@ def context_parallel_attention(
     local = _ring_attention_local if method == "ring" else _ulysses_attention_local
 
     fn = jax.shard_map(
-        functools.partial(local, axis_name=axis_name, causal=causal, scale=scale),
+        functools.partial(local, axis_name=axis_name, causal=causal, scale=scale, window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -58,17 +58,19 @@ def test_paged_serving_with_window(tiny_mistral):
         np.testing.assert_array_equal(got, ref)
 
 
-def test_window_with_seq_mesh_raises():
-    """Windowed attention must refuse a seq-sharded mesh rather than
-    silently computing full attention."""
+def test_window_on_seq_mesh_matches_unsharded(tiny_mistral):
+    """Windowed attention on a seq-sharded mesh runs the banded RING
+    schedule (absolute positions make the band rotation-invariant):
+    logits equal the unsharded forward."""
     import jax
 
     from accelerate_tpu.parallel.mesh import MeshConfig
     from accelerate_tpu.parallel.sharding import mesh_context
 
-    model = create_mistral_model(MistralConfig.tiny(sliding_window=4), seq_len=16)
-    mesh = MeshConfig(seq=2, data=4).build()
-    ids = np.ones((2, 8), np.int32)
+    ids = (np.arange(2 * 16).reshape(2, 16) % 250 + 1).astype(np.int32)
+    want = np.asarray(tiny_mistral(ids))
+
+    mesh = MeshConfig(seq=4, data=2).build()
     with mesh_context(mesh):
-        with pytest.raises(NotImplementedError, match="sliding-window"):
-            jax.eval_shape(lambda p, i: model.apply_fn(p, i), model.params, ids)
+        got = np.asarray(jax.jit(tiny_mistral.apply_fn)(tiny_mistral.params, ids))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
