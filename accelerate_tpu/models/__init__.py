@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/gemma/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/gemma/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -41,6 +41,12 @@ from .gemma import (
     GemmaConfig,
     GemmaModel,
     create_gemma_model,
+)
+from .phi3 import (
+    PHI3_SHARDING_RULES,
+    Phi3Config,
+    Phi3Model,
+    create_phi3_model,
 )
 from .qwen2 import (
     QWEN2_SHARDING_RULES,
@@ -110,6 +116,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_llama,
     load_hf_mistral,
     load_hf_mixtral,
+    load_hf_phi3,
     load_hf_qwen2,
     load_hf_t5,
     load_hf_vit,

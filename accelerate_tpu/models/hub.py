@@ -262,6 +262,51 @@ def load_hf_llama(checkpoint_path: str, config=None):
     return model
 
 
+def split_phi3_fused_state(state: dict[str, np.ndarray], num_heads: int, num_kv_heads: int) -> dict:
+    """Rewrite Phi-3's fused tensors into the llama state-dict layout:
+    ``qkv_proj`` -> q/k/v (row-split in torch [out, in] orientation, so
+    the head width divides the fused out dim) and ``gate_up_proj`` ->
+    gate/up (first half gate — HF's chunk(2) order). The result feeds
+    :func:`convert_hf_llama_state` unchanged, rope re-pairing included."""
+    out: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        if key.endswith("self_attn.qkv_proj.weight"):
+            prefix = key[: -len("qkv_proj.weight")]
+            hd = value.shape[0] // (num_heads + 2 * num_kv_heads)
+            q, k, v = np.split(value, [num_heads * hd, (num_heads + num_kv_heads) * hd], axis=0)
+            out[prefix + "q_proj.weight"] = q
+            out[prefix + "k_proj.weight"] = k
+            out[prefix + "v_proj.weight"] = v
+        elif key.endswith("mlp.gate_up_proj.weight"):
+            prefix = key[: -len("gate_up_proj.weight")]
+            gate, up = np.split(value, 2, axis=0)
+            out[prefix + "gate_proj.weight"] = gate
+            out[prefix + "up_proj.weight"] = up
+        else:
+            out[key] = value
+    return out
+
+
+def load_hf_phi3(checkpoint_path: str, config=None):
+    """HF Phi-3 checkpoints are llama-layout after splitting the fused
+    qkv_proj / gate_up_proj tensors (the module keeps separate
+    projections — XLA fuses the matmuls on TPU regardless)."""
+    from .phi3 import Phi3Config, create_phi3_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Phi3Config.phi3_mini_4k()
+    state = split_phi3_fused_state(state, config.num_attention_heads, config.num_key_value_heads)
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+    )
+    model = create_phi3_model(config)
+    _merge_into(model, tree)
+    return model
+
+
 def load_hf_gemma(checkpoint_path: str, config=None):
     """HF Gemma checkpoints are llama-layout (the rope re-pairing derives
     head width from the projection shapes, covering the explicit

@@ -1,0 +1,68 @@
+"""Phi-3: the llama architecture with fused checkpoint projections.
+
+Microsoft's Phi-3 decoders are llama modules in all but the state-dict
+layout: attention ships ONE fused ``qkv_proj`` tensor and the MLP one
+fused ``gate_up_proj``. Rather than teaching the module about fusion
+(XLA fuses the three matmuls regardless — the module split costs
+nothing on TPU), the importer splits the fused tensors into the llama
+layout (:func:`accelerate_tpu.models.hub.load_hf_phi3`) and everything
+else — sharding rules, loss, decode, serving, quantization — is the
+llama surface. Mini variants carry a ~2k sliding window, riding the
+same band paths as Mistral.
+
+The reference has no in-tree models (SURVEY §2.2); importer parity is
+tested against ``transformers.Phi3ForCausalLM`` in
+tests/test_hf_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+PHI3_SHARDING_RULES = LLAMA_SHARDING_RULES
+Phi3Model = LlamaModel
+
+
+@dataclasses.dataclass
+class Phi3Config(LlamaConfig):
+    """Llama config with phi-3-mini-4k defaults (MHA, 2047-token window)."""
+
+    vocab_size: int = 32064
+    hidden_size: int = 3072
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    sliding_window: Optional[int] = 2047
+
+    @classmethod
+    def tiny(cls, **kw) -> "Phi3Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("sliding_window", 8)
+        return cls(**kw)
+
+    @classmethod
+    def phi3_mini_4k(cls, **kw) -> "Phi3Config":
+        return cls(**kw)
+
+
+def create_phi3_model(config: Optional[Phi3Config] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with Phi-3 widths and window."""
+    return create_llama_model(config or Phi3Config.tiny(), seed=seed, seq_len=seq_len)

@@ -327,6 +327,41 @@ def test_qwen2_import_scan_layers_and_tied_head(tmp_path):
     np.testing.assert_allclose(got, want, atol=TOL)
 
 
+def test_phi3_import_matches_transformers(tmp_path):
+    """Phi-3 = llama weights shipped FUSED (qkv_proj, gate_up_proj) + a
+    sliding window: the importer's split points and chunk order are
+    exactly what element-wise parity pins down (window 8 < seq 16 so the
+    band bites too)."""
+    import jax
+
+    from accelerate_tpu.models import Phi3Config
+    from accelerate_tpu.models.hub import load_hf_phi3
+
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=8, attn_implementation="eager",
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,  # defaults exceed the tiny vocab
+    )
+    torch.manual_seed(0)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        sliding_window=8, scan_layers=False, remat=False,
+    )
+    model = load_hf_phi3(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
 def test_gemma_import_matches_transformers(tmp_path):
     """Gemma = llama skeleton + explicit head_dim (!= hidden/heads here,
     on purpose) + MQA + GeGLU + (1+scale) norms + sqrt(hidden) embedding
