@@ -40,16 +40,17 @@ def main():
     # paged KV cache: pool capacity set by tokens in flight, not
     # slots x max_len (128 here) — a 14-block pool serves 4 slots
     # (admission waits when blocks run out, then drains exactly)
+    block_size, pool_blocks = 8, 14
     paged = ServingEngine(
         model, num_slots=4, prompt_buckets=(8, 16),
-        paged_block_size=8, pool_blocks=14,
+        paged_block_size=block_size, pool_blocks=pool_blocks,
     )
     free0 = paged.pool_free_blocks
     outs_paged = paged.generate_many(prompts, max_new_tokens=8)
     for want, got in zip(outs, outs_paged):
         np.testing.assert_array_equal(got, want)
     assert paged.pool_free_blocks == free0
-    pool_rows = paged._pcfg.num_blocks * paged._pcfg.block_size
+    pool_rows = pool_blocks * block_size
     dense_rows = paged.num_slots * paged.max_len
     print(
         f"paged: same tokens from a pool of {pool_rows} cache rows "
