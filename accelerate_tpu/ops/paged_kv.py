@@ -280,6 +280,21 @@ def paste_blocks(paged_cache, row_cache, write_row):
     return _scatter_pools(paged_cache, row_cache, write_row, lambda name, leaf: None)
 
 
+def set_table_row(paged_cache, slot, table_row):
+    """Replace ``slot``'s block-table row (leaving pools and frontier
+    untouched): the engine's window-recycling path re-points expired
+    entries at the trash sink as the frontier moves past them. Pure —
+    jit once."""
+
+    def write(path, leaf):
+        if _path_names(path)[-1] == "block_table":
+            sel = (slice(None),) * (leaf.ndim - 2) + (slot,)
+            return leaf.at[sel].set(table_row.astype(leaf.dtype))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(write, paged_cache)
+
+
 def clear_slot(paged_cache, slot):
     """Re-point ``slot``'s table row at the trash sink and zero its
     frontier. MUST run when a slot retires: the static decode tick keeps

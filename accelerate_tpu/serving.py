@@ -162,8 +162,16 @@ class ServingEngine:
             self._pcfg = PagedConfig(block_size=bs_, num_blocks=nb)
             self._alloc = BlockAllocator(nb)
             self._shared_refs: dict[int, int] = {}  # prefix block id -> refcount
-            self._slot_blocks: list[list] = [[] for _ in range(num_slots)]
-            self._slot_shared: list[list] = [[] for _ in range(num_slots)]
+            # per-slot {table entry index -> pool block id}: owned blocks
+            # are freed at retirement OR when the sliding window expires
+            # them; shared (prefix) entries only drop a refcount
+            self._slot_blocks: list[dict] = [{} for _ in range(num_slots)]
+            self._slot_shared: list[dict] = [{} for _ in range(num_slots)]
+            self._slot_table = [np.zeros((self._mb,), np.int32) for _ in range(num_slots)]
+            # windowed models never read keys <= frontier - W, so their
+            # pool cost is O(window + max_new), not O(total): below-band
+            # entries start as trash and blocks expire behind the frontier
+            self._window = getattr(model.config, "sliding_window", None)
             with paged_mode(self._pcfg):
                 _, pcache = jax.eval_shape(
                     lambda p, i, pos: apply_fn(p, i, positions=pos, decode=True, cache=None),
@@ -310,7 +318,7 @@ class ServingEngine:
                 nxt = jax.vmap(lambda lg, s: sampler(lg[None], s)[0])(logits[:, -1], subs)
                 return cache, nxt, keys
 
-            from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row
+            from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row, set_table_row
 
             # Lazy jit wrapped in BOTH trace contexts (paged layout +
             # model mesh), re-entered every call: contexts only matter at
@@ -329,6 +337,7 @@ class ServingEngine:
             self._paste = ctx_jit(paste_row)
             self._paste_blocks = ctx_jit(paste_blocks)
             self._clear_slot = ctx_jit(clear_slot)
+            self._set_table = ctx_jit(set_table_row)
         else:
             def one_step(params, cache_row, tok, pos, key):
                 logits, cache_row = apply_fn(
@@ -418,20 +427,30 @@ class ServingEngine:
             # rewrite would race slots actively decoding against the
             # blocks, and cross-program recomputes of the same K/V are not
             # guaranteed bit-identical)
-            n_full = len(toks) // self._pcfg.block_size
-            ids = self._alloc.alloc(n_full)
+            bs_ = self._pcfg.block_size
+            n_full = len(toks) // bs_
+            # windowed models: no request can ever read below the minimum
+            # band (shortest suffix is 1 token), so registering those
+            # blocks would pin pool space every aliasing table sets to
+            # trash anyway — a 24k-token prefix with a 4k window pins
+            # O(window), not O(prefix)
+            lo_min = 0
+            if self._window is not None:
+                lo_min = min(max(0, len(toks) + 1 - self._window + 1) // bs_, n_full)
+            ids = self._alloc.alloc(n_full - lo_min)
             if ids is None:
                 raise ValueError(
-                    f"prefix needs {n_full} pool blocks but only "
+                    f"prefix needs {n_full - lo_min} pool blocks but only "
                     f"{self._alloc.free_count} are free; raise pool_blocks or unregister prefixes"
                 )
-            for i in ids:
-                self._shared_refs[i] = 1  # registration's own reference
-            entry["block_ids"] = ids
+            entry["block_ids"] = dict(zip(range(lo_min, n_full), ids))
+            for bid in ids:
+                self._shared_refs[bid] = 1  # registration's own reference
             if ids:
                 jnp = _jax().numpy
                 write_row = np.zeros((self._mb,), np.int32)  # pad -> trash sink
-                write_row[:n_full] = ids
+                for i, bid in entry["block_ids"].items():
+                    write_row[i] = bid
                 self.slot_caches = self._paste_blocks(self.slot_caches, cache, jnp.asarray(write_row))
         self._prefixes[pid] = entry
         return pid
@@ -448,10 +467,10 @@ class ServingEngine:
             raise ValueError(f"prefix_id {prefix_id} still referenced by active/queued requests")
         entry = self._prefixes.pop(prefix_id)
         if self.paged:
-            for i in entry.get("block_ids", []):
-                refs = self._shared_refs.pop(i)
-                assert refs == 1, f"shared block {i} still referenced ({refs})"
-                self._alloc.free([i])
+            for bid in entry.get("block_ids", {}).values():
+                refs = self._shared_refs.pop(bid)
+                assert refs == 1, f"shared block {bid} still referenced ({refs})"
+                self._alloc.free([bid])
 
     def submit(self, prompt_ids, max_new_tokens: int = 32, prefix_id: Optional[int] = None) -> int:
         """Queue a prompt; returns a request id resolved via :meth:`poll`.
@@ -473,10 +492,10 @@ class ServingEngine:
                 f"({max_new_tokens}) exceeds the slot cache ({self.max_len})"
             )
         if self.paged:
-            need, shared_n = self._blocks_needed(plen, len(prompt), max_new_tokens)
-            if need - shared_n > self._pcfg.num_blocks - 1:
+            need = self._new_blocks_for(plen, len(prompt), max_new_tokens)
+            if need > self._pcfg.num_blocks - 1:
                 raise ValueError(
-                    f"request needs {need - shared_n} pool blocks but the pool has "
+                    f"request needs {need} pool blocks but the pool has "
                     f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
                 )
         uid = self._uid
@@ -504,30 +523,36 @@ class ServingEngine:
         for slot in range(self.num_slots):
             if self.slot_req[slot] is not None or not self.queue:
                 continue
-            table = new_ids = shared_ids = None
             if self.paged:
                 # reserve pool blocks BEFORE dequeuing; if the pool can't
                 # satisfy the head request, the whole queue waits (FIFO —
                 # no starvation of large requests by later small ones)
                 head = self.queue[0]
-                need, shared_n = self._head_blocks()
-                new_ids = self._alloc.alloc(need - shared_n)
+                hp = self._prefixes[head.prefix_id]["len"] if head.prefix_id is not None else 0
+                lo, hi, alias_hi = self._plan_blocks(hp, len(head.prompt), head.max_new_tokens)
+                shared_entries: dict[int, int] = {}
+                if head.prefix_id is not None:
+                    pids = self._prefixes[head.prefix_id]["block_ids"]
+                    # every i in [lo, alias_hi) is registered: the prefix's
+                    # lo_min (suffix length 1) lower-bounds any request's lo
+                    shared_entries = {i: pids[i] for i in range(lo, alias_hi)}
+                new_ids = self._alloc.alloc((hi - lo) - len(shared_entries))
                 if new_ids is None:
                     self._pool_blocked = True
                     break
-                shared_ids = (
-                    self._prefixes[head.prefix_id]["block_ids"][:shared_n] if shared_n else []
-                )
-                for i in shared_ids:
-                    self._shared_refs[i] += 1
-                table = np.zeros((self._mb,), np.int32)  # pad entries -> trash sink
-                table[:shared_n] = shared_ids
-                table[shared_n:need] = new_ids
+                for bid in shared_entries.values():
+                    self._shared_refs[bid] += 1
+                table = np.zeros((self._mb,), np.int32)  # pad/out-of-band -> trash sink
+                owned: dict[int, int] = {}
+                ids = iter(new_ids)
+                for i in range(lo, hi):
+                    table[i] = shared_entries[i] if i in shared_entries else owned.setdefault(i, next(ids))
                 # the paste writes ONLY this request's own blocks: shared
                 # prefix entries go to the trash sink in the write row
                 # (their canonical content was written at registration)
                 write_row = table.copy()
-                write_row[:shared_n] = 0
+                for i in shared_entries:
+                    write_row[i] = 0
             req = self.queue.popleft()
             key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
             if req.prefix_id is None and len(req.prompt) <= max(self.prompt_buckets):
@@ -554,7 +579,8 @@ class ServingEngine:
                 total = len(full)
             self._slot_keys = self._slot_keys.at[slot].set(key)
             if self.paged:
-                self._slot_blocks[slot], self._slot_shared[slot] = new_ids, shared_ids
+                self._slot_blocks[slot], self._slot_shared[slot] = owned, shared_entries
+                self._slot_table[slot] = table
                 self.slot_caches = self._paste(
                     self.slot_caches, row_cache, jnp.asarray(write_row), jnp.asarray(table),
                     jnp.int32(slot), jnp.int32(total),
@@ -589,6 +615,30 @@ class ServingEngine:
                 if self._finished(req, tok):
                     self._retire(slot)
                     break  # remaining block tokens are overshoot — discarded
+
+        if self.paged and self._window is not None:
+            # expire blocks the band can no longer read: entries fully
+            # below frontier - W + 1 return to the pool (owned) or drop a
+            # refcount (shared); their table entries point at the trash
+            # sink before the next tick, so the (masked) reads stay valid
+            bs_ = self._pcfg.block_size
+            for slot, req in enumerate(self.slot_req):
+                if req is None:
+                    continue
+                keep_from = max(0, int(self.slot_pos[slot]) - self._window + 1) // bs_
+                dead_own = [i for i in self._slot_blocks[slot] if i < keep_from]
+                dead_shared = [i for i in self._slot_shared[slot] if i < keep_from]
+                if not dead_own and not dead_shared:
+                    continue
+                for i in dead_own:
+                    self._alloc.free([self._slot_blocks[slot].pop(i)])
+                    self._slot_table[slot][i] = 0
+                for i in dead_shared:
+                    self._shared_refs[self._slot_shared[slot].pop(i)] -= 1
+                    self._slot_table[slot][i] = 0
+                self.slot_caches = self._set_table(
+                    self.slot_caches, jnp.int32(slot), jnp.asarray(self._slot_table[slot])
+                )
         return self.active_count
 
     def run(self) -> dict:
@@ -602,10 +652,10 @@ class ServingEngine:
                 # raising beats the silent busy-loop; if it fits, the
                 # blocking was transient (the tick's retirements freed
                 # blocks after the admit pass) and the next step admits it.
-                need, shared_n = self._head_blocks()
-                if need - shared_n > self._alloc.free_count:
+                need = self._head_new_blocks()
+                if need > self._alloc.free_count:
                     raise RuntimeError(
-                        f"request {self.queue[0].uid} needs {need - shared_n} pool blocks but "
+                        f"request {self.queue[0].uid} needs {need} pool blocks but "
                         f"only {self._alloc.free_count} can ever be free (registered prefixes "
                         "hold the rest); raise pool_blocks or unregister unused prefixes"
                     )
@@ -632,26 +682,37 @@ class ServingEngine:
 
         return _trace_ctx(getattr(self.model, "mesh", None))
 
-    def _blocks_needed(self, plen: int, prompt_len: int, max_new: int):
-        """(total blocks for a request's table, of which shared prefix
-        blocks). Reserves through the last *kept* write — position
-        total + max_new - 2 (the token hitting max_new is sampled from
-        that write's step). A finished slot's discarded overshoot writes
-        within the rest of its tick land in trash-sink table entries or
-        its own last block, never a neighbour's, so they need no
-        reservation."""
+    def _plan_blocks(self, plen: int, prompt_len: int, max_new: int):
+        """Live table-entry range ``[lo, hi)`` for a request, plus the
+        count of leading prefix FULL blocks eligible for aliasing.
+        ``hi`` reserves through the last *kept* write — position
+        total + max_new - 2 (a finished slot's discarded overshoot
+        writes land in trash entries or its own last block, never a
+        neighbour's). ``lo`` is 0 unless the model has a sliding window:
+        the decode band never reads positions <= total - W, so blocks
+        entirely below it start as trash — a windowed request's pool
+        cost is O(window + max_new) regardless of prompt length."""
         bs_ = self._pcfg.block_size
         total = plen + prompt_len
-        need = min(self._mb, -(-(total + max_new - 1) // bs_))
-        shared_n = min(plen // bs_, need)
-        return need, shared_n
+        hi = min(self._mb, -(-(total + max_new - 1) // bs_))
+        lo = 0
+        if self._window is not None:
+            lo = min(max(0, total - self._window + 1) // bs_, hi)
+        alias_hi = min(plen // bs_, hi)  # plen=0 (no prefix) -> nothing aliasable
+        return lo, hi, alias_hi
 
-    def _head_blocks(self):
-        """(need, shared_n) for the queue's head request — shared by the
-        admission path and run()'s unsatisfiable-head diagnostic."""
+    def _new_blocks_for(self, plen: int, prompt_len: int, max_new: int) -> int:
+        """New (non-aliased) blocks a request allocates — the ONE place
+        the capacity arithmetic lives (submit's feasibility check, the
+        admission allocation, and run()'s unsatisfiable-head diagnostic
+        must agree or admission deadlocks/overcommits)."""
+        lo, hi, alias_hi = self._plan_blocks(plen, prompt_len, max_new)
+        return (hi - lo) - max(0, alias_hi - lo)
+
+    def _head_new_blocks(self) -> int:
         head = self.queue[0]
         plen = self._prefixes[head.prefix_id]["len"] if head.prefix_id is not None else 0
-        return self._blocks_needed(plen, len(head.prompt), head.max_new_tokens)
+        return self._new_blocks_for(plen, len(head.prompt), head.max_new_tokens)
 
     @property
     def pool_free_blocks(self) -> Optional[int]:
@@ -671,10 +732,11 @@ class ServingEngine:
             # and a stale table would corrupt blocks once they're
             # reallocated to another request
             jnp = _jax().numpy
-            self._alloc.free(self._slot_blocks[slot])
-            self._slot_blocks[slot] = []
-            for i in self._slot_shared[slot]:
-                self._shared_refs[i] -= 1
-                assert self._shared_refs[i] >= 1, f"shared block {i} over-freed"
-            self._slot_shared[slot] = []
+            self._alloc.free(list(self._slot_blocks[slot].values()))
+            self._slot_blocks[slot] = {}
+            for bid in self._slot_shared[slot].values():
+                self._shared_refs[bid] -= 1
+                assert self._shared_refs[bid] >= 1, f"shared block {bid} over-freed"
+            self._slot_shared[slot] = {}
+            self._slot_table[slot][:] = 0
             self.slot_caches = self._clear_slot(self.slot_caches, jnp.int32(slot))
