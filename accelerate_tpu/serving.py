@@ -546,7 +546,10 @@ class ServingEngine:
                 owned: dict[int, int] = {}
                 ids = iter(new_ids)
                 for i in range(lo, hi):
-                    table[i] = shared_entries[i] if i in shared_entries else owned.setdefault(i, next(ids))
+                    if i in shared_entries:
+                        table[i] = shared_entries[i]
+                    else:
+                        owned[i] = table[i] = next(ids)
                 # the paste writes ONLY this request's own blocks: shared
                 # prefix entries go to the trash sink in the write row
                 # (their canonical content was written at registration)
