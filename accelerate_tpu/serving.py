@@ -200,6 +200,7 @@ class ServingEngine:
         self.slot_pos = np.zeros((num_slots,), np.int32)
         self.queue: collections.deque[_Request] = collections.deque()
         self.done: dict[int, np.ndarray] = {}
+        self._done_new: dict[int, np.ndarray] = {}  # uid -> generated suffix only
         self._uid = 0
         self._pool_blocked = False  # last admit pass hit pool exhaustion
 
@@ -507,6 +508,40 @@ class ServingEngine:
         """The finished [S + new] tokens for ``uid``, or None if pending."""
         return self.done.get(uid)
 
+    def partial(self, uid: int) -> np.ndarray:
+        """Tokens generated SO FAR for ``uid`` (streaming surface) —
+        ALWAYS the generated suffix (empty while queued), including after
+        completion, so a delta-by-length streamer never re-emits prompt
+        tokens; ``poll`` returns the full prompt+output sequence. Raises
+        KeyError for unknown (or cancelled) ids."""
+        if uid in self._done_new:
+            return self._done_new[uid]
+        for req in self.slot_req:
+            if req is not None and req.uid == uid:
+                return np.asarray(req.out_tokens, np.int32)
+        for req in self.queue:
+            if req.uid == uid:
+                return np.zeros((0,), np.int32)
+        raise KeyError(f"unknown request id {uid}")
+
+    def cancel(self, uid: int) -> np.ndarray:
+        """Abort a queued or decoding request, returning whatever tokens it
+        had generated. Its slot/pool blocks free immediately; ``poll``
+        never resolves a cancelled id. Raises ValueError if already
+        finished, KeyError if unknown."""
+        if uid in self.done:
+            raise ValueError(f"request {uid} already finished; poll() it instead")
+        for slot, req in enumerate(self.slot_req):
+            if req is not None and req.uid == uid:
+                out = np.asarray(req.out_tokens, np.int32)
+                self._release(slot)
+                return out
+        for req in list(self.queue):
+            if req.uid == uid:
+                self.queue.remove(req)
+                return np.zeros((0,), np.int32)
+        raise KeyError(f"unknown request id {uid}")
+
     @property
     def active_count(self) -> int:
         return sum(r is not None for r in self.slot_req)
@@ -728,6 +763,12 @@ class ServingEngine:
         if req.prefix_id is not None:
             parts.insert(0, self._prefixes[req.prefix_id]["tokens"])
         self.done[req.uid] = np.concatenate(parts)
+        self._done_new[req.uid] = np.asarray(req.out_tokens, np.int32)
+        self._release(slot)
+
+    def _release(self, slot: int):
+        """Free a slot's resources without publishing a result (shared by
+        retirement and cancellation)."""
         self.slot_req[slot] = None
         if self.paged:
             # free this request's blocks and re-point the whole row at the

@@ -31,12 +31,15 @@ A100_PER_CHIP_SAMPLES_PER_SEC = 350.0
 PEAK_BF16_TFLOPS = {"v5e": 197.0, "v4": 275.0, "v5p": 459.0, "v6e": 918.0}
 
 
-def _probe_backend(max_tries: int = 5, probe_timeout: int = 180, base_delay: float = 10.0):
+def _probe_backend(max_tries: int = 10, probe_timeout: int = 180, base_delay: float = 15.0):
     """Verify the accelerator backend actually initialises before touching it
     in-process. The axon TPU plugin has two failure modes observed in round 1:
     raising UNAVAILABLE right after the tunnel comes up, and *hanging* inside
     backend init (uninterruptible C call) — so the probe runs in a subprocess
-    with a hard timeout and retries with backoff."""
+    with a hard timeout and retries with backoff. Round 4 saw a multi-hour
+    tunnel outage mid-session: the budget below rides out ~45 min of
+    downtime (capped per-try delay) before giving up with the diagnostic
+    JSON, maximising the odds the driver's run lands after a recovery."""
     import subprocess
 
     last = "unknown"
@@ -55,7 +58,7 @@ def _probe_backend(max_tries: int = 5, probe_timeout: int = 180, base_delay: flo
             last = f"backend init hung >{probe_timeout}s"
         if attempt == max_tries - 1:
             break
-        delay = base_delay * (1.5**attempt)
+        delay = min(base_delay * (1.5**attempt), 300.0)
         print(
             f"bench: backend probe {attempt + 1}/{max_tries} failed ({last}); "
             f"retrying in {delay:.0f}s",

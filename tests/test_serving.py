@@ -64,6 +64,47 @@ def test_eos_retires_slot(tiny_llama):
     assert eng.active_count == 0
 
 
+def test_partial_streams_and_cancel(tiny_llama):
+    """partial() exposes the growing suffix mid-decode; cancel() frees
+    the slot immediately and the surviving request stays token-exact."""
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,), tick_block=2)
+    a = eng.submit(np.arange(1, 7, dtype=np.int32), max_new_tokens=8)
+    b = eng.submit(np.arange(20, 25, dtype=np.int32), max_new_tokens=8)
+    assert eng.partial(a).size == 0  # queued: nothing yet
+    eng.step()
+    grew = eng.partial(a).size
+    assert 0 < grew < 8 and eng.poll(a) is None  # mid-decode prefix of the answer
+    got = eng.cancel(b)
+    assert got.size >= 1  # b had started too
+    eng.run()
+    np.testing.assert_array_equal(eng.poll(a), _reference(tiny_llama, np.arange(1, 7), 8))
+    # partial stays suffix-only after completion: a delta streamer never
+    # re-emits prompt tokens on the finishing tick
+    np.testing.assert_array_equal(eng.partial(a), eng.poll(a)[6:])
+    assert eng.poll(b) is None  # cancelled ids never resolve
+    with pytest.raises(KeyError):
+        eng.partial(b)
+    with pytest.raises(ValueError, match="finished"):
+        eng.cancel(a)
+    c = eng.submit(np.ones(3, np.int32), max_new_tokens=4)
+    assert eng.cancel(c).size == 0  # cancelled straight out of the queue
+    with pytest.raises(KeyError):
+        eng.cancel(999)
+
+
+def test_cancel_frees_paged_blocks(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,), tick_block=2, paged_block_size=4)
+    free0 = eng.pool_free_blocks
+    uid = eng.submit(np.arange(1, 7, dtype=np.int32), max_new_tokens=12)
+    eng.step()
+    assert eng.pool_free_blocks < free0
+    eng.cancel(uid)
+    assert eng.pool_free_blocks == free0  # blocks returned immediately
+    # slot is reusable and exact afterwards
+    [out] = eng.generate_many([np.arange(9, 12, dtype=np.int32)], max_new_tokens=4)
+    np.testing.assert_array_equal(out, _reference(tiny_llama, np.arange(9, 12), 4))
+
+
 def test_validation_errors(tiny_llama):
     eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(4,), max_len=16)
     with pytest.raises(ValueError, match="cache"):
