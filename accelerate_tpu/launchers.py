@@ -143,20 +143,32 @@ def debug_launcher(function, args=(), num_processes: int = 2):
     """(reference: launchers.py:260). Run ``function`` under a CPU fake mesh
     in-process — the cheapest way to smoke-test distributed code paths.
 
-    Must be called before any other JAX use in the process:
-    ``--xla_force_host_platform_device_count`` is read once at backend
-    initialisation."""
+    Import-order contract: ``--xla_force_host_platform_device_count`` is read
+    ONCE at backend initialisation, so this must run before any other JAX use
+    in the process. If the backend is already live it cannot be re-topologised;
+    matching the reference's ``notebook_launcher`` pre-flight checks
+    (launchers.py:165-257) this raises unless the live backend is already a
+    CPU mesh with at least ``num_processes`` devices (a superset fake mesh,
+    e.g. the test suite's shared 8-device mesh — the function then sees that
+    topology instead of a fresh one)."""
     import jax
 
     # Private but the only way to detect initialisation without causing it.
     if getattr(jax._src.xla_bridge, "_backends", None):
-        import warnings
-
-        warnings.warn(
-            "debug_launcher called after the JAX backend was initialised; the "
-            f"{num_processes}-device fake mesh cannot be applied and `function` "
-            "will see the existing backend. Call debug_launcher first.",
-            stacklevel=2,
+        devs = jax.devices()
+        if devs[0].platform != "cpu" or len(devs) < num_processes:
+            raise RuntimeError(
+                "debug_launcher called after the JAX backend was initialised "
+                f"(live: {len(devs)}x {devs[0].platform}); the {num_processes}-device "
+                "CPU fake mesh cannot be applied. Call debug_launcher before any "
+                "other JAX use in the process, or run under JAX_PLATFORMS=cpu "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={num_processes}."
+            )
+        log = logger.debug if len(devs) == num_processes else logger.warning
+        log(
+            "debug_launcher: backend already initialised with a %d-device CPU mesh; "
+            "running `function` on the existing topology (requested %d).",
+            len(devs), num_processes,
         )
     with patch_environment(
         JAX_PLATFORMS="cpu",

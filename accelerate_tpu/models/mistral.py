@@ -3,7 +3,8 @@
 Mistral-7B is structurally llama (RMSNorm pre-norm, rotary, SwiGLU,
 GQA) with one semantic change — every position attends to at most the
 last ``sliding_window`` keys — plus different default widths (14336
-intermediate, 8 KV heads, theta 1e6). The family therefore reuses
+intermediate, 8 KV heads; rope theta 1e4 for v0.1, 1e6 for v0.2/v0.3).
+The family therefore reuses
 :mod:`accelerate_tpu.models.llama` wholesale: :class:`MistralConfig`
 subclasses :class:`LlamaConfig` (the ``sliding_window`` field lives
 there so the band mask threads through the shared attention, KV-cache,

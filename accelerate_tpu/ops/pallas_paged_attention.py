@@ -104,8 +104,10 @@ def _kernel(
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _finalize():
-        # l > 0 always: the page holding `cur` itself is live for any row
-        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+        # l can be 0 for a long-retired slot whose windowed frontier moved
+        # past every live page: its output is discarded host-side, but an
+        # unguarded 0/0 would trip jax_debug_nans / NaN-scan tooling.
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, 0], 1.0)[:, None]).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("sliding_window", "scale", "interpret"))

@@ -466,11 +466,19 @@ class ServingEngine:
             r.prefix_id == prefix_id for r in self.queue
         ):
             raise ValueError(f"prefix_id {prefix_id} still referenced by active/queued requests")
-        entry = self._prefixes.pop(prefix_id)
+        entry = self._prefixes[prefix_id]
+        if self.paged:
+            # Validate every refcount BEFORE mutating anything so a failed
+            # invariant (must survive python -O) leaves the pool accounting
+            # intact for diagnosis rather than half-freed.
+            for bid in entry.get("block_ids", {}).values():
+                refs = self._shared_refs.get(bid)
+                if refs != 1:
+                    raise RuntimeError(f"shared block {bid} still referenced ({refs})")
+        del self._prefixes[prefix_id]
         if self.paged:
             for bid in entry.get("block_ids", {}).values():
-                refs = self._shared_refs.pop(bid)
-                assert refs == 1, f"shared block {bid} still referenced ({refs})"
+                self._shared_refs.pop(bid)
                 self._alloc.free([bid])
 
     def submit(self, prompt_ids, max_new_tokens: int = 32, prefix_id: Optional[int] = None) -> int:
@@ -769,6 +777,13 @@ class ServingEngine:
     def _release(self, slot: int):
         """Free a slot's resources without publishing a result (shared by
         retirement and cancellation)."""
+        if self.paged:
+            # Validate shared refcounts BEFORE any mutation (must survive
+            # python -O): a tripped invariant must leave the slot, pool, and
+            # table state intact for diagnosis, not half-freed.
+            for bid in self._slot_shared[slot].values():
+                if self._shared_refs.get(bid, 0) < 2:
+                    raise RuntimeError(f"shared block {bid} over-freed")
         self.slot_req[slot] = None
         if self.paged:
             # free this request's blocks and re-point the whole row at the
@@ -780,7 +795,6 @@ class ServingEngine:
             self._slot_blocks[slot] = {}
             for bid in self._slot_shared[slot].values():
                 self._shared_refs[bid] -= 1
-                assert self._shared_refs[bid] >= 1, f"shared block {bid} over-freed"
             self._slot_shared[slot] = {}
             self._slot_table[slot][:] = 0
             self.slot_caches = self._clear_slot(self.slot_caches, jnp.int32(slot))
