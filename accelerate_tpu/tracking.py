@@ -82,8 +82,46 @@ class GeneralTracker:
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         raise NotImplementedError
 
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """Log ``{name: [HWC uint8/float arrays or PIL images]}`` media
+        (reference: tracking.py:272/:373/:666/:998 — per-tracker
+        ``log_images``). Base raises: ``Accelerator.log_images`` dispatches
+        only to trackers that override this."""
+        raise NotImplementedError(f"{type(self).__name__} does not support image logging")
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe=None,
+        step: Optional[int] = None,
+        **kwargs,
+    ):
+        """Log a table from ``columns``+``data`` rows or a ``dataframe``
+        (reference: tracking.py:392/:1016)."""
+        raise NotImplementedError(f"{type(self).__name__} does not support table logging")
+
     def finish(self):
         pass
+
+
+def _as_hwc_uint8(image):
+    """Normalise one image (PIL / [H,W] / [H,W,C] float-or-int array) to an
+    HWC uint8 numpy array — the common currency every media sink accepts.
+    Floats are assumed in [0, 1] (the diffusion example's output range)."""
+    import numpy as np
+
+    if hasattr(image, "mode"):  # PIL.Image duck-type
+        return np.asarray(image.convert("RGB"))
+    arr = np.asarray(image)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+    elif arr.dtype != np.uint8:  # int16/32/64 pixels are already 0-255
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    return arr
 
 
 class JSONLTracker(GeneralTracker):
@@ -122,6 +160,40 @@ class JSONLTracker(GeneralTracker):
         record.update(values)
         with open(self.path, "a") as f:
             f.write(json.dumps(record, default=float) + "\n")
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """PNGs under ``{run}/media/`` (PIL; falls back to .npy without it),
+        with their paths appended to the metrics stream."""
+        media_dir = os.path.join(self.dir, "media")
+        os.makedirs(media_dir, exist_ok=True)
+        paths = {}
+        for k, images in values.items():
+            paths[k] = []
+            for i, image in enumerate(images):
+                arr = _as_hwc_uint8(image)
+                stem = f"{k.replace('/', '_')}_{step if step is not None else 'x'}_{i}"
+                try:
+                    from PIL import Image
+
+                    path = os.path.join(media_dir, stem + ".png")
+                    Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr).save(path)
+                except ImportError:
+                    import numpy as np
+
+                    path = os.path.join(media_dir, stem + ".npy")
+                    np.save(path, arr)
+                paths[k].append(path)
+        self.log({f"_images/{k}": v for k, v in paths.items()}, step=step)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        if dataframe is not None:
+            columns = list(dataframe.columns)
+            data = dataframe.values.tolist()
+        elif data is None:
+            raise ValueError("log_table needs `data` (with optional `columns`) or `dataframe`")
+        self.log({f"_table/{table_name}": {"columns": columns, "data": data}}, step=step)
 
 
 class TensorBoardTracker(GeneralTracker):
@@ -166,6 +238,24 @@ class TensorBoardTracker(GeneralTracker):
                 self.writer.add_text(k, v, global_step=step, **kwargs)
             elif isinstance(v, dict):
                 self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """(reference: tracking.py:272). Accepts ``{name: [images]}``; images
+        are normalised to a stacked NHWC uint8 batch (the JAX-native layout —
+        the reference's torch default is NCHW)."""
+        import numpy as np
+
+        for k, v in values.items():
+            imgs = [_as_hwc_uint8(image) for image in v]
+            # a batch may mix grayscale/RGB/RGBA inputs — stack needs one
+            # depth: drop alpha, broadcast grayscale
+            imgs = [
+                i[..., :3] if i.shape[-1] >= 3 else np.repeat(i[..., :1], 3, axis=-1)
+                for i in imgs
+            ]
+            self.writer.add_images(k, np.stack(imgs), global_step=step, dataformats="NHWC", **kwargs)
         self.writer.flush()
 
     @on_main_process
@@ -218,6 +308,21 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """(reference: tracking.py:373)."""
+        import wandb
+
+        for k, v in values.items():
+            self.log({k: [wandb.Image(image) for image in v]}, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        """(reference: tracking.py:392)."""
+        import wandb
+
+        self.log({table_name: wandb.Table(columns=columns, data=data, dataframe=dataframe)}, step=step, **kwargs)
 
     @on_main_process
     def finish(self):
@@ -311,6 +416,24 @@ class AimTracker(GeneralTracker):
             self.writer.track(v, name=k, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """(reference: tracking.py:666). Values may be ``(image, caption)``
+        tuples; ``kwargs`` may carry ``aim_image`` / ``track`` sub-dicts."""
+        import aim
+
+        aim_image_kw = (kwargs or {}).get("aim_image", {})
+        track_kw = (kwargs or {}).get("track", {})
+        for k, v in values.items():
+            # a key maps to one image, one (image, caption) tuple, or a list
+            for image in (v if isinstance(v, list) else [v]):
+                if isinstance(image, tuple):
+                    img, caption = image
+                    aim_img = aim.Image(img, caption=caption, **aim_image_kw)
+                else:
+                    aim_img = aim.Image(image, **aim_image_kw)
+                self.writer.track(aim_img, name=k, step=step, **track_kw)
+
+    @on_main_process
     def finish(self):
         self.writer.close()
 
@@ -346,6 +469,26 @@ class CometMLTracker(GeneralTracker):
         if step is not None:
             self.writer.set_step(step)
         self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """comet_ml ``Experiment.log_image`` per image (named ``{key}_{i}``)."""
+        for k, v in values.items():
+            for i, image in enumerate(v):
+                self.writer.log_image(_as_hwc_uint8(image), name=f"{k}_{i}", step=step, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        """comet_ml ``Experiment.log_table`` (csv filename + tabular data)."""
+        if step is not None:
+            self.writer.set_step(step)
+        filename = table_name if table_name.endswith((".csv", ".tsv")) else f"{table_name}.csv"
+        if dataframe is not None:
+            self.writer.log_table(filename, tabular_data=dataframe, **kwargs)
+        else:
+            if data is None:
+                raise ValueError("log_table needs `data` (with optional `columns`) or `dataframe`")
+            self.writer.log_table(filename, tabular_data=data, headers=columns if columns is not None else False, **kwargs)
 
     @on_main_process
     def finish(self):
@@ -386,6 +529,30 @@ class ClearMLTracker(GeneralTracker):
                 clearml_logger.report_single_value(name=k, value=v) if step is None else clearml_logger.report_scalar(
                     title=k, series=k, value=v, iteration=step
                 )
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """(reference: tracking.py:998) ``Logger.report_image`` per image."""
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            for i, image in enumerate(v):
+                clearml_logger.report_image(
+                    title=k, series=str(i), iteration=step, image=_as_hwc_uint8(image), **kwargs
+                )
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        """(reference: tracking.py:1016) ``Logger.report_table``. Reference
+        semantics when ``columns`` is omitted: the FIRST data row is the
+        header row (unlike wandb/comet, which treat every row as data)."""
+        to_report = dataframe
+        if dataframe is None:
+            if data is None:
+                raise ValueError("log_table needs `data` (with optional `columns`) or `dataframe`")
+            to_report = [columns] + list(data) if columns else data
+        self.task.get_logger().report_table(
+            title=table_name, series=table_name, table_plot=to_report, iteration=step, **kwargs
+        )
 
     @on_main_process
     def finish(self):
@@ -503,6 +670,12 @@ class SwanLabTracker(GeneralTracker):
         import swanlab
 
         swanlab.log(values, step=step)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        import swanlab
+
+        swanlab.log({k: [swanlab.Image(image, **kwargs) for image in v] for k, v in values.items()}, step=step)
 
     @on_main_process
     def finish(self):

@@ -46,7 +46,8 @@ def main():
 
     import jax
 
-    acc = Accelerator(mixed_precision="bf16")
+    acc = Accelerator(mixed_precision="bf16", log_with="jsonl", project_dir="runs")
+    acc.init_trackers("diffusion_example")
     model = acc.prepare_model(create_unet_model(UNetConfig.tiny(sample_size=8), seed=0))
     acc.prepare_optimizer(optax.adam(2e-3))
     schedule = make_schedule(128)
@@ -73,6 +74,11 @@ def main():
     imgs = np.asarray(sample(model, 4, num_steps=args.sample_steps, schedule=schedule))
     acc.print(f"sampled {imgs.shape}, range [{imgs.min():.2f}, {imgs.max():.2f}]")
     assert np.isfinite(imgs).all()
+    # media parity (reference: tracking.py:373 log_images): samples land in
+    # runs/diffusion_example/media/ as PNGs via the jsonl tracker — swap
+    # log_with for "wandb"/"tensorboard" to stream them to a dashboard
+    acc.log_images({"samples": [(img + 1) / 2 for img in imgs]}, step=args.steps)
+    acc.end_training()
     acc.print("diffusion example OK")
 
 

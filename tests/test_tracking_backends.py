@@ -305,3 +305,162 @@ def test_logger_type_map_covers_all_availability_keys():
 def test_main_process_only_attribute():
     for cls in tracking.LOGGER_TYPE_TO_CLASS.values():
         assert isinstance(cls.name, str) and isinstance(cls.requires_logging_directory, bool)
+
+
+# ---------------------------------------------------------------------------
+# media logging (reference: tracking.py:272/:373/:392/:666/:998/:1016)
+# ---------------------------------------------------------------------------
+
+
+def _gray(v, h=4, w=6):
+    import numpy as np
+
+    return np.full((h, w, 3), v, np.uint8)
+
+
+def test_wandb_log_images_and_table(fake_module):
+    run = Recorder("run")
+
+    class Image:
+        def __init__(self, data, **kw):
+            self.data = data
+
+    class Table:
+        def __init__(self, columns=None, data=None, dataframe=None):
+            self.columns, self.data, self.dataframe = columns, data, dataframe
+
+    fake_module("wandb", init=lambda **kw: run, Image=Image, Table=Table, config=Recorder("config"))
+    t = tracking.WandBTracker("proj")
+    t.start()
+    t.log_images({"samples": [_gray(0), _gray(255)]}, step=3)
+    name, args, kwargs = run.get("log")[0]
+    assert [type(i) for i in args[0]["samples"]] == [Image, Image] and kwargs["step"] == 3
+    t.log_table("preds", columns=["x", "y"], data=[[1, 2]], step=4)
+    name, args, kwargs = run.get("log")[1]
+    table = args[0]["preds"]
+    assert isinstance(table, Table) and table.columns == ["x", "y"] and table.data == [[1, 2]]
+
+
+def test_comet_log_images_and_table(fake_module):
+    exp = Recorder("experiment")
+
+    class Experiment:
+        def __new__(cls, project_name=None, **kw):
+            return exp
+
+    fake_module("comet_ml", Experiment=Experiment)
+    t = tracking.CometMLTracker("proj")
+    t.start()
+    t.log_images({"gen": [_gray(10)]}, step=1)
+    name, args, kwargs = exp.get("log_image")[0]
+    assert kwargs["name"] == "gen_0" and kwargs["step"] == 1 and args[0].shape == (4, 6, 3)
+    t.log_table("metrics", columns=["a"], data=[[1]], step=2)
+    name, args, kwargs = exp.get("log_table")[0]
+    assert args[0] == "metrics.csv" and kwargs["tabular_data"] == [[1]] and kwargs["headers"] == ["a"]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="log_table needs"):
+        t.log_table("empty")
+
+
+def test_clearml_log_images_and_table(fake_module):
+    task = Recorder("task")
+    logger = Recorder("logger")
+    task._returns["get_logger"] = logger
+
+    class Task:
+        @staticmethod
+        def init(project_name=None, **kw):
+            return task
+
+    fake_module("clearml", Task=Task)
+    t = tracking.ClearMLTracker("proj")
+    t.start()
+    t.log_images({"viz": [_gray(1), _gray(2)]}, step=7)
+    calls = logger.get("report_image")
+    assert len(calls) == 2
+    assert calls[0][2]["title"] == "viz" and calls[0][2]["series"] == "0" and calls[0][2]["iteration"] == 7
+    t.log_table("tbl", columns=["c"], data=[[9]], step=1)
+    name, args, kwargs = logger.get("report_table")[0]
+    assert kwargs["table_plot"] == [["c"], [9]] and kwargs["iteration"] == 1
+
+
+def test_aim_log_images_with_captions(fake_module, tmp_path):
+    writer = Recorder("aim_run")
+    writer.__dict__["name"] = None
+    images = []
+
+    class AimImage:
+        def __init__(self, data, caption=None, **kw):
+            images.append((data, caption))
+
+    class Run:
+        def __new__(cls, repo=None, **kw):
+            return writer
+
+    fake_module("aim", Run=Run, Image=AimImage)
+    t = tracking.AimTracker("exp", logging_dir=str(tmp_path))
+    t.start()
+    t.log_images({"single": _gray(3), "pair": [(_gray(4), "cap")]}, step=2)
+    assert len(images) == 2 and images[1][1] == "cap"
+    assert len(writer.get("track")) == 2
+
+
+def test_tensorboard_log_images_real_writer(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    import numpy as np
+
+    t = tracking.TensorBoardTracker("run", logging_dir=str(tmp_path))
+    t.start()
+    # mixed inputs: uint8 HWC + float [0,1] grayscale HW
+    t.log_images({"batch": [_gray(128), np.linspace(0, 1, 24).reshape(4, 6)]}, step=0)
+    t.finish()
+    assert any(f.is_file() for f in tmp_path.rglob("*")), "no event files written"
+
+
+def test_jsonl_log_images_and_table(tmp_path):
+    import json as _json
+
+    t = tracking.JSONLTracker("run", logging_dir=str(tmp_path))
+    t.start()
+    t.log_images({"x": [_gray(7)]}, step=5)
+    t.log_table("t", columns=["a", "b"], data=[[1, 2]], step=6)
+    lines = [_json.loads(line) for line in open(t.path)]
+    img_paths = lines[0]["_images/x"]
+    assert len(img_paths) == 1 and img_paths[0].endswith(".png")
+    import os as _os
+
+    assert _os.path.exists(img_paths[0])
+    assert lines[1]["_table/t"] == {"columns": ["a", "b"], "data": [[1, 2]]}
+
+
+def test_accelerator_log_images_dispatch(fake_module, tmp_path):
+    """Accelerator.log_images routes to capable trackers and silently skips
+    trackers that don't override the base method."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.tracking import GeneralTracker
+
+    seen = []
+
+    class NoMedia(GeneralTracker):
+        name = "nomedia"
+        requires_logging_directory = False
+        main_process_only = True
+
+        def __init__(self):
+            super().__init__()
+
+        def store_init_configuration(self, values):
+            pass
+
+        def log(self, values, step=None, **kw):
+            seen.append(("log", values))
+
+    acc = Accelerator(log_with=["jsonl", NoMedia()], project_dir=str(tmp_path))
+    acc.init_trackers("proj")
+    acc.log_images({"img": [_gray(9)]}, step=1)
+    acc.log_table("t", columns=["a"], data=[[1]], step=1)
+    jsonl = acc.get_tracker("jsonl")
+    lines = open(jsonl.tracker).read().splitlines()
+    assert len(lines) == 2  # images + table records, no error from NoMedia
+    assert not seen  # NoMedia.log was never used as a media fallback
