@@ -1,11 +1,14 @@
 """``accelerate-tpu estimate-memory`` — HBM requirement estimator.
 
 Reference analogue: src/accelerate/commands/estimate.py (312 LoC — builds a
-meta-model from the Hub and prints a dtype table). Zero-egress version:
-estimates from a local safetensors checkpoint / config.json, or from a
-parameter count, and reports per-dtype totals for inference and Adam
-training (params + grads + 2 moments), plus how the total divides across a
-mesh.
+meta-model from the Hub and prints a dtype table). This version never
+instantiates a model: it estimates from a local safetensors checkpoint /
+config.json, a literal parameter count, or a **Hub repo id resolved
+metadata-only** (reference: estimate.py:34-116 pulls the full meta-model;
+here the parameter count comes from the local HF cache when present, else
+from safetensors header metadata over ranged requests — no weight download,
+no torch), and reports per-dtype totals for inference and Adam training
+(params + grads + 2 moments), plus how the total divides across a mesh.
 """
 
 from __future__ import annotations
@@ -39,6 +42,62 @@ def count_params_from_safetensors(path: str) -> int:
                 n *= d
             total += n
     return total
+
+
+def _repo_id_like(text: str) -> bool:
+    """``org/name`` shape that is not a local path and not a param count."""
+    import re
+
+    return bool(re.fullmatch(r"[\w.\-]+/[\w.\-]+", text))
+
+
+def count_params_from_hub(repo_id: str, token=None) -> tuple[int, str]:
+    """Parameter count for a Hub repo WITHOUT downloading weights or
+    instantiating a model (contrast reference estimate.py:64-116, which
+    builds the full meta-model via AutoModel). Returns ``(count, how)``.
+
+    Resolution order — offline-first so the zero-egress/airgapped case
+    works transparently:
+
+    1. local HF cache snapshot (``snapshot_download(local_files_only=True)``):
+       safetensors headers if weights are cached, else
+       ``model.safetensors.index.json`` ``total_size`` / dtype width;
+    2. ``get_safetensors_metadata`` — the Hub serves safetensors headers via
+       ranged requests, so this transfers a few KB for any model size.
+    """
+    try:
+        from huggingface_hub import snapshot_download
+
+        path = snapshot_download(repo_id, local_files_only=True)
+        n = count_params_from_safetensors(path)
+        if n:
+            return n, "local cache (safetensors headers)"
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                meta = json.load(f)
+            total_bytes = meta.get("metadata", {}).get("total_size")
+            if total_bytes:
+                bytes_per = 2  # safetensors LLM checkpoints are bf16/fp16 by default
+                cfg_path = os.path.join(path, "config.json")
+                if os.path.exists(cfg_path):
+                    with open(cfg_path) as f:
+                        dtype = json.load(f).get("torch_dtype", "bfloat16")
+                    bytes_per = DTYPE_BYTES.get(dtype, 2)
+                return total_bytes // bytes_per, f"local cache (index total_size / {bytes_per}B)"
+    except Exception:  # noqa: BLE001 — any cache miss falls through to the network
+        pass
+    try:
+        from huggingface_hub import get_safetensors_metadata
+
+        meta = get_safetensors_metadata(repo_id, token=token)
+        return sum(meta.parameter_count.values()), "hub safetensors metadata"
+    except Exception as e:  # noqa: BLE001 — surface one actionable message
+        raise RuntimeError(
+            f"could not resolve `{repo_id}` from the local HF cache or the Hub "
+            f"({type(e).__name__}: {e}). Offline alternatives: pass a local "
+            "safetensors path, or a parameter count like `7B`."
+        ) from e
 
 
 def estimate_table(num_params: int, mesh_devices: int = 1, training: bool = True) -> list[dict]:
@@ -75,9 +134,15 @@ def estimate_parser(subparsers=None):
         parser = subparsers.add_parser("estimate-memory", help="Estimate HBM requirements")
     else:
         parser = argparse.ArgumentParser("accelerate-tpu estimate-memory")
-    parser.add_argument("source", help="safetensors file/dir, or a parameter count like 7B / 124M / 350000")
+    parser.add_argument(
+        "source",
+        help="safetensors file/dir, a Hub repo id like meta-llama/Llama-3.2-1B "
+        "(resolved metadata-only), or a parameter count like 7B / 124M / 350000",
+    )
     parser.add_argument("--num_devices", type=int, default=1, help="mesh size to divide across")
     parser.add_argument("--inference_only", action="store_true")
+    parser.add_argument("--hbm_gb", type=float, default=16.0, help="per-device HBM for the fit column (v5e=16, v4=32, v5p=95)")
+    parser.add_argument("--token", default=None, help="Hub token for gated/private repos")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser
@@ -96,19 +161,29 @@ def parse_param_count(text: str) -> int:
 
 
 def estimate_command(args) -> int:
+    how = None
     if os.path.exists(args.source):
         num_params = count_params_from_safetensors(args.source)
+    elif _repo_id_like(args.source):
+        num_params, how = count_params_from_hub(args.source, token=getattr(args, "token", None))
     else:
         num_params = parse_param_count(args.source)
     rows = estimate_table(num_params, args.num_devices, training=not args.inference_only)
-    print(f"Memory estimate for {num_params:,} parameters over {args.num_devices} device(s):")
-    header = f"{'dtype':>10} | {'inference':>12} | {'train(Adam)':>12} | {'inf/device':>12} | {'train/device':>12}"
+    via = f" (via {how})" if how else ""
+    print(f"Memory estimate for {num_params:,} parameters over {args.num_devices} device(s){via}:")
+    hbm = getattr(args, "hbm_gb", 16.0) * 1024**3
+    header = (
+        f"{'dtype':>10} | {'inference':>12} | {'train(Adam)':>12} | {'inf/device':>12} | "
+        f"{'train/device':>12} | {'fits/device':>11}"
+    )
     print(header)
     print("-" * len(header))
     for r in rows:
+        per_dev = r["training_per_device"] if r["training_per_device"] is not None else r["inference_per_device"]
+        fits = "yes" if per_dev <= hbm else "no"
         print(
             f"{r['dtype']:>10} | {_human(r['inference_bytes']):>12} | {_human(r['training_bytes']):>12} | "
-            f"{_human(r['inference_per_device']):>12} | {_human(r['training_per_device']):>12}"
+            f"{_human(r['inference_per_device']):>12} | {_human(r['training_per_device']):>12} | {fits:>11}"
         )
     return 0
 

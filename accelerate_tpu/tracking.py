@@ -322,6 +322,8 @@ class WandBTracker(GeneralTracker):
         """(reference: tracking.py:392)."""
         import wandb
 
+        if data is None and dataframe is None:
+            raise ValueError("log_table needs `data` (with optional `columns`) or `dataframe`")
         self.log({table_name: wandb.Table(columns=columns, data=data, dataframe=dataframe)}, step=step, **kwargs)
 
     @on_main_process

@@ -40,6 +40,98 @@ def test_estimate_memory_param_count():
     assert "bfloat16" in result.stdout
 
 
+def _fake_hf_cache(tmp_path, repo="acme/tiny", n_rows=10, n_cols=20, index_only=False):
+    """A minimal HF hub cache: models--org--name/snapshots/<rev>/ with either
+    a real tiny safetensors file or just the index+config metadata."""
+    import struct
+
+    hf_home = tmp_path / "hf_home"
+    repo_dir = hf_home / "hub" / ("models--" + repo.replace("/", "--"))
+    snap = repo_dir / "snapshots" / "rev0"
+    snap.mkdir(parents=True)
+    (repo_dir / "refs").mkdir()
+    (repo_dir / "refs" / "main").write_text("rev0")
+    if index_only:
+        (snap / "model.safetensors.index.json").write_text(
+            json.dumps({"metadata": {"total_size": n_rows * n_cols * 2}, "weight_map": {}})
+        )
+        (snap / "config.json").write_text(json.dumps({"torch_dtype": "bfloat16"}))
+    else:
+        header = {"w": {"dtype": "F32", "shape": [n_rows, n_cols], "data_offsets": [0, n_rows * n_cols * 4]}}
+        hb = json.dumps(header).encode()
+        with open(snap / "model.safetensors", "wb") as f:
+            f.write(struct.pack("<Q", len(hb)))
+            f.write(hb)
+            f.write(b"\0" * (n_rows * n_cols * 4))
+    return hf_home
+
+
+def test_estimate_memory_hub_repo_from_cache(tmp_path):
+    """Repo-id source resolves offline from the local HF cache — no network,
+    no torch (reference: estimate.py:34-116 needs the full meta-model)."""
+    hf_home = _fake_hf_cache(tmp_path, n_rows=30, n_cols=10)
+    result = run_cli(
+        "estimate-memory", "acme/tiny",
+        env={**CPU_ENV, "HF_HOME": str(hf_home), "HF_HUB_OFFLINE": "1"},
+    )
+    assert result.returncode == 0, result.stderr
+    assert "300" in result.stdout and "local cache" in result.stdout
+
+
+def test_estimate_memory_hub_repo_index_only_cache(tmp_path):
+    """With only index.json + config.json cached (no weights), total_size /
+    dtype width gives the parameter count."""
+    hf_home = _fake_hf_cache(tmp_path, n_rows=40, n_cols=10, index_only=True)
+    result = run_cli(
+        "estimate-memory", "acme/tiny",
+        env={**CPU_ENV, "HF_HOME": str(hf_home), "HF_HUB_OFFLINE": "1"},
+    )
+    assert result.returncode == 0, result.stderr
+    assert "400" in result.stdout and "index total_size" in result.stdout
+
+
+def test_estimate_memory_hub_repo_unreachable(tmp_path):
+    """No cache + no network -> one actionable error naming the offline
+    alternatives, not a bare traceback."""
+    result = run_cli(
+        "estimate-memory", "acme/absent",
+        env={**CPU_ENV, "HF_HOME": str(tmp_path / "empty"), "HF_HUB_OFFLINE": "1"},
+    )
+    assert result.returncode != 0
+    assert "could not resolve" in result.stderr and "parameter count like `7B`" in result.stderr
+
+
+def test_estimate_memory_hub_metadata_mocked(monkeypatch, tmp_path):
+    """The network path sums get_safetensors_metadata parameter counts
+    (metadata-only ranged requests; no weight download)."""
+    import types
+
+    from accelerate_tpu.commands import estimate
+
+    monkeypatch.setenv("HF_HOME", str(tmp_path / "empty"))
+    import huggingface_hub
+
+    monkeypatch.setattr(
+        huggingface_hub,
+        "get_safetensors_metadata",
+        lambda repo_id, token=None: types.SimpleNamespace(parameter_count={"BF16": 1000, "F32": 24}),
+    )
+    n, how = estimate.count_params_from_hub("acme/remote")
+    assert n == 1024 and how == "hub safetensors metadata"
+
+
+def test_estimate_memory_fit_column():
+    """--hbm_gb drives a fits/device verdict (north-star sizing aid)."""
+    result = run_cli("estimate-memory", "7B", "--num_devices", "8", "--hbm_gb", "16")
+    assert result.returncode == 0
+    assert "fits/device" in result.stdout
+    single = run_cli("estimate-memory", "7B", "--hbm_gb", "16")
+    fp32 = [line for line in single.stdout.splitlines() if line.strip().startswith("float32")]
+    assert fp32 and fp32[0].rstrip().endswith("no")  # 104 GB Adam state on one 16 GB chip
+    sharded = [line for line in result.stdout.splitlines() if line.strip().startswith("float32")]
+    assert sharded and sharded[0].rstrip().endswith("yes")  # /8 brings it under HBM
+
+
 def test_config_roundtrip(tmp_path):
     cfg_path = tmp_path / "cfg.yaml"
     result = run_cli("config", "--default", "--config_file", str(cfg_path))
