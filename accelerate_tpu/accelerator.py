@@ -436,7 +436,15 @@ class Accelerator:
         With ``ParallelismPlugin(shard_optimizer_state=True)`` (ZeRO-1/2;
         reference: utils/deepspeed.py:253-294) the state is born sharded
         over the ``data`` axis via ``out_shardings`` — params stay
-        replicated, per-device optimizer memory divides by the dp degree."""
+        replicated, per-device optimizer memory divides by the dp degree.
+
+        With ``ParallelismPlugin(offload_optimizer=True)`` (ZeRO-offload /
+        FSDP cpu-offload analogue; reference: utils/dataclasses.py:1100-1180
+        ``offload_optimizer_device``, accelerator.py:1694-1750 cpu_offload)
+        the state is *born on* ``pinned_host`` memory-kind shardings — it
+        never materialises in HBM — and the jitted step streams it through
+        the device around the update (``_offload_transfers``). Composes
+        with ZeRO: the host copy keeps the data-axis layout."""
         if opt.opt_state is not None:
             return
         model = model or getattr(opt, "_model", None) or (self._models[-1] if self._models else None)
@@ -444,9 +452,68 @@ class Accelerator:
             return
         jax = _jax()
         shardings = self._zero_state_shardings(opt.optimizer, model)
-        opt.opt_state = jax.jit(opt.optimizer.init, out_shardings=shardings)(model.params)
+        init_shardings = shardings
+        plugin = self.state.parallelism_plugin
+        if plugin is not None and getattr(plugin, "offload_optimizer", False):
+            from .parallel.sharding import zero_optimizer_shardings
+
+            state_shapes = jax.eval_shape(opt.optimizer.init, model.params)
+            base = shardings
+            if base is None:  # param-matched layout, no ZeRO split
+                base = zero_optimizer_shardings(
+                    state_shapes, getattr(model, "param_shardings", None), self.mesh, axis=None
+                )
+            # scalar leaves (adam's step count) stay in device memory: XLA's
+            # SPMD partitioner rejects pinned_host placement on scalars
+            # ("Side-effect HLO must have sharding"), and they're 4 bytes
+            opt._offload_shardings = jax.tree_util.tree_map(
+                lambda s, shape: s if getattr(shape, "ndim", 0) == 0 else s.with_memory_kind("pinned_host"),
+                base,
+                state_shapes,
+            )
+        opt.opt_state = jax.jit(opt.optimizer.init, out_shardings=init_shardings)(model.params)
+        if getattr(opt, "_offload_shardings", None) is not None:
+            # move to the pinned_host home OUTSIDE jit: memory-kind
+            # out_shardings on init trip XLA's SPMD partitioner on the
+            # constant scalar leaves ("Side-effect HLO must have sharding").
+            # The transient HBM copy is just-born state (zeros for adam).
+            opt.opt_state = jax.device_put(opt.opt_state, opt._offload_shardings)
         opt._zero_shardings = shardings
         opt._model = model
+
+    def _offload_transfers(self, opt: AcceleratedOptimizer):
+        """``(pull, push)`` for a host-offloaded optimizer state, or
+        ``(None, None)`` when offload is off.
+
+        ``pull`` runs INSIDE the jitted step, at its top level (never inside
+        ``lax.cond`` — host-offload transfers are not legal in every
+        control-flow position): a host->device stream XLA's latency-hiding
+        scheduler can overlap with the forward/backward. ``push`` runs
+        OUTSIDE jit, after the step returns: XLA's CPU backend has no
+        device->pinned_host placement lowering inside a program (the
+        ``annotate_device_placement`` custom call is unimplemented for Host
+        targets, and the SPMD partitioner rejects it besides), while a plain
+        ``jax.device_put`` after the fact is an async D2H copy on every
+        backend. The updated state's device buffers are freed as soon as the
+        copy lands, restoring the between-steps HBM saving."""
+        host = getattr(opt, "_offload_shardings", None)
+        if host is None:
+            return None, None
+        jax = _jax()
+        kind = jax.devices()[0].default_memory().kind
+
+        def pull(st):
+            # per-leaf: only host-resident leaves transfer; scalar leaves
+            # (device-kind home) pass through untouched
+            return jax.tree_util.tree_map(
+                lambda x, s: (
+                    jax.device_put(x, s.with_memory_kind(kind)) if s.memory_kind == "pinned_host" else x
+                ),
+                st,
+                host,
+            )
+
+        return pull, (lambda st: jax.device_put(st, host))
 
     def _zero_state_shardings(self, optax_tx, model: Model):
         """ZeRO-1/2 ``NamedSharding`` pytree for ``optax_tx``'s state, or
@@ -665,7 +732,19 @@ class Accelerator:
 
             psgd_rank = powersgd_rank(compress_method)
 
+        offload_pull, offload_push = self._offload_transfers(optimizer)
+
         def step_fn(params, opt_state, grad_buf, mstate, batch, scale_state, do_sync, rng, clip_norm, comp_state):
+            # With offload, do_sync is a STATIC python bool (two compiled
+            # variants): a non-sync microbatch's program never touches the
+            # host-resident state, so grad accumulation amortizes the
+            # host<->HBM stream to once per sync boundary instead of
+            # multiplying it. Without offload it stays a traced scalar.
+            static_sync = isinstance(do_sync, bool)
+            if offload_pull is not None and (not static_sync or do_sync):
+                # host->HBM stream at the top of the program (not inside the
+                # sync cond — see _offload_transfers)
+                opt_state = offload_pull(opt_state)
             loss_scale = scale_state["scale"]
             new_comp_state = comp_state
 
@@ -744,10 +823,12 @@ class Accelerator:
                 params, opt_state, grad_buf = operand
                 return params, opt_state, grad_buf, jnp.float32(0.0), jnp.bool_(True)
 
-            if accum == 1:
+            if accum == 1 or (static_sync and do_sync):
                 new_params, new_opt, new_buf, gnorm, finite = apply_gradients(
                     (params, opt_state, grad_buf), clip_norm
                 )
+            elif static_sync:  # non-sync microbatch, compiled without the update
+                new_params, new_opt, new_buf, gnorm, finite = hold((params, opt_state, grad_buf))
             else:
                 new_params, new_opt, new_buf, gnorm, finite = jax.lax.cond(
                     do_sync,
@@ -755,10 +836,15 @@ class Accelerator:
                     hold,
                     (params, opt_state, grad_buf),
                 )
+            applied = accum == 1 or not static_sync or do_sync
             if zero_shardings is not None:
                 # pin the ZeRO-1/2 layout so XLA keeps moments (and the
-                # accumulation buffer: ZeRO-2) data-sharded across steps
-                new_opt = jax.lax.with_sharding_constraint(new_opt, zero_shardings)
+                # accumulation buffer: ZeRO-2) data-sharded across steps.
+                # Skip the (unchanged, possibly host-resident) state on a
+                # static non-sync program — the constraint would force a
+                # pointless transfer.
+                if applied:
+                    new_opt = jax.lax.with_sharding_constraint(new_opt, zero_shardings)
                 new_buf = jax.lax.with_sharding_constraint(new_buf, buf_shardings)
 
             new_scale_state = scale_state
@@ -792,7 +878,15 @@ class Accelerator:
         donate_args = ((0, 1, 2, 3) if has_state else (0, 1, 2)) if donate else ()
         if donate and psgd_rank is not None:
             donate_args = donate_args + (9,)  # the params-sized error-feedback carry
-        jitted = jax.jit(step_fn, donate_argnums=donate_args)
+        if offload_pull is not None:
+            # the host-resident state can't be donated to device outputs
+            # (memory-kind mismatch); its buffers are replaced by the push.
+            # do_sync turns static (two program variants) so non-sync
+            # microbatches never stream the state — see step_fn.
+            donate_args = tuple(i for i in donate_args if i != 1)
+            jitted = jax.jit(step_fn, donate_argnums=donate_args, static_argnums=(6,))
+        else:
+            jitted = jax.jit(step_fn, donate_argnums=donate_args)
 
         grad_buf = jax.jit(
             lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p),
@@ -860,7 +954,7 @@ class Accelerator:
                     getattr(model, "state", None) if has_state else None,
                     batch,
                     state_box["scale_state"],
-                    jnp.bool_(do_sync),
+                    bool(do_sync) if offload_push is not None else jnp.bool_(do_sync),
                     key_for_step(self.step),
                     jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
                     state_box["comp_state"],
@@ -868,7 +962,12 @@ class Accelerator:
             model.params = new_params
             if has_state:
                 model.state = new_state
-            optimizer.opt_state = new_opt
+            if offload_push is None:
+                optimizer.opt_state = new_opt
+            elif do_sync:
+                optimizer.opt_state = offload_push(new_opt)
+            # offload + non-sync: the state passed through the program
+            # untouched (and unstreamed) — nothing to write back
             state_box["grad_buf"] = new_buf
             state_box["scale_state"] = new_scale_state
             state_box["comp_state"] = new_comp
@@ -1070,12 +1169,15 @@ class Accelerator:
         cache_key = ("apply", id(opt))
         if cache_key not in self._jit_cache:
             apply_gradients = self._make_gradient_applier(opt.optimizer)
-            self._jit_cache[cache_key] = jax.jit(
-                lambda params, opt_state, grad_buf, clip: apply_gradients(
-                    (params, opt_state, grad_buf), clip
-                ),
-                donate_argnums=(0, 1, 2),
-            )
+            pull, _ = self._offload_transfers(opt)
+
+            def _apply(params, opt_state, grad_buf, clip):
+                if pull is not None:
+                    opt_state = pull(opt_state)
+                return apply_gradients((params, opt_state, grad_buf), clip)
+
+            donate = (0, 2) if pull is not None else (0, 1, 2)
+            self._jit_cache[cache_key] = jax.jit(_apply, donate_argnums=donate)
         with self._matmul_precision_ctx():
             new_params, new_opt, zero_buf, gnorm, finite = self._jit_cache[cache_key](
                 model.params,
@@ -1084,7 +1186,8 @@ class Accelerator:
                 _jnp().float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
             )
         model.params = new_params
-        opt.opt_state = new_opt
+        _, push = self._offload_transfers(opt)
+        opt.opt_state = new_opt if push is None else push(new_opt)
         self._grad_buffers[id(model)] = zero_buf
         self._grad_count = 0
         self._last_grad_norm = gnorm

@@ -267,6 +267,14 @@ def load_accelerator_state(accelerator, input_dir: str, **kwargs):
         path = inp / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
         if path.exists() and opt.opt_state is not None:
             opt.opt_state = _load_pytree(path, opt.opt_state, mesh=mesh)
+            host = getattr(opt, "_offload_shardings", None)
+            if host is not None:
+                # orbax restores into default (device) memory even when the
+                # abstract target carries a pinned_host kind — re-home the
+                # offloaded state so residence survives a resume
+                import jax
+
+                opt.opt_state = jax.device_put(opt.opt_state, host)
     for i, sched in enumerate(accelerator._schedulers):
         path = inp / f"{SCHEDULER_NAME}_{i}.json"
         if path.exists():

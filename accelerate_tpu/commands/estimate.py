@@ -45,10 +45,12 @@ def count_params_from_safetensors(path: str) -> int:
 
 
 def _repo_id_like(text: str) -> bool:
-    """``org/name`` shape that is not a local path and not a param count."""
+    """``org/name`` shape that is not a local path and not a param count.
+    A ``.safetensors`` suffix always means a (missing) local file — routing
+    it to the Hub would turn a path typo into a network timeout."""
     import re
 
-    return bool(re.fullmatch(r"[\w.\-]+/[\w.\-]+", text))
+    return bool(re.fullmatch(r"[\w.\-]+/[\w.\-]+", text)) and not text.endswith(".safetensors")
 
 
 def count_params_from_hub(repo_id: str, token=None) -> tuple[int, str]:

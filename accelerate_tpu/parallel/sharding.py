@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import re
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
@@ -164,7 +164,7 @@ def zero_optimizer_shardings(
     state_shapes: Any,
     param_shardings: Any,
     mesh: Mesh,
-    axis: str = "data",
+    axis: Optional[str] = "data",
 ) -> Any:
     """ZeRO-1/2 layout for optimizer state ("cross-replica weight-update
     sharding"): moments keep their parameter's sharding and additionally
@@ -183,7 +183,10 @@ def zero_optimizer_shardings(
     with ``layer_0/attn/q_proj/kernel``), so specs are looked up by path
     suffix. Scalars (step counts) and unmatched leaves stay replicated.
     """
-    n = mesh.shape.get(axis, 1)
+    # axis=None: param-matched layout only, no extra data-axis split
+    # (used for the host-offload tier, which wants the params' layout in
+    # pinned_host memory without implying ZeRO)
+    n = mesh.shape.get(axis, 1) if axis is not None else 1
     suffix_specs: dict[str, PartitionSpec] = {}
     if param_shardings is not None:
         for kp, s in jax.tree_util.tree_flatten_with_path(param_shardings)[0]:

@@ -290,6 +290,15 @@ class ParallelismPlugin(KwargsHandler):
     # ZeRO-1/2: shard optimizer state over the data axis even when params
     # are replicated ("cross-replica weight-update sharding")
     shard_optimizer_state: bool = False
+    # ZeRO-offload analogue (reference: DeepSpeedPlugin
+    # offload_optimizer_device / FSDP cpu_offload,
+    # utils/dataclasses.py:1100-1180): optimizer moments live on
+    # ``pinned_host`` memory-kind shardings and stream through HBM inside
+    # the jitted step — HBM high-water mark drops by the state bytes
+    # (2x fp32 params for Adam) at the cost of PCIe/host traffic per
+    # sync boundary. Composes with shard_optimizer_state (the host copy
+    # keeps the ZeRO layout).
+    offload_optimizer: bool = False
     # activation rematerialisation policy name (see accelerator.build_train_step)
     remat_policy: Optional[str] = None
     donate_state: bool = True
@@ -304,6 +313,7 @@ class ParallelismPlugin(KwargsHandler):
         return cls(
             mesh_config=MeshConfig.from_env(),
             shard_optimizer_state=parse_flag_from_env("ACCELERATE_SHARD_OPTIMIZER_STATE"),
+            offload_optimizer=parse_flag_from_env("ACCELERATE_OFFLOAD_OPTIMIZER"),
             remat_policy=os.environ.get("ACCELERATE_REMAT_POLICY") or None,
             grad_compression=os.environ.get("ACCELERATE_GRAD_COMPRESSION") or None,
         )
