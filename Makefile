@@ -1,7 +1,28 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style test test-slow test-all test-cli check-imports bench dryrun api-docs
+.PHONY: quality style test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+
+# Persistent XLA compile cache (tests/conftest.py points every run and its
+# subprocess children here). cache-pack snapshots a warm cache into a
+# shareable artifact; cache-seed restores it into an EMPTY dir only — a
+# half-written or corrupt cache segfaults XLA:CPU mid-suite, so a non-empty
+# dir is left alone (wipe with `rm -rf $(JAX_CACHE_DIR)` if a run dies with
+# a faulthandler dump, then re-seed). CI: store the artifact, `make
+# cache-seed test`. See docs/usage_guides/testing.md for measured times.
+JAX_CACHE_DIR ?= /tmp/accelerate_tpu_jax_cache
+JAX_CACHE_ARTIFACT ?= .cache/jax_compile_cache.tar.gz
+
+cache-pack:
+	@mkdir -p $(dir $(JAX_CACHE_ARTIFACT))
+	@tar -C $(JAX_CACHE_DIR) -czf $(JAX_CACHE_ARTIFACT) .
+	@du -h $(JAX_CACHE_ARTIFACT)
+
+cache-seed:
+	@if [ -f $(JAX_CACHE_ARTIFACT) ] && [ -z "$$(ls -A $(JAX_CACHE_DIR) 2>/dev/null)" ]; then \
+		mkdir -p $(JAX_CACHE_DIR) && tar -C $(JAX_CACHE_DIR) -xzf $(JAX_CACHE_ARTIFACT) && \
+		echo "seeded $(JAX_CACHE_DIR) from $(JAX_CACHE_ARTIFACT)"; \
+	else echo "cache-seed: nothing to do (no artifact, or cache already warm)"; fi
 
 # lint if ruff is installed (its exit code propagates); the zero-dep
 # AST/import gates always run
@@ -12,7 +33,7 @@ quality:
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi
 
-test:  # fast tier (addopts excludes -m slow)
+test: cache-seed  # fast tier (addopts excludes -m slow)
 	python -m pytest tests/ -q
 
 test-slow:  # subprocess/integration tier

@@ -132,6 +132,11 @@ def build_env(args, process_id: int = 0, num_processes: int = 1) -> dict:
         env["ACCELERATE_COORDINATOR_ADDRESS"] = f"{args.main_process_ip}:{port}"
         env["ACCELERATE_NUM_PROCESSES"] = str(num_processes)
         env["ACCELERATE_PROCESS_ID"] = str(process_id)
+        # local rank within this machine: the N-local-process testing
+        # launcher would otherwise make every process "local main"
+        # (state.local_process_index defaults to 0 for 1-proc-per-host pods)
+        procs_per_machine = num_processes // max(1, getattr(args, "num_machines", 1) or 1)
+        env["ACCELERATE_LOCAL_PROCESS_ID"] = str(process_id % max(1, procs_per_machine))
     if args.cpu or args.fake_devices:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)

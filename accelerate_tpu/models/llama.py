@@ -39,6 +39,13 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # HF-style rope_scaling dict ({"rope_type": "llama3"|"linear"|"yarn"|
+    # "longrope", "factor": ..., ...}); Llama-3.1/3.2 checkpoints require
+    # the llama3 rescale
+    rope_scaling: Optional[dict] = None
+    # HF keeps this at the config top level for Phi-3 longrope checkpoints;
+    # mirrors config.json's original_max_position_embeddings
+    original_max_position_embeddings: Optional[int] = None
     scan_layers: bool = True
     remat: bool = True
     # "auto": ring attention when the mesh seq axis is non-trivial, else
@@ -177,12 +184,146 @@ class RMSNorm(nn.Module):
         return normed * scale.astype(x.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def rope_frequencies(
+    d: int,
+    theta: float,
+    scaling: Optional[dict] = None,
+    *,
+    max_pos: Optional[int] = None,
+    seq_len: Optional[int] = None,
+    orig_max: Optional[int] = None,
+) -> tuple[jax.Array, float]:
+    """``(inverse frequencies, attention factor)`` for rotary embedding,
+    with HF-style ``rope_scaling`` applied (reference behavior: the
+    reference delegates models to ``transformers``, whose
+    ``ROPE_INIT_FUNCTIONS`` implement these; Llama-3.1/3.2 checkpoints
+    REQUIRE the ``llama3`` rescale or every rotary angle is wrong at every
+    position). The attention factor multiplies cos/sin (1.0 except
+    yarn/longrope).
+
+    Supported ``rope_type``s: ``default``; ``linear`` (position
+    interpolation: all frequencies / factor); ``llama3`` (piecewise
+    wavelength-dependent rescale with smooth interpolation band); ``yarn``
+    (NTK-by-parts ramp between interpolated and extrapolated frequencies,
+    mscale attention factor — DeepSeek/Qwen long-context); ``longrope``
+    (per-dimension short/long factor tables — Phi-3 128k; ``seq_len``, a
+    STATIC python int, selects the table like HF does from the runtime
+    length). Others (``dynamic`` NTK) raise rather than silently
+    mis-rotate.
+
+    longrope deployment contract (static shapes, unlike HF's per-forward
+    dynamic switch): plain forwards select by the input length; EVERY
+    cached-decode call — prefill included, generation.py always primes the
+    cache with ``decode=True`` — selects by the cache capacity
+    (``max_position_embeddings``), so one session never mixes rotary
+    tables. Deploying a 128k longrope checkpoint for short sessions?
+    Set ``max_position_embeddings`` to the session bound (e.g. 4096) and
+    the short table applies, matching HF for sub-original lengths — this
+    is also the knob Phi-3's own model card prescribes."""
+    import math
+
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if not scaling:
+        return freqs, 1.0
+    rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+    if rope_type == "default":
+        return freqs, 1.0
+    if rope_type == "linear":
+        return freqs / float(scaling["factor"]), 1.0
+    if rope_type == "llama3":
+        factor = float(scaling["factor"])
+        low_freq_factor = float(scaling.get("low_freq_factor", 1.0))
+        high_freq_factor = float(scaling.get("high_freq_factor", 4.0))
+        orig = float(scaling.get("original_max_position_embeddings", 8192))
+        low_freq_wavelen = orig / low_freq_factor
+        high_freq_wavelen = orig / high_freq_factor
+        wavelen = 2.0 * jnp.pi / freqs
+        # long wavelengths fully scaled, short ones untouched, the band
+        # between interpolated (HF _compute_llama3_parameters)
+        smooth = (orig / wavelen - low_freq_factor) / (high_freq_factor - low_freq_factor)
+        smoothed = (1.0 - smooth) * freqs / factor + smooth * freqs
+        scaled = jnp.where(wavelen > low_freq_wavelen, freqs / factor, smoothed)
+        return jnp.where(wavelen < high_freq_wavelen, freqs, scaled), 1.0
+    if rope_type == "yarn":
+        factor = float(scaling["factor"])
+        orig = float(scaling.get("original_max_position_embeddings") or orig_max or max_pos or 0)
+        if not orig:
+            raise ValueError("yarn rope_scaling needs original_max_position_embeddings or max_pos")
+        attention_factor = scaling.get("attention_factor")
+        mscale, mscale_all_dim = scaling.get("mscale"), scaling.get("mscale_all_dim")
+
+        def get_mscale(scale, m=1.0):
+            return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+        if attention_factor is None:
+            if mscale and mscale_all_dim:
+                attention_factor = get_mscale(factor, mscale) / get_mscale(factor, mscale_all_dim)
+            else:
+                attention_factor = get_mscale(factor)
+        beta_fast = scaling.get("beta_fast") or 32
+        beta_slow = scaling.get("beta_slow") or 1
+
+        def correction_dim(num_rotations):
+            return d * math.log(orig / (num_rotations * 2 * math.pi)) / (2 * math.log(theta))
+
+        low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+        if scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, d - 1)
+        if low == high:
+            high += 0.001  # HF's singularity guard
+        ramp = jnp.clip((jnp.arange(d // 2, dtype=jnp.float32) - low) / (high - low), 0, 1)
+        extrapolation_factor = 1.0 - ramp
+        inv = freqs / factor * (1 - extrapolation_factor) + freqs * extrapolation_factor
+        return inv, float(attention_factor)
+    if rope_type == "longrope":
+        # HF's config.json stores original_max_position_embeddings at the
+        # TOP level for Phi-3; accept it inside the dict or via orig_max,
+        # and refuse to guess — a silent max_pos fallback would pin the
+        # short table forever with attention factor 1.0
+        orig = int(scaling.get("original_max_position_embeddings") or orig_max or 0)
+        if not orig:
+            raise ValueError(
+                "longrope rope_scaling needs original_max_position_embeddings — put it in "
+                "the rope_scaling dict or set LlamaConfig.original_max_position_embeddings "
+                "(HF config.json keeps it at the top level)"
+            )
+        factor = scaling.get("factor")
+        if max_pos:
+            factor = max_pos / orig
+        attention_factor = scaling.get("attention_factor")
+        if attention_factor is None:
+            attention_factor = (
+                1.0 if not factor or factor <= 1.0 else math.sqrt(1 + math.log(factor) / math.log(orig))
+            )
+        use_long = seq_len is not None and seq_len > orig
+        ext = jnp.asarray(scaling["long_factor" if use_long else "short_factor"], jnp.float32)
+        return freqs / ext, float(attention_factor)
+    raise NotImplementedError(
+        f"rope_scaling type {rope_type!r} is not supported (default/linear/llama3/yarn/longrope are); "
+        "a silent fallback would mis-rotate every position"
+    )
+
+
+def rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float,
+    scaling: Optional[dict] = None,
+    *,
+    max_pos: Optional[int] = None,
+    seq_len: Optional[int] = None,
+    orig_max: Optional[int] = None,
+) -> jax.Array:
     """Rotary embedding over the last dim of [B, S, H, D]."""
     d = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs, attn_factor = rope_frequencies(
+        d, theta, scaling, max_pos=max_pos, seq_len=seq_len, orig_max=orig_max
+    )
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
     cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+    if attn_factor != 1.0:
+        cos, sin = cos * attn_factor, sin * attn_factor
     x1, x2 = x[..., ::2], x[..., 1::2]
     rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rotated.reshape(x.shape).astype(x.dtype)
@@ -237,8 +378,16 @@ class LlamaAttention(nn.Module):
         q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
         k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        # longrope's short/long table selection needs a STATIC length hint:
+        # prefill uses the (static) input length like HF's runtime switch;
+        # decode sees S=1, so the cache capacity stands in for it
+        rope_len = cfg.max_position_embeddings if decode else hidden.shape[1]
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling,
+                 max_pos=cfg.max_position_embeddings, seq_len=rope_len,
+                 orig_max=cfg.original_max_position_embeddings)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling,
+                 max_pos=cfg.max_position_embeddings, seq_len=rope_len,
+                 orig_max=cfg.original_max_position_embeddings)
         if decode:
             out = self._cached_attention(q, k, v)
         else:

@@ -130,8 +130,10 @@ class PartialState:
 
     @property
     def local_process_index(self) -> int:
-        # one process per host on TPU pods
-        return 0
+        # one process per host on TPU pods; the N-local-process testing
+        # launcher sets the env so rank gating (print/tqdm/local-main
+        # contexts) behaves like the reference's torchrun LOCAL_RANK
+        return int(os.environ.get("ACCELERATE_LOCAL_PROCESS_ID", 0))
 
     @property
     def is_main_process(self) -> bool:

@@ -20,6 +20,7 @@ instead of a bare traceback.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 import traceback
@@ -110,6 +111,139 @@ def _bert_step_flops(params, global_batch: int, seq_len: int) -> float:
         if not is_embedding(path)
     )
     return 6.0 * n_params * global_batch * seq_len
+
+
+def _llama_step_flops(params, global_batch: int, seq_len: int, cfg) -> float:
+    """6 * non-embedding-params * tokens, plus the attention-score FLOPs
+    (2*S^2*hidden per layer fwd, x3 with bwd, halved by causality) that the
+    params-based formula misses — material at seq 2048."""
+    import jax
+    import numpy as np
+
+    def is_embedding(path):
+        return any("embed" in getattr(k, "key", str(k)).lower() for k in path)
+
+    n_params = sum(
+        int(np.prod(x.shape))
+        for path, x in jax.tree_util.tree_flatten_with_path(params)[0]
+        if not is_embedding(path)
+    )
+    tokens = global_batch * seq_len
+    attn = 0.5 * 12.0 * cfg.num_hidden_layers * global_batch * seq_len**2 * cfg.hidden_size
+    # the tied lm_head projection lives under an 'embed' param path (so the
+    # filter above drops it) but its logits matmul is real compute
+    lm_head = 6.0 * tokens * cfg.hidden_size * cfg.vocab_size if cfg.tie_word_embeddings else 0.0
+    return 6.0 * n_params * tokens + attn + lm_head
+
+
+def run_llama_bench():
+    """Second headline: decoder-LM training at long sequence — llama-750M
+    class, seq 2048, flash attention + remat + scan-over-layers, fsdp x data
+    mesh degenerate to one chip (VERDICT r4 #3: the regime the long-context
+    kernels were built for; catches flash-bwd/remat regressions the BERT
+    bench can't see). Prints ONE JSON line."""
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import LlamaConfig, causal_lm_loss, create_llama_model
+    from accelerate_tpu.parallel.mesh import MeshConfig, batch_sharding
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import MixedPrecisionPolicy, ParallelismPlugin
+    from accelerate_tpu.utils.memory import find_executable_batch_size
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+    tiny = bool(os.environ.get("ACCELERATE_BENCH_FORCE_CPU"))
+    if tiny:
+        from accelerate_tpu.utils.environment import force_host_platform
+
+        force_host_platform(1)  # idempotent; needed when run standalone
+        cfg, seq_len, start_batch = LlamaConfig.tiny(), 128, 4
+    else:
+        # ~750M: the largest llama-class dense-Adam config that fits one
+        # 16 GB v5e with headroom (16 bytes/param of train state = 12.1 GB
+        # + seq-2048 boundary activations under remat)
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1536,
+            intermediate_size=6144,
+            num_hidden_layers=20,
+            num_attention_heads=12,
+            num_key_value_heads=6,
+            max_position_embeddings=2048,
+            tie_word_embeddings=True,
+        )
+        seq_len, start_batch = 2048, 8
+
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(data=-1, fsdp=1)),
+        kwargs_handlers=[MixedPrecisionPolicy(softmax_dtype="bfloat16")],
+    )
+    n_dev = accelerator.state.num_devices
+    devices = jax.devices()
+
+    model = accelerator.prepare_model(create_llama_model(cfg, seq_len=seq_len))
+    accelerator.prepare_optimizer(optax.adamw(3e-4, weight_decay=0.01))
+    step = accelerator.build_train_step(lambda p, b: causal_lm_loss(p, b, model.apply_fn))
+
+    rng = np.random.default_rng(0)
+
+    @find_executable_batch_size(starting_batch_size=start_batch)
+    def measure(batch_size):
+        global_batch = batch_size * accelerator.num_data_shards
+        batch = {
+            "input_ids": rng.integers(5, cfg.vocab_size - 1, size=(global_batch, seq_len)).astype(np.int32)
+        }
+        batch = jax.device_put(batch, batch_sharding(accelerator.mesh))
+        t_compile = time.perf_counter()
+        float(step(batch))  # compile; also surfaces OOM for the auto-halver
+        compile_s = time.perf_counter() - t_compile
+        for _ in range(2):
+            loss = step(batch)
+        float(loss)
+        n_steps = 5 if tiny else 12
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            loss = step(batch)
+        float(loss)
+        dt = time.perf_counter() - t0
+        return global_batch, dt / n_steps, compile_s
+
+    global_batch, step_s, compile_s = measure()
+    tokens_per_sec = global_batch * seq_len / step_s
+
+    device_kind = getattr(devices[0], "device_kind", "unknown")
+    peak = next(
+        (v for k, v in PEAK_BF16_TFLOPS.items() if k in str(device_kind).lower()),
+        PEAK_BF16_TFLOPS["v5e"],
+    )
+    flops_per_step = _llama_step_flops(model.params, global_batch, seq_len, cfg)
+    mfu = flops_per_step / step_s / (peak * 1e12 * n_dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_750m_seq2048_flash_train_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(mfu / 0.45, 3),  # target: MFU >= 0.45 at seq 2048
+                "step_time_ms": round(step_s * 1000, 2),
+                "mfu": round(mfu, 4),
+                "global_batch": global_batch,
+                "seq_len": seq_len,
+                "peak_bf16_tflops_assumed": peak,
+                "device_kind": str(device_kind),
+                "compile_s": round(compile_s, 1),
+                "n_devices": n_dev,
+                "baseline": "MFU 0.45 at seq 2048 with flash attention (VERDICT r4 #3 target)",
+            }
+        )
+    )
 
 
 def run_bench():
@@ -212,9 +346,11 @@ def run_bench():
 
 
 def main():
+    rc = 0
     try:
         run_bench()
     except Exception as e:
+        rc = 1
         print(
             json.dumps(
                 {
@@ -227,7 +363,26 @@ def main():
                 }
             )
         )
-        sys.exit(1)
+    # second headline (decoder-LM long-seq training); its failure must not
+    # mask a good BERT line and vice versa — each reports independently
+    try:
+        run_llama_bench()
+    except Exception as e:
+        rc = 1
+        print(
+            json.dumps(
+                {
+                    "metric": "llama_750m_seq2048_flash_train_tokens_per_sec",
+                    "value": 0.0,
+                    "unit": "tokens/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {str(e)[:400]}",
+                    "traceback_tail": traceback.format_exc().splitlines()[-3:],
+                }
+            )
+        )
+    if rc:
+        sys.exit(rc)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,95 @@
+"""A/B: XLA attention vs the Pallas kernel at BERT-headline shapes (S=128).
+
+The round-4 roofline (`README.md` step breakdown) left ~15 ms/step of
+fusion-boundary HBM traffic on the table and named a seq-128-shaped fused
+attention kernel as the candidate lever: at S=128 a single 128x128 block
+holds the whole score matrix in VMEM, so a one-block kernel never spills
+the [B,H,S,S] probabilities to HBM — the traffic XLA's fusion pays in both
+directions. The flash kernel's measured 2048 crossover was for its default
+multi-block configuration; this measures the degenerate one-block case.
+
+Prints one JSON line per variant (fwd and fwd+bwd). Decision rule: adopt
+the kernel for the BERT bench path only if fwd+bwd beats XLA by >3%.
+
+Usage: python benchmarks/attn_seq128_ab.py [--small]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+import argparse
+import json
+import time
+
+from _timing import force
+
+
+def bench(fn, args, steps):
+    out = fn(*args)
+    force(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    force(out)
+    return (time.perf_counter() - t0) / steps * 1000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+    if args.small:
+        from accelerate_tpu.utils.environment import force_host_platform
+
+        force_host_platform(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import dot_product_attention
+    from accelerate_tpu.ops.pallas_attention import pallas_flash_attention
+
+    # BERT-base headline shape: batch 256, 12 heads, seq 128, dim 64 (bf16)
+    b, s, h, d = (4, 128, 2, 32) if args.small else (256, 128, 12, 64)
+    steps = 3 if args.small else args.steps
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(key, (b, s, h, d), jnp.bfloat16) for key in ks)
+
+    variants = {
+        "xla": jax.jit(lambda q, k, v: dot_product_attention(q, k, v, use_flash=False)),
+        "pallas_1block": jax.jit(
+            lambda q, k, v: pallas_flash_attention(q, k, v, block_q=s, block_k=s)
+        ),
+    }
+
+    def loss_of(fn):
+        return jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+
+    import numpy as np
+
+    ref = np.asarray(variants["xla"](q, k, v), np.float32)
+    for name, fn in variants.items():
+        got = np.asarray(fn(q, k, v), np.float32)
+        err = float(np.max(np.abs(got - ref)))
+        fwd_ms = bench(fn, (q, k, v), steps)
+        bwd_ms = bench(loss_of(fn), (q, k, v), steps)
+        print(
+            json.dumps(
+                {
+                    "metric": f"attn_s{s}_{name}",
+                    "fwd_ms": round(fwd_ms, 3),
+                    "fwd_bwd_ms": round(bwd_ms, 3),
+                    "max_abs_err_vs_xla": err,
+                    "shape": [b, s, h, d],
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

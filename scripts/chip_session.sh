@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# One-shot on-chip measurement session (VERDICT r4 #1): run every benchmark
+# that needs real TPU hardware and collect JSON into benchmarks/chip_logs/.
+# Safe to re-run; each step is independently timeout-guarded so a tunnel
+# drop mid-session still leaves the earlier results on disk.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/chip_logs
+mkdir -p "$OUT"
+stamp=$(date +%Y%m%d_%H%M%S)
+
+probe() {
+  timeout 90 python -c "import jax; print('ndev', len(jax.devices()), jax.devices()[0].device_kind)" 2>/dev/null
+}
+
+if ! probe; then
+  echo "chip_session: backend unreachable; aborting" >&2
+  exit 2
+fi
+
+run_step() { # name, timeout_s, cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ==="
+  timeout "$tmo" "$@" 2>&1 | tee "$OUT/${name}_${stamp}.log"
+  # the benchmark's status, not tee's (124 = hit the timeout)
+  echo "rc=${PIPESTATUS[0]} -> $OUT/${name}_${stamp}.log"
+}
+
+# 1. the two headline lines the driver parses
+run_step bench 2400 python bench.py
+
+# 2. serving engine: continuous vs static batching (never had chip numbers)
+run_step serving 1800 python benchmarks/serving_throughput.py
+
+# 3. paged-attention kernel on hardware: token exactness + ms/token (the
+#    ONLY hardware validation of ops/pallas_paged_attention.py)
+run_step paged_check 1800 python benchmarks/paged_serving_chip_check.py
+
+# 4. big-model inference: int8/int4 decode confirmation
+run_step big_model 2400 python benchmarks/big_model_inference.py
+
+# 5. host-offload micro-bench: step-time cost + HBM saving
+run_step offload 1800 python benchmarks/offload_optimizer.py --steps 10
+
+# 6. seq-128 attention kernel A/B (the roofline's named MFU lever)
+run_step attn_ab 900 python benchmarks/attn_seq128_ab.py
+
+echo "chip_session: done; logs in $OUT (commit the JSON into benchmarks/README.md tables)"

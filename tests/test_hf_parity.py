@@ -53,6 +53,128 @@ def test_llama_import_matches_transformers(tmp_path):
     np.testing.assert_allclose(got, want, atol=TOL)
 
 
+def test_llama3_rope_scaling_matches_transformers(tmp_path):
+    """Llama-3.1/3.2-style rope_scaling (piecewise llama3 frequency
+    rescale): without it every rotary angle is wrong at every position,
+    so parity here gates real Llama-3.x checkpoint support."""
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.models.hub import load_hf_llama
+
+    scaling = {
+        "rope_type": "llama3",
+        "factor": 8.0,
+        "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0,
+        "original_max_position_embeddings": 32,
+    }
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+        rope_scaling=dict(scaling),
+    )
+    torch.manual_seed(2)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 48))  # long enough to cross the band
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, scan_layers=False, remat=False,
+        rope_scaling=dict(scaling),
+    )
+    model = load_hf_llama(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+    # and the guard: unsupported types refuse rather than mis-rotate
+    from accelerate_tpu.models.llama import rope_frequencies
+
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="dynamic"):
+        rope_frequencies(16, 1e4, {"rope_type": "dynamic", "factor": 2.0})
+
+
+def test_yarn_rope_scaling_matches_transformers(tmp_path):
+    """YaRN (NTK-by-parts) scaling — DeepSeek/Qwen long-context configs."""
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.models.hub import load_hf_llama
+
+    scaling = {"rope_type": "yarn", "factor": 4.0, "original_max_position_embeddings": 32}
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-6,
+        rope_scaling=dict(scaling),
+    )
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 48))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6, scan_layers=False, remat=False,
+        rope_scaling=dict(scaling),
+    )
+    model = load_hf_llama(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_longrope_scaling_matches_transformers(tmp_path):
+    """Phi-3-128k-style longrope: per-dim short/long factor tables selected
+    by sequence length, with the sqrt-log attention factor."""
+    import jax
+
+    from accelerate_tpu.models import Phi3Config
+    from accelerate_tpu.models.hub import load_hf_phi3
+
+    d_half = 8  # head_dim 16 -> 8 rope dims
+    short = [1.0 + 0.05 * i for i in range(d_half)]
+    long = [1.5 + 0.2 * i for i in range(d_half)]
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128, original_max_position_embeddings=32,
+        rope_theta=10000.0, rms_norm_eps=1e-6, sliding_window=None,
+        pad_token_id=0,  # the 32k-vocab default index overflows this tiny vocab
+        rope_scaling={"type": "longrope", "short_factor": short, "long_factor": long},
+    )
+    torch.manual_seed(4)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+
+    cfg = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128, rms_norm_eps=1e-6, sliding_window=None,
+        scan_layers=False, remat=False,
+        # original_max_position_embeddings at the TOP level, exactly like
+        # Phi-3's config.json (not inside the rope_scaling dict)
+        original_max_position_embeddings=32,
+        rope_scaling={"type": "longrope", "short_factor": short, "long_factor": long},
+    )
+    model = load_hf_phi3(_save(hf, tmp_path), cfg)
+    for S in (16, 48):  # below and above the 32-token switch point
+        ids = torch.randint(0, 128, (2, S))
+        with torch.no_grad():
+            want = hf(ids).logits.numpy()
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+        np.testing.assert_allclose(got, want, atol=TOL, err_msg=f"S={S}")
+
+
 def test_llama_import_scan_layers_matches_transformers(tmp_path):
     import jax
 
