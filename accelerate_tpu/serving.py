@@ -66,6 +66,12 @@ class _Request:
     max_new_tokens: int
     out_tokens: list
     prefix_id: Optional[int] = None
+    # per-request stop token-id sequences (engine eos still applies); a
+    # request finishes when its generated tail equals any sequence, with
+    # the stop tokens kept in the output (eos convention)
+    stop_sequences: tuple = ()
+    # log P(tok) for each generated token, aligned with out_tokens
+    out_lps: list = dataclasses.field(default_factory=list)
 
 
 class ServingEngine:
@@ -201,22 +207,31 @@ class ServingEngine:
         self.queue: collections.deque[_Request] = collections.deque()
         self.done: dict[int, np.ndarray] = {}
         self._done_new: dict[int, np.ndarray] = {}  # uid -> generated suffix only
+        self._done_lps: dict[int, np.ndarray] = {}  # uid -> per-generated-token logprobs
         self._uid = 0
         self._pool_blocked = False  # last admit pass hit pool exhaustion
 
         # ---- jitted programs (compiled once each) ----
+        def pick_lp(row, tok):
+            """log P(tok) under the model's FULL distribution at this step
+            (f32 log-softmax) — the standard serving logprob surface, even
+            when sampling is temperature/top-k shaped."""
+            return jax.nn.log_softmax(row.astype(jnp.float32))[tok]
+
         def prefill(params, ids, true_len, key):
-            """[1, B] padded prompt -> (first next-token, per-row cache with
-            write index reset to true_len, advanced key)."""
+            """[1, B] padded prompt -> (first next-token, its logprob,
+            per-row cache with write index reset to true_len, advanced
+            key)."""
             b_len = ids.shape[1]
             positions = jnp.broadcast_to(jnp.arange(b_len), (1, b_len))
             logits, cache = apply_fn(params, ids, positions=positions, decode=True, cache=None)
             key, sub = jax.random.split(key)
-            next_tok = sampler(logits[0, true_len - 1][None], sub)[0]
+            row = logits[0, true_len - 1]
+            next_tok = sampler(row[None], sub)[0]
             from .ops.kv_cache import reset_cache_index
 
             cache = reset_cache_index(cache, true_len)
-            return next_tok, cache, key
+            return next_tok, pick_lp(row, next_tok), cache, key
 
         key_aval = jax.eval_shape(lambda: jax.random.key(0))
         with self._trace_ctx():
@@ -247,7 +262,9 @@ class ServingEngine:
 
         def sample_at(logits, offset, key):
             key, sub = jax.random.split(key)
-            return sampler(logits[0, offset][None], sub)[0], key
+            row = logits[0, offset]
+            tok = sampler(row[None], sub)[0]
+            return tok, pick_lp(row, tok), key
 
         self._sample_at = ctx_jit(sample_at)
 
@@ -290,18 +307,18 @@ class ServingEngine:
         def make_tick(step_body):
             """K-step tick scaffold shared by both cache layouts:
             ``step_body(params, caches, toks, poss, keys) -> (caches,
-            next_toks, keys)`` advances every slot one token."""
+            next_toks, logprobs, keys)`` advances every slot one token."""
 
             def decode_tick(params, slot_caches, toks, poss, keys):
                 def block_step(carry, _):
                     caches, toks, poss, keys = carry
-                    caches, nxt, keys = step_body(params, caches, toks, poss, keys)
-                    return (caches, nxt, poss + 1, keys), nxt
+                    caches, nxt, lps, keys = step_body(params, caches, toks, poss, keys)
+                    return (caches, nxt, poss + 1, keys), (nxt, lps)
 
-                (slot_caches, _, _, keys), toks_k = jax.lax.scan(
+                (slot_caches, _, _, keys), (toks_k, lps_k) = jax.lax.scan(
                     block_step, (slot_caches, toks, poss, keys), None, length=tick_block
                 )
-                return slot_caches, toks_k, keys  # toks_k [K, slots]
+                return slot_caches, toks_k, lps_k, keys  # each [K, slots]
 
             return decode_tick
 
@@ -317,7 +334,8 @@ class ServingEngine:
                 split = jax.vmap(jax.random.split)(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 nxt = jax.vmap(lambda lg, s: sampler(lg[None], s)[0])(logits[:, -1], subs)
-                return cache, nxt, keys
+                lps = jax.vmap(pick_lp)(logits[:, -1], nxt)
+                return cache, nxt, lps, keys
 
             from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row, set_table_row
 
@@ -345,8 +363,9 @@ class ServingEngine:
                     params, tok.reshape(1, 1), positions=pos.reshape(1, 1), decode=True, cache=cache_row
                 )
                 key, sub = jax.random.split(key)
-                nxt = sampler(logits[0, -1][None], sub)[0]
-                return cache_row, nxt, key
+                row = logits[0, -1]
+                nxt = sampler(row[None], sub)[0]
+                return cache_row, nxt, pick_lp(row, nxt), key
 
             def dense_step(params, caches, toks, poss, keys):
                 return jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(params, caches, toks, poss, keys)
@@ -398,10 +417,10 @@ class ServingEngine:
                 )
             s_last, s = s_adj, e
         row_cache = self._reset_idx(row_cache, jnp.int32(t))
-        next_tok = None
+        next_tok = lp = None
         if key is not None:
-            next_tok, key = self._sample_at(logits, jnp.int32(t - 1 - s_last), key)
-        return next_tok, row_cache, key
+            next_tok, lp, key = self._sample_at(logits, jnp.int32(t - 1 - s_last), key)
+        return next_tok, lp, row_cache, key
 
     # ---- public API ----------------------------------------------------
 
@@ -417,7 +436,7 @@ class ServingEngine:
                 f"prefix length {len(toks)} leaves no room in the slot cache "
                 f"(max_len={self.max_len})"
             )
-        _, cache, _ = self._chunked_prefill(toks)
+        _, _, cache, _ = self._chunked_prefill(toks)
         pid = self._prefix_uid
         self._prefix_uid += 1
         entry = {"len": len(toks), "cache": cache, "tokens": toks}
@@ -481,15 +500,28 @@ class ServingEngine:
                 self._shared_refs.pop(bid)
                 self._alloc.free([bid])
 
-    def submit(self, prompt_ids, max_new_tokens: int = 32, prefix_id: Optional[int] = None) -> int:
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int = 32,
+        prefix_id: Optional[int] = None,
+        stop_sequences=None,
+    ) -> int:
         """Queue a prompt; returns a request id resolved via :meth:`poll`.
         With ``prefix_id``, ``prompt_ids`` is the SUFFIX after the registered
-        prefix (at least one token — its logits seed the first sample)."""
+        prefix (at least one token — its logits seed the first sample).
+        ``stop_sequences``: per-request token-id sequences (each a list of
+        ints) that end generation when they appear in the generated tail —
+        the token-level analogue of vLLM's ``stop``; the matched tokens stay
+        in the output like an EOS does."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         if len(prompt) == 0:
             raise ValueError("empty prompt" + (" suffix" if prefix_id is not None else ""))
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        stops = tuple(tuple(int(t) for t in s) for s in (stop_sequences or ()))
+        if any(len(s) == 0 for s in stops):
+            raise ValueError("empty stop sequence")
         plen = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -509,12 +541,26 @@ class ServingEngine:
                 )
         uid = self._uid
         self._uid += 1
-        self.queue.append(_Request(uid, prompt, max_new_tokens, [], prefix_id))
+        self.queue.append(_Request(uid, prompt, max_new_tokens, [], prefix_id, stops))
         return uid
 
     def poll(self, uid: int):
         """The finished [S + new] tokens for ``uid``, or None if pending."""
         return self.done.get(uid)
+
+    def _locate(self, uid: int):
+        """``("done"|"active"|"queued", req)`` for a known id (``req`` is
+        None once done); raises KeyError for unknown/cancelled ids. The
+        ONE request-lookup ladder behind every streaming accessor."""
+        if uid in self._done_new:
+            return "done", None
+        for req in self.slot_req:
+            if req is not None and req.uid == uid:
+                return "active", req
+        for req in self.queue:
+            if req.uid == uid:
+                return "queued", req
+        raise KeyError(f"unknown request id {uid}")
 
     def partial(self, uid: int) -> np.ndarray:
         """Tokens generated SO FAR for ``uid`` (streaming surface) —
@@ -522,15 +568,24 @@ class ServingEngine:
         completion, so a delta-by-length streamer never re-emits prompt
         tokens; ``poll`` returns the full prompt+output sequence. Raises
         KeyError for unknown (or cancelled) ids."""
-        if uid in self._done_new:
+        state, req = self._locate(uid)
+        if state == "done":
             return self._done_new[uid]
-        for req in self.slot_req:
-            if req is not None and req.uid == uid:
-                return np.asarray(req.out_tokens, np.int32)
-        for req in self.queue:
-            if req.uid == uid:
-                return np.zeros((0,), np.int32)
-        raise KeyError(f"unknown request id {uid}")
+        out = req.out_tokens if state == "active" else ()
+        return np.asarray(out, np.int32)
+
+    def logprobs(self, uid: int) -> np.ndarray:
+        """log P(token) for each GENERATED token so far, under the model's
+        full next-token distribution (f32 log-softmax — the standard
+        serving logprob surface even when sampling is temperature/top-k
+        shaped). Aligned with :meth:`partial` while decoding and with
+        :meth:`poll`'s generated suffix once finished; empty while queued.
+        Raises KeyError for unknown (or cancelled) ids."""
+        state, req = self._locate(uid)
+        if state == "done":
+            return self._done_lps[uid]
+        out = req.out_lps if state == "active" else ()
+        return np.asarray(out, np.float32)
 
     def cancel(self, uid: int) -> np.ndarray:
         """Abort a queued or decoding request, returning whatever tokens it
@@ -606,7 +661,7 @@ class ServingEngine:
                 bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, : len(req.prompt)] = req.prompt
-                next_tok, row_cache, key = self._prefill[bucket](
+                next_tok, lp, row_cache, key = self._prefill[bucket](
                     self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), key
                 )
                 total = len(req.prompt)
@@ -616,7 +671,7 @@ class ServingEngine:
                 # immutable, each request builds on its own copy
                 pre = self._prefixes[req.prefix_id] if req.prefix_id is not None else None
                 full = req.prompt if pre is None else np.concatenate([pre["tokens"], req.prompt])
-                next_tok, row_cache, key = self._chunked_prefill(
+                next_tok, lp, row_cache, key = self._chunked_prefill(
                     full,
                     row_cache=None if pre is None else pre["cache"],
                     done_upto=0 if pre is None else pre["len"],
@@ -636,6 +691,7 @@ class ServingEngine:
             tok = int(next_tok)
             self.slot_req[slot] = req
             req.out_tokens.append(tok)
+            req.out_lps.append(float(lp))
             if self._finished(req, tok):
                 self._retire(slot)
                 continue
@@ -645,17 +701,19 @@ class ServingEngine:
         if self.active_count == 0:
             return 0
 
-        self.slot_caches, toks_k, self._slot_keys = self._decode_tick(
+        self.slot_caches, toks_k, lps_k, self._slot_keys = self._decode_tick(
             self.model.params, self.slot_caches,
             jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos), self._slot_keys
         )
         toks_k = np.asarray(toks_k)  # [K, slots] — ONE host sync per block
+        lps_k = np.asarray(lps_k)
         for slot, req in enumerate(self.slot_req):
             if req is None:
                 continue
             for k in range(self.tick_block):
                 tok = int(toks_k[k, slot])
                 req.out_tokens.append(tok)
+                req.out_lps.append(float(lps_k[k, slot]))
                 self.slot_pos[slot] += 1
                 self.slot_tok[slot] = tok
                 if self._finished(req, tok):
@@ -719,6 +777,9 @@ class ServingEngine:
     def _finished(self, req: _Request, tok: int) -> bool:
         if self.eos_token_id is not None and tok == self.eos_token_id:
             return True
+        for seq in req.stop_sequences:
+            if len(req.out_tokens) >= len(seq) and req.out_tokens[-len(seq):] == list(seq):
+                return True
         return len(req.out_tokens) >= req.max_new_tokens
 
     def _trace_ctx(self):
@@ -772,6 +833,7 @@ class ServingEngine:
             parts.insert(0, self._prefixes[req.prefix_id]["tokens"])
         self.done[req.uid] = np.concatenate(parts)
         self._done_new[req.uid] = np.asarray(req.out_tokens, np.int32)
+        self._done_lps[req.uid] = np.asarray(req.out_lps, np.float32)
         self._release(slot)
 
     def _release(self, slot: int):

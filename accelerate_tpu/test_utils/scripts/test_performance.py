@@ -181,6 +181,44 @@ def run_pipe_trace(mesh_kwargs: dict, steps: int = 8):
     return losses
 
 
+def run_llama_trace(mesh_kwargs: dict, steps: int = 6):
+    """Decoder-LM training trace (VERDICT r4 weak #3: the BERT gate can't
+    see flash-bwd/remat/ring regressions): tiny llama with its production
+    defaults — scan-over-layers, remat, GQA, auto attention dispatch (ring
+    on seq-sharded meshes) — same data, fp32, per layout."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import LlamaConfig, causal_lm_loss, create_llama_model
+    from accelerate_tpu.parallel.mesh import MeshConfig, batch_sharding
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import set_seed
+    from accelerate_tpu.utils.dataclasses import ParallelismPlugin
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    set_seed(42)
+    acc = Accelerator(
+        mixed_precision="no",
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(**mesh_kwargs)),
+    )
+    seq_len = 32
+    model = acc.prepare_model(create_llama_model(LlamaConfig.tiny(), seq_len=seq_len))
+    acc.prepare_optimizer(optax.adamw(1e-3))
+    step = acc.build_train_step(lambda p, b: causal_lm_loss(p, b, model.apply_fn))
+    rng = np.random.default_rng(11)
+    ids = rng.integers(5, 250, size=(16, seq_len)).astype(np.int32)  # fixed global batch
+    batch = jax.device_put({"input_ids": ids}, batch_sharding(acc.mesh))
+    losses = []
+    for _ in range(steps):
+        loss = step(batch)
+        losses.append(float(jnp.asarray(loss)))
+    return losses
+
+
 def main():
     import jax
 
@@ -222,6 +260,18 @@ def main():
                 err_msg=f"fp32 MoE trajectory of {name} diverged from dp",
             )
         print(f"test_performance: MoE expert-axis trajectories match dp {moe_dp[:3]}...")
+
+        llama_dp = run_llama_trace({"data": 8})
+        for name, mesh_kwargs in {
+            "llama_fsdp": {"fsdp": 8},
+            "llama_dp_x_tp": {"data": 4, "tensor": 2},
+            "llama_dp_x_sp": {"data": 2, "seq": 4},  # ring attention in training
+        }.items():
+            np.testing.assert_allclose(
+                run_llama_trace(mesh_kwargs), llama_dp, rtol=2e-4,
+                err_msg=f"fp32 decoder trajectory of {name} diverged from dp",
+            )
+        print(f"test_performance: llama decoder trajectories match dp {llama_dp[:3]}...")
 
         pipe_dp = run_pipe_trace({"data": 8})
         for name, mesh_kwargs in {

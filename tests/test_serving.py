@@ -274,3 +274,70 @@ def test_gptneox_family_works_too():
     eng = ServingEngine(model, num_slots=2, prompt_buckets=(8,))
     [got] = eng.generate_many([prompt], max_new_tokens=4)
     np.testing.assert_array_equal(got, _reference(model, prompt, 4))
+
+
+def test_stop_sequences_end_generation(tiny_llama):
+    """Per-request stop sequences (vLLM `stop` analogue at the token
+    level): generation ends when the generated tail matches, the matched
+    tokens stay in the output, other requests are unaffected."""
+    prompt = np.ones((4,), np.int32)
+    full = _reference(tiny_llama, prompt, 8)
+    gen = full[len(prompt):]
+    stop = [int(gen[3]), int(gen[4])]  # a 2-token run generate actually emits
+    # first place the pair occurs (the engine must stop there, which is
+    # positions 3-4 unless the pair also shows up earlier in this output)
+    first = next(i for i in range(len(gen) - 1) if [int(gen[i]), int(gen[i + 1])] == stop)
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(4, 8))
+    u_stop = eng.submit(prompt, max_new_tokens=8, stop_sequences=[stop])
+    u_free = eng.submit(prompt, max_new_tokens=8)
+    while eng.poll(u_stop) is None or eng.poll(u_free) is None:
+        eng.step()
+    got_stop, got_free = eng.poll(u_stop), eng.poll(u_free)
+    np.testing.assert_array_equal(got_free, full)       # no stop: full output
+    assert len(got_stop) == len(prompt) + first + 2     # ends right at the match
+    np.testing.assert_array_equal(got_stop, full[: len(got_stop)])
+    assert list(got_stop[-2:]) == stop                  # stop tokens retained
+    assert eng.active_count == 0
+
+
+def test_stop_sequence_validation(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(4,))
+    with pytest.raises(ValueError, match="empty stop sequence"):
+        eng.submit(np.ones((2,), np.int32), stop_sequences=[[]])
+
+
+def test_logprobs_match_full_context_forward(tiny_llama):
+    """Per-token logprobs (vLLM-style surface): for greedy decoding they
+    must equal the f32 log-softmax of a FULL-context forward at each
+    generated position — one reference computation, both cache layouts."""
+    import jax
+
+    prompt = (np.arange(6) % 250).astype(np.int32)
+    for kwargs in ({}, {"paged_block_size": 4}):
+        eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8, 16), **kwargs)
+        uid = eng.submit(prompt, max_new_tokens=5)
+        while eng.poll(uid) is None:
+            eng.step()
+        full = eng.poll(uid)
+        lps = eng.logprobs(uid)
+        assert lps.shape == (5,) and lps.dtype == np.float32
+
+        logits = tiny_llama.apply_fn(tiny_llama.params, full[None, :-1].astype(np.int32))
+        ref_rows = np.asarray(logits[0], np.float32)
+        for i in range(5):
+            ctx = len(prompt) + i  # tokens seen before generating full[ctx]
+            row = ref_rows[ctx - 1]
+            want = row[full[ctx]] - np.log(np.exp(row - row.max()).sum()) - row.max()
+            np.testing.assert_allclose(lps[i], want, atol=2e-3, err_msg=f"{kwargs} token {i}")
+
+
+def test_logprobs_lifecycle(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
+    u1 = eng.submit(np.ones((4,), np.int32), max_new_tokens=3)
+    u2 = eng.submit(np.ones((5,), np.int32), max_new_tokens=3)  # queued behind u1
+    assert eng.logprobs(u2).shape == (0,)  # queued: empty
+    while eng.poll(u1) is None:
+        eng.step()
+    assert len(eng.logprobs(u1)) == 3
+    with pytest.raises(KeyError):
+        eng.logprobs(999)
