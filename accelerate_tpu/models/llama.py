@@ -276,6 +276,27 @@ def rope_frequencies(
         extrapolation_factor = 1.0 - ramp
         inv = freqs / factor * (1 - extrapolation_factor) + freqs * extrapolation_factor
         return inv, float(attention_factor)
+    if rope_type == "dynamic":
+        # dynamic NTK: the base grows with the deployed length so the
+        # longest wavelength always spans it (HF _compute_dynamic_ntk_
+        # parameters with seq_len pinned to the static deployment length —
+        # HF recomputes per forward, we specialize per compiled shape)
+        factor = float(scaling["factor"])
+        # NO max_pos fallback here: orig == deployed bound makes the formula
+        # cancel to base == theta — the scaling silently disabled exactly
+        # when the user relied on the guess (unlike yarn, where a wrong
+        # orig at least changes the numbers)
+        orig = float(scaling.get("original_max_position_embeddings") or orig_max or 0)
+        if not orig:
+            raise ValueError(
+                "dynamic rope_scaling needs the ORIGINAL context length — put "
+                "original_max_position_embeddings in the rope_scaling dict or set "
+                "LlamaConfig.original_max_position_embeddings (HF stores it as the "
+                "checkpoint's top-level max_position_embeddings)"
+            )
+        length = float(max(seq_len or 0, orig))
+        base = theta * ((factor * length / orig) - (factor - 1)) ** (d / (d - 2))
+        return 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)), 1.0
     if rope_type == "longrope":
         # HF's config.json stores original_max_position_embeddings at the
         # TOP level for Phi-3; accept it inside the dict or via orig_max,
@@ -300,7 +321,8 @@ def rope_frequencies(
         ext = jnp.asarray(scaling["long_factor" if use_long else "short_factor"], jnp.float32)
         return freqs / ext, float(attention_factor)
     raise NotImplementedError(
-        f"rope_scaling type {rope_type!r} is not supported (default/linear/llama3/yarn/longrope are); "
+        f"rope_scaling type {rope_type!r} is not supported "
+        "(default/linear/llama3/yarn/longrope/dynamic are); "
         "a silent fallback would mis-rotate every position"
     )
 

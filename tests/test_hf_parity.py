@@ -97,8 +97,42 @@ def test_llama3_rope_scaling_matches_transformers(tmp_path):
 
     import pytest as _pytest
 
-    with _pytest.raises(NotImplementedError, match="dynamic"):
-        rope_frequencies(16, 1e4, {"rope_type": "dynamic", "factor": 2.0})
+    with _pytest.raises(NotImplementedError, match="made_up"):
+        rope_frequencies(16, 1e4, {"rope_type": "made_up", "factor": 2.0})
+
+
+def test_dynamic_ntk_rope_scaling_matches_transformers(tmp_path):
+    """Dynamic NTK: base grows with the deployed length past the original
+    context. HF recomputes per forward seq_len; here the static input
+    length plays that role."""
+    import jax
+
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.models.hub import load_hf_llama
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32,  # HF's dynamic orig = config max
+        rope_theta=10000.0, rms_norm_eps=1e-6,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0},
+    )
+    torch.manual_seed(5)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 48))  # past the 32-token original context
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-6, scan_layers=False, remat=False,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0, "original_max_position_embeddings": 32},
+    )
+    model = load_hf_llama(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
 
 
 def test_yarn_rope_scaling_matches_transformers(tmp_path):
