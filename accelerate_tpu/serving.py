@@ -107,6 +107,8 @@ class ServingEngine:
         seed: int = 0,
         paged_block_size: Optional[int] = None,
         pool_blocks: Optional[int] = None,
+        draft_model=None,
+        gamma: int = 4,
     ):
         jax = _jax()
         jnp = jax.numpy
@@ -114,6 +116,26 @@ class ServingEngine:
         self.num_slots = num_slots
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.max_len = max_len or model.config.max_position_embeddings
+        # Speculative continuous batching: a draft model proposes gamma
+        # tokens per slot, ONE target forward verifies them (greedy
+        # accept-prefix; emitted tokens are exactly the target's own
+        # greedy stream). Constraints are enforced below: dense layout,
+        # temperature 0, bucket-sized prompts, no prefix caching.
+        self.draft_model = draft_model
+        self.gamma = int(gamma)
+        if draft_model is not None:
+            if paged_block_size is not None:
+                raise NotImplementedError("speculative serving is dense-layout only (no paged cache yet)")
+            if temperature != 0.0:
+                raise NotImplementedError("speculative serving is greedy-only (temperature=0)")
+            if self.gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            draft_cap = draft_model.config.max_position_embeddings
+            if self.max_len > draft_cap:
+                raise ValueError(
+                    f"max_len {self.max_len} exceeds the draft cache "
+                    f"(max_position_embeddings={draft_cap})"
+                )
         if self.max_len > model.config.max_position_embeddings:
             raise ValueError(
                 f"max_len {self.max_len} exceeds the model cache "
@@ -196,6 +218,17 @@ class ServingEngine:
                 params,
                 jnp.zeros((1, 1), jnp.int32),
             )
+            if draft_model is not None:
+                # the slot cache pytree becomes a {target, draft} pair; all
+                # the slot machinery (insert, tree zeros) is pytree-generic
+                _, d_cache0 = jax.eval_shape(
+                    lambda p, i: draft_model.apply_fn(
+                        p, i, positions=jnp.zeros((1, 1), jnp.int32), decode=True, cache=None
+                    ),
+                    draft_model.params,
+                    jnp.zeros((1, 1), jnp.int32),
+                )
+                cache0 = {"t": cache0, "d": d_cache0}
             self.slot_caches = jax.tree.map(
                 lambda l: jnp.zeros((num_slots, *l.shape), l.dtype), cache0
             )
@@ -234,14 +267,15 @@ class ServingEngine:
             return next_tok, pick_lp(row, next_tok), cache, key
 
         key_aval = jax.eval_shape(lambda: jax.random.key(0))
-        with self._trace_ctx():
-            self._prefill = {
-                b: jax.jit(prefill).lower(
-                    params, jax.ShapeDtypeStruct((1, b), jnp.int32),
-                    jax.ShapeDtypeStruct((), jnp.int32), key_aval
-                ).compile()
-                for b in self.prompt_buckets
-            }
+        if draft_model is None:  # speculative admits route to _spec_prefill
+            with self._trace_ctx():
+                self._prefill = {
+                    b: jax.jit(prefill).lower(
+                        params, jax.ShapeDtypeStruct((1, b), jnp.int32),
+                        jax.ShapeDtypeStruct((), jnp.int32), key_aval
+                    ).compile()
+                    for b in self.prompt_buckets
+                }
 
         # ---- chunked-prefill programs (long prompts / prefix suffixes) ----
         # one chunk size (the largest bucket) x {cold, warm}: compile count
@@ -372,6 +406,64 @@ class ServingEngine:
 
             self._decode_tick = ctx_jit(make_tick(dense_step))
 
+        if draft_model is not None:
+            # ---- speculative programs (dense layout; greedy) ----------
+            # One tick iteration per slot: speculative.py's shared
+            # draft-propose / target-verify core, vmapped over the slot
+            # axis — emitted tokens are exactly the target's greedy stream.
+            d_apply = draft_model.apply_fn
+            g = self.gamma
+            from .speculative import build_spec_step
+
+            _spec_core = build_spec_step(apply_fn, d_apply, g)
+
+            def spec_row_step(t_params, d_params, row_caches, tok, pos):
+                t_cache, d_cache, emit, lps, n_emit = _spec_core(
+                    t_params, d_params, row_caches["t"], row_caches["d"], tok, pos
+                )
+                # the slot's next fed token is the last emitted one
+                return {"t": t_cache, "d": d_cache}, emit, lps, n_emit, emit[n_emit - 1], pos + n_emit
+
+            def spec_tick(t_params, d_params, slot_caches, toks, poss):
+                def block_step(carry, _):
+                    caches, toks, poss = carry
+                    caches, emits, lps, n_emits, last, poss = jax.vmap(
+                        spec_row_step, in_axes=(None, None, 0, 0, 0)
+                    )(t_params, d_params, caches, toks, poss)
+                    return (caches, last, poss), (emits, lps, n_emits)
+
+                (slot_caches, _, poss), (emits_k, lps_k, n_k) = jax.lax.scan(
+                    block_step, (slot_caches, toks, poss), None, length=tick_block
+                )
+                # [K, slots, g+1] tokens/lps; [K, slots] emit counts
+                return slot_caches, emits_k, lps_k, n_k
+
+            self._spec_tick = ctx_jit(spec_tick)
+
+            from .ops.kv_cache import reset_cache_index
+
+            def spec_prefill(t_params, d_params, ids, true_len):
+                b_len = ids.shape[1]
+                positions = jnp.broadcast_to(jnp.arange(b_len), (1, b_len))
+                t_logits, t_cache = apply_fn(t_params, ids, positions=positions, decode=True, cache=None)
+                _, d_cache = d_apply(d_params, ids, positions=positions, decode=True, cache=None)
+                row = t_logits[0, true_len - 1].astype(jnp.float32)
+                first = jnp.argmax(row).astype(jnp.int32)
+                t_cache = reset_cache_index(t_cache, true_len)
+                d_cache = reset_cache_index(d_cache, true_len)
+                return first, jax.nn.log_softmax(row)[first], {"t": t_cache, "d": d_cache}
+
+            with self._trace_ctx():
+                self._spec_prefill = {
+                    b: jax.jit(spec_prefill).lower(
+                        params, draft_model.params,
+                        jax.ShapeDtypeStruct((1, b), jnp.int32), jax.ShapeDtypeStruct((), jnp.int32),
+                    ).compile()
+                    for b in self.prompt_buckets
+                }
+            # accept-rate telemetry: {"steps", "accepted", "emitted"}
+            self.spec_stats = {"steps": 0, "accepted": 0, "emitted": 0}
+
     # ---- chunked prefill (host driver) ----------------------------------
 
     def _chunked_prefill(self, full_tokens: np.ndarray, row_cache=None, done_upto: int = 0, key=None):
@@ -429,6 +521,8 @@ class ServingEngine:
         returned ``prefix_id`` copy its KV cache and prefill only their
         suffix. The finished output includes the prefix tokens."""
         toks = np.asarray(prefix_ids, np.int32).ravel()
+        if self.draft_model is not None:
+            raise NotImplementedError("speculative serving does not compose with prefix caching yet")
         if len(toks) == 0:
             raise ValueError("empty prefix")
         if len(toks) + 1 > self.max_len:
@@ -522,6 +616,19 @@ class ServingEngine:
         stops = tuple(tuple(int(t) for t in s) for s in (stop_sequences or ()))
         if any(len(s) == 0 for s in stops):
             raise ValueError("empty stop sequence")
+        if self.draft_model is not None:
+            if prefix_id is not None:
+                raise NotImplementedError("speculative serving does not compose with prefix caching yet")
+            if len(prompt) > max(self.prompt_buckets):
+                raise ValueError(
+                    f"speculative serving needs bucket-sized prompts "
+                    f"(len {len(prompt)} > largest bucket {max(self.prompt_buckets)})"
+                )
+            if len(prompt) + max_new_tokens + self.gamma > self.max_len:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) + gamma "
+                    f"({self.gamma}) headroom exceeds the slot cache ({self.max_len})"
+                )
         plen = 0
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
@@ -656,7 +763,17 @@ class ServingEngine:
                     write_row[i] = 0
             req = self.queue.popleft()
             key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
-            if req.prefix_id is None and len(req.prompt) <= max(self.prompt_buckets):
+            if self.draft_model is not None:
+                # speculative admit: both models prefill the prompt (greedy)
+                bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, : len(req.prompt)] = req.prompt
+                next_tok, lp, row_cache = self._spec_prefill[bucket](
+                    self.model.params, self.draft_model.params,
+                    jnp.asarray(padded), jnp.int32(len(req.prompt)),
+                )
+                total = len(req.prompt)
+            elif req.prefix_id is None and len(req.prompt) <= max(self.prompt_buckets):
                 # short prompt, no prefix: the one-shot fused program
                 bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
                 padded = np.zeros((1, bucket), np.int32)
@@ -700,6 +817,9 @@ class ServingEngine:
 
         if self.active_count == 0:
             return 0
+
+        if self.draft_model is not None:
+            return self._spec_decode_pass()
 
         self.slot_caches, toks_k, lps_k, self._slot_keys = self._decode_tick(
             self.model.params, self.slot_caches,
@@ -773,6 +893,48 @@ class ServingEngine:
         return [self.done[u] for u in uids]
 
     # ---- internals ------------------------------------------------------
+
+    def _spec_decode_pass(self) -> int:
+        """The speculative tick's host half: run ``tick_block`` draft+verify
+        iterations on device, then walk the variable per-slot emit counts
+        (``n_emit = accepted + 1`` tokens per iteration) exactly like the
+        one-token tick walks its block — overshoot past retirement is
+        discarded identically."""
+        jnp = _jax().numpy
+        self.slot_caches, emits_k, lps_k, n_k = self._spec_tick(
+            self.model.params, self.draft_model.params, self.slot_caches,
+            jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos),
+        )
+        emits_k = np.asarray(emits_k)  # [K, slots, gamma+1]
+        lps_k = np.asarray(lps_k)
+        n_k = np.asarray(n_k)  # [K, slots]
+        for slot, req in enumerate(self.slot_req):
+            if req is None:
+                continue
+            retired = False
+            for k in range(self.tick_block):
+                n = int(n_k[k, slot])
+                self.spec_stats["steps"] += 1  # one target forward spent
+                walked = 0
+                for j in range(n):
+                    tok = int(emits_k[k, slot, j])
+                    req.out_tokens.append(tok)
+                    req.out_lps.append(float(lps_k[k, slot, j]))
+                    walked += 1
+                    self.slot_pos[slot] += 1
+                    self.slot_tok[slot] = tok
+                    if self._finished(req, tok):
+                        self._retire(slot)
+                        retired = True
+                        break
+                # only USED tokens count (a mid-run EOS discards the rest;
+                # the correction/bonus token is target-sourced, not a
+                # draft acceptance) — matches speculative_generate's stats
+                self.spec_stats["emitted"] += walked
+                self.spec_stats["accepted"] += min(walked, n - 1)
+                if retired:
+                    break
+        return self.active_count
 
     def _finished(self, req: _Request, tok: int) -> bool:
         if self.eos_token_id is not None and tok == self.eos_token_id:

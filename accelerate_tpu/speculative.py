@@ -38,6 +38,77 @@ def _jax():
 
 
 
+def build_spec_step(t_apply, d_apply, gamma: int):
+    """The draft-propose / target-verify core shared by
+    :func:`speculative_generate` (batch-1 host loop) and the serving
+    engine's speculative tick (vmapped over slots):
+
+    ``(t_params, d_params, t_cache, d_cache, last_tok, pos) ->
+    (t_cache, d_cache, emit [gamma+1], lps [gamma+1], n_emit)``
+
+    ``pos`` is the cache frontier (= valid entries in BOTH caches;
+    ``last_tok`` is emitted-but-not-yet-cached). The draft proposes
+    ``gamma`` tokens autoregressively, one target forward scores them
+    all, the longest prefix matching the target's own argmax is accepted
+    and the target's correction (or bonus) token appended — so
+    ``n_emit = accepted + 1`` and the emitted stream equals plain greedy
+    target decode. ``lps`` are the target's f32 log-softmax of each
+    emitted token. Both cache frontiers are reset to ``pos + n_emit``;
+    stale speculative rows beyond are overwritten before the causal
+    frontier reaches them (serving.py's padded-prefill argument)."""
+    jax = _jax()
+    jnp = jax.numpy
+    from .ops.kv_cache import reset_cache_index
+
+    g = gamma
+
+    def spec_step(t_params, d_params, t_cache, d_cache, last_tok, pos):
+        def draft_one(carry, _):
+            d_cache, tok, p = carry
+            logits, d_cache = d_apply(
+                d_params, tok.reshape(1, 1), positions=p.reshape(1, 1), decode=True, cache=d_cache
+            )
+            nxt = jnp.argmax(logits[0, -1].astype(jnp.float32)).astype(jnp.int32)
+            return (d_cache, nxt, p + 1), nxt
+
+        (d_cache, d_last, _), drafts = jax.lax.scan(
+            draft_one, (d_cache, last_tok, pos), None, length=g
+        )  # drafts [g] = tokens for positions pos+1..pos+g
+        # one extra draft pass caches d_last's own row (needed when every
+        # draft is accepted — the next iteration's frontier includes it)
+        _, d_cache = d_apply(
+            d_params, d_last.reshape(1, 1), positions=(pos + g).reshape(1, 1),
+            decode=True, cache=d_cache,
+        )
+
+        # target scores last_tok + ALL g drafts in ONE pass: logits[j] is
+        # the target's token for position pos+j+1, so t_argmax[g] is the
+        # bonus token when every draft matches
+        fed = jnp.concatenate([last_tok[None], drafts])  # [g+1]
+        positions = (pos + jnp.arange(g + 1))[None]
+        t_logits, t_cache = t_apply(
+            t_params, fed[None], positions=positions, decode=True, cache=t_cache
+        )
+        rows = t_logits[0].astype(jnp.float32)  # [g+1, V]
+        t_argmax = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+
+        matches = drafts == t_argmax[:g]
+        n_acc = jnp.argmin(jnp.concatenate([matches, jnp.array([False])])).astype(jnp.int32)
+        emit = jnp.where(
+            jnp.arange(g + 1) < n_acc, jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]), 0
+        )
+        emit = emit.at[n_acc].set(t_argmax[n_acc])
+        n_emit = n_acc + 1
+        lps = jax.vmap(lambda r, t: jax.nn.log_softmax(r)[t])(rows, emit)
+
+        new_frontier = pos + n_emit
+        t_cache = reset_cache_index(t_cache, new_frontier)
+        d_cache = reset_cache_index(d_cache, new_frontier)
+        return t_cache, d_cache, emit, lps, n_emit
+
+    return spec_step
+
+
 def speculative_generate(
     target_model,
     draft_model,
@@ -100,58 +171,16 @@ def speculative_generate(
             first = jnp.argmax(t_logits[0, -1].astype(jnp.float32)).astype(jnp.int32)
             return first, t_cache, d_cache
 
+        _core = build_spec_step(t_apply, d_apply, gamma)
+
         @jax.jit
         def spec_step(t_params, d_params, t_cache, d_cache, last_tok, pos):
-            """One iteration at frontier ``pos`` (= entries valid in both
-            caches; ``last_tok`` is the emitted-but-not-yet-cached token).
-            Returns (tokens [gamma+1], n_emit, t_cache, d_cache)."""
-
-            # 1) draft proposes gamma tokens autoregressively
-            def draft_one(carry, _):
-                d_cache, tok, p = carry
-                logits, d_cache = d_apply(
-                    d_params, tok.reshape(1, 1), positions=p.reshape(1, 1), decode=True, cache=d_cache
-                )
-                nxt = jnp.argmax(logits[0, -1].astype(jnp.float32)).astype(jnp.int32)
-                return (d_cache, nxt, p + 1), nxt
-
-            (d_cache, d_last, _), drafts = jax.lax.scan(
-                draft_one, (d_cache, last_tok, pos), None, length=gamma
-            )  # drafts [gamma] = tokens for positions pos+1..pos+gamma
-            # one extra draft pass caches d_gamma's row (needed when every
-            # draft is accepted — the next iteration's frontier includes it)
-            _, d_cache = d_apply(
-                d_params, d_last.reshape(1, 1), positions=(pos + gamma).reshape(1, 1),
-                decode=True, cache=d_cache,
+            """One iteration at frontier ``pos`` (shared core; the batch-1
+            host loop discards the logprob tail). Returns
+            (tokens [gamma+1], n_emit, t_cache, d_cache)."""
+            t_cache, d_cache, emit, _, n_emit = _core(
+                t_params, d_params, t_cache, d_cache, last_tok, pos
             )
-
-            # 2) target scores last_tok + ALL gamma drafts in ONE pass:
-            # logits[j] is the target's token for position pos+j+1, so
-            # t_argmax[gamma] is the bonus token when every draft matches
-            fed = jnp.concatenate([last_tok[None], drafts])  # [gamma+1]
-            positions = (pos + jnp.arange(gamma + 1))[None]
-            t_logits, t_cache = t_apply(
-                t_params, fed[None], positions=positions, decode=True, cache=t_cache
-            )
-            t_argmax = jnp.argmax(t_logits[0].astype(jnp.float32), axis=-1).astype(jnp.int32)  # [gamma+1]
-
-            # 3) longest matching prefix; correction (or bonus) appended
-            matches = drafts == t_argmax[:gamma]  # [gamma]
-            n_acc = jnp.argmin(jnp.concatenate([matches, jnp.array([False])])).astype(jnp.int32)
-            emit = jnp.where(
-                jnp.arange(gamma + 1) < n_acc, jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]), 0
-            )
-            emit = emit.at[n_acc].set(t_argmax[n_acc])
-            n_emit = n_acc + 1
-
-            # 4) frontier reset: pos+n_emit entries are now valid; stale
-            # rows beyond get overwritten before the causal frontier
-            # reaches them (serving.py prefill argument)
-            from .ops.kv_cache import reset_cache_index
-
-            new_frontier = pos + n_emit
-            t_cache = reset_cache_index(t_cache, new_frontier)
-            d_cache = reset_cache_index(d_cache, new_frontier)
             return emit, n_emit, t_cache, d_cache
 
         runners[key] = (prefill, spec_step, d_apply)

@@ -31,11 +31,21 @@ def main():
         np.testing.assert_array_equal(out, want)
     print(f"served {len(prompts)} mixed-length requests through 2 slots, token-exact")
 
-    # incremental submission (a server loop shape)
-    uid = engine.submit(prompts[0], max_new_tokens=4)
+    # incremental submission (a server loop shape): streaming partial(),
+    # per-token logprobs, and a per-request stop sequence
+    gen = outs[0][len(prompts[0]):]
+    uid = engine.submit(
+        prompts[0], max_new_tokens=8, stop_sequences=[[int(gen[1]), int(gen[2])]]
+    )
     while engine.poll(uid) is None:
         engine.step()
-    print("incremental request done:", engine.poll(uid)[-4:].tolist())
+    lps = engine.logprobs(uid)
+    final = engine.poll(uid)
+    assert len(final) < len(outs[0]), "stop sequence should end generation early"
+    print(
+        f"incremental request stopped at the 2-token stop sequence: "
+        f"{final[-4:].tolist()}, logprobs {np.round(lps, 2).tolist()}"
+    )
 
     # paged KV cache: pool capacity set by tokens in flight, not
     # slots x max_len (128 here) — a 14-block pool serves 4 slots

@@ -1,0 +1,112 @@
+"""Speculative continuous batching (serving.py draft_model mode): a draft
+model proposes gamma tokens per slot, one target forward verifies them —
+emitted streams are exactly the target's greedy output at both acceptance
+extremes, with variable per-iteration emit counts threading correctly
+through slot reuse, EOS/stop retirement, streaming, and logprobs."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import LlamaConfig, create_llama_model
+from accelerate_tpu.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def target():
+    return create_llama_model(LlamaConfig.tiny(), seq_len=64, seed=0)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # a different (1-layer, different-init) model: near-zero acceptance,
+    # so every token comes from the correction path
+    return create_llama_model(LlamaConfig.tiny(num_hidden_layers=1), seq_len=64, seed=1)
+
+
+def _reference(model, prompt, n):
+    return np.asarray(generate(model, np.asarray(prompt, np.int32)[None], max_new_tokens=n))[0]
+
+
+def test_disjoint_draft_token_exact(target, draft):
+    """Low-acceptance regime: outputs still exactly match target greedy."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (5, 9, 3, 12)]
+    eng = ServingEngine(
+        target, num_slots=2, prompt_buckets=(8, 16), tick_block=2, draft_model=draft, gamma=3
+    )
+    for p, got in zip(prompts, eng.generate_many(prompts, max_new_tokens=6)):
+        np.testing.assert_array_equal(got, _reference(target, p, 6))
+    # 6 tokens per request, minus the one emitted by admission prefill
+    assert eng.spec_stats["emitted"] == 4 * (6 - 1), eng.spec_stats
+
+
+def test_self_draft_full_acceptance(target):
+    """draft == target: every proposal matches the target's own argmax, so
+    each iteration emits gamma+1 tokens (the all-accepted bonus path and
+    the extra draft cache pass both exercised)."""
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (5, 9)]
+    eng = ServingEngine(
+        target, num_slots=2, prompt_buckets=(8, 16), tick_block=2, draft_model=target, gamma=3
+    )
+    for p, got in zip(prompts, eng.generate_many(prompts, max_new_tokens=9)):
+        np.testing.assert_array_equal(got, _reference(target, p, 9))
+    rate = eng.spec_stats["accepted"] / (eng.spec_stats["steps"] * 3)
+    assert rate == 1.0, eng.spec_stats
+
+
+def test_spec_streaming_logprobs_and_stop(target, draft):
+    eng = ServingEngine(
+        target, num_slots=1, prompt_buckets=(8,), tick_block=2, draft_model=draft, gamma=2
+    )
+    prompt = np.ones((4,), np.int32)
+    full = _reference(target, prompt, 8)
+    gen = full[len(prompt):]
+    stop = [int(gen[2]), int(gen[3])]
+    first = next(i for i in range(len(gen) - 1) if [int(gen[i]), int(gen[i + 1])] == stop)
+    uid = eng.submit(prompt, max_new_tokens=8, stop_sequences=[stop])
+    while eng.poll(uid) is None:
+        assert len(eng.partial(uid)) == len(eng.logprobs(uid))
+        eng.step()
+    final = eng.poll(uid)
+    assert len(final) == len(prompt) + first + 2
+    np.testing.assert_array_equal(final, full[: len(final)])
+    assert np.all(eng.logprobs(uid) <= 0)
+
+
+def test_spec_eos_and_slot_reuse(target, draft):
+    """EOS retires mid-iteration (overshoot within the accepted run is
+    discarded) and the freed slot serves the next request token-exact."""
+    prompt = np.ones((4,), np.int32)
+    full = _reference(target, prompt, 8)
+    eos = int(full[6])
+    eng = ServingEngine(
+        target, num_slots=1, prompt_buckets=(8,), tick_block=3,
+        draft_model=draft, gamma=3, eos_token_id=eos,
+    )
+    u1 = eng.submit(prompt, max_new_tokens=8)
+    u2 = eng.submit((np.arange(5) % 200).astype(np.int32), max_new_tokens=4)
+    while eng.poll(u1) is None or eng.poll(u2) is None:
+        eng.step()
+    got1 = eng.poll(u1)
+    assert got1[-1] == eos and len(got1) <= len(full)
+    np.testing.assert_array_equal(got1, full[: len(got1)])
+    np.testing.assert_array_equal(
+        eng.poll(u2), _reference(target, (np.arange(5) % 200).astype(np.int32), 4)
+    )
+    assert eng.active_count == 0
+
+
+def test_spec_mode_constraints(target, draft):
+    with pytest.raises(NotImplementedError, match="dense-layout"):
+        ServingEngine(target, draft_model=draft, paged_block_size=4)
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        ServingEngine(target, draft_model=draft, temperature=0.7)
+    eng = ServingEngine(target, num_slots=1, prompt_buckets=(8,), draft_model=draft, max_len=32)
+    with pytest.raises(ValueError, match="bucket-sized"):
+        eng.submit(np.ones((20,), np.int32))
+    with pytest.raises(NotImplementedError, match="prefix caching"):
+        eng.register_prefix(np.ones((4,), np.int32))
+    with pytest.raises(ValueError, match="gamma"):
+        eng.submit(np.ones((4,), np.int32), max_new_tokens=30)  # 4+30+gamma > 32
