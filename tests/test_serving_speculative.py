@@ -110,3 +110,22 @@ def test_spec_mode_constraints(target, draft):
         eng.register_prefix(np.ones((4,), np.int32))
     with pytest.raises(ValueError, match="gamma"):
         eng.submit(np.ones((4,), np.int32), max_new_tokens=30)  # 4+30+gamma > 32
+
+
+def test_spec_serving_sharded_target(target, draft):
+    """Speculative serving over a TP-sharded target (shard_model): the
+    draft stays replicated, tokens equal unsharded target greedy."""
+    from accelerate_tpu.big_modeling import shard_model
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (5, 7)]
+    want = [_reference(target, p, 5) for p in prompts]
+
+    sharded = create_llama_model(LlamaConfig.tiny(), seq_len=64, seed=0)
+    shard_model(sharded, MeshConfig(data=2, fsdp=2, tensor=2).build())
+    eng = ServingEngine(
+        sharded, num_slots=2, prompt_buckets=(8, 16), tick_block=2, draft_model=draft, gamma=3
+    )
+    for w, got in zip(want, eng.generate_many(prompts, max_new_tokens=5)):
+        np.testing.assert_array_equal(got, w)
