@@ -129,3 +129,5 @@ def test_spec_serving_sharded_target(target, draft):
     )
     for w, got in zip(want, eng.generate_many(prompts, max_new_tokens=5)):
         np.testing.assert_array_equal(got, w)
+    # pin that the SPECULATIVE path ran (not a silent greedy fallback)
+    assert eng.spec_stats["emitted"] == 2 * (5 - 1), eng.spec_stats
