@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/gemma/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/gemma/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -53,6 +53,12 @@ from .qwen2 import (
     Qwen2Config,
     Qwen2Model,
     create_qwen2_model,
+)
+from .qwen3 import (
+    QWEN3_SHARDING_RULES,
+    Qwen3Config,
+    Qwen3Model,
+    create_qwen3_model,
 )
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
@@ -118,6 +124,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_mixtral,
     load_hf_phi3,
     load_hf_qwen2,
+    load_hf_qwen3,
     load_hf_t5,
     load_hf_vit,
     load_hf_clip,

@@ -152,6 +152,9 @@ _LLAMA_LAYER = {
     "mlp.down_proj.weight": ("mlp/down_proj/kernel", True),
     "input_layernorm.weight": ("input_norm/scale", False),
     "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
+    # Qwen3 per-head q/k RMSNorm scales ([head_dim], shared across heads)
+    "self_attn.q_norm.weight": ("attn/q_norm/scale", False),
+    "self_attn.k_norm.weight": ("attn/k_norm/scale", False),
 }
 
 
@@ -181,13 +184,21 @@ def _rope_interleave_permute(kernel: np.ndarray, head_dim: int) -> np.ndarray:
 
 
 def convert_hf_llama_state(
-    state: dict[str, np.ndarray], scan_layers: bool, num_heads: int, num_kv_heads: int
+    state: dict[str, np.ndarray],
+    scan_layers: bool,
+    num_heads: int,
+    num_kv_heads: int,
+    require: tuple = (),
 ) -> dict:
     """HF ``*ForCausalLM`` Llama -> our param pytree. With ``scan_layers``
     the per-layer weights are stacked along a leading layer dim to match
     the scanned module layout (``layers/block/...``). q/k kernels are
     re-paired for the interleaved rope convention (see
-    :func:`_rope_interleave_permute`)."""
+    :func:`_rope_interleave_permute`). ``require`` adds family-OPTIONAL
+    param names (``attn/q_norm/scale`` etc.) to the every-layer
+    completeness check — loaders pass the families their config demands,
+    so a checkpoint missing them fails loudly instead of silently keeping
+    random init (``_merge_into`` skips absent leaves)."""
     tree: dict = {}
     for hf_key, (ours, transpose) in _LLAMA_FIXED.items():
         if hf_key in state:
@@ -216,6 +227,10 @@ def convert_hf_llama_state(
                 converted = _rope_interleave_permute(converted[None], len(converted) // num_heads)[0]
             elif rest == "self_attn.k_proj.bias":
                 converted = _rope_interleave_permute(converted[None], len(converted) // num_kv_heads)[0]
+            elif rest in ("self_attn.q_norm.weight", "self_attn.k_norm.weight"):
+                # the [head_dim] norm scale multiplies per channel AFTER the
+                # (re-paired) projection, so it re-pairs as one head's worth
+                converted = _rope_interleave_permute(converted[None], len(converted))[0]
             per_layer.setdefault(idx, {})[ours] = converted
     if not per_layer:
         return tree
@@ -223,7 +238,12 @@ def convert_hf_llama_state(
     # fail loudly on partial checkpoints (e.g. one shard of a sharded
     # save): the core weight families must be present in every layer —
     # a silent skip here would return a model with random kernels
-    required = {ours for ours, _ in _LLAMA_LAYER.values() if not ours.endswith("/bias")}
+    # biases (Qwen2) and q/k norm scales (Qwen3) are family-optional
+    required = {
+        ours
+        for ours, _ in _LLAMA_LAYER.values()
+        if not ours.endswith(("/bias", "q_norm/scale", "k_norm/scale"))
+    } | set(require)
     for i in range(n_layers):
         missing = required - set(per_layer.get(i, {}))
         if missing:
@@ -231,10 +251,21 @@ def convert_hf_llama_state(
                 f"layer {i} is missing {sorted(missing)} — partial checkpoint? "
                 "pass the checkpoint directory (or its index), not a single shard"
             )
+    # family-optional params (biases, q/k norms) must still be all-or-none
+    # across layers: stacking from layer 0's key set would silently drop a
+    # param present only in later layers (or KeyError on one missing later)
+    union = set().union(*(per_layer[i].keys() for i in range(n_layers)))
+    for name in union:
+        holes = [i for i in range(n_layers) if name not in per_layer[i]]
+        if holes:
+            raise ValueError(
+                f"param {name!r} present in some layers but missing from layers "
+                f"{holes} — partial checkpoint? pass the full directory/index"
+            )
     if scan_layers:
         # stack only params the checkpoint actually has (biases are
         # family-dependent)
-        for name in per_layer[0]:
+        for name in sorted(union):
             stacked = np.stack([per_layer[i][name] for i in range(n_layers)])
             _set(tree, f"layers/block/{name}", stacked)
     else:
@@ -340,8 +371,33 @@ def load_hf_qwen2(checkpoint_path: str, config=None):
         scan_layers=config.scan_layers,
         num_heads=config.num_attention_heads,
         num_kv_heads=config.num_key_value_heads,
+        require=(
+            ("attn/q_proj/bias", "attn/k_proj/bias", "attn/v_proj/bias")
+            if config.qkv_bias
+            else ()
+        ),
     )
     model = create_qwen2_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+def load_hf_qwen3(checkpoint_path: str, config=None):
+    """HF Qwen3 checkpoints are llama-layout with per-head q/k norm scales
+    (re-paired for the interleaved rope convention) and no qkv biases;
+    small variants tie lm_head to the embeddings (importer fallback)."""
+    from .qwen3 import Qwen3Config, create_qwen3_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Qwen3Config.qwen3_8b()
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        require=("attn/q_norm/scale", "attn/k_norm/scale") if config.qk_norm else (),
+    )
+    model = create_qwen3_model(config)
     _merge_into(model, tree)
     return model
 

@@ -60,6 +60,10 @@ class LlamaConfig:
     # Qwen2-style bias on the q/k/v projections only (o_proj stays
     # bias-free); importer re-pairs q/k biases for the rope convention
     qkv_bias: bool = False
+    # Qwen3/OLMo2-style per-head RMSNorm on q and k (one [head_dim] scale
+    # shared across heads, applied after the projection, before rope);
+    # the importer re-pairs the scales for the interleaved rope layout
+    qk_norm: bool = False
     # Gemma-family knobs: an explicit per-head width (None = hidden/heads),
     # the MLP gate activation, RMSNorm's (1 + scale) variant, and the
     # sqrt(hidden) embedding multiplier
@@ -400,6 +404,12 @@ class LlamaAttention(nn.Module):
         q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
         k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
+        if cfg.qk_norm:
+            # per-head RMSNorm over head_dim (Qwen3): the mean-of-squares is
+            # permutation-invariant, so the interleaved rope layout only
+            # requires the imported scale vector to be re-paired (hub.py)
+            q = RMSNorm(cfg.rms_norm_eps, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, name="k_norm")(k)
         # longrope's short/long table selection needs a STATIC length hint:
         # prefill uses the (static) input length like HF's runtime switch;
         # decode sees S=1, so the cache capacity stands in for it

@@ -1,0 +1,68 @@
+"""Qwen3: the llama architecture + per-head q/k RMSNorm.
+
+Qwen3 decoders are structurally llama (RMSNorm pre-norm, rotary, GQA,
+SwiGLU) with two changes vs Qwen2: the q/k/v biases are GONE, replaced by
+a per-head RMSNorm on q and k (``LlamaConfig.qk_norm`` — one ``[head_dim]``
+scale shared across heads, applied after the projection, before rope), and
+an explicit ``head_dim`` (128) decoupled from ``hidden_size / num_heads``.
+Small variants tie the LM head to the embeddings (importer fallback).
+
+Like :mod:`.qwen2`, the module/sharding/loss surfaces are the llama ones;
+only the config and checkpoint importer differ. The reference has no
+in-tree models (SURVEY §2.2); importer parity is tested against
+``transformers.Qwen3ForCausalLM`` in tests/test_hf_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+QWEN3_SHARDING_RULES = LLAMA_SHARDING_RULES
+Qwen3Model = LlamaModel
+
+
+@dataclasses.dataclass
+class Qwen3Config(LlamaConfig):
+    """Llama config with Qwen3-8B defaults (qk-norm on, explicit head_dim)."""
+
+    vocab_size: int = 151936
+    hidden_size: int = 4096
+    intermediate_size: int = 12288
+    num_hidden_layers: int = 36
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    head_dim: Optional[int] = 128
+    max_position_embeddings: int = 40960
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    qk_norm: bool = True
+
+    @classmethod
+    def tiny(cls, **kw) -> "Qwen3Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    @classmethod
+    def qwen3_8b(cls, **kw) -> "Qwen3Config":
+        return cls(**kw)
+
+
+def create_qwen3_model(config: Optional[Qwen3Config] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with Qwen3's per-head q/k norms."""
+    return create_llama_model(config or Qwen3Config.tiny(), seed=seed, seq_len=seq_len)

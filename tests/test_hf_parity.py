@@ -718,3 +718,35 @@ def test_clip_import_matches_transformers(tmp_path):
     np.testing.assert_allclose(np.asarray(img), want_img, atol=TOL)
     np.testing.assert_allclose(np.asarray(txt), want_txt, atol=TOL)
     assert float(scale) == pytest.approx(float(hf.logit_scale.item()), rel=1e-6)
+
+
+def test_qwen3_import_matches_transformers(tmp_path):
+    """Qwen3: llama layout + per-head q/k RMSNorm (scales re-paired for the
+    interleaved rope convention) + explicit head_dim != hidden/heads."""
+    import jax
+
+    from accelerate_tpu.models import Qwen3Config
+    from accelerate_tpu.models.hub import load_hf_qwen3
+
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24,  # deliberately != 64/4: the decoupled-width knob
+        max_position_embeddings=64, rope_theta=1e6, rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(6)
+    hf = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=64, rope_theta=1e6, rms_norm_eps=1e-6,
+        scan_layers=False, remat=False,
+    )
+    model = load_hf_qwen3(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)

@@ -1,0 +1,100 @@
+"""Qwen3 family (models/qwen3.py): per-head q/k RMSNorm through decode,
+explicit head_dim, TP-sharded decode, and serving. HF importer parity
+lives in test_hf_parity.py."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import Qwen3Config, create_qwen3_model
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen3():
+    return create_qwen3_model(Qwen3Config.tiny(), seq_len=16)
+
+
+def test_qk_norm_params_exist(tiny_qwen3):
+    block = tiny_qwen3.params["layers"]["block"]["attn"]
+    cfg = Qwen3Config.tiny()
+    for norm in ("q_norm", "k_norm"):
+        # scan-over-layers stacks a leading layer dim over the [head_dim] scale
+        assert block[norm]["scale"].shape == (cfg.num_hidden_layers, cfg.head_dim), norm
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        assert "bias" not in block[proj], proj  # Qwen3 dropped the Qwen2 biases
+
+
+def test_greedy_decode_matches_full_prefix(tiny_qwen3):
+    ids = (np.arange(2 * 8).reshape(2, 8) % 250 + 1).astype(np.int32)
+    out = np.asarray(generate(tiny_qwen3, ids, max_new_tokens=6))
+    full = ids
+    for _ in range(6):
+        logits = np.asarray(tiny_qwen3(full))
+        full = np.concatenate([full, logits[:, -1].argmax(-1).astype(np.int32)[:, None]], 1)
+    np.testing.assert_array_equal(out, full)
+
+
+def test_tp_sharded_decode(tiny_qwen3):
+    """TP splits q/k/v kernels over heads while the shared [head_dim]
+    norm scales stay replicated: sharded tokens == single-device tokens."""
+    import jax
+
+    from accelerate_tpu.big_modeling import shard_model
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    prompt = (np.arange(8) % 250).astype(np.int32)[None]
+    want = np.asarray(generate(tiny_qwen3, prompt, max_new_tokens=5))
+
+    model = create_qwen3_model(Qwen3Config.tiny(), seq_len=16)
+    mesh = MeshConfig(data=1, tensor=2).build(jax.devices()[:2])
+    shard_model(model, mesh)
+    norm_sh = model.param_shardings["layers"]["block"]["attn"]["q_norm"]["scale"]
+    assert norm_sh.is_fully_replicated, norm_sh  # shared across split heads
+    got = np.asarray(generate(model, prompt, max_new_tokens=5))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_paged_serving(tiny_qwen3):
+    from accelerate_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (3, 9, 6)]
+    eng = ServingEngine(tiny_qwen3, num_slots=2, prompt_buckets=(4, 8, 16), paged_block_size=4)
+    outs = eng.generate_many(prompts, max_new_tokens=5)
+    for p, got in zip(prompts, outs):
+        ref = np.asarray(generate(tiny_qwen3, p[None], max_new_tokens=5))[0]
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_loader_requires_norm_scales(tmp_path):
+    """A Qwen3-config load without q/k norm tensors must fail loudly —
+    _merge_into would otherwise silently keep random-init norm scales
+    (and the all-or-none cross-layer stacking check must also hold)."""
+    import pytest as _pytest
+
+    from accelerate_tpu.models.hub import convert_hf_llama_state
+
+    rng = np.random.default_rng(0)
+    state = {}
+    for i in range(2):
+        for name, shape in (
+            ("self_attn.q_proj.weight", (64, 64)),
+            ("self_attn.k_proj.weight", (32, 64)),
+            ("self_attn.v_proj.weight", (32, 64)),
+            ("self_attn.o_proj.weight", (64, 64)),
+            ("mlp.gate_proj.weight", (128, 64)),
+            ("mlp.up_proj.weight", (128, 64)),
+            ("mlp.down_proj.weight", (64, 128)),
+            ("input_layernorm.weight", (64,)),
+            ("post_attention_layernorm.weight", (64,)),
+        ):
+            state[f"model.layers.{i}.{name}"] = rng.normal(size=shape).astype(np.float32)
+    with _pytest.raises(ValueError, match="q_norm"):
+        convert_hf_llama_state(
+            state, scan_layers=True, num_heads=4, num_kv_heads=2,
+            require=("attn/q_norm/scale", "attn/k_norm/scale"),
+        )
+    # present in one layer but not the other: all-or-none check fires
+    state["model.layers.0.self_attn.q_norm.weight"] = np.ones((16,), np.float32)
+    with _pytest.raises(ValueError, match="present in some layers"):
+        convert_hf_llama_state(state, scan_layers=True, num_heads=4, num_kv_heads=2)
