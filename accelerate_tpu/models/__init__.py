@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/gemma/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -59,6 +59,12 @@ from .qwen3 import (
     Qwen3Config,
     Qwen3Model,
     create_qwen3_model,
+)
+from .olmo2 import (
+    OLMO2_SHARDING_RULES,
+    Olmo2Config,
+    Olmo2Model,
+    create_olmo2_model,
 )
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
@@ -123,6 +129,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_mistral,
     load_hf_mixtral,
     load_hf_phi3,
+    load_hf_olmo2,
     load_hf_qwen2,
     load_hf_qwen3,
     load_hf_t5,

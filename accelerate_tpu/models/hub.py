@@ -152,7 +152,10 @@ _LLAMA_LAYER = {
     "mlp.down_proj.weight": ("mlp/down_proj/kernel", True),
     "input_layernorm.weight": ("input_norm/scale", False),
     "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
-    # Qwen3 per-head q/k RMSNorm scales ([head_dim], shared across heads)
+    # OLMo2 post-norm layout (no input norms; attn/mlp outputs normalized)
+    "post_feedforward_layernorm.weight": ("post_ffn_norm/scale", False),
+    # q/k RMSNorm scales: Qwen3 [head_dim] (per-head), OLMo2 [H*head_dim]
+    # (flat) — the loader's flat_qk_norm flag picks the re-pair grouping
     "self_attn.q_norm.weight": ("attn/q_norm/scale", False),
     "self_attn.k_norm.weight": ("attn/k_norm/scale", False),
 }
@@ -189,6 +192,8 @@ def convert_hf_llama_state(
     num_heads: int,
     num_kv_heads: int,
     require: tuple = (),
+    norm_after: bool = False,
+    flat_qk_norm: bool = False,
 ) -> dict:
     """HF ``*ForCausalLM`` Llama -> our param pytree. With ``scan_layers``
     the per-layer weights are stacked along a leading layer dim to match
@@ -227,10 +232,15 @@ def convert_hf_llama_state(
                 converted = _rope_interleave_permute(converted[None], len(converted) // num_heads)[0]
             elif rest == "self_attn.k_proj.bias":
                 converted = _rope_interleave_permute(converted[None], len(converted) // num_kv_heads)[0]
-            elif rest in ("self_attn.q_norm.weight", "self_attn.k_norm.weight"):
-                # the [head_dim] norm scale multiplies per channel AFTER the
-                # (re-paired) projection, so it re-pairs as one head's worth
-                converted = _rope_interleave_permute(converted[None], len(converted))[0]
+            elif rest == "self_attn.q_norm.weight":
+                # the norm scale multiplies per channel AFTER the (re-paired)
+                # projection: Qwen3's [head_dim] re-pairs as one head, OLMo2's
+                # flat [H*head_dim] re-pairs per head_dim group like a bias
+                d = len(converted) // num_heads if flat_qk_norm else len(converted)
+                converted = _rope_interleave_permute(converted[None], d)[0]
+            elif rest == "self_attn.k_norm.weight":
+                d = len(converted) // num_kv_heads if flat_qk_norm else len(converted)
+                converted = _rope_interleave_permute(converted[None], d)[0]
             per_layer.setdefault(idx, {})[ours] = converted
     if not per_layer:
         return tree
@@ -238,12 +248,16 @@ def convert_hf_llama_state(
     # fail loudly on partial checkpoints (e.g. one shard of a sharded
     # save): the core weight families must be present in every layer —
     # a silent skip here would return a model with random kernels
-    # biases (Qwen2) and q/k norm scales (Qwen3) are family-optional
+    # biases (Qwen2) and q/k norm scales (Qwen3/OLMo2) are family-optional;
+    # the layer norms swap with the convention (pre-norm: input+post_attn,
+    # OLMo2 post-norm: post_attn+post_ffn, no input norms)
     required = {
         ours
         for ours, _ in _LLAMA_LAYER.values()
         if not ours.endswith(("/bias", "q_norm/scale", "k_norm/scale"))
+        and ours not in ("input_norm/scale", "post_ffn_norm/scale")
     } | set(require)
+    required |= {"post_ffn_norm/scale"} if norm_after else {"input_norm/scale"}
     for i in range(n_layers):
         missing = required - set(per_layer.get(i, {}))
         if missing:
@@ -398,6 +412,28 @@ def load_hf_qwen3(checkpoint_path: str, config=None):
         require=("attn/q_norm/scale", "attn/k_norm/scale") if config.qk_norm else (),
     )
     model = create_qwen3_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+def load_hf_olmo2(checkpoint_path: str, config=None):
+    """HF OLMo2 checkpoints are llama-layout with post-norm keys
+    (post_attention/post_feedforward, no input norms) and flat q/k norm
+    scales re-paired per head_dim group for the interleaved rope."""
+    from .olmo2 import Olmo2Config, create_olmo2_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Olmo2Config.olmo2_7b()
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        require=("attn/q_norm/scale", "attn/k_norm/scale") if config.qk_norm_flat else (),
+        norm_after=config.norm_after,
+        flat_qk_norm=config.qk_norm_flat,
+    )
+    model = create_olmo2_model(config)
     _merge_into(model, tree)
     return model
 

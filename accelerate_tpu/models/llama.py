@@ -60,10 +60,17 @@ class LlamaConfig:
     # Qwen2-style bias on the q/k/v projections only (o_proj stays
     # bias-free); importer re-pairs q/k biases for the rope convention
     qkv_bias: bool = False
-    # Qwen3/OLMo2-style per-head RMSNorm on q and k (one [head_dim] scale
+    # Qwen3-style per-head RMSNorm on q and k (one [head_dim] scale
     # shared across heads, applied after the projection, before rope);
     # the importer re-pairs the scales for the interleaved rope layout
     qk_norm: bool = False
+    # OLMo2-style FULL-WIDTH RMSNorm on the flat q/k projections
+    # ([H*head_dim] / [H_kv*head_dim] scales, applied before the head
+    # reshape); mutually exclusive with qk_norm
+    qk_norm_flat: bool = False
+    # OLMo2-style post-norms: normalize each sublayer's output before the
+    # residual add instead of its input (no input_norm params)
+    norm_after: bool = False
     # Gemma-family knobs: an explicit per-head width (None = hidden/heads),
     # the MLP gate activation, RMSNorm's (1 + scale) variant, and the
     # sqrt(hidden) embedding multiplier
@@ -401,6 +408,11 @@ class LlamaAttention(nn.Module):
         q = _dense(cfg, cfg.num_attention_heads * head_dim, "q_proj", hidden.dtype, cfg.qkv_bias)(hidden)
         k = _dense(cfg, cfg.num_key_value_heads * head_dim, "k_proj", hidden.dtype, cfg.qkv_bias)(hidden)
         v = _dense(cfg, cfg.num_key_value_heads * head_dim, "v_proj", hidden.dtype, cfg.qkv_bias)(hidden)
+        if cfg.qk_norm_flat:
+            # OLMo2: RMSNorm over the FLAT projection (all heads jointly)
+            # before the head split — a different statistic than per-head
+            q = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="k_norm")(k)
         q = q.reshape(*q.shape[:-1], cfg.num_attention_heads, head_dim)
         k = k.reshape(*k.shape[:-1], cfg.num_key_value_heads, head_dim)
         v = v.reshape(*v.shape[:-1], cfg.num_key_value_heads, head_dim)
@@ -408,8 +420,8 @@ class LlamaAttention(nn.Module):
             # per-head RMSNorm over head_dim (Qwen3): the mean-of-squares is
             # permutation-invariant, so the interleaved rope layout only
             # requires the imported scale vector to be re-paired (hub.py)
-            q = RMSNorm(cfg.rms_norm_eps, name="q_norm")(q)
-            k = RMSNorm(cfg.rms_norm_eps, name="k_norm")(k)
+            q = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="k_norm")(k)
         # longrope's short/long table selection needs a STATIC length hint:
         # prefill uses the (static) input length like HF's runtime switch;
         # decode sees S=1, so the cache capacity stands in for it
@@ -461,6 +473,18 @@ class LlamaLayer(nn.Module):
     @nn.compact
     def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.config
+        if cfg.norm_after:
+            # OLMo2 convention: normalize each sublayer's OUTPUT before the
+            # residual add (no input norms); HF key post_attention_layernorm
+            # maps to post_attn_norm, post_feedforward_layernorm to
+            # post_ffn_norm
+            hidden = hidden + RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="post_attn_norm")(
+                LlamaAttention(cfg, name="attn")(hidden, positions, decode)
+            )
+            hidden = hidden + RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="post_ffn_norm")(
+                LlamaMLP(cfg, name="mlp")(hidden)
+            )
+            return hidden
         hidden = hidden + LlamaAttention(cfg, name="attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="input_norm")(hidden), positions, decode
         )

@@ -1,0 +1,69 @@
+"""OLMo2: the llama architecture with post-norms and flat q/k RMSNorm.
+
+OLMo2 decoders reorder normalization relative to llama: each sublayer's
+OUTPUT is normalized before the residual add (``LlamaConfig.norm_after``
+— ``post_attn_norm``/``post_ffn_norm``, no input norms), and RMSNorm is
+applied to the FLAT q/k projections before the head split
+(``qk_norm_flat`` — ``[H*head_dim]``/``[H_kv*head_dim]`` scales, a
+different statistic than Qwen3's per-head norm). Rope theta is 500000;
+widths are llama-7B-class.
+
+Like the other llama variants, the module/sharding/loss surfaces are the
+llama ones; only the config knobs and the checkpoint importer differ.
+The reference has no in-tree models (SURVEY §2.2); importer parity is
+tested against ``transformers.Olmo2ForCausalLM`` in
+tests/test_hf_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+OLMO2_SHARDING_RULES = LLAMA_SHARDING_RULES
+Olmo2Model = LlamaModel
+
+
+@dataclasses.dataclass
+class Olmo2Config(LlamaConfig):
+    """Llama config with OLMo2-7B defaults (post-norms, flat qk-norm)."""
+
+    vocab_size: int = 100352
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 500000.0
+    norm_after: bool = True
+    qk_norm_flat: bool = True
+
+    @classmethod
+    def tiny(cls, **kw) -> "Olmo2Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    @classmethod
+    def olmo2_7b(cls, **kw) -> "Olmo2Config":
+        return cls(**kw)
+
+
+def create_olmo2_model(config: Optional[Olmo2Config] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with OLMo2's post-norm layout and flat q/k norms."""
+    return create_llama_model(config or Olmo2Config.tiny(), seed=seed, seq_len=seq_len)

@@ -750,3 +750,39 @@ def test_qwen3_import_matches_transformers(tmp_path):
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_olmo2_import_matches_transformers(tmp_path):
+    """OLMo2: post-norm layout (outputs normalized pre-residual, no input
+    norms) + FLAT q/k RMSNorm (scales re-paired per head_dim group)."""
+    import jax
+
+    from accelerate_tpu.models import Olmo2Config
+    from accelerate_tpu.models.hub import load_hf_olmo2
+
+    hf_cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=500000.0, rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(7)
+    hf = transformers.Olmo2ForCausalLM(hf_cfg).eval()
+    # random norm scales so the flat-vs-per-head re-pairing is actually load-bearing
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            layer.self_attn.q_norm.weight.copy_(torch.rand_like(layer.self_attn.q_norm.weight) + 0.5)
+            layer.self_attn.k_norm.weight.copy_(torch.rand_like(layer.self_attn.k_norm.weight) + 0.5)
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Olmo2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=500000.0, rms_norm_eps=1e-6,
+        scan_layers=False, remat=False,
+    )
+    model = load_hf_olmo2(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
