@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/gemma2/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -66,6 +66,12 @@ from .olmo2 import (
     Olmo2Model,
     create_olmo2_model,
 )
+from .gemma2 import (
+    GEMMA2_SHARDING_RULES,
+    Gemma2Config,
+    Gemma2Model,
+    create_gemma2_model,
+)
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
     MixtralConfig,
@@ -123,6 +129,7 @@ from .vae import (
 from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_bert,
     load_hf_gemma,
+    load_hf_gemma2,
     load_hf_gpt2,
     load_hf_gptneox,
     load_hf_llama,

@@ -1,0 +1,101 @@
+"""Gemma2: the llama skeleton with Google's second-generation deviations.
+
+On top of Gemma1's knobs (explicit ``head_dim``, GeGLU, ``(1+scale)``
+norms, scaled embeddings, tied head), Gemma2 adds the four things that
+made it distinctive:
+
+* **sandwich norms** (``sandwich_norm``): pre- AND post-RMSNorm around
+  both the attention and MLP sublayers;
+* **logit softcapping**: attention scores tanh-bounded at 50
+  (``attn_logit_softcap``, applied before the mask) and final logits at
+  30 (``final_logit_softcap``);
+* **attention scale** from ``query_pre_attn_scalar`` (224 for 9B —
+  deliberately NOT head_dim) instead of ``head_dim**-0.5``;
+* **alternating local/global attention** (``layer_types``): every other
+  layer applies the 4096-token sliding window. Per-layer attention kinds
+  need ``scan_layers=False`` (one scanned block shares a static config),
+  so Gemma2 defaults to the unrolled stack.
+
+Softcapping runs on the XLA attention path (the flash kernel has no
+tanh-cap branch) and the dense KV cache (the paged kernel raises).
+Parity vs ``transformers.Gemma2ForCausalLM`` in tests/test_hf_parity.py.
+The reference has no in-tree models (SURVEY §2.2); this family is zoo
+surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+GEMMA2_SHARDING_RULES = LLAMA_SHARDING_RULES
+Gemma2Model = LlamaModel
+
+
+def _alternating(n_layers: int) -> tuple:
+    """HF Gemma2 layer pattern: odd layers slide, even layers are global."""
+    return tuple(
+        "sliding_attention" if bool((i + 1) % 2) else "full_attention" for i in range(n_layers)
+    )
+
+
+@dataclasses.dataclass
+class Gemma2Config(LlamaConfig):
+    """Llama config with gemma2-9b defaults (sandwich norms, softcaps,
+    alternating 4096-token window)."""
+
+    vocab_size: int = 256000
+    hidden_size: int = 3584
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 42
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 8
+    head_dim: Optional[int] = 256
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    mlp_activation: str = "gelu_tanh"
+    norm_plus_one: bool = True
+    scale_embeddings: bool = True
+    tie_word_embeddings: bool = True
+    sandwich_norm: bool = True
+    attn_logit_softcap: Optional[float] = 50.0
+    final_logit_softcap: Optional[float] = 30.0
+    query_pre_attn_scalar: Optional[float] = 256.0  # transformers Gemma2Config default
+    sliding_window: Optional[int] = 4096
+    layer_types: Optional[tuple] = None  # filled per num_hidden_layers below
+    scan_layers: bool = False  # per-layer attention kinds need the unrolled stack
+
+    def __post_init__(self):
+        if self.layer_types is None:
+            self.layer_types = _alternating(self.num_hidden_layers)
+
+    @classmethod
+    def tiny(cls, **kw) -> "Gemma2Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)  # one sliding + one full layer
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("sliding_window", 8)  # small enough for the band to bite
+        kw.setdefault("query_pre_attn_scalar", 32.0)  # != head_dim: scale is load-bearing
+        return cls(**kw)
+
+    @classmethod
+    def gemma2_9b(cls, **kw) -> "Gemma2Config":
+        return cls(**kw)
+
+
+def create_gemma2_model(config: Optional[Gemma2Config] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with Gemma2's sandwich norms, softcaps, and alternating windows."""
+    return create_llama_model(config or Gemma2Config.tiny(), seed=seed, seq_len=seq_len)

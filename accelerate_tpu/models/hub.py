@@ -152,8 +152,9 @@ _LLAMA_LAYER = {
     "mlp.down_proj.weight": ("mlp/down_proj/kernel", True),
     "input_layernorm.weight": ("input_norm/scale", False),
     "post_attention_layernorm.weight": ("post_attn_norm/scale", False),
-    # OLMo2 post-norm layout (no input norms; attn/mlp outputs normalized)
+    # OLMo2 post-norm / Gemma2 sandwich layouts
     "post_feedforward_layernorm.weight": ("post_ffn_norm/scale", False),
+    "pre_feedforward_layernorm.weight": ("pre_ffn_norm/scale", False),
     # q/k RMSNorm scales: Qwen3 [head_dim] (per-head), OLMo2 [H*head_dim]
     # (flat) — the loader's flat_qk_norm flag picks the re-pair grouping
     "self_attn.q_norm.weight": ("attn/q_norm/scale", False),
@@ -255,7 +256,7 @@ def convert_hf_llama_state(
         ours
         for ours, _ in _LLAMA_LAYER.values()
         if not ours.endswith(("/bias", "q_norm/scale", "k_norm/scale"))
-        and ours not in ("input_norm/scale", "post_ffn_norm/scale")
+        and ours not in ("input_norm/scale", "post_ffn_norm/scale", "pre_ffn_norm/scale")
     } | set(require)
     required |= {"post_ffn_norm/scale"} if norm_after else {"input_norm/scale"}
     for i in range(n_layers):
@@ -368,6 +369,26 @@ def load_hf_gemma(checkpoint_path: str, config=None):
         num_kv_heads=config.num_key_value_heads,
     )
     model = create_gemma_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+def load_hf_gemma2(checkpoint_path: str, config=None):
+    """HF Gemma2 checkpoints are llama-layout plus the sandwich-norm keys
+    (pre/post feedforward layernorms); head always tied, (1+scale) norm
+    offsets import verbatim."""
+    from .gemma2 import Gemma2Config, create_gemma2_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Gemma2Config.gemma2_9b()
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        require=("pre_ffn_norm/scale", "post_ffn_norm/scale") if config.sandwich_norm else (),
+    )
+    model = create_gemma2_model(config)
     _merge_into(model, tree)
     return model
 

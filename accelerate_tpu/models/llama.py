@@ -71,6 +71,20 @@ class LlamaConfig:
     # OLMo2-style post-norms: normalize each sublayer's output before the
     # residual add instead of its input (no input_norm params)
     norm_after: bool = False
+    # Gemma2-style sandwich norms: BOTH a pre- and post-norm around each
+    # sublayer (input_norm/post_attn_norm around attention,
+    # pre_ffn_norm/post_ffn_norm around the MLP)
+    sandwich_norm: bool = False
+    # Gemma2 logit softcapping: tanh-bound attention scores / final logits
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    # Gemma2: attention scale = query_pre_attn_scalar**-0.5 when set
+    # (instead of head_dim**-0.5)
+    query_pre_attn_scalar: Optional[float] = None
+    # per-layer attention kind ("sliding_attention"|"full_attention") for
+    # Gemma2's alternating local/global layers — requires scan_layers=False
+    # (a scanned block shares one static config across layers)
+    layer_types: Optional[tuple] = None
     # Gemma-family knobs: an explicit per-head width (None = hidden/heads),
     # the MLP gate activation, RMSNorm's (1 + scale) variant, and the
     # sqrt(hidden) embedding multiplier
@@ -362,7 +376,9 @@ def rope(
     return rotated.reshape(x.shape).astype(x.dtype)
 
 
-def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None):
+def _dispatch_attention(
+    q, k, v, impl: str, sliding_window: Optional[int] = None, scale=None, logit_softcap=None
+):
     """Pick the attention path: context-parallel (ring / all-to-all) when
     the active mesh has a non-trivial ``seq`` axis, else dense/flash. This
     is where long-context becomes a *layout* decision rather than a model
@@ -387,15 +403,25 @@ def _dispatch_attention(q, k, v, impl: str, sliding_window: Optional[int] = None
     if seq_ok:
         from ..parallel.context import context_parallel_attention
 
+        if logit_softcap is not None:
+            raise NotImplementedError(
+                "attention logit softcapping (Gemma2) is not supported inside the "
+                "ring/all-to-all context-parallel schedules; use a mesh without a seq axis"
+            )
         method = "all_to_all" if impl == "all_to_all" else "ring"
         return context_parallel_attention(
-            q, k, v, mesh=mesh, causal=True, method=method, window=sliding_window
+            q, k, v, mesh=mesh, causal=True, method=method, window=sliding_window, scale=scale
         )
     from ..ops.attention import dot_product_attention
 
     # the op folds the band (if any) into the XLA mask at short lengths
     # and runs the banded flash kernel (O(S*W)) at flash lengths on TPU
-    return dot_product_attention(q, k, v, causal=True, mesh=mesh, window=sliding_window)
+    # (the op's auto-dispatch avoids the flash kernel when a softcap is
+    # set — the kernel has no tanh-cap branch)
+    return dot_product_attention(
+        q, k, v, causal=True, mesh=mesh, window=sliding_window, scale=scale,
+        logit_softcap=logit_softcap,
+    )
 
 
 class LlamaAttention(nn.Module):
@@ -432,21 +458,29 @@ class LlamaAttention(nn.Module):
         k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling,
                  max_pos=cfg.max_position_embeddings, seq_len=rope_len,
                  orig_max=cfg.original_max_position_embeddings)
+        scale = None  # attention default: head_dim**-0.5
+        if cfg.query_pre_attn_scalar is not None:
+            scale = float(cfg.query_pre_attn_scalar) ** -0.5  # Gemma2
         if decode:
-            out = self._cached_attention(q, k, v)
+            out = self._cached_attention(q, k, v, scale)
         else:
-            out = _dispatch_attention(q, k, v, cfg.attention_impl, cfg.sliding_window)
+            out = _dispatch_attention(
+                q, k, v, cfg.attention_impl, cfg.sliding_window,
+                scale=scale, logit_softcap=cfg.attn_logit_softcap,
+            )
         out = out.reshape(*out.shape[:-2], cfg.num_attention_heads * head_dim)
         return _dense(cfg, cfg.hidden_size, "o_proj", hidden.dtype)(out)
 
-    def _cached_attention(self, q, k, v):
+    def _cached_attention(self, q, k, v, scale=None):
         """KV-cache incremental attention (generation path; shared cache
         machinery in :mod:`accelerate_tpu.ops.kv_cache`)."""
         from ..ops.kv_cache import cached_attention
 
         return cached_attention(
             self, q, k, v, self.config.max_position_embeddings,
+            scale=scale,
             sliding_window=self.config.sliding_window,
+            logit_softcap=self.config.attn_logit_softcap,
         )
 
 
@@ -483,6 +517,20 @@ class LlamaLayer(nn.Module):
             )
             hidden = hidden + RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="post_ffn_norm")(
                 LlamaMLP(cfg, name="mlp")(hidden)
+            )
+            return hidden
+        if cfg.sandwich_norm:
+            # Gemma2 convention: pre- AND post-norm around each sublayer
+            hidden = hidden + RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="post_attn_norm")(
+                LlamaAttention(cfg, name="attn")(
+                    RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="input_norm")(hidden),
+                    positions, decode,
+                )
+            )
+            hidden = hidden + RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="post_ffn_norm")(
+                LlamaMLP(cfg, name="mlp")(
+                    RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="pre_ffn_norm")(hidden)
+                )
             )
             return hidden
         hidden = hidden + LlamaAttention(cfg, name="attn")(
@@ -524,6 +572,11 @@ class LlamaModel(nn.Module):
 
         hidden = maybe_shard(hidden, ACTIVATION_SPEC)
 
+        if cfg.layer_types is not None and cfg.scan_layers:
+            raise ValueError(
+                "layer_types (per-layer sliding/full attention, Gemma2) requires "
+                "scan_layers=False — a scanned block shares one static config"
+            )
         if cfg.scan_layers:
             layer_cls = nn.remat(_ScanLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else _ScanLayer
             scanned = nn.scan(
@@ -538,14 +591,28 @@ class LlamaModel(nn.Module):
         else:
             layer_cls = nn.remat(LlamaLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else LlamaLayer
             for i in range(cfg.num_hidden_layers):
-                hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, positions, decode)
+                lcfg = cfg
+                if cfg.layer_types is not None:
+                    # Gemma2 alternating local/global attention: the band
+                    # only applies on "sliding_attention" layers
+                    windowed = cfg.layer_types[i] == "sliding_attention"
+                    lcfg = dataclasses.replace(
+                        cfg, sliding_window=cfg.sliding_window if windowed else None
+                    )
+                hidden = layer_cls(lcfg, name=f"layer_{i}")(hidden, positions, decode)
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="final_norm")(hidden)
         if cfg.tie_word_embeddings:
             # true weight tying: reuse the embedding table (no lm_head
             # param at all), matching HF tied-head semantics under
             # fine-tuning and halving the head+table HBM
-            return hidden.astype(jnp.float32) @ embed.embedding.astype(jnp.float32).T
-        return nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
+            logits = hidden.astype(jnp.float32) @ embed.embedding.astype(jnp.float32).T
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=jnp.float32)(hidden)
+        if cfg.final_logit_softcap is not None:
+            from ..ops.attention import softcap
+
+            logits = softcap(logits, cfg.final_logit_softcap)
+        return logits
 
 
 def _wrap_llama(module: LlamaModel, params, config: LlamaConfig, state=None) -> Model:

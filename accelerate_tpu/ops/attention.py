@@ -39,6 +39,7 @@ def dot_product_attention(
     dropout_rng=None,
     mesh=None,  # pin the mesh for the sharded pallas path (else read from state at trace time)
     window: Optional[int] = None,  # Mistral band: keys <= q_pos - window are masked
+    logit_softcap: Optional[float] = None,  # Gemma2: tanh-bound scores (XLA path only)
 ) -> jax.Array:
     """Multi-head attention with optional GQA (H_kv divides H) and
     flash-kernel dispatch. Causal masking is bottom-right aligned when
@@ -62,7 +63,10 @@ def dot_product_attention(
             and seq_len >= FLASH_MIN_SEQ
             and mask is None  # kernel supports causal/banded masking only
             and dropout_rate == 0.0
+            and logit_softcap is None  # the kernel has no tanh-cap branch
         )
+    if use_flash and logit_softcap is not None:
+        raise ValueError("logit_softcap runs on the XLA path only; drop use_flash=True")
     if explicit_flash and use_flash and window is not None and jax.default_backend() != "tpu":
         # the scan fallback has no band support: refuse the explicit
         # request (consistent with the mask/dropout guards below). The
@@ -87,7 +91,18 @@ def dot_product_attention(
         q_pos = jnp.arange(s)[:, None] + (k.shape[1] - s)
         band = (jnp.arange(k.shape[1])[None, :] > q_pos - window)[None, None]
         mask = band if mask is None else (mask & band)
-    return _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng, _softmax_dtype())
+    return _xla_attention(
+        q, k, v, mask, causal, scale, dropout_rate, dropout_rng, _softmax_dtype(),
+        logit_softcap=logit_softcap,
+    )
+
+
+def softcap(x: jax.Array, cap) -> jax.Array:
+    """Gemma2 logit softcapping: ``tanh(x / cap) * cap`` in ``x``'s dtype —
+    the ONE definition shared by the XLA attention path, the KV-cache
+    decode path, and the final-logits head."""
+    c = jnp.asarray(cap, x.dtype)
+    return jnp.tanh(x / c) * c
 
 
 def _softmax_dtype():
@@ -177,7 +192,9 @@ def sharded_pallas_attention(
     return fn(q, k, v)
 
 
-def _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng, softmax_dtype=None):
+def _xla_attention(
+    q, k, v, mask, causal, scale, dropout_rate, dropout_rng, softmax_dtype=None, logit_softcap=None
+):
     seq_len = q.shape[1]
     num_heads, num_kv = q.shape[-2], k.shape[-2]
     if num_kv != num_heads:  # GQA: repeat kv groups
@@ -198,6 +215,10 @@ def _xla_attention(q, k, v, mask, causal, scale, dropout_rate, dropout_rng, soft
     # BERT v5e step; MixedPrecisionPolicy.softmax_dtype)
     sm_dtype = jnp.dtype(softmax_dtype) if softmax_dtype is not None else jnp.float32
     logits = logits.astype(sm_dtype)
+    if logit_softcap is not None:
+        # Gemma2 attention softcapping: tanh-bound the scores BEFORE the
+        # mask (HF order), keeping gradients finite at long context
+        logits = softcap(logits, logit_softcap)
     if causal:
         offset = k.shape[1] - seq_len  # bottom-right alignment
         q_pos = jnp.arange(seq_len)[:, None] + offset

@@ -36,7 +36,9 @@ def _constrain(x):
     return maybe_shard(x, CACHE_KV_SPEC)
 
 
-def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None, sliding_window=None):
+def cached_attention(
+    module, q, k, v, max_len: int, scale=None, bias_fn=None, sliding_window=None, logit_softcap=None
+):
     """Incremental causal attention against a growing cache.
 
     ``module``: the calling flax module (owns the ``cache`` variables).
@@ -56,6 +58,11 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None, sl
 
     pcfg = paged_kv.active_paged_config()
     if pcfg is not None:
+        if logit_softcap is not None:
+            raise NotImplementedError(
+                "attention logit softcapping (Gemma2) is not supported by the paged "
+                "cache kernel yet; serve with the dense engine layout"
+            )
         # serving engine's paged mode: block-pool cache layout instead of
         # dense per-row buffers (trace-time switch; see ops/paged_kv.py)
         return paged_kv.paged_cached_attention(
@@ -82,19 +89,26 @@ def cached_attention(module, q, k, v, max_len: int, scale=None, bias_fn=None, sl
     if sliding_window is not None:
         live &= key_pos[None, :] > q_pos[:, None] - sliding_window
     bias = bias_fn(q_pos, key_pos) if bias_fn is not None else None
+    def cap(scores):
+        if logit_softcap is None:
+            return scores
+        from .attention import softcap  # Gemma2: tanh-bound BEFORE the mask
+
+        return softcap(scores, logit_softcap)
+
     if groups > 1:
         # GQA: contract grouped queries against the UN-repeated cache —
         # materializing jnp.repeat over [B, max_len, H, D] would 4x the
         # cache's memory traffic on every decode step
         qg = q.reshape(b, s_new, h_kv, groups, d)
-        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) * scale
+        scores = cap(jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_all).astype(jnp.float32) * scale)
         if bias is not None:
             scores = scores + bias.reshape(1, h_kv, groups, s_new, max_len)
         mask = live[None, None, None]
         probs = jax.nn.softmax(jnp.where(mask, scores, -jnp.inf), axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v_all)
         return out.reshape(b, s_new, h_kv * groups, d)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) * scale
+    scores = cap(jnp.einsum("bqhd,bkhd->bhqk", q, k_all).astype(jnp.float32) * scale)
     if bias is not None:
         scores = scores + bias
     mask = live[None, None]

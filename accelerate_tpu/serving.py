@@ -198,8 +198,14 @@ class ServingEngine:
             self._slot_table = [np.zeros((self._mb,), np.int32) for _ in range(num_slots)]
             # windowed models never read keys <= frontier - W, so their
             # pool cost is O(window + max_new), not O(total): below-band
-            # entries start as trash and blocks expire behind the frontier
+            # entries start as trash and blocks expire behind the frontier.
+            # Per-layer attention kinds (Gemma2 alternating local/global)
+            # disable the recycling: a full_attention layer reads EVERY
+            # position, so no block ever becomes dead
             self._window = getattr(model.config, "sliding_window", None)
+            layer_types = getattr(model.config, "layer_types", None)
+            if layer_types is not None and any(t != "sliding_attention" for t in layer_types):
+                self._window = None
             with paged_mode(self._pcfg):
                 _, pcache = jax.eval_shape(
                     lambda p, i, pos: apply_fn(p, i, positions=pos, decode=True, cache=None),

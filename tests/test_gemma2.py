@@ -1,0 +1,64 @@
+"""Gemma2 family (models/gemma2.py): sandwich norms + softcaps +
+alternating local/global attention through decode and serving. HF importer
+parity lives in test_hf_parity.py."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import Gemma2Config, create_gemma2_model
+
+
+@pytest.fixture(scope="module")
+def tiny_gemma2():
+    return create_gemma2_model(Gemma2Config.tiny(), seq_len=32)
+
+
+def test_structure(tiny_gemma2):
+    cfg = Gemma2Config.tiny()
+    assert cfg.layer_types == ("sliding_attention", "full_attention")
+    layer0 = tiny_gemma2.params["layer_0"]
+    for norm in ("input_norm", "post_attn_norm", "pre_ffn_norm", "post_ffn_norm"):
+        assert norm in layer0, norm  # the sandwich
+    assert "lm_head" not in tiny_gemma2.params  # always tied
+
+
+def test_greedy_decode_matches_full_prefix(tiny_gemma2):
+    """The cached decode path must apply the softcaps, the
+    query_pre_attn_scalar scale, AND the per-layer window exactly like the
+    full forward — token equality over enough steps to cross the window."""
+    ids = (np.arange(2 * 12).reshape(2, 12) % 250 + 1).astype(np.int32)
+    out = np.asarray(generate(tiny_gemma2, ids, max_new_tokens=8))
+    full = ids
+    for _ in range(8):
+        logits = np.asarray(tiny_gemma2(full))
+        full = np.concatenate([full, logits[:, -1].argmax(-1).astype(np.int32)[:, None]], 1)
+    np.testing.assert_array_equal(out, full)
+
+
+def test_final_softcap_bounds_logits(tiny_gemma2):
+    ids = np.ones((1, 8), np.int32)
+    logits = np.asarray(tiny_gemma2(ids))
+    assert np.abs(logits).max() <= 30.0 + 1e-5  # final_logit_softcap
+
+
+def test_serving(tiny_gemma2):
+    from accelerate_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (3, 12, 6)]
+    eng = ServingEngine(tiny_gemma2, num_slots=2, prompt_buckets=(4, 8, 16))
+    outs = eng.generate_many(prompts, max_new_tokens=5)
+    for p, got in zip(prompts, outs):
+        ref = np.asarray(generate(tiny_gemma2, p[None], max_new_tokens=5))[0]
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_paged_serving_raises(tiny_gemma2):
+    """The paged kernel has no tanh-cap branch: refuse loudly rather than
+    silently dropping the softcap."""
+    from accelerate_tpu.serving import ServingEngine
+
+    with pytest.raises(NotImplementedError, match="softcapping"):
+        eng = ServingEngine(tiny_gemma2, num_slots=1, prompt_buckets=(8,), paged_block_size=4)
+        eng.generate_many([np.ones((4,), np.int32)], max_new_tokens=3)

@@ -786,3 +786,38 @@ def test_olmo2_import_matches_transformers(tmp_path):
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_gemma2_import_matches_transformers(tmp_path):
+    """Gemma2: sandwich norms, attention+final logit softcapping,
+    query_pre_attn_scalar scale, and the alternating sliding/full layer
+    pattern (the tiny window makes the band load-bearing at S=16)."""
+    import jax
+
+    from accelerate_tpu.models import Gemma2Config
+    from accelerate_tpu.models.hub import load_hf_gemma2
+
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=32, sliding_window=8,  # scalar != head_dim: load-bearing
+    )
+    torch.manual_seed(8)
+    hf = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        query_pre_attn_scalar=32.0, sliding_window=8, remat=False,
+        layer_types=tuple(hf_cfg.layer_types),  # HF's own alternation
+    )
+    model = load_hf_gemma2(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
