@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/gemma2/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/gemma2/gemma3/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -72,6 +72,12 @@ from .gemma2 import (
     Gemma2Model,
     create_gemma2_model,
 )
+from .gemma3 import (
+    GEMMA3_SHARDING_RULES,
+    Gemma3Config,
+    Gemma3Model,
+    create_gemma3_model,
+)
 from .mixtral import (
     MIXTRAL_SHARDING_RULES,
     MixtralConfig,
@@ -130,6 +136,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_bert,
     load_hf_gemma,
     load_hf_gemma2,
+    load_hf_gemma3,
     load_hf_gpt2,
     load_hf_gptneox,
     load_hf_llama,

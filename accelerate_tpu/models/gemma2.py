@@ -75,6 +75,11 @@ class Gemma2Config(LlamaConfig):
     def __post_init__(self):
         if self.layer_types is None:
             self.layer_types = _alternating(self.num_hidden_layers)
+        if len(self.layer_types) != self.num_hidden_layers:
+            raise ValueError(
+                f"layer_types has {len(self.layer_types)} entries for "
+                f"{self.num_hidden_layers} layers — pass both together (or neither)"
+            )
 
     @classmethod
     def tiny(cls, **kw) -> "Gemma2Config":

@@ -1,0 +1,106 @@
+"""Gemma3 (text): the gemma lineage's third generation on the llama core.
+
+Relative to Gemma2, Gemma3 keeps the sandwich norms, the
+``query_pre_attn_scalar`` scale, scaled embeddings, and the tied head —
+and changes:
+
+* per-head **q/k RMSNorm** (``qk_norm`` — zero-centred ``(1+scale)``
+  like every Gemma norm) instead of attention logit softcapping, which
+  is GONE (``attn_logit_softcap=None``, final softcap too);
+* a **5:1 local/global pattern** (``layer_types``: five
+  ``sliding_attention`` layers per ``full_attention`` layer) with a
+  1024/4096-token window;
+* **dual rope bases** (``rope_local_theta``): sliding layers rotate with
+  theta 10k and no scaling, full layers with theta 1M (+``rope_scaling``
+  linear factor 8 on the 4B+ checkpoints).
+
+Per-layer attention kinds need ``scan_layers=False`` (one scanned block
+shares a static config), so Gemma3 defaults to the unrolled stack.
+Parity vs ``transformers.Gemma3ForCausalLM`` in tests/test_hf_parity.py.
+The reference has no in-tree models (SURVEY §2.2); this family is zoo
+surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .llama import (
+    LLAMA_SHARDING_RULES,
+    LlamaConfig,
+    LlamaModel,
+    create_llama_model,
+)
+
+GEMMA3_SHARDING_RULES = LLAMA_SHARDING_RULES
+Gemma3Model = LlamaModel
+
+
+def _five_to_one(n_layers: int) -> tuple:
+    """HF Gemma3 pattern: every 6th layer is global, the rest slide."""
+    return tuple(
+        "full_attention" if (i + 1) % 6 == 0 else "sliding_attention" for i in range(n_layers)
+    )
+
+
+@dataclasses.dataclass
+class Gemma3Config(LlamaConfig):
+    """Llama config with google/gemma-3-1b text defaults (5:1 local/global,
+    dual rope bases, per-head qk-norm, MQA, 512-token window)."""
+
+    vocab_size: int = 262144
+    hidden_size: int = 1152
+    intermediate_size: int = 6912
+    num_hidden_layers: int = 26
+    num_attention_heads: int = 4
+    num_key_value_heads: int = 1
+    head_dim: Optional[int] = 256
+    max_position_embeddings: int = 32768
+    rms_norm_eps: float = 1e-6
+    mlp_activation: str = "gelu_tanh"
+    norm_plus_one: bool = True
+    scale_embeddings: bool = True
+    tie_word_embeddings: bool = True
+    sandwich_norm: bool = True
+    qk_norm: bool = True
+    query_pre_attn_scalar: Optional[float] = 256.0
+    sliding_window: Optional[int] = 512
+    rope_theta: float = 1_000_000.0
+    rope_local_theta: Optional[float] = 10_000.0
+    layer_types: Optional[tuple] = None  # filled per num_hidden_layers below
+    scan_layers: bool = False  # per-layer attention kinds need the unrolled stack
+
+    def __post_init__(self):
+        if self.layer_types is None:
+            self.layer_types = _five_to_one(self.num_hidden_layers)
+        if len(self.layer_types) != self.num_hidden_layers:
+            raise ValueError(
+                f"layer_types has {len(self.layer_types)} entries for "
+                f"{self.num_hidden_layers} layers — pass both together (or neither)"
+            )
+
+    @classmethod
+    def tiny(cls, **kw) -> "Gemma3Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_hidden_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("num_key_value_heads", 2)
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("sliding_window", 8)  # small enough for the band to bite
+        kw.setdefault("query_pre_attn_scalar", 32.0)  # != head_dim: load-bearing
+        kw.setdefault("layer_types", ("sliding_attention", "full_attention"))
+        return cls(**kw)
+
+    @classmethod
+    def gemma3_1b(cls, **kw) -> "Gemma3Config":
+        return cls(**kw)
+
+
+def create_gemma3_model(config: Optional[Gemma3Config] = None, seed: int = 0, seq_len: int = 128):
+    """A :class:`~accelerate_tpu.modeling.Model` running the llama module
+    with Gemma3's dual rope bases, qk-norms, and 5:1 attention pattern."""
+    return create_llama_model(config or Gemma3Config.tiny(), seed=seed, seq_len=seq_len)

@@ -393,6 +393,30 @@ def load_hf_gemma2(checkpoint_path: str, config=None):
     return model
 
 
+def load_hf_gemma3(checkpoint_path: str, config=None):
+    """HF Gemma3 text checkpoints: llama layout + sandwich-norm keys +
+    per-head q/k norm scales ([head_dim], re-paired like Qwen3's)."""
+    from .gemma3 import Gemma3Config, create_gemma3_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Gemma3Config.gemma3_1b()
+    require = ()
+    if config.sandwich_norm:
+        require += ("pre_ffn_norm/scale", "post_ffn_norm/scale")
+    if config.qk_norm:
+        require += ("attn/q_norm/scale", "attn/k_norm/scale")
+    tree = convert_hf_llama_state(
+        state,
+        scan_layers=config.scan_layers,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        require=require,
+    )
+    model = create_gemma3_model(config)
+    _merge_into(model, tree)
+    return model
+
+
 def load_hf_qwen2(checkpoint_path: str, config=None):
     """HF Qwen2/Qwen2.5 checkpoints are llama-layout plus q/k/v bias
     vectors (re-paired for the rope convention like their kernels);

@@ -85,6 +85,9 @@ class LlamaConfig:
     # Gemma2's alternating local/global layers — requires scan_layers=False
     # (a scanned block shares one static config across layers)
     layer_types: Optional[tuple] = None
+    # Gemma3: sliding layers rotate with THIS theta (10k) and no rope
+    # scaling, while full layers use rope_theta (1M) + rope_scaling
+    rope_local_theta: Optional[float] = None
     # Gemma-family knobs: an explicit per-head width (None = hidden/heads),
     # the MLP gate activation, RMSNorm's (1 + scale) variant, and the
     # sqrt(hidden) embedding multiplier
@@ -577,6 +580,11 @@ class LlamaModel(nn.Module):
                 "layer_types (per-layer sliding/full attention, Gemma2) requires "
                 "scan_layers=False — a scanned block shares one static config"
             )
+        if cfg.layer_types is not None and len(cfg.layer_types) != cfg.num_hidden_layers:
+            raise ValueError(
+                f"layer_types has {len(cfg.layer_types)} entries for "
+                f"{cfg.num_hidden_layers} layers"
+            )
         if cfg.scan_layers:
             layer_cls = nn.remat(_ScanLayer, prevent_cse=False, static_argnums=(3,)) if cfg.remat else _ScanLayer
             scanned = nn.scan(
@@ -593,12 +601,15 @@ class LlamaModel(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 lcfg = cfg
                 if cfg.layer_types is not None:
-                    # Gemma2 alternating local/global attention: the band
-                    # only applies on "sliding_attention" layers
+                    # Gemma2/3 alternating local/global attention: the band
+                    # only applies on "sliding_attention" layers, which in
+                    # Gemma3 also rotate with the LOCAL theta and no scaling
                     windowed = cfg.layer_types[i] == "sliding_attention"
-                    lcfg = dataclasses.replace(
-                        cfg, sliding_window=cfg.sliding_window if windowed else None
-                    )
+                    overrides = {"sliding_window": cfg.sliding_window if windowed else None}
+                    if windowed and cfg.rope_local_theta is not None:
+                        overrides["rope_theta"] = cfg.rope_local_theta
+                        overrides["rope_scaling"] = None
+                    lcfg = dataclasses.replace(cfg, **overrides)
                 hidden = layer_cls(lcfg, name=f"layer_{i}")(hidden, positions, decode)
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.norm_plus_one, name="final_norm")(hidden)
         if cfg.tie_word_embeddings:

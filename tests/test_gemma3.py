@@ -1,0 +1,55 @@
+"""Gemma3 family (models/gemma3.py): dual rope bases + per-head qk-norm +
+5:1 local/global attention through decode and serving. HF importer parity
+lives in test_hf_parity.py."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import Gemma3Config, create_gemma3_model
+
+
+@pytest.fixture(scope="module")
+def tiny_gemma3():
+    return create_gemma3_model(Gemma3Config.tiny(), seq_len=32)
+
+
+def test_structure(tiny_gemma3):
+    cfg = Gemma3Config.tiny()
+    assert cfg.layer_types == ("sliding_attention", "full_attention")
+    assert cfg.rope_local_theta == 10_000.0 and cfg.rope_theta == 1_000_000.0
+    layer0 = tiny_gemma3.params["layer_0"]
+    for norm in ("input_norm", "post_attn_norm", "pre_ffn_norm", "post_ffn_norm"):
+        assert norm in layer0, norm  # the sandwich
+    assert layer0["attn"]["q_norm"]["scale"].shape == (cfg.head_dim,)  # per-head
+    assert "lm_head" not in tiny_gemma3.params  # always tied
+
+
+def test_default_pattern_is_five_to_one():
+    cfg = Gemma3Config(num_hidden_layers=12)
+    assert cfg.layer_types.count("full_attention") == 2
+    assert cfg.layer_types[5] == "full_attention" and cfg.layer_types[11] == "full_attention"
+
+
+def test_greedy_decode_matches_full_prefix(tiny_gemma3):
+    """The cached decode path must apply the per-layer theta AND the band
+    exactly like the full forward — token equality past the window."""
+    ids = (np.arange(2 * 12).reshape(2, 12) % 250 + 1).astype(np.int32)
+    out = np.asarray(generate(tiny_gemma3, ids, max_new_tokens=8))
+    full = ids
+    for _ in range(8):
+        logits = np.asarray(tiny_gemma3(full))
+        full = np.concatenate([full, logits[:, -1].argmax(-1).astype(np.int32)[:, None]], 1)
+    np.testing.assert_array_equal(out, full)
+
+
+def test_serving(tiny_gemma3):
+    from accelerate_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (3, 12, 6)]
+    eng = ServingEngine(tiny_gemma3, num_slots=2, prompt_buckets=(4, 8, 16))
+    outs = eng.generate_many(prompts, max_new_tokens=5)
+    for p, got in zip(prompts, outs):
+        ref = np.asarray(generate(tiny_gemma3, p[None], max_new_tokens=5))[0]
+        np.testing.assert_array_equal(got, ref)

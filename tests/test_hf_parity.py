@@ -821,3 +821,45 @@ def test_gemma2_import_matches_transformers(tmp_path):
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_gemma3_import_matches_transformers(tmp_path):
+    """Gemma3 text: sandwich norms + per-head qk-norm + DUAL rope bases
+    (sliding layers theta 10k unscaled, full layers theta 1M with linear
+    rope_scaling) + the sliding band — all load-bearing at this size."""
+    import jax
+
+    from accelerate_tpu.models import Gemma3Config
+    from accelerate_tpu.models.hub import load_hf_gemma3
+
+    hf_cfg = transformers.Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        query_pre_attn_scalar=32, sliding_window=8,
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        layer_types=["sliding_attention", "full_attention"],
+    )
+    torch.manual_seed(9)
+    hf = transformers.Gemma3ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():  # randomize the tiny norm scales: re-pairing load-bearing
+        for layer in hf.model.layers:
+            layer.self_attn.q_norm.weight.copy_(torch.rand_like(layer.self_attn.q_norm.weight))
+            layer.self_attn.k_norm.weight.copy_(torch.rand_like(layer.self_attn.k_norm.weight))
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Gemma3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        query_pre_attn_scalar=32.0, sliding_window=8, remat=False,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        layer_types=("sliding_attention", "full_attention"),
+    )
+    model = load_hf_gemma3(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
