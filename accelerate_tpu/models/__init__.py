@@ -1,5 +1,5 @@
 """Model zoo: TPU-first flax implementations with mesh sharding rules
-(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/gemma2/gemma3/phi3/mixtral/resnet/vit/whisper/clip/unet/vae)
+(bert/gpt2/gptneox/t5/llama/mistral/qwen2/qwen3/olmo2/gemma/gemma2/gemma3/phi3/mixtral/qwen3moe/resnet/vit/whisper/clip/unet/vae)
 + HF safetensors weight import. The reference delegates models to
 transformers; here they ship in-tree (SURVEY hard-part #3: torch-free
 model story)."""
@@ -85,6 +85,13 @@ from .mixtral import (
     create_mixtral_model,
     mixtral_lm_loss,
 )
+from .qwen3_moe import (
+    QWEN3_MOE_SHARDING_RULES,
+    Qwen3MoeConfig,
+    Qwen3MoeModel,
+    create_qwen3_moe_model,
+    qwen3_moe_lm_loss,
+)
 from .resnet import (
     RESNET_SHARDING_RULES,
     ResNet,
@@ -146,6 +153,7 @@ from .hub import (  # noqa: E402 — HF safetensors importers
     load_hf_olmo2,
     load_hf_qwen2,
     load_hf_qwen3,
+    load_hf_qwen3_moe,
     load_hf_t5,
     load_hf_vit,
     load_hf_clip,

@@ -717,11 +717,29 @@ _MIXTRAL_ATTN = {
 }
 
 
-def convert_hf_mixtral_state(state: dict[str, np.ndarray], num_heads: int, num_kv_heads: int) -> dict:
-    """HF ``MixtralForCausalLM`` -> our param pytree: llama-style attention
-    (q/k re-paired for interleaved rope), per-expert w1/w3/w2 stacked into
-    ``experts/{gate,up,down}_proj`` with a leading expert dim, router
-    ``gate.weight`` transposed to ``router/kernel``."""
+_MIXTRAL_EXPERT_NAMES = {"w1": "gate_proj", "w3": "up_proj", "w2": "down_proj"}
+
+
+def convert_hf_mixtral_state(
+    state: dict[str, np.ndarray],
+    num_heads: int,
+    num_kv_heads: int,
+    *,
+    router_key: str = "block_sparse_moe.gate.weight",
+    expert_re: str = r"block_sparse_moe\.experts\.(\d+)\.(w[123])\.weight",
+    expert_names: Optional[dict] = None,
+    qk_norm: bool = False,
+) -> dict:
+    """HF MoE ``*ForCausalLM`` -> our param pytree: llama-style attention
+    (q/k re-paired for interleaved rope), per-expert kernels stacked into
+    ``experts/{gate,up,down}_proj`` with a leading expert dim, the router
+    transposed to ``router/kernel``. One skeleton serves Mixtral (defaults)
+    and Qwen3-MoE (``mlp.gate`` router, ``gate/up/down_proj`` expert keys,
+    ``qk_norm=True`` for the re-paired per-head norm scales). Every layer
+    must carry the full attention/norm/router/expert family — a partial
+    checkpoint fails loudly instead of silently keeping random init
+    (``_merge_into`` skips absent leaves)."""
+    expert_names = expert_names if expert_names is not None else _MIXTRAL_EXPERT_NAMES
     tree: dict = {}
     if "model.embed_tokens.weight" in state:
         _set(tree, "embed_tokens/embedding", state["model.embed_tokens.weight"])
@@ -733,13 +751,16 @@ def convert_hf_mixtral_state(state: dict[str, np.ndarray], num_heads: int, num_k
         _set(tree, "lm_head/kernel", state["model.embed_tokens.weight"].T)
 
     layer_re = re.compile(r"model\.layers\.(\d+)\.(.+)")
+    expert_pat = re.compile(expert_re)
     experts: dict[tuple, dict[int, np.ndarray]] = {}
+    seen: dict[int, set] = {}
     for key, value in state.items():
         m = layer_re.match(key)
         if not m:
             continue
         idx, rest = int(m.group(1)), m.group(2)
         prefix = f"layer_{idx}"
+        got = seen.setdefault(idx, set())
         if rest in _MIXTRAL_ATTN:
             kernel = value.T
             if rest == "self_attn.q_proj.weight":
@@ -747,20 +768,54 @@ def convert_hf_mixtral_state(state: dict[str, np.ndarray], num_heads: int, num_k
             elif rest == "self_attn.k_proj.weight":
                 kernel = _rope_interleave_permute(kernel, kernel.shape[1] // num_kv_heads)
             _set(tree, f"{prefix}/{_MIXTRAL_ATTN[rest]}", kernel)
+            got.add(_MIXTRAL_ATTN[rest])
+        elif qk_norm and rest in ("self_attn.q_norm.weight", "self_attn.k_norm.weight"):
+            # [head_dim] per-head scales re-pair as one head (see qwen3.py)
+            which = "q_norm" if "q_norm" in rest else "k_norm"
+            _set(tree, f"{prefix}/attn/{which}/scale", _rope_interleave_permute(value[None], len(value))[0])
+            got.add(f"attn/{which}/scale")
         elif rest == "input_layernorm.weight":
             _set(tree, f"{prefix}/input_norm/scale", value)
+            got.add("input_norm/scale")
         elif rest == "post_attention_layernorm.weight":
             _set(tree, f"{prefix}/post_attn_norm/scale", value)
-        elif rest == "block_sparse_moe.gate.weight":
+            got.add("post_attn_norm/scale")
+        elif rest == router_key:
             _set(tree, f"{prefix}/moe/router/kernel", value.T)
+            got.add("moe/router/kernel")
         else:
-            em = re.fullmatch(r"block_sparse_moe\.experts\.(\d+)\.(w[123])\.weight", rest)
+            em = expert_pat.fullmatch(rest)
             if em:
-                # w1 = gate (silu branch), w3 = up, w2 = down; torch [out, in]
-                name = {"w1": "gate_proj", "w3": "up_proj", "w2": "down_proj"}[em.group(2)]
+                # mixtral: w1 = gate (silu branch), w3 = up, w2 = down;
+                # qwen3-moe names map through identically. torch [out, in]
+                name = expert_names.get(em.group(2), em.group(2))
                 experts.setdefault((idx, name), {})[int(em.group(1))] = value.T
+    if not seen:
+        return tree
+    n_layers = max(seen) + 1
+    required = set(_MIXTRAL_ATTN.values()) | {
+        "input_norm/scale", "post_attn_norm/scale", "moe/router/kernel",
+    }
+    if qk_norm:
+        required |= {"attn/q_norm/scale", "attn/k_norm/scale"}
+    for i in range(n_layers):
+        missing = required - seen.get(i, set())
+        missing |= {
+            f"moe/experts/{name}"
+            for name in ("gate_proj", "up_proj", "down_proj")
+            if (i, name) not in experts
+        }
+        if missing:
+            raise ValueError(
+                f"layer {i} is missing {sorted(missing)} — partial checkpoint? "
+                "pass the checkpoint directory (or its index), not a single shard"
+            )
     for (idx, name), per_expert in experts.items():
-        stacked = np.stack([per_expert[i] for i in range(len(per_expert))])
+        n_exp = max(per_expert) + 1
+        holes = [e for e in range(n_exp) if e not in per_expert]
+        if holes:
+            raise ValueError(f"layer {idx} {name}: experts {holes} missing — partial checkpoint?")
+        stacked = np.stack([per_expert[i] for i in range(n_exp)])
         _set(tree, f"layer_{idx}/moe/experts/{name}", stacked)
     return tree
 
@@ -774,6 +829,36 @@ def load_hf_mixtral(checkpoint_path: str, config=None):
         state, num_heads=config.num_attention_heads, num_kv_heads=config.num_key_value_heads
     )
     model = create_mixtral_model(config)
+    _merge_into(model, tree)
+    return model
+
+
+def convert_hf_qwen3_moe_state(state: dict[str, np.ndarray], num_heads: int, num_kv_heads: int) -> dict:
+    """HF ``Qwen3MoeForCausalLM`` -> our param pytree: the mixtral skeleton
+    with Qwen3's key names (``mlp.gate`` router, ``gate/up/down_proj``
+    expert kernels) and the per-head q/k norm scales re-paired for
+    interleaved rope."""
+    return convert_hf_mixtral_state(
+        state,
+        num_heads,
+        num_kv_heads,
+        router_key="mlp.gate.weight",
+        expert_re=r"mlp\.experts\.(\d+)\.(gate_proj|up_proj|down_proj)\.weight",
+        expert_names={},
+        qk_norm=True,
+    )
+
+
+def load_hf_qwen3_moe(checkpoint_path: str, config=None):
+    """HF Qwen3-MoE checkpoints through the mixtral-core model."""
+    from .qwen3_moe import Qwen3MoeConfig, create_qwen3_moe_model
+
+    state = read_safetensors_state(checkpoint_path)
+    config = config or Qwen3MoeConfig.qwen3_30b_a3b()
+    tree = convert_hf_qwen3_moe_state(
+        state, num_heads=config.num_attention_heads, num_kv_heads=config.num_key_value_heads
+    )
+    model = create_qwen3_moe_model(config)
     _merge_into(model, tree)
     return model
 

@@ -38,6 +38,13 @@ class MixtralConfig:
     rope_theta: float = 1e6
     router_aux_loss_coef: float = 0.02
     attention_impl: str = "auto"
+    # Qwen3-MoE variations through the same machinery: per-head q/k
+    # RMSNorm, an explicit head width, a separate expert FF width, and the
+    # raw-softmax (non-renormalised) combine weights
+    qk_norm: bool = False
+    head_dim: Optional[int] = None
+    moe_intermediate_size: Optional[int] = None
+    norm_topk: bool = True
 
     @classmethod
     def tiny(cls, **kw) -> "MixtralConfig":
@@ -63,6 +70,8 @@ class MixtralConfig:
             rms_norm_eps=self.rms_norm_eps,
             rope_theta=self.rope_theta,
             attention_impl=self.attention_impl,
+            qk_norm=self.qk_norm,
+            head_dim=self.head_dim,
         )
 
 
@@ -90,9 +99,10 @@ class MixtralLayer(nn.Module):
         )
         hidden = hidden + MoEBlock(
             num_experts=cfg.num_local_experts,
-            intermediate_size=cfg.intermediate_size,
+            intermediate_size=cfg.moe_intermediate_size or cfg.intermediate_size,
             num_selected=cfg.num_experts_per_tok,
             capacity_factor=cfg.capacity_factor,
+            norm_topk=cfg.norm_topk,
             name="moe",
         )(RMSNorm(cfg.rms_norm_eps, name="post_attn_norm")(hidden))
         return hidden

@@ -29,13 +29,17 @@ def top_k_routing(
     router_logits: jax.Array,  # [T, E]
     num_selected: int,
     capacity: int,
+    norm_topk: bool = True,
 ):
     """GShard-style top-k token routing with fixed expert capacity.
 
     Returns ``(dispatch, combine, aux_loss)``:
     dispatch — bool [T, E, C], token t occupies slot c of expert e;
     combine — float [T, E, C], routing weight for the same slots
-    (normalised over the selected experts);
+    (normalised over the selected experts when ``norm_topk``, the
+    Mixtral convention; Qwen3-MoE checkpoints with
+    ``norm_topk_prob=False`` keep the raw full-softmax probabilities —
+    HF calls this "the only diff with the mixtral sparse moe block");
     aux_loss — load-balance loss (mean fraction routed x mean router prob,
     scaled by E; Shazeer/GShard form).
     """
@@ -69,8 +73,9 @@ def top_k_routing(
         fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
         remaining = remaining * (1.0 - onehot)  # mask chosen expert out
 
-    # normalise combine weights over the actually-kept choices
-    combine = combine / jnp.maximum(selected_mass, 1e-9)[:, None, None]
+    if norm_topk:
+        # normalise combine weights over the actually-kept choices
+        combine = combine / jnp.maximum(selected_mass, 1e-9)[:, None, None]
 
     # load-balance aux: E * mean_e(frac_tokens_e * mean_prob_e)
     frac = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32), axis=0)
@@ -88,6 +93,7 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     wi_gate: Optional[jax.Array] = None,  # [E, d, ff] for SwiGLU experts
     activation=nn.gelu,
+    norm_topk: bool = True,
 ):
     """Dense-dispatch MoE feed-forward. Returns (out [T, d], aux_loss).
 
@@ -101,7 +107,7 @@ def moe_ffn(
     # budget, so top-k routing gets k*T total slots before the factor
     capacity = max(1, int(capacity_factor * num_selected * t / e))
     logits = x @ router_kernel.astype(x.dtype)
-    dispatch, combine, aux = top_k_routing(logits, num_selected, capacity)
+    dispatch, combine, aux = top_k_routing(logits, num_selected, capacity, norm_topk=norm_topk)
 
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # all-to-all in
     if wi_gate is not None:
@@ -125,6 +131,7 @@ class MoEBlock(nn.Module):
     intermediate_size: int
     num_selected: int = 2
     capacity_factor: float = 1.25
+    norm_topk: bool = True  # False = Qwen3-MoE's raw-softmax combine weights
 
     @nn.compact
     def __call__(self, x):
@@ -143,6 +150,7 @@ class MoEBlock(nn.Module):
             num_selected=self.num_selected,
             capacity_factor=self.capacity_factor,
             wi_gate=wi_gate,
+            norm_topk=self.norm_topk,
         )
         self.sow("intermediates", "moe_aux_loss", aux)
         return out.reshape(b, s, d)

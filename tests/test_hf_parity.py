@@ -863,3 +863,45 @@ def test_gemma3_import_matches_transformers(tmp_path):
     with jax.default_matmul_precision("highest"):
         got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
     np.testing.assert_allclose(got, want, atol=TOL)
+
+
+def test_qwen3_moe_import_matches_transformers(tmp_path):
+    """Qwen3-MoE: Qwen3 attention (per-head qk-norm) + routed experts with
+    norm_topk_prob combine weights — HF's routing comment ("only diff with
+    the mixtral sparse moe block") is the contract under test. Capacity is
+    set high enough that the GShard dispatch drops nothing, making the
+    dense comparison exact."""
+    import jax
+
+    from accelerate_tpu.models import Qwen3MoeConfig
+    from accelerate_tpu.models.hub import load_hf_qwen3_moe
+
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=1e6,  # match the family default (HF's own default is 10k)
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+        norm_topk_prob=True, decoder_sparse_step=1, mlp_only_layers=[],
+    )
+    torch.manual_seed(10)
+    hf = transformers.Qwen3MoeForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            layer.self_attn.q_norm.weight.copy_(torch.rand_like(layer.self_attn.q_norm.weight) + 0.5)
+            layer.self_attn.k_norm.weight.copy_(torch.rand_like(layer.self_attn.k_norm.weight) + 0.5)
+    ids = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        want = hf(ids).logits.numpy()
+
+    cfg = Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        num_local_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+        capacity_factor=8.0,  # no token ever dropped at this size
+    )
+    model = load_hf_qwen3_moe(_save(hf, tmp_path), cfg)
+    with jax.default_matmul_precision("highest"):
+        got = np.asarray(model.apply_fn(model.params, ids.numpy().astype(np.int32)))
+    np.testing.assert_allclose(got, want, atol=TOL)
