@@ -79,3 +79,57 @@ def test_lora_finetune_on_new_families(factory, cfg):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+@pytest.mark.parametrize(
+    "family",
+    ["qwen3", "olmo2", "gemma3"],
+)
+def test_round5_families_compose_with_lora_quant_speculative(family):
+    """The round-5 knobs (qk-norm, post-norms, sandwich+dual-rope) ride the
+    same LoRA, quantization, and speculative machinery as llama — one
+    smoke per family keeps every new structural variant composed."""
+    import jax
+
+    from accelerate_tpu.models import (
+        Gemma3Config,
+        Olmo2Config,
+        Qwen3Config,
+        create_gemma3_model,
+        create_olmo2_model,
+        create_qwen3_model,
+    )
+    from accelerate_tpu.models.llama import causal_lm_loss
+    from accelerate_tpu.utils.lora import LoRAConfig, lora_init, lora_merge
+    from accelerate_tpu.utils.quantization import QuantizationConfig, load_and_quantize_model
+
+    factory, cfg = {
+        "qwen3": (create_qwen3_model, Qwen3Config.tiny()),
+        "olmo2": (create_olmo2_model, Olmo2Config.tiny()),
+        "gemma3": (create_gemma3_model, Gemma3Config.tiny()),
+    }[family]
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 250, size=(1, 8)).astype(np.int32)
+    model = factory(cfg, seq_len=16)
+
+    # speculative with a same-family draft: token-exact
+    want = np.asarray(generate(model, ids, max_new_tokens=5))
+    got = np.asarray(speculative_generate(model, model, ids, max_new_tokens=5, gamma=2))
+    np.testing.assert_array_equal(got, want)
+
+    # LoRA step on the variant projections
+    lcfg = LoRAConfig(rank=2, alpha=4.0)
+    lora = lora_init(jax.random.key(0), model.params, lcfg)
+    batch = {"input_ids": rng.integers(1, 250, size=(2, 16)).astype(np.int32)}
+
+    def loss_fn(trainable):
+        merged = lora_merge(model.params, trainable, lcfg)
+        return causal_lm_loss(merged, batch, model.apply_fn)
+
+    loss, grads = jax.value_and_grad(loss_fn)(lora)
+    assert np.isfinite(float(loss))
+    assert any(float(np.abs(np.asarray(g)).max()) > 0 for g in jax.tree.leaves(grads))
+
+    # weight-only int8 quantization: forward stays finite
+    qmodel = load_and_quantize_model(factory(cfg, seq_len=16), QuantizationConfig(bits=8, method="int8"))
+    assert np.isfinite(np.asarray(qmodel(ids))).all()
