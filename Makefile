@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -26,9 +26,15 @@ cache-seed:
 
 # lint if ruff is installed (its exit code propagates); the zero-dep
 # AST/import gates always run
-quality:
+quality: lint
 	@if command -v ruff >/dev/null 2>&1; then ruff check accelerate_tpu tests examples; else echo "ruff not installed; skipping lint"; fi
 	python scripts/check_repo.py
+
+# TPU correctness linter: self-lint the tree (exit nonzero on any
+# error-severity finding) + prove every rule fires on its seeded-defect
+# fixture. Runs on the CPU backend — safe on machines with no TPU.
+lint:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
 
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi

@@ -454,7 +454,18 @@ class Accelerator:
         shardings = self._zero_state_shardings(opt.optimizer, model)
         init_shardings = shardings
         plugin = self.state.parallelism_plugin
-        if plugin is not None and getattr(plugin, "offload_optimizer", False):
+        offload = plugin is not None and getattr(plugin, "offload_optimizer", False)
+        if offload:
+            from .utils.compat import supports_memory_kind
+
+            if not supports_memory_kind("pinned_host"):
+                logger.warning(
+                    "offload_optimizer requested but the %s backend has no pinned_host "
+                    "memory; optimizer state stays in device memory",
+                    jax.default_backend(),
+                )
+                offload = False
+        if offload:
             from .parallel.sharding import zero_optimizer_shardings
 
             state_shapes = jax.eval_shape(opt.optimizer.init, model.params)
@@ -632,6 +643,41 @@ class Accelerator:
 
         return run
 
+    def lint(
+        self,
+        step_fn: Callable,
+        *sample_args,
+        donate_argnums=(),
+        in_shardings=None,
+        ignore=(),
+    ):
+        """Statically lint ``step_fn`` against this accelerator's mesh
+        *before* paying a multi-chip compile (tier-1 jaxpr analysis:
+        collective axis names, silent bf16/fp8->f32 promotion, buffer
+        donation, output sharding constraints — see
+        docs/usage_guides/static_analysis.md for the rule catalogue).
+
+        ``sample_args`` are traced abstractly (``jax.ShapeDtypeStruct``s
+        or real arrays — nothing executes, nothing compiles); concrete
+        arrays contribute their ``NamedSharding`` to the TPU104 check.
+        Returns the list of :class:`~accelerate_tpu.analysis.Finding`;
+        error-severity findings are also logged. Suppress individual rules
+        with ``ignore=("TPU103",)``.
+        """
+        from .analysis import lint_step, render_text
+
+        findings = lint_step(
+            step_fn,
+            *sample_args,
+            mesh=self.mesh,
+            donate_argnums=donate_argnums,
+            in_shardings=in_shardings,
+            ignore=ignore,
+        )
+        if any(f.is_error for f in findings):
+            logger.warning("lint found issues in %s:\n%s", getattr(step_fn, "__name__", "step_fn"), render_text(findings))
+        return findings
+
     def build_train_step(
         self,
         loss_fn: Callable,
@@ -803,7 +849,9 @@ class Accelerator:
                     return g, jax.lax.pmean(local_l, "data"), new_cstate
 
                 comp_spec = {"error": P("data"), "q": P()} if psgd_rank is not None else {}
-                sm = jax.shard_map(
+                from .utils.compat import shard_map as _shard_map
+
+                sm = _shard_map(
                     local_grads,
                     mesh=self.mesh,
                     in_specs=(P(), P(("data", "fsdp")), P(), P(), comp_spec),

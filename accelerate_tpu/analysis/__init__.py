@@ -1,0 +1,44 @@
+"""``accelerate_tpu.analysis`` — the TPU correctness linter.
+
+Two analysis tiers behind one rule registry (``rules.RULES``, stable
+``TPUxxx`` IDs):
+
+* **jaxpr tier** (``lint_step``) — trace a step function against the
+  active mesh and check collective axis names, silent dtype promotion,
+  buffer donation, and output sharding constraints before any compile.
+* **AST tier** (``lint_source`` / ``lint_paths``) — source-text checks
+  for host syncs inside ``jit``, tracer-dependent branches,
+  ``static_argnums`` hazards, the ``_jax()`` lazy-import convention, and
+  the repo hygiene gates grown out of ``scripts/check_repo.py``.
+
+Surfaced as ``accelerate-tpu lint`` (commands/lint.py) and
+``Accelerator.lint(step_fn, *sample_args)``. Suppress a finding inline
+with ``# tpu-lint: disable=TPU201``.
+"""
+
+from .ast_lint import LintConfig, iter_python_files, lint_file, lint_paths, lint_source
+from .jaxpr_lint import lint_step
+from .report import exit_code, format_finding, render_json, render_text
+from .rules import ERROR, RULES, WARNING, Finding, Rule, apply_suppressions, filter_findings
+from .selfcheck import run_selfcheck
+
+__all__ = [
+    "ERROR",
+    "WARNING",
+    "RULES",
+    "Rule",
+    "Finding",
+    "LintConfig",
+    "apply_suppressions",
+    "filter_findings",
+    "lint_source",
+    "lint_file",
+    "lint_paths",
+    "lint_step",
+    "iter_python_files",
+    "format_finding",
+    "render_text",
+    "render_json",
+    "exit_code",
+    "run_selfcheck",
+]

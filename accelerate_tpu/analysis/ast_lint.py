@@ -1,0 +1,468 @@
+"""Tier-2 AST analysis: TPU hazards visible in source text, no jax needed.
+
+Grown out of the ``scripts/check_repo.py`` seed (which is now a thin shim
+over this module). Rules:
+
+* ``TPU001`` unused imports, ``TPU002`` missing module docstrings — the
+  original repo-hygiene gates, kept bug-for-bug compatible with the seed
+  (string constants count as uses so ``__all__`` re-exports pass;
+  ``__init__.py`` is exempt from TPU001).
+* ``TPU201`` host-synchronising calls lexically inside a ``@jit``-decorated
+  function: ``jax.device_get``, ``.item()``, ``float()/int()/bool()`` on a
+  traced parameter, ``time.time()``-family, and host ``numpy`` calls.
+  These force a device->host transfer (or fail outright) at trace time and
+  serialise every step against the host.
+* ``TPU202`` Python ``if``/``while`` on a traced (non-static) parameter of
+  a jitted function — a ConcretizationTypeError on TPU, or a silent
+  per-value recompile. ``x is None`` checks and trace-static accesses
+  (``x.ndim``/``x.shape``/``len(x)``/``isinstance(x, ...)``) are exempt.
+* ``TPU203`` ``static_argnums``/``static_argnames`` naming a parameter
+  whose default is an unhashable literal — jit hashes static arguments, so
+  the first defaulted call dies with ``TypeError: unhashable type``.
+* ``TPU204`` module-level ``import jax`` in the lazy-import zone (the
+  orchestration layer's ``_jax()`` convention, which keeps
+  ``import accelerate_tpu`` and the CLI from initialising a backend).
+
+This module must stay stdlib-only: it is imported by the zero-dependency
+``scripts/check_repo.py`` gate and must run where jax is absent.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .rules import Finding, apply_suppressions, filter_findings
+
+#: numpy attribute calls that are trace-static (operate on shapes/dtypes,
+#: not values) and therefore allowed inside jit.
+_NP_STATIC_ATTRS = frozenset(
+    {"dtype", "shape", "ndim", "prod", "finfo", "iinfo", "issubdtype", "result_type", "promote_types"}
+)
+
+#: attribute accesses on a tracer that are static at trace time — reading
+#: them in an ``if`` does not concretise the value.
+_TRACER_STATIC_ATTRS = frozenset({"ndim", "shape", "dtype", "size", "aval", "sharding", "itemsize"})
+
+#: calls through which a parameter may appear in a branch test without
+#: concretising it.
+_TRACER_STATIC_CALLS = frozenset({"len", "isinstance", "hasattr", "getattr", "callable", "type", "id"})
+
+_TIME_HOST_FNS = frozenset({"time", "perf_counter", "monotonic", "process_time", "thread_time"})
+
+#: directory names never descended into by lint_paths.
+_SKIP_DIRS = frozenset({"__pycache__", ".git", ".cache", "build", "dist", ".eggs"})
+
+
+@dataclass
+class LintConfig:
+    """Knobs for the AST tier.
+
+    ``lazy_jax`` controls TPU204's zone: ``"auto"`` enforces the
+    ``_jax()`` convention only where the repo established it (top-level
+    ``accelerate_tpu/*.py`` plus ``commands/`` and ``analysis/`` — the
+    compute layers ``ops/``, ``models/``, ``parallel/`` import jax eagerly
+    by design), ``"always"`` enforces everywhere, ``"never"`` disables it.
+    """
+
+    select: Optional[frozenset] = None
+    ignore: frozenset = field(default_factory=frozenset)
+    lazy_jax: str = "auto"
+
+
+#: package subdirectories where the lazy-import convention is enforced in
+#: ``auto`` mode (relative to the ``accelerate_tpu`` package root).
+_LAZY_ZONE_SUBDIRS = ("commands", "analysis")
+
+
+def _in_lazy_jax_zone(path: str) -> bool:
+    parts = pathlib.PurePath(path).parts
+    if "accelerate_tpu" not in parts:
+        return False
+    tail = parts[parts.index("accelerate_tpu") + 1 :]
+    if len(tail) == 1:  # top-level orchestration module
+        return True
+    return len(tail) == 2 and tail[0] in _LAZY_ZONE_SUBDIRS
+
+
+# -- shared AST helpers ---------------------------------------------------
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """``a.b.c`` -> ``["a", "b", "c"]``; empty when the root is not a Name."""
+    out: list[str] = []
+    while isinstance(node, ast.Attribute):
+        out.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        out.append(node.id)
+        out.reverse()
+        return out
+    return []
+
+
+def _module_aliases(tree: ast.Module, module: str) -> set[str]:
+    """Local names bound to ``module`` (``import numpy as np`` -> {np})."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module or a.name.startswith(module + "."):
+                    aliases.add((a.asname or a.name).split(".")[0])
+    return aliases
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``pjit`` / ``jax.experimental.pjit.pjit``."""
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    chain = _attr_chain(node)
+    return bool(chain) and chain[-1] in ("jit", "pjit")
+
+
+def _const_strs(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _const_ints(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+@dataclass
+class _JitInfo:
+    static_names: set[str]
+    static_nums: list[int]
+
+
+def _jit_decoration(func: ast.AST) -> Optional[_JitInfo]:
+    """Return static-argument info when ``func`` carries a jit decorator
+    (``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+    ``@jax.jit(...)`` factory form), else ``None``."""
+    for deco in getattr(func, "decorator_list", []):
+        call = None
+        if _is_jit_expr(deco):
+            return _JitInfo(set(), [])
+        if isinstance(deco, ast.Call):
+            if _is_jit_expr(deco.func):
+                call = deco
+            else:
+                chain = _attr_chain(deco.func)
+                is_partial = (isinstance(deco.func, ast.Name) and deco.func.id == "partial") or (
+                    bool(chain) and chain[-1] == "partial"
+                )
+                if is_partial and deco.args and _is_jit_expr(deco.args[0]):
+                    call = deco
+        if call is not None:
+            names: set[str] = set()
+            nums: list[int] = []
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    names.update(_const_strs(kw.value))
+                elif kw.arg == "static_argnums":
+                    nums.extend(_const_ints(kw.value))
+            return _JitInfo(names, nums)
+    return None
+
+
+def _param_nodes(func) -> list[ast.arg]:
+    a = func.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _param_default(func, name: str) -> Optional[ast.AST]:
+    a = func.args
+    positional = list(a.posonlyargs) + list(a.args)
+    for arg, default in zip(reversed(positional), reversed(a.defaults)):
+        if arg.arg == name:
+            return default
+    for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+        if arg.arg == name and default is not None:
+            return default
+    return None
+
+
+def _traced_params(func, info: _JitInfo) -> set[str]:
+    positional = [a.arg for a in list(func.args.posonlyargs) + list(func.args.args)]
+    static = set(info.static_names)
+    for i in info.static_nums:
+        if 0 <= i < len(positional):
+            static.add(positional[i])
+    params = {a.arg for a in _param_nodes(func)} - static - {"self", "cls"}
+    return params
+
+
+def _dynamic_names_in(test: ast.AST, candidates: set[str]) -> set[str]:
+    """Names from ``candidates`` used *dynamically* in a branch test —
+    i.e. not behind a trace-static access (``x.ndim``, ``len(x)``,
+    ``x is None``, ``isinstance(x, T)``)."""
+    hits: set[str] = set()
+
+    def visit(node: ast.AST):
+        if isinstance(node, ast.Name):
+            if node.id in candidates:
+                hits.add(node.id)
+            return
+        if isinstance(node, ast.Attribute) and node.attr in _TRACER_STATIC_ATTRS:
+            return  # x.ndim / x.shape[...] — static
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _TRACER_STATIC_CALLS:
+                return
+            if isinstance(fn, ast.Attribute):  # x.get(...)? visit receiver only
+                visit(fn.value)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                visit(arg)
+            return
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            comparators = [node.left] + list(node.comparators)
+            if any(isinstance(c, ast.Constant) and c.value is None for c in comparators):
+                return  # `x is None` — resolved at trace time
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return hits
+
+
+# -- per-rule passes ------------------------------------------------------
+
+
+def _check_unused_imports(tree: ast.Module, path: str) -> list[Finding]:
+    if pathlib.PurePath(path).name == "__init__.py":
+        return []  # __init__ imports are re-exports by convention
+    imported: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)  # __all__ / docstring mentions count as use
+    return [
+        Finding("TPU001", f"unused import {name!r}", path=path, line=lineno)
+        for name, lineno in imported.items()
+        if name not in used
+    ]
+
+
+def _check_module_docstring(tree: ast.Module, path: str, text: str) -> list[Finding]:
+    if pathlib.PurePath(path).name == "__init__.py" and not text.strip():
+        return []
+    if ast.get_docstring(tree) is None:
+        return [Finding("TPU002", "missing module docstring", path=path, line=1)]
+    return []
+
+
+def _check_jit_bodies(tree: ast.Module, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    np_aliases = _module_aliases(tree, "numpy")
+    time_aliases = _module_aliases(tree, "time")
+
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _jit_decoration(func)
+        if info is None:
+            continue
+        traced = _traced_params(func, info)
+
+        # TPU203 — static params with unhashable defaults
+        positional = [a.arg for a in list(func.args.posonlyargs) + list(func.args.args)]
+        static_names = set(info.static_names) | {
+            positional[i] for i in info.static_nums if 0 <= i < len(positional)
+        }
+        for name in sorted(static_names):
+            default = _param_default(func, name)
+            if isinstance(default, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp)):
+                findings.append(
+                    Finding(
+                        "TPU203",
+                        f"static argument {name!r} of {func.name!r} has an unhashable default; "
+                        "jit hashes static arguments, so the defaulted call raises TypeError",
+                        path=path,
+                        line=default.lineno,
+                    )
+                )
+
+        for node in ast.walk(func):
+            # TPU201 — host-synchronising calls
+            if isinstance(node, ast.Call):
+                fn = node.func
+                chain = _attr_chain(fn)
+                if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+                    findings.append(
+                        Finding(
+                            "TPU201",
+                            ".item() synchronises device->host inside jit",
+                            path=path,
+                            line=node.lineno,
+                        )
+                    )
+                elif chain[:1] == ["jax"] and chain[-1] in ("device_get", "block_until_ready"):
+                    findings.append(
+                        Finding(
+                            "TPU201",
+                            f"jax.{chain[-1]}() is a host sync and has no meaning on tracers inside jit",
+                            path=path,
+                            line=node.lineno,
+                        )
+                    )
+                elif chain and chain[0] in time_aliases and chain[-1] in _TIME_HOST_FNS:
+                    findings.append(
+                        Finding(
+                            "TPU201",
+                            f"{'.'.join(chain)}() reads the host clock inside jit; it runs at trace "
+                            "time only (use jax.block_until_ready outside the jitted function to time steps)",
+                            path=path,
+                            line=node.lineno,
+                        )
+                    )
+                elif chain and chain[0] in np_aliases and chain[-1] not in _NP_STATIC_ATTRS:
+                    findings.append(
+                        Finding(
+                            "TPU201",
+                            f"host numpy call {'.'.join(chain)}() inside jit materialises the operand "
+                            "on the host (use jnp instead)",
+                            path=path,
+                            line=node.lineno,
+                        )
+                    )
+                elif (
+                    isinstance(fn, ast.Name)
+                    and fn.id in ("float", "int", "bool")
+                    and len(node.args) == 1
+                    and _dynamic_names_in(node.args[0], traced)
+                ):
+                    findings.append(
+                        Finding(
+                            "TPU201",
+                            f"{fn.id}() on traced argument "
+                            f"{sorted(_dynamic_names_in(node.args[0], traced))[0]!r} concretises it "
+                            "inside jit (ConcretizationTypeError on TPU)",
+                            path=path,
+                            line=node.lineno,
+                        )
+                    )
+            # TPU202 — tracer-dependent Python control flow
+            elif isinstance(node, (ast.If, ast.While)):
+                dyn = _dynamic_names_in(node.test, traced)
+                if dyn:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(
+                        Finding(
+                            "TPU202",
+                            f"Python `{kind}` on traced argument(s) {sorted(dyn)} inside jitted "
+                            f"{func.name!r}; use jax.lax.cond/select, or mark the argument static",
+                            path=path,
+                            line=node.lineno,
+                        )
+                    )
+    return findings
+
+
+def _check_eager_jax_import(tree: ast.Module, path: str, config: LintConfig) -> list[Finding]:
+    if config.lazy_jax == "never":
+        return []
+    if config.lazy_jax == "auto" and not _in_lazy_jax_zone(path):
+        return []
+    findings = []
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            bad = [a.name for a in node.names if a.name == "jax" or a.name.startswith("jax.")]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            bad = [node.module] if node.module and (node.module == "jax" or node.module.startswith("jax.")) else []
+        else:
+            continue
+        for name in bad:
+            findings.append(
+                Finding(
+                    "TPU204",
+                    f"module-level `import {name}` in a lazy-import zone; use the `_jax()` "
+                    "convention so importing this module never initialises a backend",
+                    path=path,
+                    line=node.lineno,
+                )
+            )
+    return findings
+
+
+# -- entry points ---------------------------------------------------------
+
+
+def lint_source(text: str, path: str = "<string>", config: Optional[LintConfig] = None) -> list[Finding]:
+    """Lint one module's source text; suppressions and select/ignore applied."""
+    config = config or LintConfig()
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Finding("TPU003", f"syntax error: {e.msg}", path=path, line=e.lineno or 1)]
+    findings = (
+        _check_unused_imports(tree, path)
+        + _check_module_docstring(tree, path, text)
+        + _check_jit_bodies(tree, path)
+        + _check_eager_jax_import(tree, path, config)
+    )
+    findings = apply_suppressions(findings, text.splitlines())
+    findings = filter_findings(findings, select=config.select, ignore=config.ignore)
+    # nested jit-in-jit defs are walked from both enclosing scopes — dedup
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.path, f.line, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    unique.sort(key=lambda f: (f.path or "", f.line or 0, f.rule))
+    return unique
+
+
+def lint_file(path, config: Optional[LintConfig] = None) -> list[Finding]:
+    p = pathlib.Path(path)
+    return lint_source(p.read_text(), path=str(p), config=config)
+
+
+def iter_python_files(paths: Iterable) -> list[pathlib.Path]:
+    out = []
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in f.parts):
+                    out.append(f)
+    return out
+
+
+def lint_paths(paths: Iterable, config: Optional[LintConfig] = None) -> list[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_file(f, config))
+    return findings

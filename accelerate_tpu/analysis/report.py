@@ -1,0 +1,42 @@
+"""Finding reporters: the ``path:line: TPUxxx message`` text format that
+editors and CI annotators parse, and a JSON format for tooling.
+
+The text format is the contract shared by ``accelerate-tpu lint``,
+``scripts/check_repo.py`` and ``make lint`` — one finding per line, the
+rule ID immediately after the location so ``grep TPU1`` / problem-matcher
+regexes work unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .rules import ERROR, Finding
+
+
+def format_finding(f: Finding) -> str:
+    loc = f.path or "<jaxpr>"
+    if f.line is not None:
+        loc = f"{loc}:{f.line}"
+    return f"{loc}: {f.rule} {f.message}"
+
+
+def render_text(findings: list[Finding], *, summary: bool = True) -> str:
+    lines = [format_finding(f) for f in findings]
+    if summary:
+        n_err = sum(1 for f in findings if f.is_error)
+        n_warn = len(findings) - n_err
+        lines.append(f"{len(findings)} finding(s): {n_err} error(s), {n_warn} warning(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps([f.as_dict() for f in findings], indent=2)
+
+
+def exit_code(findings: list[Finding], *, strict: bool = False) -> int:
+    """CI contract: nonzero on any error-severity finding (any finding at
+    all under ``strict``)."""
+    if strict:
+        return 1 if findings else 0
+    return 1 if any(f.severity == ERROR for f in findings) else 0

@@ -1,0 +1,138 @@
+"""Rule registry for the TPU correctness linter: stable IDs, severities,
+findings, and ``# tpu-lint: disable=...`` suppression handling.
+
+The rule space is split by analysis tier (see docs/usage_guides/
+static_analysis.md for the worked catalogue):
+
+* ``TPU0xx`` — repo hygiene, grown out of ``scripts/check_repo.py``
+  (unused imports, module docstrings, import health).
+* ``TPU1xx`` — jaxpr-level checks that need a traced program and the
+  active ``jax.sharding.Mesh`` (collective axes, dtype promotion,
+  donation, output shardings).
+* ``TPU2xx`` — AST-level checks on source text (host syncs inside
+  ``jit``, tracer-dependent Python control flow, ``static_argnums``
+  hazards, the ``_jax()`` lazy-import convention).
+
+This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
+its zero-extra-dependency property and the AST tier can run where jax is
+not importable.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+ERROR = "error"
+WARNING = "warning"
+
+#: Tiers (informational; reporters group by it).
+TIER_REPO = "repo"
+TIER_JAXPR = "jaxpr"
+TIER_AST = "ast"
+
+
+@dataclass(frozen=True)
+class Rule:
+    """A registered lint rule with a stable ID."""
+
+    id: str
+    name: str
+    severity: str
+    tier: str
+    summary: str
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        # -- repo hygiene (the check_repo.py seed, now importable) --------
+        Rule("TPU001", "unused-import", ERROR, TIER_REPO, "name imported but never referenced"),
+        Rule("TPU002", "missing-module-docstring", ERROR, TIER_REPO, "public module has no module docstring"),
+        Rule("TPU003", "import-failure", ERROR, TIER_REPO, "module does not import cleanly on the CPU backend"),
+        # -- tier 1: jaxpr ------------------------------------------------
+        Rule("TPU101", "unknown-collective-axis", ERROR, TIER_JAXPR, "collective uses an axis name absent from the mesh"),
+        Rule("TPU102", "silent-dtype-promotion", WARNING, TIER_JAXPR, "low-precision value promoted to f32/f64 in the graph"),
+        Rule("TPU103", "missed-donation", WARNING, TIER_JAXPR, "read-and-replaced argument is not donated"),
+        Rule("TPU104", "unconstrained-output-sharding", WARNING, TIER_JAXPR, "input mesh axis never re-constrained anywhere in the graph"),
+        # -- tier 2: AST --------------------------------------------------
+        Rule("TPU201", "host-call-in-jit", ERROR, TIER_AST, "host-synchronising call lexically inside a jitted function"),
+        Rule("TPU202", "tracer-dependent-branch", WARNING, TIER_AST, "Python if/while on a traced argument inside a jitted function"),
+        Rule("TPU203", "unhashable-static-default", ERROR, TIER_AST, "static_argnums/static_argnames parameter has an unhashable default"),
+        Rule("TPU204", "eager-jax-import", ERROR, TIER_AST, "module-level jax import in a lazy-import (`_jax()`) zone"),
+    )
+}
+
+
+@dataclass
+class Finding:
+    """One linter finding, bound to a rule ID.
+
+    ``path``/``line`` are absent for jaxpr-tier findings that have no
+    source location (the reporter prints ``<jaxpr>`` then).
+    """
+
+    rule: str
+    message: str
+    path: Optional[str] = None
+    line: Optional[int] = None
+    severity: str = field(default="")
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule id {self.rule!r}")
+        if not self.severity:
+            self.severity = RULES[self.rule].severity
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity == ERROR
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "name": RULES[self.rule].name,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+# -- suppressions ---------------------------------------------------------
+
+#: Inline suppression comment: ``# tpu-lint: disable`` silences every rule
+#: on that line; ``# tpu-lint: disable=TPU201,TPU102`` silences those IDs.
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable(?:=([A-Za-z0-9_,\s]+))?")
+
+
+def suppressions_for_line(source_line: str) -> Optional[frozenset[str]]:
+    """Rule IDs suppressed on this source line: ``None`` when there is no
+    suppression comment, an empty frozenset for a bare ``disable`` (silence
+    everything), else the named IDs."""
+    m = _SUPPRESS_RE.search(source_line)
+    if m is None:
+        return None
+    if m.group(1) is None:
+        return frozenset()
+    return frozenset(part.strip().upper() for part in m.group(1).split(",") if part.strip())
+
+
+def apply_suppressions(findings: list[Finding], source_lines: list[str]) -> list[Finding]:
+    """Drop findings whose source line carries a matching suppression."""
+    kept = []
+    for f in findings:
+        if f.line is not None and 1 <= f.line <= len(source_lines):
+            ids = suppressions_for_line(source_lines[f.line - 1])
+            if ids is not None and (not ids or f.rule in ids):
+                continue
+        kept.append(f)
+    return kept
+
+
+def filter_findings(findings: list[Finding], select=None, ignore=()) -> list[Finding]:
+    """Keep only ``select`` (when given) minus ``ignore`` rule IDs."""
+    sel = {s.upper() for s in select} if select else None
+    ign = {s.upper() for s in ignore}
+    return [f for f in findings if (sel is None or f.rule in sel) and f.rule not in ign]

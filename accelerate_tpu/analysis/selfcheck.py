@@ -1,0 +1,170 @@
+"""Linter selfcheck: one deliberately-broken fixture per rule, asserting
+every detector actually fires (``accelerate-tpu lint --selfcheck``).
+
+This is the executable spec of the rule catalogue: each fixture seeds
+exactly the defect its rule exists to catch — a wrong collective axis, a
+silent bf16->f32 promotion, a missed donation, an unconstrained output
+sharding, a host sync inside jit, a tracer-dependent branch, an unhashable
+static default, and an eager module-scope jax import. A CI run that passes
+selfcheck has proven the linter end-to-end on the CPU backend, so a clean
+repo lint actually means something.
+
+jax is imported lazily (this module lives in the analysis lazy-import
+zone); the jaxpr fixtures are built inside ``run_selfcheck``.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from .ast_lint import LintConfig, lint_source
+from .jaxpr_lint import lint_step
+from .rules import Finding
+
+# -- AST-tier fixtures (source text, linted without executing) ------------
+
+_AST_FIXTURES = {
+    "TPU201": textwrap.dedent(
+        '''
+        """Fixture: host sync inside jit."""
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            host = jax.device_get(x)
+            return float(x) + host.item()
+        '''
+    ),
+    "TPU202": textwrap.dedent(
+        '''
+        """Fixture: tracer-dependent Python branch inside jit."""
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        '''
+    ),
+    "TPU203": textwrap.dedent(
+        '''
+        """Fixture: unhashable static_argnames default."""
+        import functools
+
+        import jax
+
+
+        @functools.partial(jax.jit, static_argnames=("layers",))
+        def step(x, layers=[64, 64]):
+            return x
+        '''
+    ),
+    "TPU204": textwrap.dedent(
+        '''
+        """Fixture: eager module-scope jax import in a lazy-import zone."""
+        import jax
+
+        __version__ = str(jax.__version__)
+        '''
+    ),
+    "TPU001": '"""Fixture: unused import."""\nimport os\n\nVALUE = 1\n',
+    "TPU002": "VALUE = 1\n",
+}
+
+#: which rules each AST fixture is expected to raise (a fixture may also
+#: trip other rules — e.g. the TPU204 fixture's import is on purpose).
+_AST_CONFIGS = {
+    "TPU201": LintConfig(select=frozenset({"TPU201"})),
+    "TPU202": LintConfig(select=frozenset({"TPU202"})),
+    "TPU203": LintConfig(select=frozenset({"TPU203"})),
+    "TPU204": LintConfig(select=frozenset({"TPU204"}), lazy_jax="always"),
+    "TPU001": LintConfig(select=frozenset({"TPU001"})),
+    "TPU002": LintConfig(select=frozenset({"TPU002"})),
+}
+
+
+def _jaxpr_fixtures(mesh):
+    """``rule -> (fn, sample_args, kwargs)`` seeded jaxpr-tier defects."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def bad_axis_step(x):
+        return jax.lax.psum(x, "nonexistent_axis")
+
+    def promoting_step(x):
+        return (x.astype(jnp.float32) * 2.0).sum()
+
+    def undonated_step(params, batch):
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+        return new_params, batch.sum()
+
+    def unconstrained_step(x):
+        return (x * 2.0).sum(axis=-1)
+
+    x_bf16 = jax.ShapeDtypeStruct((8, 16), jnp.bfloat16)
+    x_f32 = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    params = {"w": jax.ShapeDtypeStruct((16, 16), jnp.float32), "b": jax.ShapeDtypeStruct((16,), jnp.float32)}
+
+    fixtures = {
+        "TPU101": (bad_axis_step, (x_f32,), {}),
+        "TPU102": (promoting_step, (x_bf16,), {}),
+        "TPU103": (undonated_step, (params, x_f32), {}),
+    }
+    # TPU104 needs an input actually sharded over a non-trivial axis
+    batch_axes = [a for a, n in mesh.shape.items() if n > 1]
+    if batch_axes:
+        sharded = jax.device_put(
+            np.zeros((8 * mesh.shape[batch_axes[0]], 16), np.float32),
+            NamedSharding(mesh, P(batch_axes[0])),
+        )
+        fixtures["TPU104"] = (unconstrained_step, (sharded,), {})
+    return fixtures
+
+
+def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
+    """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
+    when any rule failed to fire on its seeded defect."""
+    lines: list[str] = []
+    ok = True
+
+    for rule, source in sorted(_AST_FIXTURES.items()):
+        found = lint_source(source, path=f"<selfcheck:{rule}>", config=_AST_CONFIGS[rule])
+        fired = any(f.rule == rule for f in found)
+        ok &= fired
+        lines.append(f"[selfcheck] {rule} ast fixture: {'detected' if fired else 'MISSED'}")
+
+    if mesh is None:
+        from ..parallel.mesh import MeshConfig
+
+        mesh = MeshConfig().build()
+
+    for rule, (fn, args, kwargs) in sorted(_jaxpr_fixtures(mesh).items()):
+        found = lint_step(fn, *args, mesh=mesh, select=(rule,), **kwargs)
+        fired = any(f.rule == rule for f in found)
+        ok &= fired
+        lines.append(f"[selfcheck] {rule} jaxpr fixture: {'detected' if fired else 'MISSED'}")
+
+    # suppression honoured: the TPU201 fixture with an inline disable
+    suppressed_src = _AST_FIXTURES["TPU201"].replace(
+        "host = jax.device_get(x)", "host = jax.device_get(x)  # tpu-lint: disable=TPU201"
+    ).replace("return float(x) + host.item()", "return x.sum()  # tpu-lint: disable")
+    left = lint_source(suppressed_src, path="<selfcheck:suppress>", config=_AST_CONFIGS["TPU201"])
+    quiet = not left
+    ok &= quiet
+    lines.append(f"[selfcheck] inline suppressions: {'honoured' if quiet else 'BROKEN'}")
+
+    return ok, lines
+
+
+def selfcheck_findings() -> list[Finding]:
+    """Selfcheck as findings (empty == healthy), for embedding in reports."""
+    ok, lines = run_selfcheck()
+    if ok:
+        return []
+    return [Finding("TPU003", f"linter selfcheck failed: {line}") for line in lines if "MISSED" in line or "BROKEN" in line]

@@ -9,6 +9,7 @@ from .config import config_parser
 from .env import env_parser
 from .estimate import estimate_parser
 from .launch import launch_parser
+from .lint import lint_parser
 from .merge import merge_parser
 from .migrate import migrate_parser
 from .test import test_parser
@@ -25,6 +26,7 @@ def main():
     env_parser(subparsers)
     test_parser(subparsers)
     estimate_parser(subparsers)
+    lint_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)
     tpu_command_parser(subparsers)

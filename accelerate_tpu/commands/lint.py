@@ -1,0 +1,103 @@
+"""``accelerate-tpu lint`` — the TPU correctness linter CLI.
+
+AST tier over source paths plus the ``--selfcheck`` seeded-defect run
+(which also exercises the jaxpr tier against a CPU fake mesh, so CI can
+prove the detectors fire without touching hardware). Exit code is the CI
+contract from ``analysis.report.exit_code``: nonzero on any
+error-severity finding (or any finding at all under ``--strict``).
+
+Examples::
+
+    accelerate-tpu lint accelerate_tpu/            # lint the tree
+    accelerate-tpu lint --selfcheck                # prove the rules fire
+    accelerate-tpu lint src/train.py --format json # machine-readable
+    accelerate-tpu lint pkg/ --select TPU201,TPU202
+
+The jaxpr tier for *your* step function is programmatic —
+``Accelerator.lint(step_fn, *sample_args)`` or
+``accelerate_tpu.analysis.lint_step`` — because it needs sample shapes
+and your mesh, which a file path cannot carry.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def lint_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("lint", help="Static TPU correctness checks (AST tier + selfcheck)")
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu lint")
+    parser.add_argument("paths", nargs="*", help="Files or directories to lint (.py files)")
+    parser.add_argument("--format", choices=("text", "json"), default="text", help="Report format")
+    parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
+    parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
+    parser.add_argument(
+        "--lazy-jax",
+        choices=("auto", "always", "never"),
+        default="auto",
+        help="TPU204 zone: enforce the _jax() lazy-import convention (default: auto-detect)",
+    )
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck",
+        action="store_true",
+        help="Run every rule against its seeded-defect fixture on a CPU fake mesh",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=lint_command)
+    return parser
+
+
+def _split_ids(raw):
+    return frozenset(p.strip().upper() for p in raw.split(",") if p.strip()) or None
+
+
+def lint_command(args) -> int:
+    from accelerate_tpu.analysis import LintConfig, exit_code, lint_paths, render_json, render_text
+
+    if not args.paths and not args.selfcheck:
+        print("usage: accelerate-tpu lint [paths ...] [--selfcheck]")
+        return 2
+
+    rc = 0
+    if args.selfcheck:
+        # the jaxpr fixtures need a (multi-device) mesh; never touch a real
+        # backend from a lint invocation — same bootstrap as check_repo.py
+        from accelerate_tpu.utils.environment import force_host_platform
+
+        force_host_platform(8)
+        from accelerate_tpu.analysis.selfcheck import run_selfcheck
+
+        ok, lines = run_selfcheck()
+        if args.format == "text":
+            for line in lines:
+                print(line)
+        if not ok:
+            print("selfcheck FAILED: a rule missed its seeded defect")
+            return 1
+
+    findings = []
+    if args.paths:
+        config = LintConfig(
+            select=_split_ids(args.select) if args.select else None,
+            ignore=_split_ids(args.ignore) or frozenset(),
+            lazy_jax=args.lazy_jax,
+        )
+        findings = lint_paths(args.paths, config)
+        rc = exit_code(findings, strict=args.strict)
+
+    if args.format == "json":
+        print(render_json(findings))
+    elif findings or args.paths:
+        print(render_text(findings))
+    return rc
+
+
+def main():
+    raise SystemExit(lint_command(lint_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,13 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
-import jax
 import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
 
 
 class Model:
@@ -72,9 +77,11 @@ class Model:
         return self
 
     def num_parameters(self) -> int:
+        jax = _jax()
         return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params) if hasattr(p, "shape"))
 
     def parameter_bytes(self) -> int:
+        jax = _jax()
         return sum(
             int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
             for p in jax.tree_util.tree_leaves(self.params)
@@ -83,12 +90,14 @@ class Model:
 
     def state_dict(self) -> Any:
         """Flat ``{path: np.ndarray}`` view (for save/export)."""
+        jax = _jax()
         flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
         from .parallel.sharding import path_str
 
         return {path_str(kp): np.asarray(jax.device_get(v)) for kp, v in flat}
 
     def load_state_dict(self, state_dict: dict) -> None:
+        jax = _jax()
         from .parallel.sharding import path_str
 
         leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(self.params)

@@ -186,8 +186,9 @@ def _kernel_runner(fn, heads: int, kv_heads: int):
     non-trivial tensor axis is active (or we're already inside a
     shard_map region), and None when heads don't divide the axis — the
     caller then uses the XLA gather path, which partitions naturally."""
-    am = jax.sharding.get_abstract_mesh()
-    if any(t == jax.sharding.AxisType.Manual for t in getattr(am, "axis_types", ())):
+    from ..utils.compat import in_manual_region, shard_map
+
+    if in_manual_region():
         return fn
     from .attention import active_mesh
 
@@ -203,7 +204,7 @@ def _kernel_runner(fn, heads: int, kv_heads: int):
         return None
     qspec = P(None, "tensor", None)
     pspec = P(None, None, "tensor", None)
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(qspec, pspec, pspec, P(None, None), P(None)),

@@ -18,8 +18,13 @@ from __future__ import annotations
 
 from typing import Any
 
-import jax
 import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
 
 
 class AcceleratedOptimizer:
@@ -50,7 +55,7 @@ class AcceleratedOptimizer:
         """Create optimizer state. With ``out_shardings`` the state is
         *born sharded* (jit with out_shardings) — no post-hoc re-layout."""
         if out_shardings is not None:
-            self.opt_state = jax.jit(self.optimizer.init, out_shardings=out_shardings)(params)
+            self.opt_state = _jax().jit(self.optimizer.init, out_shardings=out_shardings)(params)
         else:
             self.opt_state = self.optimizer.init(params)
         return self.opt_state
@@ -91,10 +96,12 @@ class AcceleratedOptimizer:
 
     def state_dict(self) -> dict:
         """Host-side snapshot of optimizer state (for checkpointing)."""
+        jax = _jax()
         leaves = jax.tree_util.tree_leaves(self.opt_state)
         return {"leaves": [np.asarray(jax.device_get(l)) for l in leaves]}
 
     def load_state_dict(self, state_dict: dict):
+        jax = _jax()
         leaves, treedef = jax.tree_util.tree_flatten(self.opt_state)
         new = state_dict["leaves"]
         if len(new) != len(leaves):

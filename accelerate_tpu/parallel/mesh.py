@@ -91,7 +91,9 @@ class MeshConfig:
         try:
             axis_types = (jax.sharding.AxisType.Auto,) * len(AXIS_NAMES)
             return jax.make_mesh(shape, AXIS_NAMES, devices=devices, axis_types=axis_types)
-        except TypeError:
+        except (AttributeError, TypeError):
+            # jax < 0.6 has no AxisType (meshes are implicitly Auto) and older
+            # make_mesh signatures lack axis_types — same GSPMD semantics
             mesh_devices = np.asarray(devices).reshape(shape)
             return jax.sharding.Mesh(mesh_devices, AXIS_NAMES)
 

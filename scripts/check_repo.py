@@ -1,22 +1,24 @@
 #!/usr/bin/env python
-"""Zero-dependency repo quality gates (reference analogue: the Makefile
-quality targets + utils/check_copies.py-style repo checks; the image has no
-ruff/flake8, so the checks that matter are implemented directly):
+"""Zero-dependency repo quality gate — a thin shim over
+``accelerate_tpu.analysis`` so ``make quality`` and ``accelerate-tpu lint``
+share one rule implementation (the AST tier is stdlib-only, so this script
+keeps its zero-extra-dependency property):
 
-1. **import check** — every package module imports cleanly on the CPU
-   backend. This is the gate that would have caught round 1's
+1. **import check** (``TPU003``) — every package module imports cleanly on
+   the CPU backend. This is the gate that would have caught round 1's
    ``tracking.py`` module-level NameError.
-2. **unused-import check** — AST scan; names imported but never referenced.
-3. **docstring check** — every public module opens with a docstring (the
-   project convention: docstrings cite the reference file:line they cover).
+2. **AST tier** (``TPU001`` unused imports, ``TPU002`` module docstrings,
+   ``TPU2xx`` TPU hazards) — delegated to
+   ``accelerate_tpu.analysis.ast_lint``.
 
-Exit code is nonzero on any finding. Run via ``make quality``.
+Findings print in the standard ``path:line: TPUxxx message`` format so
+editors and CI annotators can parse them. Exit code is nonzero on any
+error-severity finding. Run via ``make quality`` (or ``make lint`` for the
+CLI equivalent plus the rule selfcheck).
 """
 
 from __future__ import annotations
 
-import ast
-import importlib
 import pathlib
 import sys
 
@@ -24,81 +26,25 @@ REPO = pathlib.Path(__file__).parent.parent
 PKG = REPO / "accelerate_tpu"
 
 
-def iter_modules():
+def check_imports() -> list:
+    """Import every package module on the forced-CPU backend (TPU003)."""
+    import importlib
+
+    from accelerate_tpu.analysis import Finding
+
+    failures = []
     for path in sorted(PKG.rglob("*.py")):
         rel = path.relative_to(REPO)
         mod = ".".join(rel.with_suffix("").parts)
         if mod.endswith(".__init__"):
             mod = mod[: -len(".__init__")]
-        yield mod, path
-
-
-def check_imports() -> list[str]:
-    failures = []
-    for mod, _ in iter_modules():
         try:
             importlib.import_module(mod)
         except Exception as e:  # noqa: BLE001 — report everything
-            failures.append(f"import {mod}: {type(e).__name__}: {e}")
+            failures.append(
+                Finding("TPU003", f"import {mod} failed: {type(e).__name__}: {e}", path=str(rel), line=1)
+            )
     return failures
-
-
-class _NameCollector(ast.NodeVisitor):
-    def __init__(self):
-        self.used: set[str] = set()
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        # record the root name of dotted access (os.path -> os)
-        n = node
-        while isinstance(n, ast.Attribute):
-            n = n.value
-        if isinstance(n, ast.Name):
-            self.used.add(n.id)
-        self.generic_visit(node)
-
-
-def check_unused_imports() -> list[str]:
-    findings = []
-    for _, path in iter_modules():
-        tree = ast.parse(path.read_text(), filename=str(path))
-        imported: dict[str, int] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    name = (a.asname or a.name).split(".")[0]
-                    imported[name] = node.lineno
-            elif isinstance(node, ast.ImportFrom):
-                if node.module == "__future__":
-                    continue
-                for a in node.names:
-                    if a.name == "*":
-                        continue
-                    imported[a.asname or a.name] = node.lineno
-        collector = _NameCollector()
-        collector.visit(tree)
-        # names re-exported via __all__ count as used
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                collector.used.add(node.value)
-        is_init = path.name == "__init__.py"
-        for name, lineno in imported.items():
-            if name not in collector.used and not is_init:
-                findings.append(f"{path.relative_to(REPO)}:{lineno}: unused import {name!r}")
-    return findings
-
-
-def check_docstrings() -> list[str]:
-    findings = []
-    for _, path in iter_modules():
-        if path.name == "__init__.py" and path.stat().st_size == 0:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        if ast.get_docstring(tree) is None:
-            findings.append(f"{path.relative_to(REPO)}: missing module docstring")
-    return findings
 
 
 def main() -> int:
@@ -109,19 +55,19 @@ def main() -> int:
 
     force_host_platform(1)
 
-    failures = []
-    for title, check in (
-        ("imports", check_imports),
-        ("unused imports", check_unused_imports),
-        ("module docstrings", check_docstrings),
-    ):
-        found = check()
-        status = "OK" if not found else f"{len(found)} finding(s)"
-        print(f"[{title}] {status}")
-        for f in found:
-            print(f"  {f}")
-        failures.extend(found)
-    return 1 if failures else 0
+    from accelerate_tpu.analysis import exit_code, format_finding, lint_paths
+
+    findings = check_imports()
+    print(f"[imports] {'OK' if not findings else f'{len(findings)} finding(s)'}")
+
+    ast_findings = lint_paths([PKG])
+    n_err = sum(1 for f in ast_findings if f.is_error)
+    print(f"[ast lint] {'OK' if not ast_findings else f'{len(ast_findings)} finding(s), {n_err} error(s)'}")
+
+    findings += ast_findings
+    for f in findings:
+        print(f"  {format_finding(f)}")
+    return exit_code(findings)
 
 
 if __name__ == "__main__":

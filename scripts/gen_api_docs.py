@@ -63,6 +63,10 @@ MODULES = [
     "accelerate_tpu.utils.memory",
     "accelerate_tpu.utils.random",
     "accelerate_tpu.utils.offload",
+    "accelerate_tpu.analysis.rules",
+    "accelerate_tpu.analysis.ast_lint",
+    "accelerate_tpu.analysis.jaxpr_lint",
+    "accelerate_tpu.analysis.report",
     "accelerate_tpu.models",
 ]
 

@@ -1,0 +1,398 @@
+"""The TPU correctness linter (``accelerate_tpu.analysis``): one
+deliberately-broken fixture per rule, asserting rule ID, severity, and
+suppression behaviour — plus the negative (clean-code) paths that keep the
+linter quiet, and the self-lint guarantee that the repo's own tree is
+error-free."""
+
+import json
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.analysis import (
+    ERROR,
+    RULES,
+    WARNING,
+    Finding,
+    LintConfig,
+    exit_code,
+    lint_paths,
+    lint_source,
+    lint_step,
+    render_json,
+    render_text,
+    run_selfcheck,
+)
+
+# --------------------------------------------------------------------- #
+# tier 1 — jaxpr rules against the 8-device fake mesh
+# --------------------------------------------------------------------- #
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def test_tpu101_wrong_collective_axis(mesh8):
+    def step(x):
+        return jax.lax.psum(x, "model")  # mesh8 has no 'model' axis
+
+    findings = lint_step(step, jax.ShapeDtypeStruct((8, 16), jnp.float32), mesh=mesh8)
+    assert _rules(findings) == ["TPU101"]
+    assert findings[0].severity == ERROR
+    assert "'model'" in findings[0].message
+
+
+def test_tpu101_valid_axis_is_clean(mesh8):
+    def step(x):
+        return jax.lax.psum(x, "data")  # bound via the replicated shard_map retrace
+
+    findings = lint_step(step, jax.ShapeDtypeStruct((8, 16), jnp.float32), mesh=mesh8)
+    assert "TPU101" not in _rules(findings)
+
+
+def test_tpu102_silent_promotion_detected(mesh8):
+    def step(x):
+        return (x.astype(jnp.float32) * 2.0).sum()  # widened value escapes
+
+    findings = lint_step(step, jax.ShapeDtypeStruct((8, 16), jnp.bfloat16), mesh=mesh8)
+    assert "TPU102" in _rules(findings)
+    f = next(f for f in findings if f.rule == "TPU102")
+    assert f.severity == WARNING
+    assert "bfloat16" in f.message and "float32" in f.message
+
+
+def test_tpu102_transient_accumulation_is_clean(mesh8):
+    # jnp reductions widen bf16 for accumulation and immediately narrow
+    # back — that f32 region never escapes and must not be flagged
+    def step(x):
+        return jnp.mean(x) + jnp.sum(x)
+
+    findings = lint_step(step, jax.ShapeDtypeStruct((8, 16), jnp.bfloat16), mesh=mesh8)
+    assert "TPU102" not in _rules(findings)
+
+
+def test_tpu103_missed_donation_and_donated(mesh8):
+    params = {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32)}  # 16 KiB
+    batch = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+
+    def step(p, b):
+        new = jax.tree_util.tree_map(lambda x: x - 0.1, p)
+        return new, b.sum()
+
+    findings = lint_step(step, params, batch, mesh=mesh8)
+    assert _rules(findings) == ["TPU103"]
+    assert findings[0].severity == WARNING
+    assert "donate_argnums=(0,)" in findings[0].message
+
+    assert lint_step(step, params, batch, mesh=mesh8, donate_argnums=(0,)) == []
+
+
+def test_tpu103_small_buffers_not_advised(mesh8):
+    small = {"w": jax.ShapeDtypeStruct((4, 4), jnp.float32)}  # 64 B < floor
+
+    def step(p):
+        return jax.tree_util.tree_map(lambda x: x + 1.0, p)
+
+    assert lint_step(step, small, mesh=mesh8) == []
+
+
+def test_tpu104_unconstrained_output_sharding(mesh8):
+    sharded = jax.device_put(np.zeros((64, 16), np.float32), NamedSharding(mesh8, P("data")))
+
+    def step(x):
+        return (x * 2.0).sum(axis=-1)
+
+    findings = lint_step(step, sharded, mesh=mesh8)
+    assert "TPU104" in _rules(findings)
+    assert "'data'" in next(f for f in findings if f.rule == "TPU104").message
+
+    def constrained(x):
+        return jax.lax.with_sharding_constraint(x * 2.0, NamedSharding(mesh8, P("data")))
+
+    assert "TPU104" not in _rules(lint_step(constrained, sharded, mesh=mesh8))
+
+
+def test_tpu104_via_in_shardings_specs(mesh8):
+    # declared (not concrete) input shardings feed the same check
+    x = jax.ShapeDtypeStruct((64, 16), jnp.float32)
+
+    def step(x):
+        return x * 2.0
+
+    findings = lint_step(step, x, mesh=mesh8, in_shardings=(P("data"),))
+    assert "TPU104" in _rules(findings)
+
+
+def test_lint_step_requires_mesh():
+    with pytest.raises(ValueError, match="mesh"):
+        lint_step(lambda x: x, jnp.ones(4))
+
+
+def test_ignore_filters_rules(mesh8):
+    params = {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32)}
+
+    def step(p):
+        return jax.tree_util.tree_map(lambda x: x + 1.0, p)
+
+    assert lint_step(step, params, mesh=mesh8, ignore=("TPU103",)) == []
+
+
+def test_accelerator_lint_hook():
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator()
+
+    def step(params, batch):
+        new = jax.tree_util.tree_map(lambda p: p - 0.1, params)
+        return new, jax.lax.psum(batch.sum(), "bogus")
+
+    findings = acc.lint(
+        step,
+        {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32)},
+        jax.ShapeDtypeStruct((8, 16), jnp.float32),
+    )
+    assert _rules(findings) == ["TPU101"]
+
+
+# --------------------------------------------------------------------- #
+# tier 2 — AST rules on source fixtures
+# --------------------------------------------------------------------- #
+
+_HOST_CALL_SRC = textwrap.dedent(
+    '''
+    """Fixture."""
+    import jax
+
+
+    @jax.jit
+    def step(x):
+        host = jax.device_get(x)
+        return float(x) + host.item()
+    '''
+)
+
+
+def test_tpu201_host_calls_in_jit():
+    findings = lint_source(_HOST_CALL_SRC, path="fix.py", config=LintConfig(select=frozenset({"TPU201"})))
+    assert _rules(findings) == ["TPU201", "TPU201", "TPU201"]  # device_get, float(x), .item()
+    assert all(f.severity == ERROR for f in findings)
+    assert findings[0].line == 8  # jax.device_get line
+
+
+def test_tpu201_not_flagged_outside_jit():
+    src = '"""Fixture."""\nimport jax\n\n\ndef step(x):\n    return jax.device_get(x)\n'
+    assert lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU201"}))) == []
+
+
+def test_tpu201_float_of_constant_ok():
+    src = textwrap.dedent(
+        '''
+        """Fixture."""
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            return x * float("-inf")
+        '''
+    )
+    assert lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU201"}))) == []
+
+
+def test_tpu202_tracer_branch():
+    src = textwrap.dedent(
+        '''
+        """Fixture."""
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        '''
+    )
+    findings = lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU202"})))
+    assert _rules(findings) == ["TPU202"]
+    assert findings[0].severity == WARNING
+    assert "'step'" in findings[0].message
+
+
+def test_tpu202_static_and_none_checks_are_clean():
+    src = textwrap.dedent(
+        '''
+        """Fixture: all trace-static branch tests."""
+        import functools
+
+        import jax
+
+
+        @functools.partial(jax.jit, static_argnames=("causal",))
+        def step(x, mask=None, causal=False):
+            if causal:              # static arg
+                x = x + 1
+            if mask is None:        # None check
+                x = x * 2
+            if x.ndim == 3:         # static attribute
+                x = x.sum(0)
+            if len(x) > 1:          # static len()
+                x = x + 0
+            return x
+        '''
+    )
+    assert lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU202"}))) == []
+
+
+def test_tpu203_unhashable_static_default():
+    src = textwrap.dedent(
+        '''
+        """Fixture."""
+        import functools
+
+        import jax
+
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def step(x, layers=[64, 64]):
+            return x
+        '''
+    )
+    findings = lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU203"})))
+    assert _rules(findings) == ["TPU203"]
+    assert findings[0].severity == ERROR
+    assert "'layers'" in findings[0].message
+
+
+def test_tpu203_hashable_static_default_ok():
+    src = textwrap.dedent(
+        '''
+        """Fixture."""
+        import functools
+
+        import jax
+
+
+        @functools.partial(jax.jit, static_argnames=("block",))
+        def step(x, block=(64, 64)):
+            return x
+        '''
+    )
+    assert lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU203"}))) == []
+
+
+def test_tpu204_eager_jax_import_zones():
+    src = '"""Fixture."""\nimport jax\n\nV = str(jax.__version__)\n'
+    always = LintConfig(select=frozenset({"TPU204"}), lazy_jax="always")
+    never = LintConfig(select=frozenset({"TPU204"}), lazy_jax="never")
+    auto = LintConfig(select=frozenset({"TPU204"}), lazy_jax="auto")
+
+    assert _rules(lint_source(src, path="pkg/mod.py", config=always)) == ["TPU204"]
+    assert lint_source(src, path="pkg/mod.py", config=never) == []
+    # auto: the convention zone is the orchestration layer only
+    assert _rules(lint_source(src, path="accelerate_tpu/foo.py", config=auto)) == ["TPU204"]
+    assert _rules(lint_source(src, path="accelerate_tpu/commands/foo.py", config=auto)) == ["TPU204"]
+    assert lint_source(src, path="accelerate_tpu/ops/foo.py", config=auto) == []
+    assert lint_source(src, path="somewhere/else.py", config=auto) == []
+
+
+def test_tpu001_unused_import_and_init_exemption():
+    src = '"""Fixture."""\nimport os\n\nV = 1\n'
+    findings = lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU001"})))
+    assert _rules(findings) == ["TPU001"]
+    assert findings[0].line == 2
+    # __init__.py re-exports are exempt
+    assert lint_source(src, path="pkg/__init__.py", config=LintConfig(select=frozenset({"TPU001"}))) == []
+
+
+def test_tpu002_missing_docstring():
+    findings = lint_source("V = 1\n", path="fix.py", config=LintConfig(select=frozenset({"TPU002"})))
+    assert _rules(findings) == ["TPU002"]
+    assert lint_source('"""Doc."""\nV = 1\n', path="fix.py", config=LintConfig(select=frozenset({"TPU002"}))) == []
+
+
+# --------------------------------------------------------------------- #
+# suppressions, reporters, registry
+# --------------------------------------------------------------------- #
+
+
+def test_inline_suppression_by_id_and_bare():
+    by_id = _HOST_CALL_SRC.replace(
+        "host = jax.device_get(x)", "host = jax.device_get(x)  # tpu-lint: disable=TPU201"
+    )
+    findings = lint_source(by_id, path="fix.py", config=LintConfig(select=frozenset({"TPU201"})))
+    assert all(f.line != 8 for f in findings)  # that line is silenced, others remain
+    assert len(findings) == 2
+
+    bare = by_id.replace(
+        "return float(x) + host.item()", "return float(x) + host.item()  # tpu-lint: disable"
+    )
+    assert lint_source(bare, path="fix.py", config=LintConfig(select=frozenset({"TPU201"}))) == []
+
+
+def test_suppression_of_other_rule_does_not_silence():
+    src = _HOST_CALL_SRC.replace(
+        "host = jax.device_get(x)", "host = jax.device_get(x)  # tpu-lint: disable=TPU999X"
+    )
+    # unknown/other IDs in the comment leave the finding in place
+    findings = lint_source(src, path="fix.py", config=LintConfig(select=frozenset({"TPU201"})))
+    assert len(findings) == 3
+
+
+def test_render_text_format_is_parseable():
+    f = Finding("TPU201", "host sync", path="a/b.py", line=12)
+    line = render_text([f], summary=False)
+    assert line == "a/b.py:12: TPU201 host sync"
+
+
+def test_render_json_round_trip():
+    findings = lint_source(_HOST_CALL_SRC, path="fix.py", config=LintConfig(select=frozenset({"TPU201"})))
+    payload = json.loads(render_json(findings))
+    assert len(payload) == 3
+    assert payload[0]["rule"] == "TPU201"
+    assert payload[0]["severity"] == "error"
+    assert payload[0]["name"] == "host-call-in-jit"
+    assert payload[0]["path"] == "fix.py"
+
+
+def test_exit_code_contract():
+    err = Finding("TPU201", "x")
+    warn = Finding("TPU202", "x")
+    assert exit_code([]) == 0
+    assert exit_code([warn]) == 0
+    assert exit_code([warn], strict=True) == 1
+    assert exit_code([err, warn]) == 1
+
+
+def test_registry_ids_are_stable():
+    assert set(RULES) == {
+        "TPU001", "TPU002", "TPU003",
+        "TPU101", "TPU102", "TPU103", "TPU104",
+        "TPU201", "TPU202", "TPU203", "TPU204",
+    }
+    with pytest.raises(ValueError):
+        Finding("TPU999", "no such rule")
+
+
+# --------------------------------------------------------------------- #
+# the repo itself must stay lint-clean; the selfcheck must stay green
+# --------------------------------------------------------------------- #
+
+
+def test_repo_tree_is_lint_clean():
+    import pathlib
+
+    pkg = pathlib.Path(__file__).parent.parent / "accelerate_tpu"
+    errors = [f for f in lint_paths([pkg]) if f.is_error]
+    assert errors == [], "\n".join(render_text(errors, summary=False).splitlines())
+
+
+def test_selfcheck_all_rules_fire(mesh8):
+    ok, lines = run_selfcheck(mesh8)
+    assert ok, "\n".join(lines)
+    assert sum("detected" in line for line in lines) == 10

@@ -681,3 +681,64 @@ def test_config_template_run_me():
     )
     assert result.returncode == 0, result.stderr + result.stdout
     assert "Accelerator state" in result.stdout
+
+
+# --------------------------------------------------------------------- #
+# accelerate-tpu lint (the TPU correctness linter CLI)
+# --------------------------------------------------------------------- #
+
+
+def test_lint_repo_tree_clean():
+    """The package tree must carry zero error-severity findings."""
+    import pathlib
+
+    pkg = pathlib.Path(__file__).parent.parent / "accelerate_tpu"
+    result = run_cli("lint", str(pkg))
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "0 error(s)" in result.stdout
+
+
+def test_lint_detects_seeded_defects_and_exits_nonzero(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        '"""Fixture."""\n'
+        "import jax\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x > 0:\n"
+        "        return jax.device_get(x)\n"
+        "    return x\n"
+    )
+    result = run_cli("lint", str(bad))
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert "TPU201" in result.stdout  # device_get in jit (error)
+    assert "TPU202" in result.stdout  # tracer branch (warning)
+    assert f"{bad}:8: TPU201" in result.stdout  # path:line: TPUxxx format
+
+
+def test_lint_json_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n")
+    result = run_cli("lint", str(bad), "--format", "json")
+    payload = json.loads(result.stdout)
+    assert {f["rule"] for f in payload} == {"TPU001", "TPU002"}
+    assert all(f["severity"] == "error" for f in payload)
+
+
+def test_lint_select_ignore_and_suppression(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os  # tpu-lint: disable=TPU001\n")
+    result = run_cli("lint", str(bad), "--ignore", "TPU002")
+    assert result.returncode == 0, result.stdout
+    assert "0 finding(s)" in result.stdout
+
+
+@pytest.mark.slow
+def test_lint_selfcheck():
+    """Every rule detects its seeded-defect fixture (CPU fake mesh)."""
+    result = run_cli("lint", "--selfcheck")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("detected") == 10
+    assert "honoured" in result.stdout

@@ -8,6 +8,7 @@ import optax
 import pytest
 
 from accelerate_tpu import Accelerator, MeshConfig, ParallelismPlugin
+from accelerate_tpu.utils.compat import shard_map
 from accelerate_tpu.parallel.compression import compressed_psum_mean, wire_bytes
 from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, linear_loss_fn
 
@@ -24,7 +25,7 @@ def test_compressed_psum_mean_matches_plain(mesh8):
                 return jax.tree.map(lambda l: jax.lax.pmean(l, "data"), local)
             return compressed_psum_mean(local, "data", method)
 
-        fn = jax.shard_map(body, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False)
+        fn = shard_map(body, mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False)
         return np.asarray(fn(g)["g"])
 
     exact = reduce(None)
@@ -47,7 +48,7 @@ def test_int8_keeps_int8_on_the_wire(mesh8):
 
     g = jax.random.normal(jax.random.key(0), (8, 64), jnp.float32)
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: compressed_psum_mean({"g": x}, "data", "int8")["g"],
             mesh=mesh8, in_specs=P("data"), out_specs=P(), check_vma=False,
         )
@@ -124,7 +125,7 @@ def _psgd_reduce(mesh8, grads, state, rank):
         )
         return out["w"], new["error"]["w"][None], new["q"]["w"]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh8,
         in_specs=(P("data"), P("data"), P()),
         out_specs=(P(), P("data"), P()),
@@ -184,7 +185,7 @@ def test_powersgd_wire_bytes_and_hlo(mesh8):
         out, _ = powersgd_psum_mean({"w": x}, "data", {"error": {"w": e[0]}, "q": {"w": q}}, r)
         return out["w"]
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         body, mesh=mesh8,
         in_specs=(P(), P("data"), P()), out_specs=P(), check_vma=False,
     ))

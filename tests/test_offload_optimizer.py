@@ -25,7 +25,15 @@ import jax.numpy as jnp  # noqa: E402
 
 from accelerate_tpu import Accelerator  # noqa: E402
 from accelerate_tpu.modeling import Model  # noqa: E402
+from accelerate_tpu.utils.compat import supports_memory_kind  # noqa: E402
 from accelerate_tpu.utils.dataclasses import MeshConfig, ParallelismPlugin  # noqa: E402
+
+# offload is a memory-kind feature: without pinned_host (old CPU backends)
+# the Accelerator degrades to in-device state and residence can't be tested
+pytestmark = pytest.mark.skipif(
+    not supports_memory_kind("pinned_host"),
+    reason="backend has no pinned_host memory kind",
+)
 
 
 def mlp_apply(params, x):

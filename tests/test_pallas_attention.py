@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from accelerate_tpu.ops.attention import dot_product_attention
+from accelerate_tpu.utils.compat import shard_map
 from accelerate_tpu.ops.pallas_attention import pallas_flash_attention
 
 
@@ -211,7 +212,7 @@ def test_sharded_dispatch_inside_shard_map():
 
     spec = P("data")
     fn = jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     )
     shard = NamedSharding(mesh, spec)
     out = fn(*(jax.device_put(x, shard) for x in (q, k, v)))
