@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint flight-check test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -33,8 +33,18 @@ quality: lint
 # TPU correctness linter: self-lint the tree (exit nonzero on any
 # error-severity finding) + prove every rule fires on its seeded-defect
 # fixture. Runs on the CPU backend — safe on machines with no TPU.
+# The flight-check gate rides along non-strict: TPU3xx warnings print but
+# don't fail the build (yet).
 lint:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
+	-$(MAKE) --no-print-directory flight-check
+
+# SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
+# then report the example step (peak HBM + collective traffic) on a fake
+# 8-device CPU mesh.
+flight-check:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli flight-check --selfcheck \
+		examples/by_feature/flight_check.py::train_step --mesh data=8 --donate 0
 
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi

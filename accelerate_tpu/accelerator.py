@@ -678,6 +678,49 @@ class Accelerator:
             logger.warning("lint found issues in %s:\n%s", getattr(step_fn, "__name__", "step_fn"), render_text(findings))
         return findings
 
+    def flight_check(
+        self,
+        step_fn: Callable,
+        *sample_args,
+        donate_argnums=(),
+        in_shardings=None,
+        generation: str = "v5e",
+        ignore=(),
+    ):
+        """Static SPMD flight-check of ``step_fn`` against this
+        accelerator's mesh, *before* paying a multi-chip compile: a
+        per-device peak-HBM estimate (liveness walk with donated-buffer
+        reuse and sharding-aware byte counts), the collective traffic bill
+        (bytes on wire, ICI vs DCN, per-step totals), and the TPU3xx
+        safety rules — collective under value-dependent ``cond``/``while``
+        (deadlock), implicit reshards, donation defeated by a late read.
+
+        Same calling convention as :meth:`lint`; returns a
+        :class:`~accelerate_tpu.analysis.FlightReport` (``.render_text()``
+        for the human report, ``.as_dict()`` for tooling,
+        ``.fits(hbm_gb)`` for a go/no-go). Error-severity findings are
+        logged. See ``docs/usage_guides/static_analysis.md``.
+        """
+        from .analysis import flight_check as _flight_check
+        from .analysis import render_text
+
+        report = _flight_check(
+            step_fn,
+            *sample_args,
+            mesh=self.mesh,
+            donate_argnums=donate_argnums,
+            in_shardings=in_shardings,
+            generation=generation,
+            ignore=ignore,
+        )
+        if not report.ok:
+            logger.warning(
+                "flight-check found issues in %s:\n%s",
+                getattr(step_fn, "__name__", "step_fn"),
+                render_text(report.findings),
+            )
+        return report
+
     def build_train_step(
         self,
         loss_fn: Callable,

@@ -1,6 +1,7 @@
-"""``accelerate_tpu.analysis`` — the TPU correctness linter.
+"""``accelerate_tpu.analysis`` — the TPU correctness linter and SPMD
+flight-check.
 
-Two analysis tiers behind one rule registry (``rules.RULES``, stable
+Three analysis tiers behind one rule registry (``rules.RULES``, stable
 ``TPUxxx`` IDs):
 
 * **jaxpr tier** (``lint_step``) — trace a step function against the
@@ -10,15 +11,22 @@ Two analysis tiers behind one rule registry (``rules.RULES``, stable
   for host syncs inside ``jit``, tracer-dependent branches,
   ``static_argnums`` hazards, the ``_jax()`` lazy-import convention, and
   the repo hygiene gates grown out of ``scripts/check_repo.py``.
+* **flight tier** (``flight_check``) — static per-device peak-HBM
+  liveness estimate, a collective cost model (bytes-on-wire, ICI vs DCN,
+  ``costmodel``), and the TPU3xx SPMD safety rules (collective deadlock
+  under value-dependent control flow, implicit reshards, defeated
+  donation).
 
-Surfaced as ``accelerate-tpu lint`` (commands/lint.py) and
-``Accelerator.lint(step_fn, *sample_args)``. Suppress a finding inline
-with ``# tpu-lint: disable=TPU201``.
+Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check``
+(commands/) and ``Accelerator.lint`` / ``Accelerator.flight_check``.
+Suppress a finding inline with ``# tpu-lint: disable=TPU201``.
 """
 
 from .ast_lint import LintConfig, iter_python_files, lint_file, lint_paths, lint_source
+from .costmodel import BANDWIDTH_TABLE, CollectiveRecord, TrafficReport, collect_traffic, price_collective
+from .flightcheck import FlightReport, LiveBuffer, estimate_peak_hbm, flight_check
 from .jaxpr_lint import lint_step
-from .report import exit_code, format_finding, render_json, render_text
+from .report import exit_code, format_finding, render_json, render_sarif, render_text
 from .rules import ERROR, RULES, WARNING, Finding, Rule, apply_suppressions, filter_findings
 from .selfcheck import run_selfcheck
 
@@ -29,8 +37,17 @@ __all__ = [
     "Rule",
     "Finding",
     "LintConfig",
+    "BANDWIDTH_TABLE",
+    "CollectiveRecord",
+    "TrafficReport",
+    "FlightReport",
+    "LiveBuffer",
     "apply_suppressions",
     "filter_findings",
+    "collect_traffic",
+    "price_collective",
+    "estimate_peak_hbm",
+    "flight_check",
     "lint_source",
     "lint_file",
     "lint_paths",
@@ -39,6 +56,7 @@ __all__ = [
     "format_finding",
     "render_text",
     "render_json",
+    "render_sarif",
     "exit_code",
     "run_selfcheck",
 ]

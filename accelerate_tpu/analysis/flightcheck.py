@@ -1,0 +1,655 @@
+"""SPMD flight-check: static peak-HBM, collective traffic, and deadlock
+rules over a traced step — run *before* paying a multi-chip XLA compile.
+
+``flight_check(fn, *sample_args, mesh=...)`` traces ``fn`` abstractly with
+the PR-1 linter machinery (nothing executes, nothing compiles) and emits:
+
+* a per-device **peak-HBM estimate** — a liveness walk over the jaxpr:
+  every equation allocates its outputs, buffers die after their last use,
+  non-donated inputs and constants stay resident for the whole step,
+  donated inputs are freed at their last read (the XLA aliasing story).
+  Byte counts are sharding-aware: a value known to be sharded over mesh
+  axes is divided by the axis-size product, propagated through same-shape
+  equations from argument shardings and ``with_sharding_constraint`` sites.
+* a **collective traffic report** (``costmodel.collect_traffic``):
+  per-collective wire bytes, axis group, ICI-vs-DCN transport, scan trip
+  multipliers, and a bandwidth-table time estimate.
+* the **TPU3xx safety rules**:
+
+  - ``TPU301`` — a collective inside a value-dependent ``cond``/``while``
+    body. Devices that disagree on the predicate/trip count stop meeting
+    at the collective and the program hangs (the MPMD scheduling
+    invariant: per-stage collective schedules must agree). ``scan`` is
+    exempt — its trip count is static and identical everywhere.
+  - ``TPU302`` — implicit reshard: a value with a known sharding is
+    re-constrained to a conflicting layout, forcing GSPMD to materialise
+    an all-gather/reshard the author probably didn't intend.
+  - ``TPU303`` — donation defeated: an argument is donated, an output has
+    already been produced that would alias its buffer, and the argument is
+    read again afterwards — XLA must insert a defensive copy, so the
+    donation saves nothing.
+
+jax is imported lazily; analysis needs only abstract values.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+from .costmodel import TrafficReport, collect_traffic
+from .jaxpr_lint import (
+    COLLECTIVE_PRIMS,
+    _eqn_location,
+    _iter_subjaxprs,
+    _sharding_axes,
+    _spec_axes,
+    _trace,
+    _walk_eqns,
+)
+from .rules import Finding, filter_findings
+
+#: control-flow primitives whose bodies run a value-dependent number of
+#: times (while) or on a value-selected branch (cond). scan is static.
+_DYNAMIC_FLOW_PRIMS = frozenset({"while", "cond"})
+
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal"
+
+
+
+def _human(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} PB"
+
+
+@dataclass
+class LiveBuffer:
+    """One buffer live at the peak-HBM program point."""
+
+    describe: str  # e.g. "f32[1024,1024]"
+    bytes: int
+    per_device_bytes: int
+    kind: str  # "const" | "arg" | "donated-arg" | "activation" | "output"
+    shard_factor: int = 1
+
+
+@dataclass
+class FlightReport:
+    """Everything ``flight_check`` learns about one step function."""
+
+    fn_name: str
+    mesh_axes: dict[str, int] = field(default_factory=dict)
+    peak_hbm_bytes: int = 0  # per device
+    peak_eqn: str = ""  # primitive + location of the peak program point
+    param_bytes: int = 0  # per-device resident args + consts
+    donated_bytes: int = 0  # per-device bytes freed by donation
+    output_bytes: int = 0  # per-device outputs
+    top_live: list[LiveBuffer] = field(default_factory=list)
+    traffic: TrafficReport = field(default_factory=TrafficReport)
+    findings: list[Finding] = field(default_factory=list)
+    generation: str = "v5e"
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.is_error for f in self.findings)
+
+    def fits(self, hbm_gb: float) -> bool:
+        return self.peak_hbm_bytes <= hbm_gb * 1024**3
+
+    def as_dict(self) -> dict:
+        return {
+            "fn": self.fn_name,
+            "mesh": dict(self.mesh_axes),
+            "peak_hbm_bytes_per_device": self.peak_hbm_bytes,
+            "peak_eqn": self.peak_eqn,
+            "param_bytes_per_device": self.param_bytes,
+            "donated_bytes_per_device": self.donated_bytes,
+            "output_bytes_per_device": self.output_bytes,
+            "top_live": [
+                {
+                    "describe": b.describe,
+                    "bytes": b.bytes,
+                    "per_device_bytes": b.per_device_bytes,
+                    "kind": b.kind,
+                    "shard_factor": b.shard_factor,
+                }
+                for b in self.top_live
+            ],
+            "collectives": [
+                {
+                    "primitive": r.primitive,
+                    "axes": list(r.axes),
+                    "group_size": r.group_size,
+                    "transport": r.transport,
+                    "bytes_per_call": r.bytes_per_call,
+                    "wire_bytes": r.wire_bytes,
+                    "count": r.count,
+                    "time_us": round(r.time_us(self.generation), 3),
+                    "location": r.location,
+                }
+                for r in self.traffic.records
+            ],
+            "wire_bytes_by_transport": self.traffic.bytes_by_transport(),
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+    def render_text(self) -> str:
+        mesh = ", ".join(f"{a}={n}" for a, n in self.mesh_axes.items() if n > 1) or "1 device"
+        lines = [
+            f"flight-check: {self.fn_name} on mesh ({mesh})",
+            f"  peak HBM / device : {_human(self.peak_hbm_bytes)}"
+            + (f" at {self.peak_eqn}" if self.peak_eqn else ""),
+            f"  resident params   : {_human(self.param_bytes)}"
+            f"   donated (reused): {_human(self.donated_bytes)}"
+            f"   outputs: {_human(self.output_bytes)}",
+        ]
+        if self.top_live:
+            lines.append("  top live buffers at peak:")
+            for b in self.top_live:
+                shard = f" (1/{b.shard_factor} shard)" if b.shard_factor > 1 else ""
+                lines.append(f"    {_human(b.per_device_bytes):>10}  {b.describe:<22} {b.kind}{shard}")
+        if self.traffic.records:
+            lines.append("  collective traffic / step:")
+            for r in self.traffic.records:
+                count = f" x{r.count}" if r.count > 1 else ""
+                lines.append(
+                    f"    {r.primitive:<13} over {'x'.join(r.axes) or '?'} ({r.group_size} devices){count}"
+                    f"  {_human(r.wire_bytes):>10} wire  {r.transport}"
+                    f"  ~{r.time_us(self.generation):.1f}us"
+                )
+            by = self.traffic.bytes_by_transport()
+            lines.append(
+                f"  wire totals: ici {_human(by['ici'])}, dcn {_human(by['dcn'])}"
+                f"  (~{self.traffic.time_us(self.generation):.1f}us on {self.generation})"
+            )
+        else:
+            lines.append("  collective traffic / step: none visible in the jaxpr")
+        if self.findings:
+            from .report import format_finding
+
+            lines.append("  findings:")
+            lines.extend(f"    {format_finding(f)}" for f in self.findings)
+        else:
+            lines.append("  findings: none")
+        return "\n".join(lines)
+
+
+# -- sharding-aware byte accounting ---------------------------------------
+
+
+def _nbytes(aval) -> int:
+    import numpy as np
+
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+
+
+def _describe(aval) -> str:
+    dtype = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", ())
+    short = {"float32": "f32", "float64": "f64", "bfloat16": "bf16", "float16": "f16",
+             "int32": "i32", "int64": "i64", "int8": "i8", "uint8": "u8", "bool": "pred"}
+    name = short.get(str(dtype), str(dtype))
+    return f"{name}[{','.join(str(d) for d in shape)}]"
+
+
+def _spec_factor(spec_axes: set[str], mesh) -> int:
+    n = 1
+    for a in spec_axes:
+        n *= int(mesh.shape.get(a, 1))
+    return max(1, n)
+
+
+def _arg_spec_axes(sample_args, in_shardings, n_invars) -> list[set[str]]:
+    """Per-flattened-argument sharding axes, from concrete ``NamedSharding``s
+    on the sample args and/or the declared ``in_shardings`` pytree."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(sample_args)
+    spec_leaves: list[Any] = []
+    if in_shardings is not None:
+        flat = jax.tree_util.tree_leaves(
+            in_shardings, is_leaf=lambda x: type(x).__name__ == "PartitionSpec" or hasattr(x, "spec")
+        )
+        spec_leaves = list(flat)
+    out: list[set[str]] = []
+    for i in range(n_invars):
+        axes: set[str] = set()
+        if i < len(leaves):
+            axes |= _sharding_axes(getattr(leaves[i], "sharding", None))
+        if i < len(spec_leaves):
+            sl = spec_leaves[i]
+            axes |= _sharding_axes(sl) if hasattr(sl, "spec") else _spec_axes(sl)
+        out.append(axes)
+    return out
+
+
+def _donated_var_indices(sample_args, donate_argnums, n_invars) -> set[int]:
+    """Flattened invar indices covered by ``donate_argnums`` (argument
+    positions, pytree-expanded the way jax.jit expands them)."""
+    import jax
+
+    donated: set[int] = set()
+    offset = 0
+    for pos, arg in enumerate(sample_args):
+        n = len(jax.tree_util.tree_leaves(arg))
+        if pos in set(donate_argnums):
+            donated.update(range(offset, min(offset + n, n_invars)))
+        offset += n
+    return donated
+
+
+def _main_jaxpr(closed):
+    """The program body to walk. A step that is a single pjit/shard_map
+    wrapper — ``jax.jit(fn)``, or the replicated rebind ``_trace`` uses for
+    shard_map-style code — hides everything behind one opaque equation;
+    unwrap while the (sole) sub-jaxpr's invars line up 1:1."""
+    jaxpr = closed.jaxpr
+    while len(jaxpr.eqns) == 1:
+        subs = list(_iter_subjaxprs(jaxpr.eqns[0].params))
+        if len(subs) == 1 and len(subs[0].invars) == len(jaxpr.invars):
+            jaxpr = subs[0]
+        else:
+            break
+    return jaxpr
+
+
+def _jaxpr_transient_peak(jaxpr) -> int:
+    """Liveness peak of a sub-jaxpr's INTERMEDIATES (its own invars and
+    outvars are accounted by the enclosing walk): allocate each equation's
+    outputs, free after last use, recurse into nested calls."""
+    last_use: dict[Any, int] = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last_use[v] = idx
+    end = len(jaxpr.eqns)
+    outer = set(jaxpr.invars) | set(jaxpr.constvars)
+    out_set = {v for v in jaxpr.outvars if not _is_literal(v)}
+
+    live: dict[Any, int] = {}
+    peak = 0
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for o in eqn.outvars:
+            live[o] = _nbytes(getattr(o, "aval", None))
+        peak = max(peak, sum(live.values()) + _sub_transient_bytes(eqn))
+        for v in list(live):
+            if last_use.get(v, end) <= idx and v not in out_set:
+                del live[v]
+    # the sub-jaxpr's outputs surface as the call eqn's outvars outside
+    return max(0, peak - sum(live.get(v, 0) for v in out_set) - sum(live.get(v, 0) for v in outer))
+
+
+def _sub_transient_bytes(eqn) -> int:
+    """Per-device transient of an opaque call eqn (pjit/shard_map body,
+    control flow branches): the largest nested liveness peak. Sharding
+    inside the body is not modelled — the bound is conservative (high)."""
+    extra = 0
+    for sub in _iter_subjaxprs(eqn.params):
+        extra = max(extra, _jaxpr_transient_peak(sub))
+    return extra
+
+
+def estimate_peak_hbm(
+    closed,
+    sample_args,
+    mesh,
+    *,
+    donate_argnums: Sequence[int] = (),
+    in_shardings: Any = None,
+    top_k: int = 5,
+) -> tuple[int, str, list[LiveBuffer], dict[str, int]]:
+    """Liveness walk over the top-level jaxpr.
+
+    Returns ``(peak_per_device_bytes, peak_eqn_desc, top_live_at_peak,
+    summary)`` where summary has ``param``/``donated``/``output`` per-device
+    byte totals.
+    """
+    jaxpr = _main_jaxpr(closed)
+    n_invars = len(jaxpr.invars)
+
+    # var -> sharding axes (for per-device byte division)
+    var_axes: dict[Any, set[str]] = {}
+    for v, axes in zip(jaxpr.invars, _arg_spec_axes(sample_args, in_shardings, n_invars)):
+        if axes:
+            var_axes[v] = axes
+
+    def propagate(eqn):
+        if eqn.primitive.name == "sharding_constraint":
+            axes = _sharding_axes(eqn.params.get("sharding"))
+            for o in eqn.outvars:
+                var_axes[o] = axes
+            return
+        # same-shape pass-through: outputs inherit the sharded input's axes
+        in_axes = [
+            (v, var_axes[v]) for v in eqn.invars
+            if not _is_literal(v) and v in var_axes and var_axes[v]
+        ]
+        if not in_axes:
+            return
+        for o in eqn.outvars:
+            for v, axes in in_axes:
+                if getattr(o.aval, "shape", None) == getattr(v.aval, "shape", ()):
+                    var_axes[o] = axes
+                    break
+
+    def per_device(v) -> int:
+        return _nbytes(getattr(v, "aval", None)) // _spec_factor(var_axes.get(v, set()), mesh)
+
+    # last-use index per var (index into eqns; outvars of the jaxpr live
+    # to the end == index len(eqns))
+    last_use: dict[Any, int] = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last_use[v] = idx
+    end = len(jaxpr.eqns)
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            last_use[v] = end
+
+    donated_idx = _donated_var_indices(sample_args, donate_argnums, n_invars)
+    donated_vars = {v for i, v in enumerate(jaxpr.invars) if i in donated_idx}
+
+    live: dict[Any, int] = {}  # var -> per-device bytes
+    kind: dict[Any, str] = {}
+    for v in jaxpr.constvars:
+        live[v] = per_device(v)
+        kind[v] = "const"
+    for i, v in enumerate(jaxpr.invars):
+        live[v] = per_device(v)
+        kind[v] = "donated-arg" if v in donated_vars else "arg"
+
+    param_bytes = sum(b for v, b in live.items() if kind[v] in ("const", "arg"))
+    donated_bytes = sum(b for v, b in live.items() if kind[v] == "donated-arg")
+    out_set = {v for v in jaxpr.outvars if not _is_literal(v)}
+
+    peak = sum(live.values())
+    peak_desc = "program inputs"
+    peak_snapshot = dict(live)
+
+    for idx, eqn in enumerate(jaxpr.eqns):
+        propagate(eqn)
+        # a donated buffer whose LAST read is this equation is overwritable
+        # by this equation's outputs (XLA's input/output aliasing) — free it
+        # before accounting the outputs so the reuse shows up in the peak
+        for v in list(live):
+            if kind[v] == "donated-arg" and last_use.get(v, end) <= idx:
+                del live[v]
+        for o in eqn.outvars:
+            live[o] = per_device(o)
+            kind[o] = "output" if o in out_set else "activation"
+        transient = _sub_transient_bytes(eqn)
+        current = sum(live.values()) + transient
+        if current > peak:
+            peak = current
+            peak_desc = f"{eqn.primitive.name}{_eqn_location(eqn)}"
+            peak_snapshot = dict(live)
+        # free intermediates whose last use was this equation; non-donated
+        # args and consts stay resident (the caller still owns them)
+        for v in list(live):
+            if last_use.get(v, end) <= idx:
+                if kind[v] in ("arg", "const"):
+                    continue
+                if v in out_set:
+                    continue
+                del live[v]
+
+    output_bytes = sum(per_device(v) for v in out_set)
+    top = sorted(peak_snapshot.items(), key=lambda kv: -kv[1])[:top_k]
+    top_live = [
+        LiveBuffer(
+            describe=_describe(getattr(v, "aval", None)),
+            bytes=_nbytes(getattr(v, "aval", None)),
+            per_device_bytes=b,
+            kind=kind.get(v, "activation"),
+            shard_factor=_spec_factor(var_axes.get(v, set()), mesh),
+        )
+        for v, b in top
+    ]
+    summary = {"param": param_bytes, "donated": donated_bytes, "output": output_bytes}
+    return peak, peak_desc, top_live, summary
+
+
+# -- TPU3xx rules ----------------------------------------------------------
+
+
+def _collectives_below(jaxpr) -> list:
+    hits = []
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name in COLLECTIVE_PRIMS and eqn.primitive.name != "axis_index":
+            hits.append(eqn)
+    return hits
+
+
+def check_collective_under_dynamic_flow(closed) -> list[Finding]:
+    """TPU301: psum/all_gather/… inside a ``cond`` branch or ``while``
+    body. SPMD deadlock: devices disagreeing on the predicate stop
+    arriving at the collective together."""
+    findings = []
+    seen = set()
+    for eqn in _walk_eqns(closed.jaxpr):
+        if eqn.primitive.name not in _DYNAMIC_FLOW_PRIMS:
+            continue
+        for sub in _iter_subjaxprs(eqn.params):
+            for hit in _collectives_below(sub):
+                key = (eqn.primitive.name, hit.primitive.name, _eqn_location(hit))
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(
+                    Finding(
+                        "TPU301",
+                        f"{hit.primitive.name} inside a value-dependent `{eqn.primitive.name}` "
+                        f"body{_eqn_location(hit)}: devices that disagree on the "
+                        "predicate/trip count will not all reach the collective and the "
+                        "program deadlocks; hoist the collective out of the branch (compute "
+                        "both sides and `where`-select, or move the reduction after the loop)",
+                    )
+                )
+    return findings
+
+
+def _norm_spec(spec, mesh) -> tuple:
+    """Per-dim layout tuple with trivial axes and trailing Nones dropped —
+    the canonical form TPU302 compares. ``()`` == replicated."""
+    entries = []
+    for entry in tuple(spec or ()):
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        kept = tuple(a for a in axes if isinstance(a, str) and mesh.shape.get(a, 1) > 1)
+        entries.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return tuple(entries)
+
+
+def _obj_spec(obj):
+    """The PartitionSpec carried by a sharding-like object, or None."""
+    spec = getattr(obj, "spec", None)
+    if spec is not None:
+        return spec
+    if obj is not None and type(obj).__name__ == "PartitionSpec":
+        return obj
+    return None
+
+
+def _arg_norm_specs(sample_args, in_shardings, n_invars, mesh) -> list[Optional[tuple]]:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(sample_args)
+    spec_leaves: list[Any] = []
+    if in_shardings is not None:
+        spec_leaves = jax.tree_util.tree_leaves(
+            in_shardings, is_leaf=lambda x: type(x).__name__ == "PartitionSpec" or hasattr(x, "spec")
+        )
+    out: list[Optional[tuple]] = []
+    for i in range(n_invars):
+        spec = None
+        if i < len(leaves):
+            spec = _obj_spec(getattr(leaves[i], "sharding", None))
+        if spec is None and i < len(spec_leaves):
+            spec = _obj_spec(spec_leaves[i])
+        out.append(None if spec is None else _norm_spec(spec, mesh))
+    return out
+
+
+def check_implicit_reshard(closed, sample_args, in_shardings, mesh) -> list[Finding]:
+    """TPU302: a value with a known sharding is re-constrained to a
+    conflicting layout — GSPMD must materialise a reshard (worst case a
+    full all-gather) between the two annotation sites. Layouts are compared
+    per dimension, so moving an axis between dims (a transpose-reshard)
+    counts as a conflict even though the same axes are in play."""
+    jaxpr = _main_jaxpr(closed)
+    n_invars = len(jaxpr.invars)
+
+    var_spec: dict[Any, tuple] = {}
+    for v, spec in zip(jaxpr.invars, _arg_norm_specs(sample_args, in_shardings, n_invars, mesh)):
+        if spec:  # () == replicated is not a constraint worth tracking
+            var_spec[v] = spec
+
+    findings = []
+    seen = set()
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "sharding_constraint":
+            new = _norm_spec(_obj_spec(eqn.params.get("sharding")), mesh)
+            src = next((v for v in eqn.invars if not _is_literal(v)), None)
+            old = var_spec.get(src)
+            if src is not None and old is not None and old != new:
+                key = (old, new, _eqn_location(eqn))
+                if key not in seen:
+                    seen.add(key)
+                    nbytes = _nbytes(getattr(src, "aval", None))
+                    findings.append(
+                        Finding(
+                            "TPU302",
+                            f"implicit reshard{_eqn_location(eqn)}: value laid out as "
+                            f"{old} is re-constrained to {new or 'replicated'} "
+                            f"(~{_human(nbytes)} moved through an all-gather/reshard); if "
+                            "unintended, align the producer and consumer shardings",
+                        )
+                    )
+            for o in eqn.outvars:
+                var_spec[o] = new
+            continue
+        # propagate through same-shape outputs
+        in_specs = [(v, var_spec[v]) for v in eqn.invars if not _is_literal(v) and v in var_spec]
+        if not in_specs:
+            continue
+        for o in eqn.outvars:
+            for v, spec in in_specs:
+                if getattr(o.aval, "shape", None) == getattr(v.aval, "shape", ()):
+                    var_spec.setdefault(o, spec)
+                    break
+    return findings
+
+
+def check_donation_hazard(closed, sample_args, donate_argnums) -> list[Finding]:
+    """TPU303: a donated argument is read *after* a shape/dtype-compatible
+    output has been produced. XLA would alias the output into the donated
+    buffer, so it must insert a defensive copy instead — the donation
+    saves no HBM. Reorder the reads before the update (or drop the
+    donation)."""
+    jaxpr = _main_jaxpr(closed)
+    n_invars = len(jaxpr.invars)
+
+    donated_idx = _donated_var_indices(sample_args, donate_argnums, n_invars)
+    if not donated_idx:
+        return []
+    donated_vars = {jaxpr.invars[i]: i for i in donated_idx}
+
+    last_use: dict[Any, int] = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last_use[v] = idx
+
+    # first production index of each output var + a shape/dtype pool
+    produced_at: dict[Any, int] = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for o in eqn.outvars:
+            produced_at.setdefault(o, idx)
+    out_keys: list[tuple[tuple, str, int]] = []
+    for v in jaxpr.outvars:
+        if _is_literal(v) or v not in produced_at:
+            continue
+        aval = getattr(v, "aval", None)
+        out_keys.append((tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype", "")), produced_at[v]))
+
+    findings = []
+    for v, argpos in sorted(donated_vars.items(), key=lambda kv: kv[1]):
+        aval = getattr(v, "aval", None)
+        key = (tuple(getattr(aval, "shape", ())), str(getattr(aval, "dtype", "")))
+        read_at = last_use.get(v)
+        if read_at is None:
+            continue
+        # earliest aliasable output production
+        alias_at = min((t for s, d, t in out_keys if (s, d) == key), default=None)
+        if alias_at is not None and alias_at < read_at:
+            findings.append(
+                Finding(
+                    "TPU303",
+                    f"donated argument (flat index {argpos}, {_describe(aval)}) is read after "
+                    "its aliased output is already produced; XLA inserts a defensive copy and "
+                    "the donation saves no HBM — reorder the read before the update, or drop "
+                    "it from donate_argnums",
+                )
+            )
+    return findings
+
+
+# -- entry point -----------------------------------------------------------
+
+
+def flight_check(
+    fn,
+    *sample_args: Any,
+    mesh=None,
+    donate_argnums: Sequence[int] = (),
+    in_shardings: Any = None,
+    dcn: Optional[Sequence[str]] = None,
+    generation: str = "v5e",
+    select: Optional[Sequence[str]] = None,
+    ignore: Sequence[str] = (),
+) -> FlightReport:
+    """Trace ``fn(*sample_args)`` abstractly and return a
+    :class:`FlightReport` — peak-HBM estimate, collective traffic, and
+    TPU301/302/303 findings. Same calling convention as
+    :func:`~accelerate_tpu.analysis.jaxpr_lint.lint_step`.
+    """
+    if mesh is None:
+        from ..parallel.sharding import context_mesh
+
+        mesh = context_mesh()
+    if mesh is None:
+        raise ValueError("flight_check needs a mesh (pass mesh=... or enter parallel.sharding.mesh_context)")
+
+    name = getattr(fn, "__name__", "step_fn")
+    closed, findings = _trace(fn, sample_args, mesh)
+    report = FlightReport(fn_name=name, mesh_axes=dict(mesh.shape), generation=generation)
+    if closed is not None:
+        peak, peak_desc, top_live, summary = estimate_peak_hbm(
+            closed, sample_args, mesh, donate_argnums=donate_argnums, in_shardings=in_shardings
+        )
+        report.peak_hbm_bytes = peak
+        report.peak_eqn = peak_desc.strip()
+        report.top_live = top_live
+        report.param_bytes = summary["param"]
+        report.donated_bytes = summary["donated"]
+        report.output_bytes = summary["output"]
+        report.traffic = collect_traffic(closed.jaxpr, mesh, dcn=dcn)
+        findings = findings + check_collective_under_dynamic_flow(closed)
+        findings += check_implicit_reshard(closed, sample_args, in_shardings, mesh)
+        findings += check_donation_hazard(closed, sample_args, donate_argnums)
+    report.findings = filter_findings(findings, select=select, ignore=ignore)
+    return report

@@ -49,9 +49,10 @@ _LOW_DTYPES = (
 )
 _WIDE_DTYPES = ("float32", "float64")
 
-_COLLECTIVE_PRIMS = frozenset(
+COLLECTIVE_PRIMS = frozenset(
     {"psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather", "all_to_all", "psum_scatter", "reduce_scatter", "axis_index"}
 )
+_COLLECTIVE_PRIMS = COLLECTIVE_PRIMS  # historical private alias
 
 _UNBOUND_AXIS_RE = re.compile(r"unbound axis name:?\s*([\w\-]+)")
 

@@ -1,5 +1,6 @@
 """Finding reporters: the ``path:line: TPUxxx message`` text format that
-editors and CI annotators parse, and a JSON format for tooling.
+editors and CI annotators parse, a JSON format for tooling, and a SARIF
+2.1.0 format for CI PR annotation (GitHub code scanning et al.).
 
 The text format is the contract shared by ``accelerate-tpu lint``,
 ``scripts/check_repo.py`` and ``make lint`` — one finding per line, the
@@ -11,7 +12,7 @@ from __future__ import annotations
 
 import json
 
-from .rules import ERROR, Finding
+from .rules import ERROR, RULES, Finding
 
 
 def format_finding(f: Finding) -> str:
@@ -32,6 +33,66 @@ def render_text(findings: list[Finding], *, summary: bool = True) -> str:
 
 def render_json(findings: list[Finding]) -> str:
     return json.dumps([f.as_dict() for f in findings], indent=2)
+
+
+#: finding severity -> SARIF result level
+_SARIF_LEVELS = {ERROR: "error"}  # everything else downgrades to "warning"
+
+
+def render_sarif(findings: list[Finding], *, tool_version: str = "0") -> str:
+    """SARIF 2.1.0 — the format GitHub code scanning ingests to annotate
+    PRs inline. One ``run`` with the full rule catalogue as
+    ``tool.driver.rules`` (so IDs resolve to help text) and one ``result``
+    per finding. Findings without a source location (jaxpr tier) anchor to
+    the synthetic artifact ``<jaxpr>`` at line 1 — SARIF requires a
+    location, and CI surfaces those at the run level."""
+    used = sorted({f.rule for f in findings})
+    rule_index = {rid: i for i, rid in enumerate(used)}
+    rules = [
+        {
+            "id": rid,
+            "name": RULES[rid].name,
+            "shortDescription": {"text": RULES[rid].summary},
+            "defaultConfiguration": {"level": _SARIF_LEVELS.get(RULES[rid].severity, "warning")},
+            "properties": {"tier": RULES[rid].tier},
+        }
+        for rid in used
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": _SARIF_LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path or "<jaxpr>"},
+                        "region": {"startLine": f.line or 1},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "accelerate-tpu-lint",
+                        "informationUri": "https://github.com/",
+                        "version": tool_version,
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def exit_code(findings: list[Finding], *, strict: bool = False) -> int:

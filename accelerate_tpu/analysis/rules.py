@@ -12,6 +12,9 @@ static_analysis.md for the worked catalogue):
 * ``TPU2xx`` — AST-level checks on source text (host syncs inside
   ``jit``, tracer-dependent Python control flow, ``static_argnums``
   hazards, the ``_jax()`` lazy-import convention).
+* ``TPU3xx`` — SPMD flight-check rules over the traced program
+  (``analysis.flightcheck``): collective deadlock under value-dependent
+  control flow, implicit reshards, donation defeated by late reads.
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -31,6 +34,7 @@ WARNING = "warning"
 TIER_REPO = "repo"
 TIER_JAXPR = "jaxpr"
 TIER_AST = "ast"
+TIER_FLIGHT = "flight"
 
 
 @dataclass(frozen=True)
@@ -57,10 +61,15 @@ RULES: dict[str, Rule] = {
         Rule("TPU103", "missed-donation", WARNING, TIER_JAXPR, "read-and-replaced argument is not donated"),
         Rule("TPU104", "unconstrained-output-sharding", WARNING, TIER_JAXPR, "input mesh axis never re-constrained anywhere in the graph"),
         # -- tier 2: AST --------------------------------------------------
+        # -- tier 2: AST --------------------------------------------------
         Rule("TPU201", "host-call-in-jit", ERROR, TIER_AST, "host-synchronising call lexically inside a jitted function"),
         Rule("TPU202", "tracer-dependent-branch", WARNING, TIER_AST, "Python if/while on a traced argument inside a jitted function"),
         Rule("TPU203", "unhashable-static-default", ERROR, TIER_AST, "static_argnums/static_argnames parameter has an unhashable default"),
         Rule("TPU204", "eager-jax-import", ERROR, TIER_AST, "module-level jax import in a lazy-import (`_jax()`) zone"),
+        # -- tier 3: SPMD flight-check (analysis.flightcheck) --------------
+        Rule("TPU301", "collective-in-dynamic-control-flow", ERROR, TIER_FLIGHT, "collective inside a value-dependent cond/while body (SPMD deadlock)"),
+        Rule("TPU302", "implicit-reshard", WARNING, TIER_FLIGHT, "conflicting sharding constraints force GSPMD to all-gather/reshard"),
+        Rule("TPU303", "donation-defeated", WARNING, TIER_FLIGHT, "donated buffer read after its aliased output is produced (defensive copy)"),
     )
 }
 

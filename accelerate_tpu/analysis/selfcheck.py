@@ -5,7 +5,9 @@ This is the executable spec of the rule catalogue: each fixture seeds
 exactly the defect its rule exists to catch — a wrong collective axis, a
 silent bf16->f32 promotion, a missed donation, an unconstrained output
 sharding, a host sync inside jit, a tracer-dependent branch, an unhashable
-static default, and an eager module-scope jax import. A CI run that passes
+static default, an eager module-scope jax import, and (flight tier) a
+collective under ``lax.cond``, a conflicting re-constraint, and a donated
+buffer read after its aliased output exists. A CI run that passes
 selfcheck has proven the linter end-to-end on the CPU backend, so a clean
 repo lint actually means something.
 
@@ -18,6 +20,7 @@ from __future__ import annotations
 import textwrap
 
 from .ast_lint import LintConfig, lint_source
+from .flightcheck import flight_check
 from .jaxpr_lint import lint_step
 from .rules import Finding
 
@@ -127,6 +130,41 @@ def _jaxpr_fixtures(mesh):
     return fixtures
 
 
+def _flight_fixtures(mesh):
+    """``rule -> (fn, sample_args, kwargs)`` seeded flight-tier (TPU3xx)
+    defects, checked through :func:`analysis.flightcheck.flight_check`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+
+    def cond_collective_step(x):
+        # SPMD deadlock: devices disagreeing on the predicate never meet
+        # at the psum
+        return jax.lax.cond(x.sum() > 0.0, lambda v: jax.lax.psum(v, axis), lambda v: v, x)
+
+    def resharding_step(x):
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(axis, None)))
+        x = x * 2.0
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(None, axis)))
+        return x.sum()
+
+    def late_read_step(params, batch):
+        new = jax.tree_util.tree_map(lambda p: p - 0.1, params)
+        loss = (params["w"] * batch).sum()  # read after `new` is produced
+        return new, loss
+
+    x = jax.ShapeDtypeStruct((8 * max(2, mesh.shape.get(axis, 2)), 16), jnp.float32)
+    w = {"w": jax.ShapeDtypeStruct((64, 64), jnp.float32)}
+    b = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    return {
+        "TPU301": (cond_collective_step, (x,), {}),
+        "TPU302": (resharding_step, (x,), {}),
+        "TPU303": (late_read_step, (w, b), {"donate_argnums": (0,)}),
+    }
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -149,6 +187,12 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
         fired = any(f.rule == rule for f in found)
         ok &= fired
         lines.append(f"[selfcheck] {rule} jaxpr fixture: {'detected' if fired else 'MISSED'}")
+
+    for rule, (fn, args, kwargs) in sorted(_flight_fixtures(mesh).items()):
+        report = flight_check(fn, *args, mesh=mesh, select=(rule,), **kwargs)
+        fired = any(f.rule == rule for f in report.findings)
+        ok &= fired
+        lines.append(f"[selfcheck] {rule} flight fixture: {'detected' if fired else 'MISSED'}")
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

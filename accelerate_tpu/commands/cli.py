@@ -8,6 +8,7 @@ import argparse
 from .config import config_parser
 from .env import env_parser
 from .estimate import estimate_parser
+from .flightcheck import flightcheck_parser
 from .launch import launch_parser
 from .lint import lint_parser
 from .merge import merge_parser
@@ -27,6 +28,7 @@ def main():
     test_parser(subparsers)
     estimate_parser(subparsers)
     lint_parser(subparsers)
+    flightcheck_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)
     tpu_command_parser(subparsers)

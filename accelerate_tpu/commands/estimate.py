@@ -9,6 +9,15 @@ here the parameter count comes from the local HF cache when present, else
 from safetensors header metadata over ranged requests — no weight download,
 no torch), and reports per-dtype totals for inference and Adam training
 (params + grads + 2 moments), plus how the total divides across a mesh.
+
+``--jaxpr`` upgrades the param-count table into a real per-device report:
+the source becomes a step-function target (``file.py::fn`` or
+``pkg.module:fn``), which is traced abstractly and run through the SPMD
+flight-check — peak HBM from a liveness walk over the actual program,
+donated-buffer reuse, and the collective traffic bill (see
+``accelerate-tpu flight-check`` for the full surface)::
+
+    accelerate-tpu estimate-memory --jaxpr train.py::step --arg "f32[32,128]" --mesh data=8
 """
 
 from __future__ import annotations
@@ -145,6 +154,15 @@ def estimate_parser(subparsers=None):
     parser.add_argument("--inference_only", action="store_true")
     parser.add_argument("--hbm_gb", type=float, default=16.0, help="per-device HBM for the fit column (v5e=16, v4=32, v5p=95)")
     parser.add_argument("--token", default=None, help="Hub token for gated/private repos")
+    parser.add_argument(
+        "--jaxpr",
+        action="store_true",
+        help="treat SOURCE as a step function (file.py::fn) and report per-device "
+        "peak HBM from a traced-program liveness walk instead of the param table",
+    )
+    parser.add_argument("--arg", action="append", default=[], help="(--jaxpr) sample arg spec like f32[8,128]")
+    parser.add_argument("--mesh", default=None, help="(--jaxpr) mesh shape, e.g. data=4,tensor=2")
+    parser.add_argument("--donate", default="", help="(--jaxpr) comma-separated donated argnums")
     if subparsers is not None:
         parser.set_defaults(func=estimate_command)
     return parser
@@ -162,7 +180,29 @@ def parse_param_count(text: str) -> int:
     return int(float(text) * mult)
 
 
+def estimate_jaxpr_command(args) -> int:
+    """The ``--jaxpr`` path: trace the step target and print the flight
+    report plus a fit verdict against ``--hbm_gb``."""
+    from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+    mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.source)
+    sample_args = resolve_sample_args(module, fn, args.arg)
+    donate = tuple(int(p) for p in args.donate.split(",") if p.strip())
+
+    from accelerate_tpu.analysis.flightcheck import flight_check
+
+    report = flight_check(fn, *sample_args, mesh=mesh, donate_argnums=donate)
+    print(report.render_text())
+    hbm = getattr(args, "hbm_gb", 16.0)
+    verdict = "fits" if report.fits(hbm) else "DOES NOT FIT"
+    print(f"  verdict: {verdict} in {hbm:g} GB/device HBM")
+    return 0
+
+
 def estimate_command(args) -> int:
+    if getattr(args, "jaxpr", False):
+        return estimate_jaxpr_command(args)
     how = None
     if os.path.exists(args.source):
         num_params = count_params_from_safetensors(args.source)

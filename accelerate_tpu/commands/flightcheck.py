@@ -1,0 +1,204 @@
+"""``accelerate-tpu flight-check`` — static SPMD cost/safety analysis of a
+step function before any XLA compile.
+
+Points at a step function — ``path/to/file.py::fn`` or ``pkg.module:fn`` —
+traces it abstractly against a mesh, and prints the flight report: peak
+HBM per device, the collective traffic bill (bytes on wire, ICI vs DCN),
+and the TPU3xx safety findings (collective deadlock under value-dependent
+control flow, implicit reshards, defeated donation).
+
+Sample shapes come from repeatable ``--arg dtype[shape]`` specs, or from
+the target module itself: a ``SAMPLE_ARGS`` constant/callable, or a
+``<fn>_sample_args`` function next to the step. Everything runs on the CPU
+backend with a fake multi-device mesh — safe on a dev box with no TPU.
+
+Examples::
+
+    accelerate-tpu flight-check examples/by_feature/flight_check.py::train_step
+    accelerate-tpu flight-check train.py::step --arg "f32[32,128]" --mesh data=4,tensor=2
+    accelerate-tpu flight-check train.py::step --donate 0 --format json --hbm-gb 16
+    accelerate-tpu flight-check --selfcheck        # prove TPU301/302/303 fire
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import re
+import sys
+
+_DTYPE_ALIASES = {
+    "f32": "float32", "f64": "float64", "f16": "float16", "bf16": "bfloat16",
+    "i32": "int32", "i64": "int64", "i8": "int8", "u8": "uint8", "bool": "bool",
+    "f8e4m3": "float8_e4m3fn", "f8e5m2": "float8_e5m2",
+}
+
+_ARG_RE = re.compile(r"^\s*([A-Za-z0-9_]+)\[([0-9,\s]*)\]\s*$")
+
+
+def parse_arg_spec(spec: str):
+    """``"f32[8,128]"`` -> ``jax.ShapeDtypeStruct((8, 128), float32)``."""
+    import jax
+    import jax.numpy as jnp
+
+    m = _ARG_RE.match(spec)
+    if m is None:
+        raise ValueError(f"bad --arg spec {spec!r}; expected e.g. f32[8,128] or i32[16]")
+    dtype = _DTYPE_ALIASES.get(m.group(1), m.group(1))
+    shape = tuple(int(d) for d in m.group(2).split(",") if d.strip())
+    return jax.ShapeDtypeStruct(shape, jnp.dtype(dtype))
+
+
+def load_step(target: str):
+    """Resolve ``file.py::fn`` or ``pkg.module:fn`` to ``(module, fn)``."""
+    if "::" in target:
+        path, _, fn_name = target.partition("::")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no such file: {path}")
+        spec = importlib.util.spec_from_file_location(
+            os.path.splitext(os.path.basename(path))[0], path
+        )
+        module = importlib.util.module_from_spec(spec)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+        try:
+            spec.loader.exec_module(module)
+        finally:
+            sys.path.pop(0)
+    elif ":" in target:
+        mod_name, _, fn_name = target.partition(":")
+        module = importlib.import_module(mod_name)
+    else:
+        raise ValueError(f"target {target!r} must be file.py::fn or pkg.module:fn")
+    try:
+        fn = getattr(module, fn_name)
+    except AttributeError as e:
+        raise AttributeError(f"{target!r}: module has no function {fn_name!r}") from e
+    return module, fn
+
+
+def resolve_sample_args(module, fn, arg_specs):
+    """Sample args for the trace: explicit ``--arg`` specs win; else the
+    module's ``<fn>_sample_args()`` / ``SAMPLE_ARGS`` convention."""
+    if arg_specs:
+        return tuple(parse_arg_spec(s) for s in arg_specs)
+    builder = getattr(module, f"{fn.__name__}_sample_args", None) or getattr(module, "SAMPLE_ARGS", None)
+    if builder is None:
+        raise ValueError(
+            f"no sample shapes for {fn.__name__}: pass --arg 'f32[8,128]' (repeatable) "
+            f"or define {fn.__name__}_sample_args() / SAMPLE_ARGS in the module"
+        )
+    return tuple(builder()) if callable(builder) else tuple(builder)
+
+
+def build_mesh(mesh_spec: str | None):
+    """``"data=2,tensor=2"`` -> a fake CPU mesh of that shape (host
+    platform forced before jax initialises). Default: all devices on
+    ``data``."""
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    kwargs = {}
+    if mesh_spec:
+        for part in mesh_spec.split(","):
+            name, _, val = part.partition("=")
+            kwargs[name.strip()] = int(val)
+    n_needed = 1
+    for v in kwargs.values():
+        n_needed *= max(1, v)
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(max(8, n_needed))
+    if not kwargs:
+        return MeshConfig().build()
+    import jax
+
+    # explicit shapes may use fewer devices than the fake host platform has
+    return MeshConfig(**kwargs).build(jax.devices()[:n_needed])
+
+
+def flightcheck_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "flight-check", help="Static peak-HBM / collective-cost / deadlock analysis of a step fn"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu flight-check")
+    parser.add_argument("target", nargs="?", help="step function: file.py::fn or pkg.module:fn")
+    parser.add_argument("--arg", action="append", default=[], help="sample arg spec like f32[8,128] (repeatable)")
+    parser.add_argument("--mesh", default=None, help="mesh shape, e.g. data=4,tensor=2 (default: all devices on data)")
+    parser.add_argument("--donate", default="", help="comma-separated donated argnums, e.g. 0,1")
+    parser.add_argument("--dcn-axes", default=None, help="axes that cross DCN, e.g. data (default: env/single-slice)")
+    parser.add_argument("--generation", default="v5e", help="TPU generation for the bandwidth table (v4/v5e/v5p/v6e)")
+    parser.add_argument("--hbm-gb", type=float, default=None, help="per-device HBM; adds a fits/doesn't-fit verdict")
+    parser.add_argument("--format", choices=("text", "json"), default="text", help="Report format")
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU301/302/303 fire on seeded defects (no target needed)",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=flightcheck_command)
+    return parser
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)
+    from accelerate_tpu.analysis.flightcheck import flight_check
+    from accelerate_tpu.analysis.selfcheck import _flight_fixtures
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    mesh = MeshConfig().build()
+    ok = True
+    for rule, (fn, args, kwargs) in sorted(_flight_fixtures(mesh).items()):
+        report = flight_check(fn, *args, mesh=mesh, select=(rule,), **kwargs)
+        fired = any(f.rule == rule for f in report.findings)
+        ok &= fired
+        print(f"[flight-check selfcheck] {rule}: {'detected' if fired else 'MISSED'}")
+    if not ok:
+        print("flight-check selfcheck FAILED: a rule missed its seeded defect")
+        return 1
+    return 0
+
+
+def flightcheck_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not args.target:
+            return rc
+
+    if not args.target:
+        print("usage: accelerate-tpu flight-check file.py::step_fn [--arg f32[8,128] ...]")
+        return 2
+
+    mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.target)
+    sample_args = resolve_sample_args(module, fn, args.arg)
+    donate = tuple(int(p) for p in args.donate.split(",") if p.strip())
+    dcn = tuple(a.strip() for a in args.dcn_axes.split(",") if a.strip()) if args.dcn_axes else None
+
+    from accelerate_tpu.analysis import exit_code
+    from accelerate_tpu.analysis.flightcheck import flight_check
+
+    report = flight_check(
+        fn, *sample_args, mesh=mesh, donate_argnums=donate, dcn=dcn, generation=args.generation
+    )
+    if args.format == "json":
+        import json
+
+        print(json.dumps(report.as_dict(), indent=2))
+    else:
+        print(report.render_text())
+        if args.hbm_gb is not None:
+            verdict = "fits" if report.fits(args.hbm_gb) else "DOES NOT FIT"
+            print(f"  verdict: {verdict} in {args.hbm_gb:g} GB/device HBM")
+    return exit_code(report.findings, strict=args.strict)
+
+
+def main():
+    raise SystemExit(flightcheck_command(flightcheck_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ Examples::
     accelerate-tpu lint accelerate_tpu/            # lint the tree
     accelerate-tpu lint --selfcheck                # prove the rules fire
     accelerate-tpu lint src/train.py --format json # machine-readable
+    accelerate-tpu lint pkg/ --format sarif        # CI PR annotation
     accelerate-tpu lint pkg/ --select TPU201,TPU202
 
 The jaxpr tier for *your* step function is programmatic —
@@ -30,7 +31,7 @@ def lint_parser(subparsers=None):
     else:
         parser = argparse.ArgumentParser("accelerate-tpu lint")
     parser.add_argument("paths", nargs="*", help="Files or directories to lint (.py files)")
-    parser.add_argument("--format", choices=("text", "json"), default="text", help="Report format")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default="text", help="Report format")
     parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
     parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
     parser.add_argument(
@@ -55,7 +56,7 @@ def _split_ids(raw):
 
 
 def lint_command(args) -> int:
-    from accelerate_tpu.analysis import LintConfig, exit_code, lint_paths, render_json, render_text
+    from accelerate_tpu.analysis import LintConfig, exit_code, lint_paths, render_json, render_sarif, render_text
 
     if not args.paths and not args.selfcheck:
         print("usage: accelerate-tpu lint [paths ...] [--selfcheck]")
@@ -90,6 +91,8 @@ def lint_command(args) -> int:
 
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
     elif findings or args.paths:
         print(render_text(findings))
     return rc

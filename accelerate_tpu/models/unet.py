@@ -60,15 +60,38 @@ class UNetConfig:
 
 
 UNET_SHARDING_RULES = [
-    # conv kernels [kh, kw, in, out]: column-split the out channels
-    (r"conv_(in|1|2)/kernel", P(None, None, None, "tensor")),
+    # conv kernels [kh, kw, in, out]: the Megatron column/row pair per
+    # ResBlock — conv_1 column-splits the out channels, conv_2 row-splits
+    # the in channels (GSPMD inserts the psum), so every block's OUTPUT is
+    # replicated over `tensor`. Skip tensors must never be channel-sharded:
+    # XLA's SPMD partitioner miscompiles `concatenate` along a dimension
+    # sharded over one axis of a multi-axis mesh (observed on XLA:CPU,
+    # jax 0.4.37 — wrong values, not reduction-order noise), and the up
+    # path concatenates every skip along channels.
+    (r"conv_1/kernel", P(None, None, None, "tensor")),
+    (r"conv_2/kernel", P(None, None, "tensor", None)),
     (r"conv_out/kernel", P(None, None, "tensor", None)),
-    # attention projections (self and cross)
+    # attention projections (self and cross): column qkv, row out
     (r"(cross_)?(q|k|v)_proj/kernel", P(None, "tensor")),
     (r"(cross_)?out_proj/kernel", P("tensor", None)),
-    # time/label embedding MLPs
-    (r"time_mlp_[12]/kernel", P(None, "tensor")),
+    # time embedding MLP: column then row, temb stays replicated
+    (r"time_mlp_1/kernel", P(None, "tensor")),
+    (r"time_mlp_2/kernel", P("tensor", None)),
 ]
+
+
+def _skip_safe(h):
+    """Constrain an activation headed for a skip concat to the
+    batch-sharded/channel-replicated layout. Without the annotation GSPMD
+    may propagate a column-split conv's channel sharding into the skip list
+    and partition the up-path ``concatenate`` along channels — the layout
+    the row-split convs make redundant anyway, and the one XLA's SPMD
+    partitioner gets wrong on multi-axis meshes (see UNET_SHARDING_RULES).
+    No-op when no mesh is active."""
+    from ..parallel.mesh import BATCH_AXES
+    from ..parallel.sharding import maybe_shard
+
+    return maybe_shard(h, P(BATCH_AXES))
 
 
 def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
@@ -175,7 +198,7 @@ class UNet2D(nn.Module):
             temb = temb + nn.Embed(cfg.num_classes, temb_dim, name="label_embed")(class_labels).astype(temb.dtype)
 
         h = nn.Conv(cfg.base_channels, (3, 3), padding="SAME", name="conv_in", dtype=sample.dtype)(sample)
-        skips = [h]
+        skips = [_skip_safe(h)]
         # down path
         for lvl, mult in enumerate(cfg.channel_mults):
             ch = cfg.base_channels * mult
@@ -183,10 +206,10 @@ class UNet2D(nn.Module):
                 h = ResBlock(ch, cfg.num_groups, cfg.dropout, name=f"down_{lvl}_{i}")(h, temb, deterministic)
                 if lvl in cfg.attention_levels:
                     h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"down_attn_{lvl}_{i}")(h, ctx)
-                skips.append(h)
+                skips.append(_skip_safe(h))
             if lvl != len(cfg.channel_mults) - 1:
                 h = nn.Conv(ch, (3, 3), (2, 2), padding="SAME", name=f"downsample_{lvl}", dtype=h.dtype)(h)
-                skips.append(h)
+                skips.append(_skip_safe(h))
         # mid
         ch = cfg.base_channels * cfg.channel_mults[-1]
         h = ResBlock(ch, cfg.num_groups, cfg.dropout, name="mid_1")(h, temb, deterministic)
@@ -196,7 +219,7 @@ class UNet2D(nn.Module):
         for lvl, mult in reversed(list(enumerate(cfg.channel_mults))):
             ch = cfg.base_channels * mult
             for i in range(cfg.layers_per_block + 1):
-                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = jnp.concatenate([_skip_safe(h), skips.pop()], axis=-1)
                 h = ResBlock(ch, cfg.num_groups, cfg.dropout, name=f"up_{lvl}_{i}")(h, temb, deterministic)
                 if lvl in cfg.attention_levels:
                     h = AttnBlock(cfg.num_heads, cfg.num_groups, name=f"up_attn_{lvl}_{i}")(h, ctx)

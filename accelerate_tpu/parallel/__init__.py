@@ -1,7 +1,18 @@
 """Parallelism machinery: mesh construction, sharding-rule engine,
 in-shard_map collectives, GPipe pipeline, ring/Ulysses context parallel."""
 
-from .mesh import AXIS_NAMES, BATCH_AXES, MeshConfig, batch_sharding, data_parallel_size, replicated
+from .mesh import (
+    AXIS_NAMES,
+    BATCH_AXES,
+    DCN,
+    ICI,
+    MeshConfig,
+    axis_transport,
+    batch_sharding,
+    data_parallel_size,
+    dcn_axes,
+    replicated,
+)
 from .sharding import (
     Rules,
     fsdp_rules_for,

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from dataclasses import dataclass
+from typing import Sequence
 
 import numpy as np
 
@@ -120,6 +121,42 @@ class MeshConfig:
 
 
 _FIELD_BY_AXIS = {"pipe": "pipe", "data": "data", "fsdp": "fsdp", "expert": "expert", "seq": "seq", "tensor": "tensor"}
+
+
+# -- axis transport metadata (ICI vs DCN) ---------------------------------
+#
+# On a single TPU slice every mesh axis rides the ICI torus. Multi-slice
+# ("multipod") topologies route the OUTERMOST axes over the data-center
+# network instead — orders of magnitude less bandwidth — so the cost model
+# (analysis.costmodel) must know which axes cross DCN. The launcher sets
+# ``ACCELERATE_MESH_DCN_AXES`` (comma-separated axis names) on multi-slice
+# jobs; single-slice runs leave it unset and everything is ICI.
+
+ICI = "ici"
+DCN = "dcn"
+
+DCN_AXES_ENV = "ACCELERATE_MESH_DCN_AXES"
+
+
+def dcn_axes() -> tuple[str, ...]:
+    """Mesh axes that cross the data-center network, from the
+    ``ACCELERATE_MESH_DCN_AXES`` launcher protocol (empty == single slice,
+    every axis on ICI)."""
+    import os
+
+    raw = os.environ.get(DCN_AXES_ENV, "")
+    return tuple(a.strip() for a in raw.split(",") if a.strip())
+
+
+def axis_transport(mesh, axis: str, dcn: Sequence[str] | None = None) -> str:
+    """``"ici"`` or ``"dcn"`` for a mesh axis. ``dcn`` overrides the env
+    protocol (analysis passes an explicit list when modelling a topology
+    that is not the ambient one). Trivial (size-1) axes carry no traffic
+    and report ICI."""
+    names = tuple(dcn) if dcn is not None else dcn_axes()
+    if axis in names and mesh.shape.get(axis, 1) > 1:
+        return DCN
+    return ICI
 
 
 def batch_sharding(mesh) -> "jax.sharding.NamedSharding":  # noqa: F821

@@ -66,6 +66,8 @@ MODULES = [
     "accelerate_tpu.analysis.rules",
     "accelerate_tpu.analysis.ast_lint",
     "accelerate_tpu.analysis.jaxpr_lint",
+    "accelerate_tpu.analysis.flightcheck",
+    "accelerate_tpu.analysis.costmodel",
     "accelerate_tpu.analysis.report",
     "accelerate_tpu.models",
 ]

@@ -374,9 +374,43 @@ def test_registry_ids_are_stable():
         "TPU001", "TPU002", "TPU003",
         "TPU101", "TPU102", "TPU103", "TPU104",
         "TPU201", "TPU202", "TPU203", "TPU204",
+        "TPU301", "TPU302", "TPU303",
     }
     with pytest.raises(ValueError):
         Finding("TPU999", "no such rule")
+
+
+def test_render_sarif_shape():
+    from accelerate_tpu.analysis import render_sarif
+
+    findings = [
+        Finding("TPU201", "host sync", path="a/b.py", line=12),
+        Finding("TPU301", "deadlocky collective"),  # jaxpr tier: no location
+    ]
+    doc = json.loads(render_sarif(findings))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "accelerate-tpu-lint"
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rules == {"TPU201", "TPU301"}
+    results = run["results"]
+    assert results[0]["ruleId"] == "TPU201" and results[0]["level"] == "error"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a/b.py"
+    assert loc["region"]["startLine"] == 12
+    # location-less finding anchors to the synthetic artifact
+    assert results[1]["locations"][0]["physicalLocation"]["artifactLocation"]["uri"] == "<jaxpr>"
+    # ruleIndex round-trips into the rules array
+    for res in results:
+        assert run["tool"]["driver"]["rules"][res["ruleIndex"]]["id"] == res["ruleId"]
+
+
+def test_render_sarif_empty():
+    from accelerate_tpu.analysis import render_sarif
+
+    doc = json.loads(render_sarif([]))
+    assert doc["runs"][0]["results"] == []
+    assert doc["runs"][0]["tool"]["driver"]["rules"] == []
 
 
 # --------------------------------------------------------------------- #
@@ -395,4 +429,4 @@ def test_repo_tree_is_lint_clean():
 def test_selfcheck_all_rules_fire(mesh8):
     ok, lines = run_selfcheck(mesh8)
     assert ok, "\n".join(lines)
-    assert sum("detected" in line for line in lines) == 10
+    assert sum("detected" in line for line in lines) == 13  # 6 AST + 4 jaxpr + 3 flight

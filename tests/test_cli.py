@@ -735,10 +735,22 @@ def test_lint_select_ignore_and_suppression(tmp_path):
     assert "0 finding(s)" in result.stdout
 
 
+def test_lint_sarif_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n")
+    result = run_cli("lint", str(bad), "--format", "sarif")
+    doc = json.loads(result.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert {r["ruleId"] for r in results} == {"TPU001", "TPU002"}
+    uri = results[0]["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+    assert uri == str(bad)
+
+
 @pytest.mark.slow
 def test_lint_selfcheck():
     """Every rule detects its seeded-defect fixture (CPU fake mesh)."""
     result = run_cli("lint", "--selfcheck")
     assert result.returncode == 0, result.stdout + result.stderr
-    assert result.stdout.count("detected") == 10
+    assert result.stdout.count("detected") == 13  # 6 AST + 4 jaxpr + 3 flight
     assert "honoured" in result.stdout

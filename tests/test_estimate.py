@@ -1,0 +1,146 @@
+"""``accelerate-tpu estimate-memory`` internals: parameter-count parsing,
+the dtype table, safetensors header counting, repo-id routing, and the
+``--jaxpr`` flight-check path."""
+
+import json
+import struct
+
+import pytest
+
+from accelerate_tpu.commands.estimate import (
+    DTYPE_BYTES,
+    _repo_id_like,
+    count_params_from_safetensors,
+    estimate_command,
+    estimate_parser,
+    estimate_table,
+    parse_param_count,
+)
+
+
+def test_parse_param_count_suffixes():
+    assert parse_param_count("7B") == 7_000_000_000
+    assert parse_param_count("124M") == 124_000_000
+    assert parse_param_count("350K") == 350_000
+    assert parse_param_count("350000") == 350_000
+    assert parse_param_count(" 1.5b ") == 1_500_000_000
+    assert parse_param_count("0.5M") == 500_000
+
+
+def test_parse_param_count_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_param_count("seven billion")
+
+
+def test_estimate_table_training_math():
+    rows = estimate_table(1000, mesh_devices=4, training=True)
+    assert len(rows) == len(DTYPE_BYTES)
+    by_dtype = {r["dtype"]: r for r in rows}
+    f32 = by_dtype["float32"]
+    assert f32["inference_bytes"] == 4000
+    # Adam: weights + fp32 grads + 2 fp32 moments
+    assert f32["training_bytes"] == 4000 + 1000 * 4 * 3
+    assert f32["inference_per_device"] == 1000.0
+    bf16 = by_dtype["bfloat16"]
+    assert bf16["inference_bytes"] == 2000
+    assert bf16["training_bytes"] == 2000 + 1000 * 4 * 3
+
+
+def test_estimate_table_inference_only():
+    rows = estimate_table(1000, mesh_devices=2, training=False)
+    assert all(r["training_bytes"] is None for r in rows)
+    assert all(r["training_per_device"] is None for r in rows)
+
+
+def test_repo_id_like_routing():
+    assert _repo_id_like("meta-llama/Llama-3.2-1B")
+    assert not _repo_id_like("7B")
+    assert not _repo_id_like("weights/model.safetensors")  # path typo, not a repo
+    assert not _repo_id_like("a/b/c")
+
+
+def _write_safetensors(path, tensors):
+    """Minimal safetensors writer: header + zero data."""
+    header = {}
+    offset = 0
+    for name, shape in tensors.items():
+        n = 1
+        for d in shape:
+            n *= d
+        header[name] = {"dtype": "F32", "shape": list(shape), "data_offsets": [offset, offset + n * 4]}
+        offset += n * 4
+    blob = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        f.write(b"\0" * offset)
+
+
+def test_count_params_from_safetensors_file_and_dir(tmp_path):
+    _write_safetensors(tmp_path / "a.safetensors", {"w": (10, 20), "b": (20,)})
+    _write_safetensors(tmp_path / "b.safetensors", {"v": (5, 5)})
+    assert count_params_from_safetensors(str(tmp_path / "a.safetensors")) == 220
+    assert count_params_from_safetensors(str(tmp_path)) == 245
+    assert count_params_from_safetensors(str(tmp_path / "nope.txt")) == 0
+
+
+def test_estimate_command_param_table(capsys):
+    args = estimate_parser().parse_args(["124M", "--num_devices", "4"])
+    assert estimate_command(args) == 0
+    out = capsys.readouterr().out
+    assert "124,000,000" in out
+    assert "bfloat16" in out and "fits/device" in out
+
+
+def test_estimate_command_jaxpr_path(tmp_path, capsys):
+    """--jaxpr upgrades the table into a per-device flight report."""
+    import textwrap
+
+    mod = tmp_path / "step_mod.py"
+    mod.write_text(
+        textwrap.dedent(
+            '''
+            """Fixture step for estimate --jaxpr."""
+            import jax
+            import jax.numpy as jnp
+
+
+            def step(w, x):
+                return (x @ w).sum()
+
+
+            def step_sample_args():
+                return (
+                    jax.ShapeDtypeStruct((128, 64), jnp.float32),
+                    jax.ShapeDtypeStruct((32, 128), jnp.float32),
+                )
+            '''
+        )
+    )
+    args = estimate_parser().parse_args([f"{mod}::step", "--jaxpr", "--mesh", "data=2"])
+    assert estimate_command(args) == 0
+    out = capsys.readouterr().out
+    assert "peak HBM / device" in out
+    assert "verdict: fits" in out
+
+
+def test_estimate_command_jaxpr_arg_specs(tmp_path, capsys):
+    import textwrap
+
+    mod = tmp_path / "step_mod2.py"
+    mod.write_text(
+        textwrap.dedent(
+            '''
+            """Fixture step without a sample-args convention."""
+
+
+            def step(w, x):
+                return (x @ w).sum()
+            '''
+        )
+    )
+    args = estimate_parser().parse_args(
+        [f"{mod}::step", "--jaxpr", "--arg", "f32[128,64]", "--arg", "f32[32,128]"]
+    )
+    assert estimate_command(args) == 0
+    assert "peak HBM / device" in capsys.readouterr().out
