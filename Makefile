@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint flight-check test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint flight-check telemetry-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -38,6 +38,7 @@ quality: lint
 lint:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
 	-$(MAKE) --no-print-directory flight-check
+	-$(MAKE) --no-print-directory telemetry-selfcheck
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake
@@ -45,6 +46,12 @@ lint:
 flight-check:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli flight-check --selfcheck \
 		examples/by_feature/flight_check.py::train_step --mesh data=8 --donate 0
+
+# Runtime telemetry: 5-step CPU loop -> JSONL -> parse -> summarize; proves
+# the event-log schema, the step split, the recompile watchdog, and the
+# summarize CLI agree end to end.
+telemetry-selfcheck:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli telemetry selfcheck
 
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi

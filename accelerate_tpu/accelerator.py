@@ -86,10 +86,13 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
     ):
         # kwargs handlers (reference: accelerator.py:415-452)
+        from .utils.dataclasses import TelemetryKwargs
+
         self.autocast_handler = AutocastKwargs()
         self.scaler_handler = GradScalerKwargs()
         self.profile_handler = ProfileKwargs()
         self.init_handler = DistributedInitKwargs()
+        self.telemetry_handler = TelemetryKwargs()
         self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, AutocastKwargs):
@@ -100,6 +103,8 @@ class Accelerator:
                 self.profile_handler = handler
             elif isinstance(handler, DistributedInitKwargs):
                 self.init_handler = handler
+            elif isinstance(handler, TelemetryKwargs):
+                self.telemetry_handler = handler
             else:
                 from .utils.dataclasses import Fp8RecipeKwargs, MixedPrecisionPolicy
 
@@ -198,6 +203,9 @@ class Accelerator:
 
         self.trackers: list = []
         self._log_with = log_with
+
+        # runtime telemetry (lazy — see the `telemetry` property)
+        self._telemetry = None
 
         self.flag_tensor = None
 
@@ -365,6 +373,19 @@ class Accelerator:
             if i in staged:
                 continue
             staged[i] = self.prepare_scheduler(obj)
+        if self._telemetry is not None:
+            # telemetry already live: mark the prepare so the timeline can
+            # attribute the layout/device_put cost (never force-create it —
+            # prepare() must not start writing files as a side effect)
+            self._telemetry.log.event(
+                "prepare",
+                models=len(self._models),
+                optimizers=len(self._optimizers),
+                dataloaders=len(self._dataloaders),
+                schedulers=len(self._schedulers),
+                mesh={k: int(v) for k, v in dict(self.mesh.shape).items()},
+                mixed_precision=self.mixed_precision,
+            )
         result = [staged[i] for i in range(len(args))]
         return result[0] if len(result) == 1 else tuple(result)
 
@@ -719,6 +740,9 @@ class Accelerator:
                 getattr(step_fn, "__name__", "step_fn"),
                 render_text(report.findings),
             )
+        if self._telemetry is not None and report.peak_hbm_bytes:
+            # seed the runtime HBM drift check with the static prediction
+            self._telemetry.set_static_hbm_estimate(report.peak_hbm_bytes)
         return report
 
     def build_train_step(
@@ -1149,9 +1173,20 @@ class Accelerator:
     def accumulate(self, *models):
         """(reference: accelerator.py:1149). Gradient-sync bookkeeping for
         the imperative path: inside the context, ``backward`` accumulates;
-        ``optimizer.step()`` applies only on sync boundaries."""
+        ``optimizer.step()`` applies only on sync boundaries.
+
+        When telemetry is live (the ``telemetry`` property has been
+        accessed), each ``accumulate`` block is recorded as one step on
+        the runtime timeline, fenced on the active model's params — the
+        imperative twin of ``telemetry.wrap(step)``."""
         self._do_sync()
-        yield
+        if self._telemetry is None:
+            yield
+            return
+        with self._telemetry.steps.step() as handle:
+            yield
+            target = (models[0] if models else None) or (self._models[-1] if self._models else None)
+            handle.done(getattr(target, "params", None))
 
     @contextlib.contextmanager
     def no_sync(self, model=None):
@@ -1480,6 +1515,47 @@ class Accelerator:
         return _skip_first_batches(dataloader, num_batches)
 
     # ------------------------------------------------------------------ #
+    # runtime telemetry (no reference analogue; docs/usage_guides/telemetry.md)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def telemetry(self):
+        """The run's :class:`~accelerate_tpu.telemetry.Telemetry` facade
+        (created on first access from the ``TelemetryKwargs`` handler).
+
+        Typical use — instrument the fast path and let everything else
+        happen automatically (event log under ``logging_dir``, HBM
+        sampling, recompile watchdog, tracker forwarding)::
+
+            step = accelerator.telemetry.wrap(accelerator.build_train_step(loss_fn))
+
+        The imperative path needs no call at all: ``accumulate()`` blocks
+        are timed as steps once telemetry has been touched. Pass
+        ``TelemetryKwargs(enabled=False)`` to keep even explicit accesses
+        event-log-free (in-memory records still accumulate, so
+        ``telemetry.summary()`` keeps working)."""
+        if self._telemetry is None:
+            from .telemetry import Telemetry, default_path
+
+            h = self.telemetry_handler
+            path = None
+            if h.enabled:
+                path = h.output_path or default_path(self.logging_dir)
+            self._telemetry = Telemetry(
+                path,
+                rank=self.process_index,
+                main_process_only=h.main_process_only,
+                warmup_steps=h.warmup_steps,
+                fence=h.fence,
+                watchdog=h.recompile_watchdog,
+                n_devices=self.state.num_devices,
+                hbm_sample_every=h.hbm_sample_every,
+                forward_fn=(lambda values, step: self.log(values, step=step)),
+                forward_every=h.forward_to_trackers_every,
+            )
+        return self._telemetry
+
+    # ------------------------------------------------------------------ #
     # tracking (reference: accelerator.py:3002-3114)
     # ------------------------------------------------------------------ #
 
@@ -1489,10 +1565,19 @@ class Accelerator:
         self.trackers = filter_trackers(self._log_with, self.logging_dir, project_name, config, init_kwargs)
 
     def get_tracker(self, name: str, unwrap: bool = False):
-        for tracker in self.trackers:
-            if tracker.name == name:
-                return tracker.tracker if unwrap else tracker
-        raise ValueError(f"{name} is not an active tracker: {[t.name for t in self.trackers]}")
+        """(reference: accelerator.py:3069). With NO active trackers,
+        returns a no-op blank ``GeneralTracker`` (reference behavior) so
+        user code can call ``get_tracker(...).log(...)`` unconditionally;
+        the ``ValueError`` is kept only for a *named* tracker genuinely
+        missing among active ones."""
+        if self.trackers:
+            for tracker in self.trackers:
+                if tracker.name == name:
+                    return tracker.tracker if unwrap else tracker
+            raise ValueError(f"{name} is not an active tracker: {[t.name for t in self.trackers]}")
+        from .tracking import GeneralTracker
+
+        return GeneralTracker(_blank=True)
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = {}):
         if self.is_main_process:
@@ -1552,13 +1637,40 @@ class Accelerator:
 
     @contextlib.contextmanager
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
+        """Trace the body with ``jax.profiler``. Every ``ProfileKwargs``
+        field is honoured as far as the installed jax allows:
+        ``create_perfetto_link``/``create_perfetto_trace`` go straight to
+        ``start_trace``; the tracer levels ride on profiler options when
+        this jax exposes them (``jax.profiler.ProfileOptions``, jax>=0.5)
+        and are otherwise DROPPED with a one-time warning naming exactly
+        which knobs were ignored."""
         if isinstance(profile_handler, str):  # path shorthand
             profile_handler = ProfileKwargs(output_trace_dir=profile_handler)
         handler = profile_handler or self.profile_handler
+        import inspect
         import jax
 
         trace_dir = handler.output_trace_dir or os.path.join(self.logging_dir or ".", "profile")
-        jax.profiler.start_trace(trace_dir, create_perfetto_trace=handler.create_perfetto_trace)
+        start_params = inspect.signature(jax.profiler.start_trace).parameters
+        kwargs = {}
+        if "create_perfetto_trace" in start_params:
+            kwargs["create_perfetto_trace"] = handler.create_perfetto_trace
+        if "create_perfetto_link" in start_params:
+            kwargs["create_perfetto_link"] = handler.create_perfetto_link
+        elif handler.create_perfetto_link:
+            _warn_dropped_profile_options(["create_perfetto_link"])
+        defaults = ProfileKwargs()
+        tracer_fields = ("host_tracer_level", "python_tracer_level", "device_tracer_level")
+        requested = [f for f in tracer_fields if getattr(handler, f) != getattr(defaults, f)]
+        options_cls = getattr(jax.profiler, "ProfileOptions", None)
+        if options_cls is not None and "profiler_options" in start_params:
+            options = options_cls()
+            for f in tracer_fields:
+                setattr(options, f, getattr(handler, f))
+            kwargs["profiler_options"] = options
+        elif requested:
+            _warn_dropped_profile_options(requested)
+        jax.profiler.start_trace(trace_dir, **kwargs)
         try:
             yield
         finally:
@@ -1568,6 +1680,26 @@ class Accelerator:
 
     def __repr__(self):
         return f"Accelerator(mesh={dict(self.mesh.shape)}, mixed_precision={self.mixed_precision!r})"
+
+
+_dropped_profile_options_warned = False
+
+
+def _warn_dropped_profile_options(fields):
+    """One warning per process for ProfileKwargs knobs this jax version
+    cannot honour (accepting-and-ignoring them silently was the old bug)."""
+    global _dropped_profile_options_warned
+    if _dropped_profile_options_warned:
+        return
+    _dropped_profile_options_warned = True
+    import jax
+
+    logger.warning(
+        "ProfileKwargs option(s) %s are not supported by jax %s's profiler "
+        "and were ignored (profiler options need jax>=0.5)",
+        ", ".join(fields),
+        jax.__version__,
+    )
 
 
 class _RemovableHandle:

@@ -37,6 +37,57 @@ BANDWIDTH_TABLE: dict[str, dict[str, float]] = {
     "v6e": {ICI: 450e9, DCN: 50e9},
 }
 
+#: Peak dense-matmul FLOP/s per chip by generation and compute dtype — the
+#: published bf16 figures (v4 275, v5e 197, v5p 459, v6e 918 TFLOP/s), int8
+#: at 2x where the generation supports it. This is the SHARED denominator
+#: for MFU: the runtime telemetry (telemetry.mfu) and any static roofline
+#: both read this table, so "peak" means the same thing everywhere.
+PEAK_FLOPS_TABLE: dict[str, dict[str, float]] = {
+    "v4": {"bf16": 275e12, "int8": 275e12},
+    "v5e": {"bf16": 197e12, "int8": 394e12},
+    "v5p": {"bf16": 459e12, "int8": 918e12},
+    "v6e": {"bf16": 918e12, "int8": 1836e12},
+}
+
+#: Per-chip HBM capacity (GB) by generation — flight-check go/no-go and the
+#: telemetry HBM-headroom report share this.
+HBM_GB_TABLE: dict[str, float] = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}
+
+
+def device_generation(device=None) -> Optional[str]:
+    """Map a jax device (default: the first local device of an
+    already-initialised backend) to a generation key of the tables above,
+    or None when unknown (CPU/GPU backends, or jax not yet imported —
+    this helper must never be the thing that initialises the backend)."""
+    kind = None
+    if device is not None:
+        kind = str(getattr(device, "device_kind", device))
+    else:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        try:
+            kind = str(getattr(jax.devices()[0], "device_kind", ""))
+        except Exception:
+            return None
+    kind = kind.lower()
+    # longest-match so "v5p" never matches a "v5e" row and vice versa
+    for gen in sorted(PEAK_FLOPS_TABLE, key=len, reverse=True):
+        if gen in kind:
+            return gen
+    if "v5litepod" in kind or "v5 lite" in kind:
+        return "v5e"
+    return None
+
+
+def peak_flops(generation: str, dtype: str = "bf16") -> float:
+    """Peak FLOP/s per device for ``generation``; unknown generations fall
+    back to v5e (the cost-optimised part — a conservative denominator)."""
+    row = PEAK_FLOPS_TABLE.get(generation, PEAK_FLOPS_TABLE["v5e"])
+    return row.get(dtype, row["bf16"])
+
 #: Collectives the traffic walk prices. Maps primitive name -> wire-bytes
 #: multiplier ``f(n)`` applied to the (per-device) operand bytes ``B`` for
 #: an axis group of size ``n``, from the standard ring algorithms:

@@ -13,6 +13,7 @@ from .launch import launch_parser
 from .lint import lint_parser
 from .merge import merge_parser
 from .migrate import migrate_parser
+from .telemetry import telemetry_parser
 from .test import test_parser
 from .tpu import tpu_command_parser
 
@@ -31,6 +32,7 @@ def main():
     flightcheck_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)
+    telemetry_parser(subparsers)
     tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)

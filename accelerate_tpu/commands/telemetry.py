@@ -1,0 +1,132 @@
+"""``accelerate-tpu telemetry`` — summarize a run's JSONL event log, or
+self-check the whole runtime-telemetry pipeline on CPU.
+
+``summarize`` parses a telemetry file (written by
+``Accelerator.telemetry`` / :class:`~accelerate_tpu.telemetry.Telemetry`)
+and renders step-time p50/p95, the data-wait/dispatch/execute split,
+compile time, recompile count (with the changed avals), MFU, goodput,
+HBM peak (observed + flight-check-predicted) and serving counters — no
+TPU, no jax required to read.
+
+``selfcheck`` runs a 5-step jitted loop on the CPU backend with the
+watchdog armed (including a deliberate shape perturbation), writes the
+JSONL, re-parses it, and asserts the summary holds what the docs promise
+— the CI gate ``make telemetry-selfcheck`` wraps.
+
+Examples::
+
+    accelerate-tpu telemetry summarize run.jsonl
+    accelerate-tpu telemetry summarize run.jsonl --format json
+    accelerate-tpu telemetry selfcheck
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def telemetry_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "telemetry", help="Summarize or self-check runtime telemetry JSONL event logs"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu telemetry")
+    sub = parser.add_subparsers(dest="telemetry_command", required=True)
+
+    p_sum = sub.add_parser("summarize", help="Render a telemetry JSONL file as a run report")
+    p_sum.add_argument("path", help="telemetry JSONL file (e.g. runs/telemetry.jsonl)")
+    p_sum.add_argument("--format", choices=("text", "json"), default="text", help="Report format")
+    p_sum.add_argument(
+        "--strict", action="store_true",
+        help="Exit nonzero when the run recorded warnings (recompiles, HBM drift)",
+    )
+    p_sum.set_defaults(telemetry_func=summarize_command)
+
+    p_check = sub.add_parser("selfcheck", help="Prove the telemetry pipeline works on the CPU backend")
+    p_check.set_defaults(telemetry_func=selfcheck_command)
+
+    if subparsers is not None:
+        parser.set_defaults(func=lambda args: args.telemetry_func(args))
+    return parser
+
+
+def summarize_command(args) -> int:
+    if not os.path.exists(args.path):
+        print(f"no such file: {args.path}")
+        return 2
+    from accelerate_tpu.telemetry import render_text, summarize_file
+
+    report = summarize_file(args.path)
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_text(report))
+    if args.strict and report.get("warnings"):
+        return 1
+    return 0
+
+
+def selfcheck_command(args) -> int:
+    """5-step CPU loop -> JSONL -> parse -> summarize; nonzero on any
+    broken link in that chain."""
+    import tempfile
+
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(1)
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.telemetry import Telemetry, read_events, render_text, summarize
+
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "run.jsonl")
+        tel = Telemetry(
+            path,
+            rank=0,
+            hbm_sample_every=1,
+            flops_per_step=2 * 64 * 64 * 64,
+            peak_flops_per_device=1e12,
+        )
+        step = tel.wrap(jax.jit(lambda x: (x @ x).sum()))
+        x = jnp.ones((64, 64), jnp.float32)
+        for _ in range(5):
+            step(x)
+        step(jnp.ones((32, 32), jnp.float32))  # post-warmup cache miss
+        tel.close()
+
+        events = read_events(path)
+        if not events:
+            failures.append("event log is empty or unparseable")
+        if any(e.get("v") != 1 or "ts" not in e or "rank" not in e for e in events):
+            failures.append("schema fields missing on some records")
+        report = summarize(events)
+        steps = report.get("steps") or {}
+        if steps.get("count") != 6:
+            failures.append(f"expected 6 step spans, got {steps.get('count')}")
+        if steps.get("recompiles") != 1:
+            failures.append(f"expected exactly 1 recompile, got {steps.get('recompiles')}")
+        if steps.get("p50_step_ms") is None or steps.get("p95_step_ms") is None:
+            failures.append("step-time percentiles missing")
+        if steps.get("compile_ms", 0) <= 0:
+            failures.append("compile attribution missing")
+        print(render_text(report))
+
+    for msg in failures:
+        print(f"[telemetry selfcheck] FAILED: {msg}")
+    if not failures:
+        print("[telemetry selfcheck] OK: log schema, step split, watchdog, summarize")
+    return 1 if failures else 0
+
+
+def main():
+    args = telemetry_parser().parse_args()
+    raise SystemExit(args.telemetry_func(args))
+
+
+if __name__ == "__main__":
+    main()

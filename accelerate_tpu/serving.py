@@ -109,9 +109,16 @@ class ServingEngine:
         pool_blocks: Optional[int] = None,
         draft_model=None,
         gamma: int = 4,
+        telemetry_log=None,
     ):
         jax = _jax()
         jnp = jax.numpy
+        # serving-side observability (TTFT, tokens/sec, queue depth, KV
+        # utilisation, preemptions + Prometheus dump); ``telemetry_log``
+        # (an EventLog) additionally mirrors snapshots into a run's JSONL
+        from .telemetry.serving_metrics import ServingMetrics
+
+        self.metrics = ServingMetrics(self, log=telemetry_log)
         self.model = model
         self.num_slots = num_slots
         self.prompt_buckets = tuple(sorted(prompt_buckets))
@@ -655,6 +662,7 @@ class ServingEngine:
         uid = self._uid
         self._uid += 1
         self.queue.append(_Request(uid, prompt, max_new_tokens, [], prefix_id, stops))
+        self.metrics.on_submit(uid)
         return uid
 
     def poll(self, uid: int):
@@ -711,10 +719,12 @@ class ServingEngine:
             if req is not None and req.uid == uid:
                 out = np.asarray(req.out_tokens, np.int32)
                 self._release(slot)
+                self.metrics.on_cancel(uid)
                 return out
         for req in list(self.queue):
             if req.uid == uid:
                 self.queue.remove(req)
+                self.metrics.on_cancel(uid)
                 return np.zeros((0,), np.int32)
         raise KeyError(f"unknown request id {uid}")
 
@@ -750,6 +760,7 @@ class ServingEngine:
                 new_ids = self._alloc.alloc((hi - lo) - len(shared_entries))
                 if new_ids is None:
                     self._pool_blocked = True
+                    self.metrics.on_pool_blocked()
                     break
                 for bid in shared_entries.values():
                     self._shared_refs[bid] += 1
@@ -815,6 +826,8 @@ class ServingEngine:
             self.slot_req[slot] = req
             req.out_tokens.append(tok)
             req.out_lps.append(float(lp))
+            self.metrics.on_first_token(req.uid)  # TTFT: prefill's tail token
+            self.metrics.on_tokens(1)
             if self._finished(req, tok):
                 self._retire(slot)
                 continue
@@ -840,6 +853,7 @@ class ServingEngine:
                 tok = int(toks_k[k, slot])
                 req.out_tokens.append(tok)
                 req.out_lps.append(float(lps_k[k, slot]))
+                self.metrics.on_tokens(1)
                 self.slot_pos[slot] += 1
                 self.slot_tok[slot] = tok
                 if self._finished(req, tok):
@@ -926,6 +940,7 @@ class ServingEngine:
                     tok = int(emits_k[k, slot, j])
                     req.out_tokens.append(tok)
                     req.out_lps.append(float(lps_k[k, slot, j]))
+                    self.metrics.on_tokens(1)
                     walked += 1
                     self.slot_pos[slot] += 1
                     self.slot_tok[slot] = tok
@@ -1003,6 +1018,7 @@ class ServingEngine:
         self._done_new[req.uid] = np.asarray(req.out_tokens, np.int32)
         self._done_lps[req.uid] = np.asarray(req.out_lps, np.float32)
         self._release(slot)
+        self.metrics.on_complete(req.uid)
 
     def _release(self, slot: int):
         """Free a slot's resources without publishing a result (shared by

@@ -1,0 +1,190 @@
+"""Runtime observability: step timeline, recompile watchdog, MFU/goodput,
+HBM sampling, serving metrics — one JSONL event stream, one summarize CLI.
+
+The static tier (``accelerate_tpu.analysis``: lint, flight-check, cost
+model) predicts what a step *should* do; this package measures what it
+*actually* does and cross-checks the two (observed peak HBM vs the
+flight-check estimate, MFU against the same per-generation peak-FLOPs
+table the cost model prices with). Quick start::
+
+    from accelerate_tpu.telemetry import Telemetry
+
+    tel = Telemetry("run.jsonl")
+    step = tel.wrap(step)             # instruments every call
+    for batch in loader:
+        loss = step(batch)
+    tel.close()
+    # then: accelerate-tpu telemetry summarize run.jsonl
+
+or, through the Accelerator (the usual path — see
+``docs/usage_guides/telemetry.md``)::
+
+    accelerator = Accelerator(kwargs_handlers=[TelemetryKwargs(...)])
+    step = accelerator.telemetry.wrap(accelerator.build_train_step(loss_fn))
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from .eventlog import SCHEMA_VERSION, EventLog, read_events
+from .mfu import (
+    HBM_GB_TABLE,
+    PEAK_FLOPS_TABLE,
+    HBMSampler,
+    device_generation,
+    flops_from_compiled,
+    goodput,
+    mfu,
+    peak_flops,
+)
+from .serving_metrics import ServingMetrics
+from .step import StepTelemetry, diff_signatures, signature_of
+from .summarize import render_text, summarize, summarize_file
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EventLog",
+    "read_events",
+    "StepTelemetry",
+    "signature_of",
+    "diff_signatures",
+    "HBMSampler",
+    "ServingMetrics",
+    "Telemetry",
+    "PEAK_FLOPS_TABLE",
+    "HBM_GB_TABLE",
+    "device_generation",
+    "peak_flops",
+    "mfu",
+    "goodput",
+    "flops_from_compiled",
+    "summarize",
+    "summarize_file",
+    "render_text",
+]
+
+
+class Telemetry:
+    """Facade bundling one :class:`EventLog`, one :class:`StepTelemetry`,
+    and one :class:`HBMSampler` for a run — what ``Accelerator.telemetry``
+    hands out.
+
+    ``hbm_sample_every=N`` samples live memory every N wrapped steps;
+    ``forward_fn`` + ``forward_every=N`` push a rolling summary dict to a
+    callback every N steps (the Accelerator wires ``Accelerator.log`` in
+    here, so step time / MFU / recompile counts land in the active
+    trackers automatically). ``static_hbm_bytes`` seeds the drift check
+    with a flight-check prediction.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        *,
+        rank: Optional[int] = None,
+        main_process_only: bool = True,
+        warmup_steps: int = 2,
+        fence: bool = True,
+        watchdog: bool = True,
+        flops_per_step: Optional[float] = None,
+        peak_flops_per_device: Optional[float] = None,
+        n_devices: int = 1,
+        hbm_sample_every: int = 10,
+        static_hbm_bytes: Optional[int] = None,
+        hbm_drift_threshold: float = 0.2,
+        forward_fn: Optional[Callable[[dict, Optional[int]], None]] = None,
+        forward_every: int = 0,
+    ):
+        self.log = EventLog(path, rank=rank, main_process_only=main_process_only)
+        self.steps = StepTelemetry(
+            self.log,
+            warmup_steps=warmup_steps,
+            fence=fence,
+            watchdog=watchdog,
+            flops_per_step=flops_per_step,
+            peak_flops_per_device=peak_flops_per_device,
+            n_devices=n_devices,
+        )
+        self.hbm = HBMSampler(
+            self.log, static_peak_bytes=static_hbm_bytes, drift_threshold=hbm_drift_threshold
+        )
+        self._hbm_sample_every = max(0, int(hbm_sample_every))
+        self._forward_fn = forward_fn
+        self._forward_every = max(0, int(forward_every))
+        self.steps.on_step = self._on_step
+
+    # -- delegation ----------------------------------------------------- #
+
+    @property
+    def path(self) -> Optional[str]:
+        return self.log.path
+
+    @property
+    def recompiles(self) -> int:
+        return self.steps.recompiles
+
+    def wrap(self, step_fn: Callable, **kwargs) -> Callable:
+        return self.steps.wrap(step_fn, **kwargs)
+
+    def step(self, batch=None, **kwargs):
+        return self.steps.step(batch, **kwargs)
+
+    def event(self, name: str, **fields) -> dict:
+        return self.log.event(name, **fields)
+
+    def set_static_hbm_estimate(self, peak_bytes: int):
+        """Attach a flight-check peak-HBM prediction after construction
+        (``Accelerator.flight_check`` calls this when telemetry is live)."""
+        self.hbm.static_peak_bytes = int(peak_bytes)
+        self.log.event("hbm_static_estimate", bytes=int(peak_bytes))
+
+    def summary(self) -> dict:
+        out = self.steps.summary()
+        if self.hbm.observed_peak_bytes:
+            out["observed_peak_hbm_bytes"] = self.hbm.observed_peak_bytes
+        if self.hbm.static_peak_bytes:
+            out["static_peak_hbm_bytes"] = int(self.hbm.static_peak_bytes)
+        return out
+
+    def flush(self):
+        self.log.flush()
+
+    def close(self):
+        self.log.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- per-step plumbing ---------------------------------------------- #
+
+    def _on_step(self, rec: dict):
+        i = rec["step"]
+        if self._hbm_sample_every and i % self._hbm_sample_every == 0:
+            self.hbm.sample()
+        if self._forward_fn is not None and self._forward_every and i > 0 and i % self._forward_every == 0:
+            recent = [r for r in list(self.steps.records)[-self._forward_every:] if not r["compile"]]
+            values = {
+                "telemetry/step_ms": round(
+                    sum(r["dur_ms"] for r in recent) / len(recent), 3
+                ) if recent else None,
+                "telemetry/data_wait_ms": round(
+                    sum(r["data_wait_ms"] for r in recent) / len(recent), 3
+                ) if recent else None,
+                "telemetry/recompiles": self.steps.recompiles,
+            }
+            mfus = [r["mfu"] for r in recent if "mfu" in r]
+            if mfus:
+                values["telemetry/mfu"] = round(sum(mfus) / len(mfus), 5)
+            if self.hbm.observed_peak_bytes:
+                values["telemetry/peak_hbm_bytes"] = self.hbm.observed_peak_bytes
+            self._forward_fn({k: v for k, v in values.items() if v is not None}, i)
+
+
+def default_path(logging_dir: Optional[str] = None) -> str:
+    """Default event-log location: ``{logging_dir}/telemetry.jsonl``."""
+    return os.path.join(logging_dir or ".", "telemetry.jsonl")

@@ -1,0 +1,197 @@
+"""Structured, rank-tagged JSONL event log — the one sink every runtime
+telemetry signal writes to.
+
+Three record kinds share one schema (``SCHEMA_VERSION``), so a single
+``accelerate-tpu telemetry summarize run.jsonl`` pass can explain a whole
+run — training steps, recompiles, HBM samples, and serving counters
+interleave in the same file:
+
+* ``span``    — a timed region: ``{"kind": "span", "name": ..., "dur_ms": ...}``
+  plus whatever fields the emitter attaches (a train step attaches its
+  data-wait / dispatch / execute split);
+* ``counter`` — a sampled value: ``{"kind": "counter", "name": ..., "value": ...}``;
+* ``event``   — a point occurrence with a severity (``info`` / ``warning`` /
+  ``error``): recompile detections, HBM-drift findings, prepare() markers.
+
+Every record carries ``ts`` (unix seconds), ``rank`` (the jax process
+index), and ``v`` (schema version). Writes are line-buffered in memory and
+flushed every ``buffer_lines`` records (and at close/atexit) — one
+``write()`` syscall per flush, so per-step overhead is a dict + a string
+append. By default only the main process writes (``main_process_only``),
+matching ``Accelerator.log``'s gating; worker ranks construct the log for
+free and every emit is a no-op there.
+
+jax is never imported at module load; the rank is resolved lazily and only
+if a ``PartialState`` singleton already exists (telemetry must not be the
+thing that initialises the backend).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+#: record kinds a well-formed telemetry line may carry
+KINDS = ("span", "counter", "event")
+
+
+def _resolve_rank() -> int:
+    """The jax process index, WITHOUT initialising the backend: use the
+    PartialState singleton if some other code already created it, else 0
+    (single-process is the overwhelmingly common case on a dev box)."""
+    try:
+        from ..state import PartialState
+
+        shared = PartialState._shared_state
+        if shared and "process_index_host" in shared:
+            return int(shared["process_index_host"])
+    except Exception:
+        pass
+    return 0
+
+
+class EventLog:
+    """Buffered JSONL writer for telemetry records.
+
+    ``path=None`` (or a non-main rank under ``main_process_only``)
+    disables writing entirely — emits become no-ops — so instrumented
+    code never needs an ``if telemetry:`` guard. ``clock`` is injectable
+    for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        *,
+        rank: Optional[int] = None,
+        main_process_only: bool = True,
+        buffer_lines: int = 64,
+        clock=time.time,
+    ):
+        self.path = path
+        self.rank = _resolve_rank() if rank is None else int(rank)
+        self._clock = clock
+        self._buffer_lines = max(1, int(buffer_lines))
+        self.enabled = path is not None and not (main_process_only and self.rank != 0)
+        self._buf: list[str] = []
+        self._closed = False
+        self._atexit_registered = False
+        if self.enabled:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            # truncate: one file == one run (summarize assumes it)
+            with open(path, "w"):
+                pass
+            atexit.register(self.close)
+            self._atexit_registered = True
+
+    # ------------------------------------------------------------------ #
+    # emit surface
+    # ------------------------------------------------------------------ #
+
+    def emit(self, kind: str, name: str, **fields) -> dict:
+        """Append one record; returns the dict (written or not) so callers
+        can reuse it for in-memory summaries."""
+        if kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        rec = {"v": SCHEMA_VERSION, "ts": self._clock(), "rank": self.rank, "kind": kind, "name": name}
+        rec.update(fields)
+        if self.enabled and not self._closed:
+            self._buf.append(json.dumps(rec, default=_json_default))
+            if len(self._buf) >= self._buffer_lines:
+                self.flush()
+        return rec
+
+    def counter(self, name: str, value, **fields) -> dict:
+        return self.emit("counter", name, value=value, **fields)
+
+    def event(self, name: str, severity: str = "info", **fields) -> dict:
+        return self.emit("event", name, severity=severity, **fields)
+
+    def span(self, name: str, **fields) -> "_Span":
+        """``with log.span("prefill"):`` — emits the span with ``dur_ms``
+        on exit. Extra ``fields`` ride along on the record."""
+        return _Span(self, name, fields)
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+
+    def flush(self):
+        if not self._buf:
+            return
+        lines, self._buf = self._buf, []
+        with open(self.path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def close(self):
+        if self._closed:
+            return
+        if self.enabled:
+            self.flush()
+        self._closed = True
+        if self._atexit_registered:
+            try:
+                atexit.unregister(self.close)
+            except Exception:
+                pass
+            self._atexit_registered = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _Span:
+    def __init__(self, log: EventLog, name: str, fields: dict):
+        self._log = log
+        self._name = name
+        self._fields = fields
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ms = (time.perf_counter() - self._t0) * 1000.0
+        self._log.emit("span", self._name, dur_ms=round(dur_ms, 3), **self._fields)
+
+
+def _json_default(obj):
+    """Last-resort coercion: numpy/jax scalars -> python numbers, arrays ->
+    their shape/dtype string (a telemetry line must never hold a tensor)."""
+    for attr in ("item",):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:
+                break
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    return repr(obj)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a telemetry JSONL file, skipping blank/corrupt lines (a run
+    killed mid-write must still summarize)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events

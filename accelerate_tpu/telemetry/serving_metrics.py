@@ -1,0 +1,201 @@
+"""Serving-side counters: TTFT, tokens/sec, queue depth, KV utilisation,
+preemptions — plus a Prometheus text-exposition dump.
+
+The :class:`~accelerate_tpu.serving.ServingEngine` drives these hooks from
+the places the events actually happen (submit, admit/first-token, decode
+walk, retire, cancel, pool-blocked admission), so the numbers are exact
+counts, not sampled approximations. Latency distributions (TTFT,
+per-request e2e) are kept in bounded deques — a long-running server's
+metrics memory is O(window), not O(requests).
+
+``prometheus_text()`` renders the standard text exposition format
+(``# HELP`` / ``# TYPE`` + samples) so a scrape endpoint is one
+``web.Response(text=engine.metrics.prometheus_text())`` away; quantiles
+are emitted as ``summary`` quantile samples over the retained window.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Optional
+
+from .eventlog import EventLog
+
+_PREFIX = "accelerate_tpu_serving"
+
+
+def _pct(values, q: float) -> Optional[float]:
+    vals = sorted(values)
+    if not vals:
+        return None
+    k = max(0, min(len(vals) - 1, int(round(q / 100.0 * (len(vals) - 1)))))
+    return vals[k]
+
+
+class ServingMetrics:
+    """Counter/latency surface for one :class:`ServingEngine`.
+
+    ``log`` (optional): mirror every snapshot to a telemetry
+    :class:`EventLog` as ``serving.*`` counters, so a serving run and a
+    training run summarize through the same CLI.
+    """
+
+    def __init__(self, engine=None, *, log: Optional[EventLog] = None, window: int = 1024, clock=time.monotonic):
+        self._engine = engine
+        self.log = log if log is not None else EventLog(None)
+        self._clock = clock
+        # monotonically increasing counters
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_cancelled = 0
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.preemptions = 0  # admission passes blocked on pool exhaustion
+        # latency windows
+        self.ttft_ms: collections.deque = collections.deque(maxlen=window)
+        self.e2e_ms: collections.deque = collections.deque(maxlen=window)
+        # per-inflight-request timing
+        self._submit_ts: dict[int, float] = {}
+        # tokens/sec over a sliding window of (ts, cumulative tokens)
+        self._token_marks: collections.deque = collections.deque(maxlen=window)
+
+    # ------------------------------------------------------------------ #
+    # engine hooks
+    # ------------------------------------------------------------------ #
+
+    def on_submit(self, uid: int):
+        self.requests_submitted += 1
+        self._submit_ts[uid] = self._clock()
+
+    def on_first_token(self, uid: int):
+        """Called when a request's first generated token lands (the tail
+        of its prefill) — the TTFT sample."""
+        self.prefills += 1
+        t0 = self._submit_ts.get(uid)
+        if t0 is not None:
+            self.ttft_ms.append((self._clock() - t0) * 1000.0)
+
+    def on_tokens(self, n: int = 1):
+        self.tokens_generated += n
+        self._token_marks.append((self._clock(), self.tokens_generated))
+
+    def on_complete(self, uid: int):
+        self.requests_completed += 1
+        t0 = self._submit_ts.pop(uid, None)
+        if t0 is not None:
+            self.e2e_ms.append((self._clock() - t0) * 1000.0)
+
+    def on_cancel(self, uid: int):
+        self.requests_cancelled += 1
+        self._submit_ts.pop(uid, None)
+
+    def on_pool_blocked(self):
+        self.preemptions += 1
+
+    # ------------------------------------------------------------------ #
+    # read surface
+    # ------------------------------------------------------------------ #
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._engine.queue) if self._engine is not None else 0
+
+    @property
+    def active_slots(self) -> int:
+        return self._engine.active_count if self._engine is not None else 0
+
+    @property
+    def kv_block_utilization(self) -> Optional[float]:
+        """Fraction of the paged pool in use (None in dense mode)."""
+        if self._engine is None or not getattr(self._engine, "paged", False):
+            return None
+        total = self._engine._pcfg.num_blocks - 1  # minus the trash sink
+        if total <= 0:
+            return 0.0
+        return 1.0 - self._engine._alloc.free_count / total
+
+    def tokens_per_sec(self, window_s: float = 10.0) -> Optional[float]:
+        """Decode throughput over the trailing ``window_s`` seconds of
+        token marks (None until two marks exist)."""
+        if len(self._token_marks) < 2:
+            return None
+        now = self._clock()
+        marks = [(ts, tot) for ts, tot in self._token_marks if now - ts <= window_s]
+        if len(marks) < 2:
+            marks = list(self._token_marks)[-2:]
+        (t0, c0), (t1, c1) = marks[0], marks[-1]
+        if t1 <= t0:
+            return None
+        return (c1 - c0) / (t1 - t0)
+
+    def snapshot(self) -> dict:
+        """One flat dict of every metric — what the event log and the
+        tracker forwarding consume."""
+        snap = {
+            "requests_submitted": self.requests_submitted,
+            "requests_completed": self.requests_completed,
+            "requests_cancelled": self.requests_cancelled,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "preemptions": self.preemptions,
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "kv_block_utilization": self.kv_block_utilization,
+            "tokens_per_sec": self.tokens_per_sec(),
+            "ttft_ms_p50": _pct(self.ttft_ms, 50),
+            "ttft_ms_p95": _pct(self.ttft_ms, 95),
+            "e2e_ms_p50": _pct(self.e2e_ms, 50),
+            "e2e_ms_p95": _pct(self.e2e_ms, 95),
+        }
+        return snap
+
+    def emit(self):
+        """Write the snapshot to the attached event log as ``serving.*``
+        counters (no-op when the log is disabled)."""
+        for name, value in self.snapshot().items():
+            if value is not None:
+                self.log.counter(f"serving.{name}", value)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (v0.0.4) of the snapshot."""
+        lines = []
+
+        def metric(name, mtype, help_text, samples):
+            lines.append(f"# HELP {_PREFIX}_{name} {help_text}")
+            lines.append(f"# TYPE {_PREFIX}_{name} {mtype}")
+            for labels, value in samples:
+                if value is None:
+                    continue
+                lines.append(f"{_PREFIX}_{name}{labels} {value:g}")
+
+        metric("requests_submitted_total", "counter", "Requests accepted by submit()",
+               [("", self.requests_submitted)])
+        metric("requests_completed_total", "counter", "Requests retired with a result",
+               [("", self.requests_completed)])
+        metric("requests_cancelled_total", "counter", "Requests cancelled mid-flight or queued",
+               [("", self.requests_cancelled)])
+        metric("tokens_generated_total", "counter", "Generated tokens across all requests",
+               [("", self.tokens_generated)])
+        metric("prefills_total", "counter", "Prompt prefills executed",
+               [("", self.prefills)])
+        metric("preemptions_total", "counter", "Admission passes blocked on KV pool exhaustion",
+               [("", self.preemptions)])
+        metric("queue_depth", "gauge", "Requests waiting for a slot",
+               [("", self.queue_depth)])
+        metric("active_slots", "gauge", "Slots currently decoding",
+               [("", self.active_slots)])
+        util = self.kv_block_utilization
+        metric("kv_block_utilization", "gauge", "Fraction of the paged KV pool in use",
+               [("", util)])
+        metric("tokens_per_sec", "gauge", "Decode throughput over the trailing window",
+               [("", self.tokens_per_sec())])
+        metric("ttft_ms", "summary", "Time to first token (ms)",
+               [('{quantile="0.5"}', _pct(self.ttft_ms, 50)),
+                ('{quantile="0.95"}', _pct(self.ttft_ms, 95)),
+                ("_count", len(self.ttft_ms))])
+        metric("e2e_ms", "summary", "Request end-to-end latency (ms)",
+               [('{quantile="0.5"}', _pct(self.e2e_ms, 50)),
+                ('{quantile="0.95"}', _pct(self.e2e_ms, 95)),
+                ("_count", len(self.e2e_ms))])
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,414 @@
+"""Step timeline: wall-time split, compile attribution, and the recompile
+watchdog.
+
+On TPU a ``step(batch)`` call is three different costs wearing one wall
+clock: the host waiting for data, the python+dispatch that enqueues the
+XLA program, and the device actually executing it. ``StepTelemetry``
+fences with ``block_until_ready`` on the step's outputs so the three are
+separable:
+
+* ``data_wait_ms`` — time between the previous step's fence completing and
+  this call starting (dataloader + host-side glue);
+* ``dispatch_ms``  — time inside the wrapped call before it returns
+  (tracing/compile on a cache miss, microseconds on a hit);
+* ``execute_ms``   — time blocked on the outputs after dispatch (device
+  compute the dispatch didn't already overlap).
+
+The first call's dispatch is attributed as **compile time** (jit blocks in
+the caller while XLA compiles), as is any later call the watchdog flags.
+
+The **recompile watchdog** is the runtime twin of the static TPU2xx lint
+rules: after ``warmup_steps`` calls, any input signature (pytree structure
++ shape/dtype per leaf) never seen before is a jit cache miss — silent
+recompiles are the classic TPU throughput killer (a drifting batch
+dimension recompiles every step). Each miss emits ONE ``recompile``
+warning event naming exactly which avals changed versus the previous call.
+When the wrapped callable exposes jit's ``_cache_size`` (``jax.jit``
+functions and ``build_train_step``'s ``step._jitted`` do), cache growth is
+cross-checked too, catching drift a shape signature can't see (e.g.
+weak-type promotion).
+
+Per-step records are kept in a bounded in-memory deque (so ``summary()``
+works with no event log at all) and mirrored to an :class:`EventLog` when
+one is attached.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Callable, Optional
+
+from .eventlog import EventLog
+
+
+def _aval_str(leaf) -> str:
+    """``f32[8,128]``-style signature for one pytree leaf."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    return type(leaf).__name__
+
+
+def signature_of(tree) -> tuple:
+    """Hashable (path, aval-string) signature of an input pytree — the
+    host-side proxy for jit's cache key. Uses jax's path flattening when
+    jax is already imported, else a plain structural walk (telemetry must
+    not initialise the backend)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        from ..parallel.sharding import path_str
+
+        return tuple((path_str(kp), _aval_str(leaf)) for kp, leaf in flat)
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(node[k], f"{path}.{k}" if path else str(k))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, f"{path}[{i}]")
+        else:
+            out.append((path, _aval_str(node)))
+
+    walk(tree, "")
+    return tuple(out)
+
+
+class _PathCachedSignature:
+    """Per-instance fast signature: path strings are computed ONCE per
+    pytree structure (treedef) and cached — the per-step cost is one
+    ``tree_flatten`` plus an aval string per leaf (~2 us for a typical
+    batch), which is what keeps the watchdog inside the <2% overhead
+    budget on small steps."""
+
+    def __init__(self):
+        self._paths: dict = {}  # treedef -> tuple of path strings
+
+    def __call__(self, tree) -> tuple:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return signature_of(tree)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        paths = self._paths.get(treedef)
+        if paths is None:
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            from ..parallel.sharding import path_str
+
+            paths = tuple(path_str(kp) for kp, _ in flat)
+            self._paths[treedef] = paths
+        return tuple(zip(paths, (_aval_str(l) for l in leaves)))
+
+
+def diff_signatures(old: Optional[tuple], new: tuple) -> list[str]:
+    """Human strings naming what changed between two input signatures."""
+    if old is None:
+        return [f"{path}: {aval} (new input)" for path, aval in new]
+    old_map, new_map = dict(old), dict(new)
+    changes = []
+    for path, aval in new:
+        prev = old_map.get(path)
+        if prev is None:
+            changes.append(f"{path}: (absent) -> {aval}")
+        elif prev != aval:
+            changes.append(f"{path}: {prev} -> {aval}")
+    for path, aval in old:
+        if path not in new_map:
+            changes.append(f"{path}: {aval} -> (absent)")
+    return changes or ["input signature unchanged (cache key drift invisible to shapes — "
+                       "likely weak_type/sharding)"]
+
+
+def _block_until_ready(out):
+    """Fence on every array leaf of ``out`` (non-arrays pass through)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    for leaf in jax.tree_util.tree_leaves(out):
+        fn = getattr(leaf, "block_until_ready", None)
+        if fn is not None:
+            fn()
+
+
+class StepTelemetry:
+    """Timeline + watchdog for a repeatedly-called step function.
+
+    Two usage shapes::
+
+        st = StepTelemetry(log)
+        step = st.wrap(step)          # fast path: instruments every call
+        ...
+        with st.step() as s:          # imperative path (accumulate block)
+            loss = accelerator.backward(loss_fn, batch)
+            s.done(loss)              # optional: what to fence on
+
+    ``flops_per_step`` + ``peak_flops_per_device`` (+ ``n_devices``) turn
+    each steady-state record into an MFU sample. ``fence=False`` drops the
+    ``block_until_ready`` (execute time then reads 0 — use when the loop
+    already fences, e.g. a ``float(loss)`` per step).
+
+    ``warmup_steps`` defaults to 2, not 1: the first call compiles, and
+    the SECOND may legitimately compile a second program variant when
+    sharding propagation re-lays-out carried state (``build_train_step``'s
+    gradient buffer comes back from step 1 with propagated shardings, a
+    different jit cache key). Anything past warmup is a real miss.
+    """
+
+    def __init__(
+        self,
+        log: Optional[EventLog] = None,
+        *,
+        warmup_steps: int = 2,
+        fence: bool = True,
+        watchdog: bool = True,
+        flops_per_step: Optional[float] = None,
+        peak_flops_per_device: Optional[float] = None,
+        n_devices: int = 1,
+        max_records: int = 4096,
+        clock=time.perf_counter,
+    ):
+        self.log = log if log is not None else EventLog(None)
+        self.warmup_steps = max(0, int(warmup_steps))
+        self.fence = fence
+        self.watchdog = watchdog
+        self.flops_per_step = flops_per_step
+        self.peak_flops_per_device = peak_flops_per_device
+        self.n_devices = max(1, int(n_devices))
+        self._clock = clock
+
+        self.step_index = 0
+        self.recompiles = 0
+        self.compile_ms = 0.0  # summed over first step + every detected miss
+        self.records: collections.deque = collections.deque(maxlen=max_records)
+        self.recompile_events: list[dict] = []
+        self._signature = _PathCachedSignature()
+        self._last_fence_end: Optional[float] = None
+        self._cm_watchdog: Optional[_WatchdogState] = None  # context-manager path's
+        self.on_step: Optional[Callable[[dict], None]] = None  # post-record hook
+
+    # ------------------------------------------------------------------ #
+    # instrumentation
+    # ------------------------------------------------------------------ #
+
+    def wrap(self, step_fn: Callable, *, name: str = "step") -> Callable:
+        """Instrumented twin of ``step_fn``; every call records one step.
+        The telemetry object rides on the wrapper as ``.telemetry``.
+
+        Watchdog state (warmup counter, seen signatures, jit cache probe)
+        is PER WRAPPER: a second wrapped function — or one wrapped after
+        imperative steps already ran — gets its own warmup, so its first
+        compiles are attributed, not misreported as recompiles."""
+        probe = step_fn if hasattr(step_fn, "_cache_size") else getattr(step_fn, "_jitted", None)
+        if probe is None or not hasattr(probe, "_cache_size"):
+            probe = None
+        wd = _WatchdogState(self.warmup_steps, probe)
+
+        def instrumented(*args, **kwargs):
+            sig = self._signature((args, kwargs)) if self.watchdog else None
+            t_enter = self._clock()
+            out = step_fn(*args, **kwargs)
+            t_done = self._clock()
+            if self.fence:
+                _block_until_ready(out)
+            t_fence = self._clock()
+            self._record(name, sig, t_enter, t_done, t_fence, wd)
+            return out
+
+        instrumented.telemetry = self
+        instrumented.__wrapped__ = step_fn
+        return instrumented
+
+    @contextlib.contextmanager
+    def step(self, batch=None, *, name: str = "step"):
+        """Context-manager form for imperative loops. ``batch`` (optional)
+        feeds the watchdog; call ``handle.done(outputs)`` to mark dispatch
+        complete and name what to fence on — otherwise the whole body
+        counts as dispatch and the fence is skipped."""
+        sig = self._signature(batch) if (self.watchdog and batch is not None) else None
+        if self._cm_watchdog is None:
+            self._cm_watchdog = _WatchdogState(self.warmup_steps, None)
+        handle = _StepHandle(self._clock)
+        t_enter = self._clock()
+        yield handle
+        t_done = handle.done_at if handle.done_at is not None else self._clock()
+        if self.fence and handle.outputs is not None:
+            _block_until_ready(handle.outputs)
+        t_fence = self._clock()
+        self._record(name, sig, t_enter, t_done, t_fence, self._cm_watchdog)
+
+    # ------------------------------------------------------------------ #
+    # internals
+    # ------------------------------------------------------------------ #
+
+    def _check_watchdog(self, wd: "_WatchdogState", sig) -> tuple[bool, list[str], bool]:
+        """(is_miss, changed-aval strings, compiled_hint) for this call
+        through the wrapper owning ``wd``. During warmup every signature
+        is learned silently (the first compile of each shape bucket is
+        expected) but a fresh signature / cache growth still flags the
+        step as a compile step, keeping the steady-state stats clean;
+        afterwards a never-seen signature — or jit cache growth with an
+        unchanged signature — is a miss."""
+        cache_grew = False
+        if wd.probe is not None:
+            try:
+                size = wd.probe._cache_size()
+                cache_grew = size > wd.probe_size
+                wd.probe_size = size
+            except Exception:
+                wd.probe = None
+        if not self.watchdog:
+            return False, [], cache_grew
+        in_warmup = wd.calls < wd.warmup
+        if sig is not None:
+            fresh = sig not in wd.seen
+            wd.seen.add(sig)
+        else:
+            fresh = False
+        if in_warmup:
+            return False, [], cache_grew or fresh
+        if sig is not None and fresh:
+            return True, diff_signatures(wd.last_sig, sig), True
+        if cache_grew:
+            # signature unchanged (or untracked) but jit still compiled
+            changed = diff_signatures(wd.last_sig, sig) if sig else [
+                "jit cache grew with no tracked input change"
+            ]
+            return True, changed, True
+        return False, [], False
+
+    def _record(self, name, sig, t_enter, t_done, t_fence, wd: "_WatchdogState"):
+        data_wait_ms = 0.0
+        if self._last_fence_end is not None:
+            data_wait_ms = max(0.0, (t_enter - self._last_fence_end) * 1000.0)
+        dispatch_ms = (t_done - t_enter) * 1000.0
+        execute_ms = (t_fence - t_done) * 1000.0
+        self._last_fence_end = t_fence
+
+        is_first = wd.calls == 0  # first call THROUGH THIS WRAPPER compiles
+        miss, changed, compiled_hint = self._check_watchdog(wd, sig)
+        if miss:
+            self.recompiles += 1
+            ev = self.log.event(
+                "recompile",
+                severity="warning",
+                step=self.step_index,
+                changed=changed,
+                count=self.recompiles,
+            )
+            self.recompile_events.append(ev)
+        is_compile = is_first or miss or compiled_hint
+        if is_compile:
+            # on a miss/first call the dispatch segment IS the compile
+            self.compile_ms += dispatch_ms
+
+        rec = {
+            "step": self.step_index,
+            "dur_ms": round(data_wait_ms + dispatch_ms + execute_ms, 3),
+            "data_wait_ms": round(data_wait_ms, 3),
+            "dispatch_ms": round(dispatch_ms, 3),
+            "execute_ms": round(execute_ms, 3),
+            "compile": is_compile,
+        }
+        if (
+            not is_compile
+            and self.flops_per_step
+            and self.peak_flops_per_device
+            and (dispatch_ms + execute_ms) > 0
+        ):
+            step_s = (dispatch_ms + execute_ms) / 1000.0
+            rec["mfu"] = round(
+                self.flops_per_step / step_s / (self.peak_flops_per_device * self.n_devices), 5
+            )
+        self.log.emit("span", name, **rec)
+        self.records.append(rec)
+        if sig is not None:
+            wd.last_sig = sig
+        wd.calls += 1
+        self.step_index += 1
+        if self.on_step is not None:
+            self.on_step(rec)
+
+    # ------------------------------------------------------------------ #
+    # summaries
+    # ------------------------------------------------------------------ #
+
+    def steady_records(self) -> list[dict]:
+        return [r for r in self.records if not r["compile"]]
+
+    def summary(self) -> dict:
+        """p50/p95 step split, compile attribution, recompiles, MFU and
+        goodput over the retained (steady-state) records."""
+        steady = self.steady_records()
+        durs = sorted(r["dur_ms"] for r in steady)
+        out = {
+            "steps": self.step_index,
+            "steady_steps": len(steady),
+            "compile_ms": round(self.compile_ms, 3),
+            "recompiles": self.recompiles,
+            "p50_step_ms": _pct(durs, 50),
+            "p95_step_ms": _pct(durs, 95),
+        }
+        if steady:
+            total = sum(r["dur_ms"] for r in steady)
+            out["mean_data_wait_ms"] = round(sum(r["data_wait_ms"] for r in steady) / len(steady), 3)
+            out["mean_dispatch_ms"] = round(sum(r["dispatch_ms"] for r in steady) / len(steady), 3)
+            out["mean_execute_ms"] = round(sum(r["execute_ms"] for r in steady) / len(steady), 3)
+            # goodput: fraction of steady wall time the device spent executing
+            # (dispatch included when unfenced loops fold execute into it)
+            busy = sum(r["dispatch_ms"] + r["execute_ms"] for r in steady)
+            out["goodput"] = round(min(1.0, busy / total), 4) if total > 0 else None
+            mfus = [r["mfu"] for r in steady if "mfu" in r]
+            if mfus:
+                out["mfu"] = round(sum(mfus) / len(mfus), 5)
+        return out
+
+
+class _WatchdogState:
+    """Per-wrapper watchdog bookkeeping: warmup counter, seen input
+    signatures, last signature (for diff naming), and the jit cache-size
+    probe. One per :meth:`StepTelemetry.wrap` call (and one shared by the
+    context-manager path) — warmup is about a PROGRAM's compile history,
+    not the run's global step count."""
+
+    __slots__ = ("warmup", "calls", "seen", "last_sig", "probe", "probe_size")
+
+    def __init__(self, warmup: int, probe=None):
+        self.warmup = warmup
+        self.calls = 0
+        self.seen: set = set()
+        self.last_sig: Optional[tuple] = None
+        self.probe = probe
+        self.probe_size = 0
+
+
+class _StepHandle:
+    """Yielded by :meth:`StepTelemetry.step`; ``done(outputs)`` marks the
+    dispatch boundary and registers what the exit fence blocks on."""
+
+    def __init__(self, clock):
+        self._clock = clock
+        self.outputs = None
+        self.done_at: Optional[float] = None
+
+    def done(self, outputs=None):
+        self.done_at = self._clock()
+        self.outputs = outputs
+        return outputs
+
+
+def _pct(sorted_vals: list, q: float) -> Optional[float]:
+    """Nearest-rank percentile (no numpy needed at summarize time)."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return round(sorted_vals[k], 3)

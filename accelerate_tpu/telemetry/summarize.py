@@ -1,0 +1,168 @@
+"""Turn a telemetry JSONL file into the run's story: step-time
+percentiles, compile vs execute vs data-wait, recompile count, MFU, HBM
+peak (observed AND statically predicted), and serving counters.
+
+This is the offline half of the subsystem — everything here works on a
+plain list of parsed records, no jax, no backend. The
+``accelerate-tpu telemetry summarize`` CLI is a thin shell over
+:func:`summarize` + :func:`render_text`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .eventlog import read_events
+
+
+def _pct(sorted_vals, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return round(sorted_vals[k], 3)
+
+
+def _mean(vals) -> Optional[float]:
+    vals = list(vals)
+    return round(sum(vals) / len(vals), 3) if vals else None
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate parsed telemetry records into one report dict. Sections
+    (``steps`` / ``hbm`` / ``serving``) appear only when the run emitted
+    the corresponding records, so training-only and serving-only files
+    both summarize cleanly."""
+    report: dict = {"events": len(events)}
+
+    steps = [e for e in events if e.get("kind") == "span" and e.get("name") == "step"]
+    if steps:
+        steady = [s for s in steps if not s.get("compile")]
+        durs = sorted(s.get("dur_ms", 0.0) for s in steady)
+        compile_ms = sum(s.get("dispatch_ms", 0.0) for s in steps if s.get("compile"))
+        recompiles = [e for e in events if e.get("kind") == "event" and e.get("name") == "recompile"]
+        mfus = [s["mfu"] for s in steady if "mfu" in s]
+        total = sum(s.get("dur_ms", 0.0) for s in steady)
+        busy = sum(s.get("dispatch_ms", 0.0) + s.get("execute_ms", 0.0) for s in steady)
+        report["steps"] = {
+            "count": len(steps),
+            "steady_count": len(steady),
+            "p50_step_ms": _pct(durs, 50),
+            "p95_step_ms": _pct(durs, 95),
+            "mean_data_wait_ms": _mean(s.get("data_wait_ms", 0.0) for s in steady),
+            "mean_dispatch_ms": _mean(s.get("dispatch_ms", 0.0) for s in steady),
+            "mean_execute_ms": _mean(s.get("execute_ms", 0.0) for s in steady),
+            "compile_ms": round(compile_ms, 3),
+            "recompiles": len(recompiles),
+            "recompile_details": [
+                {"step": e.get("step"), "changed": e.get("changed")} for e in recompiles
+            ],
+            "goodput": round(min(1.0, busy / total), 4) if total > 0 else None,
+            "mfu": round(sum(mfus) / len(mfus), 5) if mfus else None,
+        }
+
+    hbm_counters = [e for e in events if e.get("kind") == "counter" and e.get("name") == "hbm_peak_bytes"]
+    static = next(
+        (e for e in events if e.get("kind") == "event" and e.get("name") == "hbm_static_estimate"), None
+    )
+    drift = [e for e in events if e.get("kind") == "event" and e.get("name") == "hbm_drift"]
+    if hbm_counters or static:
+        observed = max((e.get("value", 0) for e in hbm_counters), default=None)
+        limits = [e.get("bytes_limit") for e in hbm_counters if e.get("bytes_limit")]
+        report["hbm"] = {
+            "observed_peak_bytes": observed,
+            "static_peak_bytes": static.get("bytes") if static else None,
+            "bytes_limit": max(limits) if limits else None,
+            "headroom_bytes": (max(limits) - observed) if (limits and observed is not None) else None,
+            "drift_events": [
+                {
+                    "observed_peak_bytes": e.get("observed_peak_bytes"),
+                    "static_peak_bytes": e.get("static_peak_bytes"),
+                    "rel_error": e.get("rel_error"),
+                }
+                for e in drift
+            ],
+        }
+
+    serving = {}
+    for e in events:
+        if e.get("kind") == "counter" and str(e.get("name", "")).startswith("serving."):
+            serving[e["name"][len("serving."):]] = e.get("value")  # last write wins
+    if serving:
+        report["serving"] = serving
+
+    warnings = [
+        e for e in events
+        if e.get("kind") == "event" and e.get("severity") in ("warning", "error")
+    ]
+    report["warnings"] = len(warnings)
+    return report
+
+
+def summarize_file(path: str) -> dict:
+    return summarize(read_events(path))
+
+
+def _human_bytes(n) -> str:
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def render_text(report: dict) -> str:
+    """Human-readable report (the ``--format text`` default)."""
+    lines = [f"telemetry summary ({report.get('events', 0)} records, "
+             f"{report.get('warnings', 0)} warnings)"]
+    steps = report.get("steps")
+    if steps:
+        lines.append("  steps:")
+        lines.append(
+            f"    step time         : p50 {steps['p50_step_ms']} ms / p95 {steps['p95_step_ms']} ms "
+            f"({steps['steady_count']} steady of {steps['count']})"
+        )
+        lines.append(
+            f"    split (mean)      : data-wait {steps['mean_data_wait_ms']} ms | "
+            f"dispatch {steps['mean_dispatch_ms']} ms | execute {steps['mean_execute_ms']} ms"
+        )
+        lines.append(f"    compile           : {steps['compile_ms']} ms")
+        lines.append(f"    recompiles        : {steps['recompiles']}")
+        for d in steps.get("recompile_details", []):
+            for change in d.get("changed") or []:
+                lines.append(f"      step {d.get('step')}: {change}")
+        if steps.get("goodput") is not None:
+            lines.append(f"    goodput           : {steps['goodput']:.1%}")
+        if steps.get("mfu") is not None:
+            lines.append(f"    MFU               : {steps['mfu']:.1%}")
+    hbm = report.get("hbm")
+    if hbm:
+        lines.append("  hbm:")
+        lines.append(f"    observed peak     : {_human_bytes(hbm['observed_peak_bytes'])}")
+        lines.append(f"    static estimate   : {_human_bytes(hbm['static_peak_bytes'])}")
+        if hbm.get("headroom_bytes") is not None:
+            lines.append(f"    headroom          : {_human_bytes(hbm['headroom_bytes'])}")
+        for d in hbm.get("drift_events", []):
+            lines.append(
+                f"    DRIFT: observed {_human_bytes(d['observed_peak_bytes'])} vs "
+                f"static {_human_bytes(d['static_peak_bytes'])} ({d['rel_error']:.0%} off)"
+            )
+    serving = report.get("serving")
+    if serving:
+        lines.append("  serving:")
+        order = (
+            "requests_submitted", "requests_completed", "requests_cancelled",
+            "tokens_generated", "tokens_per_sec", "ttft_ms_p50", "ttft_ms_p95",
+            "queue_depth", "kv_block_utilization", "preemptions",
+        )
+        for key in order:
+            if key in serving and serving[key] is not None:
+                val = serving[key]
+                lines.append(f"    {key:<18}: {val:.3f}" if isinstance(val, float) else f"    {key:<18}: {val}")
+        for key, val in serving.items():
+            if key not in order and val is not None:
+                lines.append(f"    {key:<18}: {val}")
+    if len(lines) == 1:
+        lines.append("  (no step/hbm/serving records found)")
+    return "\n".join(lines)

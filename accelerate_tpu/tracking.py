@@ -63,10 +63,19 @@ class GeneralTracker:
     main_process_only = True
 
     def __init__(self, _blank: bool = False):
-        if not _blank:
-            for attr in ("name", "requires_logging_directory"):
-                if not hasattr(self, attr):
-                    raise NotImplementedError(f"Tracker subclass must define `{attr}`")
+        """``_blank=True`` builds a NO-OP tracker (reference:
+        tracking.py:110 + ``Accelerator.get_tracker`` with no active
+        trackers) — every method accepts its arguments and does nothing,
+        so user code can call ``get_tracker(...).log(...)``
+        unconditionally."""
+        self._blank = _blank
+        if _blank:
+            self.name = ""
+            self.requires_logging_directory = False
+            return
+        for attr in ("name", "requires_logging_directory"):
+            if not hasattr(self, attr):
+                raise NotImplementedError(f"Tracker subclass must define `{attr}`")
 
     def start(self):
         """Initialise the tracking backend. Idempotence is the subclass's
@@ -74,12 +83,18 @@ class GeneralTracker:
 
     @property
     def tracker(self):
+        if getattr(self, "_blank", False):
+            return None
         raise NotImplementedError
 
     def store_init_configuration(self, values: dict):
+        if getattr(self, "_blank", False):
+            return None
         raise NotImplementedError
 
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if getattr(self, "_blank", False):
+            return None
         raise NotImplementedError
 
     def log_images(self, values: dict, step: Optional[int] = None, **kwargs):

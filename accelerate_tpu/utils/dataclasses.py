@@ -172,7 +172,14 @@ class Fp8RecipeKwargs(KwargsHandler):
 @dataclass
 class ProfileKwargs(KwargsHandler):
     """``jax.profiler`` options (reference torch.profiler kwargs:
-    utils/dataclasses.py:439-552). Traces are TensorBoard/Perfetto-viewable."""
+    utils/dataclasses.py:439-552). Traces are TensorBoard/Perfetto-viewable.
+
+    The tracer levels map to XLA profiler options
+    (``host_tracer_level`` 0-3, ``python_tracer_level`` 0/1,
+    ``device_tracer_level`` 0/1); ``Accelerator.profile`` passes them
+    through when the installed jax supports profiler options and warns
+    ONCE per process about any option it has to drop — a silently-ignored
+    knob is worse than no knob."""
 
     output_trace_dir: Optional[str] = None
     create_perfetto_link: bool = False
@@ -181,6 +188,37 @@ class ProfileKwargs(KwargsHandler):
     python_tracer_level: int = 0
     device_tracer_level: int = 1
     on_trace_ready: Optional[Callable] = None
+
+
+@dataclass
+class TelemetryKwargs(KwargsHandler):
+    """Runtime-telemetry knobs consumed by ``Accelerator.telemetry``
+    (see :mod:`accelerate_tpu.telemetry`). No reference analogue — the
+    reference has no runtime observability layer.
+
+    ``output_path=None`` writes to ``{logging_dir}/telemetry.jsonl``;
+    ``fence=False`` drops the per-step ``block_until_ready`` (the
+    data-wait/dispatch/execute split then degrades but overhead reaches
+    zero); ``forward_to_trackers_every=N`` pushes a rolling summary
+    through ``Accelerator.log`` every N steps (0 disables)."""
+
+    enabled: bool = True
+    output_path: Optional[str] = None
+    # 2, not 1: the train step's second call may legitimately compile a
+    # second program variant (sharding propagation re-lays-out the carried
+    # gradient buffer) — see StepTelemetry's docstring
+    warmup_steps: int = 2
+    fence: bool = True
+    recompile_watchdog: bool = True
+    hbm_sample_every: int = 10
+    forward_to_trackers_every: int = 10
+    main_process_only: bool = True
+
+    def __post_init__(self):
+        if self.warmup_steps < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got {self.warmup_steps}")
+        if self.hbm_sample_every < 0 or self.forward_to_trackers_every < 0:
+            raise ValueError("hbm_sample_every / forward_to_trackers_every must be >= 0")
 
 
 # ---------------------------------------------------------------------------

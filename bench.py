@@ -27,9 +27,27 @@ import traceback
 
 A100_PER_CHIP_SAMPLES_PER_SEC = 350.0
 
-# bf16 peak TFLOP/s per chip for MFU; v5e=197, v4=275, v5p=459. The driver's
-# chip is v5e-class unless told otherwise (BASELINE.json targets v5e-8).
-PEAK_BF16_TFLOPS = {"v5e": 197.0, "v4": 275.0, "v5p": 459.0, "v6e": 918.0}
+
+def _peak_bf16_tflops():
+    """bf16 peak TFLOP/s per chip for MFU, from the SAME per-generation
+    table the static cost model prices with
+    (``analysis.costmodel.PEAK_FLOPS_TABLE``) — runtime MFU and static
+    rooflines must never disagree about "peak"."""
+    from accelerate_tpu.analysis.costmodel import PEAK_FLOPS_TABLE
+
+    return {gen: row["bf16"] / 1e12 for gen, row in PEAK_FLOPS_TABLE.items()}
+
+
+def _peak_for_device(devices):
+    """(peak_tflops, device_kind string) for the attached chip; v5e (the
+    cost-optimised part) is the conservative fallback."""
+    table = _peak_bf16_tflops()
+    device_kind = getattr(devices[0], "device_kind", "unknown")
+    peak = next(
+        (v for k, v in table.items() if k in str(device_kind).lower()),
+        table["v5e"],
+    )
+    return peak, device_kind
 
 
 def _probe_backend(max_tries: int = 10, probe_timeout: int = 180, base_delay: float = 15.0):
@@ -192,6 +210,7 @@ def run_llama_bench():
     step = accelerator.build_train_step(lambda p, b: causal_lm_loss(p, b, model.apply_fn))
 
     rng = np.random.default_rng(0)
+    from accelerate_tpu.telemetry import StepTelemetry
 
     @find_executable_batch_size(starting_batch_size=start_batch)
     def measure(batch_size):
@@ -200,28 +219,28 @@ def run_llama_bench():
             "input_ids": rng.integers(5, cfg.vocab_size - 1, size=(global_batch, seq_len)).astype(np.int32)
         }
         batch = jax.device_put(batch, batch_sharding(accelerator.mesh))
-        t_compile = time.perf_counter()
-        float(step(batch))  # compile; also surfaces OOM for the auto-halver
-        compile_s = time.perf_counter() - t_compile
+        # fresh telemetry per attempt: an OOM-halved retry changes the batch
+        # shape, which must read as a new run, not a recompile storm
+        telem = StepTelemetry(warmup_steps=2)
+        tstep = telem.wrap(step)
+        float(tstep(batch))  # compile (telemetry attributes it); surfaces OOM for the auto-halver
         for _ in range(2):
-            loss = step(batch)
+            loss = tstep(batch)
         float(loss)
         n_steps = 5 if tiny else 12
         t0 = time.perf_counter()
         for _ in range(n_steps):
-            loss = step(batch)
+            loss = tstep(batch)
         float(loss)
         dt = time.perf_counter() - t0
-        return global_batch, dt / n_steps, compile_s
+        return global_batch, dt / n_steps, telem
 
-    global_batch, step_s, compile_s = measure()
+    global_batch, step_s, telem = measure()
     tokens_per_sec = global_batch * seq_len / step_s
+    telem_summary = telem.summary()
+    compile_s = telem.compile_ms / 1000.0
 
-    device_kind = getattr(devices[0], "device_kind", "unknown")
-    peak = next(
-        (v for k, v in PEAK_BF16_TFLOPS.items() if k in str(device_kind).lower()),
-        PEAK_BF16_TFLOPS["v5e"],
-    )
+    peak, device_kind = _peak_for_device(devices)
     flops_per_step = _llama_step_flops(model.params, global_batch, seq_len, cfg)
     mfu = flops_per_step / step_s / (peak * 1e12 * n_dev)
 
@@ -233,6 +252,8 @@ def run_llama_bench():
                 "unit": "tokens/sec",
                 "vs_baseline": round(mfu / 0.45, 3),  # target: MFU >= 0.45 at seq 2048
                 "step_time_ms": round(step_s * 1000, 2),
+                "p95_step_ms": telem_summary.get("p95_step_ms"),
+                "recompiles": telem.recompiles,
                 "mfu": round(mfu, 4),
                 "global_batch": global_batch,
                 "seq_len": seq_len,
@@ -294,11 +315,27 @@ def run_bench():
     }
     batch = jax.device_put(batch, batch_sharding(accelerator.mesh))
 
+    # Step telemetry replaces the hand-rolled compile/execute split: the
+    # first call's dispatch is attributed as compile, every later call
+    # fences on its outputs, and the recompile watchdog proves the steady
+    # loop really replays ONE program (a silent recompile here would
+    # invalidate the whole samples/sec claim).
+    from accelerate_tpu.telemetry import StepTelemetry
+
+    peak, device_kind = _peak_for_device(devices)
+    flops_per_step = _bert_step_flops(model.params, global_batch, seq_len)
+    telem = StepTelemetry(
+        warmup_steps=2,
+        flops_per_step=flops_per_step,
+        peak_flops_per_device=peak * 1e12,
+        n_devices=n_dev,
+    )
+    step = telem.wrap(step)
+
     # compile + warmup; float(loss) both synchronises (scalar D2H fetch)
     # and surfaces NaNs immediately.
-    t_compile = time.perf_counter()
     float(step(batch))
-    compile_s = time.perf_counter() - t_compile
+    compile_s = telem.compile_ms / 1000.0
     for _ in range(3):
         loss = step(batch)
     float(loss)
@@ -314,13 +351,8 @@ def run_bench():
     step_time_ms = dt / n_steps * 1000
     samples_per_sec = global_batch * n_steps / dt
     per_chip = samples_per_sec / n_dev
+    telem_summary = telem.summary()
 
-    device_kind = getattr(devices[0], "device_kind", "unknown")
-    peak = next(
-        (v for k, v in PEAK_BF16_TFLOPS.items() if k in str(device_kind).lower()),
-        PEAK_BF16_TFLOPS["v5e"],
-    )
-    flops_per_step = _bert_step_flops(model.params, global_batch, seq_len)
     mfu = flops_per_step / (dt / n_steps) / (peak * 1e12 * n_dev)
 
     print(
@@ -331,6 +363,8 @@ def run_bench():
                 "unit": "samples/sec",
                 "vs_baseline": round(per_chip / A100_PER_CHIP_SAMPLES_PER_SEC, 3),
                 "step_time_ms": round(step_time_ms, 2),
+                "p95_step_ms": telem_summary.get("p95_step_ms"),
+                "recompiles": telem.recompiles,
                 "per_chip_samples_per_sec": round(per_chip, 1),
                 "mfu": round(mfu, 4),
                 "peak_bf16_tflops_assumed": peak,

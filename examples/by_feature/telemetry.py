@@ -1,0 +1,53 @@
+"""Runtime telemetry: step timeline, recompile watchdog, HBM sampling,
+and the summarize CLI (docs/usage_guides/telemetry.md).
+
+Trains the tiny regression task with telemetry armed, deliberately
+perturbs the batch shape once so the recompile watchdog fires, then
+summarizes the run's JSONL in-process (the same report
+``accelerate-tpu telemetry summarize`` prints).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.telemetry import render_text, summarize_file
+from accelerate_tpu.utils import TelemetryKwargs
+
+from _common import make_task
+
+
+def main():
+    with tempfile.TemporaryDirectory() as run_dir:
+        accelerator = Accelerator(
+            project_dir=run_dir,
+            kwargs_handlers=[TelemetryKwargs(hbm_sample_every=5, forward_to_trackers_every=0)],
+        )
+        model, optimizer, dataloader, loss_fn = make_task(accelerator)
+        step = accelerator.telemetry.wrap(accelerator.build_train_step(loss_fn))
+
+        for _ in range(4):
+            for batch in dataloader:
+                step(batch)
+
+        # a drifting batch shape is the classic silent-recompile bug the
+        # watchdog exists for — provoke it once, on purpose
+        bad_batch = {k: np.asarray(v)[:-1] for k, v in batch.items()}
+        step(bad_batch)
+
+        accelerator.telemetry.close()
+        path = os.path.join(run_dir, "telemetry.jsonl")
+        report = summarize_file(path)
+        accelerator.print(render_text(report))
+
+        assert report["steps"]["recompiles"] == 1, report["steps"]
+        assert report["steps"]["p95_step_ms"] is not None
+        accelerator.print(
+            f"watchdog caught the shape drift: {report['steps']['recompile_details'][0]['changed']}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,12 @@ MODULES = [
     "accelerate_tpu.analysis.flightcheck",
     "accelerate_tpu.analysis.costmodel",
     "accelerate_tpu.analysis.report",
+    "accelerate_tpu.telemetry",
+    "accelerate_tpu.telemetry.eventlog",
+    "accelerate_tpu.telemetry.step",
+    "accelerate_tpu.telemetry.mfu",
+    "accelerate_tpu.telemetry.serving_metrics",
+    "accelerate_tpu.telemetry.summarize",
     "accelerate_tpu.models",
 ]
 

