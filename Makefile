@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint flight-check telemetry-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint flight-check telemetry-selfcheck ft-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -39,6 +39,7 @@ lint:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
+	-$(MAKE) --no-print-directory ft-selfcheck
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake
@@ -52,6 +53,12 @@ flight-check:
 # summarize CLI agree end to end.
 telemetry-selfcheck:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli telemetry selfcheck
+
+# Fault tolerance: seeded good/uncommitted/corrupt/recoverable checkpoint
+# fixtures -> prove manifest verify (crc32 + sizes), discovery walk-back,
+# tmp GC/recovery, and protected pruning classify every one correctly.
+ft-selfcheck:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli checkpoints verify --selfcheck
 
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi

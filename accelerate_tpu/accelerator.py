@@ -86,13 +86,17 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
     ):
         # kwargs handlers (reference: accelerator.py:415-452)
-        from .utils.dataclasses import TelemetryKwargs
+        from .utils.dataclasses import FaultToleranceKwargs, TelemetryKwargs
 
         self.autocast_handler = AutocastKwargs()
         self.scaler_handler = GradScalerKwargs()
         self.profile_handler = ProfileKwargs()
         self.init_handler = DistributedInitKwargs()
         self.telemetry_handler = TelemetryKwargs()
+        self.ft_handler = FaultToleranceKwargs()
+        # opt-in behaviors (signal handlers, tracker retries) only activate
+        # when the user passed the handler explicitly
+        self._ft_explicit = False
         self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, AutocastKwargs):
@@ -105,6 +109,9 @@ class Accelerator:
                 self.init_handler = handler
             elif isinstance(handler, TelemetryKwargs):
                 self.telemetry_handler = handler
+            elif isinstance(handler, FaultToleranceKwargs):
+                self.ft_handler = handler
+                self._ft_explicit = True
             else:
                 from .utils.dataclasses import Fp8RecipeKwargs, MixedPrecisionPolicy
 
@@ -206,6 +213,24 @@ class Accelerator:
 
         # runtime telemetry (lazy — see the `telemetry` property)
         self._telemetry = None
+
+        # fault tolerance (docs/usage_guides/fault_tolerance.md): the
+        # checkpoint a run resumed from (protected from pruning), the
+        # one-final-checkpoint latch, and the preemption handler
+        self._resumed_from: Optional[str] = None
+        self._preempt_checkpointed = False
+        self._preemption = None
+        if self._ft_explicit and self.ft_handler.handle_preemption:
+            from .ft.preemption import PreemptionHandler
+
+            def _on_preempt(signame: str):
+                if self._telemetry is not None:
+                    self._telemetry.log.event("preempt", severity="warning", signal=signame)
+
+            self._preemption = PreemptionHandler(
+                signals=self.ft_handler.preemption_signals, on_preempt=_on_preempt
+            )
+            self._preemption.install()
 
         self.flag_tensor = None
 
@@ -1484,13 +1509,23 @@ class Accelerator:
             }
 
     def save_state(self, output_dir: Optional[str] = None, **save_model_func_kwargs):
-        """``async_save=True`` returns once device->host copies finish;
-        disk writes continue in the background (drained by
-        :meth:`wait_for_checkpoint` or the next save/load)."""
+        """Atomic checkpoint save (tmp-dir write -> barrier -> manifest ->
+        rename; see ``docs/usage_guides/fault_tolerance.md``).
+
+        ``async_save=True`` returns once device->host copies finish;
+        disk writes AND the commit continue in the background (drained by
+        :meth:`wait_for_checkpoint` or the next save/load). Under
+        preemption the async request is demoted to a synchronous save —
+        the grace window is for committing, not for queueing."""
         from .checkpointing import save_accelerator_state
 
+        if self.preempted:
+            save_model_func_kwargs.pop("async_save", None)
         self._sync_loss_scale_to_host()
-        return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+        out = save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+        if self.preempted:
+            self._preempt_checkpointed = True
+        return out
 
     def wait_for_checkpoint(self):
         """Block until pending ``save_state(async_save=True)`` writes commit."""
@@ -1499,11 +1534,64 @@ class Accelerator:
         wait_for_checkpoint()
 
     def load_state(self, input_dir: Optional[str] = None, **load_model_func_kwargs):
+        """Restore a checkpoint. With ``input_dir=None``, **auto-resume**:
+        find the newest checkpoint whose integrity manifest verifies under
+        ``{project_dir}/checkpoints`` (walking back past corrupt or
+        uncommitted ones), restore it, and continue the ``checkpoint_N``
+        numbering from there."""
         from .checkpointing import load_accelerator_state
 
         out = load_accelerator_state(self, input_dir, **load_model_func_kwargs)
         self._seed_loss_scale_to_device()
         return out
+
+    @property
+    def checkpoint_manager(self):
+        """A :class:`~accelerate_tpu.ft.CheckpointManager` over this
+        project's automatic-naming checkpoint directory (``None`` without
+        a ``project_dir``)."""
+        if self.project_dir is None:
+            return None
+        from .ft.manager import CheckpointManager
+
+        return CheckpointManager(
+            os.path.join(self.project_dir, self.project_configuration.checkpoints_dir_name)
+        )
+
+    # ------------------------------------------------------------------ #
+    # preemption (docs/usage_guides/fault_tolerance.md; no reference
+    # analogue — the reference dies with the SIGTERM)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def preemption_handler(self):
+        """The installed :class:`~accelerate_tpu.ft.PreemptionHandler`, or
+        ``None`` (pass ``FaultToleranceKwargs()`` to install one)."""
+        return self._preemption
+
+    @property
+    def preempted(self) -> bool:
+        """True once SIGTERM/SIGINT was received (always False without a
+        preemption handler)."""
+        return self._preemption is not None and self._preemption.preempted
+
+    @property
+    def should_checkpoint(self) -> bool:
+        """True when a preemption signal arrived and the final synchronous
+        checkpoint has not been taken yet — check after each step::
+
+            if accelerator.should_checkpoint:
+                accelerator.save_state()   # drains async saves, saves sync
+            if accelerator.should_stop:
+                break
+        """
+        return self.preempted and not self._preempt_checkpointed
+
+    @property
+    def should_stop(self) -> bool:
+        """True once preemption was signalled: exit the training loop at
+        the next step boundary (after the :attr:`should_checkpoint` save)."""
+        return self.preempted
 
     def save_model(self, model, save_directory: str, max_shard_size="10GB", safe_serialization: bool = True):
         from .checkpointing import save_model as _save_model
@@ -1580,9 +1668,42 @@ class Accelerator:
         return GeneralTracker(_blank=True)
 
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = {}):
-        if self.is_main_process:
-            for tracker in self.trackers:
-                tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
+        if not self.is_main_process:
+            return
+        retries = self.ft_handler.tracker_retries if self._ft_explicit else 1
+        for tracker in self.trackers:
+            kw = log_kwargs.get(tracker.name, {})
+            if retries <= 1:
+                tracker.log(values, step=step, **kw)
+                continue
+            # FT mode: a tracker backend hiccup (wandb 5xx, mlflow timeout)
+            # is retried with backoff and, on giveup, logged and swallowed —
+            # metrics loss must not kill a multi-hour run
+            from .utils.retry import retry_call
+
+            def _on_retry(attempt, delay, exc, _name=tracker.name):
+                if self._telemetry is not None:
+                    self._telemetry.log.event(
+                        "tracker_retry", severity="warning", tracker=_name,
+                        attempt=attempt, delay_s=round(delay, 3), error=str(exc),
+                    )
+
+            try:
+                retry_call(
+                    tracker.log, values, step=step,
+                    attempts=retries,
+                    base_delay=self.ft_handler.retry_base_delay,
+                    max_delay=self.ft_handler.retry_max_delay,
+                    exceptions=(Exception,),
+                    on_retry=_on_retry,
+                    **kw,
+                )
+            except Exception as e:
+                logger.warning(f"tracker {tracker.name}.log failed after {retries} attempts: {e}")
+                if self._telemetry is not None:
+                    self._telemetry.log.event(
+                        "tracker_giveup", severity="error", tracker=tracker.name, error=str(e)
+                    )
 
     def _media_trackers(self, method: str):
         """Active trackers that override ``method`` beyond the base class

@@ -18,6 +18,7 @@ checkpoint_dir/
   custom_checkpoint_0.pkl
   rng_state_0.pkl     # per-process host RNG (reference: per-rank RNG :152)
   accelerate_state.json
+  commit_success.json # integrity manifest — the COMMIT marker (ft/manifest.py)
 ```
 
 Sharded arrays are saved/restored with orbax (async-capable, multi-host
@@ -25,6 +26,19 @@ aware: every host writes only its addressable shards — the TPU-native
 equivalent of FSDP's sharded DCP state dicts, reference:
 utils/fsdp_utils.py:101-412). ``save_model`` exports a consolidated
 safetensors file set with ``max_shard_size`` splitting like the reference.
+
+**Atomic commit protocol** (no reference analogue — the survive-any-SIGTERM
+story of Orbax's distributed checkpointing design, see
+``docs/usage_guides/fault_tolerance.md``): every save writes into
+``<dir>.tmp/``, all hosts barrier, the main process writes the
+``commit_success.json`` manifest (per-file sizes + crc32) and renames to
+the final name. A crash at ANY point leaves either (a) a ``.tmp`` dir
+without a manifest — invisible to discovery, removed by GC — or (b) a
+fully committed checkpoint. ``total_limit`` pruning runs strictly AFTER
+the new checkpoint commits and never touches the checkpoint the run
+resumed from, so the newest valid checkpoint can never be lost. The
+labeled :func:`~accelerate_tpu.ft.crashpoints.crash_point` calls are
+no-ops in production and crash sites under the fault-injection tests.
 """
 
 from __future__ import annotations
@@ -40,7 +54,10 @@ from typing import Optional
 
 import numpy as np
 
+from .ft.crashpoints import crash_point
+from .ft.manifest import TMP_SUFFIX, build_manifest, write_manifest
 from .logging import get_logger
+from .utils.retry import retry_call
 
 logger = get_logger(__name__)
 
@@ -63,67 +80,105 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-# in-flight async checkpointers; drained by wait_for_checkpoint() and
-# before any subsequent save/load touches the same process
-_PENDING_ASYNC: list = []
+class _PendingSave:
+    """One in-flight ``save_state(..., async_save=True)``: its background
+    orbax checkpointers plus the commit/abort actions. The COMMIT (manifest
+    write + rename + pruning) is deferred until every array write has
+    finished — a half-written async checkpoint must never look committed."""
+
+    def __init__(self, checkpointers: list, finalize=None, abort=None):
+        self.checkpointers = checkpointers
+        self.finalize = finalize
+        self.abort = abort
+
+    def drain(self):
+        """Wait out every checkpointer (closing each even on error), then
+        run ``finalize`` on full success or ``abort`` on any failure.
+        Returns the first exception instead of raising so the caller can
+        sweep every pending save before propagating."""
+        first_error = None
+        for ckptr in self.checkpointers:
+            try:
+                ckptr.wait_until_finished()
+            except Exception as e:  # noqa: PERF203
+                if first_error is None:
+                    first_error = e
+            finally:
+                # close even when the wait raised: an unclosed checkpointer
+                # leaks its background thread/executor
+                try:
+                    ckptr.close()
+                except Exception as e:
+                    if first_error is None:
+                        first_error = e
+        try:
+            if first_error is None:
+                if self.finalize is not None:
+                    self.finalize()
+            elif self.abort is not None:
+                self.abort(first_error)
+        except Exception as e:
+            if first_error is None:
+                first_error = e
+        return first_error
+
+
+# in-flight async saves; drained by wait_for_checkpoint() and before any
+# subsequent save/load touches the same process
+_PENDING_ASYNC: list[_PendingSave] = []
 _ATEXIT_REGISTERED = False
 
 
 def wait_for_checkpoint():
     """Block until every async ``save_state(..., async_save=True)`` has
-    committed to disk (the orbax analogue of torch.distributed.checkpoint's
-    async_save future; the reference has no async checkpoint path). Safe to
-    call when nothing is pending."""
+    fully COMMITTED (array writes done, manifest written, directory renamed
+    into place — the orbax analogue of torch.distributed.checkpoint's
+    async_save future; the reference has no async checkpoint path). A save
+    whose background write failed is aborted: its ``.tmp`` directory is
+    removed so nothing can ever mistake it for a checkpoint, and the first
+    error propagates after the sweep. Safe to call when nothing is pending."""
     global _PENDING_ASYNC
     pending, _PENDING_ASYNC = _PENDING_ASYNC, []
-    # drain every checkpointer even if one raises (a lost entry would let a
-    # later save/load touch a checkpoint still being written); the first
-    # error propagates after the sweep
     first_error = None
-    for ckptr in pending:
-        try:
-            ckptr.wait_until_finished()
-        except Exception as e:  # noqa: PERF203
-            if first_error is None:
-                first_error = e
-        finally:
-            # close even when the wait raised: an unclosed checkpointer
-            # leaks its background thread/executor
-            try:
-                ckptr.close()
-            except Exception as e:
-                if first_error is None:
-                    first_error = e
+    for save in pending:
+        err = save.drain()
+        if err is not None and first_error is None:
+            first_error = err
     if first_error is not None:
         raise first_error
 
 
-def _save_pytree(tree, path: Path, async_save: bool = False):
+def _register_drain_atexit():
+    global _ATEXIT_REGISTERED
+    if _ATEXIT_REGISTERED:
+        return
+    # a script whose last action is an async save must still commit.
+    # Plain atexit is too late: CPython runs threading._shutdown
+    # (which stops concurrent.futures executors) BEFORE atexit
+    # callbacks, so orbax's background commit would die with
+    # "cannot schedule new futures after shutdown". The threading
+    # atexit hooks run before that shutdown.
+    import atexit
+    import threading
+
+    try:
+        threading._register_atexit(wait_for_checkpoint)
+    except Exception:  # very late in shutdown — best effort
+        atexit.register(wait_for_checkpoint)
+    _ATEXIT_REGISTERED = True
+
+
+def _save_pytree(tree, path: Path, async_group: Optional[list] = None):
     import orbax.checkpoint as ocp
 
-    if async_save:
+    if async_group is not None:
         # one AsyncCheckpointer per pytree: device->host copies happen now
         # (so training can step on donated buffers immediately), disk IO
         # proceeds on a background thread until wait_for_checkpoint()
         ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         ckptr.save(path.absolute(), args=ocp.args.StandardSave(tree), force=True)
-        global _ATEXIT_REGISTERED
-        if not _ATEXIT_REGISTERED:
-            # a script whose last action is an async save must still commit.
-            # Plain atexit is too late: CPython runs threading._shutdown
-            # (which stops concurrent.futures executors) BEFORE atexit
-            # callbacks, so orbax's background commit would die with
-            # "cannot schedule new futures after shutdown". The threading
-            # atexit hooks run before that shutdown.
-            import atexit
-            import threading
-
-            try:
-                threading._register_atexit(wait_for_checkpoint)
-            except Exception:  # very late in shutdown — best effort
-                atexit.register(wait_for_checkpoint)
-            _ATEXIT_REGISTERED = True
-        _PENDING_ASYNC.append(ckptr)
+        _register_drain_atexit()
+        async_group.append(ckptr)
         return
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path.absolute(), tree, force=True)
@@ -162,93 +217,262 @@ def _load_pytree(path: Path, like, mesh=None):
         return ckptr.restore(path.absolute(), abstract)
 
 
+def _telemetry_log(accelerator):
+    """The live telemetry EventLog, or None. Reads the private slot on
+    purpose: checkpointing must not be the thing that instantiates
+    telemetry (the ``Accelerator.telemetry`` property is lazy)."""
+    tel = getattr(accelerator, "_telemetry", None)
+    return tel.log if tel is not None else None
+
+
+def _retry_cfg(accelerator, log, what: str) -> dict:
+    """Retry policy for checkpoint filesystem IO, from the accelerator's
+    ``FaultToleranceKwargs``; retry/giveup land in the telemetry event log
+    (``ckpt_retry`` warnings) so a run report shows every absorbed blip."""
+    h = getattr(accelerator, "ft_handler", None)
+
+    def on_retry(attempt, delay, exc):
+        logger.warning(f"checkpoint IO retry {attempt} for {what}: {exc}")
+        if log is not None:
+            log.event("ckpt_retry", severity="warning", what=what, attempt=attempt,
+                      delay_s=round(delay, 3), error=str(exc))
+
+    def on_giveup(attempt, exc):
+        if log is not None:
+            log.event("ckpt_giveup", severity="error", what=what, attempts=attempt, error=str(exc))
+
+    return dict(
+        attempts=h.io_retries if h is not None else 3,
+        base_delay=h.retry_base_delay if h is not None else 0.1,
+        max_delay=h.retry_max_delay if h is not None else 5.0,
+        on_retry=on_retry,
+        on_giveup=on_giveup,
+    )
+
+
+def _commit_checkpoint(accelerator, tmp: Path, final: Path, iteration: Optional[int]):
+    """The commit half of the atomic save protocol: all-host barrier ->
+    main process writes the integrity manifest into the tmp dir (THE
+    commit point — a manifest is only ever written once every host's
+    shards are durably on disk) -> rename to the final name -> post-commit
+    ``total_limit`` pruning that never touches the new checkpoint or the
+    one this run resumed from."""
+    log = _telemetry_log(accelerator)
+    accelerator.wait_for_everyone()
+    if accelerator.is_main_process:
+        manifest = build_manifest(
+            tmp,
+            step=accelerator.step,
+            iteration=iteration,
+            num_processes=accelerator.num_processes,
+        )
+        retry_call(write_manifest, tmp, manifest, **_retry_cfg(accelerator, log, "manifest"))
+        crash_point("pre_rename")
+        if final.exists():
+            # overwriting an explicit output_dir: swap via a side name so a
+            # crash leaves either the old committed dir or the new one,
+            # never a hole
+            old = final.with_name(final.name + ".old" + TMP_SUFFIX)
+            if old.exists():
+                shutil.rmtree(old)
+            final.rename(old)
+            tmp.rename(final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            tmp.rename(final)
+    accelerator.wait_for_everyone()
+    if log is not None:
+        log.event("ckpt_commit", dir=str(final), iteration=iteration, step=accelerator.step)
+
+    # pruning moved to AFTER commit (the reference prunes before writing —
+    # a crash in that window loses both the old and the new checkpoint)
+    project = accelerator.project_configuration
+    if (
+        project.automatic_checkpoint_naming
+        and accelerator.is_main_process
+        and project.total_limit is not None
+    ):
+        from .ft.manager import CheckpointManager
+
+        protect = [final]
+        resumed_from = getattr(accelerator, "_resumed_from", None)
+        if resumed_from:
+            protect.append(resumed_from)
+        CheckpointManager(final.parent).prune(project.total_limit, protect=protect)
+    logger.info(f"Saved accelerator state to {final}")
+
+
+def _abort_checkpoint(accelerator, tmp: Path, error):
+    """A background async write failed: the ``.tmp`` directory holds a
+    partial, never-committed state — remove it so no discovery or human
+    ever mistakes it for a checkpoint, and flag the event."""
+    log = _telemetry_log(accelerator)
+    logger.error(f"async checkpoint save to {tmp} FAILED ({error}); removing partial directory")
+    if log is not None:
+        log.event("ckpt_abort", severity="error", dir=str(tmp), error=str(error))
+    if accelerator.is_main_process:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def save_accelerator_state(
     accelerator, output_dir: Optional[str] = None, safe_serialization: bool = True, async_save: bool = False
 ):
     """(reference: Accelerator.save_state accelerator.py:3308 +
     checkpointing.save_accelerator_state :61).
 
+    Atomic: writes into ``<output_dir>.tmp``, barriers, writes the
+    ``commit_success.json`` manifest, renames. A kill at any instant
+    leaves the previous checkpoints untouched and the partial one
+    invisible to discovery (``docs/usage_guides/fault_tolerance.md``).
+
     ``async_save=True`` returns once device->host copies are done; array
-    writes continue on background threads (call
+    writes AND the commit continue in the background (call
     :func:`wait_for_checkpoint` or let the next save/load drain them).
     The reference has no async path — this is the orbax-native upgrade."""
     wait_for_checkpoint()  # a previous async save must fully commit first
     project = accelerator.project_configuration
+    iteration = None
     if project.automatic_checkpoint_naming:
-        base = os.path.join(accelerator.project_dir or ".", "checkpoints")
-        output_dir = os.path.join(base, f"checkpoint_{project.iteration}")
-        # total_limit pruning (reference: accelerator.py:3350-3365)
-        if accelerator.is_main_process and project.total_limit is not None and os.path.isdir(base):
-            existing = sorted(
-                (d for d in os.listdir(base) if d.startswith("checkpoint_")),
-                key=lambda d: int(d.split("_")[-1]),
-            )
-            while len(existing) + 1 > project.total_limit:
-                victim = existing.pop(0)
-                shutil.rmtree(os.path.join(base, victim), ignore_errors=True)
+        base = os.path.join(accelerator.project_dir or ".", project.checkpoints_dir_name)
+        iteration = project.iteration
+        output_dir = os.path.join(base, f"checkpoint_{iteration}")
     if output_dir is None:
         raise ValueError("output_dir is required unless automatic_checkpoint_naming is enabled")
-    out = Path(output_dir)
+    final = Path(output_dir)
+    tmp = final.with_name(final.name + TMP_SUFFIX)
+    log = _telemetry_log(accelerator)
+    rcfg = _retry_cfg(accelerator, log, "state files")
+
+    crash_point("pre_write")
     if accelerator.is_main_process:
-        out.mkdir(parents=True, exist_ok=True)
+        if project.automatic_checkpoint_naming and getattr(accelerator, "ft_handler", None) is not None \
+                and accelerator.ft_handler.gc_tmp_on_save:
+            # sweep stale .tmp leftovers of older crashed saves (recovering
+            # any fully committed one) BEFORE creating our own
+            from .ft.manager import CheckpointManager
+
+            CheckpointManager(final.parent).gc()
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
     accelerator.wait_for_everyone()
 
     for hook in accelerator._save_model_hooks:
-        hook(accelerator._models, [], str(out))
+        hook(accelerator._models, [], str(tmp))
 
-    # models + optimizers: sharded orbax saves (every host participates)
-    for i, model in enumerate(accelerator._models):
-        _save_pytree(model.params, out / f"{MODEL_NAME}_{i}" if i > 0 else out / MODEL_NAME, async_save)
-        # non-trainable mutable collections (BatchNorm running stats —
-        # build_train_step(has_state=True)); torch carries these as module
-        # buffers inside the state_dict, here they are a separate pytree
-        if getattr(model, "state", None) is not None:
-            _save_pytree(model.state, out / f"{MODEL_NAME}_state_{i}", async_save)
-    for i, opt in enumerate(accelerator._optimizers):
-        if opt.opt_state is not None:
-            _save_pytree(opt.opt_state, out / f"{OPTIMIZER_NAME}_{i}" if i > 0 else out / OPTIMIZER_NAME, async_save)
+    async_group: Optional[list] = [] if async_save else None
+    with (log.span("ckpt_save", dir=str(final), async_save=async_save) if log is not None
+          else _null_cm()):
+        # models + optimizers: sharded orbax saves (every host participates)
+        for i, model in enumerate(accelerator._models):
+            _save_pytree(model.params, tmp / f"{MODEL_NAME}_{i}" if i > 0 else tmp / MODEL_NAME, async_group)
+            crash_point("mid_pytree")
+            # non-trainable mutable collections (BatchNorm running stats —
+            # build_train_step(has_state=True)); torch carries these as module
+            # buffers inside the state_dict, here they are a separate pytree
+            if getattr(model, "state", None) is not None:
+                _save_pytree(model.state, tmp / f"{MODEL_NAME}_state_{i}", async_group)
+        for i, opt in enumerate(accelerator._optimizers):
+            if opt.opt_state is not None:
+                _save_pytree(
+                    opt.opt_state, tmp / f"{OPTIMIZER_NAME}_{i}" if i > 0 else tmp / OPTIMIZER_NAME, async_group
+                )
 
-    if accelerator.is_main_process:
-        for i, sched in enumerate(accelerator._schedulers):
-            (out / f"{SCHEDULER_NAME}_{i}.json").write_text(json.dumps(sched.state_dict()))
-        # dataloader positions incl. exact mid-epoch offset (reference:
-        # StatefulDataLoader state dicts, checkpointing.py:139-143)
-        samplers = [dl.state_dict() if hasattr(dl, "state_dict") else {} for dl in accelerator._dataloaders]
-        (out / "samplers.json").write_text(json.dumps(samplers))
-        for i, obj in enumerate(accelerator._custom_objects):
-            with open(out / f"custom_checkpoint_{i}.pkl", "wb") as f:
-                pickle.dump(obj.state_dict(), f)
-        meta = {
-            "step": accelerator.step,
-            "save_iteration": project.iteration,
-            "loss_scale": accelerator._loss_scale,
-            "mixed_precision": accelerator.mixed_precision,
+        if accelerator.is_main_process:
+            for i, sched in enumerate(accelerator._schedulers):
+                retry_call((tmp / f"{SCHEDULER_NAME}_{i}.json").write_text, json.dumps(sched.state_dict()), **rcfg)
+            # dataloader positions incl. exact mid-epoch offset (reference:
+            # StatefulDataLoader state dicts, checkpointing.py:139-143)
+            samplers = [dl.state_dict() if hasattr(dl, "state_dict") else {} for dl in accelerator._dataloaders]
+            retry_call((tmp / "samplers.json").write_text, json.dumps(samplers), **rcfg)
+            for i, obj in enumerate(accelerator._custom_objects):
+                retry_call(_pickle_to, tmp / f"custom_checkpoint_{i}.pkl", obj.state_dict(), **rcfg)
+            meta = {
+                "step": accelerator.step,
+                "save_iteration": iteration if iteration is not None else project.iteration,
+                "loss_scale": accelerator._loss_scale,
+                "mixed_precision": accelerator.mixed_precision,
+            }
+            retry_call((tmp / "accelerate_state.json").write_text, json.dumps(meta), **rcfg)
+
+        # per-process host RNG (reference: checkpointing.py:152-175)
+        from .utils.random import get_seed
+
+        rng_states = {
+            "python": random.getstate(),
+            "numpy": np.random.get_state(),
+            "seed": get_seed(),
         }
-        (out / "accelerate_state.json").write_text(json.dumps(meta))
+        retry_call(_pickle_to, tmp / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl", rng_states, **rcfg)
 
-    # per-process host RNG (reference: checkpointing.py:152-175)
-    from .utils.random import get_seed
+    # the NAME is now reserved; the commit below (or at drain time for
+    # async) stamps `iteration` into the manifest, and load_accelerator_state
+    # restores the counter from the checkpoint it resumes from
+    if project.automatic_checkpoint_naming:
+        project.iteration += 1
 
-    rng_states = {
-        "python": random.getstate(),
-        "numpy": np.random.get_state(),
-        "seed": get_seed(),
-    }
-    with open(out / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl", "wb") as f:
-        pickle.dump(rng_states, f)
+    crash_point("pre_manifest")
+    if async_save:
+        _PENDING_ASYNC.append(
+            _PendingSave(
+                async_group,
+                finalize=lambda: _commit_checkpoint(accelerator, tmp, final, iteration),
+                abort=lambda err: _abort_checkpoint(accelerator, tmp, err),
+            )
+        )
+        return str(final)
+    _commit_checkpoint(accelerator, tmp, final, iteration)
+    return str(final)
 
-    project.iteration += 1
-    accelerator.wait_for_everyone()
-    logger.info(f"Saved accelerator state to {out}")
-    return str(out)
+
+def _pickle_to(path: Path, obj):
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
 
 
-def load_accelerator_state(accelerator, input_dir: str, **kwargs):
+class _null_cm:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwargs):
     """(reference: Accelerator.load_state accelerator.py:3474 +
     checkpointing.load_accelerator_state :179). Restores onto the *current*
     shardings — loading a checkpoint saved on a different mesh reshards
-    transparently (reference needs FULL_STATE_DICT / merge tooling)."""
+    transparently (reference needs FULL_STATE_DICT / merge tooling).
+
+    ``input_dir=None`` **auto-resumes**: garbage-collects orphaned ``.tmp``
+    dirs (finishing any interrupted rename), walks back from the newest
+    ``checkpoint_N`` to the newest one whose integrity manifest verifies,
+    and restores from it — including ``project.iteration``, so the resumed
+    run's next save lands on ``checkpoint_{N+1}`` instead of overwriting
+    ``checkpoint_0``. Requires ``automatic_checkpoint_naming``."""
     wait_for_checkpoint()  # never read past a checkpoint still being written
+    project = accelerator.project_configuration
+    if input_dir is None:
+        from .ft.manager import CheckpointManager
+
+        if not project.automatic_checkpoint_naming or accelerator.project_dir is None:
+            raise ValueError(
+                "load_state() auto-resume requires ProjectConfiguration("
+                "project_dir=..., automatic_checkpoint_naming=True); otherwise pass input_dir"
+            )
+        base = os.path.join(accelerator.project_dir, project.checkpoints_dir_name)
+        mgr = CheckpointManager(base)
+        if accelerator.is_main_process:
+            mgr.gc()
+        accelerator.wait_for_everyone()
+        h = getattr(accelerator, "ft_handler", None)
+        target = mgr.latest(deep=h.verify_on_resume if h is not None else True)
+        if target is None:
+            raise FileNotFoundError(f"auto-resume found no valid checkpoint under {base}")
+        input_dir = str(target)
+        log = _telemetry_log(accelerator)
+        if log is not None:
+            log.event("ckpt_auto_resume", dir=input_dir)
     inp = Path(input_dir)
     if not inp.is_dir():
         raise FileNotFoundError(f"checkpoint directory {input_dir} not found")
@@ -299,17 +523,28 @@ def load_accelerator_state(accelerator, input_dir: str, **kwargs):
         meta = json.loads(meta_path.read_text())
         accelerator.step = meta.get("step", 0)
         accelerator._loss_scale = meta.get("loss_scale", accelerator._loss_scale)
+        if meta.get("save_iteration") is not None:
+            # restore the automatic-naming counter (the seed wrote
+            # save_iteration but never read it back, so EVERY resumed run
+            # started again at checkpoint_0 and overwrote history)
+            project.iteration = int(meta["save_iteration"]) + 1
     rng_path = inp / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"
     if rng_path.exists():
         with open(rng_path, "rb") as f:
             rng_states = pickle.load(f)
         random.setstate(rng_states["python"])
         np.random.set_state(rng_states["numpy"])
-        if rng_states.get("seed") is not None:
-            from .utils.random import set_seed
+        # the JAX key-derivation seed comes back too — but NOT via
+        # set_seed, which would reseed python/numpy and destroy the exact
+        # stream positions just restored above
+        from .utils.random import restore_seed_for_keys
 
-            set_seed(rng_states["seed"])
+        restore_seed_for_keys(rng_states.get("seed"))
+    # pruning must never delete the checkpoint this run restored from
+    # until a newer one has committed
+    accelerator._resumed_from = str(inp.resolve())
     logger.info(f"Loaded accelerator state from {inp}")
+    return str(inp)
 
 
 def _parse_size(size) -> int:

@@ -1,0 +1,257 @@
+"""``accelerate-tpu checkpoints`` — inspect, verify, and garbage-collect
+the ``checkpoint_N`` family a run writes under its project directory.
+
+Runs entirely on manifests (``commit_success.json``): no jax, no orbax,
+no TPU needed — safe to point at a live run's directory from a login
+node. See ``docs/usage_guides/fault_tolerance.md``.
+
+Examples::
+
+    accelerate-tpu checkpoints list runs/my_run/checkpoints
+    accelerate-tpu checkpoints verify runs/my_run/checkpoints --format json
+    accelerate-tpu checkpoints verify runs/my_run/checkpoints/checkpoint_7
+    accelerate-tpu checkpoints gc runs/my_run/checkpoints --dry-run
+    accelerate-tpu checkpoints verify --selfcheck   # CI gate (make ft-selfcheck)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from pathlib import Path
+
+
+def checkpoints_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "checkpoints", help="List, verify, or garbage-collect checkpoint directories"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu checkpoints")
+    sub = parser.add_subparsers(dest="checkpoints_command", required=True)
+
+    p_list = sub.add_parser("list", help="List committed/in-flight checkpoints with validity")
+    p_list.add_argument("base_dir", help="the checkpoints/ directory of a run")
+    p_list.add_argument("--format", choices=("text", "json"), default="text")
+    p_list.add_argument("--deep", action="store_true", help="full size+crc32 verification per entry")
+    p_list.set_defaults(checkpoints_func=list_command)
+
+    p_verify = sub.add_parser("verify", help="Deep integrity check (manifest sizes + crc32)")
+    p_verify.add_argument(
+        "path", nargs="?", help="one checkpoint_N dir, or a checkpoints/ base dir (verifies all)"
+    )
+    p_verify.add_argument("--format", choices=("text", "json"), default="text")
+    p_verify.add_argument("--shallow", action="store_true", help="manifest presence/parse only")
+    p_verify.add_argument(
+        "--selfcheck", action="store_true",
+        help="prove discovery/verify/gc classify seeded good/uncommitted/corrupt fixtures",
+    )
+    p_verify.set_defaults(checkpoints_func=verify_command)
+
+    p_gc = sub.add_parser(
+        "gc", help="Recover committed .tmp dirs (interrupted renames) and delete partial ones"
+    )
+    p_gc.add_argument("base_dir", help="the checkpoints/ directory of a run")
+    p_gc.add_argument("--dry-run", action="store_true", help="report without touching disk")
+    p_gc.add_argument("--format", choices=("text", "json"), default="text")
+    p_gc.set_defaults(checkpoints_func=gc_command)
+
+    if subparsers is not None:
+        parser.set_defaults(func=lambda args: args.checkpoints_func(args))
+    return parser
+
+
+def _describe(mgr, path: Path, deep: bool) -> dict:
+    from accelerate_tpu.ft.manifest import read_manifest
+
+    result = mgr.verify(path, deep=deep)
+    manifest = result.manifest or read_manifest(path) or {}
+    return {
+        "name": path.name,
+        "valid": result.ok,
+        "step": manifest.get("step"),
+        "iteration": manifest.get("iteration"),
+        "problems": result.problems,
+    }
+
+
+def list_command(args) -> int:
+    from accelerate_tpu.ft.manager import CheckpointManager
+    from accelerate_tpu.ft.manifest import TMP_SUFFIX, verify_manifest
+
+    if not os.path.isdir(args.base_dir):
+        print(f"no such directory: {args.base_dir}")
+        return 2
+    mgr = CheckpointManager(args.base_dir)
+    rows = [_describe(mgr, d, args.deep) for d in mgr.all_checkpoints()]
+    for tmp in mgr.tmp_dirs():
+        recoverable = not verify_manifest(tmp, deep=True)
+        rows.append({
+            "name": tmp.name,
+            "valid": False,
+            "state": "recoverable (committed, rename interrupted)" if recoverable else "uncommitted partial",
+        })
+    if args.format == "json":
+        print(json.dumps({"base_dir": args.base_dir, "checkpoints": rows}, indent=2))
+        return 0
+    if not rows:
+        print(f"no checkpoints under {args.base_dir}")
+        return 0
+    for row in rows:
+        if row["name"].endswith(TMP_SUFFIX):
+            print(f"  {row['name']:<24} {row['state']}")
+        else:
+            status = "valid" if row["valid"] else f"INVALID ({'; '.join(row['problems'][:2])})"
+            step = f"step={row['step']}" if row.get("step") is not None else ""
+            print(f"  {row['name']:<24} {status:<40} {step}")
+    return 0
+
+
+def verify_command(args) -> int:
+    if args.selfcheck:
+        return selfcheck_command(args)
+    if not args.path:
+        print("verify: a path is required (or --selfcheck)")
+        return 2
+    from accelerate_tpu.ft.manager import CheckpointManager
+    from accelerate_tpu.ft.manifest import MANIFEST_NAME
+
+    deep = not args.shallow
+    path = Path(args.path)
+    if not path.is_dir():
+        print(f"no such directory: {path}")
+        return 2
+    # a single checkpoint carries (or should carry) a manifest; a base dir
+    # holds checkpoint_N children
+    is_single = (path / MANIFEST_NAME).exists() or not any(
+        child.name.startswith("checkpoint_") for child in path.iterdir() if child.is_dir()
+    )
+    mgr = CheckpointManager(path.parent if is_single else path)
+    targets = [path] if is_single else mgr.all_checkpoints()
+    results = [_describe(mgr, t, deep) for t in targets]
+    failed = [r for r in results if not r["valid"]]
+    if args.format == "json":
+        print(json.dumps({"results": results, "ok": not failed}, indent=2))
+    else:
+        for r in results:
+            mark = "OK " if r["valid"] else "BAD"
+            print(f"[{mark}] {r['name']}" + ("" if r["valid"] else f": {'; '.join(r['problems'][:3])}"))
+    return 1 if failed else 0
+
+
+def gc_command(args) -> int:
+    from accelerate_tpu.ft.manager import CheckpointManager
+
+    if not os.path.isdir(args.base_dir):
+        print(f"no such directory: {args.base_dir}")
+        return 2
+    report = CheckpointManager(args.base_dir).gc(dry_run=args.dry_run)
+    if args.format == "json":
+        print(json.dumps({**report, "dry_run": args.dry_run}, indent=2))
+        return 0
+    verb = ("would recover", "would remove") if args.dry_run else ("recovered", "removed")
+    for name in report["recovered"]:
+        print(f"{verb[0]} committed checkpoint from interrupted rename: {name}")
+    for name in report["removed"]:
+        print(f"{verb[1]} partial checkpoint: {name}")
+    if not report["recovered"] and not report["removed"]:
+        print("nothing to collect")
+    return 0
+
+
+def selfcheck_command(args) -> int:
+    """Seed good / corrupt / truncated / uncommitted / recoverable fixture
+    checkpoints (plain files — no jax) and assert discovery, verify, gc,
+    and prune classify every one correctly. The ``make ft-selfcheck`` CI
+    gate wraps this."""
+    import pickle
+    import shutil
+    import tempfile
+
+    from accelerate_tpu.ft.manager import CheckpointManager
+    from accelerate_tpu.ft.manifest import TMP_SUFFIX, build_manifest, write_manifest
+    from accelerate_tpu.test_utils.fault_injection import corrupt_file
+
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str):
+        if not cond:
+            failures.append(msg)
+
+    def seed(base: Path, n: int, committed: bool = True, step: int = 0) -> Path:
+        d = base / (f"checkpoint_{n}" if committed else f"checkpoint_{n}{TMP_SUFFIX}")
+        (d / "model").mkdir(parents=True)
+        (d / "model" / "array_data.bin").write_bytes(os.urandom(256))
+        (d / "accelerate_state.json").write_text(json.dumps({"step": step, "save_iteration": n}))
+        with open(d / "rng_state_0.pkl", "wb") as f:
+            pickle.dump({"seed": 42}, f)
+        write_manifest(d, build_manifest(d, step=step, iteration=n))
+        return d
+
+    with tempfile.TemporaryDirectory() as tmp:
+        base = Path(tmp) / "checkpoints"
+        good = seed(base, 0, step=10)
+        corrupt = seed(base, 1, step=20)
+        corrupt_file(corrupt / "accelerate_state.json", mode="garbage")
+        truncated = seed(base, 2, step=30)
+        corrupt_file(truncated / "model" / "array_data.bin", mode="truncate")
+        partial = base / f"checkpoint_3{TMP_SUFFIX}"  # crashed mid-write: no manifest
+        (partial / "model").mkdir(parents=True)
+        (partial / "model" / "array_data.bin").write_bytes(os.urandom(64))
+        recoverable = seed(base, 4, committed=False, step=50)  # crashed pre-rename
+
+        mgr = CheckpointManager(base)
+        check(len(mgr.all_checkpoints()) == 3, "expected 3 committed-named checkpoints")
+        check(len(mgr.tmp_dirs()) == 2, "expected 2 .tmp dirs")
+        check([p.name for p in mgr.all_valid(deep=True)] == ["checkpoint_0"],
+              "deep all_valid should keep only the good checkpoint")
+        latest = mgr.latest(deep=True)
+        check(latest is not None and latest.name == "checkpoint_0",
+              "latest() must walk back past the corrupt and truncated checkpoints")
+        check(any("crc32" in p for p in mgr.verify(corrupt).problems),
+              "garbled file must fail crc32")
+        check(any("size mismatch" in p for p in mgr.verify(truncated).problems),
+              "truncated file must fail the size check")
+
+        dry = mgr.gc(dry_run=True)
+        check(recoverable.exists() and partial.exists(), "dry-run gc must not touch disk")
+        check("checkpoint_4.tmp" in dry["recovered"], "dry-run gc must flag the recoverable tmp")
+        report = mgr.gc()
+        check("checkpoint_4.tmp" in report["recovered"], "gc must recover the committed tmp")
+        check("checkpoint_3.tmp" in report["removed"], "gc must remove the partial tmp")
+        check((base / "checkpoint_4").is_dir() and not partial.exists(), "gc on-disk result wrong")
+        latest = mgr.latest(deep=True)
+        check(latest is not None and latest.name == "checkpoint_4",
+              "after recovery the rescued checkpoint is the newest valid one")
+
+        removed = mgr.prune(total_limit=2, protect=[good])
+        names = {p.name for p in removed}
+        check("checkpoint_0" not in names, "prune must never touch a protected checkpoint")
+        check("checkpoint_1" in names, "prune should drop the oldest unprotected checkpoint")
+        check(good.exists(), "protected checkpoint deleted from disk")
+
+        try:
+            shutil.rmtree(base / "checkpoint_4" / "model")
+            check(not mgr.verify(base / "checkpoint_4").ok, "losing a pytree dir must fail verify")
+        except OSError as e:
+            failures.append(f"fixture teardown failed: {e}")
+
+    for msg in failures:
+        print(f"[checkpoints selfcheck] FAILED: {msg}")
+    if not failures:
+        print(
+            "[checkpoints selfcheck] OK: manifest commit/verify (crc32, sizes), "
+            "discovery skips corrupt+uncommitted, gc recovers interrupted renames, "
+            "prune honors protection"
+        )
+    return 1 if failures else 0
+
+
+def main():
+    args = checkpoints_parser().parse_args()
+    raise SystemExit(args.checkpoints_func(args))
+
+
+if __name__ == "__main__":
+    main()

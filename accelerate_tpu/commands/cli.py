@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import argparse
 
+from .checkpoints import checkpoints_parser
 from .config import config_parser
 from .env import env_parser
 from .estimate import estimate_parser
@@ -33,6 +34,7 @@ def main():
     merge_parser(subparsers)
     migrate_parser(subparsers)
     telemetry_parser(subparsers)
+    checkpoints_parser(subparsers)
     tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)

@@ -1,0 +1,59 @@
+"""Fault tolerance: atomic checkpoint commits, integrity manifests,
+preemption-safe auto-resume, and fault-injection crash points.
+
+TPU pods are preemptible and multi-host saves are not atomic — a kill
+mid-save must never leave a checkpoint that a later
+``Accelerator.load_state()`` mistakes for a complete one, and pruning
+must never delete the last good checkpoint before a new one has
+committed. This package provides the pieces ``checkpointing.py`` builds
+its atomic commit protocol from (the in-repo analogue of Orbax's
+distributed checkpointing design, PAPERS.md arXiv 2605.23066):
+
+* :mod:`~accelerate_tpu.ft.manifest` — the ``commit_success.json``
+  schema: per-file sizes + crc32 digests written by the main process
+  only after every host has finished writing; its presence IS the
+  commit point.
+* :mod:`~accelerate_tpu.ft.manager` — :class:`CheckpointManager`:
+  discovery that skips uncommitted/corrupt directories, deep
+  ``verify()``, ``gc()`` of orphaned ``.tmp`` dirs (recovering fully
+  written ones), and post-commit ``prune()`` that never touches the
+  resume source.
+* :mod:`~accelerate_tpu.ft.preemption` — :class:`PreemptionHandler`:
+  SIGTERM/SIGINT -> a flag surfaced as ``Accelerator.should_checkpoint``
+  / ``Accelerator.should_stop`` so the loop takes one final synchronous
+  checkpoint and exits cleanly.
+* :mod:`~accelerate_tpu.ft.crashpoints` — the labeled points inside the
+  save path that :mod:`accelerate_tpu.test_utils.fault_injection` kills
+  at, proving resume always lands on a valid checkpoint.
+
+See ``docs/usage_guides/fault_tolerance.md``.
+"""
+
+from .crashpoints import CRASH_POINTS, crash_point, set_crash_hook
+from .manifest import (
+    MANIFEST_NAME,
+    MANIFEST_SCHEMA_VERSION,
+    TMP_SUFFIX,
+    build_manifest,
+    read_manifest,
+    verify_manifest,
+    write_manifest,
+)
+from .manager import CheckpointManager, VerifyResult
+from .preemption import PreemptionHandler
+
+__all__ = [
+    "CRASH_POINTS",
+    "crash_point",
+    "set_crash_hook",
+    "MANIFEST_NAME",
+    "MANIFEST_SCHEMA_VERSION",
+    "TMP_SUFFIX",
+    "build_manifest",
+    "write_manifest",
+    "read_manifest",
+    "verify_manifest",
+    "CheckpointManager",
+    "VerifyResult",
+    "PreemptionHandler",
+]

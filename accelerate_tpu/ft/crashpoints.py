@@ -1,0 +1,45 @@
+"""Labeled crash points inside the checkpoint save path.
+
+``save_accelerator_state`` calls :func:`crash_point` at every state
+transition of the atomic commit protocol. In production the calls are
+free (one ``is None`` check); under test,
+:class:`accelerate_tpu.test_utils.fault_injection.CrashPoint` installs a
+hook that raises (or kills the process) at a chosen label — driving the
+crash-at-every-point matrix that proves resume always lands on a valid
+checkpoint.
+
+The labels, in save order:
+
+* ``pre_write``   — before anything touches disk (no ``.tmp`` dir yet)
+* ``mid_pytree``  — after the first sharded pytree write (tmp dir holds
+  a partial array set)
+* ``pre_manifest``— all data written, barrier passed, manifest not yet
+  written (tmp dir complete but uncommitted)
+* ``pre_rename``  — manifest written (COMMITTED) but the tmp dir not yet
+  renamed to its final name (recoverable by ``CheckpointManager.gc``)
+* ``mid_prune``   — new checkpoint visible, ``total_limit`` pruning in
+  progress
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+#: every labeled point, in the order the save path reaches them
+CRASH_POINTS = ("pre_write", "mid_pytree", "pre_manifest", "pre_rename", "mid_prune")
+
+_hook: Optional[Callable[[str], None]] = None
+
+
+def set_crash_hook(hook: Optional[Callable[[str], None]]):
+    """Install (or clear, with ``None``) the process-wide crash hook.
+    Test-only machinery — production code never sets a hook."""
+    global _hook
+    _hook = hook
+
+
+def crash_point(label: str):
+    """Invoke the crash hook, if any, with ``label``. Called by the save
+    path at each protocol transition; a no-op unless a hook is installed."""
+    if _hook is not None:
+        _hook(label)

@@ -2,6 +2,7 @@
 src/accelerate/test_utils): fixtures, decorators, self-checking scripts."""
 
 from .training import RegressionDataset, RegressionModel, linear_loss_fn
+from .fault_injection import CrashPoint, SimulatedCrash, corrupt_file
 from .testing import (
     AccelerateTestCase,
     TempDirTestCase,

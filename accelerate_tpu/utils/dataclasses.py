@@ -221,6 +221,44 @@ class TelemetryKwargs(KwargsHandler):
             raise ValueError("hbm_sample_every / forward_to_trackers_every must be >= 0")
 
 
+@dataclass
+class FaultToleranceKwargs(KwargsHandler):
+    """Fault-tolerance knobs (see :mod:`accelerate_tpu.ft` and
+    ``docs/usage_guides/fault_tolerance.md``). No reference analogue —
+    the reference has no preemption/atomic-commit layer.
+
+    Passing this handler to ``Accelerator(kwargs_handlers=[...])`` also
+    *activates* the opt-in behaviors: the SIGTERM/SIGINT preemption
+    handler (``handle_preemption``) and retried tracker network calls
+    (``tracker_retries``). The atomic commit protocol itself is always
+    on — correctness is not opt-in — these knobs only tune its retries
+    and GC."""
+
+    #: install a PreemptionHandler so SIGTERM/SIGINT surface as
+    #: ``Accelerator.should_checkpoint`` / ``should_stop``
+    handle_preemption: bool = True
+    preemption_signals: tuple = ("SIGTERM", "SIGINT")
+    #: jittered-exponential-backoff attempts for checkpoint filesystem IO
+    io_retries: int = 3
+    retry_base_delay: float = 0.1
+    retry_max_delay: float = 5.0
+    #: retried attempts for tracker ``log`` network calls (giving up logs a
+    #: warning instead of killing the run); 1 disables
+    tracker_retries: int = 3
+    #: sweep stale ``checkpoint_*.tmp`` leftovers at the start of each
+    #: automatic-naming save (recovering any fully committed one)
+    gc_tmp_on_save: bool = True
+    #: deep-verify manifests (sizes + crc32) during auto-resume discovery;
+    #: False trusts manifest presence alone (faster on huge checkpoints)
+    verify_on_resume: bool = True
+
+    def __post_init__(self):
+        if self.io_retries < 1 or self.tracker_retries < 1:
+            raise ValueError("io_retries / tracker_retries must be >= 1")
+        if self.retry_base_delay < 0 or self.retry_max_delay < self.retry_base_delay:
+            raise ValueError("need 0 <= retry_base_delay <= retry_max_delay")
+
+
 # ---------------------------------------------------------------------------
 # Plugins
 # ---------------------------------------------------------------------------
@@ -263,6 +301,9 @@ class ProjectConfiguration(KwargsHandler):
     total_limit: Optional[int] = None
     iteration: int = 0
     save_on_each_node: bool = False
+    #: subdirectory of ``project_dir`` holding the ``checkpoint_N`` family
+    #: (save, auto-resume, and ``Accelerator.checkpoint_manager`` all use it)
+    checkpoints_dir_name: str = "checkpoints"
 
     def set_directories(self, project_dir: Optional[str] = None):
         self.project_dir = project_dir

@@ -43,6 +43,16 @@ def get_seed() -> Optional[int]:
     return _GLOBAL_SEED
 
 
+def restore_seed_for_keys(seed: Optional[int]) -> None:
+    """Restore the recorded seed for JAX key derivation WITHOUT reseeding
+    the host RNGs. Checkpoint load uses this: python/numpy states are
+    restored bit-exactly from the pickle, so a ``set_seed`` here would
+    clobber their positions back to the start of the stream."""
+    global _GLOBAL_SEED
+    if seed is not None:
+        _GLOBAL_SEED = seed
+
+
 def root_key():
     """The process-identical root PRNG key (requires prior ``set_seed``)."""
     import jax

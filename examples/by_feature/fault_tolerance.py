@@ -1,0 +1,68 @@
+"""Preemption-safe training: auto-resume + SIGTERM-to-final-checkpoint
+(docs/usage_guides/fault_tolerance.md; no reference analogue).
+
+Run it twice against the same project dir to see auto-resume pick up
+exactly where the first run stopped; send the process SIGTERM mid-run to
+see the final synchronous checkpoint + clean exit.
+"""
+
+import tempfile
+
+from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu.utils import FaultToleranceKwargs
+
+from _common import final_weights, make_task
+
+
+def train(project_dir: str, max_steps: int = 24) -> int:
+    accelerator = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=3
+        ),
+        kwargs_handlers=[FaultToleranceKwargs()],  # installs the SIGTERM/SIGINT handler
+    )
+    model, optimizer, dataloader, loss_fn = make_task(accelerator)
+    step = accelerator.build_train_step(loss_fn)
+
+    try:
+        accelerator.load_state()  # auto-resume: newest checkpoint that verifies
+        accelerator.print(f"resumed at step {accelerator.step}")
+    except FileNotFoundError:
+        accelerator.print("no checkpoint found; starting fresh")
+
+    while accelerator.step < max_steps:
+        for batch in dataloader:
+            step(batch)
+            if accelerator.step % 8 == 0:
+                accelerator.save_state(async_save=True)  # background commit
+            if accelerator.should_checkpoint:  # preemption notice arrived
+                accelerator.save_state()  # drains async saves; commits synchronously
+            if accelerator.should_stop or accelerator.step >= max_steps:
+                break
+        if accelerator.should_stop:
+            accelerator.print("preempted — final checkpoint committed, exiting cleanly")
+            break
+
+    accelerator.wait_for_checkpoint()
+    return accelerator.step
+
+
+def main():
+    with tempfile.TemporaryDirectory() as project_dir:
+        # first run: train half way, as if the pod were reclaimed after
+        reached = train(project_dir, max_steps=12)
+        print(f"first run stopped at step {reached}")
+
+        # 'restarted' run: auto-resumes from the newest valid checkpoint
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        reached = train(project_dir, max_steps=24)
+        print(f"second run finished at step {reached}")
+        assert reached >= 24
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,12 @@ MODULES = [
     "accelerate_tpu.ops.moe",
     "accelerate_tpu.ops.fp8",
     "accelerate_tpu.ops.qdense",
+    "accelerate_tpu.ft.manifest",
+    "accelerate_tpu.ft.manager",
+    "accelerate_tpu.ft.preemption",
+    "accelerate_tpu.ft.crashpoints",
+    "accelerate_tpu.test_utils.fault_injection",
+    "accelerate_tpu.utils.retry",
     "accelerate_tpu.utils.dataclasses",
     "accelerate_tpu.utils.operations",
     "accelerate_tpu.utils.lora",

@@ -319,3 +319,35 @@ def test_async_save_drained_by_next_load(tmp_path):
     acc.save_state(ck, async_save=True)
     acc.load_state(ck)  # no explicit wait: load drains the pending save
     assert float(model.params["a"]) == 3.25
+
+
+def test_every_save_writes_commit_manifest(tmp_path):
+    """Atomic protocol: a committed checkpoint always carries a verifying
+    commit_success.json, and the .tmp staging dir is gone."""
+    from accelerate_tpu.ft.manifest import MANIFEST_NAME, verify_manifest
+
+    acc = Accelerator()
+    train_some(acc, steps=1)
+    out = acc.save_state(str(tmp_path / "ckpt"))
+    assert (tmp_path / "ckpt" / MANIFEST_NAME).exists()
+    assert verify_manifest(out, deep=True) == []
+    assert not (tmp_path / "ckpt.tmp").exists()
+
+
+def test_explicit_dir_overwrite_stays_atomic(tmp_path):
+    """Saving twice to the same explicit output_dir swaps atomically: the
+    second save fully replaces the first and still verifies."""
+    from accelerate_tpu.ft.manifest import read_manifest, verify_manifest
+
+    acc = Accelerator()
+    model, _, _ = train_some(acc, steps=1)
+    ck = str(tmp_path / "ckpt")
+    acc.save_state(ck)
+    first_step = read_manifest(ck)["step"]
+    model.params = {k: v + 1 for k, v in model.params.items()}
+    acc.step += 5
+    acc.save_state(ck)
+    assert verify_manifest(ck, deep=True) == []
+    assert read_manifest(ck)["step"] == first_step + 5
+    leftovers = [p.name for p in (tmp_path).iterdir() if p.name != "ckpt"]
+    assert leftovers == [], f"swap left debris: {leftovers}"

@@ -1,0 +1,474 @@
+"""Fault-tolerance tests: atomic commit protocol, crash-at-every-point
+matrix, integrity manifests, auto-resume, preemption, retries.
+
+The invariant under test (ISSUE 4 acceptance): for every labeled crash
+point during save and for corrupt/truncated checkpoint files,
+``Accelerator.load_state()`` auto-resume restores a bit-exact valid
+state (step, params, opt_state, sampler position, RNG) from the newest
+committed checkpoint, and no code path ever deletes the last valid
+checkpoint before a new one commits.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu.ft import (
+    CRASH_POINTS,
+    CheckpointManager,
+    PreemptionHandler,
+    build_manifest,
+    read_manifest,
+    verify_manifest,
+    write_manifest,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.test_utils import (
+    CrashPoint,
+    RegressionDataset,
+    RegressionModel,
+    SimulatedCrash,
+    corrupt_file,
+    linear_loss_fn,
+)
+from accelerate_tpu.utils import FaultToleranceKwargs
+from accelerate_tpu.utils.retry import backoff_delays, retry, retry_call
+
+BATCH = {"x": np.ones((8,), np.float32), "y": 2 * np.ones((8,), np.float32)}
+
+
+def _reset():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _fresh(project_dir, total_limit=None, with_loader=False, **acc_kwargs):
+    """A 'new process': reset the singletons and build a full training
+    setup with automatic checkpoint naming."""
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(project_dir), automatic_checkpoint_naming=True, total_limit=total_limit
+        ),
+        **acc_kwargs,
+    )
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.adam(0.05))
+    loader = None
+    if with_loader:
+        loader = acc.prepare(RegressionDataset(length=64, seed=11))
+        loader.batch_size = 8 // acc.num_data_shards
+    step = acc.build_train_step(linear_loss_fn)
+    return acc, model, step, loader
+
+
+def _next_rand_from(state):
+    """The next np.random draw a process restored to `state` will produce."""
+    rs = np.random.RandomState()
+    rs.set_state(state)
+    return float(rs.rand())
+
+
+def _snapshot(acc, model):
+    return {
+        "a": float(np.asarray(model.params["a"])),
+        "b": float(np.asarray(model.params["b"])),
+        "opt": [float(np.asarray(x).sum()) for x in __import__("jax").tree_util.tree_leaves(acc._optimizers[-1].opt_state)],
+        "step": acc.step,
+        "next_rand": _next_rand_from(np.random.get_state()),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# the crash matrix
+# --------------------------------------------------------------------------- #
+
+# which checkpoint auto-resume must land on after a crash at each point:
+# before the manifest exists the save never committed -> the OLD checkpoint;
+# from pre_rename on, the manifest IS written (commit point) -> the NEW
+# state must be recovered (gc finishes the rename)
+EXPECT_SOURCE = {
+    "pre_write": "old",
+    "mid_pytree": "old",
+    "pre_manifest": "old",
+    "pre_rename": "new",
+    "mid_prune": "new",
+}
+assert set(EXPECT_SOURCE) == set(CRASH_POINTS)
+
+
+@pytest.mark.parametrize("label", CRASH_POINTS)
+def test_crash_at_every_point_resumes_on_valid_checkpoint(tmp_path, label):
+    # mid_prune only fires when pruning has victims: give it a total_limit
+    total_limit = 2 if label == "mid_prune" else None
+    acc, model, step, loader = _fresh(tmp_path, total_limit=total_limit, with_loader=True)
+
+    # deliver 2 batches mid-epoch, train, take one GOOD checkpoint
+    it = iter(loader)
+    next(it), next(it)
+    step(BATCH)
+    step(BATCH)
+    acc.save_state()
+    old = _snapshot(acc, model)
+
+    if label == "mid_prune":
+        # pruning needs existing checkpoints beyond the limit
+        step(BATCH)
+        acc.save_state()
+
+    # train further, then the save CRASHES at `label`
+    step(BATCH)
+    next(it)  # 3 batches delivered now
+    new = _snapshot(acc, model)
+    with CrashPoint(label) as cp:
+        with pytest.raises(SimulatedCrash):
+            acc.save_state()
+    assert cp.fired, f"crash point {label} was never reached"
+    del it
+
+    # ---- 'new process': auto-resume must land on the newest VALID state ----
+    acc2, model2, step2, loader2 = _fresh(tmp_path, total_limit=total_limit, with_loader=True)
+    acc2.load_state()  # input_dir=None -> auto-resume
+    want = new if EXPECT_SOURCE[label] == "new" else old
+    if label == "mid_prune":
+        # two saves happened between `old` and the crash-save
+        want = new
+    assert float(np.asarray(model2.params["a"])) == pytest.approx(want["a"])
+    assert float(np.asarray(model2.params["b"])) == pytest.approx(want["b"])
+    assert acc2.step == want["step"]
+    # RNG restored bit-exactly: the next draw matches what the crashed
+    # process would have drawn after its last committed save
+    assert float(np.random.rand()) == pytest.approx(want["next_rand"], abs=0)
+    # sampler position: batches already delivered at the committed save
+    expected_skip = 3 if EXPECT_SOURCE[label] == "new" or label == "mid_prune" else 2
+    assert loader2.skip_batches == expected_skip
+
+    # no .tmp garbage survives resume, and training + saving continue
+    mgr = CheckpointManager(tmp_path / "checkpoints")
+    assert mgr.tmp_dirs() == []
+    step2(BATCH)
+    committed_before = {p.name for p in mgr.all_valid(deep=True)}
+    assert committed_before, "resume must leave at least one valid checkpoint"
+    out = acc2.save_state()
+    assert mgr.verify(out).ok
+    # the next save went to a FRESH index (no overwrite of history)
+    assert os.path.basename(out) not in committed_before
+
+
+def test_crash_save_never_deletes_last_valid_checkpoint(tmp_path):
+    """With total_limit=1 the seed code pruned the only good checkpoint
+    BEFORE writing the new one — a crash in that window lost both."""
+    acc, model, step, _ = _fresh(tmp_path, total_limit=1)
+    step(BATCH)
+    acc.save_state()  # checkpoint_0
+    mgr = CheckpointManager(tmp_path / "checkpoints")
+    assert [p.name for p in mgr.all_valid(deep=True)] == ["checkpoint_0"]
+
+    step(BATCH)
+    for label in ("pre_write", "mid_pytree", "pre_manifest"):
+        with CrashPoint(label):
+            with pytest.raises(SimulatedCrash):
+                acc.save_state()
+        # the old checkpoint MUST still be there and valid
+        assert mgr.verify(tmp_path / "checkpoints" / "checkpoint_0").ok, label
+
+    # an uninterrupted save finally prunes it, post-commit
+    out = acc.save_state()
+    names = {p.name for p in mgr.all_valid(deep=True)}
+    assert os.path.basename(out) in names
+    assert "checkpoint_0" not in names
+
+
+def test_prune_protects_resume_source(tmp_path):
+    """Satellite: total_limit pruning excludes the checkpoint the run is
+    resuming from, even when it is the oldest."""
+    acc, model, step, _ = _fresh(tmp_path, total_limit=1)
+    step(BATCH)
+    acc.save_state()  # checkpoint_0
+
+    acc2, model2, step2, _ = _fresh(tmp_path, total_limit=1)
+    src = acc2.load_state()
+    assert os.path.basename(src) == "checkpoint_0"
+    step2(BATCH)
+    acc2.save_state()  # checkpoint_1; limit=1 would normally kill checkpoint_0
+    names = {p.name for p in CheckpointManager(tmp_path / "checkpoints").all_valid(deep=True)}
+    assert names == {"checkpoint_0", "checkpoint_1"}  # resume source survives
+
+
+def test_iteration_restored_on_resume(tmp_path):
+    """Satellite regression: the seed wrote `save_iteration` but never read
+    it, so a resumed run started at checkpoint_0 again and overwrote it."""
+    acc, model, step, _ = _fresh(tmp_path)
+    step(BATCH)
+    acc.save_state()
+    a0 = float(np.asarray(model.params["a"]))
+
+    acc2, model2, step2, _ = _fresh(tmp_path)
+    acc2.load_state()
+    assert acc2.project_configuration.iteration == 1
+    step2(BATCH)
+    acc2.save_state()
+    base = tmp_path / "checkpoints"
+    assert (base / "checkpoint_1").is_dir(), "resumed save must continue the numbering"
+    # checkpoint_0 untouched: reload it and compare
+    acc3, model3, _, _ = _fresh(tmp_path)
+    acc3.load_state(str(base / "checkpoint_0"))
+    assert float(np.asarray(model3.params["a"])) == pytest.approx(a0)
+    assert acc3.project_configuration.iteration == 1  # explicit load restores the counter too
+
+
+# --------------------------------------------------------------------------- #
+# corruption / truncation detection
+# --------------------------------------------------------------------------- #
+
+def test_auto_resume_walks_back_past_corrupt_checkpoint(tmp_path):
+    acc, model, step, _ = _fresh(tmp_path)
+    step(BATCH)
+    acc.save_state()  # checkpoint_0 (good)
+    a0 = float(np.asarray(model.params["a"]))
+    step(BATCH)
+    acc.save_state()  # checkpoint_1 (to be corrupted)
+
+    base = tmp_path / "checkpoints"
+    corrupt_file(base / "checkpoint_1" / "accelerate_state.json", mode="garbage")
+    mgr = CheckpointManager(base)
+    res = mgr.verify(base / "checkpoint_1")
+    assert not res.ok and any("crc32" in p for p in res.problems)
+
+    acc2, model2, _, _ = _fresh(tmp_path)
+    src = acc2.load_state()
+    assert os.path.basename(src) == "checkpoint_0"
+    assert float(np.asarray(model2.params["a"])) == pytest.approx(a0)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "delete"])
+def test_verify_detects_damaged_pytree_files(tmp_path, mode):
+    acc, model, step, _ = _fresh(tmp_path)
+    step(BATCH)
+    out = acc.save_state()
+    mgr = CheckpointManager(tmp_path / "checkpoints")
+    assert mgr.verify(out).ok
+    manifest = read_manifest(out)
+    # damage the largest recorded orbax array file
+    rel = max(manifest["pytree_files"], key=manifest["pytree_files"].get)
+    corrupt_file(os.path.join(out, rel), mode=mode)
+    res = mgr.verify(out)
+    assert not res.ok
+    assert any(rel in p for p in res.problems)
+    assert mgr.latest(deep=True) is None  # nothing valid left to resume from
+    acc2, _, _, _ = _fresh(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        acc2.load_state()
+
+
+def test_uncommitted_checkpoint_is_invisible(tmp_path):
+    """A directory without a manifest (pre-FT checkpoint or kill mid-write)
+    never surfaces through discovery."""
+    base = tmp_path / "checkpoints"
+    (base / "checkpoint_0").mkdir(parents=True)
+    (base / "checkpoint_0" / "accelerate_state.json").write_text(json.dumps({"step": 3}))
+    mgr = CheckpointManager(base)
+    assert mgr.all_checkpoints() != []
+    assert mgr.all_valid() == []
+    assert mgr.latest() is None
+    problems = verify_manifest(base / "checkpoint_0")
+    assert any("no commit manifest" in p for p in problems)
+
+
+def test_truncated_manifest_means_uncommitted(tmp_path):
+    d = tmp_path / "checkpoint_0"
+    d.mkdir()
+    (d / "data.json").write_text("{}")
+    write_manifest(d, build_manifest(d, step=1, iteration=0))
+    corrupt_file(d / "commit_success.json", mode="truncate", nbytes=8)
+    assert read_manifest(d) is None
+    assert verify_manifest(d) != []
+
+
+# --------------------------------------------------------------------------- #
+# async-save failure drain (satellite)
+# --------------------------------------------------------------------------- #
+
+def test_failed_async_save_never_looks_committed(tmp_path):
+    """If a background write fails, the drain must abort the commit and
+    remove the partial directory — nothing may mistake it for a
+    checkpoint."""
+    from accelerate_tpu import checkpointing
+
+    acc, model, step, _ = _fresh(tmp_path)
+    step(BATCH)
+    acc.save_state()  # checkpoint_0, good
+    step(BATCH)
+    acc.save_state(async_save=True)  # checkpoint_1 in flight
+
+    assert len(checkpointing._PENDING_ASYNC) == 1
+    pending = checkpointing._PENDING_ASYNC[0]
+
+    class _Exploding:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def wait_until_finished(self):
+            self._inner.wait_until_finished()  # let the real write land...
+            raise OSError("simulated filer failure")  # ...then report failure
+
+        def close(self):
+            self._inner.close()
+
+    pending.checkpointers = [_Exploding(c) for c in pending.checkpointers]
+    with pytest.raises(OSError, match="simulated filer failure"):
+        acc.wait_for_checkpoint()
+
+    base = tmp_path / "checkpoints"
+    mgr = CheckpointManager(base)
+    assert not (base / "checkpoint_1").exists(), "failed save must not be committed"
+    assert mgr.tmp_dirs() == [], "failed save's partial dir must be removed"
+    assert [p.name for p in mgr.all_valid(deep=True)] == ["checkpoint_0"]
+    # and a later save still works (pending list was consumed)
+    out = acc.save_state()
+    assert mgr.verify(out).ok
+
+
+def test_async_save_commits_manifest_on_drain(tmp_path):
+    acc, model, step, _ = _fresh(tmp_path)
+    step(BATCH)
+    out = acc.save_state(async_save=True)
+    base = tmp_path / "checkpoints"
+    acc.wait_for_checkpoint()
+    assert (base / "checkpoint_0").is_dir()
+    res = CheckpointManager(base).verify(out)
+    assert res.ok, res.problems
+    assert res.manifest["step"] == acc.step
+
+
+# --------------------------------------------------------------------------- #
+# preemption
+# --------------------------------------------------------------------------- #
+
+def test_preemption_handler_latches_flag():
+    handler = PreemptionHandler(signals=("SIGTERM",))
+    try:
+        assert handler.install()
+        assert not handler.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert handler.preempted
+        assert handler.received == "SIGTERM"
+    finally:
+        handler.uninstall()
+
+
+def test_accelerator_preemption_checkpoint_and_stop(tmp_path):
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True),
+        kwargs_handlers=[FaultToleranceKwargs(preemption_signals=("SIGTERM",))],
+    )
+    try:
+        model = acc.prepare_model(RegressionModel())
+        acc.prepare_optimizer(optax.sgd(0.1))
+        step = acc.build_train_step(linear_loss_fn)
+        assert acc.preemption_handler is not None and acc.preemption_handler.installed
+        assert not acc.should_stop and not acc.should_checkpoint
+
+        step(BATCH)
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        assert acc.should_checkpoint and acc.should_stop
+
+        # the loop's reaction: one final SYNCHRONOUS checkpoint
+        out = acc.save_state(async_save=True)  # async demoted to sync under preemption
+        from accelerate_tpu import checkpointing
+
+        assert checkpointing._PENDING_ASYNC == [], "preempted save must be synchronous"
+        assert CheckpointManager(tmp_path / "checkpoints").verify(out).ok
+        assert not acc.should_checkpoint, "final checkpoint taken exactly once"
+        assert acc.should_stop
+    finally:
+        if acc.preemption_handler is not None:
+            acc.preemption_handler.uninstall()
+
+
+def test_accelerator_without_ft_handler_installs_nothing():
+    _reset()
+    acc = Accelerator()
+    assert acc.preemption_handler is None
+    assert not acc.should_stop and not acc.should_checkpoint
+
+
+# --------------------------------------------------------------------------- #
+# retry decorator
+# --------------------------------------------------------------------------- #
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps, calls = [], []
+
+    @retry(attempts=4, base_delay=0.01, sleep=sleeps.append)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert len(calls) == 3
+    assert len(sleeps) == 2
+
+
+def test_retry_gives_up_and_reports():
+    events = []
+    with pytest.raises(OSError):
+        retry_call(
+            lambda: (_ for _ in ()).throw(OSError("dead")),
+            attempts=3,
+            base_delay=0.01,
+            sleep=lambda s: None,
+            on_retry=lambda a, d, e: events.append(("retry", a)),
+            on_giveup=lambda a, e: events.append(("giveup", a)),
+        )
+    assert events == [("retry", 1), ("retry", 2), ("giveup", 3)]
+
+
+def test_retry_does_not_catch_simulated_crash():
+    def boom():
+        raise SimulatedCrash("not retryable")
+
+    with pytest.raises(SimulatedCrash):
+        retry_call(boom, attempts=5, sleep=lambda s: None)
+
+
+def test_backoff_delays_grow_and_cap():
+    delays = list(backoff_delays(5, base_delay=1.0, max_delay=4.0, jitter=0.0, rng=lambda: 0.0))
+    assert delays == [1.0, 2.0, 4.0, 4.0]
+    jittered = list(backoff_delays(3, base_delay=1.0, max_delay=9.0, jitter=0.5, rng=lambda: 1.0))
+    assert jittered == [1.5, 3.0]
+
+
+# --------------------------------------------------------------------------- #
+# telemetry integration
+# --------------------------------------------------------------------------- #
+
+def test_checkpoint_events_land_in_telemetry_log(tmp_path):
+    from accelerate_tpu.telemetry import read_events
+
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True),
+    )
+    acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.1))
+    step = acc.build_train_step(linear_loss_fn)
+    tel = acc.telemetry  # activate the event log
+    step(BATCH)
+    acc.save_state()
+    acc.load_state()
+    tel.close()
+
+    names = [e["name"] for e in read_events(tel.path)]
+    assert "ckpt_save" in names
+    assert "ckpt_commit" in names
+    assert "ckpt_auto_resume" in names
