@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint flight-check telemetry-selfcheck ft-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check telemetry-selfcheck ft-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -37,9 +37,28 @@ quality: lint
 # don't fail the build (yet).
 lint:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
+	$(MAKE) --no-print-directory divergence
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
+
+# Multi-host divergence analyzer (TPU4xx): prove TPU401-405 fire on their
+# seeded deadlock fixtures (and the clean fixture stays quiet), then
+# self-analyze the tree. This gate is STRICT for the TPU401-403 errors —
+# a collective not every rank reaches is a guaranteed all-host hang —
+# while the TPU404/405 warnings report but pass. Pure AST, no jax needed.
+divergence:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --selfcheck
+
+# Merged SARIF 2.1.0 artifact for GitHub code scanning: the AST tier and
+# the divergence tier each contribute one runs[] entry. Findings don't
+# fail this target (make lint is the gate); the artifact is for PR
+# annotation.
+lint-sarif:
+	@mkdir -p .cache
+	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --format sarif > .cache/lint.sarif
+	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --format sarif > .cache/divergence.sarif
+	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif -o lint-merged.sarif
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake

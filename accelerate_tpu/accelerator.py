@@ -696,6 +696,7 @@ class Accelerator:
         donate_argnums=(),
         in_shardings=None,
         ignore=(),
+        divergence: bool = True,
     ):
         """Statically lint ``step_fn`` against this accelerator's mesh
         *before* paying a multi-chip compile (tier-1 jaxpr analysis:
@@ -706,6 +707,13 @@ class Accelerator:
         ``sample_args`` are traced abstractly (``jax.ShapeDtypeStruct``s
         or real arrays — nothing executes, nothing compiles); concrete
         arrays contribute their ``NamedSharding`` to the TPU104 check.
+
+        With ``divergence=True`` (the default) the multi-host divergence
+        analyzer (TPU4xx, ``analysis.divergence``) also runs over the
+        *calling module's* source: collectives or barriers that not every
+        rank reaches, rank-divergent loop trip counts, unguarded host
+        writes — the deadlocks a single-program trace cannot see.
+
         Returns the list of :class:`~accelerate_tpu.analysis.Finding`;
         error-severity findings are also logged. Suppress individual rules
         with ``ignore=("TPU103",)``.
@@ -720,9 +728,34 @@ class Accelerator:
             in_shardings=in_shardings,
             ignore=ignore,
         )
+        if divergence:
+            findings += self._lint_calling_module(ignore=ignore, depth=2)
         if any(f.is_error for f in findings):
             logger.warning("lint found issues in %s:\n%s", getattr(step_fn, "__name__", "step_fn"), render_text(findings))
         return findings
+
+    def _lint_calling_module(self, ignore=(), depth: int = 1):
+        """Run the TPU4xx divergence analyzer over the source file of the
+        caller ``depth`` frames up. Quietly returns ``[]`` when the caller
+        has no readable ``.py`` source (REPL, notebook, frozen app)."""
+        import sys
+
+        try:
+            frame = sys._getframe(depth)
+        except ValueError:
+            return []
+        path = frame.f_globals.get("__file__") if frame is not None else None
+        if not path or not str(path).endswith(".py") or not os.path.exists(path):
+            return []
+        from .analysis.divergence import analyze_file
+        from .analysis.project_config import load_project_config
+
+        cfg = load_project_config(os.path.dirname(os.path.abspath(path)))
+        try:
+            findings = analyze_file(path, n_ranks=max(3, cfg.resolve_ranks(None)), ignore=cfg.merge_ignore(ignore))
+        except (OSError, RecursionError):
+            return []
+        return cfg.apply_suppressions(findings)
 
     def flight_check(
         self,

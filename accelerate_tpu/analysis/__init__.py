@@ -16,19 +16,30 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   ``costmodel``), and the TPU3xx SPMD safety rules (collective deadlock
   under value-dependent control flow, implicit reshards, defeated
   donation).
+* **divergence tier** (``analyze_source`` / ``analyze_paths``) — the
+  abstract multi-rank interpreter (``ranksim``) runs a script for k
+  synthetic ranks and diffs the per-rank collective traces into the
+  TPU4xx rules: syncs not every rank reaches, rank-divergent loop trip
+  counts around collectives, mismatched collective order, divergent early
+  exits, unguarded host side effects.
 
-Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check``
-(commands/) and ``Accelerator.lint`` / ``Accelerator.flight_check``.
-Suppress a finding inline with ``# tpu-lint: disable=TPU201``.
+Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
+``accelerate-tpu divergence`` (commands/) and ``Accelerator.lint`` /
+``Accelerator.flight_check``. Suppress a finding inline with
+``# tpu-lint: disable=TPU201``, or project-wide via ``.tpulint.toml``
+(``project_config``).
 """
 
 from .ast_lint import LintConfig, iter_python_files, lint_file, lint_paths, lint_source
 from .costmodel import BANDWIDTH_TABLE, CollectiveRecord, TrafficReport, collect_traffic, price_collective
+from .divergence import analyze_file, analyze_paths, analyze_source
 from .flightcheck import FlightReport, LiveBuffer, estimate_peak_hbm, flight_check
 from .jaxpr_lint import lint_step
+from .project_config import ProjectConfig, find_project_config, load_project_config
+from .ranksim import ACCELERATOR_EFFECTS, COLLECTIVE_EFFECTS, ModuleSimulator
 from .report import exit_code, format_finding, render_json, render_sarif, render_text
 from .rules import ERROR, RULES, WARNING, Finding, Rule, apply_suppressions, filter_findings
-from .selfcheck import run_selfcheck
+from .selfcheck import run_divergence_selfcheck, run_selfcheck
 
 __all__ = [
     "ERROR",
@@ -59,4 +70,14 @@ __all__ = [
     "render_sarif",
     "exit_code",
     "run_selfcheck",
+    "run_divergence_selfcheck",
+    "analyze_source",
+    "analyze_file",
+    "analyze_paths",
+    "ModuleSimulator",
+    "ACCELERATOR_EFFECTS",
+    "COLLECTIVE_EFFECTS",
+    "ProjectConfig",
+    "find_project_config",
+    "load_project_config",
 ]

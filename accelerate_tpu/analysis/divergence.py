@@ -1,0 +1,235 @@
+"""Tier-4 multi-host divergence analysis: prove every rank runs the same
+collective program.
+
+``analysis.ranksim`` symbolically executes a module for ``k`` synthetic
+ranks (rank 0 = main process, plus non-main ranks) and records each rank's
+trace of collective-ordering events. This module diffs those traces into
+the ``TPU4xx`` rule family:
+
+* ``TPU401`` (error) — a collective or barrier that not every rank
+  reaches: a sync under a rank-divergent ``if``/``return``/``raise``
+  (``if accelerator.is_main_process: accelerator.gather(...)``), or a
+  collective inside a ``main_process_first`` body (ranks are serialized
+  there by design, so they can never meet at the collective). The ranks
+  that do arrive wait forever — the classic SPMD deadlock, with no error.
+* ``TPU402`` (error) — a collective inside a loop whose trip count is
+  rank-divergent (iterating a per-host ``os.listdir``/glob, a host-RNG
+  draw): hosts run the collective a different number of times and the
+  program hangs on the extra iteration.
+* ``TPU403`` (error) — rank-divergent branches that *both* sync, but in a
+  different order (main gathers then barriers, others barrier then
+  gather): every rank reaches every sync, just never together.
+* ``TPU404`` (warning) — a rank-divergent early ``break``/``continue``/
+  handled ``raise`` that can skip a later barrier on some ranks only.
+* ``TPU405`` (warning) — a host file write or tracker call executed by
+  every rank in rank-aware code: PR-4's retry layer serializes these
+  differently per host, so unguarded shared-path writes race. Fires only
+  when the surrounding code is demonstrably rank-aware (touches
+  ``is_main_process``/barriers) — a pure IO helper's caller owns the
+  guard.
+
+Entry points mirror ``ast_lint``: :func:`analyze_source` /
+:func:`analyze_file` / :func:`analyze_paths`, plus ``entry=`` to restrict
+to one function (the CLI's ``file.py::fn`` form). Stdlib-only — runs
+where jax is not importable.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from collections import Counter
+from typing import Iterable, Optional
+
+from .ranksim import EntryResult, ModuleSimulator
+from .rules import Finding, apply_suppressions, filter_findings
+
+#: Notes whose ``kind`` maps straight to a rule.
+_NOTE_RULES = {
+    "loop_collective": "TPU402",
+    "divergent_exit": "TPU404",
+    "serialized_sync": "TPU401",
+}
+
+
+def _sync_seq(trace) -> list:
+    return [e for e in trace.events if e.sync]
+
+
+def _ctx_desc(*events) -> str:
+    for e in events:
+        if e is not None and e.ctx:
+            return e.ctx[-1]
+    return "a rank-divergent condition"
+
+
+def diff_entry(entry: EntryResult) -> list[Finding]:
+    """Diff one entry's per-rank traces (plus its structural notes) into
+    raw findings. Rank 0 (the main process) is the reference; every other
+    rank is compared against it."""
+    findings: list[Finding] = []
+    ref = entry.traces[0]
+    ref_seq = _sync_seq(ref)
+
+    # sync programs are compared by (kind, name) ORDER — the same collective
+    # emitted from different source lines of an if/else still matches at
+    # runtime, so lines only feed the messages.
+    def key(e):
+        return (e.kind, e.name)
+
+    for other in entry.traces[1:]:
+        if ref.truncated or other.truncated:
+            continue  # node budget hit: traces are incomparable, stay quiet
+        seq = _sync_seq(other)
+        if [key(e) for e in ref_seq] == [key(e) for e in seq]:
+            continue
+        i = 0
+        while i < len(ref_seq) and i < len(seq) and key(ref_seq[i]) == key(seq[i]):
+            i += 1
+        a = ref_seq[i] if i < len(ref_seq) else None
+        b = seq[i] if i < len(seq) else None
+        rest_a, rest_b = Counter(key(e) for e in ref_seq[i:]), Counter(key(e) for e in seq[i:])
+        if a is not None and b is not None and rest_a == rest_b:
+            # same sync multiset from the split point on, different order:
+            # every rank reaches every sync, just never together
+            findings.append(
+                Finding(
+                    "TPU403",
+                    f"ranks disagree on collective order under {_ctx_desc(a, b)}: "
+                    f"rank 0 reaches {a.name} (line {a.line}) while rank {other.rank} "
+                    f"reaches {b.name} (line {b.line}) — every rank syncs, never together",
+                    line=min(a.line, b.line),
+                )
+            )
+            continue
+        reported = set()
+        for extra, missing_rank, running, source in (
+            (rest_a - rest_b, other.rank, 0, ref_seq[i:]),
+            (rest_b - rest_a, 0, other.rank, seq[i:]),
+        ):
+            for k in extra:
+                ev = next(e for e in source if key(e) == k)
+                if (ev.name, ev.line) in reported:
+                    continue
+                reported.add((ev.name, ev.line))
+                findings.append(
+                    Finding(
+                        "TPU401",
+                        f"{ev.kind} {ev.name} (line {ev.line}) is reached by rank {running} but not rank "
+                        f"{missing_rank} (guarded by {_ctx_desc(ev)}) — the arriving ranks hang forever",
+                        line=ev.line,
+                    )
+                )
+
+    for note in entry.notes:
+        rule = _NOTE_RULES.get(note.kind)
+        if rule is None:
+            continue
+        if note.kind == "loop_collective":
+            msg = (
+                f"collective {note.name} inside a loop whose trip count is rank-divergent "
+                f"({note.origin or 'per-host state'}) — hosts run it a different number of times"
+            )
+        elif note.kind == "serialized_sync":
+            msg = (
+                f"collective/barrier {note.name} inside a main_process_first body — ranks are "
+                f"serialized there and can never meet at the sync"
+            )
+        else:  # divergent_exit
+            msg = (
+                f"rank-divergent {note.name} under {note.origin or 'a divergent condition'} can skip "
+                f"the later {note.skipped_name} barrier (line {note.skipped_line}) on some ranks"
+            )
+        findings.append(Finding(rule, msg, line=note.line))
+
+    # TPU405: a host write / tracker call that >=2 synthetic ranks execute,
+    # in rank-aware code. Events identical across ranks collapse to one
+    # finding; a write only rank 0 performs (is_main_process-guarded) is
+    # invisible here by construction.
+    if entry.rank_aware:
+        counts: dict = {}
+        for trace in entry.traces:
+            for e in trace.events:
+                if e.kind in ("write", "tracker"):
+                    counts.setdefault((e.kind, e.name, e.line), set()).add(trace.rank)
+        for (kind, name, line), ranks in sorted(counts.items(), key=lambda kv: kv[0][2]):
+            if len(ranks) >= 2:
+                what = "host write" if kind == "write" else "tracker call"
+                findings.append(
+                    Finding(
+                        "TPU405",
+                        f"{what} {name} (line {line}) executed by every rank — guard with "
+                        f"is_main_process or rank-namespace the target path",
+                        line=line,
+                    )
+                )
+    return findings
+
+
+def analyze_tree(
+    tree: ast.Module,
+    path: str = "<string>",
+    *,
+    entry: Optional[str] = None,
+    n_ranks: int = 3,
+) -> list[Finding]:
+    """Run the multi-rank simulation over a parsed module and diff every
+    entry (module body, top-level functions, methods) under both worlds.
+    Findings are deduplicated by (rule, line) across entries — a function
+    fires once whether reached as its own entry or followed from a
+    caller."""
+    sim = ModuleSimulator(tree, path=path, n_ranks=n_ranks)
+    findings: list[Finding] = []
+    seen = set()
+    for result in sim.run(entry=entry):
+        for f in diff_entry(result):
+            key = (f.rule, f.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            f.path = path
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line or 0, f.rule))
+    return findings
+
+
+def analyze_source(
+    text: str,
+    path: str = "<string>",
+    *,
+    entry: Optional[str] = None,
+    n_ranks: int = 3,
+    select=None,
+    ignore=(),
+) -> list[Finding]:
+    """Analyze one module's source text; suppressions and select/ignore
+    applied (same contract as ``ast_lint.lint_source``)."""
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Finding("TPU003", f"syntax error: {e.msg}", path=path, line=e.lineno or 1)]
+    findings = analyze_tree(tree, path, entry=entry, n_ranks=n_ranks)
+    findings = apply_suppressions(findings, text.splitlines())
+    return filter_findings(findings, select=select, ignore=ignore)
+
+
+def analyze_file(path, *, entry: Optional[str] = None, n_ranks: int = 3, select=None, ignore=()) -> list[Finding]:
+    p = pathlib.Path(path)
+    return analyze_source(p.read_text(), path=str(p), entry=entry, n_ranks=n_ranks, select=select, ignore=ignore)
+
+
+def analyze_paths(paths: Iterable, *, n_ranks: int = 3, select=None, ignore=()) -> list[Finding]:
+    """Analyze every ``.py`` file under ``paths`` (files or directories).
+    A ``file.py::fn`` element restricts that file to one entry point."""
+    from .ast_lint import iter_python_files
+
+    findings: list[Finding] = []
+    for raw in paths:
+        raw = str(raw)
+        if "::" in raw:
+            fpath, _, entry = raw.partition("::")
+            findings.extend(analyze_file(fpath, entry=entry, n_ranks=n_ranks, select=select, ignore=ignore))
+            continue
+        for f in iter_python_files([raw]):
+            findings.extend(analyze_file(f, n_ranks=n_ranks, select=select, ignore=ignore))
+    return findings

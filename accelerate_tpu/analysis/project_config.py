@@ -1,0 +1,201 @@
+"""``.tpulint.toml`` — project-level configuration for the analysis CLIs.
+
+Inline ``# tpu-lint: disable=...`` comments don't scale to vendored or
+example code you can't annotate; this file gives ``accelerate-tpu lint``,
+``flight-check`` and ``divergence`` a shared project config: rule
+enable/disable lists, per-path suppressions, and the default report
+format. Discovered by walking up from the working directory (like
+``pyproject.toml``), so invocations from any subdirectory agree.
+
+Schema::
+
+    [lint]
+    format = "text"            # default --format for every analysis CLI
+    disable = ["TPU103"]       # rule IDs merged into --ignore
+    enable = ["TPU2", ...]     # optional: only these run (like --select)
+
+    [divergence]
+    ranks = 3                  # default --ranks for the multi-rank simulator
+
+    [[suppress]]
+    path = "examples/*"        # fnmatch glob or directory prefix
+    rules = ["TPU405"]         # omitted = every rule suppressed there
+
+Parsing uses :mod:`tomllib` (3.11+) or ``tomli`` when importable and
+otherwise falls back to a minimal built-in reader covering exactly the
+schema above — the analysis package keeps its zero-extra-dependency
+property either way.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import pathlib
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from .rules import Finding
+
+CONFIG_FILENAME = ".tpulint.toml"
+
+
+@dataclass(frozen=True)
+class ProjectConfig:
+    """Parsed ``.tpulint.toml`` (all fields optional; the zero-arg
+    instance is the no-config default)."""
+
+    path: Optional[str] = None
+    format: Optional[str] = None
+    enable: Optional[frozenset] = None
+    disable: frozenset = frozenset()
+    ranks: Optional[int] = None
+    #: ``(glob_or_prefix, rule_ids_or_None)`` — ``None`` suppresses all.
+    suppressions: tuple = ()
+
+    def resolve_format(self, cli_format: Optional[str], fallback: str = "text") -> str:
+        """CLI flag wins; then the config's ``[lint].format``; then text."""
+        return cli_format or self.format or fallback
+
+    def resolve_ranks(self, cli_ranks: Optional[int], fallback: int = 3) -> int:
+        return cli_ranks or self.ranks or fallback
+
+    def merge_ignore(self, ignore) -> frozenset:
+        return frozenset(s.upper() for s in (ignore or ())) | self.disable
+
+    def merge_select(self, select):
+        return select if select is not None else self.enable
+
+    def _suppressed(self, f: Finding) -> bool:
+        if f.path is None:
+            return False
+        cand = {f.path.replace(os.sep, "/")}
+        if self.path is not None:
+            root = os.path.dirname(os.path.abspath(self.path))
+            try:
+                cand.add(os.path.relpath(os.path.abspath(f.path), root).replace(os.sep, "/"))
+            except ValueError:
+                pass
+        for pattern, rules in self.suppressions:
+            if rules is not None and f.rule not in rules:
+                continue
+            pat = pattern.rstrip("/")
+            for p in cand:
+                if fnmatch.fnmatch(p, pat) or fnmatch.fnmatch(p, pat + "/*") or p.startswith(pat + "/"):
+                    return True
+        return False
+
+    def apply_suppressions(self, findings: list) -> list:
+        """Drop findings matched by a per-path suppression entry."""
+        if not self.suppressions:
+            return findings
+        return [f for f in findings if not self._suppressed(f)]
+
+
+# -- TOML loading ---------------------------------------------------------
+
+_KV_RE = re.compile(r"^\s*([A-Za-z0-9_-]+)\s*=\s*(.+?)\s*$")
+
+
+def _parse_minimal_toml(text: str) -> dict:
+    """Fallback reader for the documented schema subset: ``[table]``,
+    ``[[array-of-tables]]``, string/int/bool scalars, and flat string
+    arrays. Good enough that a missing ``tomli`` never disables the
+    feature."""
+
+    def scalar(raw: str):
+        raw = raw.strip()
+        if raw.startswith("[") and raw.endswith("]"):
+            inner = raw[1:-1].strip()
+            return [scalar(p) for p in re.split(r",\s*", inner) if p.strip()] if inner else []
+        if raw.startswith(("'", '"')):
+            return raw[1:-1]
+        if raw in ("true", "false"):
+            return raw == "true"
+        try:
+            return int(raw)
+        except ValueError:
+            return raw
+
+    doc: dict = {}
+    current: dict = doc
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped.startswith("[[") and stripped.endswith("]]"):
+            current = {}
+            doc.setdefault(stripped[2:-2].strip(), []).append(current)
+        elif stripped.startswith("[") and stripped.endswith("]"):
+            current = doc.setdefault(stripped[1:-1].strip(), {})
+        else:
+            body, quoted = [], False
+            for ch in stripped:
+                if ch in "'\"":
+                    quoted = not quoted
+                if ch == "#" and not quoted:
+                    break
+                body.append(ch)
+            m = _KV_RE.match("".join(body).strip())
+            if m:
+                current[m.group(1)] = scalar(m.group(2))
+    return doc
+
+
+def _load_toml(path: str) -> dict:
+    text = pathlib.Path(path).read_text()
+    for modname in ("tomllib", "tomli"):
+        try:
+            mod = __import__(modname)
+        except ImportError:
+            continue
+        return mod.loads(text)
+    return _parse_minimal_toml(text)
+
+
+def find_project_config(start: Optional[str] = None) -> Optional[str]:
+    """Walk up from ``start`` (default: cwd) to the filesystem root looking
+    for ``.tpulint.toml``."""
+    d = pathlib.Path(start or os.getcwd()).resolve()
+    for parent in [d, *d.parents]:
+        candidate = parent / CONFIG_FILENAME
+        if candidate.is_file():
+            return str(candidate)
+    return None
+
+
+def _ids(raw) -> frozenset:
+    return frozenset(str(s).strip().upper() for s in (raw or ()) if str(s).strip())
+
+
+def load_project_config(start: Optional[str] = None) -> ProjectConfig:
+    """Locate + parse the project config; the empty default when there is
+    none (or it is unreadable — a broken config must not kill a lint
+    run)."""
+    path = find_project_config(start)
+    if path is None:
+        return ProjectConfig()
+    try:
+        doc = _load_toml(path)
+    except Exception:
+        return ProjectConfig(path=path)
+    lint = doc.get("lint", {}) or {}
+    div = doc.get("divergence", {}) or {}
+    suppressions = []
+    for entry in doc.get("suppress", []) or []:
+        pat = entry.get("path")
+        if not pat:
+            continue
+        rules = entry.get("rules")
+        suppressions.append((str(pat), _ids(rules) if rules else None))
+    enable = _ids(lint.get("enable"))
+    ranks = div.get("ranks")
+    return ProjectConfig(
+        path=path,
+        format=lint.get("format") or None,
+        enable=enable or None,
+        disable=_ids(lint.get("disable")),
+        ranks=int(ranks) if ranks else None,
+        suppressions=tuple(suppressions),
+    )

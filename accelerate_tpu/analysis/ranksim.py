@@ -1,0 +1,1046 @@
+"""Abstract multi-rank interpreter: symbolically execute a training script's
+AST for ``k`` synthetic ranks and record each rank's **collective-ordering
+trace**.
+
+The survey's L0/L1 layers assume every host runs the *same sequence* of
+collectives and barriers — if they don't, the job hangs forever with no
+error (the classic SPMD deadlock; MPI literature calls the property
+"collective matching"). This module is the machinery that checks it
+statically:
+
+* a **rank-divergence taint lattice** — every value is either ``uniform``
+  (provably identical on all hosts) or ``divergent`` (may differ per host).
+  ``process_index`` / ``is_main_process`` / per-host RNG / host-clock /
+  filesystem reads seed the divergent end; pure computation over uniform
+  values stays uniform. Where the per-rank values are *known*
+  (``is_main_process`` is True exactly on rank 0) they are carried
+  concretely, so ``if accelerator.is_main_process:`` sends each synthetic
+  rank down its real branch.
+* a **per-rank trace** of collective-ordering events: barriers
+  (``wait_for_everyone``, ``sync_global_devices``), collectives
+  (``gather``/``reduce``/``broadcast``, the ``psum`` family inside
+  ``shard_map``, the ``parallel.collectives`` wrappers), checkpoint commit
+  barriers (``save_state`` modelled as enter+commit barriers from the
+  effect-summary table below), and ``main_process_first`` enter/exit
+  fences. Side effects (host file writes, tracker calls) are recorded as
+  non-sync events for the TPU405 hazard check.
+* **effect summaries** for ``Accelerator``/``PartialState`` methods and the
+  ``parallel.collectives`` wrappers (:data:`ACCELERATOR_EFFECTS`,
+  :data:`COLLECTIVE_EFFECTS`), so real user scripts check cleanly without
+  tracing into the framework; plus **interprocedural** following of calls
+  one level deep within the analyzed file.
+
+``analysis.divergence`` diffs the per-rank traces produced here into the
+TPU4xx rule family. Like the rest of the AST tier this module is
+deliberately stdlib-only — it runs where jax is not importable.
+"""
+
+from __future__ import annotations
+
+import ast
+import operator
+from dataclasses import dataclass
+from typing import Optional
+
+# -- the taint lattice ----------------------------------------------------
+
+UNIFORM = "uniform"
+DIVERGENT = "divergent"
+
+
+@dataclass(frozen=True)
+class Value:
+    """An abstract value: its taint, optionally the concrete per-rank
+    values (``is_main_process`` -> ``(True, False, ...)``), and a short
+    description of where the divergence came from."""
+
+    taint: str = UNIFORM
+    per_rank: Optional[tuple] = None
+    origin: str = ""
+
+    @property
+    def divergent(self) -> bool:
+        return self.taint == DIVERGENT
+
+
+UNKNOWN = Value()
+
+
+def join_values(*vals: Value) -> Value:
+    """Lattice join: divergent wins; the first divergent origin is kept."""
+    for v in vals:
+        if v.divergent:
+            return Value(DIVERGENT, None, v.origin)
+    return UNKNOWN
+
+
+@dataclass(frozen=True)
+class Event:
+    """One collective-ordering (or side-effect) event in a rank's trace.
+
+    ``kind`` is ``collective``/``barrier`` (sync events — these must match
+    across ranks) or ``write``/``tracker`` (side effects — these feed the
+    TPU405 hazard check only)."""
+
+    kind: str
+    name: str
+    line: int
+    ctx: tuple = ()  # descriptions of the divergence contexts active at emit
+
+    @property
+    def sync(self) -> bool:
+        return self.kind in ("collective", "barrier")
+
+
+@dataclass(frozen=True)
+class Note:
+    """A structural observation recorded mid-interpretation (a collective
+    under a rank-divergent loop, a divergent early exit, a sync inside a
+    ``main_process_first`` body) — raw material for TPU402/404 findings."""
+
+    kind: str  # "loop_collective" | "divergent_exit" | "serialized_sync"
+    line: int
+    name: str = ""
+    origin: str = ""
+    skipped_line: int = 0
+    skipped_name: str = ""
+
+
+@dataclass
+class RankTrace:
+    rank: int
+    events: list
+    truncated: bool = False
+
+
+@dataclass
+class EntryResult:
+    """All k rank traces (plus structural notes) for one analyzed entry
+    point, under one 'world' (one choice of uniform-unknown branches)."""
+
+    name: str
+    line: int
+    world: str
+    traces: list
+    notes: list
+    rank_aware: bool
+
+
+# -- effect summaries -----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CallEffect:
+    """Divergence model of a framework call: the sync events every rank
+    emits when calling it, and the taint of its return value."""
+
+    events: tuple = ()
+    returns: str = UNIFORM
+
+
+#: Effect summaries for ``Accelerator``/``PartialState`` methods (matched by
+#: method name on any receiver). ``save_state`` is the PR-4 atomic commit
+#: protocol: a pre-write barrier plus the commit barrier. Methods that are
+#: internally main-process-guarded (``log``) or purely local (``prepare``,
+#: ``backward``) are uniform no-ops here — that's the point of the table:
+#: idiomatic scripts check clean.
+ACCELERATOR_EFFECTS: dict = {
+    "wait_for_everyone": CallEffect(("barrier:wait_for_everyone",)),
+    "save_state": CallEffect(("barrier:save_state/enter", "barrier:save_state/commit")),
+    "load_state": CallEffect(("barrier:load_state/enter", "barrier:load_state/exit")),
+    "save_model": CallEffect(("barrier:save_model",)),
+    "end_training": CallEffect(("barrier:end_training",)),
+    "gather": CallEffect(("collective:gather",)),
+    "gather_for_metrics": CallEffect(("collective:gather_for_metrics",)),
+    "gather_object": CallEffect(("collective:gather_object",)),
+    "pad_across_processes": CallEffect(("collective:pad_across_processes",)),
+    "reduce": CallEffect(("collective:reduce",)),
+    "broadcast": CallEffect(("collective:broadcast",)),
+    "broadcast_object_list": CallEffect(("collective:broadcast_object_list",)),
+    # purely local / internally rank-guarded -> uniform no-ops
+    "prepare": CallEffect(),
+    "prepare_model": CallEffect(),
+    "prepare_data_loader": CallEffect(),
+    "prepare_optimizer": CallEffect(),
+    "prepare_scheduler": CallEffect(),
+    "backward": CallEffect(),
+    "clip_grad_norm_": CallEffect(),
+    "clip_grad_value_": CallEffect(),
+    "log": CallEffect(),
+    "log_images": CallEffect(),
+    "log_table": CallEffect(),
+    "print": CallEffect(),
+    "init_trackers": CallEffect(),
+    "get_tracker": CallEffect(),
+    "free_memory": CallEffect(),
+    "unwrap_model": CallEffect(),
+    "skip_first_batches": CallEffect(),
+    "lint": CallEffect(),
+    "flight_check": CallEffect(),
+}
+
+#: Divergence model of every public symbol in ``parallel.collectives`` —
+#: the shard_map-level vocabulary. A unit test asserts this table covers
+#: the module's whole public surface, so a new collective cannot silently
+#: bypass the analyzer.
+COLLECTIVE_EFFECTS: dict = {
+    "all_reduce_sum": CallEffect(("collective:all_reduce_sum",)),
+    "all_reduce_mean": CallEffect(("collective:all_reduce_mean",)),
+    "all_gather": CallEffect(("collective:all_gather",)),
+    "reduce_scatter_sum": CallEffect(("collective:reduce_scatter_sum",)),
+    "ppermute_next": CallEffect(("collective:ppermute_next",)),
+    "barrier_value": CallEffect(("barrier:barrier_value",)),
+    "axis_index": CallEffect((), returns=DIVERGENT),
+}
+
+#: jax-level collective primitives (any receiver except numpy-likes).
+JAX_COLLECTIVES = frozenset(
+    {
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "psum_scatter",
+        "ppermute",
+        "pshuffle",
+        "all_to_all",
+        "all_gather",
+        "broadcast_one_to_all",
+        "process_allgather",
+    }
+)
+
+#: host-level barriers (any receiver).
+BARRIER_CALLS = frozenset({"wait_for_everyone", "sync_global_devices"})
+
+#: attribute reads that *are* the rank: reading one taints the value, with
+#: known per-rank concretes so guards send each synthetic rank down its
+#: real branch.
+DIVERGENT_ATTRS = frozenset(
+    {
+        "process_index",
+        "process_index_host",
+        "local_process_index",
+        "is_main_process",
+        "is_local_main_process",
+        "is_last_process",
+    }
+)
+
+#: roots whose member calls never resolve to Accelerator effect summaries
+#: (``jnp.log`` is not ``Accelerator.log``; ``functools.reduce`` is not a
+#: collective).
+_NUMERIC_ROOTS = frozenset(
+    {"jnp", "np", "numpy", "jax", "lax", "math", "cmath", "operator", "functools", "itertools", "torch", "tf", "scipy", "jsp"}
+)
+
+#: per-host entropy: host RNG modules, the host clock, host identity.
+_RNG_ROOTS = frozenset({"random", "secrets", "uuid"})
+_TIME_FNS = frozenset({"time", "time_ns", "perf_counter", "monotonic", "process_time", "thread_time"})
+_HOST_ID_FNS = frozenset({"gethostname", "getpid", "urandom", "getrandbits", "gethostbyname"})
+
+#: filesystem READS — per-host state (a file may exist on one host only).
+_FS_READ_NAMES = frozenset(
+    {
+        "exists",
+        "isfile",
+        "isdir",
+        "is_file",
+        "is_dir",
+        "listdir",
+        "iterdir",
+        "glob",
+        "rglob",
+        "stat",
+        "getsize",
+        "getmtime",
+        "read_text",
+        "read_bytes",
+    }
+)
+
+#: filesystem WRITES, by final attribute (pathlib style, receiver is the
+#: target) and by ``module.fn`` chain (target is the first argument).
+_PATHLIB_WRITE_ATTRS = frozenset(
+    {"write_text", "write_bytes", "mkdir", "touch", "unlink", "rmdir", "rename", "replace", "symlink_to"}
+)
+_OS_WRITE_FNS = frozenset({"makedirs", "mkdir", "remove", "unlink", "rename", "replace", "rmdir", "symlink"})
+_SHUTIL_WRITE_FNS = frozenset({"rmtree", "copy", "copy2", "copyfile", "copytree", "move"})
+
+#: experiment-tracker surfaces (module-level SDK roots, or a receiver
+#: *named* ``tracker``/``writer``).
+_TRACKER_ROOTS = frozenset({"wandb", "mlflow", "neptune", "comet_ml", "clearml", "aim", "swanlab", "tensorboard"})
+_TRACKER_METHODS = frozenset(
+    {"log", "add_scalar", "add_text", "add_image", "log_metric", "log_metrics", "log_artifact", "log_table", "log_images"}
+)
+
+#: names whose presence in an entry marks it "rank-aware" — TPU405 only
+#: fires in rank-aware code (a pure IO helper's caller owns the guard).
+_RANK_MARKERS = (
+    DIVERGENT_ATTRS
+    | BARRIER_CALLS
+    | {"main_process_first", "local_main_process_first", "on_main_process", "split_between_processes"}
+)
+
+
+#: decorators that make a function body run on ONE rank only (the
+#: reference's ``@on_main_process`` family) — the body is skipped entirely
+#: on every other rank, so a barrier inside one is itself a deadlock.
+_SOLO_DECORATORS = {"on_main_process": 0, "on_local_main_process": 0, "on_process": 0, "on_last_process": -1}
+
+
+def solo_rank(fn, n_ranks: int) -> Optional[int]:
+    """The single rank a decorated function runs on, or ``None`` when the
+    function runs everywhere."""
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _final_name(target)
+        if name in _SOLO_DECORATORS:
+            r = _SOLO_DECORATORS[name]
+            return r % n_ranks
+    return None
+
+
+def _attr_per_rank(attr: str, n: int) -> Optional[tuple]:
+    if attr in ("process_index", "process_index_host", "local_process_index"):
+        return tuple(range(n))
+    if attr in ("is_main_process", "is_local_main_process"):
+        return tuple(i == 0 for i in range(n))
+    if attr == "is_last_process":
+        return tuple(i == n - 1 for i in range(n))
+    return None
+
+
+# -- AST helpers ----------------------------------------------------------
+
+
+def _attr_chain(node: ast.AST) -> list:
+    out = []
+    while isinstance(node, ast.Attribute):
+        out.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        out.append(node.id)
+        out.reverse()
+        return out
+    return []
+
+
+def _final_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _scan_rank_aware(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_MARKERS:
+            return True
+        if isinstance(n, ast.Name) and n.id in _RANK_MARKERS:
+            return True
+    return False
+
+
+def _scan_sync_sites(node: ast.AST) -> list:
+    """(line, name) of every lexical sync call site in the entry — used to
+    decide whether a divergent early exit can actually skip a barrier."""
+    sites = []
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        fname = _final_name(n.func)
+        root = _attr_chain(n.func)[:1]
+        if fname in BARRIER_CALLS or (fname in JAX_COLLECTIVES and root != ["np"] and root != ["numpy"]):
+            sites.append((n.lineno, fname))
+        elif fname in COLLECTIVE_EFFECTS and COLLECTIVE_EFFECTS[fname].events:
+            sites.append((n.lineno, fname))
+        elif fname in ACCELERATOR_EFFECTS and ACCELERATOR_EFFECTS[fname].events and (root and root[0]) not in _NUMERIC_ROOTS:
+            sites.append((n.lineno, fname))
+    sites.sort()
+    return sites
+
+
+_BINOPS = {
+    ast.Add: operator.add,
+    ast.Sub: operator.sub,
+    ast.Mult: operator.mul,
+    ast.Div: operator.truediv,
+    ast.FloorDiv: operator.floordiv,
+    ast.Mod: operator.mod,
+    ast.Pow: operator.pow,
+    ast.BitAnd: operator.and_,
+    ast.BitOr: operator.or_,
+    ast.BitXor: operator.xor,
+}
+
+_CMPOPS = {
+    ast.Eq: operator.eq,
+    ast.NotEq: operator.ne,
+    ast.Lt: operator.lt,
+    ast.LtE: operator.le,
+    ast.Gt: operator.gt,
+    ast.GtE: operator.ge,
+    ast.Is: lambda a, b: a is b,
+    ast.IsNot: lambda a, b: a is not b,
+}
+
+
+# -- control-flow signals -------------------------------------------------
+
+
+class _ControlFlow(Exception):
+    pass
+
+
+class _Return(_ControlFlow):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(_ControlFlow):
+    pass
+
+
+class _Continue(_ControlFlow):
+    pass
+
+
+class _Abort(_ControlFlow):
+    """An uncaught ``raise`` (or exhausted node budget): the rank's
+    execution of this entry ends here."""
+
+
+@dataclass
+class Ctx:
+    """An active divergence context: 'we are inside a branch/loop whose
+    condition may differ across ranks'."""
+
+    kind: str  # "if" | "loop"
+    origin: str
+    line: int
+
+    @property
+    def desc(self) -> str:
+        return f"{self.origin or 'a rank-divergent condition'} (line {self.line})"
+
+
+# -- the simulator --------------------------------------------------------
+
+
+class ModuleSimulator:
+    """Symbolically execute a module's entry points for ``n_ranks``
+    synthetic ranks. Entries are the module body, every top-level function,
+    and every method of top-level classes; each is run under two 'worlds'
+    (uniform-unknown branches all-then vs all-else) so both arms of
+    ordinary config branches get coverage without path explosion."""
+
+    def __init__(self, tree: ast.Module, path: str = "<string>", n_ranks: int = 3, follow_calls: int = 1, node_budget: int = 60000):
+        self.tree = tree
+        self.path = path
+        self.n_ranks = max(2, n_ranks)
+        self.follow_calls = follow_calls
+        self.node_budget = node_budget
+        self.functions = {}
+        self.methods = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.methods[node.name] = {
+                    n.name: n for n in node.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+
+    def entries(self):
+        yield ("<module>", 1, None, None)
+        for name, fn in self.functions.items():
+            yield (name, fn.lineno, fn, None)
+        for cls, meths in self.methods.items():
+            for name, fn in meths.items():
+                yield (f"{cls}.{name}", fn.lineno, fn, cls)
+
+    def run(self, entry: Optional[str] = None) -> list:
+        results = []
+        for name, line, fn, cls in self.entries():
+            if entry is not None and name != entry and name.split(".")[-1] != entry:
+                continue
+            for world in ("then", "else"):
+                try:
+                    results.append(self._simulate(name, line, fn, cls, world))
+                except Exception:  # a malformed entry must never kill the lint run
+                    continue
+        return results
+
+    def _simulate(self, name, line, fn, cls, world) -> EntryResult:
+        scope_node = fn if fn is not None else self.tree
+        rank_aware = _scan_rank_aware(scope_node)
+        sync_sites = _scan_sync_sites(scope_node)
+        only_rank = solo_rank(fn, self.n_ranks) if fn is not None else None
+        traces, notes = [], []
+        for rank in range(self.n_ranks):
+            run = _RankRun(self, rank, world, cls, sync_sites)
+            try:
+                if only_rank is not None and rank != only_rank:
+                    pass  # @on_main_process-style guard: body is a no-op here
+                elif fn is not None:
+                    run.bind_params(fn)
+                    run.exec_block(fn.body)
+                else:
+                    run.exec_block(self.tree.body)
+            except _ControlFlow:
+                pass
+            except RecursionError:
+                run.truncated = True
+            traces.append(RankTrace(rank, run.events, run.truncated))
+            notes.extend(run.notes)
+        seen, uniq = set(), []
+        for n in notes:
+            key = (n.kind, n.line, n.name, n.skipped_line)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(n)
+        return EntryResult(name, line, world, traces, uniq, rank_aware)
+
+
+class _RankRun:
+    """One rank's symbolic execution of one entry under one world."""
+
+    def __init__(self, sim: ModuleSimulator, rank: int, world: str, cls: Optional[str], sync_sites: list):
+        self.sim = sim
+        self.rank = rank
+        self.world = world
+        self.cls = cls
+        self.sync_sites = sync_sites
+        self.events: list = []
+        self.notes: list = []
+        self.scopes: list = [{}]
+        self.nested_funcs: dict = {}
+        self.ctx: list = []
+        self.serialized = 0
+        self.try_depth = 0
+        self.depth = 0
+        self.active_calls: list = []
+        self.nodes = 0
+        self.truncated = False
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _tick(self):
+        self.nodes += 1
+        if self.nodes > self.sim.node_budget:
+            self.truncated = True
+            raise _Abort()
+
+    def bind(self, name: str, value: Value):
+        self.scopes[-1][name] = value
+
+    def lookup(self, name: str) -> Value:
+        for scope in reversed(self.scopes):
+            if name in scope:
+                return scope[name]
+        return UNKNOWN
+
+    def bind_params(self, fn, args: Optional[list] = None, kwargs: Optional[dict] = None):
+        params = [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+        args = args or []
+        kwargs = kwargs or {}
+        for i, p in enumerate(params):
+            if p in ("self", "cls"):
+                self.bind(p, UNKNOWN)
+                continue
+            self.bind(p, args[i] if i < len(args) else kwargs.get(p, UNKNOWN))
+        for a in fn.args.kwonlyargs:
+            self.bind(a.arg, kwargs.get(a.arg, UNKNOWN))
+        if fn.args.vararg:
+            self.bind(fn.args.vararg.arg, join_values(*args[len(params):]) if len(args) > len(params) else UNKNOWN)
+        if fn.args.kwarg:
+            self.bind(fn.args.kwarg.arg, UNKNOWN)
+
+    def emit(self, kind: str, name: str, line: int):
+        if kind in ("barrier", "collective"):
+            loop = next((c for c in self.ctx if c.kind == "loop"), None)
+            if loop is not None:
+                self.notes.append(Note("loop_collective", line, name, loop.desc))
+            if self.serialized:
+                self.notes.append(Note("serialized_sync", line, name, "main_process_first"))
+        self.events.append(Event(kind, name, line, tuple(c.desc for c in self.ctx)))
+
+    def _note_divergent_exit(self, node, exit_kind: str):
+        inner = next((c for c in reversed(self.ctx) if c.kind == "if"), None)
+        later = next(((ln, nm) for ln, nm in self.sync_sites if ln > node.lineno), None)
+        if inner is not None and later is not None:
+            self.notes.append(
+                Note("divergent_exit", node.lineno, exit_kind, inner.desc, skipped_line=later[0], skipped_name=later[1])
+            )
+
+    # -- statements -------------------------------------------------------
+
+    def exec_block(self, stmts):
+        for s in stmts:
+            self.exec_stmt(s)
+
+    def exec_stmt(self, node):
+        self._tick()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.nested_funcs[node.name] = node
+            self.bind(node.name, UNKNOWN)
+        elif isinstance(node, ast.ClassDef):
+            self.bind(node.name, UNKNOWN)
+        elif isinstance(node, ast.Return):
+            raise _Return(self.eval(node.value) if node.value is not None else UNKNOWN)
+        elif isinstance(node, ast.Assign):
+            self._exec_assign(node.targets, node.value)
+        elif isinstance(node, ast.AugAssign):
+            v = self.eval(node.value)
+            if isinstance(node.target, ast.Name):
+                self.bind(node.target.id, join_values(self.lookup(node.target.id), v))
+            else:
+                self.eval(node.target.value) if isinstance(node.target, (ast.Attribute, ast.Subscript)) else None
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self.assign_target(node.target, self.eval(node.value))
+        elif isinstance(node, ast.Expr):
+            self.eval(node.value)
+        elif isinstance(node, ast.If):
+            self._exec_if(node)
+        elif isinstance(node, ast.While):
+            cond = self.eval(node.test)
+            self._exec_loop(node, divergent=cond.divergent, origin=cond.origin)
+        elif isinstance(node, ast.For) or isinstance(node, ast.AsyncFor):
+            it = self.eval(node.iter)
+            self.assign_target(node.target, Value(it.taint, None, it.origin))
+            self._exec_loop(node, divergent=it.divergent, origin=it.origin)
+        elif isinstance(node, ast.Break):
+            if any(c.kind == "if" for c in self.ctx):
+                self._note_divergent_exit(node, "break")
+            raise _Break()
+        elif isinstance(node, ast.Continue):
+            if any(c.kind == "if" for c in self.ctx):
+                self._note_divergent_exit(node, "continue")
+            raise _Continue()
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self.eval(node.exc)
+            if self.try_depth > 0 and any(c.kind == "if" for c in self.ctx):
+                self._note_divergent_exit(node, "raise")
+            raise _Abort()
+        elif isinstance(node, ast.Try):
+            self._exec_try(node)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            self._exec_with(node)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                self.bind((a.asname or a.name).split(".")[0], UNKNOWN)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    self.bind(a.asname or a.name, UNKNOWN)
+        elif isinstance(node, ast.Assert):
+            self.eval(node.test)
+        elif isinstance(node, (ast.Global, ast.Nonlocal, ast.Pass, ast.Delete)):
+            pass
+        elif isinstance(node, ast.Match):
+            self.eval(node.subject)  # case bodies skipped: rare, and exploring all would fake events
+        # anything else: ignore conservatively
+
+    def _exec_assign(self, targets, value_node):
+        # pairwise tuple unpack keeps `pc, pi = process_count(), process_index()`
+        # from tainting both names
+        if (
+            isinstance(value_node, (ast.Tuple, ast.List))
+            and len(targets) == 1
+            and isinstance(targets[0], (ast.Tuple, ast.List))
+            and len(targets[0].elts) == len(value_node.elts)
+        ):
+            for t, v in zip(targets[0].elts, value_node.elts):
+                self.assign_target(t, self.eval(v))
+            return
+        v = self.eval(value_node)
+        for t in targets:
+            self.assign_target(t, v)
+
+    def assign_target(self, target, value: Value):
+        if isinstance(target, ast.Name):
+            self.bind(target.id, value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign_target(e, Value(value.taint, None, value.origin))
+        elif isinstance(target, ast.Starred):
+            self.assign_target(target.value, value)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            self.eval(target.value)
+
+    def _branch_choice(self, cond: Value, line: int) -> bool:
+        if cond.per_rank is not None:
+            v = cond.per_rank[self.rank]
+            if v is not None:
+                return bool(v)
+        # unknown-but-divergent (a per-host RNG/file check): ranks may split
+        # either way — rank parity guarantees the synthetic ranks disagree
+        return self.rank % 2 == 0
+
+    @staticmethod
+    def _const_truth(cond: Value):
+        if cond.per_rank is not None and all(v is not None for v in cond.per_rank):
+            truths = {bool(v) for v in cond.per_rank}
+            if len(truths) == 1:
+                return truths.pop()
+        return None
+
+    def _exec_if(self, node: ast.If):
+        cond = self.eval(node.test)
+        if cond.divergent:
+            take_then = self._branch_choice(cond, node.lineno)
+            self.ctx.append(Ctx("if", cond.origin, node.lineno))
+            try:
+                self.exec_block(node.body if take_then else node.orelse)
+            finally:
+                self.ctx.pop()
+            return
+        known = self._const_truth(cond)
+        if known is True:
+            self.exec_block(node.body)
+        elif known is False:
+            self.exec_block(node.orelse)
+        else:
+            # uniform-unknown: all ranks agree — the 'world' picks the arm
+            self.exec_block(node.body if (self.world == "then" or not node.orelse) else node.orelse)
+
+    def _exec_loop(self, node, divergent: bool, origin: str):
+        if divergent:
+            self.ctx.append(Ctx("loop", origin, node.lineno))
+        try:
+            try:
+                self.exec_block(node.body)  # body once: trip counts are symbolic
+            except _Break:
+                pass
+            except _Continue:
+                pass
+        finally:
+            if divergent:
+                self.ctx.pop()
+        self.exec_block(node.orelse)
+
+    def _exec_try(self, node: ast.Try):
+        has_handlers = bool(node.handlers)
+        if has_handlers:
+            self.try_depth += 1
+        aborted = False
+        pending = None
+        try:
+            try:
+                self.exec_block(node.body)
+            except _Abort:
+                aborted = True
+            except _ControlFlow as cf:
+                pending = cf
+        finally:
+            if has_handlers:
+                self.try_depth -= 1
+        if aborted and has_handlers:
+            h = node.handlers[0]
+            if h.name:
+                self.bind(h.name, UNKNOWN)
+            self.exec_block(h.body)
+        if not aborted and pending is None:
+            self.exec_block(node.orelse)
+        self.exec_block(node.finalbody)
+        if pending is not None:
+            raise pending
+        if aborted and not has_handlers:
+            raise _Abort()
+
+    def _exec_with(self, node):
+        serialized_here = 0
+        exit_lines = []
+        for item in node.items:
+            ce = item.context_expr
+            v = None
+            if isinstance(ce, ast.Call):
+                fname = _final_name(ce.func)
+                if fname in ("main_process_first", "local_main_process_first"):
+                    # every rank passes the enter fence once and the exit
+                    # fence once (main runs the body first; order differs,
+                    # the trace does not)
+                    for a in ce.args:
+                        self.eval(a)
+                    self.emit("barrier", f"{fname}/enter", ce.lineno)
+                    serialized_here += 1
+                    exit_lines.append((fname, ce.lineno))
+                    v = UNKNOWN
+                elif fname == "split_between_processes":
+                    for a in ce.args:
+                        self.eval(a)
+                    v = Value(DIVERGENT, None, "split_between_processes")
+            if v is None:
+                v = self.eval(ce)
+            if item.optional_vars is not None:
+                self.assign_target(item.optional_vars, v)
+        self.serialized += serialized_here
+        try:
+            self.exec_block(node.body)
+        finally:
+            self.serialized -= serialized_here
+            for fname, line in exit_lines:
+                self.emit("barrier", f"{fname}/exit", line)
+
+    # -- expressions ------------------------------------------------------
+
+    def eval(self, node) -> Value:
+        self._tick()
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, (bool, int, str)):
+                return Value(UNIFORM, (node.value,) * self.sim.n_ranks)
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in DIVERGENT_ATTRS:
+                self.eval(node.value)
+                return Value(DIVERGENT, _attr_per_rank(node.attr, self.sim.n_ranks), node.attr)
+            recv = self.eval(node.value)
+            return Value(recv.taint, None, recv.origin)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.BoolOp):
+            return self._eval_boolop(node)
+        if isinstance(node, ast.BinOp):
+            left, right = self.eval(node.left), self.eval(node.right)
+            op = _BINOPS.get(type(node.op))
+            return self._fold([left, right], op) if op else join_values(left, right)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand)
+            if isinstance(node.op, ast.Not):
+                return self._fold([v], operator.not_)
+            return v
+        if isinstance(node, ast.Compare):
+            vals = [self.eval(node.left)] + [self.eval(c) for c in node.comparators]
+            if len(vals) == 2:
+                op = _CMPOPS.get(type(node.ops[0]))
+                if op is not None:
+                    return self._fold(vals, op)
+            return join_values(*vals)
+        if isinstance(node, ast.IfExp):
+            return join_values(self.eval(node.test), self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return join_values(*[self.eval(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            vals = [self.eval(k) for k in node.keys if k is not None] + [self.eval(v) for v in node.values]
+            return join_values(*vals) if vals else UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return join_values(self.eval(node.value), self.eval(node.slice))
+        if isinstance(node, ast.Slice):
+            return join_values(*[self.eval(x) for x in (node.lower, node.upper, node.step) if x is not None])
+        if isinstance(node, ast.JoinedStr):
+            return join_values(*[self.eval(v) for v in node.values])
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value)
+        if isinstance(node, ast.Lambda):
+            return UNKNOWN
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            return self._eval_comp(node)
+        if isinstance(node, ast.NamedExpr):
+            v = self.eval(node.value)
+            self.assign_target(node.target, v)
+            return v
+        if isinstance(node, ast.Await):
+            return self.eval(node.value)
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            if node.value is not None:
+                self.eval(node.value)
+            return UNKNOWN
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value)
+        return UNKNOWN
+
+    def _fold(self, vals: list, fn) -> Value:
+        n = self.sim.n_ranks
+        per_rank = None
+        if all(v.per_rank is not None for v in vals):
+            out = []
+            for i in range(n):
+                xs = [v.per_rank[i] for v in vals]
+                if any(x is None for x in xs):
+                    out.append(None)
+                else:
+                    try:
+                        out.append(fn(*xs))
+                    except Exception:
+                        out.append(None)
+            per_rank = tuple(out)
+        joined = join_values(*vals)
+        if per_rank is not None and all(x is not None for x in per_rank) and len(set(per_rank)) == 1:
+            return Value(UNIFORM, per_rank)  # same everywhere -> uniform again
+        return Value(joined.taint, per_rank, joined.origin)
+
+    def _eval_boolop(self, node: ast.BoolOp) -> Value:
+        vals = [self.eval(v) for v in node.values]
+        is_and = isinstance(node.op, ast.And)
+        n = self.sim.n_ranks
+        out = []
+        for i in range(n):
+            acc = True if is_and else False
+            unknown = False
+            for v in vals:
+                if v.per_rank is not None and v.per_rank[i] is not None:
+                    x = bool(v.per_rank[i])
+                elif v.divergent:
+                    unknown = True
+                    continue
+                else:
+                    # uniform-unknown (a config flag): assume the neutral
+                    # element so the *divergent* operand decides the branch
+                    x = True if is_and else False
+                if is_and and not x:
+                    acc, unknown = False, False
+                    break
+                if not is_and and x:
+                    acc, unknown = True, False
+                    break
+            out.append(None if unknown else acc)
+        per_rank = tuple(out)
+        joined = join_values(*vals)
+        if all(x is not None for x in per_rank) and len(set(per_rank)) == 1 and not joined.divergent:
+            return Value(UNIFORM, per_rank)
+        return Value(joined.taint, per_rank if any(x is not None for x in per_rank) else None, joined.origin)
+
+    def _eval_comp(self, node) -> Value:
+        self.scopes.append({})
+        try:
+            taints = []
+            for gen in node.generators:
+                it = self.eval(gen.iter)
+                taints.append(it)
+                self.assign_target(gen.target, Value(it.taint, None, it.origin))
+                for cond in gen.ifs:
+                    self.eval(cond)
+            if isinstance(node, ast.DictComp):
+                taints.append(self.eval(node.key))
+                taints.append(self.eval(node.value))
+            else:
+                taints.append(self.eval(node.elt))
+            return join_values(*taints)
+        finally:
+            self.scopes.pop()
+
+    # -- calls ------------------------------------------------------------
+
+    def _eval_call(self, node: ast.Call) -> Value:
+        fn = node.func
+        chain = _attr_chain(fn)
+        fname = _final_name(fn)
+        root = chain[0] if chain else ""
+        is_method = isinstance(fn, ast.Attribute)
+        recv_name = fn.value.id if is_method and isinstance(fn.value, ast.Name) else ""
+        line = node.lineno
+
+        recv = self.eval(fn.value) if is_method else (UNKNOWN if chain else self.eval(fn))
+        argv = [self.eval(a.value if isinstance(a, ast.Starred) else a) for a in node.args]
+        kwv = {kw.arg: self.eval(kw.value) for kw in node.keywords}
+
+        # 1. host barriers
+        if fname in BARRIER_CALLS:
+            self.emit("barrier", fname, line)
+            return UNKNOWN
+        # 2. jax-level collectives (lax.psum & co; a collective's result is
+        #    by construction identical on every participant -> uniform)
+        if fname in JAX_COLLECTIVES and root not in ("np", "numpy"):
+            self.emit("collective", fname, line)
+            return UNKNOWN
+        # 3. the rank itself, in call form
+        if fname in ("axis_index", "process_index"):
+            return Value(DIVERGENT, tuple(range(self.sim.n_ranks)), fname)
+        # 4. parallel.collectives wrappers (the shard_map vocabulary)
+        if fname in COLLECTIVE_EFFECTS:
+            eff = COLLECTIVE_EFFECTS[fname]
+            self._apply_effect(eff, fname, line)
+            return Value(eff.returns, tuple(range(self.sim.n_ranks)) if eff.returns == DIVERGENT else None, fname)
+        # 5. Accelerator / PartialState effect summaries
+        if fname in ACCELERATOR_EFFECTS and root not in _NUMERIC_ROOTS:
+            eff = ACCELERATOR_EFFECTS[fname]
+            self._apply_effect(eff, fname, line)
+            return Value(eff.returns, None, fname)
+        # 6. per-host entropy: RNG, clock, host identity, filesystem reads
+        if (
+            root in _RNG_ROOTS
+            or (root in ("np", "numpy") and "random" in chain)
+            or (root == "time" and fname in _TIME_FNS)
+            or fname in _HOST_ID_FNS
+            or fname in _FS_READ_NAMES
+        ):
+            return Value(DIVERGENT, None, ".".join(chain) or fname)
+        if fname == "open" and not is_method:
+            mode = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) and isinstance(node.args[1].value, str):
+                mode = node.args[1].value
+            elif "mode" in kwv and isinstance(node.keywords[0].value, ast.Constant):
+                mode = str(next((k.value.value for k in node.keywords if k.arg == "mode" and isinstance(k.value, ast.Constant)), ""))
+            if any(c in mode for c in "wax+"):
+                self._write_event(f"open({mode!r})", argv[0] if argv else UNKNOWN, line)
+                return UNKNOWN
+            return Value(DIVERGENT, None, "open() read")  # per-host file contents
+        # 7. filesystem writes / tracker calls (TPU405 raw material)
+        if is_method and fname in _PATHLIB_WRITE_ATTRS:
+            self._write_event(fname, recv, line)
+            return UNKNOWN
+        if (root == "os" or chain[:2] == ["os", "path"]) and fname in _OS_WRITE_FNS:
+            self._write_event(f"os.{fname}", argv[0] if argv else UNKNOWN, line)
+            return UNKNOWN
+        if root == "shutil" and fname in _SHUTIL_WRITE_FNS:
+            self._write_event(f"shutil.{fname}", argv[0] if argv else UNKNOWN, line)
+            return UNKNOWN
+        if (root in _TRACKER_ROOTS and (fname.startswith("log") or fname.startswith("add_"))) or (
+            recv_name in ("tracker", "writer") and fname in _TRACKER_METHODS
+        ):
+            if not self.serialized:
+                self.events.append(Event("tracker", ".".join(chain) or fname, line, tuple(c.desc for c in self.ctx)))
+            return UNKNOWN
+        # 8. interprocedural: follow calls one level deep within this file
+        target = self._resolve_local(fname, is_method, recv_name)
+        if target is not None and self.depth < self.sim.follow_calls and fname not in self.active_calls:
+            return self._call_function(target, argv, kwv, fname)
+        # 9. default: taint propagates through unknown calls
+        vals = ([recv] if is_method else []) + argv + list(kwv.values())
+        return join_values(*vals) if vals else UNKNOWN
+
+    def _apply_effect(self, eff: CallEffect, fname: str, line: int):
+        for ev in eff.events:
+            kind, _, name = ev.partition(":")
+            self.emit(kind, name or fname, line)
+
+    def _write_event(self, name: str, target: Value, line: int):
+        # rank-namespaced targets (path contains process_index) and
+        # main_process_first bodies (serialized by design) are safe
+        if self.serialized or target.divergent:
+            return
+        self.events.append(Event("write", name, line, tuple(c.desc for c in self.ctx)))
+
+    def _resolve_local(self, fname: str, is_method: bool, recv_name: str):
+        if not is_method:
+            return self.nested_funcs.get(fname) or self.sim.functions.get(fname)
+        if recv_name in ("self", "cls") and self.cls is not None:
+            return self.sim.methods.get(self.cls, {}).get(fname)
+        return None
+
+    def _call_function(self, fn, argv: list, kwv: dict, fname: str) -> Value:
+        only = solo_rank(fn, self.sim.n_ranks)
+        if only is not None and self.rank != only:
+            return UNKNOWN  # @on_main_process-style guard: no-op on this rank
+        self.scopes.append({})
+        self.depth += 1
+        self.active_calls.append(fname)
+        try:
+            self.bind_params(fn, argv, kwv)
+            self.exec_block(fn.body)
+        except _Return as r:
+            return r.value
+        except (_Break, _Continue):
+            pass
+        finally:
+            self.active_calls.pop()
+            self.depth -= 1
+            self.scopes.pop()
+        return UNKNOWN

@@ -15,6 +15,11 @@ static_analysis.md for the worked catalogue):
 * ``TPU3xx`` — SPMD flight-check rules over the traced program
   (``analysis.flightcheck``): collective deadlock under value-dependent
   control flow, implicit reshards, donation defeated by late reads.
+* ``TPU4xx`` — multi-host divergence rules (``analysis.divergence``):
+  the abstract multi-rank interpreter (``analysis.ranksim``) executes a
+  script for k synthetic ranks and diffs the per-rank collective traces —
+  a collective or barrier that not every rank reaches is a guaranteed
+  all-host hang with no error.
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -35,6 +40,7 @@ TIER_REPO = "repo"
 TIER_JAXPR = "jaxpr"
 TIER_AST = "ast"
 TIER_FLIGHT = "flight"
+TIER_DIVERGENCE = "divergence"
 
 
 @dataclass(frozen=True)
@@ -70,6 +76,12 @@ RULES: dict[str, Rule] = {
         Rule("TPU301", "collective-in-dynamic-control-flow", ERROR, TIER_FLIGHT, "collective inside a value-dependent cond/while body (SPMD deadlock)"),
         Rule("TPU302", "implicit-reshard", WARNING, TIER_FLIGHT, "conflicting sharding constraints force GSPMD to all-gather/reshard"),
         Rule("TPU303", "donation-defeated", WARNING, TIER_FLIGHT, "donated buffer read after its aliased output is produced (defensive copy)"),
+        # -- tier 4: multi-host divergence (analysis.divergence) -----------
+        Rule("TPU401", "collective-under-divergent-guard", ERROR, TIER_DIVERGENCE, "collective or barrier not reached by every rank (rank-divergent guard — guaranteed deadlock)"),
+        Rule("TPU402", "collective-in-divergent-loop", ERROR, TIER_DIVERGENCE, "collective inside a loop whose trip count is rank-divergent (per-host filesystem/RNG)"),
+        Rule("TPU403", "mismatched-collective-order", ERROR, TIER_DIVERGENCE, "ranks execute collectives in different orders across rank-divergent branches"),
+        Rule("TPU404", "divergent-early-exit", WARNING, TIER_DIVERGENCE, "rank-divergent break/continue/raise can skip a later barrier"),
+        Rule("TPU405", "unguarded-host-side-effect", WARNING, TIER_DIVERGENCE, "host file write or tracker call executed by every rank in rank-aware code"),
     )
 }
 

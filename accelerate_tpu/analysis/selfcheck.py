@@ -5,9 +5,11 @@ This is the executable spec of the rule catalogue: each fixture seeds
 exactly the defect its rule exists to catch — a wrong collective axis, a
 silent bf16->f32 promotion, a missed donation, an unconstrained output
 sharding, a host sync inside jit, a tracer-dependent branch, an unhashable
-static default, an eager module-scope jax import, and (flight tier) a
+static default, an eager module-scope jax import, (flight tier) a
 collective under ``lax.cond``, a conflicting re-constraint, and a donated
-buffer read after its aliased output exists. A CI run that passes
+buffer read after its aliased output exists, plus (divergence tier) one
+seeded multi-host deadlock/hazard per TPU4xx rule and a clean idiomatic
+rank-aware script that must produce zero findings. A CI run that passes
 selfcheck has proven the linter end-to-end on the CPU backend, so a clean
 repo lint actually means something.
 
@@ -20,6 +22,7 @@ from __future__ import annotations
 import textwrap
 
 from .ast_lint import LintConfig, lint_source
+from .divergence import analyze_source
 from .flightcheck import flight_check
 from .jaxpr_lint import lint_step
 from .rules import Finding
@@ -87,6 +90,132 @@ _AST_CONFIGS = {
     "TPU001": LintConfig(select=frozenset({"TPU001"})),
     "TPU002": LintConfig(select=frozenset({"TPU002"})),
 }
+
+
+# -- divergence-tier fixtures (multi-rank simulation, no jax) -------------
+
+#: one seeded deadlock/hazard per TPU4xx rule. Each source is analyzed for
+#: 3 synthetic ranks; the named rule must fire. ``CLEAN`` is the executable
+#: negative: an idiomatic rank-aware training script that must produce
+#: ZERO findings — the analyzer's false-positive budget on real user code.
+_DIVERGENCE_FIXTURES = {
+    "TPU401": textwrap.dedent(
+        '''
+        """Fixture: gather under a main-process guard — non-main ranks never arrive."""
+
+
+        def evaluate(accelerator, metrics):
+            if accelerator.is_main_process:
+                return accelerator.gather(metrics)
+            return None
+        '''
+    ),
+    "TPU402": textwrap.dedent(
+        '''
+        """Fixture: collective inside a per-host-trip-count loop."""
+        import os
+
+
+        def drain(accelerator):
+            for shard in os.listdir("/data"):
+                accelerator.reduce(shard)
+        '''
+    ),
+    "TPU403": textwrap.dedent(
+        '''
+        """Fixture: both branches sync, in different orders."""
+
+
+        def step(accelerator, x):
+            if accelerator.is_main_process:
+                x = accelerator.gather(x)
+                accelerator.wait_for_everyone()
+            else:
+                accelerator.wait_for_everyone()
+                x = accelerator.gather(x)
+            return x
+        '''
+    ),
+    "TPU404": textwrap.dedent(
+        '''
+        """Fixture: rank-divergent break can skip the end-of-loop barrier."""
+
+
+        def loop(accelerator, batches):
+            for batch in batches:
+                if accelerator.process_index > 0:
+                    break
+                accelerator.backward(batch)
+            accelerator.wait_for_everyone()
+        '''
+    ),
+    "TPU405": textwrap.dedent(
+        '''
+        """Fixture: every host writes the same summary file."""
+        import os
+
+
+        def finish(accelerator, payload):
+            os.makedirs("out")
+            with open("out/summary.json", "w") as fh:
+                fh.write(payload)
+            accelerator.wait_for_everyone()
+        '''
+    ),
+    "CLEAN": textwrap.dedent(
+        '''
+        """Fixture: idiomatic rank-aware training script — must check clean."""
+        import os
+
+
+        def main(accelerator, batches, model):
+            model = accelerator.prepare(model)
+            loss = None
+            for batch in batches:
+                loss = train_step(model, batch)
+                accelerator.backward(loss)
+            metrics = accelerator.gather_for_metrics(loss)
+            if accelerator.is_main_process:
+                os.makedirs("out")
+                with open("out/metrics.json", "w") as fh:
+                    fh.write(str(metrics))
+            accelerator.wait_for_everyone()
+            accelerator.save_state("ckpt")
+            with accelerator.main_process_first():
+                data = load_dataset()
+            accelerator.end_training()
+            return data
+
+
+        def train_step(model, batch):
+            return batch
+
+
+        def load_dataset():
+            return []
+        '''
+    ),
+}
+
+
+def run_divergence_selfcheck(n_ranks: int = 3) -> tuple[bool, list[str]]:
+    """Prove TPU401-TPU405 each fire on their seeded fixture and the clean
+    idiomatic script yields zero findings."""
+    lines: list[str] = []
+    ok = True
+    for rule, source in sorted(_DIVERGENCE_FIXTURES.items()):
+        found = analyze_source(source, path=f"<selfcheck:{rule}>", n_ranks=n_ranks)
+        if rule == "CLEAN":
+            quiet = not found
+            ok &= quiet
+            lines.append(
+                f"[selfcheck] clean idiomatic script: {'zero findings' if quiet else 'DIRTY: ' + ', '.join(f.rule for f in found)}"
+            )
+            continue
+        fired = any(f.rule == rule for f in found)
+        ok &= fired
+        lines.append(f"[selfcheck] {rule} divergence fixture: {'detected' if fired else 'MISSED'}")
+    return ok, lines
 
 
 def _jaxpr_fixtures(mesh):
@@ -193,6 +322,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
         fired = any(f.rule == rule for f in report.findings)
         ok &= fired
         lines.append(f"[selfcheck] {rule} flight fixture: {'detected' if fired else 'MISSED'}")
+
+    div_ok, div_lines = run_divergence_selfcheck()
+    ok &= div_ok
+    lines.extend(div_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

@@ -7,6 +7,7 @@ import argparse
 
 from .checkpoints import checkpoints_parser
 from .config import config_parser
+from .divergence import divergence_parser
 from .env import env_parser
 from .estimate import estimate_parser
 from .flightcheck import flightcheck_parser
@@ -31,6 +32,7 @@ def main():
     estimate_parser(subparsers)
     lint_parser(subparsers)
     flightcheck_parser(subparsers)
+    divergence_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)
     telemetry_parser(subparsers)

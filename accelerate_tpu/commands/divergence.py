@@ -1,0 +1,103 @@
+"""``accelerate-tpu divergence`` — the multi-host divergence analyzer CLI.
+
+Symbolically executes a training script for k synthetic ranks
+(``analysis.ranksim``) and diffs the per-rank collective traces into the
+TPU4xx rules (``analysis.divergence``): a collective or barrier under a
+rank-divergent guard (TPU401), a collective in a rank-divergent loop
+(TPU402), mismatched collective order across branches (TPU403), a
+divergent early exit that can skip a barrier (TPU404), and unguarded host
+side effects (TPU405). Pure AST interpretation — no jax import, no trace,
+safe anywhere.
+
+Targets are files, directories, or ``file.py::fn`` to restrict one file to
+a single entry point. ``.tpulint.toml`` supplies the default format,
+disabled rules, and per-path suppressions.
+
+Examples::
+
+    accelerate-tpu divergence train.py                 # whole module
+    accelerate-tpu divergence train.py::main --ranks 4 # one entry, 4 ranks
+    accelerate-tpu divergence accelerate_tpu/ --format sarif
+    accelerate-tpu divergence --selfcheck              # prove TPU401-405 fire
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def divergence_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "divergence", help="Multi-host divergence analyzer: prove every rank runs the same collective program"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu divergence")
+    parser.add_argument("targets", nargs="*", help="Files, directories, or file.py::fn entry points")
+    parser.add_argument("--ranks", type=int, default=None, help="Synthetic ranks to simulate (default: 3, or .tpulint.toml)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
+    parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck",
+        action="store_true",
+        help="Prove TPU401-TPU405 fire on seeded deadlocks and the clean fixture stays quiet",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=divergence_command)
+    return parser
+
+
+def _split_ids(raw):
+    return frozenset(p.strip().upper() for p in raw.split(",") if p.strip()) or None
+
+
+def divergence_command(args) -> int:
+    from accelerate_tpu.analysis import exit_code, render_json, render_sarif, render_text
+    from accelerate_tpu.analysis.divergence import analyze_paths
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    fmt = cfg.resolve_format(args.format)
+
+    if not args.targets and not args.selfcheck:
+        print("usage: accelerate-tpu divergence [file.py | file.py::fn | dir ...] [--selfcheck]")
+        return 2
+
+    if args.selfcheck:
+        from accelerate_tpu.analysis.selfcheck import run_divergence_selfcheck
+
+        ok, lines = run_divergence_selfcheck(n_ranks=cfg.resolve_ranks(args.ranks))
+        if fmt == "text":
+            for line in lines:
+                print(line)
+        if not ok:
+            print("divergence selfcheck FAILED: a rule missed its seeded defect (or the clean fixture fired)")
+            return 1
+
+    findings = []
+    if args.targets:
+        findings = analyze_paths(
+            args.targets,
+            n_ranks=cfg.resolve_ranks(args.ranks),
+            select=cfg.merge_select(_split_ids(args.select) if args.select else None),
+            ignore=cfg.merge_ignore(_split_ids(args.ignore) or ()),
+        )
+        findings = cfg.apply_suppressions(findings)
+
+    if fmt == "json":
+        print(render_json(findings))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    elif findings or args.targets:
+        print(render_text(findings))
+    return exit_code(findings, strict=args.strict) if args.targets else 0
+
+
+def main():
+    raise SystemExit(divergence_command(divergence_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -130,7 +130,7 @@ def flightcheck_parser(subparsers=None):
     parser.add_argument("--dcn-axes", default=None, help="axes that cross DCN, e.g. data (default: env/single-slice)")
     parser.add_argument("--generation", default="v5e", help="TPU generation for the bandwidth table (v4/v5e/v5p/v6e)")
     parser.add_argument("--hbm-gb", type=float, default=None, help="per-device HBM; adds a fits/doesn't-fit verdict")
-    parser.add_argument("--format", choices=("text", "json"), default="text", help="Report format")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
     parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
     parser.add_argument(
         "--selfcheck", action="store_true",
@@ -178,22 +178,31 @@ def flightcheck_command(args) -> int:
     donate = tuple(int(p) for p in args.donate.split(",") if p.strip())
     dcn = tuple(a.strip() for a in args.dcn_axes.split(",") if a.strip()) if args.dcn_axes else None
 
-    from accelerate_tpu.analysis import exit_code
+    from accelerate_tpu.analysis import exit_code, render_sarif
     from accelerate_tpu.analysis.flightcheck import flight_check
+    from accelerate_tpu.analysis.project_config import load_project_config
 
+    cfg = load_project_config()
     report = flight_check(
-        fn, *sample_args, mesh=mesh, donate_argnums=donate, dcn=dcn, generation=args.generation
+        fn, *sample_args, mesh=mesh, donate_argnums=donate, dcn=dcn, generation=args.generation,
+        ignore=tuple(cfg.disable),
     )
-    if args.format == "json":
+    findings = cfg.apply_suppressions(report.findings)
+    fmt = cfg.resolve_format(args.format)
+    if fmt == "json":
         import json
 
         print(json.dumps(report.as_dict(), indent=2))
+    elif fmt == "sarif":
+        # same SARIF 2.1.0 reporter the lint CLI uses, so every analysis
+        # tier can feed GitHub code scanning from one upload step
+        print(render_sarif(findings))
     else:
         print(report.render_text())
         if args.hbm_gb is not None:
             verdict = "fits" if report.fits(args.hbm_gb) else "DOES NOT FIT"
             print(f"  verdict: {verdict} in {args.hbm_gb:g} GB/device HBM")
-    return exit_code(report.findings, strict=args.strict)
+    return exit_code(findings, strict=args.strict)
 
 
 def main():

@@ -14,6 +14,10 @@ Examples::
     accelerate-tpu lint pkg/ --format sarif        # CI PR annotation
     accelerate-tpu lint pkg/ --select TPU201,TPU202
 
+A ``.tpulint.toml`` found by walking up from the working directory
+supplies the default ``--format``, globally disabled rules, and per-path
+suppressions (``analysis.project_config``); CLI flags win.
+
 The jaxpr tier for *your* step function is programmatic —
 ``Accelerator.lint(step_fn, *sample_args)`` or
 ``accelerate_tpu.analysis.lint_step`` — because it needs sample shapes
@@ -31,7 +35,7 @@ def lint_parser(subparsers=None):
     else:
         parser = argparse.ArgumentParser("accelerate-tpu lint")
     parser.add_argument("paths", nargs="*", help="Files or directories to lint (.py files)")
-    parser.add_argument("--format", choices=("text", "json", "sarif"), default="text", help="Report format")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
     parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
     parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
     parser.add_argument(
@@ -57,6 +61,10 @@ def _split_ids(raw):
 
 def lint_command(args) -> int:
     from accelerate_tpu.analysis import LintConfig, exit_code, lint_paths, render_json, render_sarif, render_text
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    fmt = cfg.resolve_format(args.format)
 
     if not args.paths and not args.selfcheck:
         print("usage: accelerate-tpu lint [paths ...] [--selfcheck]")
@@ -72,7 +80,7 @@ def lint_command(args) -> int:
         from accelerate_tpu.analysis.selfcheck import run_selfcheck
 
         ok, lines = run_selfcheck()
-        if args.format == "text":
+        if fmt == "text":
             for line in lines:
                 print(line)
         if not ok:
@@ -82,16 +90,16 @@ def lint_command(args) -> int:
     findings = []
     if args.paths:
         config = LintConfig(
-            select=_split_ids(args.select) if args.select else None,
-            ignore=_split_ids(args.ignore) or frozenset(),
+            select=cfg.merge_select(_split_ids(args.select) if args.select else None),
+            ignore=cfg.merge_ignore(_split_ids(args.ignore) or frozenset()),
             lazy_jax=args.lazy_jax,
         )
-        findings = lint_paths(args.paths, config)
+        findings = cfg.apply_suppressions(lint_paths(args.paths, config))
         rc = exit_code(findings, strict=args.strict)
 
-    if args.format == "json":
+    if fmt == "json":
         print(render_json(findings))
-    elif args.format == "sarif":
+    elif fmt == "sarif":
         print(render_sarif(findings))
     elif findings or args.paths:
         print(render_text(findings))

@@ -39,7 +39,8 @@ def merge_command(args) -> int:
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(model_path.absolute())
     model = Model(lambda p: p, params, name="merged")
-    save_model(model, args.output_dir, max_shard_size=args.max_shard_size)
+    # single-process CLI: the exists-raise above cannot strand other ranks
+    save_model(model, args.output_dir, max_shard_size=args.max_shard_size)  # tpu-lint: disable=TPU401
     print(f"Merged weights written to {args.output_dir}")
     return 0
 

@@ -29,16 +29,20 @@ class MultiProcessAdapter(logging.LoggerAdapter):
         in_order = kwargs.pop("in_order", False)
         kwargs.setdefault("stacklevel", 2)
         if self.isEnabledFor(level):
-            if self._should_log(main_process_only):
-                msg, kwargs = self.process(msg, kwargs)
-                self.logger.log(level, msg, *args, **kwargs)
-            elif in_order:
+            # in_order first, unconditionally on every process: the loop body
+            # barriers, so routing only non-main processes here (the old
+            # `elif`) deadlocked whenever main_process_only stayed True —
+            # main logged via the first branch and never met the barrier.
+            if in_order:
                 state = PartialState()
                 for i in range(state.num_processes):
                     if i == state.process_index:
                         msg, kwargs = self.process(msg, kwargs)
                         self.logger.log(level, msg, *args, **kwargs)
                     state.wait_for_everyone()
+            elif self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
 
     @functools.lru_cache(None)
     def warning_once(self, *args, **kwargs):

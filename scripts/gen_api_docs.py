@@ -74,6 +74,9 @@ MODULES = [
     "accelerate_tpu.analysis.jaxpr_lint",
     "accelerate_tpu.analysis.flightcheck",
     "accelerate_tpu.analysis.costmodel",
+    "accelerate_tpu.analysis.ranksim",
+    "accelerate_tpu.analysis.divergence",
+    "accelerate_tpu.analysis.project_config",
     "accelerate_tpu.analysis.report",
     "accelerate_tpu.telemetry",
     "accelerate_tpu.telemetry.eventlog",
@@ -161,6 +164,47 @@ def render_module(modname: str) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
+# -- rules catalogue ------------------------------------------------------
+
+CATALOGUE_PATH = os.path.join(REPO, "docs", "usage_guides", "static_analysis.md")
+CATALOGUE_START = "<!-- rules-catalogue:start (generated by scripts/gen_api_docs.py — do not edit) -->"
+CATALOGUE_END = "<!-- rules-catalogue:end -->"
+
+
+def render_rules_catalogue() -> str:
+    """The full TPU001-TPU405 rule table, generated from the
+    ``analysis.rules`` registry so the doc cannot drift from the code."""
+    from accelerate_tpu.analysis.rules import RULES
+
+    lines = [
+        "| ID | Name | Severity | Tier | Catches |",
+        "|---|---|---|---|---|",
+    ]
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        lines.append(f"| `{r.id}` | {r.name} | {r.severity} | {r.tier} | {r.summary} |")
+    return "\n".join(lines)
+
+
+def embed_rules_catalogue(check: bool) -> bool:
+    """Splice the generated table between the catalogue markers in
+    ``static_analysis.md``. Returns True when the file was already (or is
+    now) up to date; False from --check when it is stale."""
+    with open(CATALOGUE_PATH) as f:
+        text = f.read()
+    start = text.find(CATALOGUE_START)
+    end = text.find(CATALOGUE_END)
+    if start < 0 or end < 0:
+        raise SystemExit(f"{CATALOGUE_PATH}: rules-catalogue markers missing")
+    updated = text[: start + len(CATALOGUE_START)] + "\n" + render_rules_catalogue() + "\n" + text[end:]
+    if check:
+        return updated == text
+    if updated != text:
+        with open(CATALOGUE_PATH, "w") as f:
+            f.write(updated)
+    return True
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--check", action="store_true", help="fail if docs on disk are stale")
@@ -187,6 +231,8 @@ def main():
     if args.check:
         if (not os.path.exists(index_path)) or open(index_path).read() != index_content:
             stale.append("index.md")
+        if not embed_rules_catalogue(check=True):
+            stale.append("usage_guides/static_analysis.md (rules catalogue)")
         if stale:
             print(f"STALE: {stale} — run python scripts/gen_api_docs.py", file=sys.stderr)
             raise SystemExit(1)
@@ -194,7 +240,8 @@ def main():
     else:
         with open(index_path, "w") as f:
             f.write(index_content)
-        print(f"wrote {len(MODULES) + 1} files to {OUT_DIR}")
+        embed_rules_catalogue(check=False)
+        print(f"wrote {len(MODULES) + 1} files to {OUT_DIR} (+ rules catalogue)")
 
 
 if __name__ == "__main__":

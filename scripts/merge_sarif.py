@@ -1,0 +1,48 @@
+"""Merge SARIF 2.1.0 documents into one multi-run file.
+
+GitHub code scanning accepts one SARIF upload per job; each analysis tier
+(`accelerate-tpu lint`, `accelerate-tpu divergence`, `flight-check`)
+emits its own document, so CI merges them here: the output keeps one
+``runs[]`` entry per input, tool metadata intact.
+
+    python scripts/merge_sarif.py a.sarif b.sarif -o merged.sarif
+
+Inputs that are missing or unparseable are skipped with a warning — a
+tier that failed to run must not lose the others' findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SCHEMA = "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+
+
+def merge(paths: list[str]) -> dict:
+    runs = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"merge_sarif: skipping {path}: {e}", file=sys.stderr)
+            continue
+        runs.extend(doc.get("runs", []))
+    return {"$schema": SCHEMA, "version": "2.1.0", "runs": runs}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inputs", nargs="+", help="SARIF files to merge")
+    ap.add_argument("-o", "--output", required=True, help="merged SARIF output path")
+    args = ap.parse_args()
+    doc = merge(args.inputs)
+    with open(args.output, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"merged {len(doc['runs'])} run(s) into {args.output}")
+
+
+if __name__ == "__main__":
+    main()
