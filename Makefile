@@ -75,7 +75,9 @@ telemetry-selfcheck:
 
 # Fault tolerance: seeded good/uncommitted/corrupt/recoverable checkpoint
 # fixtures -> prove manifest verify (crc32 + sizes), discovery walk-back,
-# tmp GC/recovery, and protected pruning classify every one correctly.
+# tmp GC/recovery, and protected pruning classify every one correctly;
+# plus a mesh-mismatch (topology v2) fixture -> prove `checkpoints
+# describe` classifies identical/elastic/unknown and prices the reshard.
 ft-selfcheck:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli checkpoints verify --selfcheck
 

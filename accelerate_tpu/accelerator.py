@@ -219,6 +219,7 @@ class Accelerator:
         # one-final-checkpoint latch, and the preemption handler
         self._resumed_from: Optional[str] = None
         self._preempt_checkpointed = False
+        self._preempt_agreed = False
         self._preemption = None
         if self._ft_explicit and self.ft_handler.handle_preemption:
             from .ft.preemption import PreemptionHandler
@@ -1608,23 +1609,55 @@ class Accelerator:
         preemption handler)."""
         return self._preemption is not None and self._preemption.preempted
 
+    def _preempted_everywhere(self) -> bool:
+        """The fleet-wide preemption flag. Multi-host, a SIGTERM usually
+        lands on a SUBSET of hosts; every rank runs the same max-reduce
+        of its local flag here (``parallel.collectives.agree_preempt_max``)
+        so the flag flips on all ranks in the same step and the fleet
+        takes one coherent final checkpoint. Called unconditionally by
+        ``should_checkpoint``/``should_stop`` — never guard a call to
+        those behind rank-divergent state. Latches after the first
+        agreed-True so later checks are free; single-process runs skip
+        the collective entirely."""
+        if self._preemption is None:
+            return False
+        if self._preempt_agreed:
+            return True
+        local = self._preemption.preempted
+        if self.num_processes == 1 or not self.ft_handler.agree_preemption:
+            return local
+        from .parallel.collectives import agree_preempt_max
+
+        agreed = bool(agree_preempt_max(1 if local else 0))
+        if agreed:
+            self._preempt_agreed = True
+            if not local:
+                # this rank never saw the signal: latch its handler so
+                # telemetry/logging and `preempted` agree fleet-wide
+                self._preemption.mark_remote()
+        return agreed
+
     @property
     def should_checkpoint(self) -> bool:
-        """True when a preemption signal arrived and the final synchronous
-        checkpoint has not been taken yet — check after each step::
+        """True when a preemption signal arrived — on ANY host (see
+        :meth:`_preempted_everywhere`) — and the final synchronous
+        checkpoint has not been taken yet; check after each step::
 
             if accelerator.should_checkpoint:
                 accelerator.save_state()   # drains async saves, saves sync
             if accelerator.should_stop:
                 break
-        """
-        return self.preempted and not self._preempt_checkpointed
+
+        Every rank must read this at the same step boundary: multi-host it
+        performs the preemption-agreement collective."""
+        return self._preempted_everywhere() and not self._preempt_checkpointed
 
     @property
     def should_stop(self) -> bool:
-        """True once preemption was signalled: exit the training loop at
-        the next step boundary (after the :attr:`should_checkpoint` save)."""
-        return self.preempted
+        """True once preemption was signalled anywhere in the fleet: exit
+        the training loop at the next step boundary (after the
+        :attr:`should_checkpoint` save)."""
+        return self._preempted_everywhere()
 
     def save_model(self, model, save_directory: str, max_shard_size="10GB", safe_serialization: bool = True):
         from .checkpointing import save_model as _save_model

@@ -204,6 +204,33 @@ def price_collective(
     )
 
 
+def reshard_cost(global_bytes: int, mesh_shape: dict, dcn: Optional[Sequence[str]] = None) -> dict:
+    """Wire bytes to re-gather one global array onto a mesh of
+    ``mesh_shape`` (a plain ``{axis: size}`` dict — no jax needed), split
+    into the two stages of a hierarchical ring all-gather: an ICI stage
+    within each slice and a DCN stage across slices. This is the upper
+    bound the elastic checkpoint restore pays when a checkpoint written
+    on one topology is loaded onto another (``ft.topology.predict_reshard``)
+    — each device re-gathers the full array then keeps its new shard;
+    overlapping source/target layouts move less.
+
+    Ring formula per stage: ``B * (n - 1) / n`` for stage fan-in ``n``
+    (the all-gather row of ``_WIRE_FACTORS`` applied to per-shard bytes
+    ``B / n``). Trivial stages (fan-in 1) move nothing."""
+    dcn_names = tuple(dcn or ())
+    n_ici = n_dcn = 1
+    for axis, size in (mesh_shape or {}).items():
+        if int(size) <= 1:
+            continue
+        if axis in dcn_names:
+            n_dcn *= int(size)
+        else:
+            n_ici *= int(size)
+    ici = int(round(global_bytes * (n_ici - 1) / n_ici)) if n_ici > 1 else 0
+    dcn_bytes = int(round(global_bytes * (n_dcn - 1) / n_dcn)) if n_dcn > 1 else 0
+    return {ICI: ici, DCN: dcn_bytes}
+
+
 def collect_traffic(jaxpr, mesh, *, dcn: Optional[Sequence[str]] = None) -> TrafficReport:
     """Walk ``jaxpr`` (recursing through pjit/shard_map/control flow) and
     price every explicit collective. ``scan`` bodies multiply the firing

@@ -191,6 +191,9 @@ COLLECTIVE_EFFECTS: dict = {
     "ppermute_next": CallEffect(("collective:ppermute_next",)),
     "barrier_value": CallEffect(("barrier:barrier_value",)),
     "axis_index": CallEffect((), returns=DIVERGENT),
+    # host-level preemption agreement: every rank participates, the
+    # result is uniform by construction (it's a max-reduce)
+    "agree_preempt_max": CallEffect(("collective:agree_preempt_max",)),
 }
 
 #: jax-level collective primitives (any receiver except numpy-likes).

@@ -250,13 +250,17 @@ def _retry_cfg(accelerator, log, what: str) -> dict:
     )
 
 
-def _commit_checkpoint(accelerator, tmp: Path, final: Path, iteration: Optional[int]):
+def _commit_checkpoint(accelerator, tmp: Path, final: Path, iteration: Optional[int],
+                       topology: Optional[dict] = None):
     """The commit half of the atomic save protocol: all-host barrier ->
     main process writes the integrity manifest into the tmp dir (THE
     commit point — a manifest is only ever written once every host's
     shards are durably on disk) -> rename to the final name -> post-commit
     ``total_limit`` pruning that never touches the new checkpoint or the
-    one this run resumed from."""
+    one this run resumed from. ``topology`` is the save-time topology
+    record (``ft.topology.build_topology_record``) stamped into the
+    manifest so a later restore can detect — and elastically handle — a
+    changed host count or mesh."""
     log = _telemetry_log(accelerator)
     accelerator.wait_for_everyone()
     if accelerator.is_main_process:
@@ -265,6 +269,7 @@ def _commit_checkpoint(accelerator, tmp: Path, final: Path, iteration: Optional[
             step=accelerator.step,
             iteration=iteration,
             num_processes=accelerator.num_processes,
+            topology=topology,
         )
         retry_call(write_manifest, tmp, manifest, **_retry_cfg(accelerator, log, "manifest"))
         crash_point("pre_rename")
@@ -361,22 +366,29 @@ def save_accelerator_state(
         hook(accelerator._models, [], str(tmp))
 
     async_group: Optional[list] = [] if async_save else None
+    # every (dir_name, pytree) handed to orbax below — the save-time
+    # topology record captures each leaf's global shape + PartitionSpec
+    # from exactly this list, so record and bytes can never drift
+    array_trees: list = []
     with (log.span("ckpt_save", dir=str(final), async_save=async_save) if log is not None
           else _null_cm()):
         # models + optimizers: sharded orbax saves (every host participates)
         for i, model in enumerate(accelerator._models):
-            _save_pytree(model.params, tmp / f"{MODEL_NAME}_{i}" if i > 0 else tmp / MODEL_NAME, async_group)
+            model_dir = tmp / f"{MODEL_NAME}_{i}" if i > 0 else tmp / MODEL_NAME
+            array_trees.append((model_dir.name, model.params))
+            _save_pytree(model.params, model_dir, async_group)
             crash_point("mid_pytree")
             # non-trainable mutable collections (BatchNorm running stats —
             # build_train_step(has_state=True)); torch carries these as module
             # buffers inside the state_dict, here they are a separate pytree
             if getattr(model, "state", None) is not None:
+                array_trees.append((f"{MODEL_NAME}_state_{i}", model.state))
                 _save_pytree(model.state, tmp / f"{MODEL_NAME}_state_{i}", async_group)
         for i, opt in enumerate(accelerator._optimizers):
             if opt.opt_state is not None:
-                _save_pytree(
-                    opt.opt_state, tmp / f"{OPTIMIZER_NAME}_{i}" if i > 0 else tmp / OPTIMIZER_NAME, async_group
-                )
+                opt_dir = tmp / f"{OPTIMIZER_NAME}_{i}" if i > 0 else tmp / OPTIMIZER_NAME
+                array_trees.append((opt_dir.name, opt.opt_state))
+                _save_pytree(opt.opt_state, opt_dir, async_group)
 
         if accelerator.is_main_process:
             for i, sched in enumerate(accelerator._schedulers):
@@ -387,11 +399,18 @@ def save_accelerator_state(
             retry_call((tmp / "samplers.json").write_text, json.dumps(samplers), **rcfg)
             for i, obj in enumerate(accelerator._custom_objects):
                 retry_call(_pickle_to, tmp / f"custom_checkpoint_{i}.pkl", obj.state_dict(), **rcfg)
+            from .utils.random import get_seed as _get_seed
+
             meta = {
                 "step": accelerator.step,
                 "save_iteration": iteration if iteration is not None else project.iteration,
                 "loss_scale": accelerator._loss_scale,
                 "mixed_precision": accelerator.mixed_precision,
+                # the global key-derivation seed, outside the per-process
+                # RNG pickles: an elastic restore on a topology where
+                # rank i's pickle does not exist re-derives rank i's host
+                # RNG from this (ft.topology.derive_rng_state)
+                "seed": _get_seed(),
             }
             retry_call((tmp / "accelerate_state.json").write_text, json.dumps(meta), **rcfg)
 
@@ -412,16 +431,24 @@ def save_accelerator_state(
         project.iteration += 1
 
     crash_point("pre_manifest")
+    # topology record for the manifest (main process writes it; captured
+    # HERE — not at drain time — so an async commit stamps the topology
+    # the arrays were actually saved under)
+    topology = None
+    if accelerator.is_main_process:
+        from .ft.topology import build_topology_record
+
+        topology = build_topology_record(accelerator, array_trees)
     if async_save:
         _PENDING_ASYNC.append(
             _PendingSave(
                 async_group,
-                finalize=lambda: _commit_checkpoint(accelerator, tmp, final, iteration),
+                finalize=lambda: _commit_checkpoint(accelerator, tmp, final, iteration, topology),
                 abort=lambda err: _abort_checkpoint(accelerator, tmp, err),
             )
         )
         return str(final)
-    _commit_checkpoint(accelerator, tmp, final, iteration)
+    _commit_checkpoint(accelerator, tmp, final, iteration, topology)
     return str(final)
 
 
@@ -444,6 +471,21 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     shardings — loading a checkpoint saved on a different mesh reshards
     transparently (reference needs FULL_STATE_DICT / merge tooling).
 
+    **Topology-elastic**: the manifest's topology record (mesh shape,
+    process count, per-array PartitionSpecs — ``ft/topology.py``) is
+    compared against the live topology. Identical -> the bit-exact path
+    (RNG pickles + sampler positions reused verbatim). Changed -> an
+    explicit elastic path, never a silent half-restore: arrays reshard
+    onto the current mesh (orbax reads exactly the index ranges each
+    device needs), per-process host RNG is re-derived deterministically
+    from the saved seed + the NEW ``process_index``
+    (``ckpt_rng_rederive`` telemetry announces the semantics change),
+    and each dataloader's position is converted to a global sample
+    offset and re-split across the new data-parallel degree
+    (``ckpt_elastic_restore`` carries the cost-model-predicted reshard
+    bytes). ``accelerate-tpu checkpoints describe`` previews all of this
+    offline.
+
     ``input_dir=None`` **auto-resumes**: garbage-collects orphaned ``.tmp``
     dirs (finishing any interrupted rename), walks back from the newest
     ``checkpoint_N`` to the newest one whose integrity manifest verifies,
@@ -452,6 +494,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     ``checkpoint_0``. Requires ``automatic_checkpoint_naming``."""
     wait_for_checkpoint()  # never read past a checkpoint still being written
     project = accelerator.project_configuration
+    log = _telemetry_log(accelerator)
     if input_dir is None:
         from .ft.manager import CheckpointManager
 
@@ -470,12 +513,46 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
         if target is None:
             raise FileNotFoundError(f"auto-resume found no valid checkpoint under {base}")
         input_dir = str(target)
-        log = _telemetry_log(accelerator)
         if log is not None:
             log.event("ckpt_auto_resume", dir=input_dir)
     inp = Path(input_dir)
     if not inp.is_dir():
         raise FileNotFoundError(f"checkpoint directory {input_dir} not found")
+
+    # ---- topology check: explicit elastic path on mismatch ---------------
+    from .ft.manifest import read_manifest
+    from .ft.topology import compare_topology, live_topology, predict_reshard
+
+    manifest = read_manifest(inp)
+    saved_topo = (manifest or {}).get("topology")
+    delta = compare_topology(saved_topo, live_topology(accelerator))
+    elastic = delta.is_elastic
+    if elastic:
+        from .parallel.mesh import dcn_axes
+
+        pred = predict_reshard(saved_topo, dict(accelerator.mesh.shape), dcn_axes())
+        logger.warning(
+            f"checkpoint {inp.name} was saved on a different topology "
+            f"({'; '.join(delta.changes)}): entering ELASTIC restore — arrays reshard onto the "
+            f"current mesh (predicted {pred.total_bytes} wire bytes: ici={pred.ici_bytes} "
+            f"dcn={pred.dcn_bytes}), host RNG re-derived, sampler offsets redistributed"
+        )
+        if log is not None:
+            log.event(
+                "ckpt_elastic_restore",
+                severity="warning",
+                dir=str(inp),
+                changes=delta.changes,
+                reshard_ici_bytes=pred.ici_bytes,
+                reshard_dcn_bytes=pred.dcn_bytes,
+                reshard_arrays=pred.moved_count,
+            )
+    elif saved_topo is None and manifest is not None:
+        logger.info(
+            f"checkpoint {inp.name} carries no topology record (schema v1): "
+            "restore is only verifiable on the topology that wrote it"
+        )
+    crash_point("pre_restore")
 
     for hook in accelerator._load_model_hooks:
         hook(accelerator._models, str(inp))
@@ -484,6 +561,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     for i, model in enumerate(accelerator._models):
         path = inp / (f"{MODEL_NAME}_{i}" if i > 0 else MODEL_NAME)
         model.params = _load_pytree(path, model.params, mesh=mesh)
+        crash_point("mid_restore_arrays")
         state_path = inp / f"{MODEL_NAME}_state_{i}"
         if state_path.exists() and getattr(model, "state", None) is not None:
             model.state = _load_pytree(state_path, model.state, mesh=mesh)
@@ -505,8 +583,44 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
             sched.load_state_dict(json.loads(path.read_text()))
     samplers_path = inp / "samplers.json"
     if samplers_path.exists():
+        from .ft.topology import redistribute_sampler_state
+
         saved = json.loads(samplers_path.read_text())
-        for dl, s in zip(accelerator._dataloaders, saved):
+        loaders = accelerator._dataloaders
+        if len(saved) != len(loaders):
+            # never silently restore a prefix: a loader left at position 0
+            # (or a saved position dropped on the floor) re-trains on seen
+            # data without any signal
+            logger.warning(
+                f"checkpoint {inp.name} saved {len(saved)} dataloader state(s) but "
+                f"{len(loaders)} dataloader(s) are prepared: restoring the first "
+                f"{min(len(saved), len(loaders))} positionally — verify prepare() order matches the saving run"
+            )
+            if log is not None:
+                log.event(
+                    "ckpt_sampler_mismatch", severity="error",
+                    saved=len(saved), prepared=len(loaders), dir=str(inp),
+                )
+        for dl, s in zip(loaders, saved):
+            if elastic:
+                # convert the saved position into a global sample offset
+                # and re-split it over the NEW data-parallel degree
+                old_gb = s.get("global_batch_size")
+                new_gb = getattr(dl, "total_batch_size", None)
+                s, replayed = redistribute_sampler_state(s, new_gb)
+                if log is not None:
+                    log.event(
+                        "ckpt_sampler_redistribute",
+                        old_global_batch=old_gb,
+                        new_global_batch=new_gb,
+                        batches_yielded=s.get("batches_yielded"),
+                        replayed_samples=replayed,
+                    )
+                if replayed:
+                    logger.warning(
+                        f"elastic restore: global sample offset not divisible by the new "
+                        f"global batch size ({new_gb}); {replayed} sample(s) will be replayed"
+                    )
             if hasattr(dl, "load_state_dict"):
                 # restores sampler epoch/seed AND the mid-epoch position:
                 # the next iteration skips the already-delivered batches
@@ -518,6 +632,7 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
         if path.exists():
             with open(path, "rb") as f:
                 obj.load_state_dict(pickle.load(f))
+    meta = {}
     meta_path = inp / "accelerate_state.json"
     if meta_path.exists():
         meta = json.loads(meta_path.read_text())
@@ -528,8 +643,30 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
             # save_iteration but never read it back, so EVERY resumed run
             # started again at checkpoint_0 and overwrote history)
             project.iteration = int(meta["save_iteration"]) + 1
+    crash_point("pre_restore_rng")
     rng_path = inp / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"
-    if rng_path.exists():
+    if elastic:
+        # the saved per-rank stream positions belong to the OLD rank set /
+        # data layout; re-derive deterministically from the global seed +
+        # the NEW process_index instead (bit-exactness is intentionally
+        # given up here — and announced, never silent)
+        from .ft.topology import apply_derived_rng_state, derive_rng_state
+
+        seed = meta.get("seed")
+        if seed is None and saved_topo is not None:
+            seed = saved_topo.get("seed")
+        derived = derive_rng_state(seed, accelerator.process_index, step=accelerator.step)
+        apply_derived_rng_state(derived)
+        logger.warning(
+            f"elastic restore: host RNG re-derived from seed={seed} for "
+            f"process_index={accelerator.process_index} (saved per-rank streams are topology-pinned)"
+        )
+        if log is not None:
+            log.event(
+                "ckpt_rng_rederive", severity="warning",
+                seed=seed, process_index=accelerator.process_index, step=accelerator.step,
+            )
+    elif rng_path.exists():
         with open(rng_path, "rb") as f:
             rng_states = pickle.load(f)
         random.setstate(rng_states["python"])
@@ -540,6 +677,19 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
         from .utils.random import restore_seed_for_keys
 
         restore_seed_for_keys(rng_states.get("seed"))
+    else:
+        # the seed silently skipped this — a rank resuming with its
+        # boot-time RNG draws a DIFFERENT shuffle/dropout stream than
+        # every restored rank, which is a correctness bug, not a detail
+        logger.warning(
+            f"checkpoint {inp.name} has no {rng_path.name}: this process resumes with its "
+            f"current (unrestored) host RNG — draws will not continue the saved streams"
+        )
+        if log is not None:
+            log.event(
+                "ckpt_rng_missing", severity="warning",
+                file=rng_path.name, process_index=accelerator.process_index, dir=str(inp),
+            )
     # pruning must never delete the checkpoint this run restored from
     # until a newer one has committed
     accelerator._resumed_from = str(inp.resolve())

@@ -11,7 +11,15 @@ Examples::
     accelerate-tpu checkpoints verify runs/my_run/checkpoints --format json
     accelerate-tpu checkpoints verify runs/my_run/checkpoints/checkpoint_7
     accelerate-tpu checkpoints gc runs/my_run/checkpoints --dry-run
+    accelerate-tpu checkpoints describe runs/my_run/checkpoints/checkpoint_7
+    accelerate-tpu checkpoints describe runs/my_run/checkpoints --mesh data=8 --processes 2
     accelerate-tpu checkpoints verify --selfcheck   # CI gate (make ft-selfcheck)
+
+``describe`` reads the manifest's topology record (schema v2) and
+answers the operator question behind every elastic resume: *what wrote
+this checkpoint, can the topology I have restore it, and how many bytes
+will the post-restore reshard move over ICI vs DCN?* Without ``--mesh``
+it checks the saved topology against itself (the bit-exact case).
 """
 
 from __future__ import annotations
@@ -56,6 +64,29 @@ def checkpoints_parser(subparsers=None):
     p_gc.add_argument("--dry-run", action="store_true", help="report without touching disk")
     p_gc.add_argument("--format", choices=("text", "json"), default="text")
     p_gc.set_defaults(checkpoints_func=gc_command)
+
+    p_desc = sub.add_parser(
+        "describe",
+        help="Saved topology, restore compatibility, and predicted reshard bytes (ICI/DCN)",
+    )
+    p_desc.add_argument(
+        "path", help="one checkpoint_N dir, or a checkpoints/ base dir (describes the newest valid)"
+    )
+    p_desc.add_argument(
+        "--mesh", default=None,
+        help="target mesh shape to check restorability against, e.g. data=8 or data=2,tensor=2 "
+             "(default: the saved topology itself)",
+    )
+    p_desc.add_argument(
+        "--processes", type=int, default=None,
+        help="target process count (default: the saved topology's)",
+    )
+    p_desc.add_argument(
+        "--dcn-axes", default=None,
+        help="comma-separated target mesh axes that cross DCN (default: the saved topology's)",
+    )
+    p_desc.add_argument("--format", choices=("text", "json"), default="text")
+    p_desc.set_defaults(checkpoints_func=describe_command)
 
     if subparsers is not None:
         parser.set_defaults(func=lambda args: args.checkpoints_func(args))
@@ -160,6 +191,139 @@ def gc_command(args) -> int:
     return 0
 
 
+def _parse_mesh_shape(spec) -> dict:
+    """``"data=4,tensor=2"`` -> ``{"data": 4, "tensor": 2}`` — a plain
+    shape dict (no jax, no device build)."""
+    shape: dict = {}
+    if spec:
+        for part in str(spec).split(","):
+            axis, _, size = part.partition("=")
+            if not axis.strip() or not size.strip():
+                raise SystemExit(f"bad --mesh entry {part!r}; expected axis=size")
+            shape[axis.strip()] = int(size)
+    return shape
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"
+
+
+def describe_checkpoint(path, target_mesh: dict = None, target_processes: int = None,
+                        target_dcn=None) -> dict:
+    """The data behind ``checkpoints describe``: saved topology, the
+    compatibility tier against the target topology, and the cost-model
+    reshard prediction. Pure manifest + arithmetic (no jax), so it runs
+    from a login node against a live run's directory."""
+    from accelerate_tpu.ft.manifest import read_manifest
+    from accelerate_tpu.ft.topology import compare_topology, predict_reshard
+
+    path = Path(path)
+    manifest = read_manifest(path)
+    saved = (manifest or {}).get("topology")
+    live = {
+        "process_count": (
+            target_processes if target_processes is not None
+            else (saved or {}).get("process_count", 1)
+        ),
+        "mesh_shape": target_mesh if target_mesh is not None else (saved or {}).get("mesh_shape", {}),
+        "dcn_axes": list(target_dcn) if target_dcn is not None else (saved or {}).get("dcn_axes", []),
+    }
+    # dp degree of the target: product of the batch axes (data x fsdp)
+    from accelerate_tpu.parallel.mesh import BATCH_AXES
+
+    live["data_parallel_degree"] = 1
+    for a in BATCH_AXES:
+        live["data_parallel_degree"] *= int(live["mesh_shape"].get(a, 1) or 1)
+    delta = compare_topology(saved, live)
+    pred = predict_reshard(saved, live["mesh_shape"], tuple(live["dcn_axes"]))
+    return {
+        "name": path.name,
+        "committed": manifest is not None,
+        "schema_version": (manifest or {}).get("schema_version"),
+        "step": (manifest or {}).get("step"),
+        "iteration": (manifest or {}).get("iteration"),
+        "saved_topology": saved,
+        "target_topology": live,
+        "compatibility": delta.status,
+        "changes": delta.changes,
+        "verdict": delta.describe(),
+        "reshard": {
+            "ici_bytes": pred.ici_bytes,
+            "dcn_bytes": pred.dcn_bytes,
+            "total_bytes": pred.total_bytes,
+            "arrays_moved": pred.moved_count,
+            "array_count": pred.array_count,
+            "total_array_bytes": pred.total_array_bytes,
+        },
+    }
+
+
+def describe_command(args) -> int:
+    from accelerate_tpu.ft.manager import CheckpointManager
+    from accelerate_tpu.ft.manifest import MANIFEST_NAME
+
+    path = Path(args.path)
+    if not path.is_dir():
+        print(f"no such directory: {path}")
+        return 2
+    # same single-vs-base heuristic as verify: a manifest (or no
+    # checkpoint_N children) means the path IS one checkpoint
+    is_single = (path / MANIFEST_NAME).exists() or not any(
+        child.name.startswith("checkpoint_") for child in path.iterdir() if child.is_dir()
+    )
+    if not is_single:
+        target = CheckpointManager(path).latest(deep=False)
+        if target is None:
+            print(f"no committed checkpoint under {path}")
+            return 2
+        path = target
+    target_mesh = _parse_mesh_shape(args.mesh) if args.mesh else None
+    target_dcn = None
+    if args.dcn_axes is not None:
+        target_dcn = [a.strip() for a in args.dcn_axes.split(",") if a.strip()]
+    info = describe_checkpoint(path, target_mesh, args.processes, target_dcn)
+    if args.format == "json":
+        print(json.dumps(info, indent=2))
+        return 0 if info["committed"] else 1
+    if not info["committed"]:
+        print(f"{info['name']}: no readable commit manifest (uncommitted or corrupt)")
+        return 1
+    step = f"step={info['step']}" if info["step"] is not None else ""
+    print(f"{info['name']}  (manifest schema v{info['schema_version']})  {step}")
+    saved = info["saved_topology"]
+    if saved is None:
+        print("saved topology: none recorded (schema v1 checkpoint)")
+    else:
+        from accelerate_tpu.ft.topology import _shape_str
+
+        nbytes = info["reshard"]["total_array_bytes"]
+        print("saved topology:")
+        print(f"  processes: {saved.get('process_count')}")
+        print(f"  mesh: {_shape_str(saved.get('mesh_shape', {}))} ({saved.get('mesh_devices')} devices)")
+        print(f"  dcn axes: {', '.join(saved.get('dcn_axes', [])) or 'none'}")
+        print(f"  data-parallel degree: {saved.get('data_parallel_degree')}")
+        print(f"  arrays: {info['reshard']['array_count']} ({_fmt_bytes(nbytes)} global)")
+    tgt = info["target_topology"]
+    print(
+        f"target topology: mesh {_shape_str(tgt.get('mesh_shape', {})) if tgt.get('mesh_shape') else 'single-device'}, "
+        f"processes {tgt.get('process_count')}"
+    )
+    print(f"compatibility: {info['compatibility'].upper()} — {info['verdict']}")
+    for change in info["changes"]:
+        print(f"  - {change}")
+    r = info["reshard"]
+    print(
+        f"predicted reshard traffic: {_fmt_bytes(r['total_bytes'])} "
+        f"(ICI {_fmt_bytes(r['ici_bytes'])}, DCN {_fmt_bytes(r['dcn_bytes'])}; "
+        f"{r['arrays_moved']}/{r['array_count']} arrays move)"
+    )
+    return 0
+
+
 def selfcheck_command(args) -> int:
     """Seed good / corrupt / truncated / uncommitted / recoverable fixture
     checkpoints (plain files — no jax) and assert discovery, verify, gc,
@@ -231,6 +395,55 @@ def selfcheck_command(args) -> int:
         check("checkpoint_1" in names, "prune should drop the oldest unprotected checkpoint")
         check(good.exists(), "protected checkpoint deleted from disk")
 
+        # ---- topology / describe: a v2 manifest with a mesh record ------
+        # saved on mesh data=4; restoring on data=8 (mesh mismatch) must
+        # classify as elastic and predict nonzero reshard bytes; the saved
+        # topology itself must classify identical with zero bytes
+        topo_ckpt = base / "checkpoint_9"
+        (topo_ckpt / "model").mkdir(parents=True)
+        (topo_ckpt / "model" / "array_data.bin").write_bytes(os.urandom(128))
+        (topo_ckpt / "accelerate_state.json").write_text(json.dumps({"step": 90, "seed": 7}))
+        topology = {
+            "schema_version": 1,
+            "process_count": 1,
+            "mesh_shape": {"data": 4, "tensor": 1},
+            "mesh_devices": 4,
+            "dcn_axes": [],
+            "data_parallel_degree": 4,
+            "seed": 7,
+            "arrays": {
+                "model['w']": {"shape": [8, 4], "dtype": "float32", "spec": ["data", None], "bytes": 128},
+                "model['b']": {"shape": [4], "dtype": "float32", "spec": [None], "bytes": 16},
+            },
+        }
+        write_manifest(topo_ckpt, build_manifest(topo_ckpt, step=90, iteration=9, topology=topology))
+        same = describe_checkpoint(topo_ckpt)
+        check(same["compatibility"] == "identical", "same-topology describe must be identical")
+        check(same["reshard"]["total_bytes"] == 0, "identical topology must predict zero reshard bytes")
+        moved = describe_checkpoint(topo_ckpt, target_mesh={"data": 8}, target_dcn=("data",))
+        check(moved["compatibility"] == "elastic", "mesh-mismatch describe must be elastic")
+        check(moved["reshard"]["dcn_bytes"] > 0, "dcn-crossing reshard must predict DCN bytes")
+        check(moved["reshard"]["ici_bytes"] == 0, "all-DCN target must predict zero ICI bytes")
+        check(any("mesh" in c for c in moved["changes"]), "describe must name the mesh change")
+        legacy = describe_checkpoint(good)  # v2-by-build but topology-free fixture
+        check(legacy["compatibility"] == "unknown", "no-topology checkpoint must describe as unknown")
+
+        # the CLI surface over the same fixture (folded into ft-selfcheck)
+        import contextlib
+        import io
+        import types
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = describe_command(types.SimpleNamespace(
+                path=str(topo_ckpt), mesh="data=8", processes=None, dcn_axes=None, format="json"))
+        check(rc == 0, "describe CLI on a committed checkpoint must exit 0")
+        try:
+            cli_info = json.loads(buf.getvalue())
+            check(cli_info["compatibility"] == "elastic", "describe CLI JSON must carry the elastic verdict")
+        except json.JSONDecodeError:
+            failures.append("describe CLI --format json must print valid JSON")
+
         try:
             shutil.rmtree(base / "checkpoint_4" / "model")
             check(not mgr.verify(base / "checkpoint_4").ok, "losing a pytree dir must fail verify")
@@ -243,7 +456,8 @@ def selfcheck_command(args) -> int:
         print(
             "[checkpoints selfcheck] OK: manifest commit/verify (crc32, sizes), "
             "discovery skips corrupt+uncommitted, gc recovers interrupted renames, "
-            "prune honors protection"
+            "prune honors protection, describe classifies identical/elastic/unknown "
+            "topologies and prices the reshard (ICI/DCN)"
         )
     return 1 if failures else 0
 

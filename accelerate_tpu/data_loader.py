@@ -183,13 +183,20 @@ class BaseDataLoader:
         """Exact position for mid-epoch resume (reference analogue:
         StatefulDataLoader state dicts persisted at checkpointing.py:139-143).
         ``batches_yielded`` counts batches delivered this epoch; restoring
-        replays the same sampler permutation and skips exactly that many."""
+        replays the same sampler permutation and skips exactly that many.
+        ``global_batch_size``/``data_parallel_degree`` record what one
+        counted batch *meant* on the saving topology, so an elastic
+        restore (``ft.topology.redistribute_sampler_state``) can convert
+        the position into a global sample offset and re-split it across a
+        different data-parallel degree."""
         sampler = getattr(self, "sampler", None)
         return {
             "iteration": self.iteration,
             "batches_yielded": self.batches_yielded,
             "sampler_epoch": getattr(sampler, "epoch", None),
             "sampler_seed": getattr(sampler, "seed", None),
+            "global_batch_size": getattr(self, "total_batch_size", None),
+            "data_parallel_degree": self._num_shards(),
         }
 
     def load_state_dict(self, state: dict):

@@ -22,17 +22,30 @@ distributed checkpointing design, PAPERS.md arXiv 2605.23066):
   SIGTERM/SIGINT -> a flag surfaced as ``Accelerator.should_checkpoint``
   / ``Accelerator.should_stop`` so the loop takes one final synchronous
   checkpoint and exits cleanly.
+* :mod:`~accelerate_tpu.ft.topology` — the manifest's (schema v2)
+  topology record and the elastic-restore planners: compare saved vs
+  live topology, price the post-restore reshard with the cost model,
+  re-derive per-process RNG deterministically, and redistribute sampler
+  offsets across a new data-parallel degree.
 * :mod:`~accelerate_tpu.ft.crashpoints` — the labeled points inside the
-  save path that :mod:`accelerate_tpu.test_utils.fault_injection` kills
-  at, proving resume always lands on a valid checkpoint.
+  save AND restore paths that
+  :mod:`accelerate_tpu.test_utils.fault_injection` kills at, proving
+  resume always lands on a valid checkpoint.
 
 See ``docs/usage_guides/fault_tolerance.md``.
 """
 
-from .crashpoints import CRASH_POINTS, crash_point, set_crash_hook
+from .crashpoints import (
+    ALL_CRASH_POINTS,
+    CRASH_POINTS,
+    RESTORE_CRASH_POINTS,
+    crash_point,
+    set_crash_hook,
+)
 from .manifest import (
     MANIFEST_NAME,
     MANIFEST_SCHEMA_VERSION,
+    SUPPORTED_SCHEMA_VERSIONS,
     TMP_SUFFIX,
     build_manifest,
     read_manifest,
@@ -41,13 +54,29 @@ from .manifest import (
 )
 from .manager import CheckpointManager, VerifyResult
 from .preemption import PreemptionHandler
+from .topology import (
+    ELASTIC,
+    IDENTICAL,
+    UNKNOWN,
+    ReshardPrediction,
+    TopologyDelta,
+    build_topology_record,
+    compare_topology,
+    derive_rng_state,
+    live_topology,
+    predict_reshard,
+    redistribute_sampler_state,
+)
 
 __all__ = [
+    "ALL_CRASH_POINTS",
     "CRASH_POINTS",
+    "RESTORE_CRASH_POINTS",
     "crash_point",
     "set_crash_hook",
     "MANIFEST_NAME",
     "MANIFEST_SCHEMA_VERSION",
+    "SUPPORTED_SCHEMA_VERSIONS",
     "TMP_SUFFIX",
     "build_manifest",
     "write_manifest",
@@ -56,4 +85,15 @@ __all__ = [
     "CheckpointManager",
     "VerifyResult",
     "PreemptionHandler",
+    "ELASTIC",
+    "IDENTICAL",
+    "UNKNOWN",
+    "TopologyDelta",
+    "ReshardPrediction",
+    "build_topology_record",
+    "compare_topology",
+    "live_topology",
+    "predict_reshard",
+    "derive_rng_state",
+    "redistribute_sampler_state",
 ]

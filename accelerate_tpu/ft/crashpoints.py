@@ -8,7 +8,7 @@ hook that raises (or kills the process) at a chosen label — driving the
 crash-at-every-point matrix that proves resume always lands on a valid
 checkpoint.
 
-The labels, in save order:
+The save labels, in save order:
 
 * ``pre_write``   — before anything touches disk (no ``.tmp`` dir yet)
 * ``mid_pytree``  — after the first sharded pytree write (tmp dir holds
@@ -19,14 +19,33 @@ The labels, in save order:
   renamed to its final name (recoverable by ``CheckpointManager.gc``)
 * ``mid_prune``   — new checkpoint visible, ``total_limit`` pruning in
   progress
+
+``load_accelerator_state`` is instrumented the same way (a kill
+mid-restore must leave the checkpoint untouched so a fresh auto-resume
+lands on it again). The restore labels, in restore order:
+
+* ``pre_restore``       — checkpoint located (and any elastic-topology
+  decision made), nothing restored yet
+* ``mid_restore_arrays``— after the first orbax pytree restore (model
+  params in memory, optimizer state not yet)
+* ``pre_restore_rng``   — arrays/schedulers/samplers restored, host RNG
+  not yet touched
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
-#: every labeled point, in the order the save path reaches them
+#: every labeled save-path point, in the order the save path reaches them
 CRASH_POINTS = ("pre_write", "mid_pytree", "pre_manifest", "pre_rename", "mid_prune")
+
+#: every labeled restore-path point, in the order the load path reaches
+#: them — restore never mutates the checkpoint, so a crash at ANY of
+#: these must leave it as valid as it was
+RESTORE_CRASH_POINTS = ("pre_restore", "mid_restore_arrays", "pre_restore_rng")
+
+#: the full label set CrashPoint accepts
+ALL_CRASH_POINTS = CRASH_POINTS + RESTORE_CRASH_POINTS
 
 _hook: Optional[Callable[[str], None]] = None
 

@@ -8,10 +8,12 @@ manifest is by definition uncommitted — discovery
 (:class:`~accelerate_tpu.ft.manager.CheckpointManager`) never returns
 it, and ``gc()`` may delete it.
 
-Schema (``MANIFEST_SCHEMA_VERSION`` 1)::
+Schema (``MANIFEST_SCHEMA_VERSION`` 2; v1 files — written before the
+elastic-restore work — parse identically, they just carry no
+``topology`` block)::
 
     {
-      "schema_version": 1,
+      "schema_version": 2,
       "step": 12,                      # accelerator.step at save time
       "iteration": 3,                  # ProjectConfiguration.iteration (or null)
       "num_processes": 1,
@@ -24,8 +26,28 @@ Schema (``MANIFEST_SCHEMA_VERSION`` 1)::
         "model/_METADATA": 307, ...    # relpath -> size (bytes)
       },
       "pytree_dirs": ["model", "optimizer"],
-      "orbax_metadata": {"model": true, "optimizer": true}
+      "orbax_metadata": {"model": true, "optimizer": true},
+      "topology": {                    # v2: what wrote this checkpoint
+        "schema_version": 1,           # (ft/topology.py)
+        "process_count": 4,
+        "mesh_shape": {"data": 4, "tensor": 1, ...},
+        "mesh_devices": 4,
+        "dcn_axes": [],
+        "data_parallel_degree": 4,
+        "seed": 42,
+        "arrays": {                    # every orbax-saved pytree leaf
+          "model['a']": {"shape": [8, 4], "dtype": "float32",
+                          "spec": ["data", null], "bytes": 128},
+          ...
+        }
+      }
     }
+
+The ``topology`` block is what makes restore *elastic*: on load,
+``compare_topology`` decides between the bit-exact identical-topology
+path and the explicit elastic path (reshard-on-load, RNG re-derivation,
+sampler redistribution) — see :mod:`accelerate_tpu.ft.topology` and
+``accelerate-tpu checkpoints describe``.
 
 Digest policy: crc32 (zlib) for the small JSON/pkl control files — they
 decide *what* gets restored, so silent corruption there is the worst
@@ -43,7 +65,11 @@ from pathlib import Path
 from typing import Optional
 
 MANIFEST_NAME = "commit_success.json"
-MANIFEST_SCHEMA_VERSION = 1
+MANIFEST_SCHEMA_VERSION = 2
+
+#: versions ``read_manifest`` accepts: v1 (pre-elastic, no topology
+#: record) still commits and restores on an identical topology
+SUPPORTED_SCHEMA_VERSIONS = (1, 2)
 
 #: suffix a checkpoint directory carries until its rename commit
 TMP_SUFFIX = ".tmp"
@@ -66,7 +92,7 @@ def _crc32(path: Path) -> int:
 
 
 def build_manifest(ckpt_dir, *, step: Optional[int] = None, iteration: Optional[int] = None,
-                   num_processes: int = 1) -> dict:
+                   num_processes: int = 1, topology: Optional[dict] = None) -> dict:
     """Walk a fully written checkpoint directory and produce its manifest
     dict. Called by the main process AFTER the all-host barrier, so every
     shard file is on disk. The manifest file itself is excluded."""
@@ -92,7 +118,7 @@ def build_manifest(ckpt_dir, *, step: Optional[int] = None, iteration: Optional[
                 rec["crc32"] = _crc32(entry)
             files[entry.name] = rec
 
-    return {
+    manifest = {
         "schema_version": MANIFEST_SCHEMA_VERSION,
         "step": step,
         "iteration": iteration,
@@ -102,6 +128,9 @@ def build_manifest(ckpt_dir, *, step: Optional[int] = None, iteration: Optional[
         "pytree_dirs": pytree_dirs,
         "orbax_metadata": orbax_metadata,
     }
+    if topology is not None:
+        manifest["topology"] = topology
+    return manifest
 
 
 def write_manifest(ckpt_dir, manifest: dict) -> str:
@@ -130,7 +159,7 @@ def read_manifest(ckpt_dir) -> Optional[dict]:
         manifest = json.loads(path.read_text())
     except (OSError, json.JSONDecodeError):
         return None
-    if not isinstance(manifest, dict) or manifest.get("schema_version") != MANIFEST_SCHEMA_VERSION:
+    if not isinstance(manifest, dict) or manifest.get("schema_version") not in SUPPORTED_SCHEMA_VERSIONS:
         return None
     return manifest
 

@@ -94,6 +94,27 @@ class PreemptionHandler:
         decided to keep going after a spurious SIGINT)."""
         self.received = None
 
+    def mark_remote(self):
+        """Latch the flag because ANOTHER host received the signal.
+
+        Preemption notices often reach only some hosts; the all-hosts
+        agreement step (``parallel.collectives.agree_preempt_max``, run by
+        ``Accelerator.should_checkpoint``/``should_stop``) calls this on
+        every rank whose local handler saw nothing, so the whole fleet
+        behaves as if uniformly signalled — one coherent final checkpoint
+        instead of a half-stopped job."""
+        if self.received is None:
+            self.received = "REMOTE"
+            logger.warning(
+                "preemption agreed via all-hosts max-reduce (signal landed on another "
+                "rank) — will checkpoint and stop at the next step boundary"
+            )
+            if self.on_preempt is not None:
+                try:
+                    self.on_preempt("REMOTE")
+                except Exception as e:
+                    logger.warning(f"on_preempt callback failed: {e}")
+
     # ------------------------------------------------------------------ #
 
     def _handle(self, signum, frame):

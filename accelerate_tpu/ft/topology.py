@@ -1,0 +1,321 @@
+"""Topology records and elastic-restore planning.
+
+A checkpoint written by ``save_accelerator_state`` is a set of per-host
+orbax shards plus per-process host state (RNG pickles, sampler
+positions). The *array* half has always been restorable onto a different
+mesh — orbax reads arbitrary index ranges, and ``_load_pytree`` targets
+the CURRENT shardings — but the *host-state* half was silently
+topology-pinned: resume on a different host count or mesh layout kept a
+prefix of the sampler states and fresh-process RNG for any rank whose
+``rng_state_{i}.pkl`` did not exist. This module makes the topology an
+explicit, versioned part of the checkpoint (the Orbax paper's
+"topology-elastic restore" tier, PAPERS.md arXiv 2605.23066):
+
+* :func:`build_topology_record` — stamped into the integrity manifest
+  (schema v2) at save time: process count, mesh shape + DCN axes, the
+  global shape / dtype / PartitionSpec of every orbax-saved array leaf,
+  the data-parallel degree, and the RNG seed.
+* :func:`compare_topology` — classifies a restore as ``identical``
+  (bit-exact, the pre-elastic path), ``elastic`` (resharding restore:
+  RNG streams re-derived, sampler offsets redistributed), or ``unknown``
+  (schema-v1 checkpoint with no record: only an identical-topology
+  restore is verifiable).
+* :func:`predict_reshard` — prices the post-restore reshard with the
+  PR-2 cost model *before* it runs: per-array wire bytes split into ICI
+  vs DCN stages (``analysis.costmodel.reshard_cost``), surfaced by
+  ``accelerate-tpu checkpoints describe`` and the ``ckpt_elastic_restore``
+  telemetry event.
+* :func:`derive_rng_state` — the deterministic re-derivation scheme for
+  per-process host RNG when the saved pickles no longer map onto the
+  live ranks: fold ``(seed, step, process_index)`` through a
+  ``SeedSequence``. Same topology -> the pickles are used and resume is
+  bit-exact; changed topology -> every rank (old or new) derives a
+  reproducible stream, and the semantics change is announced via the
+  ``ckpt_rng_rederive`` telemetry event, never silent.
+* :func:`redistribute_sampler_state` — recomputes the global sample
+  offset (``batches_yielded x saved global batch size``) and splits it
+  across the new data-parallel degree.
+
+Everything here operates on plain shape dicts (``{"data": 4}``) and JSON
+records, so the ``checkpoints describe`` CLI stays jax-free; jax is only
+imported by :func:`build_topology_record`, which runs inside a live save.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: version of the ``topology`` block inside the (v2) integrity manifest
+TOPOLOGY_SCHEMA_VERSION = 1
+
+#: restore-compatibility tiers, strongest first
+IDENTICAL = "identical"
+ELASTIC = "elastic"
+UNKNOWN = "unknown"
+
+
+def _nontrivial(shape: Optional[dict]) -> dict[str, int]:
+    """Mesh shape normalised to its non-trivial axes — ``{"data": 4,
+    "tensor": 1}`` and ``{"data": 4}`` describe the same topology."""
+    if not shape:
+        return {}
+    return {str(a): int(s) for a, s in shape.items() if int(s) > 1}
+
+
+def spec_to_json(spec) -> Optional[list]:
+    """A ``PartitionSpec`` as JSON: one entry per array dim, each
+    ``None`` | axis name | list of axis names."""
+    if spec is None:
+        return None
+    out = []
+    for entry in spec:
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+def capture_array_specs(tag: str, tree) -> dict[str, dict]:
+    """Flatten a pytree about to be orbax-saved under directory ``tag``
+    into ``{leaf_name: {shape, dtype, spec, bytes}}``. ``spec`` is the
+    leaf's ``NamedSharding`` PartitionSpec, or ``None`` for host arrays /
+    single-device-committed leaves (they restore as replicated)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out: dict[str, dict] = {}
+    for path, leaf in flat:
+        if not hasattr(leaf, "shape"):
+            continue
+        name = tag + jax.tree_util.keystr(path)
+        sharding = getattr(leaf, "sharding", None)
+        spec = None
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            spec = spec_to_json(sharding.spec)
+        dtype = getattr(leaf, "dtype", None)
+        dtype = np.dtype(dtype) if dtype is not None else np.asarray(leaf).dtype
+        shape = tuple(int(d) for d in np.shape(leaf))
+        out[name] = {
+            "shape": list(shape),
+            "dtype": dtype.name,
+            "spec": spec,
+            "bytes": int(np.prod(shape or (1,))) * dtype.itemsize,
+        }
+    return out
+
+
+def build_topology_record(accelerator, array_trees: Sequence[tuple]) -> dict:
+    """The topology block for this save, stamped into the manifest by
+    ``save_accelerator_state``. ``array_trees`` is ``[(dir_name, pytree),
+    ...]`` — exactly the pytrees handed to orbax, keyed by their
+    checkpoint subdirectory."""
+    from ..parallel.mesh import data_parallel_size, dcn_axes
+    from ..utils.random import get_seed
+
+    arrays: dict[str, dict] = {}
+    for tag, tree in array_trees:
+        arrays.update(capture_array_specs(tag, tree))
+    mesh = accelerator.mesh
+    return {
+        "schema_version": TOPOLOGY_SCHEMA_VERSION,
+        "process_count": int(accelerator.num_processes),
+        "mesh_shape": {str(a): int(s) for a, s in dict(mesh.shape).items()},
+        "mesh_devices": int(mesh.size),
+        "dcn_axes": list(dcn_axes()),
+        "data_parallel_degree": int(data_parallel_size(mesh)),
+        "seed": get_seed(),
+        "arrays": arrays,
+    }
+
+
+def live_topology(accelerator) -> dict:
+    """The running job's topology in the same shape as the saved record."""
+    from ..parallel.mesh import data_parallel_size, dcn_axes
+
+    mesh = accelerator.mesh
+    return {
+        "process_count": int(accelerator.num_processes),
+        "mesh_shape": {str(a): int(s) for a, s in dict(mesh.shape).items()},
+        "mesh_devices": int(mesh.size),
+        "dcn_axes": list(dcn_axes()),
+        "data_parallel_degree": int(data_parallel_size(mesh)),
+    }
+
+
+@dataclass
+class TopologyDelta:
+    """Outcome of :func:`compare_topology`.
+
+    ``status`` is one of :data:`IDENTICAL` / :data:`ELASTIC` /
+    :data:`UNKNOWN`; ``changes`` is a human-readable list of what moved
+    (empty for identical)."""
+
+    status: str
+    changes: list[str] = field(default_factory=list)
+    saved: Optional[dict] = None
+    live: Optional[dict] = None
+
+    @property
+    def is_elastic(self) -> bool:
+        return self.status == ELASTIC
+
+    def describe(self) -> str:
+        if self.status == IDENTICAL:
+            return "identical topology: bit-exact restore (RNG pickles + sampler positions reused)"
+        if self.status == UNKNOWN:
+            return (
+                "no topology record (pre-elastic checkpoint): restore is only "
+                "verifiable on the topology that wrote it"
+            )
+        return "topology changed: elastic restore (arrays reshard on load, RNG re-derived, sampler offset redistributed)"
+
+
+def _shape_str(shape: dict) -> str:
+    nt = _nontrivial(shape)
+    if not nt:
+        return "single-device"
+    return ",".join(f"{a}={s}" for a, s in sorted(nt.items()))
+
+
+def compare_topology(saved: Optional[dict], live: dict) -> TopologyDelta:
+    """Classify a restore of a checkpoint whose manifest carried ``saved``
+    (or ``None`` for schema-v1 manifests) onto the ``live`` topology."""
+    if not saved:
+        return TopologyDelta(UNKNOWN, saved=saved, live=live)
+    changes: list[str] = []
+    if int(saved.get("process_count", 1)) != int(live.get("process_count", 1)):
+        changes.append(
+            f"process count {saved.get('process_count')} -> {live.get('process_count')}"
+        )
+    s_shape, l_shape = _nontrivial(saved.get("mesh_shape")), _nontrivial(live.get("mesh_shape"))
+    if s_shape != l_shape:
+        changes.append(f"mesh {_shape_str(saved.get('mesh_shape', {}))} -> {_shape_str(live.get('mesh_shape', {}))}")
+    s_dp, l_dp = saved.get("data_parallel_degree"), live.get("data_parallel_degree")
+    if s_dp is not None and l_dp is not None and int(s_dp) != int(l_dp):
+        changes.append(f"data-parallel degree {s_dp} -> {l_dp}")
+    if tuple(saved.get("dcn_axes", ())) != tuple(live.get("dcn_axes", ())):
+        changes.append(
+            f"dcn axes {list(saved.get('dcn_axes', []))} -> {list(live.get('dcn_axes', []))}"
+        )
+    status = ELASTIC if changes else IDENTICAL
+    return TopologyDelta(status, changes=changes, saved=saved, live=live)
+
+
+@dataclass
+class ReshardPrediction:
+    """Cost-model estimate of the post-restore reshard: per-device wire
+    bytes, split into the ICI and DCN stages of a hierarchical
+    re-gather (see ``analysis.costmodel.reshard_cost``)."""
+
+    ici_bytes: int = 0
+    dcn_bytes: int = 0
+    array_count: int = 0
+    moved_count: int = 0
+    total_array_bytes: int = 0
+    per_array: dict = field(default_factory=dict)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.ici_bytes + self.dcn_bytes
+
+
+def predict_reshard(
+    saved: Optional[dict],
+    target_shape: Optional[dict] = None,
+    target_dcn: Sequence[str] = (),
+) -> ReshardPrediction:
+    """Price the reshard of every recorded array onto ``target_shape``
+    (a plain ``{axis: size}`` dict; defaults to the saved shape, i.e. a
+    same-topology restore, which moves nothing). Identical topologies
+    predict zero; otherwise each array is modelled as a hierarchical
+    ring re-gather over the target mesh — an upper bound, since
+    overlapping shard layouts move less."""
+    from ..analysis.costmodel import reshard_cost
+
+    pred = ReshardPrediction()
+    if not saved:
+        return pred
+    arrays = saved.get("arrays", {})
+    pred.array_count = len(arrays)
+    pred.total_array_bytes = sum(int(a.get("bytes", 0)) for a in arrays.values())
+    src_shape = _nontrivial(saved.get("mesh_shape"))
+    dst_shape = _nontrivial(target_shape if target_shape is not None else saved.get("mesh_shape"))
+    same = src_shape == dst_shape and tuple(saved.get("dcn_axes", ())) == tuple(target_dcn or ())
+    if same:
+        return pred
+    for name, rec in arrays.items():
+        nbytes = int(rec.get("bytes", 0))
+        cost = reshard_cost(nbytes, dst_shape, target_dcn)
+        pred.per_array[name] = cost
+        pred.ici_bytes += cost["ici"]
+        pred.dcn_bytes += cost["dcn"]
+        if cost["ici"] or cost["dcn"]:
+            pred.moved_count += 1
+    return pred
+
+
+# ---------------------------------------------------------------------------
+# deterministic host-RNG re-derivation (elastic restores)
+# ---------------------------------------------------------------------------
+
+def derive_rng_state(seed: Optional[int], process_index: int, step: int = 0) -> dict:
+    """Deterministic per-process host RNG for a topology-changed resume.
+
+    The saved ``rng_state_{i}.pkl`` pickles encode exact stream positions
+    for the *old* rank set; after an elastic restore there may be more
+    ranks than pickles (grow) or pickles than ranks (shrink), and reusing
+    rank ``i``'s stream on a different data shard would correlate draws
+    across the new layout anyway. Instead every rank folds
+    ``(seed, step, process_index)`` through a ``SeedSequence`` — the
+    elastic analogue of ``set_seed(device_specific=True)``: reproducible
+    (the same resume always draws the same streams) but NOT a
+    continuation of the old streams. Callers must surface that semantics
+    change (``ckpt_rng_rederive``)."""
+    # domain tag keeps these streams disjoint from any other SeedSequence
+    # use of the same seed
+    entropy = [0xE1A57, int(seed) if seed is not None else 0, int(step), int(process_index)]
+    ss = np.random.SeedSequence(entropy)
+    py_seed, np_seed = (int(x) for x in ss.generate_state(2, np.uint64))
+    return {"python_seed": py_seed, "numpy_seed": np_seed % (2**32), "seed": seed}
+
+
+def apply_derived_rng_state(derived: dict) -> None:
+    """Seed python/numpy from :func:`derive_rng_state` output and restore
+    the JAX key-derivation seed (without re-clobbering stream positions —
+    same contract as ``utils.random.restore_seed_for_keys``)."""
+    from ..utils.random import restore_seed_for_keys
+
+    random.seed(derived["python_seed"])
+    np.random.seed(derived["numpy_seed"])
+    restore_seed_for_keys(derived.get("seed"))
+
+
+# ---------------------------------------------------------------------------
+# sampler / dataloader redistribution
+# ---------------------------------------------------------------------------
+
+def redistribute_sampler_state(state: dict, new_global_batch_size: Optional[int]) -> tuple[dict, int]:
+    """Recompute a saved dataloader position for a new data-parallel
+    degree. The saved ``batches_yielded`` counts *global* batches of
+    ``global_batch_size`` samples; the invariant that survives an elastic
+    restore is the global sample offset — their product. Returns
+    ``(new_state, replayed_samples)`` where ``replayed_samples`` counts
+    samples that will be delivered a second time because the offset is
+    not divisible by the new global batch (rounded DOWN: replaying a few
+    samples is benign, skipping unseen ones is not)."""
+    old_gb = state.get("global_batch_size")
+    yielded = int(state.get("batches_yielded", 0) or 0)
+    if not old_gb or not new_global_batch_size or int(old_gb) == int(new_global_batch_size):
+        return dict(state), 0
+    offset = yielded * int(old_gb)
+    new_batches = offset // int(new_global_batch_size)
+    replayed = offset - new_batches * int(new_global_batch_size)
+    new_state = dict(state)
+    new_state["batches_yielded"] = new_batches
+    new_state["global_batch_size"] = int(new_global_batch_size)
+    return new_state, replayed

@@ -56,3 +56,23 @@ def barrier_value(axis_name: str):
     import jax.numpy as jnp
 
     return lax.psum(jnp.ones((), jnp.int32), axis_name)
+
+
+def agree_preempt_max(value: int) -> int:
+    """Host-level max-reduce of a per-process flag across ALL processes.
+
+    Preemption SIGTERMs are frequently delivered to only a subset of
+    hosts; a rank that checkpoints-and-exits while the others keep
+    training leaves the collective program desynchronised. Every rank
+    calls this at the same step boundary (``Accelerator.should_checkpoint``
+    / ``should_stop``) with its local flag, and every rank sees the same
+    answer — so the whole fleet takes the one final checkpoint together.
+    One scalar all-gather per call; single-process runs short-circuit."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.int32(value))
+    return int(np.max(flags))

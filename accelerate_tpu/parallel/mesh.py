@@ -26,10 +26,9 @@ outermost axes.
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from dataclasses import dataclass
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -47,6 +46,13 @@ class MeshConfig:
     """Logical mesh shape. ``-1`` on exactly one axis means "fill with all
     remaining devices" (so ``MeshConfig()`` is pure data parallelism).
 
+    ``num_devices`` restricts the mesh to the first N devices instead of
+    all of them — the topology-elasticity lever: a job resuming on a
+    machine with more devices than the checkpoint's mesh (or a test
+    simulating a shrunk fleet on the 8-device fake-CPU harness) can
+    rebuild the *saved* topology, or any smaller one, without changing
+    the hardware. ``None`` (default) uses every device.
+
     Plays the role of the reference's strategy plugins
     (``FullyShardedDataParallelPlugin``, ``TorchTensorParallelPlugin``,
     ``MegatronLMPlugin`` tp/pp/sp degrees — reference:
@@ -59,6 +65,7 @@ class MeshConfig:
     seq: int = 1
     pipe: int = 1
     expert: int = 1
+    num_devices: Optional[int] = None
 
     def sizes(self, num_devices: int) -> dict[str, int]:
         vals = {name: getattr(self, _FIELD_BY_AXIS[name]) for name in AXIS_NAMES}
@@ -85,6 +92,12 @@ class MeshConfig:
 
         if devices is None:
             devices = jax.devices()
+        if self.num_devices is not None:
+            if self.num_devices > len(devices):
+                raise ValueError(
+                    f"MeshConfig(num_devices={self.num_devices}) but only {len(devices)} devices are present"
+                )
+            devices = list(devices)[: self.num_devices]
         sizes = self.sizes(len(devices))
         shape = tuple(sizes[a] for a in AXIS_NAMES)
         # Auto axis types = classic GSPMD propagation (jax>=0.9 defaults new
@@ -110,13 +123,16 @@ class MeshConfig:
             val = os.environ.get(f"ACCELERATE_MESH_{name.upper()}")
             if val is not None:
                 kwargs[field] = int(val)
+        limit = os.environ.get("ACCELERATE_MESH_NUM_DEVICES")
+        if limit is not None:
+            kwargs["num_devices"] = int(limit)
         return cls(**kwargs)
 
     @property
     def is_trivial(self) -> bool:
         return all(
-            getattr(self, f.name) in (1, -1) or f.name == "data"
-            for f in dataclasses.fields(self)
+            getattr(self, name) in (1, -1) or name == "data"
+            for name in _FIELD_BY_AXIS.values()
         )
 
 

@@ -24,9 +24,16 @@ from __future__ import annotations
 import os
 from pathlib import Path
 
-from ..ft.crashpoints import CRASH_POINTS, set_crash_hook
+from ..ft.crashpoints import ALL_CRASH_POINTS, CRASH_POINTS, RESTORE_CRASH_POINTS, set_crash_hook
 
-__all__ = ["SimulatedCrash", "CrashPoint", "corrupt_file", "CRASH_POINTS"]
+__all__ = [
+    "SimulatedCrash",
+    "CrashPoint",
+    "corrupt_file",
+    "CRASH_POINTS",
+    "RESTORE_CRASH_POINTS",
+    "ALL_CRASH_POINTS",
+]
 
 
 class SimulatedCrash(RuntimeError):
@@ -38,8 +45,10 @@ class SimulatedCrash(RuntimeError):
 class CrashPoint:
     """Context manager that crashes the save at a labeled point.
 
-    ``label`` must be one of :data:`~accelerate_tpu.ft.crashpoints.CRASH_POINTS`.
-    ``hits`` delays the crash to the Nth time the label is reached (e.g.
+    ``label`` must be one of
+    :data:`~accelerate_tpu.ft.crashpoints.ALL_CRASH_POINTS` (save-path
+    ``CRASH_POINTS`` or restore-path ``RESTORE_CRASH_POINTS``). ``hits``
+    delays the crash to the Nth time the label is reached (e.g.
     the second model's pytree write). ``action``: ``"raise"`` (default)
     raises :class:`SimulatedCrash`; ``"kill"`` calls ``os._exit(17)``.
     The hook is process-wide and cleared on exit; ``fired`` records
@@ -48,8 +57,8 @@ class CrashPoint:
     EXIT_CODE = 17
 
     def __init__(self, label: str, action: str = "raise", hits: int = 1):
-        if label not in CRASH_POINTS:
-            raise ValueError(f"unknown crash point {label!r}; choose from {CRASH_POINTS}")
+        if label not in ALL_CRASH_POINTS:
+            raise ValueError(f"unknown crash point {label!r}; choose from {ALL_CRASH_POINTS}")
         if action not in ("raise", "kill"):
             raise ValueError(f"action must be raise|kill, got {action!r}")
         self.label = label

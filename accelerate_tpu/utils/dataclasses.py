@@ -238,6 +238,12 @@ class FaultToleranceKwargs(KwargsHandler):
     #: ``Accelerator.should_checkpoint`` / ``should_stop``
     handle_preemption: bool = True
     preemption_signals: tuple = ("SIGTERM", "SIGINT")
+    #: multi-host: max-reduce the local preempt flag across every process
+    #: each time ``should_checkpoint``/``should_stop`` is read, so a
+    #: SIGTERM delivered to a subset of hosts flips the flag on ALL ranks
+    #: in the same step (one scalar all-gather per check; single-process
+    #: runs never pay it)
+    agree_preemption: bool = True
     #: jittered-exponential-backoff attempts for checkpoint filesystem IO
     io_retries: int = 3
     retry_base_delay: float = 0.1

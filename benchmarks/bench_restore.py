@@ -1,0 +1,142 @@
+"""Elastic checkpoint restore benchmark: restore latency + predicted
+reshard bytes across topology changes.
+
+The ROADMAP note says evidence must be CPU-derivable, so this measures
+what CAN be measured without a pod — wall-clock save/restore latency on
+the 8-device fake-CPU mesh — and reports what the cost model *predicts*
+for the part a pod would feel: the post-restore reshard traffic (ICI vs
+DCN wire bytes from ``analysis.costmodel.reshard_cost``, the same
+numbers ``accelerate-tpu checkpoints describe`` prints).
+
+One JSON line per (save mesh -> restore mesh) direction::
+
+    {"bench": "restore", "src": "data=4", "dst": "data=8",
+     "compatibility": "elastic", "save_s": ..., "restore_s": ...,
+     "predicted_reshard_ici_bytes": ..., "predicted_reshard_dcn_bytes": ...,
+     "params_bit_exact": true, "step_preserved": true}
+
+Usage: python benchmarks/bench_restore.py [--small] [--layers N]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root
+
+from accelerate_tpu.utils.environment import force_host_platform
+
+force_host_platform(8)  # before any jax import: the fake multi-chip mesh
+
+import argparse
+import json
+import tempfile
+import time
+
+
+MESHES = {
+    "data=4": dict(data=4, num_devices=4),
+    "data=8": dict(data=8),
+    "data=2,tensor=2": dict(data=2, tensor=2, num_devices=4),
+    "data=1": dict(data=1, num_devices=1),
+}
+
+DIRECTIONS = [
+    ("data=4", "data=8"),        # grow
+    ("data=4", "data=1"),        # shrink to one device
+    ("data=2,tensor=2", "data=4"),  # re-layout at equal size
+    ("data=4", "data=4"),        # identical-topology control (zero reshard)
+]
+
+
+def _reset():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _build(project_dir: str, mesh_name: str, cfg):
+    from accelerate_tpu import Accelerator, MeshConfig, ParallelismPlugin, ProjectConfiguration
+    from accelerate_tpu.models import create_llama_model
+
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=1
+        ),
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(**MESHES[mesh_name])),
+    )
+    model = acc.prepare_model(create_llama_model(cfg, seq_len=32))
+    import optax
+
+    acc.prepare_optimizer(optax.adam(1e-3))
+    return acc, model
+
+
+def bench_direction(src: str, dst: str, cfg) -> dict:
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.commands.checkpoints import describe_checkpoint
+    from accelerate_tpu.ft import CheckpointManager
+
+    with tempfile.TemporaryDirectory() as project_dir:
+        acc, model = _build(project_dir, src, cfg)
+        acc.step = 7
+        t0 = time.perf_counter()
+        out = acc.save_state()
+        save_s = time.perf_counter() - t0
+        want = [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(model.params)]
+        assert CheckpointManager(os.path.join(project_dir, "checkpoints")).verify(out).ok
+
+        # what `checkpoints describe` would predict for this direction
+        dst_shape = {k: v for k, v in MESHES[dst].items() if k != "num_devices"}
+        info = describe_checkpoint(out, target_mesh=dst_shape)
+
+        acc2, model2 = _build(project_dir, dst, cfg)
+        t0 = time.perf_counter()
+        acc2.load_state()
+        restore_s = time.perf_counter() - t0
+        got = [np.asarray(x) for x in jax.tree_util.tree_leaves(model2.params)]
+        bit_exact = all(np.array_equal(a, b) for a, b in zip(want, got))
+
+        return {
+            "bench": "restore",
+            "src": src,
+            "dst": dst,
+            "compatibility": info["compatibility"],
+            "array_count": info["reshard"]["array_count"],
+            "checkpoint_bytes": info["reshard"]["total_array_bytes"],
+            "save_s": round(save_s, 4),
+            "restore_s": round(restore_s, 4),
+            "predicted_reshard_ici_bytes": info["reshard"]["ici_bytes"],
+            "predicted_reshard_dcn_bytes": info["reshard"]["dcn_bytes"],
+            "params_bit_exact": bit_exact,
+            "step_preserved": acc2.step == 7,
+        }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--small", action="store_true", help="tiny model (CI smoke)")
+    parser.add_argument("--layers", type=int, default=None)
+    args = parser.parse_args()
+
+    from accelerate_tpu.models import LlamaConfig
+
+    if args.small:
+        cfg = LlamaConfig(hidden_size=64, intermediate_size=128, num_hidden_layers=args.layers or 2,
+                          num_attention_heads=4, num_key_value_heads=4, vocab_size=256)
+    else:
+        cfg = LlamaConfig(hidden_size=512, intermediate_size=1024, num_hidden_layers=args.layers or 4,
+                          num_attention_heads=8, num_key_value_heads=8, vocab_size=4096)
+
+    for src, dst in DIRECTIONS:
+        print(json.dumps(bench_direction(src, dst, cfg)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,21 +1,27 @@
 """Preemption-safe training: auto-resume + SIGTERM-to-final-checkpoint
++ topology-elastic restore
 (docs/usage_guides/fault_tolerance.md; no reference analogue).
 
 Run it twice against the same project dir to see auto-resume pick up
 exactly where the first run stopped; send the process SIGTERM mid-run to
-see the final synchronous checkpoint + clean exit.
+see the final synchronous checkpoint + clean exit. The last phase
+resumes the SAME checkpoints on a different mesh — the elastic-restore
+path: arrays reshard on load, RNG is re-derived deterministically, and
+the sampler offset is redistributed (all announced via warnings and
+telemetry events, never silent).
 """
 
 import tempfile
 
-from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu import Accelerator, MeshConfig, ParallelismPlugin, ProjectConfiguration
 from accelerate_tpu.utils import FaultToleranceKwargs
 
 from _common import final_weights, make_task
 
 
-def train(project_dir: str, max_steps: int = 24) -> int:
+def train(project_dir: str, max_steps: int = 24, mesh_config: MeshConfig = None) -> int:
     accelerator = Accelerator(
+        parallelism_plugin=ParallelismPlugin(mesh_config=mesh_config) if mesh_config else None,
         project_config=ProjectConfiguration(
             project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=3
         ),
@@ -62,6 +68,20 @@ def main():
         reached = train(project_dir, max_steps=24)
         print(f"second run finished at step {reached}")
         assert reached >= 24
+
+        # elastic restore: the fleet shrank — resume the same checkpoints
+        # on a 4-device data=2 x tensor=2 mesh. Arrays reshard on load;
+        # `accelerate-tpu checkpoints describe <dir> --mesh data=2,tensor=2`
+        # predicts the reshard bytes this pays.
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        reached = train(
+            project_dir, max_steps=32,
+            mesh_config=MeshConfig(data=2, tensor=2, num_devices=4),
+        )
+        print(f"elastic run (mesh data=2,tensor=2) finished at step {reached}")
+        assert reached >= 32
 
 
 if __name__ == "__main__":

@@ -59,6 +59,7 @@ MODULES = [
     "accelerate_tpu.ft.manifest",
     "accelerate_tpu.ft.manager",
     "accelerate_tpu.ft.preemption",
+    "accelerate_tpu.ft.topology",
     "accelerate_tpu.ft.crashpoints",
     "accelerate_tpu.test_utils.fault_injection",
     "accelerate_tpu.utils.retry",

@@ -822,8 +822,79 @@ def test_checkpoints_gc(tmp_path):
     assert not (base / "checkpoint_2.tmp").exists()
 
 
+def _seed_topology_checkpoint(base):
+    """A committed checkpoint whose (v2) manifest carries a topology
+    record — saved on mesh data=4, 2 processes."""
+    from accelerate_tpu.ft.manifest import build_manifest, write_manifest
+
+    d = base / "checkpoint_0"
+    (d / "model").mkdir(parents=True)
+    (d / "model" / "arrays.bin").write_bytes(bytes(range(64)))
+    (d / "accelerate_state.json").write_text(json.dumps({"step": 12, "seed": 5}))
+    topology = {
+        "schema_version": 1,
+        "process_count": 2,
+        "mesh_shape": {"data": 4, "tensor": 1},
+        "mesh_devices": 4,
+        "dcn_axes": [],
+        "data_parallel_degree": 4,
+        "seed": 5,
+        "arrays": {
+            "model['w']": {"shape": [16, 16], "dtype": "float32", "spec": ["data", None], "bytes": 1024},
+        },
+    }
+    write_manifest(d, build_manifest(d, step=12, iteration=0, topology=topology))
+    return d
+
+
+def test_checkpoints_describe_matching_and_mismatching(tmp_path):
+    base = tmp_path / "checkpoints"
+    ck = _seed_topology_checkpoint(base)
+
+    # no --mesh: checked against the saved topology itself -> identical
+    result = run_cli("checkpoints", "describe", str(ck), "--format", "json")
+    assert result.returncode == 0, result.stderr
+    info = json.loads(result.stdout)
+    assert info["compatibility"] == "identical"
+    assert info["reshard"]["total_bytes"] == 0
+    assert info["saved_topology"]["mesh_shape"]["data"] == 4
+
+    # mismatching target mesh -> elastic, with a nonzero reshard estimate
+    result = run_cli(
+        "checkpoints", "describe", str(ck),
+        "--mesh", "data=4,fsdp=2", "--dcn-axes", "fsdp", "--processes", "4",
+        "--format", "json",
+    )
+    assert result.returncode == 0, result.stderr
+    info = json.loads(result.stdout)
+    assert info["compatibility"] == "elastic"
+    assert any("process count" in c for c in info["changes"])
+    assert info["reshard"]["dcn_bytes"] == 1024 // 2  # 2-way DCN ring stage
+    assert info["reshard"]["ici_bytes"] == 1024 * 3 // 4  # 4-way ICI stage
+
+    # text output names the verdict and the traffic split
+    result = run_cli("checkpoints", "describe", str(ck), "--mesh", "data=8")
+    assert result.returncode == 0
+    assert "ELASTIC" in result.stdout and "predicted reshard traffic" in result.stdout
+    # base-dir form resolves to the newest valid checkpoint
+    result = run_cli("checkpoints", "describe", str(base))
+    assert result.returncode == 0
+    assert "IDENTICAL" in result.stdout
+
+
+def test_checkpoints_describe_no_topology(tmp_path):
+    base = tmp_path / "checkpoints"
+    _seed_checkpoint_fixtures(base)  # v2 manifests without topology blocks
+    result = run_cli("checkpoints", "describe", str(base / "checkpoint_0"), "--format", "json")
+    assert result.returncode == 0, result.stderr
+    info = json.loads(result.stdout)
+    assert info["compatibility"] == "unknown"
+    assert info["saved_topology"] is None
+
+
 def test_checkpoints_selfcheck():
     """The make ft-selfcheck gate: seeded fixtures classify correctly."""
     result = run_cli("checkpoints", "verify", "--selfcheck")
     assert result.returncode == 0, result.stdout + result.stderr
     assert "[checkpoints selfcheck] OK" in result.stdout
+    assert "describe classifies" in result.stdout

@@ -12,6 +12,7 @@ checkpoint before a new one commits.
 import json
 import os
 import signal
+from pathlib import Path
 
 import numpy as np
 import optax
@@ -472,3 +473,401 @@ def test_checkpoint_events_land_in_telemetry_log(tmp_path):
     assert "ckpt_save" in names
     assert "ckpt_commit" in names
     assert "ckpt_auto_resume" in names
+
+
+# --------------------------------------------------------------------------- #
+# topology-elastic restore (ISSUE 6)
+# --------------------------------------------------------------------------- #
+
+from accelerate_tpu import MeshConfig, ParallelismPlugin  # noqa: E402
+from accelerate_tpu.ft import (  # noqa: E402
+    RESTORE_CRASH_POINTS,
+    compare_topology,
+    derive_rng_state,
+    predict_reshard,
+    redistribute_sampler_state,
+)
+
+# the elastic matrix meshes, all realisable on the 8-device fake-CPU
+# harness: (4,) and (2,2) use a 4-device subset, (1,) a single device
+MESHES = {
+    "4": dict(data=4, num_devices=4),
+    "8": dict(data=8),
+    "2x2": dict(data=2, tensor=2, num_devices=4),
+    "1": dict(data=1, num_devices=1),
+}
+
+# save-side -> restore-side pairs: both ISSUE sources against every
+# target, plus the reverse direction for the targets that are not
+# themselves sources
+MATRIX = {
+    "4": ("8", "4", "2x2", "1"),
+    "2x2": ("8", "4", "2x2", "1"),
+    "8": ("4", "2x2"),
+    "1": ("4", "2x2"),
+}
+
+
+def _fresh_mesh(project_dir, mesh_kwargs, with_loader=True):
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(project_dir), automatic_checkpoint_naming=True),
+        parallelism_plugin=ParallelismPlugin(mesh_config=MeshConfig(**mesh_kwargs)),
+    )
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.adam(0.05))
+    loader = None
+    if with_loader:
+        loader = acc.prepare(RegressionDataset(length=64, seed=11))
+        loader.batch_size = 8 // acc.num_data_shards  # global batch stays 8
+    return acc, model, loader
+
+
+def _array_snapshot(acc, model):
+    import jax
+
+    return {
+        "params": [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(model.params)],
+        "opt": [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(acc._optimizers[-1].opt_state)],
+        "step": acc.step,
+    }
+
+
+def _assert_bit_exact(acc, model, want):
+    import jax
+
+    for got, exp in zip(jax.tree_util.tree_leaves(model.params), want["params"]):
+        assert np.array_equal(np.asarray(got), exp), "params must restore bit-exact"
+    for got, exp in zip(jax.tree_util.tree_leaves(acc._optimizers[-1].opt_state), want["opt"]):
+        assert np.array_equal(np.asarray(got), exp), "opt_state must restore bit-exact"
+    assert acc.step == want["step"]
+
+
+@pytest.mark.parametrize("src", list(MATRIX))
+def test_elastic_restore_matrix(tmp_path, src):
+    """ISSUE 6 acceptance: a checkpoint saved on mesh ``src`` restores
+    bit-exact params/opt-state and the correct step/sampler offset on
+    every target mesh, including a resume after an injected mid-restore
+    crash per direction."""
+    acc, model, loader = _fresh_mesh(tmp_path, MESHES[src])
+    step = acc.build_train_step(linear_loss_fn)
+    it = iter(loader)
+    next(it), next(it)  # 2 global batches delivered mid-epoch
+    step(BATCH)
+    step(BATCH)
+    acc.save_state()
+    want = _array_snapshot(acc, model)
+    del it
+
+    for dst in MATRIX[src]:
+        # injected crash mid-restore, then the retry must still succeed
+        acc2, model2, loader2 = _fresh_mesh(tmp_path, MESHES[dst])
+        with CrashPoint("mid_restore_arrays") as cp:
+            with pytest.raises(SimulatedCrash):
+                acc2.load_state()
+        assert cp.fired
+        src_path = acc2.load_state()  # checkpoint untouched by the crash
+        assert os.path.basename(src_path) == "checkpoint_0"
+        _assert_bit_exact(acc2, model2, want)
+        assert loader2.skip_batches == 2, f"{src}->{dst}: sampler offset lost"
+        # training continues on the new topology and the next save commits
+        step2 = acc2.build_train_step(linear_loss_fn)
+        step2(BATCH)
+
+
+def test_elastic_restore_emits_telemetry_and_rederives_rng(tmp_path):
+    from accelerate_tpu.telemetry import read_events
+
+    acc, model, loader = _fresh_mesh(tmp_path, MESHES["4"])
+    step = acc.build_train_step(linear_loss_fn)
+    step(BATCH)
+    acc.save_state()
+
+    acc2, model2, loader2 = _fresh_mesh(tmp_path, MESHES["8"])
+    tel = acc2.telemetry
+    acc2.load_state()
+    tel.close()
+    events = {e["name"]: e for e in read_events(tel.path)}
+    assert "ckpt_elastic_restore" in events, "elastic path must never be silent"
+    assert events["ckpt_elastic_restore"]["severity"] == "warning"
+    assert any("mesh" in c for c in events["ckpt_elastic_restore"]["changes"])
+    assert "ckpt_rng_rederive" in events
+    # the re-derived streams are deterministic: a second identical elastic
+    # restore draws the same next value
+    first_draw = float(np.random.rand())
+    acc3, model3, loader3 = _fresh_mesh(tmp_path, MESHES["8"])
+    acc3.load_state()
+    assert float(np.random.rand()) == pytest.approx(first_draw, abs=0)
+
+
+def test_identical_topology_restore_stays_bit_exact(tmp_path):
+    """The elastic path must NOT fire on a same-topology resume: RNG
+    comes back from the pickles, stream positions intact."""
+    acc, model, loader = _fresh_mesh(tmp_path, MESHES["4"])
+    step = acc.build_train_step(linear_loss_fn)
+    step(BATCH)
+    acc.save_state()
+    want_rand = _next_rand_from(np.random.get_state())
+
+    acc2, model2, loader2 = _fresh_mesh(tmp_path, MESHES["4"])
+    acc2.load_state()
+    assert float(np.random.rand()) == pytest.approx(want_rand, abs=0)
+
+
+def test_elastic_sampler_offset_redistribution(tmp_path):
+    """Different global batch on the restore side: the global sample
+    offset (2 batches x 8 samples) re-splits into 1 batch of 16."""
+    acc, model, loader = _fresh_mesh(tmp_path, MESHES["4"])  # global batch 8
+    it = iter(loader)
+    next(it), next(it)
+    step = acc.build_train_step(linear_loss_fn)
+    step(BATCH)
+    acc.save_state()
+    del it
+
+    acc2, model2, loader2 = _fresh_mesh(tmp_path, MESHES["2x2"])
+    loader2.batch_size = 16 // acc2.num_data_shards  # global batch 16
+    acc2.load_state()
+    assert loader2.skip_batches == 1  # 16 samples / 16 per global batch
+
+
+def test_redistribute_sampler_state_math():
+    s = {"batches_yielded": 6, "global_batch_size": 8, "sampler_seed": 3}
+    out, replayed = redistribute_sampler_state(s, 16)
+    assert out["batches_yielded"] == 3 and replayed == 0
+    out, replayed = redistribute_sampler_state(s, 32)
+    assert out["batches_yielded"] == 1 and replayed == 16  # rounds DOWN: replay, never skip
+    assert out["sampler_seed"] == 3  # permutation identity survives
+    # identity when nothing changed or nothing is known
+    assert redistribute_sampler_state(s, 8) == (s, 0)
+    assert redistribute_sampler_state({"batches_yielded": 2}, 16)[1] == 0
+
+
+def test_derive_rng_state_is_deterministic_and_rank_folded():
+    a = derive_rng_state(42, process_index=0, step=10)
+    assert a == derive_rng_state(42, process_index=0, step=10)
+    assert a != derive_rng_state(42, process_index=1, step=10)  # fold-in of the new rank
+    assert a != derive_rng_state(43, process_index=0, step=10)
+    assert a != derive_rng_state(42, process_index=0, step=11)
+
+
+def test_compare_topology_tiers():
+    saved = {"process_count": 2, "mesh_shape": {"data": 4, "tensor": 1}, "dcn_axes": [],
+             "data_parallel_degree": 4}
+    same = dict(saved, mesh_shape={"data": 4})  # trivial axes are normalised away
+    assert compare_topology(saved, same).status == "identical"
+    assert compare_topology(None, same).status == "unknown"
+    moved = compare_topology(saved, dict(saved, mesh_shape={"data": 8}, data_parallel_degree=8))
+    assert moved.status == "elastic" and moved.is_elastic
+    assert any("mesh" in c for c in moved.changes)
+    grown = compare_topology(saved, dict(saved, process_count=4))
+    assert grown.status == "elastic"
+    assert any("process count" in c for c in grown.changes)
+
+
+def test_predict_reshard_prices_ici_dcn_split():
+    saved = {
+        "mesh_shape": {"data": 4}, "dcn_axes": [], "data_parallel_degree": 4,
+        "arrays": {"w": {"shape": [8, 4], "dtype": "float32", "spec": ["data", None], "bytes": 1024}},
+    }
+    none = predict_reshard(saved)  # same topology -> nothing moves
+    assert none.total_bytes == 0 and none.moved_count == 0
+    ici = predict_reshard(saved, {"data": 8}, ())
+    assert ici.ici_bytes == 1024 * 7 // 8 and ici.dcn_bytes == 0
+    hybrid = predict_reshard(saved, {"data": 4, "fsdp": 2}, ("fsdp",))
+    assert hybrid.ici_bytes == 1024 * 3 // 4  # ring over the 4-way ICI stage
+    assert hybrid.dcn_bytes == 1024 * 1 // 2  # ring over the 2-way DCN stage
+    assert predict_reshard(None).total_bytes == 0
+
+
+# --------------------------------------------------------------------------- #
+# restore-side fault injection / corruption matrix
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("label", RESTORE_CRASH_POINTS)
+def test_crash_at_every_restore_point_leaves_checkpoint_valid(tmp_path, label):
+    """Restore never mutates the checkpoint: a kill at any restore point
+    leaves it deep-valid, and a fresh auto-resume lands on it with the
+    exact saved state (including RNG stream positions)."""
+    acc, model, step, loader = _fresh(tmp_path, with_loader=True)
+    step(BATCH)
+    acc.save_state()
+    want = _snapshot(acc, model)
+
+    acc2, model2, step2, loader2 = _fresh(tmp_path, with_loader=True)
+    with CrashPoint(label) as cp:
+        with pytest.raises(SimulatedCrash):
+            acc2.load_state()
+    assert cp.fired, f"restore crash point {label} was never reached"
+
+    mgr = CheckpointManager(tmp_path / "checkpoints")
+    assert mgr.verify(tmp_path / "checkpoints" / "checkpoint_0").ok, "crash mid-restore damaged the checkpoint"
+    acc3, model3, step3, loader3 = _fresh(tmp_path, with_loader=True)
+    src = acc3.load_state()
+    assert os.path.basename(src) == "checkpoint_0"
+    assert float(np.asarray(model3.params["a"])) == pytest.approx(want["a"])
+    assert acc3.step == want["step"]
+    assert float(np.random.rand()) == pytest.approx(want["next_rand"], abs=0)
+
+
+def test_elastic_auto_resume_walks_back_past_truncated_shard(tmp_path):
+    """Restore-side corruption under a topology change: the newest
+    checkpoint has a truncated orbax shard, so the elastic auto-resume
+    must walk back and reshard the older one."""
+    from accelerate_tpu.ft import read_manifest as _read_manifest
+
+    acc, model, loader = _fresh_mesh(tmp_path, MESHES["4"])
+    step = acc.build_train_step(linear_loss_fn)
+    step(BATCH)
+    acc.save_state()  # checkpoint_0 (good)
+    want = _array_snapshot(acc, model)
+    step(BATCH)
+    acc.save_state()  # checkpoint_1 (to be truncated)
+    base = tmp_path / "checkpoints"
+    manifest = _read_manifest(base / "checkpoint_1")
+    rel = max(manifest["pytree_files"], key=manifest["pytree_files"].get)
+    corrupt_file(base / "checkpoint_1" / rel, mode="truncate")
+
+    acc2, model2, loader2 = _fresh_mesh(tmp_path, MESHES["8"])
+    src = acc2.load_state()
+    assert os.path.basename(src) == "checkpoint_0"
+    _assert_bit_exact(acc2, model2, want)
+
+
+@pytest.mark.parametrize("strip", ["v1", "topology"])
+def test_pre_elastic_manifest_restores_on_identical_topology(tmp_path, strip):
+    """Backward compat: a schema-v1 manifest (or a v2 manifest whose
+    topology block was deleted) still commits and restores bit-exact on
+    the topology that wrote it."""
+    from accelerate_tpu.ft import write_manifest as _write_manifest
+
+    acc, model, step, loader = _fresh(tmp_path, with_loader=True)
+    step(BATCH)
+    acc.save_state()
+    want = _snapshot(acc, model)
+    ck = tmp_path / "checkpoints" / "checkpoint_0"
+    manifest = read_manifest(ck)
+    assert manifest["schema_version"] == 2 and "topology" in manifest
+    manifest.pop("topology")
+    if strip == "v1":
+        manifest["schema_version"] = 1
+    _write_manifest(ck, manifest)
+
+    acc2, model2, step2, loader2 = _fresh(tmp_path, with_loader=True)
+    src = acc2.load_state()  # discovery still accepts the old manifest
+    assert os.path.basename(src) == "checkpoint_0"
+    assert float(np.asarray(model2.params["a"])) == pytest.approx(want["a"])
+    assert acc2.step == want["step"]
+    # identical topology + no record -> the legacy bit-exact RNG path
+    assert float(np.random.rand()) == pytest.approx(want["next_rand"], abs=0)
+
+
+def test_missing_rng_file_warns_and_emits_telemetry(tmp_path):
+    """Satellite: a missing rng_state_{i}.pkl must be LOUD (the seed
+    silently resumed with fresh-process RNG)."""
+    from accelerate_tpu.telemetry import read_events
+
+    acc, model, step, _ = _fresh(tmp_path)
+    step(BATCH)
+    out = acc.save_state()
+    (Path(out) / "rng_state_0.pkl").unlink()
+
+    acc2, model2, step2, _ = _fresh(tmp_path)
+    tel = acc2.telemetry
+    acc2.load_state(out)  # explicit dir: bypasses deep-verify discovery
+    tel.close()
+    events = [e for e in read_events(tel.path) if e["name"] == "ckpt_rng_missing"]
+    assert events and events[0]["severity"] == "warning"
+    assert events[0]["file"] == "rng_state_0.pkl"
+    # params still restore
+    assert float(np.asarray(model2.params["a"])) == pytest.approx(float(np.asarray(model.params["a"])))
+
+
+def test_sampler_count_mismatch_warns(tmp_path):
+    """Satellite: restoring onto a different number of prepared
+    dataloaders must not silently restore a prefix."""
+    from accelerate_tpu.telemetry import read_events
+
+    acc, model, step, loader = _fresh(tmp_path, with_loader=True)
+    step(BATCH)
+    out = acc.save_state()
+
+    acc2, model2, step2, _ = _fresh(tmp_path)  # no loader prepared
+    tel = acc2.telemetry
+    acc2.load_state(out)
+    tel.close()
+    events = [e for e in read_events(tel.path) if e["name"] == "ckpt_sampler_mismatch"]
+    assert events and events[0]["severity"] == "error"
+    assert events[0]["saved"] == 1 and events[0]["prepared"] == 0
+
+
+# --------------------------------------------------------------------------- #
+# preemption agreement (one-rank SIGTERM -> all ranks checkpoint)
+# --------------------------------------------------------------------------- #
+
+def test_agree_preempt_max_single_process():
+    from accelerate_tpu.parallel.collectives import agree_preempt_max
+
+    assert agree_preempt_max(0) == 0
+    assert agree_preempt_max(1) == 1
+
+
+def test_preemption_agreement_flips_unsignalled_rank(tmp_path, monkeypatch):
+    """A SIGTERM delivered to only SOME hosts: the agreement max-reduce
+    must flip should_checkpoint/should_stop on a rank that never saw the
+    signal, and its final save must demote async to sync."""
+    from accelerate_tpu.parallel import collectives
+
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True),
+        kwargs_handlers=[FaultToleranceKwargs(preemption_signals=("SIGTERM",))],
+    )
+    try:
+        acc.prepare_model(RegressionModel())
+        acc.prepare_optimizer(optax.sgd(0.1))
+        step = acc.build_train_step(linear_loss_fn)
+        step(BATCH)
+        # pretend to be one host of two; the OTHER host got the SIGTERM
+        acc.state.partial_state.num_processes_host = 2
+        calls = []
+
+        def fake_agree(value):
+            calls.append(value)
+            return 1  # some rank's flag is up
+
+        monkeypatch.setattr(collectives, "agree_preempt_max", fake_agree)
+        assert acc.should_checkpoint and acc.should_stop
+        assert calls == [0], "agreement must run exactly once, with the LOCAL (unsignalled) flag"
+        assert acc.preemption_handler.received == "REMOTE"
+        n_calls = len(calls)
+        assert acc.should_stop  # latched: no further collectives
+        assert len(calls) == n_calls
+
+        out = acc.save_state(async_save=True)  # demoted to sync under agreed preemption
+        from accelerate_tpu import checkpointing
+
+        assert checkpointing._PENDING_ASYNC == []
+        assert CheckpointManager(tmp_path / "checkpoints").verify(out).ok
+        assert not acc.should_checkpoint and acc.should_stop
+    finally:
+        if acc.preemption_handler is not None:
+            acc.preemption_handler.uninstall()
+
+
+def test_preemption_agreement_false_when_no_rank_signalled(tmp_path, monkeypatch):
+    from accelerate_tpu.parallel import collectives
+
+    _reset()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(project_dir=str(tmp_path), automatic_checkpoint_naming=True),
+        kwargs_handlers=[FaultToleranceKwargs(preemption_signals=("SIGTERM",))],
+    )
+    try:
+        acc.state.partial_state.num_processes_host = 2
+        monkeypatch.setattr(collectives, "agree_preempt_max", lambda v: v)
+        assert not acc.should_checkpoint and not acc.should_stop
+        assert acc.preemption_handler.received is None
+    finally:
+        if acc.preemption_handler is not None:
+            acc.preemption_handler.uninstall()
