@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check telemetry-selfcheck ft-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -41,6 +41,7 @@ lint:
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
+	-$(MAKE) --no-print-directory aot-selfcheck
 
 # Multi-host divergence analyzer (TPU4xx): prove TPU401-405 fire on their
 # seeded deadlock fixtures (and the clean fixture stays quiet), then
@@ -80,6 +81,12 @@ telemetry-selfcheck:
 # describe` classifies identical/elastic/unknown and prices the reshard.
 ft-selfcheck:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli checkpoints verify --selfcheck
+
+# Compile cache (aot/): cold compile -> serialized executable store ->
+# second cache deserializes with ZERO XLA compiles -> a poisoned entry is
+# rejected cleanly and healed. Proves the AOT warm-start loop on CPU.
+aot-selfcheck:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli compile-cache --selfcheck
 
 style:
 	@if command -v ruff >/dev/null 2>&1; then ruff check --fix accelerate_tpu tests examples && ruff format accelerate_tpu tests examples; else echo "ruff not installed; style target is a no-op here"; fi

@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 from .state import AcceleratorState, GradientState, PartialState
 from .logging import get_logger
 from .utils import (
+    CompileKwargs,
     DataLoaderConfiguration,
     DistributedType,
     GradientAccumulationPlugin,

@@ -86,7 +86,7 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
     ):
         # kwargs handlers (reference: accelerator.py:415-452)
-        from .utils.dataclasses import FaultToleranceKwargs, TelemetryKwargs
+        from .utils.dataclasses import CompileKwargs, FaultToleranceKwargs, TelemetryKwargs
 
         self.autocast_handler = AutocastKwargs()
         self.scaler_handler = GradScalerKwargs()
@@ -94,9 +94,11 @@ class Accelerator:
         self.init_handler = DistributedInitKwargs()
         self.telemetry_handler = TelemetryKwargs()
         self.ft_handler = FaultToleranceKwargs()
+        self.compile_handler = CompileKwargs()
         # opt-in behaviors (signal handlers, tracker retries) only activate
         # when the user passed the handler explicitly
         self._ft_explicit = False
+        self._compile_explicit = False
         self.fp8_recipe_handler = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, AutocastKwargs):
@@ -112,6 +114,9 @@ class Accelerator:
             elif isinstance(handler, FaultToleranceKwargs):
                 self.ft_handler = handler
                 self._ft_explicit = True
+            elif isinstance(handler, CompileKwargs):
+                self.compile_handler = handler
+                self._compile_explicit = True
             else:
                 from .utils.dataclasses import Fp8RecipeKwargs, MixedPrecisionPolicy
 
@@ -213,6 +218,25 @@ class Accelerator:
 
         # runtime telemetry (lazy — see the `telemetry` property)
         self._telemetry = None
+
+        # compile management (docs/usage_guides/compilation.md): the shared
+        # ProgramCache + persistent caches activate when a CompileKwargs
+        # handler was passed or ACCELERATE_COMPILE_CACHE_DIR is set — a
+        # bare Accelerator() must never start writing cache files
+        self._program_cache = None
+        if self._compile_explicit or os.environ.get("ACCELERATE_COMPILE_CACHE_DIR"):
+            from .aot import ExecutableStore, ProgramCache, configure_persistent_cache, resolve_cache_dir
+
+            ch = self.compile_handler
+            cache_dir = resolve_cache_dir(
+                ch.cache_dir, self.project_dir, self.project_configuration.compile_cache_dir_name
+            )
+            store = None
+            if cache_dir is not None and ch.executable_store:
+                store = ExecutableStore(os.path.join(cache_dir, "executables"))
+            self._program_cache = ProgramCache(store=store)
+            if cache_dir is not None and ch.persistent_xla_cache:
+                configure_persistent_cache(os.path.join(cache_dir, "xla"), ch.min_compile_time_secs)
 
         # fault tolerance (docs/usage_guides/fault_tolerance.md): the
         # checkpoint a run resumed from (protected from pruning), the
@@ -680,6 +704,8 @@ class Accelerator:
         model = model or self._models[-1]
         compute_cast = self._compute_cast
         jitted = jax.jit(lambda p, *args, **kwargs: eval_fn(compute_cast(p), *args, **kwargs))
+        if self._program_cache is not None and self.compile_handler.aot_train_step:
+            jitted = self._program_cache.wrap_jit(jitted, name="eval_step")
         ctx = self._matmul_precision_ctx
 
         def run(*args, **kwargs):
@@ -1059,8 +1085,18 @@ class Accelerator:
             # microbatches never stream the state — see step_fn.
             donate_args = tuple(i for i in donate_args if i != 1)
             jitted = jax.jit(step_fn, donate_argnums=donate_args, static_argnums=(6,))
+            step_statics = (6,)
         else:
             jitted = jax.jit(step_fn, donate_argnums=donate_args)
+            step_statics = ()
+        if self._program_cache is not None and self.compile_handler.aot_train_step:
+            # AOT warm-start: dispatch goes signature -> executable through
+            # the shared ProgramCache, so a restarted process re-creating
+            # this step deserializes from the store instead of recompiling
+            # (the wrapper keeps `_cache_size` for the recompile watchdog)
+            jitted = self._program_cache.wrap_jit(
+                jitted, name="train_step", static_argnums=step_statics
+            )
 
         grad_buf = jax.jit(
             lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p),
@@ -1577,6 +1613,18 @@ class Accelerator:
 
         out = load_accelerator_state(self, input_dir, **load_model_func_kwargs)
         self._seed_loss_scale_to_device()
+        if self._program_cache is not None:
+            # warm-start after (elastic) restore: the restored trainer's
+            # step programs should deserialize from the executable store
+            # instead of recompiling — surface how warm that store is so
+            # a resume that DID recompile is explainable from telemetry
+            stats = self._program_cache.stats()
+            if self._telemetry is not None:
+                self._telemetry.log.event("compile_cache_warmstart", **stats)
+            logger.info(
+                "compile cache at resume: %s stored executable(s), %s deserialized this process",
+                stats.get("store_entries", 0), stats.get("deserialized", 0),
+            )
         return out
 
     @property
@@ -1707,7 +1755,21 @@ class Accelerator:
                 forward_fn=(lambda values, step: self.log(values, step=step)),
                 forward_every=h.forward_to_trackers_every,
             )
+            if self._program_cache is not None:
+                # compile_cache_* events land in the same run JSONL as the
+                # step timeline, so a summarize pass explains both
+                self._program_cache.log = self._telemetry.log
         return self._telemetry
+
+    @property
+    def program_cache(self):
+        """The shared :class:`~accelerate_tpu.aot.ProgramCache` (``None``
+        unless a :class:`~accelerate_tpu.utils.CompileKwargs` handler was
+        passed or ``ACCELERATE_COMPILE_CACHE_DIR`` is set). When active,
+        ``build_train_step`` routes program dispatch through it, so a
+        restarted process deserializes the step executable instead of
+        recompiling — see ``docs/usage_guides/compilation.md``."""
+        return self._program_cache
 
     # ------------------------------------------------------------------ #
     # tracking (reference: accelerator.py:3002-3114)

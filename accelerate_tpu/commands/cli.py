@@ -6,6 +6,7 @@ from __future__ import annotations
 import argparse
 
 from .checkpoints import checkpoints_parser
+from .compile_cache import compile_cache_parser
 from .config import config_parser
 from .divergence import divergence_parser
 from .env import env_parser
@@ -37,6 +38,7 @@ def main():
     migrate_parser(subparsers)
     telemetry_parser(subparsers)
     checkpoints_parser(subparsers)
+    compile_cache_parser(subparsers)
     tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)

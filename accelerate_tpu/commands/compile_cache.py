@@ -1,0 +1,264 @@
+"""``accelerate-tpu compile-cache`` — inspect, pre-warm, and clear the
+persistent compile cache (see :mod:`accelerate_tpu.aot` and
+``docs/usage_guides/compilation.md``).
+
+``stats`` reads the executable store (and the adjacent jax XLA cache
+when present) without touching jax — safe on a login node. ``warm``
+pre-compiles a step/decode function into the store from ``--arg
+f32[8,128]``-style specs (the flight-check spec parser), so a serving
+fleet or a to-be-resumed trainer can bake its executables before the
+first request ever lands. ``clear`` wipes entries. ``--selfcheck``
+proves the whole loop on the CPU backend: cold compile -> warm
+deserialize -> poisoned entry rejected cleanly (the CI gate
+``make aot-selfcheck`` wraps).
+
+Examples::
+
+    accelerate-tpu compile-cache stats --dir /ckpts/run1/compile_cache
+    accelerate-tpu compile-cache warm train.py::step --arg "f32[32,128]" --mesh data=8
+    accelerate-tpu compile-cache clear --dir ... --yes
+    accelerate-tpu compile-cache --selfcheck
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def _store_dir(args) -> str | None:
+    from ..aot.cache import resolve_cache_dir
+
+    base = resolve_cache_dir(getattr(args, "dir", None))
+    if base is None:
+        return None
+    # Accelerator lays the store at {cache_dir}/executables with the XLA
+    # cache beside it; accept either the base or the store dir itself
+    sub = os.path.join(base, "executables")
+    if os.path.isdir(sub):
+        return sub
+    return base
+
+
+def compile_cache_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "compile-cache", help="Inspect / pre-warm / clear the persistent compile cache"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu compile-cache")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove cold compile -> warm hit -> poisoned-entry rejection on the CPU backend",
+    )
+    sub = parser.add_subparsers(dest="cc_command")
+
+    p_stats = sub.add_parser("stats", help="Entry table + totals for the executable store")
+    p_stats.add_argument("--dir", default=None, help="cache dir (default: ACCELERATE_COMPILE_CACHE_DIR)")
+    p_stats.add_argument("--format", choices=("text", "json"), default="text")
+    p_stats.set_defaults(cc_func=stats_command)
+
+    p_warm = sub.add_parser(
+        "warm", help="Pre-compile a step/decode fn into the store from --arg shape specs"
+    )
+    p_warm.add_argument("target", help="function: file.py::fn or pkg.module:fn")
+    p_warm.add_argument("--arg", action="append", default=[], help="sample arg spec like f32[8,128] (repeatable)")
+    p_warm.add_argument("--mesh", default=None, help="mesh shape, e.g. data=4,tensor=2 (default: all devices on data)")
+    p_warm.add_argument("--donate", default="", help="comma-separated donated argnums, e.g. 0,1")
+    p_warm.add_argument("--dir", default=None, help="cache dir (default: ACCELERATE_COMPILE_CACHE_DIR)")
+    p_warm.add_argument("--name", default=None, help="program name recorded in the store (default: the fn name)")
+    p_warm.set_defaults(cc_func=warm_command)
+
+    p_clear = sub.add_parser("clear", help="Remove every entry from the executable store")
+    p_clear.add_argument("--dir", default=None, help="cache dir (default: ACCELERATE_COMPILE_CACHE_DIR)")
+    p_clear.add_argument("--yes", action="store_true", help="actually delete (otherwise dry-run)")
+    p_clear.set_defaults(cc_func=clear_command)
+
+    if subparsers is not None:
+        parser.set_defaults(func=compile_cache_command)
+    return parser
+
+
+def compile_cache_command(args) -> int:
+    if args.selfcheck:
+        rc = selfcheck_command(args)
+        if rc or not getattr(args, "cc_command", None):
+            return rc
+    if not getattr(args, "cc_command", None):
+        print("usage: accelerate-tpu compile-cache {stats|warm|clear} [--dir DIR] | --selfcheck")
+        return 2
+    return args.cc_func(args)
+
+
+# --------------------------------------------------------------------- #
+# stats
+# --------------------------------------------------------------------- #
+
+
+def _xla_cache_stats(base_dir: str) -> dict | None:
+    xla = os.path.join(base_dir, "xla")
+    if not os.path.isdir(xla):
+        return None
+    files = [os.path.join(xla, f) for f in os.listdir(xla)]
+    return {"dir": xla, "entries": len(files), "bytes": sum(os.path.getsize(f) for f in files if os.path.isfile(f))}
+
+
+def stats_command(args) -> int:
+    store_dir = _store_dir(args)
+    if store_dir is None:
+        print("no cache dir: pass --dir or set ACCELERATE_COMPILE_CACHE_DIR")
+        return 2
+    from ..aot.cache import ExecutableStore
+
+    store = ExecutableStore(store_dir)
+    entries = store.entries()
+    base = os.path.dirname(store_dir) if os.path.basename(store_dir) == "executables" else store_dir
+    report = {
+        "store_dir": store_dir,
+        "entries": len(entries),
+        "total_bytes": store.total_bytes(),
+        "programs": entries,
+    }
+    xla = _xla_cache_stats(base)
+    if xla:
+        report["xla_cache"] = xla
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    print(f"compile cache at {store_dir}: {len(entries)} executable(s), {report['total_bytes'] / 1024:.1f} KiB")
+    for e in entries:
+        if "error" in e:
+            print(f"  {e.get('key', '?')[:16]}  CORRUPT: {e['error']}")
+            continue
+        print(
+            f"  {e['key'][:16]}  {e.get('name', '?'):<24} {e.get('platform', '?'):<5} "
+            f"jax {e.get('jax', '?'):<8} {e['file_bytes'] / 1024:8.1f} KiB"
+        )
+    if xla:
+        print(f"xla persistent cache at {xla['dir']}: {xla['entries']} entrie(s), {xla['bytes'] / 1024:.1f} KiB")
+    return 0
+
+
+# --------------------------------------------------------------------- #
+# warm
+# --------------------------------------------------------------------- #
+
+
+def warm_command(args) -> int:
+    store_dir = _store_dir(args)
+    if store_dir is None:
+        print("no cache dir: pass --dir or set ACCELERATE_COMPILE_CACHE_DIR")
+        return 2
+    # flight-check's loaders: file.py::fn targets, f32[8,128] specs, fake mesh
+    from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+    mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.target)
+    sample_args = resolve_sample_args(module, fn, args.arg)
+    donate = tuple(int(p) for p in args.donate.split(",") if p.strip())
+
+    from ..aot import ExecutableStore, ProgramCache
+
+    pc = ProgramCache(store=ExecutableStore(store_dir))
+    import time
+
+    name = args.name or fn.__name__
+    with mesh:
+        t0 = time.perf_counter()
+        pc.compile(fn, *sample_args, name=name, donate_argnums=donate)
+        ms = (time.perf_counter() - t0) * 1000.0
+    outcome = "deserialized (already warm)" if pc.deserialized else "compiled + stored"
+    print(f"warm {name}: {outcome} in {ms:.1f} ms -> {store_dir} ({len(pc.store.keys())} entrie(s) total)")
+    return 0
+
+
+# --------------------------------------------------------------------- #
+# clear
+# --------------------------------------------------------------------- #
+
+
+def clear_command(args) -> int:
+    store_dir = _store_dir(args)
+    if store_dir is None:
+        print("no cache dir: pass --dir or set ACCELERATE_COMPILE_CACHE_DIR")
+        return 2
+    from ..aot.cache import ExecutableStore
+
+    store = ExecutableStore(store_dir)
+    keys = store.keys()
+    if not args.yes:
+        print(f"would remove {len(keys)} entrie(s) from {store_dir} (pass --yes to delete)")
+        return 0
+    n = store.clear()
+    print(f"removed {n} entrie(s) from {store_dir}")
+    return 0
+
+
+# --------------------------------------------------------------------- #
+# selfcheck (the make aot-selfcheck gate)
+# --------------------------------------------------------------------- #
+
+
+def selfcheck_command(args) -> int:
+    """Cold compile -> cross-cache warm hit -> poisoned entry rejected
+    cleanly, on the CPU backend; nonzero on any broken link."""
+    import tempfile
+
+    from ..utils.environment import force_host_platform
+
+    force_host_platform(1)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..aot import ExecutableStore, ProgramCache
+
+    failures = []
+    fn = lambda x: (jnp.sin(x) @ jnp.cos(x).T).sum()  # noqa: E731
+    aval = jax.ShapeDtypeStruct((16, 32), jnp.float32)
+    x = np.ones((16, 32), np.float32)
+    with tempfile.TemporaryDirectory() as tmp:
+        cold = ProgramCache(store=ExecutableStore(tmp))
+        ref = float(cold.compile(fn, aval, name="selfcheck")(x))
+        if cold.misses != 1 or cold.store is None or len(cold.store.keys()) != 1:
+            failures.append(f"cold pass: expected 1 miss + 1 stored entry, got {cold.stats()}")
+
+        warm = ProgramCache(store=ExecutableStore(tmp))
+        got = float(warm.compile(fn, aval, name="selfcheck")(x))
+        if warm.misses != 0 or warm.deserialized != 1:
+            failures.append(f"warm pass: expected 0 compiles + 1 deserialize, got {warm.stats()}")
+        if got != ref:
+            failures.append(f"warm result {got} != cold result {ref}")
+        print(f"[compile-cache selfcheck] cold compile -> warm deserialize: {'OK' if not failures else 'FAILED'}")
+
+        # poison the stored entry: it must be rejected (and healed), never executed
+        store = ExecutableStore(tmp)
+        key = store.keys()[0]
+        path = store._entry_path(key)
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[: len(blob) // 2] + b"\xde\xad" * 8 + blob[len(blob) // 2 :])
+        healed = ProgramCache(store=ExecutableStore(tmp))
+        got = float(healed.compile(fn, aval, name="selfcheck")(x))
+        if healed.rejected != 1 or healed.misses != 1:
+            failures.append(f"poison pass: expected 1 reject + 1 recompile, got {healed.stats()}")
+        if got != ref:
+            failures.append(f"post-poison result {got} != {ref}")
+        print(f"[compile-cache selfcheck] poisoned entry rejected + healed: "
+              f"{'OK' if healed.rejected == 1 else 'FAILED'}")
+
+    for msg in failures:
+        print(f"[compile-cache selfcheck] FAILED: {msg}")
+    if not failures:
+        print("[compile-cache selfcheck] OK: store round-trip, zero-compile warm start, poison rejection")
+    return 1 if failures else 0
+
+
+def main():
+    args = compile_cache_parser().parse_args()
+    raise SystemExit(compile_cache_command(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,7 @@ class BaseDataLoader:
         rng_types: Optional[list] = None,
         generator=None,
         prefetch_size: int = 2,
+        auto_bucketing: bool = False,
     ):
         self.gradient_state = GradientState()
         self.batch_sharding_ = batch_sharding
@@ -121,6 +122,8 @@ class BaseDataLoader:
         self.rng_types = rng_types
         self.generator = generator
         self.prefetch_size = max(1, prefetch_size)
+        self.auto_bucketing = auto_bucketing
+        self.bucketer = None  # created lazily (needs the live shard count)
         self.end_of_dataloader = False
         self.remainder = -1
         self.iteration = 0
@@ -147,6 +150,41 @@ class BaseDataLoader:
         from .parallel.mesh import data_parallel_size
 
         return data_parallel_size(sharding.mesh)
+
+    def _bucket_pad(self, host_batch, global_len: int):
+        """Auto-bucketing (``DataLoaderConfiguration(auto_bucketing=True)``;
+        see :mod:`accelerate_tpu.aot.bucketing`): wrap-pad the host batch's
+        rows so the GLOBAL batch dim lands on a learned bucket instead of
+        whatever ragged size the tail (or a variable stream) produced — a
+        stream of ragged shapes then compiles at most ``len(buckets)``
+        programs and the recompile watchdog stays silent after warmup.
+        Padded rows repeat from the batch start (the ``even_batches`` tail
+        semantics), and the caller's ``remainder`` bookkeeping truncates
+        them in ``gather_for_metrics`` exactly as for an evened tail.
+        Returns ``(host_batch, padded_global_len)``."""
+        if not self.auto_bucketing or global_len == 0:
+            return host_batch, global_len
+        if self.bucketer is None:
+            import math
+
+            from .aot.bucketing import ShapeBucketer
+
+            jax = _jax()
+            # buckets must split over BOTH the mesh batch axes and the
+            # process-local slices; seeding with the steady global batch
+            # keeps full batches bucket-exact (zero pad in steady state)
+            mult = math.lcm(max(1, self._num_shards()), max(1, jax.process_count()))
+            seed = [self.total_batch_size] if getattr(self, "total_batch_size", 0) else []
+            self.bucketer = ShapeBucketer(seed, multiple_of=mult)
+        target = self.bucketer.bucket(global_len)
+        if target == global_len:
+            return host_batch, global_len
+        from .aot.bucketing import pad_batch_tree
+
+        jax = _jax()
+        pc = 1 if getattr(self, "_dispatch_source", False) else jax.process_count()
+        host_batch = pad_batch_tree(host_batch, target // pc, current=global_len // pc)
+        return host_batch, target
 
     def _place(self, host_batch):
         """per-host numpy batch -> global sharded jax.Array pytree."""
@@ -323,7 +361,8 @@ class DataLoaderShard(BaseDataLoader):
             # is yielded (reference :558-592).
             window: deque = deque()
             for idx_batch, n_real in self._global_index_batches():
-                window.append((self._place(self._load(idx_batch)), n_real, len(idx_batch)))
+                host, padded = self._bucket_pad(self._load(idx_batch), len(idx_batch))
+                window.append((self._place(host), n_real, padded))
                 if len(window) > self.prefetch_size:
                     self.batches_yielded += 1
                     yield window.popleft()[0]
@@ -428,6 +467,7 @@ class IterableDataLoaderShard(BaseDataLoader):
         try:
             window: deque = deque()
             for host_batch, n_real, padded in self._batched_samples():
+                host_batch, padded = self._bucket_pad(host_batch, padded)
                 window.append((self._place(host_batch), n_real, padded))
                 if len(window) > self.prefetch_size:
                     self.batches_yielded += 1
@@ -621,6 +661,7 @@ def prepare_data_loader(
         split_batches=split_batches,
         device_placement=put_on_device,
         prefetch_size=data_loader_config.prefetch_size if data_loader_config is not None else 2,
+        auto_bucketing=data_loader_config.auto_bucketing if data_loader_config is not None else False,
     )
 
     if hasattr(dataloader, "__len__") and hasattr(dataloader, "__getitem__"):

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Optional
 
 import numpy as np
@@ -57,6 +58,32 @@ def _jax():
     import jax
 
     return jax
+
+
+class _LazyBuckets:
+    """dict-like ``bucket -> compiled program`` that compiles on FIRST
+    use instead of eagerly at engine construction: startup pays only for
+    the buckets traffic actually hits, and each build is attributed by a
+    per-bucket ``serving_bucket_compile`` telemetry event."""
+
+    def __init__(self, build):
+        self._build = build
+        self._programs: dict = {}
+
+    def __getitem__(self, bucket: int):
+        prog = self._programs.get(bucket)
+        if prog is None:
+            prog = self._programs[bucket] = self._build(bucket)
+        return prog
+
+    def __contains__(self, bucket) -> bool:
+        return bucket in self._programs
+
+    def __len__(self) -> int:
+        return len(self._programs)
+
+    def compiled_buckets(self) -> tuple:
+        return tuple(sorted(self._programs))
 
 
 @dataclasses.dataclass
@@ -110,6 +137,8 @@ class ServingEngine:
         draft_model=None,
         gamma: int = 4,
         telemetry_log=None,
+        program_cache=None,
+        auto_bucketing: bool = False,
     ):
         jax = _jax()
         jnp = jax.numpy
@@ -123,6 +152,30 @@ class ServingEngine:
         self.num_slots = num_slots
         self.prompt_buckets = tuple(sorted(prompt_buckets))
         self.max_len = max_len or model.config.max_position_embeddings
+        # Compile management (docs/usage_guides/compilation.md): EVERY
+        # engine program goes through one ProgramCache — construction
+        # compiles nothing (buckets are lazy, ticks jit on first call),
+        # and with a persistent store (``program_cache=`` or
+        # ``ACCELERATE_COMPILE_CACHE_DIR``) a new replica deserializes
+        # the programs a previous process compiled instead of re-JITting.
+        from .telemetry.eventlog import EventLog
+
+        self._log = telemetry_log if telemetry_log is not None else EventLog(None)
+        if program_cache is None:
+            from .aot import ProgramCache
+
+            program_cache = ProgramCache.from_env(log=self._log, name="serving")
+        self._pc = program_cache
+        # Auto-bucketing: the static prompt_buckets seed a learned set —
+        # prompt lengths beyond the seed grow new (power-of-two) buckets
+        # on demand instead of falling to the chunked path, refined online
+        # from the observed length histogram; compile count stays
+        # O(len(buckets)) by construction.
+        self.bucketer = None
+        if auto_bucketing:
+            from .aot import ShapeBucketer
+
+            self.bucketer = ShapeBucketer(self.prompt_buckets, max_size=self.max_len)
         # Speculative continuous batching: a draft model proposes gamma
         # tokens per slot, ONE target forward verifies them (greedy
         # accept-prefix; emitted tokens are exactly the target's own
@@ -160,12 +213,18 @@ class ServingEngine:
 
         sampler = _make_sampler(temperature, top_k)
 
-        def ctx_jit(fn):
+        def ctx_jit(fn, name=None):
             """jit + re-enter the model's mesh context around every call:
             a shard_model'ed model pins ITS mesh for the cache sharding
             constraints and the paged kernel's shard_map (constraints
-            bake in at the first trace; later calls hit the jit cache)."""
-            jitted = jax.jit(fn)
+            bake in at the first trace; later calls hit the jit cache).
+
+            Dispatch goes through the engine's ProgramCache (lowering at
+            CALL time with the real input shardings, so GSPMD-propagated
+            layouts are honoured exactly like lazy jit): with a
+            persistent store attached, a restarted replica deserializes
+            these programs instead of recompiling them."""
+            jitted = self._pc.wrap_jit(jax.jit(fn), name=name or getattr(fn, "__name__", "program"))
 
             def call(*args):
                 with self._trace_ctx():
@@ -256,6 +315,7 @@ class ServingEngine:
         self._done_lps: dict[int, np.ndarray] = {}  # uid -> per-generated-token logprobs
         self._uid = 0
         self._pool_blocked = False  # last admit pass hit pool exhaustion
+        self.bucket_compile_ms: dict = {}  # (kind, bucket) -> build wall ms
 
         # ---- jitted programs (compiled once each) ----
         def pick_lp(row, tok):
@@ -281,14 +341,19 @@ class ServingEngine:
 
         key_aval = jax.eval_shape(lambda: jax.random.key(0))
         if draft_model is None:  # speculative admits route to _spec_prefill
-            with self._trace_ctx():
-                self._prefill = {
-                    b: jax.jit(prefill).lower(
-                        params, jax.ShapeDtypeStruct((1, b), jnp.int32),
-                        jax.ShapeDtypeStruct((), jnp.int32), key_aval
-                    ).compile()
-                    for b in self.prompt_buckets
-                }
+
+            def _build_prefill(b):
+                t0 = time.perf_counter()
+                with self._trace_ctx():
+                    prog = self._pc.compile(
+                        prefill, params, jax.ShapeDtypeStruct((1, b), jnp.int32),
+                        jax.ShapeDtypeStruct((), jnp.int32), key_aval,
+                        name=f"prefill_b{b}",
+                    )
+                self._note_bucket_compile("prefill", b, (time.perf_counter() - t0) * 1000.0)
+                return prog
+
+            self._prefill = _LazyBuckets(_build_prefill)
 
         # ---- chunked-prefill programs (long prompts / prefix suffixes) ----
         # one chunk size (the largest bucket) x {cold, warm}: compile count
@@ -386,13 +451,14 @@ class ServingEngine:
 
             from .ops.paged_kv import clear_slot, paged_mode, paste_blocks, paste_row, set_table_row
 
-            # Lazy jit wrapped in BOTH trace contexts (paged layout +
+            # Lazy dispatch wrapped in BOTH trace contexts (paged layout +
             # model mesh), re-entered every call: contexts only matter at
-            # trace time, and lazy tracing lets jit adapt to whatever
-            # input shardings GSPMD propagates onto the pool between
-            # pastes — an eagerly .lower()ed program would pin the
+            # trace time, and call-time lowering (ProgramCache.wrap_jit
+            # lowers with the REAL concrete inputs) lets the program adapt
+            # to whatever input shardings GSPMD propagates onto the pool
+            # between pastes — an eagerly .lower()ed program would pin the
             # shardings it saw at construction and reject the real ones.
-            tick = jax.jit(make_tick(paged_step))
+            tick = self._pc.wrap_jit(jax.jit(make_tick(paged_step)), name="paged_decode_tick")
             pcfg = self._pcfg
 
             def decode_tick(*args):
@@ -466,14 +532,18 @@ class ServingEngine:
                 d_cache = reset_cache_index(d_cache, true_len)
                 return first, jax.nn.log_softmax(row)[first], {"t": t_cache, "d": d_cache}
 
-            with self._trace_ctx():
-                self._spec_prefill = {
-                    b: jax.jit(spec_prefill).lower(
-                        params, draft_model.params,
+            def _build_spec_prefill(b):
+                t0 = time.perf_counter()
+                with self._trace_ctx():
+                    prog = self._pc.compile(
+                        spec_prefill, params, draft_model.params,
                         jax.ShapeDtypeStruct((1, b), jnp.int32), jax.ShapeDtypeStruct((), jnp.int32),
-                    ).compile()
-                    for b in self.prompt_buckets
-                }
+                        name=f"spec_prefill_b{b}",
+                    )
+                self._note_bucket_compile("spec_prefill", b, (time.perf_counter() - t0) * 1000.0)
+                return prog
+
+            self._spec_prefill = _LazyBuckets(_build_spec_prefill)
             # accept-rate telemetry: {"steps", "accepted", "emitted"}
             self.spec_stats = {"steps": 0, "accepted": 0, "emitted": 0}
 
@@ -506,8 +576,13 @@ class ServingEngine:
             # window width = smallest bucket covering the remainder (a short
             # suffix after a long prefix runs a suffix-sized program, not a
             # full chunk), else the largest; jit specializes per width, so
-            # the compile count stays O(buckets)
-            w = next((b for b in self.prompt_buckets if b >= t - s), c)
+            # the compile count stays O(buckets). Auto-bucketing consults
+            # the CURRENT learned set without growing it (lookup, not
+            # bucket) — long-remainder chunks must not mint new buckets.
+            if self.bucketer is not None:
+                w = self.bucketer.lookup(t - s) or c
+            else:
+                w = next((b for b in self.prompt_buckets if b >= t - s), c)
             e = min(s + w, t)
             s_adj = max(0, e - w)  # end-aligned window [s_adj, s_adj + w)
             window = np.zeros((1, w), np.int32)
@@ -632,7 +707,9 @@ class ServingEngine:
         if self.draft_model is not None:
             if prefix_id is not None:
                 raise NotImplementedError("speculative serving does not compose with prefix caching yet")
-            if len(prompt) > max(self.prompt_buckets):
+            if self.bucketer is None and len(prompt) > max(self.prompt_buckets):
+                # auto-bucketing mints a covering bucket instead; the
+                # max_len headroom check below still bounds the prompt
                 raise ValueError(
                     f"speculative serving needs bucket-sized prompts "
                     f"(len {len(prompt)} > largest bucket {max(self.prompt_buckets)})"
@@ -782,7 +859,7 @@ class ServingEngine:
             key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
             if self.draft_model is not None:
                 # speculative admit: both models prefill the prompt (greedy)
-                bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
+                bucket = self._bucket_for(len(req.prompt))
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, : len(req.prompt)] = req.prompt
                 next_tok, lp, row_cache = self._spec_prefill[bucket](
@@ -790,9 +867,10 @@ class ServingEngine:
                     jnp.asarray(padded), jnp.int32(len(req.prompt)),
                 )
                 total = len(req.prompt)
-            elif req.prefix_id is None and len(req.prompt) <= max(self.prompt_buckets):
+            elif req.prefix_id is None and (bucket := self._bucket_for(len(req.prompt))) is not None:
                 # short prompt, no prefix: the one-shot fused program
-                bucket = next(b for b in self.prompt_buckets if b >= len(req.prompt))
+                # (auto-bucketing: the bucketer can mint a new covering
+                # bucket here, so "short" stretches to any prompt <= max_len)
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, : len(req.prompt)] = req.prompt
                 next_tok, lp, row_cache, key = self._prefill[bucket](
@@ -971,6 +1049,34 @@ class ServingEngine:
         from .generation import _trace_ctx
 
         return _trace_ctx(getattr(self.model, "mesh", None))
+
+    def _bucket_for(self, n: int) -> Optional[int]:
+        """Covering prefill bucket for an ``n``-token prompt: the minimal
+        static bucket, or (auto-bucketing) the learned bucketer's choice —
+        which records the observation and may mint a new bucket. ``None``
+        routes the prompt to the chunked-prefill path."""
+        if self.bucketer is not None:
+            return self.bucketer.bucket(n)
+        return next((b for b in self.prompt_buckets if b >= n), None)
+
+    def _note_bucket_compile(self, kind: str, bucket: int, ms: float):
+        """Per-bucket program-build attribution: lands in
+        ``bucket_compile_ms`` (host-side inspection) and as ONE
+        ``serving_bucket_compile`` telemetry event — startup/first-hit
+        latency is attributable to the exact bucket that caused it. The
+        wall time includes trace+lower plus either the XLA compile or
+        (warm store) the deserialize; the paired ``compile_cache_*``
+        event says which."""
+        self.bucket_compile_ms[(kind, int(bucket))] = round(ms, 3)
+        self._log.event(
+            "serving_bucket_compile", program=kind, bucket=int(bucket), compile_ms=round(ms, 3)
+        )
+
+    @property
+    def program_cache(self):
+        """The engine's :class:`~accelerate_tpu.aot.ProgramCache` (every
+        prefill bucket and tick program routes through it)."""
+        return self._pc
 
     def _plan_blocks(self, plen: int, prompt_len: int, max_new: int):
         """Live table-entry range ``[lo, hi)`` for a request, plus the

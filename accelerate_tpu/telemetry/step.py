@@ -108,6 +108,46 @@ class _PathCachedSignature:
         return tuple(zip(paths, (_aval_str(l) for l in leaves)))
 
 
+_AVAL_RE = None  # compiled lazily (re import kept off the hot path)
+
+
+def suggest_buckets(old: Optional[tuple], new: tuple) -> list[str]:
+    """Pad-shape suggestions that would have avoided a watchdog miss: for
+    each input whose SHAPE drifted between two signatures, the aval with
+    every drifting dim padded to the next power of two covering both
+    sides — the fix auto-bucketing applies automatically
+    (:class:`accelerate_tpu.aot.ShapeBucketer`), named here so users
+    running without it still get the actionable change. Dtype changes
+    and rank changes yield no suggestion (padding can't fix those)."""
+    global _AVAL_RE
+    if not old or not new:
+        return []
+    if _AVAL_RE is None:
+        import re
+
+        _AVAL_RE = re.compile(r"^([A-Za-z0-9_]+)\[([0-9,]*)\]$")
+    from ..aot.bucketing import next_pow2
+
+    out = []
+    old_map = dict(old)
+    for path, aval in new:
+        prev = old_map.get(path)
+        if prev is None or prev == aval:
+            continue
+        m_new, m_old = _AVAL_RE.match(aval), _AVAL_RE.match(prev)
+        if not m_new or not m_old or m_new.group(1) != m_old.group(1):
+            continue  # dtype changed (or unparseable): not a padding problem
+        nd = [int(d) for d in m_new.group(2).split(",") if d]
+        od = [int(d) for d in m_old.group(2).split(",") if d]
+        if len(nd) != len(od):
+            continue  # rank changed
+        padded = [n if n == o else next_pow2(max(n, o)) for n, o in zip(nd, od)]
+        if padded == nd:
+            continue  # already at the covering size
+        out.append(f"{path}: pad to {m_new.group(1)}[{','.join(str(d) for d in padded)}]")
+    return out
+
+
 def diff_signatures(old: Optional[tuple], new: tuple) -> list[str]:
     """Human strings naming what changed between two input signatures."""
     if old is None:
@@ -303,6 +343,9 @@ class StepTelemetry:
                 severity="warning",
                 step=self.step_index,
                 changed=changed,
+                # the pad shape that would have avoided this miss (empty
+                # when padding can't fix it — dtype/rank/structure drift)
+                suggested_bucket=suggest_buckets(wd.last_sig, sig) if sig else [],
                 count=self.recompiles,
             )
             self.recompile_events.append(ev)

@@ -90,6 +90,25 @@ def summarize(events: list[dict]) -> dict:
     if serving:
         report["serving"] = serving
 
+    # compile cache (aot/): hit/miss/deserialize + per-bucket serving builds
+    cc_hits = [e for e in events if e.get("kind") == "event" and e.get("name") == "compile_cache_hit"]
+    cc_miss = [e for e in events if e.get("kind") == "event" and e.get("name") == "compile_cache_miss"]
+    cc_rej = [e for e in events if e.get("kind") == "event" and e.get("name") == "compile_cache_reject"]
+    buckets = [e for e in events if e.get("kind") == "event" and e.get("name") == "serving_bucket_compile"]
+    if cc_hits or cc_miss or cc_rej or buckets:
+        report["compile_cache"] = {
+            "hits": len(cc_hits),
+            "disk_hits": sum(1 for e in cc_hits if e.get("source") == "disk"),
+            "misses": len(cc_miss),
+            "rejected": len(cc_rej),
+            "compile_ms": round(sum(e.get("compile_ms", 0.0) for e in cc_miss), 3),
+            "deserialize_ms": round(sum(e.get("deserialize_ms", 0.0) for e in cc_hits), 3),
+            "bucket_compiles": [
+                {"program": e.get("program"), "bucket": e.get("bucket"), "compile_ms": e.get("compile_ms")}
+                for e in buckets
+            ],
+        }
+
     warnings = [
         e for e in events
         if e.get("kind") == "event" and e.get("severity") in ("warning", "error")
@@ -163,6 +182,20 @@ def render_text(report: dict) -> str:
         for key, val in serving.items():
             if key not in order and val is not None:
                 lines.append(f"    {key:<18}: {val}")
+    cc = report.get("compile_cache")
+    if cc:
+        lines.append("  compile cache:")
+        lines.append(
+            f"    hits              : {cc['hits']} ({cc['disk_hits']} from disk, "
+            f"{cc['deserialize_ms']} ms deserializing)"
+        )
+        lines.append(f"    misses            : {cc['misses']} ({cc['compile_ms']} ms compiling)")
+        if cc.get("rejected"):
+            lines.append(f"    rejected entries  : {cc['rejected']} (stale/poisoned, healed)")
+        for b in cc.get("bucket_compiles", []):
+            lines.append(
+                f"    bucket {b.get('program')}[{b.get('bucket')}]: built in {b.get('compile_ms')} ms"
+            )
     if len(lines) == 1:
         lines.append("  (no step/hbm/serving records found)")
     return "\n".join(lines)

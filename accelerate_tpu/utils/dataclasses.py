@@ -222,6 +222,48 @@ class TelemetryKwargs(KwargsHandler):
 
 
 @dataclass
+class CompileKwargs(KwargsHandler):
+    """Compile-management knobs consumed by ``Accelerator.program_cache``
+    (see :mod:`accelerate_tpu.aot` and ``docs/usage_guides/compilation.md``).
+    No reference analogue — the reference delegates compilation to torch.
+
+    Passing this handler *activates* the subsystem: jax's persistent XLA
+    compilation cache is pointed at the resolved cache dir, an
+    :class:`~accelerate_tpu.aot.ExecutableStore` of serialized executables
+    is opened next to it, and ``build_train_step`` routes its programs
+    through the shared :class:`~accelerate_tpu.aot.ProgramCache` so a
+    restarted process (new serving replica, preemption-resumed trainer)
+    deserializes instead of recompiling. Setting
+    ``ACCELERATE_COMPILE_CACHE_DIR`` activates the same default
+    configuration without code changes.
+
+    ``cache_dir=None`` resolves via ``ACCELERATE_COMPILE_CACHE_DIR``,
+    then ``{ProjectConfiguration.project_dir}/compile_cache`` (see
+    :func:`accelerate_tpu.aot.resolve_cache_dir`); with no dir at all the
+    cache still deduplicates and emits telemetry, memory-only."""
+
+    cache_dir: Optional[str] = None
+    #: also wire jax's own persistent compilation cache (at
+    #: ``{cache_dir}/xla``) — saves the XLA optimization pass even for
+    #: programs the executable store doesn't cover
+    persistent_xla_cache: bool = True
+    #: keep serialized ``lower().compile()`` executables on disk so a new
+    #: process warm-starts with zero XLA compiles
+    executable_store: bool = True
+    #: only persist XLA-cache entries that took at least this long to
+    #: compile (jax's own default; 0 keeps everything, which floods the
+    #: dir with micro-program entries)
+    min_compile_time_secs: float = 1.0
+    #: route ``build_train_step``'s program dispatch through the
+    #: ProgramCache (the AOT warm-start path); False keeps plain jax.jit
+    aot_train_step: bool = True
+
+    def __post_init__(self):
+        if self.min_compile_time_secs < 0:
+            raise ValueError(f"min_compile_time_secs must be >= 0, got {self.min_compile_time_secs}")
+
+
+@dataclass
 class FaultToleranceKwargs(KwargsHandler):
     """Fault-tolerance knobs (see :mod:`accelerate_tpu.ft` and
     ``docs/usage_guides/fault_tolerance.md``). No reference analogue —
@@ -295,6 +337,14 @@ class DataLoaderConfiguration(KwargsHandler):
     use_seedable_sampler: bool = True
     prefetch_size: int = 2
     non_blocking: bool = True  # kept for API parity; device_put is async
+    #: pad ragged batch dims to a learned bucket set
+    #: (:class:`~accelerate_tpu.aot.ShapeBucketer`) so a variable tail
+    #: batch (or a variable-size stream) compiles at most len(buckets)
+    #: programs instead of one per distinct size — the auto-bucketing
+    #: loop-closer for the PR-3 recompile watchdog. Padded rows wrap
+    #: around from the batch start (``even_batches`` tail semantics) and
+    #: are truncated by the existing ``remainder`` bookkeeping.
+    auto_bucketing: bool = False
 
 
 @dataclass
@@ -310,6 +360,11 @@ class ProjectConfiguration(KwargsHandler):
     #: subdirectory of ``project_dir`` holding the ``checkpoint_N`` family
     #: (save, auto-resume, and ``Accelerator.checkpoint_manager`` all use it)
     checkpoints_dir_name: str = "checkpoints"
+    #: subdirectory of ``project_dir`` for the compile cache (persistent
+    #: XLA cache + serialized-executable store) when a ``CompileKwargs``
+    #: handler is active and neither ``CompileKwargs.cache_dir`` nor
+    #: ``ACCELERATE_COMPILE_CACHE_DIR`` names one explicitly
+    compile_cache_dir_name: str = "compile_cache"
 
     def set_directories(self, project_dir: Optional[str] = None):
         self.project_dir = project_dir

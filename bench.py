@@ -50,17 +50,36 @@ def _peak_for_device(devices):
     return peak, device_kind
 
 
-def _probe_backend(max_tries: int = 10, probe_timeout: int = 180, base_delay: float = 15.0):
+def _probe_backend(
+    max_tries: int | None = None,
+    probe_timeout: int | None = None,
+    base_delay: float = 15.0,
+    budget_s: float | None = None,
+):
     """Verify the accelerator backend actually initialises before touching it
     in-process. The axon TPU plugin has two failure modes observed in round 1:
     raising UNAVAILABLE right after the tunnel comes up, and *hanging* inside
     backend init (uninterruptible C call) — so the probe runs in a subprocess
-    with a hard timeout and retries with backoff. Round 4 saw a multi-hour
-    tunnel outage mid-session: the budget below rides out ~45 min of
-    downtime (capped per-try delay) before giving up with the diagnostic
-    JSON, maximising the odds the driver's run lands after a recovery."""
+    with a hard timeout and retries with backoff.
+
+    BENCH_r01-r05 postmortem: in harness environments where the tunnel never
+    comes up the old ~45-min ride-out just *looked* like bench.py hanging.
+    The probe is now bounded twice over — per-try by the subprocess timeout,
+    and overall by ``budget_s`` wall clock — and every knob has a flag/env:
+    ``--probe-tries``/``ACCELERATE_BENCH_PROBE_TRIES`` (default 4),
+    ``--probe-timeout``/``ACCELERATE_BENCH_PROBE_TIMEOUT_S`` (default 120 s
+    per try), ``--probe-budget``/``ACCELERATE_BENCH_PROBE_BUDGET_S``
+    (default 600 s total). A terminal failure raises with a diagnostic that
+    names the ``--platform cpu`` escape hatch; ``main`` turns it into the
+    single JSON error line the driver expects."""
     import subprocess
 
+    max_tries = int(os.environ.get("ACCELERATE_BENCH_PROBE_TRIES", 4) if max_tries is None else max_tries)
+    probe_timeout = int(
+        os.environ.get("ACCELERATE_BENCH_PROBE_TIMEOUT_S", 120) if probe_timeout is None else probe_timeout
+    )
+    budget_s = float(os.environ.get("ACCELERATE_BENCH_PROBE_BUDGET_S", 600) if budget_s is None else budget_s)
+    deadline = time.monotonic() + budget_s
     last = "unknown"
     for attempt in range(max_tries):
         try:
@@ -68,23 +87,27 @@ def _probe_backend(max_tries: int = 10, probe_timeout: int = 180, base_delay: fl
                 [sys.executable, "-c", "import jax; print('ndev', len(jax.devices()))"],
                 capture_output=True,
                 text=True,
-                timeout=probe_timeout,
+                timeout=min(probe_timeout, max(1.0, deadline - time.monotonic())),
             )
             if out.returncode == 0 and "ndev" in out.stdout:
                 return
             last = (out.stderr or out.stdout).strip().splitlines()[-1][:200] if (out.stderr or out.stdout).strip() else f"rc={out.returncode}"
         except subprocess.TimeoutExpired:
             last = f"backend init hung >{probe_timeout}s"
-        if attempt == max_tries - 1:
-            break
         delay = min(base_delay * (1.5**attempt), 300.0)
+        if attempt == max_tries - 1 or time.monotonic() + delay > deadline:
+            break
         print(
             f"bench: backend probe {attempt + 1}/{max_tries} failed ({last}); "
-            f"retrying in {delay:.0f}s",
+            f"retrying in {delay:.0f}s ({max(0.0, deadline - time.monotonic()):.0f}s of budget left)",
             file=sys.stderr,
         )
         time.sleep(delay)
-    raise RuntimeError(f"accelerator backend unreachable after {max_tries} probes: {last}")
+    raise RuntimeError(
+        f"accelerator backend unreachable (probes: {last}; budget {budget_s:.0f}s). "
+        "Re-run with --platform cpu (or ACCELERATE_BENCH_PLATFORM=cpu) for a CPU smoke "
+        "number, or raise --probe-budget to ride out a tunnel outage."
+    )
 
 
 def _init_backend_with_retry(max_tries: int = 6, base_delay: float = 5.0):
@@ -278,8 +301,11 @@ def run_bench():
 
     import os
 
-    if os.environ.get("ACCELERATE_BENCH_FORCE_CPU"):
-        # debug/smoke mode (the axon plugin ignores JAX_PLATFORMS)
+    tiny = bool(os.environ.get("ACCELERATE_BENCH_FORCE_CPU"))
+    if tiny:
+        # smoke mode (--platform cpu; the axon plugin ignores JAX_PLATFORMS):
+        # tiny config + small batch so the escape hatch finishes in seconds,
+        # not the hour BERT-base at batch 256 would take on a CPU
         from accelerate_tpu.utils.environment import force_host_platform
 
         force_host_platform(1)
@@ -288,7 +314,7 @@ def run_bench():
     devices = _init_backend_with_retry()
 
     seq_len = 128
-    batch_size = 256  # per-chip; best measured v5e throughput (128→1524, 256→1562, 512 regresses)
+    batch_size = 8 if tiny else 256  # per-chip; best measured v5e throughput (128→1524, 256→1562, 512 regresses)
 
     from accelerate_tpu.utils import MixedPrecisionPolicy
 
@@ -302,14 +328,16 @@ def run_bench():
     n_dev = accelerator.state.num_devices
     global_batch = batch_size * accelerator.num_data_shards
 
-    model = accelerator.prepare_model(create_bert_model(BertConfig.base(), seq_len=seq_len))
+    model = accelerator.prepare_model(
+        create_bert_model(BertConfig.tiny() if tiny else BertConfig.base(), seq_len=seq_len)
+    )
     optimizer = accelerator.prepare_optimizer(optax.adamw(2e-5, weight_decay=0.01))
     loss_fn = lambda p, b: bert_classification_loss(p, b, model.apply_fn)
     step = accelerator.build_train_step(loss_fn)
 
     rng = np.random.default_rng(0)
     batch = {
-        "input_ids": rng.integers(5, 30000, size=(global_batch, seq_len)).astype(np.int32),
+        "input_ids": rng.integers(5, 1000 if tiny else 30000, size=(global_batch, seq_len)).astype(np.int32),
         "attention_mask": np.ones((global_batch, seq_len), np.bool_),
         "labels": rng.integers(0, 2, size=(global_batch,)).astype(np.int32),
     }
@@ -379,7 +407,37 @@ def run_bench():
     )
 
 
-def main():
+def _parse_args(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        "bench.py", description="Headline benchmarks (one JSON line per metric)"
+    )
+    ap.add_argument(
+        "--platform",
+        choices=("auto", "cpu"),
+        default=os.environ.get("ACCELERATE_BENCH_PLATFORM", "auto"),
+        help="cpu = skip the TPU backend probe entirely and run the CPU smoke "
+        "configuration (the escape hatch for harnesses where the TPU tunnel "
+        "hangs; also ACCELERATE_BENCH_PLATFORM=cpu)",
+    )
+    ap.add_argument("--probe-tries", type=int, default=None, help="TPU backend probe attempts (default 4)")
+    ap.add_argument("--probe-timeout", type=int, default=None, help="per-probe subprocess timeout seconds (default 120)")
+    ap.add_argument("--probe-budget", type=float, default=None, help="total probe wall-clock budget seconds (default 600)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.platform == "cpu":
+        os.environ["ACCELERATE_BENCH_FORCE_CPU"] = "1"
+    for flag, env in (
+        (args.probe_tries, "ACCELERATE_BENCH_PROBE_TRIES"),
+        (args.probe_timeout, "ACCELERATE_BENCH_PROBE_TIMEOUT_S"),
+        (args.probe_budget, "ACCELERATE_BENCH_PROBE_BUDGET_S"),
+    ):
+        if flag is not None:
+            os.environ[env] = str(flag)
     rc = 0
     try:
         run_bench()

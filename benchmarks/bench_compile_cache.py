@@ -1,0 +1,150 @@
+"""Compile-cache benchmark: cold vs warm process start, with compile counts.
+
+The claim under test is the whole point of ``accelerate_tpu/aot``: a
+process that re-creates the same jitted step/decode programs against a
+warm executable store performs **zero XLA compiles** and starts
+measurably faster. Honesty requires real process boundaries, so each
+measurement runs in a fresh ``python`` subprocess against a shared cache
+dir:
+
+* **cold** — empty store: every program compiles (and is serialized);
+* **warm** — same store: every program deserializes.
+
+The workload is a llama-tiny train step (``build_train_step`` routed
+through the ProgramCache via ``CompileKwargs``) plus a ServingEngine
+prefill bucket + decode tick — the two hot surfaces a restarted trainer
+and a new serving replica respectively care about. One JSON line per
+phase, then a summary line::
+
+    {"bench": "compile_cache", "phase": "cold", "wall_s": ..., "build_ms": ...,
+     "xla_compiles": N, "deserialized": 0, ...}
+    {"bench": "compile_cache", "phase": "warm", "wall_s": ..., "xla_compiles": 0, ...}
+    {"bench": "compile_cache", "phase": "summary", "speedup": ..., "warm_compiles": 0}
+
+Runs entirely on the CPU backend (``JAX_PLATFORMS=cpu``); tier-1/CI safe.
+
+Usage: python benchmarks/bench_compile_cache.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["ACCELERATE_BENCH_REPO"])
+from accelerate_tpu.utils.environment import force_host_platform
+
+force_host_platform(1)
+t_start = time.perf_counter()
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, CompileKwargs
+from accelerate_tpu.models import LlamaConfig, causal_lm_loss, create_llama_model
+from accelerate_tpu.serving import ServingEngine
+from accelerate_tpu.telemetry import StepTelemetry
+
+acc = Accelerator(kwargs_handlers=[CompileKwargs(cache_dir=os.environ["ACCELERATE_COMPILE_CACHE_DIR_RAW"])])
+cfg = LlamaConfig.tiny()
+model = acc.prepare_model(create_llama_model(cfg, seq_len=32))
+acc.prepare_optimizer(optax.adamw(1e-3))
+step = acc.build_train_step(lambda p, b: causal_lm_loss(p, b, model.apply_fn))
+
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(1, cfg.vocab_size - 1, size=(4, 32)).astype(np.int32)}
+
+telem = StepTelemetry(warmup_steps=2)
+tstep = telem.wrap(step)
+t0 = time.perf_counter()
+for _ in range(3):
+    loss = float(tstep(batch))
+train_build_ms = (time.perf_counter() - t0) * 1000.0
+
+# serving surface: one prefill bucket + the decode tick, same store
+serve_model = create_llama_model(cfg, seq_len=32)
+eng = ServingEngine(serve_model, num_slots=2, prompt_buckets=(8,),
+                    program_cache=None)  # picks up ACCELERATE_COMPILE_CACHE_DIR
+t0 = time.perf_counter()
+out = eng.generate_many([np.arange(1, 7, dtype=np.int32)], max_new_tokens=4)
+serve_build_ms = (time.perf_counter() - t0) * 1000.0
+
+pc_train = acc.program_cache
+pc_serve = eng.program_cache
+print(json.dumps({
+    "bench": "compile_cache",
+    "phase": os.environ["ACCELERATE_BENCH_PHASE"],
+    "wall_s": round(time.perf_counter() - t_start, 3),
+    "train_build_ms": round(train_build_ms, 1),
+    "serve_build_ms": round(serve_build_ms, 1),
+    "xla_compiles": pc_train.misses + pc_serve.misses,
+    "deserialized": pc_train.deserialized + pc_serve.deserialized,
+    "recompiles_watchdog": telem.recompiles,
+    "loss": loss,
+    "first_token": int(out[0][len(out[0]) - 4]),
+}))
+"""
+
+
+def _run_phase(phase: str, cache_dir: str) -> dict:
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        ACCELERATE_BENCH_REPO=REPO,
+        ACCELERATE_BENCH_PHASE=phase,
+        ACCELERATE_COMPILE_CACHE_DIR=cache_dir,
+        ACCELERATE_COMPILE_CACHE_DIR_RAW=cache_dir,
+    )
+    # keep the subprocesses honest: no shared jax persistent cache unless
+    # it is the one under test
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    t0 = time.perf_counter()
+    out = subprocess.run([sys.executable, "-c", _CHILD], capture_output=True, text=True, env=env)
+    wall = time.perf_counter() - t0
+    if out.returncode != 0:
+        raise RuntimeError(f"{phase} phase failed:\n{out.stderr[-2000:]}")
+    line = json.loads([l for l in out.stdout.splitlines() if l.startswith("{")][-1])
+    line["subprocess_wall_s"] = round(wall, 3)
+    print(json.dumps(line))
+    return line
+
+
+def main():
+    with tempfile.TemporaryDirectory() as cache_dir:
+        cold = _run_phase("cold", cache_dir)
+        warm = _run_phase("warm", cache_dir)
+    assert warm["loss"] == cold["loss"], "warm-start result drifted from cold"
+    assert warm["first_token"] == cold["first_token"], "warm serving output drifted"
+    build_cold = cold["train_build_ms"] + cold["serve_build_ms"]
+    build_warm = warm["train_build_ms"] + warm["serve_build_ms"]
+    print(
+        json.dumps(
+            {
+                "bench": "compile_cache",
+                "phase": "summary",
+                "cold_build_ms": round(build_cold, 1),
+                "warm_build_ms": round(build_warm, 1),
+                "build_speedup": round(build_cold / max(build_warm, 1e-9), 2),
+                "cold_compiles": cold["xla_compiles"],
+                "warm_compiles": warm["xla_compiles"],
+                "warm_deserialized": warm["deserialized"],
+                "bit_exact": True,
+            }
+        )
+    )
+    if warm["xla_compiles"] != 0:
+        print("FAIL: warm process still compiled", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,10 @@ MODULES = [
     "accelerate_tpu.ops.moe",
     "accelerate_tpu.ops.fp8",
     "accelerate_tpu.ops.qdense",
+    "accelerate_tpu.aot",
+    "accelerate_tpu.aot.cache",
+    "accelerate_tpu.aot.program_cache",
+    "accelerate_tpu.aot.bucketing",
     "accelerate_tpu.ft.manifest",
     "accelerate_tpu.ft.manager",
     "accelerate_tpu.ft.preemption",
