@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -38,6 +38,7 @@ quality: lint
 lint:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
 	$(MAKE) --no-print-directory divergence
+	$(MAKE) --no-print-directory perf-check
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
@@ -60,6 +61,17 @@ lint-sarif:
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --format sarif > .cache/lint.sarif
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --format sarif > .cache/divergence.sarif
 	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif -o lint-merged.sarif
+
+# Static perf tier: prove TPU501-505 fire on their seeded defects, each
+# clean twin stays silent, and the roofline math matches the hand-computed
+# reference exactly — then roofline the example step over a fake 8-device
+# CPU mesh. The dogfood pass is non-strict for warnings (TPU501/503-505
+# print but pass) while TPU502 (redundant collective) is error-severity
+# and gates strictly: re-reducing an already-uniform value has no
+# legitimate use.
+perf-check:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli perf-check --selfcheck \
+		examples/by_feature/flight_check.py::train_step --mesh data=8
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake

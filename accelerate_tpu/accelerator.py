@@ -830,6 +830,59 @@ class Accelerator:
             self._telemetry.set_static_hbm_estimate(report.peak_hbm_bytes)
         return report
 
+    def perf_check(
+        self,
+        step_fn: Callable,
+        *sample_args,
+        in_shardings=None,
+        dcn=None,
+        generation: Optional[str] = None,
+        ignore=(),
+    ):
+        """Static roofline of ``step_fn`` against this accelerator's mesh,
+        *before* paying a multi-chip compile: per-op FLOPs / HBM bytes /
+        bytes-on-wire, compute/memory/comms-bound classification, the
+        predicted step time and MFU upper bound for the attached
+        generation, plus the TPU5xx efficiency rules (MXU tile
+        misalignment, redundant collectives, latency-bound small DCN
+        collectives, missed collective/compute overlap, f32 matmuls that
+        are safely bf16).
+
+        Same calling convention as :meth:`flight_check`; returns a
+        :class:`~accelerate_tpu.analysis.PerfReport` (``.render_text()``
+        for the human report, ``.as_dict()`` for tooling /
+        ``accelerate-tpu perf-check --baseline`` diffs). Error-severity
+        findings are logged. When telemetry is live
+        (:class:`~accelerate_tpu.utils.TelemetryKwargs`), the predicted
+        step time seeds the runtime ``perf_model_drift`` cross-check —
+        the measured steady-state step split is compared against this
+        static prediction so the model stays honest. See
+        ``docs/usage_guides/static_analysis.md`` and
+        ``docs/usage_guides/performance.md``.
+        """
+        from .analysis import render_text
+        from .analysis.perfmodel import perf_check as _perf_check
+
+        report = _perf_check(
+            step_fn,
+            *sample_args,
+            mesh=self.mesh,
+            in_shardings=in_shardings,
+            dcn=dcn,
+            generation=generation,
+            ignore=ignore,
+        )
+        if not report.ok:
+            logger.warning(
+                "perf-check found issues in %s:\n%s",
+                getattr(step_fn, "__name__", "step_fn"),
+                render_text(report.findings),
+            )
+        if self._telemetry is not None and report.predicted_step_ms > 0:
+            # seed the runtime perf-model drift check with the prediction
+            self._telemetry.set_static_step_estimate(report.predicted_step_ms)
+        return report
+
     def build_train_step(
         self,
         loss_fn: Callable,

@@ -22,10 +22,17 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   TPU4xx rules: syncs not every rank reaches, rank-divergent loop trip
   counts around collectives, mismatched collective order, divergent early
   exits, unguarded host side effects.
+* **perf tier** (``perf_check``) — the static roofline (``perfmodel``):
+  per-op FLOPs / HBM bytes / bytes-on-wire, compute/memory/comms-bound
+  classification, predicted step time and MFU upper bound per mesh, plus
+  the TPU5xx efficiency rules (``perf_rules``): MXU tile misalignment,
+  redundant collectives, latency-bound small DCN collectives, missed
+  collective/compute overlap, f32 matmuls that are safely bf16.
 
 Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
-``accelerate-tpu divergence`` (commands/) and ``Accelerator.lint`` /
-``Accelerator.flight_check``. Suppress a finding inline with
+``accelerate-tpu divergence`` / ``accelerate-tpu perf-check``
+(commands/) and ``Accelerator.lint`` / ``Accelerator.flight_check`` /
+``Accelerator.perf_check``. Suppress a finding inline with
 ``# tpu-lint: disable=TPU201``, or project-wide via ``.tpulint.toml``
 (``project_config``).
 """
@@ -35,11 +42,13 @@ from .costmodel import BANDWIDTH_TABLE, CollectiveRecord, TrafficReport, collect
 from .divergence import analyze_file, analyze_paths, analyze_source
 from .flightcheck import FlightReport, LiveBuffer, estimate_peak_hbm, flight_check
 from .jaxpr_lint import lint_step
+from .perf_rules import check_perf_rules
+from .perfmodel import OpRecord, PerfReport, perf_check, walk_ops
 from .project_config import ProjectConfig, find_project_config, load_project_config
 from .ranksim import ACCELERATOR_EFFECTS, COLLECTIVE_EFFECTS, ModuleSimulator
 from .report import exit_code, format_finding, render_json, render_sarif, render_text
 from .rules import ERROR, RULES, WARNING, Finding, Rule, apply_suppressions, filter_findings
-from .selfcheck import run_divergence_selfcheck, run_selfcheck
+from .selfcheck import run_divergence_selfcheck, run_perf_selfcheck, run_selfcheck
 
 __all__ = [
     "ERROR",
@@ -59,6 +68,11 @@ __all__ = [
     "price_collective",
     "estimate_peak_hbm",
     "flight_check",
+    "perf_check",
+    "walk_ops",
+    "check_perf_rules",
+    "OpRecord",
+    "PerfReport",
     "lint_source",
     "lint_file",
     "lint_paths",
@@ -71,6 +85,7 @@ __all__ = [
     "exit_code",
     "run_selfcheck",
     "run_divergence_selfcheck",
+    "run_perf_selfcheck",
     "analyze_source",
     "analyze_file",
     "analyze_paths",

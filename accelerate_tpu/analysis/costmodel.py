@@ -29,36 +29,59 @@ from ..parallel.mesh import DCN, ICI, axis_transport
 #: published per-chip aggregate ICI bandwidths (v4 ~ 2.4 Tbit/s, v5e is a
 #: cost-optimised part, v5p ~ 4.8 Tbit/s); DCN is the typical per-host NIC
 #: share. These price *relative* layout choices — absolute step times need
-#: a profile.
+#: a profile. The ``cpu`` row is a NOMINAL fixture (round numbers, not a
+#: measurement) so perf-check/flight-check output under
+#: ``JAX_PLATFORMS=cpu`` is deterministic instead of silently aliasing the
+#: host backend to v5e.
 BANDWIDTH_TABLE: dict[str, dict[str, float]] = {
     "v4": {ICI: 300e9, DCN: 25e9},
     "v5e": {ICI: 200e9, DCN: 25e9},
     "v5p": {ICI: 600e9, DCN: 50e9},
     "v6e": {ICI: 450e9, DCN: 50e9},
+    "cpu": {ICI: 100e9, DCN: 10e9},
 }
 
 #: Peak dense-matmul FLOP/s per chip by generation and compute dtype — the
 #: published bf16 figures (v4 275, v5e 197, v5p 459, v6e 918 TFLOP/s), int8
 #: at 2x where the generation supports it. This is the SHARED denominator
 #: for MFU: the runtime telemetry (telemetry.mfu) and any static roofline
-#: both read this table, so "peak" means the same thing everywhere.
+#: both read this table, so "peak" means the same thing everywhere. The
+#: ``cpu`` row is a nominal 1 TFLOP/s fixture for deterministic host-
+#: backend output, not a measurement.
 PEAK_FLOPS_TABLE: dict[str, dict[str, float]] = {
     "v4": {"bf16": 275e12, "int8": 275e12},
     "v5e": {"bf16": 197e12, "int8": 394e12},
     "v5p": {"bf16": 459e12, "int8": 918e12},
     "v6e": {"bf16": 918e12, "int8": 1836e12},
+    "cpu": {"bf16": 1e12, "int8": 1e12},
+}
+
+#: HBM bandwidth per chip, bytes/second (published: v4 1228, v5e 819,
+#: v5p 2765, v6e 1640 GB/s). The roofline's memory axis: an op whose
+#: arithmetic intensity (FLOPs / HBM byte) is below
+#: ``peak_flops / hbm_bandwidth`` is memory-bound. ``cpu`` is the nominal
+#: deterministic fixture row (100 GB/s).
+HBM_BW_TABLE: dict[str, float] = {
+    "v4": 1.228e12,
+    "v5e": 0.819e12,
+    "v5p": 2.765e12,
+    "v6e": 1.640e12,
+    "cpu": 100e9,
 }
 
 #: Per-chip HBM capacity (GB) by generation — flight-check go/no-go and the
-#: telemetry HBM-headroom report share this.
-HBM_GB_TABLE: dict[str, float] = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}
+#: telemetry HBM-headroom report share this. (``cpu``: nominal host-RAM
+#: share, fixture row.)
+HBM_GB_TABLE: dict[str, float] = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0, "cpu": 16.0}
 
 
 def device_generation(device=None) -> Optional[str]:
     """Map a jax device (default: the first local device of an
     already-initialised backend) to a generation key of the tables above,
-    or None when unknown (CPU/GPU backends, or jax not yet imported —
-    this helper must never be the thing that initialises the backend)."""
+    or None when unknown (GPU backends, or jax not yet imported — this
+    helper must never be the thing that initialises the backend). The CPU
+    backend maps to the explicit ``cpu`` fixture row, so host-backend
+    analysis output is deterministic rather than a silent v5e alias."""
     kind = None
     if device is not None:
         kind = str(getattr(device, "device_kind", device))
@@ -84,9 +107,16 @@ def device_generation(device=None) -> Optional[str]:
 
 def peak_flops(generation: str, dtype: str = "bf16") -> float:
     """Peak FLOP/s per device for ``generation``; unknown generations fall
-    back to v5e (the cost-optimised part — a conservative denominator)."""
+    back to v5e (the cost-optimised part — a conservative denominator).
+    ``cpu`` has its own explicit (nominal) row."""
     row = PEAK_FLOPS_TABLE.get(generation, PEAK_FLOPS_TABLE["v5e"])
     return row.get(dtype, row["bf16"])
+
+
+def hbm_bandwidth(generation: str) -> float:
+    """HBM bytes/second per device for ``generation`` (v5e fallback for
+    unknown generations, explicit ``cpu`` row for the host backend)."""
+    return HBM_BW_TABLE.get(generation, HBM_BW_TABLE["v5e"])
 
 #: Collectives the traffic walk prices. Maps primitive name -> wire-bytes
 #: multiplier ``f(n)`` applied to the (per-device) operand bytes ``B`` for
@@ -160,7 +190,13 @@ def _aval_bytes(aval) -> int:
     dtype = getattr(aval, "dtype", None)
     if shape is None or dtype is None:
         return 0
-    return int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (PRNG keys) aren't numpy dtypes; they expose
+        # itemsize directly (or contribute nothing to the byte model)
+        itemsize = int(getattr(dtype, "itemsize", 0) or 0)
+    return int(np.prod(shape or (1,))) * itemsize
 
 
 def _axis_group_size(mesh, axes: Sequence[str]) -> int:

@@ -250,13 +250,19 @@ def _donated_var_indices(sample_args, donate_argnums, n_invars) -> set[int]:
     return donated
 
 
+#: single-eqn wrappers safe to unwrap: plain calls whose body runs ONCE.
+#: Control flow (scan/while/cond) must NOT unwrap — a top-level scan's
+#: body runs `length` times, and the walk multiplies, not substitutes.
+_CALL_PRIMS = frozenset({"pjit", "shard_map", "closed_call", "core_call", "xla_call", "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint"})
+
+
 def _main_jaxpr(closed):
     """The program body to walk. A step that is a single pjit/shard_map
     wrapper — ``jax.jit(fn)``, or the replicated rebind ``_trace`` uses for
     shard_map-style code — hides everything behind one opaque equation;
     unwrap while the (sole) sub-jaxpr's invars line up 1:1."""
     jaxpr = closed.jaxpr
-    while len(jaxpr.eqns) == 1:
+    while len(jaxpr.eqns) == 1 and jaxpr.eqns[0].primitive.name in _CALL_PRIMS:
         subs = list(_iter_subjaxprs(jaxpr.eqns[0].params))
         if len(subs) == 1 and len(subs[0].invars) == len(jaxpr.invars):
             jaxpr = subs[0]

@@ -1,0 +1,465 @@
+"""TPU5xx static performance rules over the traced jaxpr — the rule tier
+of the roofline (``analysis.perfmodel``).
+
+Where TPU1xx–4xx prove a program is *correct*, these prove it is not
+leaving obvious throughput on the table:
+
+* ``TPU501`` — matmul/conv dims misaligned to the MXU tile (128 lanes,
+  dtype-paced sublanes). The compiler pads; padded MACs are wasted
+  throughput — the finding reports the padding-waste % and names the
+  covering bucket a :class:`~accelerate_tpu.aot.ShapeBucketer` would pad
+  to. Sublane (M-dim) waste only counts when the op is compute-bound —
+  a memory-bound matvec doesn't pay for sublane padding, but lane-dim
+  (N/K) padding bloats the physical weight layout and always counts.
+* ``TPU502`` — redundant collective (**error**: no legitimate use): a
+  ``psum``/``pmean``/``pmax``/``pmin`` or ``all_gather`` consuming a
+  value that an earlier reduce-collective already made uniform over the
+  same axes. Uniformity is tracked soundly: a value is uniform when
+  every operand that produced it is uniform over the axes, so
+  scale-then-re-reduce chains are caught and mixed (uniform x sharded)
+  products are not.
+* ``TPU503`` — latency-bound small collectives on a DCN axis: sites
+  moving less than :data:`TPU503_SMALL_BYTES` per call over DCN, when
+  two or more firings exist to coalesce. DCN collectives pay a fixed
+  latency floor per launch — grads belong in one bucketed all-reduce.
+* ``TPU504`` — missed collective/compute overlap: a blocking collective
+  whose result is consumed before enough independent compute has run to
+  hide it, while independent compute exists later in the program that
+  could be moved into the window. Priced: the finding names the
+  hideable microseconds under the roofline op model.
+* ``TPU505`` — f32 matmul that is safely bf16 (the dataflow extension of
+  TPU102): an operand was upcast from bf16-class, or the result is
+  immediately narrowed back — bf16 inputs with
+  ``preferred_element_type=f32`` keep the same f32 accumulation at ~2x
+  the MXU rate and half the operand HBM.
+
+All findings anchor to the user source line that created the op
+(:func:`perfmodel.eqn_path_line`), so inline ``# tpu-lint: disable``
+comments, ``.tpulint.toml`` suppressions, and SARIF locations all work.
+
+jax is imported lazily; analysis needs only abstract values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from .costmodel import DCN, price_collective
+from .perfmodel import (
+    MXU_LANE,
+    SUBLANE,
+    _is_literal,
+    _nbytes,
+    conv_dims,
+    dot_dims,
+    eqn_path_line,
+    hbm_bandwidth,
+    op_flops,
+    op_peak_flops,
+)
+from .rules import Finding
+
+#: TPU501 fires when padded MACs exceed this fraction of the padded total.
+TPU501_WASTE = 0.05
+#: TPU503: a DCN collective moving less than this per call is priced by
+#: launch latency, not bandwidth (256 KiB ~ the break-even on a 25 GB/s
+#: NIC share with typical ~100us DCN launch overhead).
+TPU503_SMALL_BYTES = 256 * 1024
+#: TPU503 needs something to coalesce *with*.
+TPU503_MIN_COUNT = 2
+#: TPU504 reports only windows worth at least this many microseconds.
+TPU504_MIN_HIDEABLE_US = 10.0
+
+_LOW_DTYPES = ("bfloat16", "float16", "float8_e4m3fn", "float8_e5m2")
+_REDUCE_COLLECTIVES = frozenset({"psum", "pmean", "pmax", "pmin"})
+_UNIFORM_CONSUMERS = _REDUCE_COLLECTIVES | {"all_gather"}
+#: shape/dtype adapters that preserve per-axis uniformity and provenance
+_PASS_THROUGH = frozenset(
+    {"convert_element_type", "reshape", "transpose", "copy", "broadcast_in_dim", "squeeze"}
+)
+
+
+def _loc(eqn) -> str:
+    from .jaxpr_lint import _eqn_location
+
+    return _eqn_location(eqn).strip()
+
+
+def _mesh_axes(params_axes, mesh) -> frozenset:
+    return frozenset(a for a in params_axes if isinstance(a, str) and mesh.shape.get(a, 1) > 1)
+
+
+def _iter_scopes(closed):
+    """Yield every jaxpr scope (the unwrapped main body plus every nested
+    sub-jaxpr) — the dataflow rules analyze each scope independently."""
+    from .flightcheck import _main_jaxpr
+    from .jaxpr_lint import _iter_subjaxprs
+
+    stack = [_main_jaxpr(closed)]
+    while stack:
+        jx = stack.pop()
+        yield jx
+        for eqn in jx.eqns:
+            stack.extend(_iter_subjaxprs(eqn.params))
+
+
+def _finding(rule: str, eqn, message: str) -> Finding:
+    path, line = eqn_path_line(eqn)
+    return Finding(rule, message, path=path, line=line)
+
+
+def _round_up(n: int, multiple: int) -> int:
+    from ..aot.bucketing import round_up_to
+
+    return round_up_to(max(1, n), multiple)
+
+
+# -- TPU501 ----------------------------------------------------------------
+
+
+def _mxu_roles(eqn) -> Optional[dict]:
+    """(M, N, K, dtype) of a dot/conv viewed as the implicit GEMM the MXU
+    runs: conv is ``M=out positions, N=C_out, K=C_in·∏kernel``."""
+    d = dot_dims(eqn)
+    if d is not None:
+        m = 1
+        for v in d["m"] + d["batch"]:
+            m *= int(v)
+        n = 1
+        for v in d["n"]:
+            n *= int(v)
+        k = 1
+        for v in d["k"]:
+            k *= int(v)
+        return {"m": m, "n": n, "k": k, "dtype": d["lhs_dtype"]}
+    c = conv_dims(eqn)
+    if c is not None:
+        kernel = 1
+        for v in c["spatial"]:
+            kernel *= int(v)
+        k = (c["in_c"] // max(1, c["groups"]) or 1) * kernel
+        return {
+            "m": c["out_positions"], "n": c["out_c"], "k": k,
+            "dtype": c["lhs_dtype"], "kind": "conv",
+        }
+    return None
+
+
+def check_mxu_alignment(closed, mesh, *, generation: str = "v5e") -> list[Finding]:
+    """TPU501: price the padded-vs-real MAC ratio of every dot/conv."""
+    from ..aot.bucketing import ShapeBucketer
+
+    findings = []
+    seen = set()
+    hbm_bw = hbm_bandwidth(generation)
+    for jx in _iter_scopes(closed):
+        for eqn in jx.eqns:
+            roles = _mxu_roles(eqn)
+            if roles is None:
+                continue
+            m, n, k = roles["m"], roles["n"], roles["k"]
+            sublane = SUBLANE.get(roles["dtype"], 8)
+            flops = op_flops(eqn)
+            bytes_ = sum(
+                _nbytes(getattr(v, "aval", None)) for v in list(eqn.invars) + list(eqn.outvars)
+                if not _is_literal(v)
+            )
+            compute_bound = (flops / op_peak_flops(eqn, generation)) >= (bytes_ / hbm_bw)
+            pm = _round_up(m, sublane) if compute_bound else m
+            pn = _round_up(n, MXU_LANE)
+            pk = _round_up(k, MXU_LANE)
+            real = m * n * k
+            padded = pm * pn * pk
+            if padded <= 0 or real <= 0:
+                continue
+            waste = 1.0 - real / padded
+            if waste <= TPU501_WASTE:
+                continue
+            bad = []
+            if pn != n:
+                bucket = ShapeBucketer(multiple_of=MXU_LANE).bucket(n)
+                bad.append(f"N={n} (lane tile {MXU_LANE}; covering bucket {bucket})")
+            if pk != k:
+                bucket = ShapeBucketer(multiple_of=MXU_LANE).bucket(k)
+                bad.append(f"K={k} (lane tile {MXU_LANE}; covering bucket {bucket})")
+            if compute_bound and pm != m:
+                bucket = ShapeBucketer(multiple_of=sublane).bucket(m)
+                bad.append(f"M={m} (sublane tile {sublane}; covering bucket {bucket})")
+            if not bad:
+                continue
+            key = (m, n, k, _loc(eqn))
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(
+                _finding(
+                    "TPU501",
+                    eqn,
+                    f"{eqn.primitive.name} as [{m}x{k}]@[{k}x{n}] {_loc(eqn)}: {waste:.1%} of MXU MACs "
+                    f"are padding — misaligned {', '.join(bad)}; pad the dim(s) to the "
+                    "covering bucket (ShapeBucketer mints it automatically under "
+                    "auto_bucketing)",
+                )
+            )
+    return findings
+
+
+# -- TPU502 ----------------------------------------------------------------
+
+
+def check_redundant_collective(closed, mesh) -> list[Finding]:
+    """TPU502: a collective consuming a value an earlier reduce already
+    made uniform over the same axes."""
+    from .jaxpr_lint import _axis_names_in_params, _iter_subjaxprs
+
+    findings = []
+    for jx in _iter_scopes(closed):
+        uniform: dict[Any, frozenset] = {}  # var -> axes it is uniform over
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in _UNIFORM_CONSUMERS:
+                axes = _mesh_axes(_axis_names_in_params(eqn.params), mesh)
+                for v in eqn.invars:
+                    if _is_literal(v):
+                        continue
+                    prior = uniform.get(v)
+                    if prior and axes and axes <= prior:
+                        verb = "re-reduces" if name in _REDUCE_COLLECTIVES else "re-gathers"
+                        findings.append(
+                            _finding(
+                                "TPU502",
+                                eqn,
+                                f"{name} over {'x'.join(sorted(axes))} {_loc(eqn)} {verb} a "
+                                f"value already uniform over that axis (reduced upstream): "
+                                "the wire bytes buy nothing — drop the collective (psum of a "
+                                "psum scales by the group size; if that scaling is intended, "
+                                "multiply locally instead)",
+                            )
+                        )
+                if name in _REDUCE_COLLECTIVES and axes:
+                    for o in eqn.outvars:
+                        uniform[o] = axes
+                continue
+            # uniformity is preserved by any op whose every array operand
+            # is uniform over a common axis set (literals are uniform)
+            operand_axes: list[frozenset] = []
+            # sub-computations are analyzed in their own scope
+            opaque = any(True for _ in _iter_subjaxprs(eqn.params))
+            for v in eqn.invars:
+                if _is_literal(v):
+                    continue
+                operand_axes.append(uniform.get(v, frozenset()))
+            if opaque or not operand_axes:
+                continue
+            common = frozenset.intersection(*operand_axes)
+            if common:
+                for o in eqn.outvars:
+                    uniform[o] = common
+    return findings
+
+
+# -- TPU503 ----------------------------------------------------------------
+
+
+def check_small_dcn_collectives(
+    closed, mesh, *, dcn: Optional[Sequence[str]] = None, generation: str = "v5e"
+) -> list[Finding]:
+    """TPU503: many small DCN collectives that should coalesce into one."""
+    from .flightcheck import _main_jaxpr
+    from .jaxpr_lint import _axis_names_in_params, _iter_subjaxprs
+
+    small = []  # (eqn, record)
+
+    def walk(jx, multiplier):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            rec = None
+            if name in _REDUCE_COLLECTIVES or name in ("all_gather", "psum_scatter", "reduce_scatter"):
+                operand = sum(
+                    _nbytes(getattr(v, "aval", None)) for v in eqn.invars if not _is_literal(v)
+                )
+                rec = price_collective(
+                    name, tuple(_axis_names_in_params(eqn.params)), operand, mesh,
+                    count=multiplier, dcn=dcn, location=_loc(eqn),
+                )
+            if rec is not None and rec.transport == DCN and rec.bytes_per_call < TPU503_SMALL_BYTES:
+                small.append((eqn, rec))
+            sub_mult = multiplier * int(eqn.params.get("length", 1) or 1) if name == "scan" else multiplier
+            for sub in _iter_subjaxprs(eqn.params):
+                walk(sub, sub_mult)
+
+    walk(_main_jaxpr(closed), 1)
+    total_firings = sum(rec.count for _, rec in small)
+    if total_firings < TPU503_MIN_COUNT:
+        return []
+    findings = []
+    for eqn, rec in small:
+        findings.append(
+            _finding(
+                "TPU503",
+                eqn,
+                f"{rec.primitive} of {rec.bytes_per_call:,} B over DCN axis "
+                f"{'x'.join(rec.axes)} {_loc(eqn)} is latency-bound "
+                f"(< {TPU503_SMALL_BYTES // 1024} KiB/call; {total_firings} small DCN "
+                "collectives per step in this program) — coalesce them into one bucketed "
+                "collective (flatten the pytree, reduce once, unflatten)",
+            )
+        )
+    return findings
+
+
+# -- TPU504 ----------------------------------------------------------------
+
+
+def _op_time_us(eqn, generation: str) -> float:
+    """Roofline time of a non-collective eqn (same model as walk_ops)."""
+    flops = op_flops(eqn)
+    bytes_ = sum(
+        _nbytes(getattr(v, "aval", None))
+        for v in list(eqn.invars) + list(eqn.outvars)
+        if not _is_literal(v)
+    )
+    return max(flops / op_peak_flops(eqn, generation), bytes_ / hbm_bandwidth(generation)) * 1e6
+
+
+def check_missed_overlap(
+    closed, mesh, *, dcn: Optional[Sequence[str]] = None, generation: str = "v5e"
+) -> list[Finding]:
+    """TPU504: a blocking collective whose window holds less independent
+    compute than its own duration, while movable independent compute
+    exists later in the same scope."""
+    from .costmodel import COLLECTIVE_PRIMS
+    from .jaxpr_lint import _axis_names_in_params
+
+    findings = []
+    for jx in _iter_scopes(closed):
+        eqns = list(jx.eqns)
+        for i, eqn in enumerate(eqns):
+            if eqn.primitive.name not in COLLECTIVE_PRIMS:
+                continue
+            operand = sum(
+                _nbytes(getattr(v, "aval", None)) for v in eqn.invars if not _is_literal(v)
+            )
+            rec = price_collective(
+                eqn.primitive.name, tuple(_axis_names_in_params(eqn.params)), operand, mesh,
+                dcn=dcn, location=_loc(eqn),
+            )
+            if rec is None:
+                continue
+            t_coll = rec.time_us(generation)
+            # taint: everything transitively derived from the collective
+            tainted = {o for o in eqn.outvars}
+            first_use = None
+            in_window_us = 0.0
+            later_independent_us = 0.0
+            from .jaxpr_lint import _iter_subjaxprs
+
+            for j in range(i + 1, len(eqns)):
+                e2 = eqns[j]
+                depends = any((not _is_literal(v)) and v in tainted for v in e2.invars)
+                if depends:
+                    tainted.update(e2.outvars)
+                    if first_use is None:
+                        first_use = j
+                    continue
+                # other collectives serialise on the link and opaque call
+                # eqns have unknown cost: neither counts as hideable compute
+                if e2.primitive.name in COLLECTIVE_PRIMS or any(
+                    True for _ in _iter_subjaxprs(e2.params)
+                ):
+                    continue
+                t2 = _op_time_us(e2, generation)
+                if first_use is None:
+                    in_window_us += t2
+                else:
+                    later_independent_us += t2
+            if first_use is None:
+                continue  # result never consumed in this scope
+            shortfall = t_coll - in_window_us
+            hideable = min(shortfall, later_independent_us)
+            if hideable < TPU504_MIN_HIDEABLE_US:
+                continue
+            findings.append(
+                _finding(
+                    "TPU504",
+                    eqn,
+                    f"{eqn.primitive.name} {_loc(eqn)} blocks ~{t_coll:.0f}us but only "
+                    f"~{in_window_us:.0f}us of independent compute sits between it and its "
+                    f"first consumer; ~{hideable:.0f}us of later independent compute could "
+                    "move into the window (reorder the code, or split the collective so XLA's "
+                    "async pass can overlap it)",
+                )
+            )
+    return findings
+
+
+# -- TPU505 ----------------------------------------------------------------
+
+
+def check_f32_matmul_bf16_safe(closed, *, generation: str = "v5e") -> list[Finding]:
+    """TPU505: f32 dot_general with bf16 provenance or destination."""
+    findings = []
+    for jx in _iter_scopes(closed):
+        upcast: set = set()  # vars that are f32 views of bf16-class data
+        consumers: dict[Any, list] = {}
+        for eqn in jx.eqns:
+            for v in eqn.invars:
+                if not _is_literal(v):
+                    consumers.setdefault(v, []).append(eqn)
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "convert_element_type":
+                src = next((v for v in eqn.invars if not _is_literal(v)), None)
+                src_dtype = str(getattr(getattr(src, "aval", None), "dtype", ""))
+                dst_dtype = str(getattr(getattr(eqn.outvars[0], "aval", None), "dtype", ""))
+                if (src_dtype in _LOW_DTYPES or src in upcast) and dst_dtype == "float32":
+                    upcast.update(eqn.outvars)
+                continue
+            if name in _PASS_THROUGH:
+                src = next((v for v in eqn.invars if not _is_literal(v)), None)
+                if src in upcast:
+                    upcast.update(eqn.outvars)
+                continue
+            d = dot_dims(eqn)
+            if d is None or d["lhs_dtype"] != "float32" or d["rhs_dtype"] != "float32":
+                continue
+            from_low = any(v in upcast for v in eqn.invars if not _is_literal(v))
+            to_low = any(
+                c.primitive.name == "convert_element_type"
+                and str(getattr(getattr(c.outvars[0], "aval", None), "dtype", "")) in _LOW_DTYPES
+                for o in eqn.outvars
+                for c in consumers.get(o, ())
+            )
+            if not (from_low or to_low):
+                continue
+            saving_us = op_flops(eqn) / op_peak_flops(eqn, generation) / 2.0 * 1e6
+            why = "operands are upcast bf16-class values" if from_low else "the result is immediately narrowed back to bf16"
+            findings.append(
+                _finding(
+                    "TPU505",
+                    eqn,
+                    f"f32 dot_general {_loc(eqn)}: {why} — run it in bf16 with "
+                    "preferred_element_type=jnp.float32 (identical f32 accumulation, ~2x the "
+                    f"MXU rate: ~{saving_us:.1f}us/step saved, half the operand HBM)",
+                )
+            )
+    return findings
+
+
+# -- aggregator ------------------------------------------------------------
+
+
+def check_perf_rules(
+    closed,
+    mesh,
+    *,
+    dcn: Optional[Sequence[str]] = None,
+    generation: str = "v5e",
+) -> list[Finding]:
+    """Run every TPU5xx detector over one traced program."""
+    findings = check_mxu_alignment(closed, mesh, generation=generation)
+    findings += check_redundant_collective(closed, mesh)
+    findings += check_small_dcn_collectives(closed, mesh, dcn=dcn, generation=generation)
+    findings += check_missed_overlap(closed, mesh, dcn=dcn, generation=generation)
+    findings += check_f32_matmul_bf16_safe(closed, generation=generation)
+    return findings

@@ -17,6 +17,9 @@ Schema::
     [divergence]
     ranks = 3                  # default --ranks for the multi-rank simulator
 
+    [perf]
+    regress_pct = 10           # default --regress-pct for perf-check --baseline
+
     [[suppress]]
     path = "examples/*"        # fnmatch glob or directory prefix
     rules = ["TPU405"]         # omitted = every rule suppressed there
@@ -51,6 +54,7 @@ class ProjectConfig:
     enable: Optional[frozenset] = None
     disable: frozenset = frozenset()
     ranks: Optional[int] = None
+    regress_pct: Optional[float] = None
     #: ``(glob_or_prefix, rule_ids_or_None)`` — ``None`` suppresses all.
     suppressions: tuple = ()
 
@@ -60,6 +64,12 @@ class ProjectConfig:
 
     def resolve_ranks(self, cli_ranks: Optional[int], fallback: int = 3) -> int:
         return cli_ranks or self.ranks or fallback
+
+    def resolve_regress_pct(self, cli_pct: Optional[float], fallback: float = 10.0) -> float:
+        """CLI flag wins; then ``[perf].regress_pct``; then 10%."""
+        if cli_pct is not None:
+            return cli_pct
+        return self.regress_pct if self.regress_pct is not None else fallback
 
     def merge_ignore(self, ignore) -> frozenset:
         return frozenset(s.upper() for s in (ignore or ())) | self.disable
@@ -182,6 +192,7 @@ def load_project_config(start: Optional[str] = None) -> ProjectConfig:
         return ProjectConfig(path=path)
     lint = doc.get("lint", {}) or {}
     div = doc.get("divergence", {}) or {}
+    perf = doc.get("perf", {}) or {}
     suppressions = []
     for entry in doc.get("suppress", []) or []:
         pat = entry.get("path")
@@ -191,11 +202,13 @@ def load_project_config(start: Optional[str] = None) -> ProjectConfig:
         suppressions.append((str(pat), _ids(rules) if rules else None))
     enable = _ids(lint.get("enable"))
     ranks = div.get("ranks")
+    regress = perf.get("regress_pct")
     return ProjectConfig(
         path=path,
         format=lint.get("format") or None,
         enable=enable or None,
         disable=_ids(lint.get("disable")),
         ranks=int(ranks) if ranks else None,
+        regress_pct=float(regress) if regress is not None else None,
         suppressions=tuple(suppressions),
     )

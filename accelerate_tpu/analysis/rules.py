@@ -20,6 +20,12 @@ static_analysis.md for the worked catalogue):
   script for k synthetic ranks and diffs the per-rank collective traces —
   a collective or barrier that not every rank reaches is a guaranteed
   all-host hang with no error.
+* ``TPU5xx`` — static performance rules (``analysis.perf_rules``) over
+  the roofline walk (``analysis.perfmodel``): MXU tile misalignment,
+  redundant collectives, latency-bound small DCN collectives, missed
+  collective/compute overlap, and f32 matmuls that are safely bf16.
+  TPU502 is error-severity — re-reducing an already-uniform value has no
+  legitimate use — so it gates strictly; the rest are warnings.
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -41,6 +47,7 @@ TIER_JAXPR = "jaxpr"
 TIER_AST = "ast"
 TIER_FLIGHT = "flight"
 TIER_DIVERGENCE = "divergence"
+TIER_PERF = "perf"
 
 
 @dataclass(frozen=True)
@@ -82,6 +89,12 @@ RULES: dict[str, Rule] = {
         Rule("TPU403", "mismatched-collective-order", ERROR, TIER_DIVERGENCE, "ranks execute collectives in different orders across rank-divergent branches"),
         Rule("TPU404", "divergent-early-exit", WARNING, TIER_DIVERGENCE, "rank-divergent break/continue/raise can skip a later barrier"),
         Rule("TPU405", "unguarded-host-side-effect", WARNING, TIER_DIVERGENCE, "host file write or tracker call executed by every rank in rank-aware code"),
+        # -- tier 5: static performance (analysis.perf_rules) --------------
+        Rule("TPU501", "mxu-misaligned-matmul", WARNING, TIER_PERF, "matmul/conv dims misaligned to the MXU tile — padded MACs are wasted throughput"),
+        Rule("TPU502", "redundant-collective", ERROR, TIER_PERF, "collective re-reduces/re-gathers a value already uniform over the axis (pure wire waste)"),
+        Rule("TPU503", "small-dcn-collective", WARNING, TIER_PERF, "latency-bound small collectives on a DCN axis that should coalesce into one"),
+        Rule("TPU504", "missed-collective-overlap", WARNING, TIER_PERF, "independent compute adjacent to a blocking collective could hide it but is scheduled outside its window"),
+        Rule("TPU505", "f32-matmul-bf16-safe", WARNING, TIER_PERF, "f32 matmul with bf16 provenance/destination — bf16 inputs with f32 accumulation are equivalent and ~2x faster"),
     )
 }
 

@@ -9,7 +9,10 @@ static default, an eager module-scope jax import, (flight tier) a
 collective under ``lax.cond``, a conflicting re-constraint, and a donated
 buffer read after its aliased output exists, plus (divergence tier) one
 seeded multi-host deadlock/hazard per TPU4xx rule and a clean idiomatic
-rank-aware script that must produce zero findings. A CI run that passes
+rank-aware script that must produce zero findings, plus (perf tier) one
+seeded inefficiency AND a repaired clean twin per TPU5xx rule and a
+hand-computed roofline reference the report must match exactly. A CI run
+that passes
 selfcheck has proven the linter end-to-end on the CPU backend, so a clean
 repo lint actually means something.
 
@@ -25,6 +28,7 @@ from .ast_lint import LintConfig, lint_source
 from .divergence import analyze_source
 from .flightcheck import flight_check
 from .jaxpr_lint import lint_step
+from .perfmodel import perf_check
 from .rules import Finding
 
 # -- AST-tier fixtures (source text, linted without executing) ------------
@@ -294,6 +298,193 @@ def _flight_fixtures(mesh):
     }
 
 
+def _perf_fixtures(mesh):
+    """``rule -> (fn, sample_args, kwargs)`` seeded perf-tier (TPU5xx)
+    defects, checked through :func:`analysis.perfmodel.perf_check`. Each
+    has a clean twin in :func:`_perf_clean_fixtures` that must stay
+    silent — the false-positive budget of the perf tier."""
+    import jax
+    import jax.numpy as jnp
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+
+    def misaligned_matmul(x, w):
+        # K=100 pads to the 128-lane MXU tile: 21.9% of MACs are padding
+        return x @ w
+
+    def rereduced_psum(x):
+        g = jax.lax.psum(x, axis)  # g is uniform over the axis now
+        return jax.lax.psum(g * 0.5, axis)  # pure wire waste
+
+    def small_dcn_psums(a, b):
+        # two tiny latency-bound all-reduces that should be one
+        return jax.lax.psum(a, axis), jax.lax.psum(b, axis)
+
+    def unoverlapped_collective(a, b):
+        g = jax.lax.psum(a, axis)
+        h = g + 1.0  # consumed immediately: nothing hides the psum
+        c = b @ b  # independent compute stuck AFTER the consumer
+        return h, c
+
+    def f32_matmul_of_bf16(x, w):
+        return x.astype(jnp.float32) @ w.astype(jnp.float32)
+
+    f32 = jnp.float32
+    return {
+        "TPU501": (
+            misaligned_matmul,
+            (jax.ShapeDtypeStruct((256, 100), f32), jax.ShapeDtypeStruct((100, 512), f32)),
+            {},
+        ),
+        "TPU502": (rereduced_psum, (jax.ShapeDtypeStruct((128, 128), f32),), {}),
+        "TPU503": (
+            small_dcn_psums,
+            (jax.ShapeDtypeStruct((16, 16), f32), jax.ShapeDtypeStruct((16, 16), f32)),
+            {"dcn": (axis,)},
+        ),
+        "TPU504": (
+            unoverlapped_collective,
+            (jax.ShapeDtypeStruct((1024, 512), f32), jax.ShapeDtypeStruct((1024, 1024), f32)),
+            {"generation": "v5e"},
+        ),
+        "TPU505": (
+            f32_matmul_of_bf16,
+            (
+                jax.ShapeDtypeStruct((256, 128), jnp.bfloat16),
+                jax.ShapeDtypeStruct((128, 512), jnp.bfloat16),
+            ),
+            {},
+        ),
+    }
+
+
+def _perf_clean_fixtures(mesh):
+    """The clean twin per TPU5xx rule: the same shape of program with the
+    defect repaired — perf-check must report ZERO findings on each."""
+    import jax
+    import jax.numpy as jnp
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+
+    def aligned_matmul(x, w):
+        return x @ w
+
+    def two_distinct_reduces(x, y):
+        return jax.lax.psum(x, axis), jax.lax.pmax(y, axis)
+
+    def one_big_ici_psum(a):
+        return jax.lax.psum(a, axis)
+
+    def overlapped_collective(a, b):
+        g = jax.lax.psum(a, axis)
+        c = b @ b  # independent compute fills the collective's window
+        h = g + 1.0
+        return h, c
+
+    def bf16_matmul_f32_accum(x, w):
+        return jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+
+    f32 = jnp.float32
+    return {
+        "TPU501": (
+            aligned_matmul,
+            (jax.ShapeDtypeStruct((256, 128), f32), jax.ShapeDtypeStruct((128, 512), f32)),
+            {},
+        ),
+        "TPU502": (
+            two_distinct_reduces,
+            (jax.ShapeDtypeStruct((128, 128), f32), jax.ShapeDtypeStruct((128, 128), f32)),
+            {},
+        ),
+        "TPU503": (one_big_ici_psum, (jax.ShapeDtypeStruct((1024, 1024), f32),), {}),
+        "TPU504": (
+            overlapped_collective,
+            (jax.ShapeDtypeStruct((1024, 512), f32), jax.ShapeDtypeStruct((1024, 1024), f32)),
+            {"generation": "v5e"},
+        ),
+        "TPU505": (
+            bf16_matmul_f32_accum,
+            (
+                jax.ShapeDtypeStruct((256, 128), jnp.bfloat16),
+                jax.ShapeDtypeStruct((128, 512), jnp.bfloat16),
+            ),
+            {},
+        ),
+    }
+
+
+def _roofline_reference(mesh) -> tuple[bool, list[str]]:
+    """The executable spec of the roofline math: a matmul + psum over the
+    mesh whose FLOPs / HBM bytes / bytes-on-wire are hand-computed here
+    and must match the report EXACTLY (deterministic on any backend)."""
+    import jax
+    import jax.numpy as jnp
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+    n_axis = mesh.shape.get(axis, 1)
+    M, K, N = 64, 256, 128
+
+    def ref_step(x, w):
+        return jax.lax.psum(x @ w, axis)
+
+    report = perf_check(
+        ref_step,
+        jax.ShapeDtypeStruct((M, K), jnp.float32),
+        jax.ShapeDtypeStruct((K, N), jnp.float32),
+        mesh=mesh,
+        generation="v5e",
+    )
+    want_flops = 2 * M * K * N
+    want_hbm = (M * K + K * N + M * N) * 4
+    want_wire = int(round(M * N * 4 * 2 * (n_axis - 1) / n_axis))
+    dots = [o for o in report.ops if o.primitive == "dot_general"]
+    psums = [o for o in report.ops if o.primitive == "psum"]
+    checks = [
+        ("one dot + one psum", len(dots) == 1 and len(psums) == 1),
+        (f"dot FLOPs == {want_flops}", bool(dots) and dots[0].flops == want_flops),
+        (f"dot HBM bytes == {want_hbm}", bool(dots) and dots[0].hbm_bytes == want_hbm),
+        (f"psum wire bytes == {want_wire}", bool(psums) and psums[0].wire_bytes == want_wire),
+        ("totals add up", report.total_flops == want_flops and report.total_wire_bytes == want_wire),
+        ("zero findings", not report.findings),
+    ]
+    ok = all(passed for _, passed in checks)
+    lines = [
+        f"[perf selfcheck] roofline reference ({M}x{K}@{K}x{N} + psum over {axis}={n_axis}): "
+        + ("exact" if ok else "MISMATCH: " + ", ".join(name for name, passed in checks if not passed))
+    ]
+    return ok, lines
+
+
+def run_perf_selfcheck(mesh=None) -> tuple[bool, list[str]]:
+    """Prove TPU501-TPU505 each fire on their seeded defect, each clean
+    twin yields zero findings, and the roofline math matches the
+    hand-computed reference exactly."""
+    if mesh is None:
+        from ..parallel.mesh import MeshConfig
+
+        mesh = MeshConfig().build()
+    lines: list[str] = []
+    ok = True
+    clean = _perf_clean_fixtures(mesh)
+    for rule, (fn, args, kwargs) in sorted(_perf_fixtures(mesh).items()):
+        report = perf_check(fn, *args, mesh=mesh, select=(rule,), **kwargs)
+        fired = any(f.rule == rule for f in report.findings)
+        ok &= fired
+        lines.append(f"[perf selfcheck] {rule} fixture: {'detected' if fired else 'MISSED'}")
+        cfn, cargs, ckwargs = clean[rule]
+        twin = perf_check(cfn, *cargs, mesh=mesh, **ckwargs)
+        quiet = not twin.findings
+        ok &= quiet
+        lines.append(
+            f"[perf selfcheck] {rule} clean twin: "
+            + ("zero findings" if quiet else "DIRTY: " + ", ".join(f.rule for f in twin.findings))
+        )
+    ref_ok, ref_lines = _roofline_reference(mesh)
+    ok &= ref_ok
+    lines.extend(ref_lines)
+    return ok, lines
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -326,6 +517,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     div_ok, div_lines = run_divergence_selfcheck()
     ok &= div_ok
     lines.extend(div_lines)
+
+    perf_ok, perf_lines = run_perf_selfcheck(mesh)
+    ok &= perf_ok
+    lines.extend(perf_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

@@ -18,7 +18,7 @@ user eats. Three coupled pieces (see
   watchdog's warning becomes a one-time pad, not a compile storm.
 """
 
-from .bucketing import ShapeBucketer, next_pow2, pad_batch_tree
+from .bucketing import ShapeBucketer, next_pow2, pad_batch_tree, round_up_to
 from .cache import (
     CorruptEntryError,
     ExecutableStore,
@@ -46,5 +46,6 @@ __all__ = [
     "next_pow2",
     "pad_batch_tree",
     "resolve_cache_dir",
+    "round_up_to",
     "serialize_compiled",
 ]

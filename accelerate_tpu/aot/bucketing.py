@@ -40,8 +40,14 @@ def next_pow2(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
 
 
-def _round_up(n: int, multiple: int) -> int:
+def round_up_to(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= n — the pad target for an
+    alignment constraint (MXU tiles in ``analysis.perf_rules``, shard
+    counts here)."""
     return -(-n // multiple) * multiple
+
+
+_round_up = round_up_to  # historical private alias
 
 
 class ShapeBucketer:

@@ -16,6 +16,7 @@ from .launch import launch_parser
 from .lint import lint_parser
 from .merge import merge_parser
 from .migrate import migrate_parser
+from .perfcheck import perfcheck_parser
 from .telemetry import telemetry_parser
 from .test import test_parser
 from .tpu import tpu_command_parser
@@ -33,6 +34,7 @@ def main():
     estimate_parser(subparsers)
     lint_parser(subparsers)
     flightcheck_parser(subparsers)
+    perfcheck_parser(subparsers)
     divergence_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)

@@ -1,0 +1,199 @@
+"""``accelerate-tpu perf-check`` — the static roofline + TPU5xx
+efficiency rules over a step function, before any XLA compile.
+
+Same target conventions as ``flight-check`` (``path/to/file.py::fn`` or
+``pkg.module:fn``, repeatable ``--arg dtype[shape]`` specs or the
+module's ``<fn>_sample_args()`` / ``SAMPLE_ARGS``), same fake CPU mesh —
+safe on a dev box with no TPU. The report prices every matmul,
+collective, and transfer in the traced jaxpr: per-op FLOPs, HBM bytes,
+bytes-on-wire, compute/memory/comms-bound classification, the predicted
+step time and the MFU upper bound for the chosen generation, plus the
+TPU501–505 findings (TPU502, redundant collective, is error-severity —
+the strict part of the ``make perf-check`` gate).
+
+``--baseline prev.json`` turns the run into a diff: per-op time deltas
+against a previous ``--format json`` report, exiting non-zero when the
+predicted step time regresses more than ``--regress-pct`` — the CI hook
+that makes static perf regressions visible per-PR.
+
+Examples::
+
+    accelerate-tpu perf-check examples/by_feature/flight_check.py::train_step --mesh data=8
+    accelerate-tpu perf-check train.py::step --arg "f32[32,128]" --generation v6e
+    accelerate-tpu perf-check train.py::step --format json > perf.json
+    accelerate-tpu perf-check train.py::step --baseline perf.json --regress-pct 10
+    accelerate-tpu perf-check --selfcheck   # prove TPU501-505 fire, twins clean, roofline exact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def perfcheck_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "perf-check", help="Static roofline + TPU5xx efficiency rules for a step fn"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu perf-check")
+    parser.add_argument("target", nargs="?", help="step function: file.py::fn or pkg.module:fn")
+    parser.add_argument("--arg", action="append", default=[], help="sample arg spec like f32[8,128] (repeatable)")
+    parser.add_argument("--mesh", default=None, help="mesh shape, e.g. data=4,tensor=2 (default: all devices on data)")
+    parser.add_argument("--dcn-axes", default=None, help="axes that cross DCN, e.g. data (default: env/single-slice)")
+    parser.add_argument(
+        "--generation", default=None,
+        help="TPU generation for the roofline tables (v4/v5e/v5p/v6e/cpu; default: attached backend)",
+    )
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--baseline", default=None, help="previous --format json report to diff against")
+    parser.add_argument(
+        "--regress-pct", type=float, default=None,
+        help="with --baseline: exit nonzero when predicted step time regresses more than this %% "
+        "(default: [perf].regress_pct from .tpulint.toml, else 10)",
+    )
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU501-505 fire on seeded defects, clean twins stay silent, roofline math is exact",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=perfcheck_command)
+    return parser
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)
+    from accelerate_tpu.analysis.selfcheck import run_perf_selfcheck
+
+    ok, lines = run_perf_selfcheck()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("perf-check selfcheck FAILED")
+        return 1
+    return 0
+
+
+def diff_baseline(current: dict, baseline: dict, regress_pct: float) -> tuple[list[str], bool]:
+    """Per-op and total deltas between two ``--format json`` reports.
+    Ops are matched by (primitive, location); the regression verdict is
+    on the total predicted step time."""
+    lines = []
+    cur_tot = current.get("totals", {})
+    base_tot = baseline.get("totals", {})
+
+    def delta(key, unit="", scale=1.0):
+        a, b = base_tot.get(key), cur_tot.get(key)
+        if a is None or b is None:
+            return None
+        pct = ((b - a) / a * 100.0) if a else (0.0 if b == a else float("inf"))
+        lines.append(f"  {key:<24}: {a * scale:.3f} -> {b * scale:.3f} {unit} ({pct:+.1f}%)")
+        return pct
+
+    step_pct = delta("predicted_step_ms", "ms")
+    delta("flops_per_device")
+    delta("hbm_bytes_per_device")
+    delta("wire_bytes_per_device")
+
+    # ops matched by (primitive, location, occurrence index) — several ops
+    # can legitimately share a source line (forward + backward of one @)
+    def keyed(ops):
+        counts: dict = {}
+        out = {}
+        for op in ops:
+            base = (op.get("primitive"), op.get("location"))
+            idx = counts.get(base, 0)
+            counts[base] = idx + 1
+            out[base + (idx,)] = op
+        return out
+
+    base_ops = keyed(baseline.get("ops", ()))
+    cur_ops = keyed(current.get("ops", ()))
+    for k, op in cur_ops.items():
+        prev = base_ops.get(k)
+        if prev is None:
+            lines.append(f"  + {op['primitive']} {op.get('location', '')}: {op['time_us']}us (new op)")
+        elif abs(op.get("time_us", 0.0) - prev.get("time_us", 0.0)) > 1e-9:
+            lines.append(
+                f"  ~ {op['primitive']} {op.get('location', '')}: "
+                f"{prev.get('time_us')}us -> {op.get('time_us')}us"
+            )
+    for k, prev in base_ops.items():
+        if k not in cur_ops:
+            lines.append(f"  - {prev['primitive']} {prev.get('location', '')}: {prev.get('time_us')}us (removed)")
+
+    regressed = step_pct is not None and step_pct > regress_pct
+    verdict = (
+        f"REGRESSION: predicted step time {step_pct:+.1f}% (threshold +{regress_pct:g}%)"
+        if regressed
+        else f"ok: predicted step time {step_pct:+.1f}% (threshold +{regress_pct:g}%)"
+        if step_pct is not None
+        else "ok: baseline has no predicted_step_ms to compare"
+    )
+    lines.append(verdict)
+    return lines, regressed
+
+
+def perfcheck_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not args.target:
+            return rc
+
+    if not args.target:
+        print("usage: accelerate-tpu perf-check file.py::step_fn [--arg f32[8,128] ...]")
+        return 2
+
+    from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+    mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.target)
+    sample_args = resolve_sample_args(module, fn, args.arg)
+    dcn = tuple(a.strip() for a in args.dcn_axes.split(",") if a.strip()) if args.dcn_axes else None
+
+    from accelerate_tpu.analysis import exit_code, render_sarif
+    from accelerate_tpu.analysis.perfmodel import perf_check
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    report = perf_check(
+        fn, *sample_args, mesh=mesh, dcn=dcn, generation=args.generation,
+        ignore=tuple(cfg.disable),
+    )
+    findings = cfg.apply_suppressions(report.findings)
+    fmt = cfg.resolve_format(args.format)
+    if fmt == "json":
+        print(json.dumps(report.as_dict(), indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(report.render_text())
+
+    rc = exit_code(findings, strict=args.strict)
+    if args.baseline:
+        try:
+            with open(args.baseline) as fh:
+                baseline = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perf-check: cannot read baseline {args.baseline}: {e}")
+            return 2
+        regress_pct = cfg.resolve_regress_pct(args.regress_pct)
+        lines, regressed = diff_baseline(report.as_dict(), baseline, regress_pct)
+        print(f"baseline diff vs {args.baseline}:")
+        for line in lines:
+            print(line)
+        if regressed:
+            rc = rc or 1
+    return rc
+
+
+def main():
+    raise SystemExit(perfcheck_command(perfcheck_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

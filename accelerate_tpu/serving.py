@@ -316,6 +316,10 @@ class ServingEngine:
         self._uid = 0
         self._pool_blocked = False  # last admit pass hit pool exhaustion
         self.bucket_compile_ms: dict = {}  # (kind, bucket) -> build wall ms
+        # raw (pre-jit) program + sample-args builder + trace contexts per
+        # engine program, so perf_check() can roofline the real prefill /
+        # decode jaxprs without compiling anything
+        self._perf_programs: dict = {}
 
         # ---- jitted programs (compiled once each) ----
         def pick_lp(row, tok):
@@ -354,6 +358,16 @@ class ServingEngine:
                 return prog
 
             self._prefill = _LazyBuckets(_build_prefill)
+            self._perf_programs["prefill"] = (
+                prefill,
+                lambda b: (
+                    params,
+                    jax.ShapeDtypeStruct((1, b), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    key_aval,
+                ),
+                (self._trace_ctx,),
+            )
 
         # ---- chunked-prefill programs (long prompts / prefix suffixes) ----
         # one chunk size (the largest bucket) x {cold, warm}: compile count
@@ -458,7 +472,8 @@ class ServingEngine:
             # to whatever input shardings GSPMD propagates onto the pool
             # between pastes — an eagerly .lower()ed program would pin the
             # shardings it saw at construction and reject the real ones.
-            tick = self._pc.wrap_jit(jax.jit(make_tick(paged_step)), name="paged_decode_tick")
+            raw_tick = make_tick(paged_step)
+            tick = self._pc.wrap_jit(jax.jit(raw_tick), name="paged_decode_tick")
             pcfg = self._pcfg
 
             def decode_tick(*args):
@@ -466,6 +481,11 @@ class ServingEngine:
                     return tick(*args)
 
             self._decode_tick = decode_tick
+            self._perf_programs["decode_tick"] = (
+                raw_tick,
+                lambda b: (params, self.slot_caches, self.slot_tok, self.slot_pos, self._slot_keys),
+                (lambda: paged_mode(pcfg), self._trace_ctx),
+            )
             self._paste = ctx_jit(paste_row)
             self._paste_blocks = ctx_jit(paste_blocks)
             self._clear_slot = ctx_jit(clear_slot)
@@ -483,7 +503,13 @@ class ServingEngine:
             def dense_step(params, caches, toks, poss, keys):
                 return jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(params, caches, toks, poss, keys)
 
-            self._decode_tick = ctx_jit(make_tick(dense_step))
+            raw_dense_tick = make_tick(dense_step)
+            self._decode_tick = ctx_jit(raw_dense_tick)
+            self._perf_programs["decode_tick"] = (
+                raw_dense_tick,
+                lambda b: (params, self.slot_caches, self.slot_tok, self.slot_pos, self._slot_keys),
+                (self._trace_ctx,),
+            )
 
         if draft_model is not None:
             # ---- speculative programs (dense layout; greedy) ----------
@@ -518,6 +544,12 @@ class ServingEngine:
                 return slot_caches, emits_k, lps_k, n_k
 
             self._spec_tick = ctx_jit(spec_tick)
+            # the spec engine decodes through spec_tick, not the dense tick
+            self._perf_programs["decode_tick"] = (
+                spec_tick,
+                lambda b: (params, draft_model.params, self.slot_caches, self.slot_tok, self.slot_pos),
+                (self._trace_ctx,),
+            )
 
             from .ops.kv_cache import reset_cache_index
 
@@ -544,6 +576,16 @@ class ServingEngine:
                 return prog
 
             self._spec_prefill = _LazyBuckets(_build_spec_prefill)
+            self._perf_programs["prefill"] = (
+                spec_prefill,
+                lambda b: (
+                    params,
+                    draft_model.params,
+                    jax.ShapeDtypeStruct((1, b), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                ),
+                (self._trace_ctx,),
+            )
             # accept-rate telemetry: {"steps", "accepted", "emitted"}
             self.spec_stats = {"steps": 0, "accepted": 0, "emitted": 0}
 
@@ -1049,6 +1091,40 @@ class ServingEngine:
         from .generation import _trace_ctx
 
         return _trace_ctx(getattr(self.model, "mesh", None))
+
+    def perf_check(self, mesh=None, generation=None, bucket=None, dcn=None) -> dict:
+        """Static roofline of the engine's real serving programs — the
+        prefill at ``bucket`` (default: the smallest prompt bucket) and
+        the decode tick — via :func:`analysis.perfmodel.perf_check`.
+        Nothing compiles or executes: the same raw functions the engine
+        jits are traced abstractly, so the report prices exactly the
+        programs that serve traffic (per-op FLOPs / HBM bytes /
+        bytes-on-wire, predicted step time, MFU upper bound, TPU5xx
+        findings). Returns ``{"prefill": PerfReport, "decode_tick":
+        PerfReport}`` (whichever programs this engine configuration
+        has). ``mesh`` defaults to the sharded model's mesh, else a
+        single-device mesh."""
+        jax = _jax()
+        import contextlib
+
+        from .analysis.perfmodel import perf_check as _perf_check
+
+        if mesh is None:
+            mesh = getattr(self.model, "mesh", None)
+        if mesh is None:
+            from .parallel.mesh import MeshConfig
+
+            mesh = MeshConfig(data=1).build(jax.devices()[:1])
+        b = int(bucket) if bucket is not None else min(self.prompt_buckets)
+        reports = {}
+        for name, (fn, args_fn, ctx_factories) in self._perf_programs.items():
+            with contextlib.ExitStack() as stack:
+                for factory in ctx_factories:
+                    stack.enter_context(factory())
+                reports[name] = _perf_check(
+                    fn, *args_fn(b), mesh=mesh, generation=generation, dcn=dcn
+                )
+        return reports
 
     def _bucket_for(self, n: int) -> Optional[int]:
         """Covering prefill bucket for an ``n``-token prompt: the minimal

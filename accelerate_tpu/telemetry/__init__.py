@@ -140,6 +140,13 @@ class Telemetry:
         self.hbm.static_peak_bytes = int(peak_bytes)
         self.log.event("hbm_static_estimate", bytes=int(peak_bytes))
 
+    def set_static_step_estimate(self, predicted_ms: float, *, threshold=None):
+        """Attach a perf-check step-time prediction after construction
+        (``Accelerator.perf_check`` calls this when telemetry is live);
+        arms the one-shot ``perf_model_drift`` cross-check in
+        :class:`StepTelemetry`."""
+        self.steps.set_static_step_estimate(predicted_ms, threshold=threshold)
+
     def summary(self) -> dict:
         out = self.steps.summary()
         if self.hbm.observed_peak_bytes:

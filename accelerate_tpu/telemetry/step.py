@@ -28,6 +28,16 @@ functions and ``build_train_step``'s ``step._jitted`` do), cache growth is
 cross-checked too, catching drift a shape signature can't see (e.g.
 weak-type promotion).
 
+The **perf-model drift check** is the roofline twin of the HBM drift
+check: when a static step-time prediction is attached
+(:meth:`StepTelemetry.set_static_step_estimate` — what
+``Accelerator.perf_check`` seeds), the observed steady-state busy time
+(dispatch + execute, the part the roofline models) is compared against it
+once enough steady steps exist, and ONE ``perf_model_drift`` warning
+event fires when they disagree by more than the threshold — either the
+static model is mispricing an op (fix ``analysis.perfmodel``) or the
+program is doing work the author didn't price (fix the program).
+
 Per-step records are kept in a bounded in-memory deque (so ``summary()``
 works with no event log at all) and mirrored to an :class:`EventLog` when
 one is attached.
@@ -231,6 +241,11 @@ class StepTelemetry:
         self.compile_ms = 0.0  # summed over first step + every detected miss
         self.records: collections.deque = collections.deque(maxlen=max_records)
         self.recompile_events: list[dict] = []
+        # perf-model drift check (seeded by set_static_step_estimate)
+        self.static_step_ms: Optional[float] = None
+        self.perf_drift_threshold = 0.5
+        self.perf_drift_min_steady = 5
+        self.perf_drift_event: Optional[dict] = None
         self._signature = _PathCachedSignature()
         self._last_fence_end: Optional[float] = None
         self._cm_watchdog: Optional[_WatchdogState] = None  # context-manager path's
@@ -378,8 +393,55 @@ class StepTelemetry:
             wd.last_sig = sig
         wd.calls += 1
         self.step_index += 1
+        self._check_perf_drift()
         if self.on_step is not None:
             self.on_step(rec)
+
+    # ------------------------------------------------------------------ #
+    # perf-model drift (static roofline vs observed step split)
+    # ------------------------------------------------------------------ #
+
+    def set_static_step_estimate(self, predicted_ms: float, *, threshold: Optional[float] = None):
+        """Attach a static step-time prediction (``Accelerator.perf_check``
+        seeds ``PerfReport.predicted_step_ms`` here). Once
+        ``perf_drift_min_steady`` steady records exist, the observed
+        median busy time (dispatch + execute — the part the roofline
+        models; data-wait is the loader's problem) is compared against it
+        and ONE ``perf_model_drift`` warning fires past ``threshold``."""
+        self.static_step_ms = float(predicted_ms)
+        if threshold is not None:
+            self.perf_drift_threshold = float(threshold)
+        self.perf_drift_event = None  # a new estimate re-arms the check
+        self.log.event("perf_static_estimate", predicted_ms=round(self.static_step_ms, 4))
+
+    def observed_busy_ms(self) -> Optional[float]:
+        """Median steady-state dispatch+execute ms (None before any
+        steady record)."""
+        steady = self.steady_records()
+        if not steady:
+            return None
+        busy = sorted(r["dispatch_ms"] + r["execute_ms"] for r in steady)
+        return round(busy[len(busy) // 2], 3)
+
+    def _check_perf_drift(self):
+        if self.perf_drift_event is not None or not self.static_step_ms:
+            return
+        steady = self.steady_records()
+        if len(steady) < self.perf_drift_min_steady:
+            return
+        observed = self.observed_busy_ms()
+        if not observed:
+            return
+        rel = abs(observed - self.static_step_ms) / self.static_step_ms
+        if rel > self.perf_drift_threshold:
+            self.perf_drift_event = self.log.event(
+                "perf_model_drift",
+                severity="warning",
+                predicted_ms=round(self.static_step_ms, 4),
+                observed_busy_ms=observed,
+                rel_error=round(rel, 4),
+                threshold=self.perf_drift_threshold,
+            )
 
     # ------------------------------------------------------------------ #
     # summaries
@@ -413,6 +475,12 @@ class StepTelemetry:
             mfus = [r["mfu"] for r in steady if "mfu" in r]
             if mfus:
                 out["mfu"] = round(sum(mfus) / len(mfus), 5)
+        if self.static_step_ms:
+            out["static_step_ms"] = round(self.static_step_ms, 4)
+            observed = self.observed_busy_ms()
+            if observed is not None:
+                out["observed_busy_ms"] = observed
+            out["perf_model_drift"] = self.perf_drift_event is not None
         return out
 
 

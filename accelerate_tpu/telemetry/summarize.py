@@ -43,6 +43,13 @@ def summarize(events: list[dict]) -> dict:
         mfus = [s["mfu"] for s in steady if "mfu" in s]
         total = sum(s.get("dur_ms", 0.0) for s in steady)
         busy = sum(s.get("dispatch_ms", 0.0) + s.get("execute_ms", 0.0) for s in steady)
+        static_step = next(
+            (e for e in events if e.get("kind") == "event" and e.get("name") == "perf_static_estimate"),
+            None,
+        )
+        perf_drift = [
+            e for e in events if e.get("kind") == "event" and e.get("name") == "perf_model_drift"
+        ]
         report["steps"] = {
             "count": len(steps),
             "steady_count": len(steady),
@@ -58,6 +65,17 @@ def summarize(events: list[dict]) -> dict:
             ],
             "goodput": round(min(1.0, busy / total), 4) if total > 0 else None,
             "mfu": round(sum(mfus) / len(mfus), 5) if mfus else None,
+            # static roofline cross-check (perf-check seeds the estimate,
+            # StepTelemetry emits perf_model_drift on disagreement)
+            "static_step_ms": static_step.get("predicted_ms") if static_step else None,
+            "perf_drift_events": [
+                {
+                    "predicted_ms": e.get("predicted_ms"),
+                    "observed_busy_ms": e.get("observed_busy_ms"),
+                    "rel_error": e.get("rel_error"),
+                }
+                for e in perf_drift
+            ],
         }
 
     hbm_counters = [e for e in events if e.get("kind") == "counter" and e.get("name") == "hbm_peak_bytes"]
@@ -155,6 +173,13 @@ def render_text(report: dict) -> str:
             lines.append(f"    goodput           : {steps['goodput']:.1%}")
         if steps.get("mfu") is not None:
             lines.append(f"    MFU               : {steps['mfu']:.1%}")
+        if steps.get("static_step_ms") is not None:
+            lines.append(f"    static prediction : {steps['static_step_ms']} ms (perf-check roofline)")
+        for d in steps.get("perf_drift_events", []):
+            lines.append(
+                f"    DRIFT: observed busy {d['observed_busy_ms']} ms vs "
+                f"predicted {d['predicted_ms']} ms ({d['rel_error']:.0%} off)"
+            )
     hbm = report.get("hbm")
     if hbm:
         lines.append("  hbm:")

@@ -29,8 +29,14 @@ def train_step(params, batch):
     update."""
 
     def loss_fn(p):
-        h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
-        pred = h @ p["w2"] + p["b2"]
+        # perf-check TPU501 prices the toy sizes honestly: the batch-of-32
+        # contraction of the backward dW matmuls (K=batch) pads the
+        # 128-lane MXU tile 75%, and the 1-wide regression head pads
+        # 99.2%. Real fixes are batch>=128 / a wider head; this example
+        # keeps the small shapes (the flight-check transcript depends on
+        # them) and suppresses the warnings instead.
+        h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])  # tpu-lint: disable=TPU501
+        pred = h @ p["w2"] + p["b2"]  # tpu-lint: disable=TPU501
         return jnp.mean((pred - batch["y"]) ** 2)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)

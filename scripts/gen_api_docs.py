@@ -79,6 +79,8 @@ MODULES = [
     "accelerate_tpu.analysis.jaxpr_lint",
     "accelerate_tpu.analysis.flightcheck",
     "accelerate_tpu.analysis.costmodel",
+    "accelerate_tpu.analysis.perfmodel",
+    "accelerate_tpu.analysis.perf_rules",
     "accelerate_tpu.analysis.ranksim",
     "accelerate_tpu.analysis.divergence",
     "accelerate_tpu.analysis.project_config",

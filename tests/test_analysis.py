@@ -376,6 +376,7 @@ def test_registry_ids_are_stable():
         "TPU201", "TPU202", "TPU203", "TPU204",
         "TPU301", "TPU302", "TPU303",
         "TPU401", "TPU402", "TPU403", "TPU404", "TPU405",
+        "TPU501", "TPU502", "TPU503", "TPU504", "TPU505",
     }
     with pytest.raises(ValueError):
         Finding("TPU999", "no such rule")
@@ -430,5 +431,5 @@ def test_repo_tree_is_lint_clean():
 def test_selfcheck_all_rules_fire(mesh8):
     ok, lines = run_selfcheck(mesh8)
     assert ok, "\n".join(lines)
-    assert sum("detected" in line for line in lines) == 18  # 6 AST + 4 jaxpr + 3 flight + 5 divergence
+    assert sum("detected" in line for line in lines) == 23  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf
     assert any("clean idiomatic script: zero findings" in line for line in lines)
