@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check perf-check telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -39,6 +39,7 @@ lint:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --selfcheck
 	$(MAKE) --no-print-directory divergence
 	$(MAKE) --no-print-directory perf-check
+	$(MAKE) --no-print-directory numerics-check
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
@@ -60,7 +61,8 @@ lint-sarif:
 	@mkdir -p .cache
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --format sarif > .cache/lint.sarif
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --format sarif > .cache/divergence.sarif
-	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif -o lint-merged.sarif
+	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check accelerate_tpu --format sarif > .cache/numerics.sarif
+	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif -o lint-merged.sarif
 
 # Static perf tier: prove TPU501-505 fire on their seeded defects, each
 # clean twin stays silent, and the roofline math matches the hand-computed
@@ -72,6 +74,19 @@ lint-sarif:
 perf-check:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli perf-check --selfcheck \
 		examples/by_feature/flight_check.py::train_step --mesh data=8
+
+# Numerics tier: prove TPU601-606 fire on their seeded defects, each
+# clean twin stays silent, and the interval arithmetic matches the
+# hand-computed reference exactly — then interpret the example's
+# mixed-precision step over a fake 8-device CPU mesh AND run the AST
+# key-reuse tier over the whole tree. The gate is STRICT for TPU602
+# (provable fp16/fp8 overflow has no legitimate use) via its error
+# severity; TPU601/603-606 warnings report but pass.
+numerics-check:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check --selfcheck \
+		examples/by_feature/numerics_check.py::train_step --mesh data=8
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check accelerate_tpu
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check examples
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake

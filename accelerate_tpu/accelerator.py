@@ -883,6 +883,55 @@ class Accelerator:
             self._telemetry.set_static_step_estimate(report.predicted_step_ms)
         return report
 
+    def numerics_check(
+        self,
+        step_fn: Callable,
+        *sample_args,
+        assume=None,
+        ignore=(),
+    ):
+        """Static numerics & precision analysis of ``step_fn`` against
+        this accelerator's mesh, *before* paying a multi-chip compile:
+        a value-interval + dtype-provenance abstract interpretation of
+        the traced jaxpr (widening through ``scan``/``while``, joins
+        across ``cond`` branches, relational softmax refinements) plus
+        the TPU6xx precision rules — low-precision accumulation over
+        long axes, provable fp16/fp8 overflow, unguarded div/log/rsqrt
+        over zero, weight updates below the param ulp, PRNG key reuse,
+        and compressed collectives without error feedback. Every finding
+        prices its impact (relative-error bound, overflow margin, or
+        lost-update ulp).
+
+        ``assume=(lo, hi)`` states the input-value assumption the proofs
+        are relative to (default ±16). Same calling convention as
+        :meth:`perf_check`; returns a
+        :class:`~accelerate_tpu.analysis.NumericsReport`
+        (``.render_text()`` for the human report, ``.as_dict()`` for
+        tooling). Error-severity findings are logged. The runtime
+        counterpart is the opt-in telemetry
+        :class:`~accelerate_tpu.telemetry.NonFiniteWatchdog`
+        (``TelemetryKwargs(nonfinite_every=N)``). See
+        ``docs/usage_guides/static_analysis.md`` and
+        ``docs/usage_guides/low_precision.md``.
+        """
+        from .analysis import render_text
+        from .analysis.numerics import numerics_check as _numerics_check
+
+        report = _numerics_check(
+            step_fn,
+            *sample_args,
+            mesh=self.mesh,
+            assume=assume,
+            ignore=ignore,
+        )
+        if not report.ok:
+            logger.warning(
+                "numerics-check found issues in %s:\n%s",
+                getattr(step_fn, "__name__", "step_fn"),
+                render_text(report.findings),
+            )
+        return report
+
     def build_train_step(
         self,
         loss_fn: Callable,
@@ -1067,8 +1116,11 @@ class Accelerator:
                 new_state, aux = mstate, None
             else:
                 grads, (loss, new_state, aux) = jax.grad(scaled_loss, has_aux=True)(params)
-            # compressed grads are already unscaled inside local_grads
-            denom = accum if compress_method is not None else (loss_scale * accum)
+            # compressed grads are already unscaled inside local_grads.
+            # The scaler clamps the loss scale at >= 1 (backoff floor), so
+            # the maximum() is an exact no-op that encodes the invariant —
+            # and makes the division provably guarded (numerics TPU603)
+            denom = accum if compress_method is not None else (jnp.maximum(loss_scale, 1.0) * accum)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) / denom, grads)
             grad_buf = jax.tree_util.tree_map(lambda b, g: b + g, grad_buf, grads)
 
@@ -1237,6 +1289,20 @@ class Accelerator:
             state_box["micro"] = 0 if do_sync else state_box["micro"] + 1
             self.step += 1
             self._last_grad_norm = gnorm
+            # opt-in runtime finiteness probe (TelemetryKwargs
+            # nonfinite_every=N) — the runtime counterpart of the static
+            # TPU602 overflow proof. Gated inside observe(): off-cadence
+            # steps coerce nothing, so no host sync is added
+            if self._telemetry is not None and self._telemetry.nonfinite.enabled:
+                self._telemetry.nonfinite.observe(
+                    self.step,
+                    loss=loss,
+                    grad_norm=gnorm,
+                    loss_scale=new_scale_state["scale"] if use_fp16 else None,
+                    # the fp16 scaler skips the update and backs off on a
+                    # grad overflow — that's calibration, not divergence
+                    scaler_handled=use_fp16,
+                )
             if do_sync:
                 if use_fp16:
                     # device value, coerced lazily by the property — reading
@@ -1807,6 +1873,7 @@ class Accelerator:
                 hbm_sample_every=h.hbm_sample_every,
                 forward_fn=(lambda values, step: self.log(values, step=step)),
                 forward_every=h.forward_to_trackers_every,
+                nonfinite_every=h.nonfinite_every,
             )
             if self._program_cache is not None:
                 # compile_cache_* events land in the same run JSONL as the

@@ -28,11 +28,22 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   the TPU5xx efficiency rules (``perf_rules``): MXU tile misalignment,
   redundant collectives, latency-bound small DCN collectives, missed
   collective/compute overlap, f32 matmuls that are safely bf16.
+* **numerics tier** (``numerics_check``) — the value-interval +
+  dtype-provenance abstract interpretation (``numerics``): per-value
+  bounds derived from stated input assumptions (widening through
+  scan/while, joins across cond branches, relational softmax
+  refinements), dtype provenance threaded through casts, plus the
+  TPU6xx precision rules (``numerics_rules``): low-precision
+  accumulation over long axes, provable fp16/fp8 overflow (error — the
+  strict gate), unguarded div/log/rsqrt over zero, weight updates below
+  the param ulp, PRNG key reuse, compressed collectives without error
+  feedback.
 
 Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
-``accelerate-tpu divergence`` / ``accelerate-tpu perf-check``
-(commands/) and ``Accelerator.lint`` / ``Accelerator.flight_check`` /
-``Accelerator.perf_check``. Suppress a finding inline with
+``accelerate-tpu divergence`` / ``accelerate-tpu perf-check`` /
+``accelerate-tpu numerics-check`` (commands/) and ``Accelerator.lint`` /
+``Accelerator.flight_check`` / ``Accelerator.perf_check`` /
+``Accelerator.numerics_check``. Suppress a finding inline with
 ``# tpu-lint: disable=TPU201``, or project-wide via ``.tpulint.toml``
 (``project_config``).
 """
@@ -42,13 +53,15 @@ from .costmodel import BANDWIDTH_TABLE, CollectiveRecord, TrafficReport, collect
 from .divergence import analyze_file, analyze_paths, analyze_source
 from .flightcheck import FlightReport, LiveBuffer, estimate_peak_hbm, flight_check
 from .jaxpr_lint import lint_step
+from .numerics import AbsVal, Interval, NumericsInterpreter, NumericsReport, numerics_check
+from .numerics_rules import COMPRESSION_NUMERICS, check_key_reuse_source, check_numerics_rules
 from .perf_rules import check_perf_rules
 from .perfmodel import OpRecord, PerfReport, perf_check, walk_ops
 from .project_config import ProjectConfig, find_project_config, load_project_config
 from .ranksim import ACCELERATOR_EFFECTS, COLLECTIVE_EFFECTS, ModuleSimulator
 from .report import exit_code, format_finding, render_json, render_sarif, render_text
 from .rules import ERROR, RULES, WARNING, Finding, Rule, apply_suppressions, filter_findings
-from .selfcheck import run_divergence_selfcheck, run_perf_selfcheck, run_selfcheck
+from .selfcheck import run_divergence_selfcheck, run_numerics_selfcheck, run_perf_selfcheck, run_selfcheck
 
 __all__ = [
     "ERROR",
@@ -86,6 +99,15 @@ __all__ = [
     "run_selfcheck",
     "run_divergence_selfcheck",
     "run_perf_selfcheck",
+    "run_numerics_selfcheck",
+    "numerics_check",
+    "check_numerics_rules",
+    "check_key_reuse_source",
+    "NumericsReport",
+    "NumericsInterpreter",
+    "AbsVal",
+    "Interval",
+    "COMPRESSION_NUMERICS",
     "analyze_source",
     "analyze_file",
     "analyze_paths",

@@ -1,0 +1,1004 @@
+"""Numerics & precision analyzer: a value-interval + dtype-provenance
+abstract interpretation over the traced jaxpr — prove a step *numerically
+sound* before anything compiles or runs.
+
+The flight-check (TPU3xx) proves a step is safe, the roofline (TPU5xx)
+prices whether it is fast; this module proves the arithmetic itself will
+not silently diverge a run. ``numerics_check(fn, *sample_args, mesh=...)``
+traces ``fn`` abstractly with the PR-1 linter machinery (nothing
+executes, nothing compiles) and interprets every equation over an
+**abstract domain** per value:
+
+* a **value interval** ``[lo, hi]`` — float inputs are assumed inside a
+  configurable range (``assume=`` per argument, default ±16: the scale
+  of logits/activations/gradients after normalisation), literals and
+  constants are exact, and per-primitive transfer functions propagate
+  the bounds (4-corner products for ``mul``/``div``, monotone maps for
+  ``exp``/``log``/``tanh``, ``K·[lo, hi]`` for length-``K`` sums and
+  contractions, axis-size multiplication for ``psum``). One relational
+  refinement matters in practice and is modelled exactly: ``x − max(x)``
+  (the max-subtracted-softmax shape, tracked through
+  ``broadcast_in_dim``/``stop_gradient``) is ``[lo−hi, 0]`` — which is
+  what proves a guarded softmax safe while the unguarded twin overflows.
+* a **dtype provenance** — the narrowest mantissa the value has passed
+  through (a bf16 value cast up to f32 still only carries 8 bits) and a
+  ``narrowed`` tag set when a float was quantized onto a narrower wire
+  dtype (bf16/fp16/fp8/int8) — what TPU606 uses to recognise a
+  compressed collective and TPU604 to recognise master-weight loss.
+
+Control flow is interpreted, not skipped: ``pjit``/``shard_map``/
+``custom_jvp``/``remat`` bodies are entered with the caller's abstract
+values, ``cond`` branches are **joined** (interval union), and
+``scan``/``while`` carries run to a **widening fixpoint** — after
+:data:`WIDEN_AFTER` non-converged passes a still-moving bound is widened
+to ±inf, so termination is guaranteed and loop-invariant bounds stay
+tight.
+
+The walk emits one :class:`OpFact` per interpreted equation (intervals,
+dtypes, provenance, scan multiplicity, source location); the TPU601–606
+rule tier (``analysis.numerics_rules``) is a pure function of that fact
+stream. Surfaces follow the house pattern: ``accelerate-tpu
+numerics-check`` (same target/``--arg``/``--mesh``/``--format``
+conventions as flight-check, plus ``--assume lo,hi``),
+``Accelerator.numerics_check``, inline ``# tpu-lint: disable`` and
+``.tpulint.toml`` suppressions, and the selfcheck fixtures
+(``run_numerics_selfcheck``) that prove every rule fires on a seeded
+defect, stays silent on its repaired twin, and that the interval
+arithmetic matches a hand-computed reference exactly.
+
+jax is imported lazily; everything works on abstract values only.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+from .rules import Finding, filter_findings
+
+#: widening: after this many non-converged joins of a scan/while carry,
+#: a still-moving bound is widened to +-inf (termination guarantee).
+WIDEN_AFTER = 3
+#: hard cap on fixpoint passes (defensive; widening converges in <= 2
+#: more passes after it triggers).
+MAX_FIXPOINT_PASSES = 12
+
+#: default assumed interval for float inputs with no explicit ``assume``:
+#: +-16 covers post-normalisation activations, logits, and gradients
+#: while keeping exp() provably finite in f32 and provably NOT in fp16.
+DEFAULT_ASSUME = (-16.0, 16.0)
+
+#: finite max / machine epsilon / mantissa bits per float dtype.
+#: eps is the distance from 1.0 to the next representable number.
+DTYPE_INFO: dict[str, dict] = {
+    "float64": {"max": 1.7976931348623157e308, "eps": 2.0**-52, "mant": 52},
+    "float32": {"max": 3.4028235e38, "eps": 2.0**-23, "mant": 23},
+    "bfloat16": {"max": 3.3895314e38, "eps": 2.0**-7, "mant": 7},
+    "float16": {"max": 65504.0, "eps": 2.0**-10, "mant": 10},
+    "float8_e4m3fn": {"max": 448.0, "eps": 2.0**-3, "mant": 3},
+    "float8_e5m2": {"max": 57344.0, "eps": 2.0**-2, "mant": 2},
+}
+
+#: dtypes whose finite range is small enough to provably overflow
+#: (bf16 shares f32's exponent range, so it never trips TPU602).
+NARROW_RANGE_DTYPES = ("float16", "float8_e4m3fn", "float8_e5m2")
+
+#: low-precision float classes for accumulation/update rules.
+LOW_PRECISION_FLOATS = ("bfloat16", "float16", "float8_e4m3fn", "float8_e5m2")
+
+_INF = math.inf
+
+
+def dtype_max(dtype: str) -> Optional[float]:
+    info = DTYPE_INFO.get(dtype)
+    return info["max"] if info else None
+
+
+def dtype_eps(dtype: str) -> Optional[float]:
+    info = DTYPE_INFO.get(dtype)
+    return info["eps"] if info else None
+
+
+def dtype_mantissa(dtype: str) -> Optional[int]:
+    info = DTYPE_INFO.get(dtype)
+    return info["mant"] if info else None
+
+
+# -- the abstract domain ----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Interval:
+    """A closed interval ``[lo, hi]`` over the extended reals. ``TOP``
+    (``[-inf, inf]`` with ``known=False``) means "nothing proven" — rules
+    skip it; a *derived* infinite bound keeps ``known=True`` (the
+    overflow is proven under the input assumptions)."""
+
+    lo: float
+    hi: float
+    known: bool = True
+
+    def __post_init__(self):
+        if self.lo > self.hi:  # collapse inverted corners defensively
+            object.__setattr__(self, "lo", -_INF)
+            object.__setattr__(self, "hi", _INF)
+
+    @property
+    def finite(self) -> bool:
+        return self.known and math.isfinite(self.lo) and math.isfinite(self.hi)
+
+    @property
+    def contains_zero(self) -> bool:
+        return self.lo <= 0.0 <= self.hi
+
+    def magnitude(self) -> float:
+        return max(abs(self.lo), abs(self.hi))
+
+    def join(self, other: "Interval") -> "Interval":
+        return Interval(min(self.lo, other.lo), max(self.hi, other.hi), self.known and other.known)
+
+    def widen(self, newer: "Interval") -> "Interval":
+        lo = self.lo if newer.lo >= self.lo else -_INF
+        hi = self.hi if newer.hi <= self.hi else _INF
+        return Interval(lo, hi, self.known and newer.known)
+
+    def __repr__(self) -> str:  # compact report form
+        if not self.known:
+            return "[?]"
+        fmt = lambda v: "-inf" if v == -_INF else "inf" if v == _INF else f"{v:.6g}"
+        return f"[{fmt(self.lo)}, {fmt(self.hi)}]"
+
+
+TOP = Interval(-_INF, _INF, known=False)
+
+
+@dataclass
+class AbsVal:
+    """Abstract value of one jaxpr var: interval + dtype provenance.
+
+    ``mant`` is the narrowest mantissa (bits) the value has passed
+    through — a bf16 value upcast to f32 keeps ``mant=7``. ``narrowed``
+    names the wire dtype a wider float was quantized to (``"bfloat16"``,
+    ``"int8"``, ...) and survives the decode cast back up — the TPU606
+    signal. ``param_like`` marks (values derived 1:1 from) float inputs
+    of the main jaxpr — the read-and-replace leaves TPU604 guards.
+    ``max_of``/``sum_of`` carry the two relational refinements that make
+    real mixed-precision code provable: this value IS ``reduce_max`` /
+    ``reduce_sum`` of the named source (tracked through broadcasts,
+    casts, and ``stop_gradient``), so ``x - max(x)`` is ``[lo-hi, 0]``
+    and ``x / sum(x)`` with ``x >= 0`` is ``[0, 1]``. ``src_id`` names
+    the original var an elementwise copy chain started from.
+    ``loop_varying`` marks scan/while carry and per-iteration slice
+    bindings, so TPU605's key-consumption multiplicity does not
+    over-count a freshly split per-iteration key.
+    """
+
+    iv: Interval = TOP
+    mant: int = 999
+    narrowed: Optional[str] = None
+    param_like: bool = False
+    max_of: Optional[int] = None  # src key of the var this is a max of
+    sum_of: Optional[int] = None  # src key of the var this is a sum of
+    src_id: Optional[int] = None  # original var of an elementwise copy chain
+    loop_varying: bool = False
+
+
+def _mk(iv: Interval, dtype: str, *, mant: Optional[int] = None, **kw) -> AbsVal:
+    m = dtype_mantissa(dtype)
+    base = m if m is not None else 999
+    return AbsVal(iv=iv, mant=min(base, mant if mant is not None else 999), **kw)
+
+
+# -- interval transfer functions --------------------------------------------
+
+
+def _exp(x: float) -> float:
+    try:
+        return math.exp(x)
+    except OverflowError:
+        return _INF
+
+
+def _corners(a: Interval, b: Interval, op) -> Interval:
+    vals = []
+    for x in (a.lo, a.hi):
+        for y in (b.lo, b.hi):
+            try:
+                v = op(x, y)
+            except (OverflowError, ValueError, ZeroDivisionError):
+                v = _INF
+            if isinstance(v, complex) or v != v:  # NaN corner -> unbounded
+                return Interval(-_INF, _INF, a.known and b.known)
+            vals.append(v)
+    return Interval(min(vals), max(vals), a.known and b.known)
+
+
+def _mono(a: Interval, f, lo_domain: Optional[float] = None) -> Interval:
+    """Image of a monotone-increasing ``f``; ``lo_domain`` clamps the
+    evaluation (log/rsqrt domains)."""
+    lo, hi = a.lo, a.hi
+    if lo_domain is not None:
+        lo = max(lo, lo_domain)
+        hi = max(hi, lo_domain)
+    try:
+        flo = f(lo)
+    except (ValueError, OverflowError, ZeroDivisionError):
+        flo = -_INF
+    try:
+        fhi = f(hi)
+    except (ValueError, OverflowError, ZeroDivisionError):
+        fhi = _INF
+    return Interval(min(flo, fhi), max(flo, fhi), a.known)
+
+
+def _reduce_axis_len(eqn) -> int:
+    """Number of elements folded per output element of a reduce eqn
+    (``axes`` on the named reduces, ``dimensions`` on generic ``reduce``)."""
+    axes = eqn.params.get("axes")
+    if axes is None:
+        axes = eqn.params.get("dimensions")
+    shape = tuple(getattr(getattr(eqn.invars[0], "aval", None), "shape", ()) or ())
+    if axes is None:
+        return max(1, _prod(shape))
+    k = 1
+    for ax in axes:
+        if 0 <= ax < len(shape):
+            k *= int(shape[ax])
+    return max(1, k)
+
+
+def _reduce_kind(eqn) -> str:
+    """The fold of a generic ``reduce`` eqn: "add", "max", "min", or ""
+    (unrecognised) — read from its single-eqn computation jaxpr."""
+    comp = eqn.params.get("jaxpr")
+    jaxpr = getattr(comp, "jaxpr", comp)
+    eqns = list(getattr(jaxpr, "eqns", ()) or ())
+    if len(eqns) == 1 and eqns[0].primitive.name in ("add", "max", "min", "mul"):
+        return eqns[0].primitive.name
+    return ""
+
+
+def _prod(it) -> int:
+    out = 1
+    for v in it:
+        out *= int(v)
+    return out
+
+
+def contraction_length(eqn) -> int:
+    """K of a ``dot_general`` (product of contracted dims)."""
+    (lc, _), _ = eqn.params["dimension_numbers"]
+    lhs = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+    return max(1, _prod(lhs[i] for i in lc))
+
+
+# -- facts ------------------------------------------------------------------
+
+
+@dataclass
+class OpFact:
+    """One interpreted equation — everything the TPU6xx rules consume."""
+
+    primitive: str
+    eqn: Any
+    scope: int  # id() of the enclosing jaxpr
+    mult: int  # scan trip multiplier (1 outside loops)
+    in_vals: list[AbsVal]
+    out_vals: list[AbsVal]
+    in_dtypes: list[str]
+    out_dtypes: list[str]
+    #: per-invar: True when the operand binding changes per loop iteration
+    in_loop_varying: list[bool]
+    #: key-consumption bookkeeping: ids of the AbsVal objects consumed
+    in_ids: list[int]
+    #: extra per-primitive detail (reduce axis length, collective axes, ...)
+    detail: dict = field(default_factory=dict)
+
+
+# -- the interpreter --------------------------------------------------------
+
+_PASS_THROUGH = frozenset(
+    {
+        "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+        "dynamic_slice", "rev", "copy", "stop_gradient", "reduce_precision",
+        "expand_dims", "device_put", "sharding_constraint", "real", "imag",
+    }
+)
+_JOIN_ALL = frozenset({"concatenate", "pad", "dynamic_update_slice", "scatter", "scatter-add", "gather", "select_n", "clamp", "where"})
+_IDENT_COLLECTIVES = frozenset({"pmax", "pmin", "all_gather", "all_to_all", "ppermute", "pshuffle", "psum_scatter", "reduce_scatter"})
+_SAFE_KEY_PRIMS = frozenset(
+    {"random_split", "random_fold_in", "random_wrap", "random_unwrap",
+     "broadcast_in_dim", "reshape", "slice", "squeeze", "transpose",
+     "copy", "device_put", "convert_element_type", "dynamic_slice"}
+)
+_CMP_PRIMS = frozenset({"eq", "ne", "lt", "le", "gt", "ge", "is_finite"})
+
+_CALL_PRIM_JAXPR_KEYS = {
+    "pjit": "jaxpr",
+    "closed_call": "call_jaxpr",
+    "core_call": "call_jaxpr",
+    "xla_call": "call_jaxpr",
+    "remat": "jaxpr",
+    "remat2": "jaxpr",
+    "checkpoint": "jaxpr",
+    "custom_jvp_call": "call_jaxpr",
+    "custom_vjp_call": "call_jaxpr",
+    "custom_vjp_call_jaxpr": "fun_jaxpr",
+    "shard_map": "jaxpr",
+    "custom_partitioning": "call",
+}
+
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal"
+
+
+def _var_dtype(v) -> str:
+    return str(getattr(getattr(v, "aval", None), "dtype", ""))
+
+
+def _numeric_interval(value) -> Optional[tuple[Interval, str]]:
+    """(interval, dtype) of a concrete array/scalar, or None. Extended
+    dtypes (bf16/fp8 via ml_dtypes register numpy kind 'V') are read
+    through an f64 view; non-numeric payloads (PRNG keys) return None."""
+    try:
+        import numpy as np
+
+        arr = np.asarray(value)
+        if not arr.size:
+            return None
+        dtype = str(arr.dtype)
+        as_f64 = arr.astype(np.float64)
+        return Interval(float(as_f64.min()), float(as_f64.max())), dtype
+    except Exception:
+        return None
+
+
+def _literal_interval(v) -> Interval:
+    got = _numeric_interval(getattr(v, "val", None))
+    return got[0] if got else TOP
+
+
+def _const_absval(const) -> AbsVal:
+    got = _numeric_interval(const)
+    if got is None:
+        return AbsVal()
+    return _mk(got[0], got[1])
+
+
+class NumericsInterpreter:
+    """Abstract interpreter over one closed jaxpr. ``run`` walks the
+    program and fills ``self.facts``; sub-computations recurse with the
+    caller's abstract values; scan/while carries run to a widening
+    fixpoint before the fact-collecting pass."""
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self.facts: list[OpFact] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def _axis_size(self, params: dict) -> int:
+        from .jaxpr_lint import _axis_names_in_params
+
+        n = 1
+        shape = dict(self.mesh.shape) if self.mesh is not None else {}
+        for a in _axis_names_in_params(params):
+            n *= int(shape.get(a, 1))
+        return max(1, n)
+
+    def _read(self, v, env: dict) -> AbsVal:
+        if _is_literal(v):
+            return _mk(_literal_interval(v), _var_dtype(v))
+        return env.get(v, AbsVal())
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self, closed, in_vals: Sequence[AbsVal]) -> list[AbsVal]:
+        from .flightcheck import _main_jaxpr
+
+        jaxpr = _main_jaxpr(closed)
+        env: dict = {}
+        consts = list(getattr(closed, "consts", ()) or ())
+        for cv, const in zip(jaxpr.constvars, consts):
+            env[cv] = _const_absval(const)
+        for cv in jaxpr.constvars:
+            env.setdefault(cv, AbsVal())
+        vals = list(in_vals)
+        for i, v in enumerate(jaxpr.invars):
+            env[v] = vals[i] if i < len(vals) else AbsVal()
+        return self._run_jaxpr(jaxpr, env, mult=1, collect=True)
+
+    # -- the walk ----------------------------------------------------------
+
+    def _run_jaxpr(self, jaxpr, env: dict, *, mult: int, collect: bool) -> list[AbsVal]:
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, env, mult=mult, collect=collect, scope=id(jaxpr))
+        return [self._read(v, env) for v in jaxpr.outvars]
+
+    def _enter_sub(self, sub, call_in_vals: Sequence[AbsVal], *, mult: int, collect: bool) -> list[AbsVal]:
+        """Interpret a sub-(Closed)Jaxpr with the caller's values."""
+        jaxpr = getattr(sub, "jaxpr", sub)
+        consts = list(getattr(sub, "consts", ()) or ())
+        env: dict = {}
+        for cv, const in zip(jaxpr.constvars, consts):
+            env[cv] = _const_absval(const)
+        for cv in jaxpr.constvars:
+            env.setdefault(cv, AbsVal())
+        vals = list(call_in_vals)
+        for i, v in enumerate(jaxpr.invars):
+            env[v] = vals[i] if i < len(vals) else AbsVal()
+        return self._run_jaxpr(jaxpr, env, mult=mult, collect=collect)
+
+    def _eqn(self, eqn, env: dict, *, mult: int, collect: bool, scope: int = 0):
+        name = eqn.primitive.name
+        in_vals = [self._read(v, env) for v in eqn.invars]
+
+        if name in _CALL_PRIM_JAXPR_KEYS:
+            sub = self._call_sub(eqn)
+            if sub is not None and len(getattr(sub, "jaxpr", sub).invars) == len(eqn.invars):
+                out_vals = self._enter_sub(sub, in_vals, mult=mult, collect=collect)
+            else:
+                out_vals = [AbsVal() for _ in eqn.outvars]
+        elif name == "scan":
+            out_vals = self._scan(eqn, in_vals, env, mult=mult, collect=collect)
+        elif name == "while":
+            out_vals = self._while(eqn, in_vals, env, mult=mult, collect=collect)
+        elif name == "cond":
+            out_vals = self._cond(eqn, in_vals, env, mult=mult, collect=collect)
+        else:
+            out_vals = self._transfer(eqn, in_vals, env)
+
+        out_vals = list(out_vals)
+        while len(out_vals) < len(eqn.outvars):
+            out_vals.append(AbsVal())
+        # loop-variance is contagious: anything computed from a
+        # per-iteration binding varies per iteration too (what keeps a
+        # fold_in-derived key from counting as scan-trip key reuse)
+        if name not in ("scan", "while") and any(v.loop_varying for v in in_vals):
+            for av in out_vals:
+                av.loop_varying = True
+        for v, av in zip(eqn.outvars, out_vals):
+            env[v] = av
+        # call-like eqns are interpreted transparently (their bodies emit
+        # the facts) — recording them too would double-count key
+        # consumption through jax's nested pjit sampler wrappers
+        if collect and name not in _CALL_PRIM_JAXPR_KEYS and name not in ("scan", "while", "cond"):
+            self._record(eqn, in_vals, out_vals, mult, scope)
+
+    def _record(self, eqn, in_vals, out_vals, mult, scope=0):
+        detail: dict = {}
+        name = eqn.primitive.name
+        if name in ("reduce_sum", "cumsum", "reduce_max", "reduce_min", "reduce_prod"):
+            detail["axis_len"] = _reduce_axis_len(eqn)
+        elif name == "reduce":
+            detail["axis_len"] = _reduce_axis_len(eqn)
+            detail["reduce_kind"] = _reduce_kind(eqn)
+        elif name == "dot_general":
+            detail["axis_len"] = contraction_length(eqn)
+            detail["preferred"] = str(eqn.params.get("preferred_element_type", "") or "")
+        elif name in ("psum", "pmean") or name in _IDENT_COLLECTIVES:
+            from .jaxpr_lint import _axis_names_in_params
+
+            detail["axes"] = tuple(_axis_names_in_params(eqn.params))
+            detail["group"] = self._axis_size(eqn.params)
+        self.facts.append(
+            OpFact(
+                primitive=name,
+                eqn=eqn,
+                scope=scope,
+                mult=mult,
+                in_vals=in_vals,
+                out_vals=out_vals,
+                in_dtypes=[_var_dtype(v) for v in eqn.invars],
+                out_dtypes=[_var_dtype(o) for o in eqn.outvars],
+                in_loop_varying=[av.loop_varying for av in in_vals],
+                in_ids=[id(av) for av in in_vals],
+                detail=detail,
+            )
+        )
+
+    def _call_sub(self, eqn):
+        """The single body sub-jaxpr of a call-like eqn (None otherwise)."""
+        key = _CALL_PRIM_JAXPR_KEYS.get(eqn.primitive.name)
+        if key is None:
+            return None
+        return eqn.params.get(key)
+
+    # -- control flow ------------------------------------------------------
+
+    def _scan(self, eqn, in_vals, env, *, mult, collect):
+        p = eqn.params
+        body = p["jaxpr"]
+        jaxpr = getattr(body, "jaxpr", body)
+        nc, ncarry = int(p.get("num_consts", 0)), int(p.get("num_carry", 0))
+        length = int(p.get("length", 1) or 1)
+        consts = in_vals[:nc]
+        carry = list(in_vals[nc : nc + ncarry])
+        xs = in_vals[nc + ncarry :]
+        # per-iteration slices of xs: same interval, loop-varying binding
+        x_slices = [
+            AbsVal(iv=av.iv, mant=av.mant, narrowed=av.narrowed, loop_varying=True) for av in xs
+        ]
+
+        def body_out(carry_vals, do_collect):
+            cins = list(consts) + [
+                AbsVal(iv=c.iv, mant=c.mant, narrowed=c.narrowed, loop_varying=True)
+                for c in carry_vals
+            ] + x_slices
+            return self._enter_sub(body, cins, mult=mult * length, collect=do_collect)
+
+        carry = self._fixpoint(carry, lambda c: body_out(c, False)[:ncarry])
+        outs = body_out(carry, collect)
+        return list(outs[:ncarry]) + [
+            AbsVal(iv=av.iv, mant=av.mant, narrowed=av.narrowed) for av in outs[ncarry:]
+        ]
+
+    def _while(self, eqn, in_vals, env, *, mult, collect):
+        p = eqn.params
+        cn, bn = int(p.get("cond_nconsts", 0)), int(p.get("body_nconsts", 0))
+        body = p["body_jaxpr"]
+        body_consts = in_vals[cn : cn + bn]
+        carry = list(in_vals[cn + bn :])
+
+        def body_out(carry_vals, do_collect):
+            cins = list(body_consts) + [
+                AbsVal(iv=c.iv, mant=c.mant, narrowed=c.narrowed, loop_varying=True)
+                for c in carry_vals
+            ]
+            return self._enter_sub(body, cins, mult=mult, collect=do_collect)
+
+        carry = self._fixpoint(carry, lambda c: body_out(c, False))
+        # the loop may run zero times: join the fixpoint body output with
+        # the initial carry values
+        init = in_vals[cn + bn :]
+        outs = body_out(carry, collect)
+        return [
+            AbsVal(
+                iv=a.iv.join(b.iv),
+                mant=min(a.mant, b.mant),
+                narrowed=a.narrowed or b.narrowed,
+            )
+            for a, b in zip(init, outs)
+        ]
+
+    def _fixpoint(self, carry: list[AbsVal], step) -> list[AbsVal]:
+        """Join-then-widen fixpoint on the carry intervals."""
+        for pass_no in range(MAX_FIXPOINT_PASSES):
+            new = step(carry)
+            joined = []
+            changed = False
+            for old, nxt in zip(carry, new):
+                iv = old.iv.join(nxt.iv)
+                if pass_no >= WIDEN_AFTER:
+                    iv = old.iv.widen(iv)
+                if iv != old.iv:
+                    changed = True
+                joined.append(
+                    AbsVal(
+                        iv=iv,
+                        mant=min(old.mant, nxt.mant),
+                        narrowed=old.narrowed or nxt.narrowed,
+                        param_like=old.param_like,
+                    )
+                )
+            carry = joined
+            if not changed:
+                return carry
+        return [AbsVal(iv=TOP, mant=c.mant, narrowed=c.narrowed) for c in carry]  # defensive top
+
+    def _cond(self, eqn, in_vals, env, *, mult, collect):
+        branches = eqn.params.get("branches", ())
+        operands = in_vals[1:]  # invars[0] is the predicate
+        per_branch = [
+            self._enter_sub(br, operands, mult=mult, collect=collect) for br in branches
+        ]
+        if not per_branch:
+            return [AbsVal() for _ in eqn.outvars]
+        out = per_branch[0]
+        for other in per_branch[1:]:
+            out = [
+                AbsVal(
+                    iv=a.iv.join(b.iv),
+                    mant=min(a.mant, b.mant),
+                    narrowed=a.narrowed or b.narrowed,
+                )
+                for a, b in zip(out, other)
+            ]
+        return out
+
+    # -- per-primitive transfer --------------------------------------------
+
+    def _transfer(self, eqn, in_vals: list[AbsVal], env: dict) -> list[AbsVal]:
+        name = eqn.primitive.name
+        out_dtype = _var_dtype(eqn.outvars[0]) if eqn.outvars else ""
+        a = in_vals[0] if in_vals else AbsVal()
+        b = in_vals[1] if len(in_vals) > 1 else AbsVal()
+        mant = min([v.mant for v in in_vals] or [999])
+        narrowed = next((v.narrowed for v in in_vals if v.narrowed), None)
+
+        def src_key(i: int = 0) -> Optional[int]:
+            """Identity of operand ``i``'s elementwise value chain."""
+            if i >= len(eqn.invars) or _is_literal(eqn.invars[i]):
+                return None
+            av = in_vals[i]
+            return av.src_id if av.src_id is not None else id(eqn.invars[i])
+
+        def out(iv: Interval, **kw) -> list[AbsVal]:
+            kw.setdefault("mant", mant)
+            return [
+                AbsVal(iv=iv, narrowed=kw.pop("narrowed", narrowed), **kw)
+                for _ in eqn.outvars
+            ] or [AbsVal(iv=iv)]
+
+        if name in _PASS_THROUGH:
+            return [
+                AbsVal(
+                    iv=a.iv, mant=a.mant, narrowed=a.narrowed,
+                    param_like=a.param_like, max_of=a.max_of, sum_of=a.sum_of,
+                    src_id=src_key(0), loop_varying=a.loop_varying,
+                )
+                for _ in eqn.outvars
+            ]
+        if name == "convert_element_type":
+            new_m = dtype_mantissa(out_dtype)
+            src_dtype = _var_dtype(eqn.invars[0]) if eqn.invars else ""
+            nrw = a.narrowed
+            if new_m is not None and new_m < a.mant and src_dtype in ("float32", "float64"):
+                nrw = out_dtype  # float quantized onto a narrower wire dtype
+            elif out_dtype in ("int8", "uint8") and src_dtype.startswith("float"):
+                nrw = out_dtype
+            return [
+                AbsVal(
+                    iv=a.iv,
+                    mant=min(a.mant, new_m if new_m is not None else 999),
+                    narrowed=nrw,
+                    param_like=a.param_like,
+                    max_of=a.max_of,
+                    sum_of=a.sum_of,
+                    src_id=src_key(0),
+                    loop_varying=a.loop_varying,
+                )
+                for _ in eqn.outvars
+            ]
+        if name == "add":
+            return out(_corners(a.iv, b.iv, lambda x, y: x + y))
+        if name == "sub":
+            # relational refinement: x - max(x) over any broadcast chain
+            if b.max_of is not None and b.max_of == src_key(0):
+                return out(Interval(min(0.0, a.iv.lo - a.iv.hi), 0.0, a.iv.known))
+            return out(_corners(a.iv, b.iv, lambda x, y: x - y))
+        if name == "mul":
+            if len(eqn.invars) > 1 and eqn.invars[0] is eqn.invars[1]:
+                sq = max(a.iv.lo * a.iv.lo, a.iv.hi * a.iv.hi)
+                low = 0.0 if a.iv.contains_zero else min(a.iv.lo * a.iv.lo, a.iv.hi * a.iv.hi)
+                return out(Interval(low, sq, a.iv.known))
+            return out(_corners(a.iv, b.iv, lambda x, y: x * y))
+        if name == "div":
+            # relational refinement: x / sum(x) with x >= 0 (softmax
+            # normalisation) is in [0, 1] — the sum includes the numerator
+            if b.sum_of is not None and b.sum_of == src_key(0) and a.iv.known and a.iv.lo >= 0.0:
+                return out(Interval(0.0, 1.0, a.iv.known and b.iv.known))
+            if b.iv.contains_zero:
+                return out(Interval(-_INF, _INF, a.iv.known and b.iv.known))
+            return out(_corners(a.iv, b.iv, lambda x, y: x / y))
+        if name in ("max", "maximum"):
+            return out(Interval(max(a.iv.lo, b.iv.lo), max(a.iv.hi, b.iv.hi), a.iv.known and b.iv.known))
+        if name in ("min", "minimum"):
+            return out(Interval(min(a.iv.lo, b.iv.lo), min(a.iv.hi, b.iv.hi), a.iv.known and b.iv.known))
+        if name == "neg":
+            return out(Interval(-a.iv.hi, -a.iv.lo, a.iv.known))
+        if name == "abs":
+            lo = 0.0 if a.iv.contains_zero else min(abs(a.iv.lo), abs(a.iv.hi))
+            return out(Interval(lo, a.iv.magnitude(), a.iv.known))
+        if name == "sign":
+            return out(Interval(-1.0, 1.0))
+        if name == "exp":
+            return out(_mono(a.iv, _exp))
+        if name in ("log", "log1p"):
+            shift = 1.0 if name == "log1p" else 0.0
+            if a.iv.lo + shift <= 0.0:
+                return out(Interval(-_INF, math.log(a.iv.hi + shift) if a.iv.hi + shift > 0 and math.isfinite(a.iv.hi) else _INF, a.iv.known))
+            return out(_mono(a.iv, lambda x: math.log(x + shift)))
+        if name == "sqrt":
+            return out(_mono(a.iv, math.sqrt, lo_domain=0.0))
+        if name == "rsqrt":
+            if a.iv.lo <= 0.0:
+                hi = _INF
+                lo = (1.0 / math.sqrt(a.iv.hi)) if a.iv.hi > 0 and math.isfinite(a.iv.hi) else 0.0
+                return out(Interval(lo, hi, a.iv.known))
+            return out(Interval(1.0 / math.sqrt(a.iv.hi), 1.0 / math.sqrt(a.iv.lo), a.iv.known))
+        if name == "tanh":
+            t = _mono(a.iv, math.tanh)
+            return out(Interval(max(-1.0, t.lo), min(1.0, t.hi), a.iv.known))
+        if name == "erf":
+            return out(Interval(-1.0, 1.0))
+        if name == "logistic":
+            return out(Interval(0.0, 1.0))
+        if name in ("sin", "cos"):
+            return out(Interval(-1.0, 1.0))
+        if name == "erf_inv":
+            return out(Interval(-_INF, _INF, a.iv.known))
+        if name == "integer_pow":
+            y = int(eqn.params.get("y", 2))
+            if y % 2 == 0:
+                hi = max(a.iv.lo**y, a.iv.hi**y) if a.iv.finite else _INF
+                lo = 0.0 if a.iv.contains_zero else min(abs(a.iv.lo), abs(a.iv.hi)) ** y
+                return out(Interval(lo, hi, a.iv.known))
+            return out(_mono(a.iv, lambda x: x**y))
+        if name == "pow":
+            return out(_corners(a.iv, b.iv, lambda x, y: x**y))
+        if name == "square":
+            hi = max(a.iv.lo**2, a.iv.hi**2) if a.iv.finite else _INF
+            lo = 0.0 if a.iv.contains_zero else min(abs(a.iv.lo), abs(a.iv.hi)) ** 2
+            return out(Interval(lo, hi, a.iv.known))
+        if name in ("reduce_sum", "cumsum"):
+            k = _reduce_axis_len(eqn)
+            res = out(_corners(a.iv, Interval(k, k), lambda x, y: x * y))
+            if name == "reduce_sum":
+                for av in res:
+                    av.sum_of = src_key(0)
+            return res
+        if name in ("reduce_max", "cummax"):
+            res = out(a.iv)
+            if name == "reduce_max":
+                for av in res:
+                    av.max_of = src_key(0)
+            return res
+        if name in ("reduce_min", "cummin"):
+            return out(a.iv)
+        if name == "reduce":  # generic lax.reduce with a computation jaxpr
+            kind = _reduce_kind(eqn)
+            if kind == "add":
+                k = _reduce_axis_len(eqn)
+                init = b.iv if len(in_vals) > 1 else Interval(0.0, 0.0)
+                acc = _corners(a.iv, Interval(k, k), lambda x, y: x * y)
+                return out(_corners(acc, init, lambda x, y: x + y))
+            if kind in ("max", "min"):
+                return out(a.iv)
+            return out(TOP)
+        if name == "reduce_prod":
+            k = _reduce_axis_len(eqn)
+            m = a.iv.magnitude()
+            try:
+                bound = m**k
+            except OverflowError:
+                bound = _INF
+            return out(Interval(-bound, bound, a.iv.known))
+        if name in ("argmax", "argmin"):
+            shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or (1,))
+            return out(Interval(0.0, float(max(1, _prod(shape)) - 1)))
+        if name == "psum":
+            n = self._axis_size(eqn.params)
+            return out(_corners(a.iv, Interval(n, n), lambda x, y: x * y))
+        if name == "pmean":
+            return out(a.iv)
+        if name in _IDENT_COLLECTIVES:
+            return [
+                AbsVal(iv=v.iv, mant=v.mant, narrowed=v.narrowed) for v in in_vals
+            ][: len(eqn.outvars)] or [AbsVal()]
+        if name == "axis_index":
+            return out(Interval(0.0, float(self._axis_size(eqn.params) - 1)))
+        if name == "iota":
+            shape = tuple(eqn.params.get("shape", ()) or (1,))
+            dim = int(eqn.params.get("dimension", 0) or 0)
+            n = int(shape[dim]) if 0 <= dim < len(shape) else max(1, _prod(shape))
+            return out(Interval(0.0, float(max(0, n - 1))))
+        if name in _CMP_PRIMS:
+            return out(Interval(0.0, 1.0))
+        if name in ("and", "or", "xor", "not"):
+            return out(Interval(0.0, 1.0) if out_dtype == "bool" else TOP)
+        if name in ("floor", "ceil", "round", "round_nearest_even", "nextafter"):
+            return out(Interval(a.iv.lo - 1.0, a.iv.hi + 1.0, a.iv.known) if a.iv.finite else a.iv)
+        if name == "clamp":  # clamp(lo, x, hi)
+            lo_v, x_v, hi_v = (in_vals + [AbsVal()] * 3)[:3]
+            return out(Interval(max(x_v.iv.lo, lo_v.iv.lo), min(x_v.iv.hi, hi_v.iv.hi), x_v.iv.known))
+        if name == "select_n":
+            cases = in_vals[1:] or [AbsVal()]
+            iv = cases[0].iv
+            m2 = min(c.mant for c in cases)
+            nrw = next((c.narrowed for c in cases if c.narrowed), None)
+            for c in cases[1:]:
+                iv = iv.join(c.iv)
+            return out(iv, mant=m2, narrowed=nrw)
+        if name in _JOIN_ALL:
+            arrays = [v for v in in_vals if v.iv is not None]
+            if not arrays:
+                return out(TOP)
+            iv = arrays[0].iv
+            for v in arrays[1:]:
+                iv = iv.join(v.iv)
+            return out(iv)
+        if name == "random_bits":
+            bits = int(eqn.params.get("bit_width", 32) or 32)
+            return out(Interval(0.0, float(2**bits - 1)))
+        if name in ("random_seed", "random_wrap"):
+            return [AbsVal() for _ in eqn.outvars]
+        if name == "mul_add":  # fused a*b+c on some backends
+            c = in_vals[2] if len(in_vals) > 2 else AbsVal()
+            return out(_corners(_corners(a.iv, b.iv, lambda x, y: x * y), c.iv, lambda x, y: x + y))
+        # unmodelled primitive: nothing proven about the value
+        return out(TOP)
+
+
+# -- report -----------------------------------------------------------------
+
+
+@dataclass
+class ValueRange:
+    """Interval + dtype of one program output (the report's summary rows)."""
+
+    describe: str
+    dtype: str
+    lo: float
+    hi: float
+    mant: int
+
+    def as_dict(self) -> dict:
+        def num(v):
+            return None if not math.isfinite(v) else v
+
+        return {
+            "describe": self.describe,
+            "dtype": self.dtype,
+            "lo": num(self.lo),
+            "hi": num(self.hi),
+            "effective_mantissa_bits": self.mant if self.mant < 999 else None,
+        }
+
+
+@dataclass
+class NumericsReport:
+    """Everything ``numerics_check`` learns about one step function."""
+
+    fn_name: str
+    mesh_axes: dict[str, int] = field(default_factory=dict)
+    assume: tuple = DEFAULT_ASSUME
+    outputs: list[ValueRange] = field(default_factory=list)
+    n_eqns: int = 0
+    n_low_precision_ops: int = 0
+    n_casts: int = 0
+    findings: list[Finding] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.is_error for f in self.findings)
+
+    def as_dict(self) -> dict:
+        return {
+            "fn": self.fn_name,
+            "mesh": dict(self.mesh_axes),
+            "assume": list(self.assume),
+            "eqns_interpreted": self.n_eqns,
+            "low_precision_ops": self.n_low_precision_ops,
+            "casts": self.n_casts,
+            "outputs": [o.as_dict() for o in self.outputs],
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+    def render_text(self) -> str:
+        mesh = ", ".join(f"{a}={n}" for a, n in self.mesh_axes.items() if n > 1) or "1 device"
+        lines = [
+            f"numerics-check: {self.fn_name} on mesh ({mesh}), inputs assumed in "
+            f"[{self.assume[0]:g}, {self.assume[1]:g}]",
+            f"  equations interpreted : {self.n_eqns}"
+            f"  (low-precision {self.n_low_precision_ops}, casts {self.n_casts})",
+        ]
+        if self.outputs:
+            lines.append("  output value intervals:")
+            for o in self.outputs:
+                fmt = lambda v: "-inf" if v == -_INF else "inf" if v == _INF else f"{v:.6g}"
+                mant = f"  ({o.mant}-bit effective mantissa)" if o.mant < 999 else ""
+                lines.append(f"    {o.describe:<22} {o.dtype:<14} [{fmt(o.lo)}, {fmt(o.hi)}]{mant}")
+        if self.findings:
+            from .report import format_finding
+
+            lines.append("  findings:")
+            lines.extend(f"    {format_finding(f)}" for f in self.findings)
+        else:
+            lines.append("  findings: none")
+        return "\n".join(lines)
+
+
+def _describe(aval) -> str:
+    from .flightcheck import _describe as d
+
+    return d(aval)
+
+
+# -- entry point ------------------------------------------------------------
+
+
+def _input_absvals(closed, sample_args, assume) -> list[AbsVal]:
+    """One AbsVal per flattened invar: float leaves get the assumed
+    interval (per-argument overrides via an ``assume`` sequence matched
+    to flattened leaf order), ints get their dtype range, keys get TOP."""
+    from .flightcheck import _main_jaxpr
+
+    jaxpr = _main_jaxpr(closed)
+    if assume is None:
+        assume = DEFAULT_ASSUME
+    per_leaf: list = []
+    if assume and isinstance(assume[0], (tuple, list)):
+        per_leaf = [tuple(a) for a in assume]
+        default = DEFAULT_ASSUME
+    else:
+        default = (float(assume[0]), float(assume[1]))
+    out: list[AbsVal] = []
+    for i, v in enumerate(jaxpr.invars):
+        dtype = _var_dtype(v)
+        rng = per_leaf[i] if i < len(per_leaf) else default
+        if dtype.startswith("float") or dtype == "bfloat16":
+            av = _mk(Interval(float(rng[0]), float(rng[1])), dtype)
+            av.param_like = True
+            out.append(av)
+        elif dtype.startswith(("int", "uint")) or dtype == "bool":
+            out.append(_mk(TOP, dtype))
+        else:  # PRNG keys, opaque dtypes
+            out.append(AbsVal())
+    return out
+
+
+def numerics_check(
+    fn,
+    *sample_args: Any,
+    mesh=None,
+    assume: Any = None,
+    select: Optional[Sequence[str]] = None,
+    ignore: Sequence[str] = (),
+    rules: bool = True,
+) -> NumericsReport:
+    """Trace ``fn(*sample_args)`` abstractly, interpret the jaxpr over
+    the interval + dtype-provenance domain, and return a
+    :class:`NumericsReport` — output value intervals plus the TPU601–606
+    findings. Same calling convention as
+    :func:`~accelerate_tpu.analysis.flightcheck.flight_check`;
+    ``assume=(lo, hi)`` (or a per-flattened-leaf sequence of pairs) sets
+    the input value assumption the proofs are relative to."""
+    if mesh is None:
+        from ..parallel.sharding import context_mesh
+
+        mesh = context_mesh()
+    if mesh is None:
+        raise ValueError("numerics_check needs a mesh (pass mesh=... or enter parallel.sharding.mesh_context)")
+
+    from .jaxpr_lint import _trace
+
+    name = getattr(fn, "__name__", "step_fn")
+    closed, findings = _trace(fn, sample_args, mesh)
+    norm_assume = tuple(assume) if (assume and not isinstance(assume[0], (tuple, list))) else DEFAULT_ASSUME
+    report = NumericsReport(fn_name=name, mesh_axes=dict(mesh.shape), assume=norm_assume)
+    if closed is not None:
+        from .flightcheck import _main_jaxpr
+
+        interp = NumericsInterpreter(mesh)
+        in_vals = _input_absvals(closed, sample_args, assume)
+        out_vals = interp.run(closed, in_vals)
+        jaxpr = _main_jaxpr(closed)
+        report.n_eqns = len(interp.facts)
+        report.n_low_precision_ops = sum(
+            1 for f in interp.facts
+            if any(d in LOW_PRECISION_FLOATS for d in f.out_dtypes)
+        )
+        report.n_casts = sum(1 for f in interp.facts if f.primitive == "convert_element_type")
+        for v, av in zip(jaxpr.outvars, out_vals):
+            aval = getattr(v, "aval", None)
+            report.outputs.append(
+                ValueRange(
+                    describe=_describe(aval),
+                    dtype=_var_dtype(v),
+                    lo=av.iv.lo if av.iv.known else -_INF,
+                    hi=av.iv.hi if av.iv.known else _INF,
+                    mant=av.mant,
+                )
+            )
+        if rules:
+            from .numerics_rules import check_numerics_rules
+
+            findings = findings + check_numerics_rules(interp.facts, mesh)
+    from .perfmodel import _apply_inline_suppressions
+
+    findings = _apply_inline_suppressions(findings)
+    report.findings = filter_findings(findings, select=select, ignore=ignore)
+    return report

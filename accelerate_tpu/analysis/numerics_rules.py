@@ -1,0 +1,533 @@
+"""TPU6xx numerics & precision rules over the interval +
+dtype-provenance interpretation (``analysis.numerics``).
+
+Where TPU1xx–4xx prove a program is *correct* and TPU5xx that it is
+*fast*, these prove its arithmetic will not silently diverge a run.
+Every finding prices its impact — a relative-error bound, an overflow
+margin, or a lost-update ulp — so the report reads as a numerics budget,
+not a style nit:
+
+* ``TPU601`` — low-precision accumulation over a long reduction or
+  contraction axis: a bf16/fp16/fp8 ``reduce_sum``/``cumsum``/
+  ``dot_general`` whose accumulator stays in the input dtype (no f32
+  ``preferred_element_type``) over ``K >=`` :data:`TPU601_MIN_AXIS`
+  elements. Worst-case relative error of a sequential same-sign sum is
+  ``~K·eps/2`` — priced in the message. (``jnp.sum``/``mean`` upcast to
+  f32 on their own; this fires on explicitly forced low-precision
+  accumulation and on low-precision dots.)
+* ``TPU602`` — **provable overflow** (error severity, the strict gate):
+  a value whose interval — derived from the stated input assumptions —
+  exceeds the finite max of its fp16/fp8 dtype. An un-max-subtracted
+  softmax is the canonical case (``exp([-16,16])`` tops out at ``8.9e6``
+  against fp16's 65504); the max-subtracted twin is *proven* safe by the
+  relational ``x - max(x) ∈ [lo-hi, 0]`` refinement. Only fires when
+  every operand bound is finite and known, so one unguarded op cannot
+  cascade into a wall of findings.
+* ``TPU603`` — unguarded singularity: ``div``/``log``/``rsqrt`` whose
+  (known) operand interval contains 0. Epsilon guards are recognised
+  naturally — ``maximum(x, eps)`` moves the interval off zero.
+* ``TPU604`` — mixed-precision weight update below the ulp of the param
+  dtype: ``p ± u`` in bf16/fp16 where the update's largest possible
+  magnitude is under ``eps/2`` of the param's scale — every update
+  rounds away and training silently stalls. Fires only when the param
+  operand is (derived 1:1 from) a program input, so epsilon-guards on
+  intermediates stay clean. Fix: keep f32 master weights.
+* ``TPU605`` — PRNG key reuse: one key consumed by two or more random
+  draws without a ``jax.random.split``/``fold_in`` (jaxpr tier: counted
+  per abstract value with scan-trip multiplicity, so a key captured by a
+  multi-iteration loop body fires too; AST tier:
+  :func:`check_key_reuse_source`). The draws are bit-identical — wired
+  to the ``utils.random.key_for_step`` discipline.
+* ``TPU606`` — compressed/quantized collective without error feedback: a
+  ``psum``/``all_to_all``/``all_gather`` whose operand was narrowed from
+  a wider float onto the wire dtype (bf16/fp16/fp8/int8), with no
+  residual (``original - quantized``) computed anywhere in the program.
+  The per-leaf quantization-error bound is priced à la EQuARX from
+  :data:`COMPRESSION_NUMERICS`; PowerSGD's f32 factor reduction and any
+  scheme that carries the residual stay clean.
+
+All findings anchor to the user source line that created the op, so
+inline ``# tpu-lint: disable`` comments, ``.tpulint.toml`` suppressions,
+and SARIF locations all work.
+
+jax is imported lazily; the rules are pure functions of the fact stream.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+from dataclasses import dataclass
+from typing import Callable
+
+from .numerics import (
+    LOW_PRECISION_FLOATS,
+    NARROW_RANGE_DTYPES,
+    OpFact,
+    dtype_eps,
+    dtype_max,
+)
+from .perfmodel import eqn_path_line
+from .rules import Finding
+
+#: TPU601 fires when a low-precision accumulation folds at least this
+#: many elements per output element.
+TPU601_MIN_AXIS = 256
+#: TPU604 fires when the update's max magnitude is below eps/2 of the
+#: param's scale (the round-to-nearest threshold at which p +- u == p).
+TPU604_ULP_FRACTION = 0.5
+
+_REDUCE_ACCUM_PRIMS = ("reduce_sum", "cumsum")
+_WIRE_COLLECTIVES = ("psum", "pmean", "all_to_all", "all_gather", "psum_scatter", "reduce_scatter")
+
+
+def _loc(eqn) -> str:
+    from .jaxpr_lint import _eqn_location
+
+    return _eqn_location(eqn).strip()
+
+
+def _finding(rule: str, eqn, message: str) -> Finding:
+    path, line = eqn_path_line(eqn)
+    return Finding(rule, message, path=path, line=line)
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "inf"
+    if v == -math.inf:
+        return "-inf"
+    return f"{v:.4g}"
+
+
+def _iv_str(iv) -> str:
+    return f"[{_fmt(iv.lo)}, {_fmt(iv.hi)}]"
+
+
+# -- TPU601: low-precision accumulation ------------------------------------
+
+
+def check_low_precision_accumulation(facts: list[OpFact]) -> list[Finding]:
+    findings = []
+    seen = set()
+    for f in facts:
+        k = f.detail.get("axis_len", 0)
+        if k < TPU601_MIN_AXIS:
+            continue
+        if f.primitive in _REDUCE_ACCUM_PRIMS or (
+            f.primitive == "reduce" and f.detail.get("reduce_kind") == "add"
+        ):
+            in_dt = f.in_dtypes[0] if f.in_dtypes else ""
+            out_dt = f.out_dtypes[0] if f.out_dtypes else ""
+            if in_dt not in LOW_PRECISION_FLOATS or out_dt not in LOW_PRECISION_FLOATS:
+                continue
+            acc_dt = out_dt
+        elif f.primitive == "dot_general":
+            in_dt = f.in_dtypes[0] if f.in_dtypes else ""
+            out_dt = f.out_dtypes[0] if f.out_dtypes else ""
+            if in_dt not in LOW_PRECISION_FLOATS or out_dt not in LOW_PRECISION_FLOATS:
+                continue
+            acc_dt = f.detail.get("preferred") or out_dt
+            if acc_dt not in LOW_PRECISION_FLOATS:
+                continue
+        else:
+            continue
+        eps = dtype_eps(acc_dt) or 0.0
+        bound = k * eps / 2.0
+        key = (f.primitive, _loc(f.eqn), k)
+        if key in seen:
+            continue
+        seen.add(key)
+        kind = "contraction" if f.primitive == "dot_general" else "reduction"
+        findings.append(
+            _finding(
+                "TPU601",
+                f.eqn,
+                f"{f.primitive} accumulates in {acc_dt} over a {kind} axis of "
+                f"{k} elements {_loc(f.eqn)}: worst-case relative error "
+                f"~K*eps/2 = {bound:.3g} (eps({acc_dt})=2^-{int(-math.log2(eps))}) — "
+                "accumulate in f32 (preferred_element_type=jnp.float32, or sum "
+                "with dtype=jnp.float32) and narrow once at the end",
+            )
+        )
+    return findings
+
+
+# -- TPU602: provable fp16/fp8 overflow ------------------------------------
+
+
+def check_provable_overflow(facts: list[OpFact]) -> list[Finding]:
+    findings = []
+    seen = set()
+    for f in facts:
+        out_dt = f.out_dtypes[0] if f.out_dtypes else ""
+        if out_dt not in NARROW_RANGE_DTYPES:
+            continue
+        # only prove from known, finite operand bounds — an upstream
+        # unguarded div (already reported) must not cascade
+        if not f.in_vals or not all(v.iv.finite for v in f.in_vals):
+            continue
+        ov = f.out_vals[0] if f.out_vals else None
+        if ov is None or not ov.iv.known:
+            continue
+        mag = ov.iv.magnitude()
+        dmax = dtype_max(out_dt) or math.inf
+        if mag <= dmax:
+            continue
+        margin = mag / dmax if math.isfinite(mag) else math.inf
+        loc = _loc(f.eqn)
+        key = (f.primitive, loc, out_dt)
+        if key in seen:
+            continue
+        seen.add(key)
+        hint = ""
+        if f.primitive == "exp":
+            hint = " — subtract the running max before exp (softmax/logsumexp style)"
+        elif f.primitive in ("mul", "integer_pow", "square"):
+            hint = " — compute the product/square in f32 and narrow the result"
+        elif f.primitive == "convert_element_type":
+            hint = " — rescale (or clip) before narrowing"
+        findings.append(
+            _finding(
+                "TPU602",
+                f.eqn,
+                f"{f.primitive} produces {out_dt} values in {_iv_str(ov.iv)} "
+                f"{loc}: provably exceeds {out_dt} max {_fmt(dmax)} by "
+                f"{_fmt(margin)}x under the stated input assumptions — overflow "
+                f"saturates to inf and poisons everything downstream{hint}",
+            )
+        )
+    return findings
+
+
+# -- TPU603: unguarded singularities ---------------------------------------
+
+
+def check_unguarded_singularity(facts: list[OpFact]) -> list[Finding]:
+    findings = []
+    seen = set()
+    for f in facts:
+        prim = f.primitive
+        if prim == "div":
+            operand = f.in_vals[1] if len(f.in_vals) > 1 else None
+            bad = operand is not None and operand.iv.known and operand.iv.contains_zero
+            what = "denominator"
+        elif prim in ("log", "log1p"):
+            operand = f.in_vals[0] if f.in_vals else None
+            shift = 1.0 if prim == "log1p" else 0.0
+            bad = operand is not None and operand.iv.known and operand.iv.lo + shift <= 0.0
+            what = "operand"
+        elif prim == "rsqrt":
+            operand = f.in_vals[0] if f.in_vals else None
+            bad = operand is not None and operand.iv.known and operand.iv.lo <= 0.0
+            what = "operand"
+        else:
+            continue
+        if not bad or not math.isfinite(operand.iv.lo) or not math.isfinite(operand.iv.hi):
+            continue
+        loc = _loc(f.eqn)
+        key = (prim, loc)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(
+            _finding(
+                "TPU603",
+                f.eqn,
+                f"{prim} {loc}: {what} interval {_iv_str(operand.iv)} contains 0 — "
+                "the result is unbounded (inf/NaN for a representable input); guard "
+                "with jnp.maximum(x, eps) or add an epsilon before the singularity",
+            )
+        )
+    return findings
+
+
+# -- TPU604: weight update below the param ulp -----------------------------
+
+
+def check_update_below_ulp(facts: list[OpFact]) -> list[Finding]:
+    findings = []
+    seen = set()
+    for f in facts:
+        if f.primitive not in ("add", "sub"):
+            continue
+        out_dt = f.out_dtypes[0] if f.out_dtypes else ""
+        if out_dt not in ("bfloat16", "float16"):
+            continue
+        if len(f.in_vals) < 2:
+            continue
+        a, b = f.in_vals[0], f.in_vals[1]
+        # identify the param operand: derived 1:1 from a program input
+        if a.param_like and not b.param_like:
+            p, u = a, b
+        elif b.param_like and not a.param_like:
+            p, u = b, a
+        else:
+            continue
+        if not (p.iv.finite and u.iv.finite):
+            continue
+        p_mag, u_mag = p.iv.magnitude(), u.iv.magnitude()
+        if p_mag <= 0.0 or u_mag <= 0.0:
+            continue
+        eps = dtype_eps(out_dt) or 0.0
+        threshold = TPU604_ULP_FRACTION * eps * p_mag
+        if u_mag >= threshold:
+            continue
+        loc = _loc(f.eqn)
+        key = (loc, out_dt)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(
+            _finding(
+                "TPU604",
+                f.eqn,
+                f"{out_dt} weight update {loc}: largest possible update magnitude "
+                f"{_fmt(u_mag)} is below the round-off threshold eps/2*|p| = "
+                f"{_fmt(threshold)} at the params' scale (eps({out_dt})=2^-"
+                f"{int(-math.log2(eps))+1}) — the update rounds away entirely and "
+                "training silently stalls; keep f32 master weights and cast to "
+                f"{out_dt} for compute only",
+            )
+        )
+    return findings
+
+
+# -- TPU605: PRNG key reuse (jaxpr tier) -----------------------------------
+
+_KEY_SAFE_PRIMS = frozenset(
+    {"random_split", "random_fold_in", "random_wrap", "random_unwrap",
+     "broadcast_in_dim", "reshape", "slice", "squeeze", "transpose",
+     "copy", "device_put", "dynamic_slice", "concatenate"}
+)
+
+
+def _is_key_dtype(dtype: str) -> bool:
+    return dtype.startswith("key<") or dtype.startswith("prngkey")
+
+
+def check_key_reuse(facts: list[OpFact]) -> list[Finding]:
+    """A key AbsVal consumed by >= 2 random draws (scan-trip multiplicity
+    counted for loop-invariant keys) without an intervening split."""
+    consumption: dict[int, int] = {}
+    second_site: dict[int, OpFact] = {}
+    for f in facts:
+        if f.primitive in _KEY_SAFE_PRIMS:
+            continue
+        for i, dt in enumerate(f.in_dtypes):
+            if not _is_key_dtype(dt):
+                continue
+            uid = f.in_ids[i] if i < len(f.in_ids) else None
+            if uid is None:
+                continue
+            weight = 1 if (i < len(f.in_loop_varying) and f.in_loop_varying[i]) else max(1, f.mult)
+            prev = consumption.get(uid, 0)
+            consumption[uid] = prev + weight
+            if prev < 2 <= consumption[uid] and uid not in second_site:
+                second_site[uid] = f
+    findings = []
+    for uid, f in second_site.items():
+        n = consumption[uid]
+        loop_note = (
+            " (consumed once per loop iteration with the same value)" if f.mult > 1 else ""
+        )
+        findings.append(
+            _finding(
+                "TPU605",
+                f.eqn,
+                f"the same PRNG key is consumed by {n} random draws{loop_note} "
+                f"{_loc(f.eqn)} without a split — the streams are bit-identical "
+                "(zero fresh entropy); derive one key per draw with "
+                "jax.random.split / jax.random.fold_in (the "
+                "utils.random.key_for_step discipline)",
+            )
+        )
+    return findings
+
+
+# -- TPU605: PRNG key reuse (AST tier) -------------------------------------
+
+_SAMPLER_FNS = frozenset(
+    {"normal", "uniform", "bernoulli", "categorical", "gumbel", "bits",
+     "randint", "truncated_normal", "laplace", "exponential", "poisson",
+     "permutation", "choice", "dirichlet", "beta", "gamma", "cauchy",
+     "rademacher", "ball", "orthogonal", "loggamma", "t"}
+)
+_KEY_DERIVE_FNS = frozenset({"split", "fold_in", "clone", "key", "PRNGKey", "key_for_step"})
+
+
+def check_key_reuse_source(source: str, path: str = "<string>") -> list[Finding]:
+    """AST tier of TPU605: within one function, the same *name* passed as
+    the key argument to two or more ``jax.random`` samplers without being
+    rebound (split/fold_in) in between."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []
+    findings: list[Finding] = []
+
+    for func in ast.walk(tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        uses: dict[str, list[int]] = {}
+        rebound: set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            rebound.add(n.id)
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (fn.id if isinstance(fn, ast.Name) else "")
+            if attr not in _SAMPLER_FNS:
+                continue
+            # jax.random.<sampler>(key, ...) — the key is the first arg
+            # (or the `key=` keyword)
+            key_node = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "key"), None
+            )
+            if isinstance(key_node, ast.Name):
+                uses.setdefault(key_node.id, []).append(node.lineno)
+        for name, lines in uses.items():
+            if len(lines) >= 2 and name not in rebound:
+                findings.append(
+                    Finding(
+                        "TPU605",
+                        f"key {name!r} is passed to {len(lines)} jax.random draws "
+                        f"(lines {', '.join(str(n) for n in lines)}) in "
+                        f"{func.name!r} without a split — the draws are "
+                        "bit-identical; split the key (jax.random.split) or fold "
+                        "in a counter (utils.random.key_for_step)",
+                        path=path,
+                        line=lines[1],
+                    )
+                )
+    return findings
+
+
+# -- TPU606: compressed collectives + the numerics-model registry ----------
+
+
+@dataclass(frozen=True)
+class CompressionNumerics:
+    """The numerics model one compression method ships with: the wire
+    dtype, whether the scheme carries error feedback, and a per-leaf
+    absolute error bound for the *mean*-reduced result (à la EQuARX) as a
+    function of ``(amax, n_shards)``."""
+
+    method: str
+    wire_dtype: str
+    error_feedback: bool
+    bound: Callable[[float, int], float]
+    describe: str
+
+
+#: every public compression entry point must carry a numerics model —
+#: enforced by the coverage test in tests/test_numerics.py (the
+#: COLLECTIVE_EFFECTS pattern applied to numerics instead of divergence).
+COMPRESSION_NUMERICS: dict[str, CompressionNumerics] = {
+    "bf16": CompressionNumerics(
+        method="bf16",
+        wire_dtype="bfloat16",
+        error_feedback=False,
+        # cast error eps/2*|g| per shard, plus (n-1) bf16 additions each
+        # adding up to eps/2 of the running |sum| <= n*amax; mean divides
+        # the absolute error by n -> amax*eps/2*(1 + (n-1)) = amax*eps*n/2/n...
+        # stated conservatively per mean element:
+        bound=lambda amax, n: amax * (2.0**-8) * (n + 1) / 2.0,
+        describe="per-element |error| <= amax*eps_bf16*(n+1)/2, eps_bf16=2^-8",
+    ),
+    "int8": CompressionNumerics(
+        method="int8",
+        wire_dtype="int8",
+        error_feedback=False,
+        # two quantization phases (codes, then the re-quantized reduced
+        # segment), each |err| <= scale/2 = amax/254 of its own amax;
+        # amax2 <= amax*(1 + 1/254)
+        bound=lambda amax, n: amax / 254.0 + amax * (1.0 + 1.0 / 254.0) / 254.0,
+        describe="per-element |error| <= amax/254 per phase (~amax/127 end-to-end)",
+    ),
+    "powersgd": CompressionNumerics(
+        method="powersgd",
+        wire_dtype="float32",
+        error_feedback=True,
+        # rank-r truncation error is carried in the per-rank residual and
+        # re-applied next step — bounded over time by the feedback loop
+        bound=lambda amax, n: 0.0,
+        describe="low-rank truncation error carried as per-rank error feedback (bound 0 in steady state)",
+    ),
+}
+
+_WIRE_EPS = {"bfloat16": 2.0**-8, "float16": 2.0**-11, "float8_e4m3fn": 2.0**-4, "float8_e5m2": 2.0**-3}
+
+
+def _scope_has_error_feedback(facts: list[OpFact], scope: int) -> bool:
+    """A residual ``original - quantized`` computed anywhere in the same
+    scope (or program) marks the scheme as error-feedback-carrying."""
+    for f in facts:
+        if f.primitive != "sub" or len(f.in_vals) < 2:
+            continue
+        a, b = f.in_vals[0], f.in_vals[1]
+        if (a.narrowed is None) != (b.narrowed is None):
+            return True
+    return False
+
+
+def check_compressed_collectives(facts: list[OpFact], mesh) -> list[Finding]:
+    findings = []
+    seen = set()
+    has_ef = _scope_has_error_feedback(facts, 0)
+    for f in facts:
+        if f.primitive not in _WIRE_COLLECTIVES:
+            continue
+        operand = f.in_vals[0] if f.in_vals else None
+        wire_dt = f.in_dtypes[0] if f.in_dtypes else ""
+        if operand is None or operand.narrowed is None:
+            continue
+        if wire_dt not in LOW_PRECISION_FLOATS and wire_dt not in ("int8", "uint8"):
+            continue
+        if has_ef:
+            continue
+        n = int(f.detail.get("group", 1) or 1)
+        if wire_dt in ("int8", "uint8"):
+            bound = "per-element |error| <= amax/254 per quantization phase (~amax/127 end-to-end for a two-phase reduce)"
+        else:
+            eps = _WIRE_EPS.get(wire_dt, 2.0**-8)
+            bound = (
+                f"per-element |error| <= amax*eps*(n+1)/2 = amax*{eps * (n + 1) / 2.0:.3g} "
+                f"(eps({wire_dt})=2^{int(math.log2(eps))}, n={n})"
+            )
+        loc = _loc(f.eqn)
+        key = (f.primitive, loc, wire_dt)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(
+            _finding(
+                "TPU606",
+                f.eqn,
+                f"{f.primitive} over a {wire_dt} wire payload narrowed from "
+                f"{operand.mant}+ mantissa bits {loc}: {bound}; without error "
+                "feedback this bias is re-injected every step and accumulates in "
+                "the params — carry the residual (PowerSGD-style error feedback) "
+                "or pin the bound with a compressed-vs-exact parity test",
+            )
+        )
+    return findings
+
+
+# -- aggregator ------------------------------------------------------------
+
+
+def check_numerics_rules(facts: list[OpFact], mesh) -> list[Finding]:
+    """Run every TPU6xx detector over one fact stream."""
+    findings = check_low_precision_accumulation(facts)
+    findings += check_provable_overflow(facts)
+    findings += check_unguarded_singularity(facts)
+    findings += check_update_below_ulp(facts)
+    findings += check_key_reuse(facts)
+    findings += check_compressed_collectives(facts, mesh)
+    return findings

@@ -26,6 +26,16 @@ static_analysis.md for the worked catalogue):
   collective/compute overlap, and f32 matmuls that are safely bf16.
   TPU502 is error-severity — re-reducing an already-uniform value has no
   legitimate use — so it gates strictly; the rest are warnings.
+* ``TPU6xx`` — numerics & precision rules (``analysis.numerics_rules``)
+  over the interval + dtype-provenance abstract interpretation
+  (``analysis.numerics``): low-precision accumulation over long
+  reduction axes, provable fp16/fp8 overflow (the interval exceeds the
+  dtype's finite max — error severity, the strict gate), unguarded
+  div/log/rsqrt over an interval containing 0, mixed-precision weight
+  updates below the ulp of the param dtype, PRNG key reuse, and
+  compressed/quantized collectives without error feedback. Every
+  finding prices its impact (relative error, overflow margin, or
+  lost-update ulp).
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -48,6 +58,7 @@ TIER_AST = "ast"
 TIER_FLIGHT = "flight"
 TIER_DIVERGENCE = "divergence"
 TIER_PERF = "perf"
+TIER_NUMERICS = "numerics"
 
 
 @dataclass(frozen=True)
@@ -95,6 +106,13 @@ RULES: dict[str, Rule] = {
         Rule("TPU503", "small-dcn-collective", WARNING, TIER_PERF, "latency-bound small collectives on a DCN axis that should coalesce into one"),
         Rule("TPU504", "missed-collective-overlap", WARNING, TIER_PERF, "independent compute adjacent to a blocking collective could hide it but is scheduled outside its window"),
         Rule("TPU505", "f32-matmul-bf16-safe", WARNING, TIER_PERF, "f32 matmul with bf16 provenance/destination — bf16 inputs with f32 accumulation are equivalent and ~2x faster"),
+        # -- tier 6: numerics & precision (analysis.numerics_rules) --------
+        Rule("TPU601", "low-precision-accumulation", WARNING, TIER_NUMERICS, "bf16/fp16 sum/mean/dot accumulates in low precision over a long axis — worst-case relative error grows with the axis length"),
+        Rule("TPU602", "provable-low-precision-overflow", ERROR, TIER_NUMERICS, "value interval provably exceeds the fp16/fp8 finite max (inf, then NaN downstream) — e.g. un-max-subtracted softmax"),
+        Rule("TPU603", "unguarded-singularity", WARNING, TIER_NUMERICS, "div/log/rsqrt whose operand interval contains 0 — add an epsilon guard or clamp"),
+        Rule("TPU604", "update-below-param-ulp", WARNING, TIER_NUMERICS, "mixed-precision weight update smaller than the ulp of the param dtype — the update rounds away (keep f32 master weights)"),
+        Rule("TPU605", "prng-key-reuse", WARNING, TIER_NUMERICS, "the same PRNG key is consumed by two or more random draws without a split — the streams are bit-identical"),
+        Rule("TPU606", "unbounded-compressed-collective", WARNING, TIER_NUMERICS, "compressed/quantized collective without error feedback — the per-step quantization error biases the reduction"),
     )
 }
 

@@ -11,8 +11,10 @@ buffer read after its aliased output exists, plus (divergence tier) one
 seeded multi-host deadlock/hazard per TPU4xx rule and a clean idiomatic
 rank-aware script that must produce zero findings, plus (perf tier) one
 seeded inefficiency AND a repaired clean twin per TPU5xx rule and a
-hand-computed roofline reference the report must match exactly. A CI run
-that passes
+hand-computed roofline reference the report must match exactly, plus
+(numerics tier) one seeded precision defect AND a repaired clean twin per
+TPU6xx rule and a hand-computed interval-arithmetic reference the
+interpreter must match exactly. A CI run that passes
 selfcheck has proven the linter end-to-end on the CPU backend, so a clean
 repo lint actually means something.
 
@@ -28,6 +30,7 @@ from .ast_lint import LintConfig, lint_source
 from .divergence import analyze_source
 from .flightcheck import flight_check
 from .jaxpr_lint import lint_step
+from .numerics import numerics_check
 from .perfmodel import perf_check
 from .rules import Finding
 
@@ -485,6 +488,187 @@ def run_perf_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     return ok, lines
 
 
+def _numerics_fixtures(mesh):
+    """``rule -> (fn, sample_args, kwargs)`` seeded numerics-tier
+    (TPU6xx) defects, checked through
+    :func:`analysis.numerics.numerics_check`. Each has a clean twin in
+    :func:`_numerics_clean_fixtures` that must stay silent."""
+    import jax
+    import jax.numpy as jnp
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+
+    def low_precision_dot(x, w):
+        # bf16 accumulation over K=512: worst-case rel error ~K*eps/2 = 1.0
+        return x @ w
+
+    def unguarded_softmax(x):
+        # no max subtraction: exp([-16,16]) tops out at 8.9e6 > fp16 65504
+        e = jnp.exp(x)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def unguarded_norm(x, n):
+        return x / n  # n's interval contains 0
+
+    def bf16_weight_update(p, g):
+        # lr=1e-4 in bf16: max |update| 1.6e-3 < eps/2*|p| = 0.0625
+        return p - 1e-4 * g
+
+    def reused_key(seed):
+        k = jax.random.key(seed)
+        return jax.random.normal(k, (4,)) + jax.random.uniform(k, (4,))
+
+    def compressed_wire(g):
+        from ..parallel.compression import compressed_psum_mean
+
+        return compressed_psum_mean({"w": g}, axis, "bf16")
+
+    f32, bf16, f16 = jnp.float32, jnp.bfloat16, jnp.float16
+    return {
+        "TPU601": (
+            low_precision_dot,
+            (jax.ShapeDtypeStruct((8, 512), bf16), jax.ShapeDtypeStruct((512, 16), bf16)),
+            {},
+        ),
+        "TPU602": (unguarded_softmax, (jax.ShapeDtypeStruct((8, 64), f16),), {}),
+        "TPU603": (
+            unguarded_norm,
+            (jax.ShapeDtypeStruct((8,), f32), jax.ShapeDtypeStruct((8,), f32)),
+            {},
+        ),
+        "TPU604": (
+            bf16_weight_update,
+            (jax.ShapeDtypeStruct((64, 64), bf16), jax.ShapeDtypeStruct((64, 64), bf16)),
+            {},
+        ),
+        "TPU605": (reused_key, (jax.ShapeDtypeStruct((), jnp.uint32),), {}),
+        "TPU606": (compressed_wire, (jax.ShapeDtypeStruct((8, 16), f32),), {}),
+    }
+
+
+def _numerics_clean_fixtures(mesh):
+    """The clean twin per TPU6xx rule: the same shape of program with the
+    defect repaired — numerics-check must report ZERO findings on each."""
+    import jax
+    import jax.numpy as jnp
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+
+    def f32_accum_dot(x, w):
+        return jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+
+    def guarded_softmax(x):
+        m = jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(x - m)  # the relational x-max(x) in [lo-hi, 0] proof
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def guarded_norm(x, n):
+        return x / jnp.maximum(n, 1e-6)
+
+    def f32_master_update(p, g):
+        return p - 1e-4 * g  # f32 params: every update representable
+
+    def split_key(seed):
+        k = jax.random.key(seed)
+        k1, k2 = jax.random.split(k)
+        return jax.random.normal(k1, (4,)) + jax.random.uniform(k2, (4,))
+
+    def exact_wire(g):
+        n = jax.lax.psum(1, axis)
+        return jax.lax.psum(g, axis) / n
+
+    f32, bf16, f16 = jnp.float32, jnp.bfloat16, jnp.float16
+    return {
+        "TPU601": (
+            f32_accum_dot,
+            (jax.ShapeDtypeStruct((8, 512), bf16), jax.ShapeDtypeStruct((512, 16), bf16)),
+            {},
+        ),
+        "TPU602": (guarded_softmax, (jax.ShapeDtypeStruct((8, 64), f16),), {}),
+        "TPU603": (
+            guarded_norm,
+            (jax.ShapeDtypeStruct((8,), f32), jax.ShapeDtypeStruct((8,), f32)),
+            {},
+        ),
+        "TPU604": (
+            f32_master_update,
+            (jax.ShapeDtypeStruct((64, 64), f32), jax.ShapeDtypeStruct((64, 64), f32)),
+            {},
+        ),
+        "TPU605": (split_key, (jax.ShapeDtypeStruct((), jnp.uint32),), {}),
+        "TPU606": (exact_wire, (jax.ShapeDtypeStruct((8, 16), f32),), {}),
+    }
+
+
+def _interval_reference(mesh) -> tuple[bool, list[str]]:
+    """The executable spec of the interval arithmetic: a pipeline whose
+    output bounds are hand-computed here and must match the interpreter
+    EXACTLY (x assumed in [-2, 3]: x^2 in [0, 9], +1 in [1, 10],
+    log in [0, log 10], /2 in [0, log(10)/2]; and the psum of a literal 1
+    over the axis is exactly the group size)."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    axis = next((a for a, n in mesh.shape.items() if n > 1), "data")
+    n_axis = mesh.shape.get(axis, 1)
+
+    def ref_step(x):
+        y = jnp.log(x**2 + 1.0) / 2.0
+        n = jax.lax.psum(1, axis)
+        return y, n
+
+    report = numerics_check(
+        ref_step, jax.ShapeDtypeStruct((8,), jnp.float32), mesh=mesh, assume=(-2.0, 3.0)
+    )
+    want_hi = math.log(10.0) / 2.0
+    y, n = report.outputs[0], report.outputs[1]
+    checks = [
+        ("two outputs", len(report.outputs) == 2),
+        ("y.lo == 0", y.lo == 0.0),
+        (f"y.hi == log(10)/2 = {want_hi:.6g}", abs(y.hi - want_hi) < 1e-12),
+        (f"psum(1) == {n_axis}", n.lo == float(n_axis) and n.hi == float(n_axis)),
+        ("zero findings", not report.findings),
+    ]
+    ok = all(passed for _, passed in checks)
+    lines = [
+        f"[numerics selfcheck] interval reference (log(x^2+1)/2 on [-2,3], psum(1) over {axis}={n_axis}): "
+        + ("exact" if ok else "MISMATCH: " + ", ".join(name for name, passed in checks if not passed))
+    ]
+    return ok, lines
+
+
+def run_numerics_selfcheck(mesh=None) -> tuple[bool, list[str]]:
+    """Prove TPU601-TPU606 each fire on their seeded defect, each clean
+    twin yields zero findings, and the interval arithmetic matches the
+    hand-computed reference exactly."""
+    if mesh is None:
+        from ..parallel.mesh import MeshConfig
+
+        mesh = MeshConfig().build()
+    lines: list[str] = []
+    ok = True
+    clean = _numerics_clean_fixtures(mesh)
+    for rule, (fn, args, kwargs) in sorted(_numerics_fixtures(mesh).items()):
+        report = numerics_check(fn, *args, mesh=mesh, select=(rule,), **kwargs)
+        fired = any(f.rule == rule for f in report.findings)
+        ok &= fired
+        lines.append(f"[numerics selfcheck] {rule} fixture: {'detected' if fired else 'MISSED'}")
+        cfn, cargs, ckwargs = clean[rule]
+        twin = numerics_check(cfn, *cargs, mesh=mesh, **ckwargs)
+        quiet = not twin.findings
+        ok &= quiet
+        lines.append(
+            f"[numerics selfcheck] {rule} clean twin: "
+            + ("zero findings" if quiet else "DIRTY: " + ", ".join(f.rule for f in twin.findings))
+        )
+    ref_ok, ref_lines = _interval_reference(mesh)
+    ok &= ref_ok
+    lines.extend(ref_lines)
+    return ok, lines
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -521,6 +705,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     perf_ok, perf_lines = run_perf_selfcheck(mesh)
     ok &= perf_ok
     lines.extend(perf_lines)
+
+    num_ok, num_lines = run_numerics_selfcheck(mesh)
+    ok &= num_ok
+    lines.extend(num_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

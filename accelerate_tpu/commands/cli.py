@@ -16,6 +16,7 @@ from .launch import launch_parser
 from .lint import lint_parser
 from .merge import merge_parser
 from .migrate import migrate_parser
+from .numericscheck import numericscheck_parser
 from .perfcheck import perfcheck_parser
 from .telemetry import telemetry_parser
 from .test import test_parser
@@ -35,6 +36,7 @@ def main():
     lint_parser(subparsers)
     flightcheck_parser(subparsers)
     perfcheck_parser(subparsers)
+    numericscheck_parser(subparsers)
     divergence_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)

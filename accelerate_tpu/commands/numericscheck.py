@@ -1,0 +1,168 @@
+"""``accelerate-tpu numerics-check`` — the interval + dtype-provenance
+abstract interpretation and TPU6xx precision rules over a step function,
+before any XLA compile.
+
+Same target conventions as ``flight-check`` (``path/to/file.py::fn`` or
+``pkg.module:fn``, repeatable ``--arg dtype[shape]`` specs or the
+module's ``<fn>_sample_args()`` / ``SAMPLE_ARGS`` convention), same fake
+CPU mesh — safe on a dev box with no TPU. The report carries the proven
+value interval of every program output and the TPU601–606 findings:
+low-precision accumulation over long axes, provable fp16/fp8 overflow
+(TPU602 is error-severity — the strict part of the ``make
+numerics-check`` gate), unguarded div/log/rsqrt over zero, weight
+updates below the param ulp, PRNG key reuse, and compressed collectives
+without error feedback. Every finding prices its impact (relative-error
+bound, overflow margin, or lost-update ulp).
+
+``--assume lo,hi`` sets the input-value assumption the proofs are
+relative to (default ±16 — post-normalisation activations/logits/grads).
+A bare ``.py`` file or directory target (no ``::fn``) runs the AST tier
+only: TPU605 PRNG-key-reuse over the source text, no trace needed.
+
+Examples::
+
+    accelerate-tpu numerics-check examples/by_feature/numerics_check.py::train_step --mesh data=8
+    accelerate-tpu numerics-check train.py::step --arg "f16[32,128]" --assume -8,8
+    accelerate-tpu numerics-check train.py::step --format json > numerics.json
+    accelerate-tpu numerics-check accelerate_tpu/          # AST tier: key reuse
+    accelerate-tpu numerics-check --selfcheck  # prove TPU601-606 fire, twins clean, intervals exact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def numericscheck_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "numerics-check",
+            help="Interval + dtype-provenance precision analysis (TPU6xx) for a step fn",
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu numerics-check")
+    parser.add_argument(
+        "target", nargs="?",
+        help="step function (file.py::fn or pkg.module:fn), or a .py file/dir for the AST tier",
+    )
+    parser.add_argument("--arg", action="append", default=[], help="sample arg spec like f16[8,128] (repeatable)")
+    parser.add_argument("--mesh", default=None, help="mesh shape, e.g. data=4,tensor=2 (default: all devices on data)")
+    parser.add_argument(
+        "--assume", default=None,
+        help="assumed input value range lo,hi the proofs are relative to "
+        "(default -16,16; use the = form for negative bounds: --assume=-8,8)",
+    )
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU601-606 fire on seeded defects, clean twins stay silent, interval math is exact",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=numericscheck_command)
+    return parser
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)
+    from accelerate_tpu.analysis.selfcheck import run_numerics_selfcheck
+
+    ok, lines = run_numerics_selfcheck()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("numerics-check selfcheck FAILED")
+        return 1
+    return 0
+
+
+def parse_assume(raw):
+    if raw is None:
+        return None
+    parts = [p.strip() for p in raw.split(",") if p.strip()]
+    if len(parts) != 2:
+        raise ValueError(f"bad --assume {raw!r}; expected lo,hi like -8,8")
+    lo, hi = float(parts[0]), float(parts[1])
+    if lo > hi:
+        raise ValueError(f"bad --assume {raw!r}: lo > hi")
+    return (lo, hi)
+
+
+def _ast_tier(target: str, args) -> int:
+    """TPU605 key-reuse over source text — no jax, no trace."""
+    from accelerate_tpu.analysis import exit_code, render_json, render_sarif, render_text
+    from accelerate_tpu.analysis.ast_lint import iter_python_files
+    from accelerate_tpu.analysis.numerics_rules import check_key_reuse_source
+    from accelerate_tpu.analysis.project_config import load_project_config
+    from accelerate_tpu.analysis.rules import apply_suppressions
+
+    cfg = load_project_config()
+    findings = []
+    for path in iter_python_files([target]):
+        text = path.read_text()
+        found = check_key_reuse_source(text, path=str(path))
+        findings.extend(apply_suppressions(found, text.splitlines()))
+    findings = cfg.apply_suppressions(
+        [f for f in findings if f.rule not in set(cfg.disable)]
+    )
+    fmt = cfg.resolve_format(args.format)
+    if fmt == "json":
+        print(render_json(findings))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(render_text(findings))
+    return exit_code(findings, strict=args.strict)
+
+
+def numericscheck_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not args.target:
+            return rc
+
+    if not args.target:
+        print("usage: accelerate-tpu numerics-check file.py::step_fn [--arg f16[8,128] ...]")
+        return 2
+
+    if "::" not in args.target and ":" not in args.target and (
+        os.path.isdir(args.target) or args.target.endswith(".py")
+    ):
+        return _ast_tier(args.target, args)
+
+    from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+    mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.target)
+    sample_args = resolve_sample_args(module, fn, args.arg)
+    assume = parse_assume(args.assume)
+
+    from accelerate_tpu.analysis import exit_code, render_sarif
+    from accelerate_tpu.analysis.numerics import numerics_check
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    report = numerics_check(
+        fn, *sample_args, mesh=mesh, assume=assume, ignore=tuple(cfg.disable)
+    )
+    findings = cfg.apply_suppressions(report.findings)
+    fmt = cfg.resolve_format(args.format)
+    if fmt == "json":
+        print(json.dumps(report.as_dict(), indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(report.render_text())
+    return exit_code(findings, strict=args.strict)
+
+
+def main():
+    raise SystemExit(numericscheck_command(numericscheck_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1126,6 +1126,35 @@ class ServingEngine:
                 )
         return reports
 
+    def numerics_check(self, mesh=None, bucket=None, assume=None) -> dict:
+        """Static numerics analysis of the engine's real serving programs
+        (same program registry as :meth:`perf_check`) via
+        :func:`analysis.numerics.numerics_check`: value intervals +
+        dtype provenance over the prefill and decode-tick jaxprs, plus
+        the TPU6xx precision findings — attention softmax overflow in
+        low precision and unguarded normalisations are exactly the
+        decode-path hazards this catches before a compile. Returns
+        ``{"prefill": NumericsReport, "decode_tick": NumericsReport}``."""
+        jax = _jax()
+        import contextlib
+
+        from .analysis.numerics import numerics_check as _numerics_check
+
+        if mesh is None:
+            mesh = getattr(self.model, "mesh", None)
+        if mesh is None:
+            from .parallel.mesh import MeshConfig
+
+            mesh = MeshConfig(data=1).build(jax.devices()[:1])
+        b = int(bucket) if bucket is not None else min(self.prompt_buckets)
+        reports = {}
+        for name, (fn, args_fn, ctx_factories) in self._perf_programs.items():
+            with contextlib.ExitStack() as stack:
+                for factory in ctx_factories:
+                    stack.enter_context(factory())
+                reports[name] = _numerics_check(fn, *args_fn(b), mesh=mesh, assume=assume)
+        return reports
+
     def _bucket_for(self, n: int) -> Optional[int]:
         """Covering prefill bucket for an ``n``-token prompt: the minimal
         static bucket, or (auto-bucketing) the learned bucketer's choice —

@@ -39,6 +39,7 @@ from .mfu import (
     mfu,
     peak_flops,
 )
+from .nonfinite import NonFiniteWatchdog
 from .serving_metrics import ServingMetrics
 from .step import StepTelemetry, diff_signatures, signature_of
 from .summarize import render_text, summarize, summarize_file
@@ -51,6 +52,7 @@ __all__ = [
     "signature_of",
     "diff_signatures",
     "HBMSampler",
+    "NonFiniteWatchdog",
     "ServingMetrics",
     "Telemetry",
     "PEAK_FLOPS_TABLE",
@@ -76,7 +78,9 @@ class Telemetry:
     callback every N steps (the Accelerator wires ``Accelerator.log`` in
     here, so step time / MFU / recompile counts land in the active
     trackers automatically). ``static_hbm_bytes`` seeds the drift check
-    with a flight-check prediction.
+    with a flight-check prediction. ``nonfinite_every=N`` opts in to the
+    :class:`NonFiniteWatchdog` finiteness probe (0 = off — each probe is
+    a host sync).
     """
 
     def __init__(
@@ -96,6 +100,7 @@ class Telemetry:
         hbm_drift_threshold: float = 0.2,
         forward_fn: Optional[Callable[[dict, Optional[int]], None]] = None,
         forward_every: int = 0,
+        nonfinite_every: int = 0,
     ):
         self.log = EventLog(path, rank=rank, main_process_only=main_process_only)
         self.steps = StepTelemetry(
@@ -110,6 +115,7 @@ class Telemetry:
         self.hbm = HBMSampler(
             self.log, static_peak_bytes=static_hbm_bytes, drift_threshold=hbm_drift_threshold
         )
+        self.nonfinite = NonFiniteWatchdog(self.log, every=nonfinite_every)
         self._hbm_sample_every = max(0, int(hbm_sample_every))
         self._forward_fn = forward_fn
         self._forward_every = max(0, int(forward_every))
@@ -153,6 +159,8 @@ class Telemetry:
             out["observed_peak_hbm_bytes"] = self.hbm.observed_peak_bytes
         if self.hbm.static_peak_bytes:
             out["static_peak_hbm_bytes"] = int(self.hbm.static_peak_bytes)
+        if self.nonfinite.enabled or self.nonfinite.probes:
+            out["nonfinite"] = self.nonfinite.summary()
         return out
 
     def flush(self):

@@ -127,6 +127,33 @@ def summarize(events: list[dict]) -> dict:
             ],
         }
 
+    # non-finite watchdog (the runtime counterpart of numerics TPU602):
+    # the latched `nonfinite` event + the fp16 loss-scale trajectory
+    nonfinite = [e for e in events if e.get("kind") == "event" and e.get("name") == "nonfinite"]
+    scales = [e for e in events if e.get("kind") == "event" and e.get("name") == "loss_scale"]
+    if nonfinite or scales:
+        scale_vals = [e.get("scale") for e in scales if e.get("scale") is not None]
+        report["nonfinite"] = {
+            "events": [
+                {
+                    "step": e.get("step"),
+                    "leaf": e.get("leaf"),
+                    "value": e.get("value"),
+                    "loss_scale": e.get("loss_scale"),
+                }
+                for e in nonfinite
+            ],
+            "loss_scale": {
+                "current": scale_vals[-1] if scale_vals else None,
+                "min": min(scale_vals) if scale_vals else None,
+                "max": max(scale_vals) if scale_vals else None,
+                "backoffs": max((e.get("backoffs", 0) for e in scales), default=0),
+                "changes": len(scales),
+            }
+            if scales
+            else None,
+        }
+
     warnings = [
         e for e in events
         if e.get("kind") == "event" and e.get("severity") in ("warning", "error")
@@ -220,6 +247,23 @@ def render_text(report: dict) -> str:
         for b in cc.get("bucket_compiles", []):
             lines.append(
                 f"    bucket {b.get('program')}[{b.get('bucket')}]: built in {b.get('compile_ms')} ms"
+            )
+    nf = report.get("nonfinite")
+    if nf:
+        lines.append("  non-finite watchdog:")
+        for e in nf.get("events", []):
+            lines.append(
+                f"    NONFINITE at step {e.get('step')}: first bad leaf "
+                f"{e.get('leaf')!r} = {e.get('value')}"
+                + (f" (loss scale {e.get('loss_scale')})" if e.get("loss_scale") is not None else "")
+            )
+        if not nf.get("events"):
+            lines.append("    all probes finite")
+        ls = nf.get("loss_scale")
+        if ls:
+            lines.append(
+                f"    loss scale        : {ls.get('current')} "
+                f"(min {ls.get('min')}, max {ls.get('max')}, {ls.get('backoffs')} backoffs)"
             )
     if len(lines) == 1:
         lines.append("  (no step/hbm/serving records found)")

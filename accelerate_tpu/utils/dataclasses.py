@@ -200,7 +200,13 @@ class TelemetryKwargs(KwargsHandler):
     ``fence=False`` drops the per-step ``block_until_ready`` (the
     data-wait/dispatch/execute split then degrades but overhead reaches
     zero); ``forward_to_trackers_every=N`` pushes a rolling summary
-    through ``Accelerator.log`` every N steps (0 disables)."""
+    through ``Accelerator.log`` every N steps (0 disables);
+    ``nonfinite_every=N`` opts in to the
+    :class:`~accelerate_tpu.telemetry.NonFiniteWatchdog` — every N steps
+    the fast-path train step probes loss / grad-norm finiteness and the
+    fp16 loss-scale trajectory (a probe is a host sync, so 0 = off is
+    the default; the static counterpart is
+    ``Accelerator.numerics_check``'s TPU602 proof)."""
 
     enabled: bool = True
     output_path: Optional[str] = None
@@ -212,6 +218,7 @@ class TelemetryKwargs(KwargsHandler):
     recompile_watchdog: bool = True
     hbm_sample_every: int = 10
     forward_to_trackers_every: int = 10
+    nonfinite_every: int = 0
     main_process_only: bool = True
 
     def __post_init__(self):
@@ -219,6 +226,8 @@ class TelemetryKwargs(KwargsHandler):
             raise ValueError(f"warmup_steps must be >= 0, got {self.warmup_steps}")
         if self.hbm_sample_every < 0 or self.forward_to_trackers_every < 0:
             raise ValueError("hbm_sample_every / forward_to_trackers_every must be >= 0")
+        if self.nonfinite_every < 0:
+            raise ValueError(f"nonfinite_every must be >= 0, got {self.nonfinite_every}")
 
 
 @dataclass

@@ -1,0 +1,86 @@
+"""Static numerics analysis before the first compile: interpret the step
+over value intervals and dtype provenance, and catch TPU6xx precision
+hazards while they are still one-line fixes.
+
+Two surfaces on the same step function:
+
+* ``Accelerator.numerics_check(step_fn, *sample_args)`` — programmatic,
+  against the accelerator's live mesh;
+* ``accelerate-tpu numerics-check examples/by_feature/numerics_check.py::train_step``
+  — the CLI reads the sample shapes from ``train_step_sample_args()``
+  below (or pass ``--arg bf16[128,512]``), and ``--assume lo,hi`` states
+  the input-value assumption the proofs are relative to.
+
+The step below contracts a 512-long axis in a bf16 matmul whose
+accumulator stays bf16 — exactly the TPU601 pattern — so the report both
+bounds the step AND prices the worst-case relative error
+(``K·eps/2 = 512·2^-7/2 = 2.0``, i.e. the sum can be 200% wrong in the
+worst case). The fixed twin keeps the same bf16 operands but accumulates
+in f32 via ``preferred_element_type`` — same wire/HBM bytes, exact
+accumulation — and is checked to produce zero findings.
+"""
+
+import jax
+import jax.numpy as jnp
+
+HIDDEN = 512
+FEATURES = 128
+BATCH = 128
+
+
+def train_step(params, batch):
+    """Forward + MSE with a bf16 matmul whose accumulator stays bf16 over
+    the K=512 contraction (the seeded TPU601 finding)."""
+    h = jnp.tanh(batch["x"] @ params["w1"])  # bf16 @ bf16 -> bf16 accumulate
+    pred = h.astype(jnp.float32) @ params["w2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def fixed_step(params, batch):
+    """The TPU601 fix: same bf16 operands, f32 accumulation via
+    ``preferred_element_type`` — the MXU keeps full rate and the sum is
+    exact; narrow once afterwards if bf16 activations are wanted."""
+    acc = jax.lax.dot(batch["x"], params["w1"], preferred_element_type=jnp.float32)
+    h = jnp.tanh(acc)
+    pred = h @ params["w2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def train_step_sample_args():
+    """Abstract sample shapes for the CLI (nothing is allocated)."""
+    params = {
+        "w1": jax.ShapeDtypeStruct((HIDDEN, HIDDEN), jnp.bfloat16),
+        "w2": jax.ShapeDtypeStruct((HIDDEN, FEATURES), jnp.float32),
+    }
+    batch = {
+        "x": jax.ShapeDtypeStruct((BATCH, HIDDEN), jnp.bfloat16),
+        "y": jax.ShapeDtypeStruct((BATCH, FEATURES), jnp.float32),
+    }
+    return params, batch
+
+
+def fixed_step_sample_args():
+    return train_step_sample_args()
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    report = accelerator.numerics_check(train_step, *train_step_sample_args())
+    accelerator.print(report.render_text())
+    [finding] = [f for f in report.findings if f.rule == "TPU601"]
+    accelerator.print(f"\npriced bound: {finding.message}")
+
+    fixed = accelerator.numerics_check(fixed_step, *fixed_step_sample_args())
+    accelerator.print(
+        "\nTPU601 fix (preferred_element_type=f32): "
+        f"{len(fixed.findings)} findings — exact f32 accumulation over the "
+        f"{HIDDEN}-long contraction at full MXU rate"
+    )
+    assert any(f.rule == "TPU601" for f in report.findings), "seeded TPU601 must fire"
+    assert not fixed.findings, "fixed twin must be clean"
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,8 @@ MODULES = [
     "accelerate_tpu.analysis.costmodel",
     "accelerate_tpu.analysis.perfmodel",
     "accelerate_tpu.analysis.perf_rules",
+    "accelerate_tpu.analysis.numerics",
+    "accelerate_tpu.analysis.numerics_rules",
     "accelerate_tpu.analysis.ranksim",
     "accelerate_tpu.analysis.divergence",
     "accelerate_tpu.analysis.project_config",
@@ -91,6 +93,7 @@ MODULES = [
     "accelerate_tpu.telemetry.mfu",
     "accelerate_tpu.telemetry.serving_metrics",
     "accelerate_tpu.telemetry.summarize",
+    "accelerate_tpu.telemetry.nonfinite",
     "accelerate_tpu.models",
 ]
 

@@ -37,10 +37,11 @@ def bert():
 
 
 def _batch(rng, batch=4, seq=16):
+    ids_rng, labels_rng = jax.random.split(rng)
     return {
-        "input_ids": jax.random.randint(rng, (batch, seq), 0, TINY.vocab_size),
+        "input_ids": jax.random.randint(ids_rng, (batch, seq), 0, TINY.vocab_size),
         "attention_mask": jnp.ones((batch, seq), jnp.int32),
-        "labels": jax.random.randint(rng, (batch,), 0, 2),
+        "labels": jax.random.randint(labels_rng, (batch,), 0, 2),
     }
 
 

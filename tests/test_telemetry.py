@@ -590,3 +590,113 @@ def test_watchdog_state_is_per_wrapper():
     # but a genuine post-warmup shape change on either wrapper still fires
     step_a(jnp.ones((12, 12)))
     assert st.recompiles == 1
+
+
+# --------------------------------------------------------------------- #
+# NonFiniteWatchdog (the runtime counterpart of numerics TPU602)
+# --------------------------------------------------------------------- #
+
+
+def test_nonfinite_watchdog_cadence_latch_and_trajectory(tmp_path):
+    import math
+
+    from accelerate_tpu.telemetry import NonFiniteWatchdog
+    from accelerate_tpu.telemetry.eventlog import EventLog, read_events
+
+    path = str(tmp_path / "run.jsonl")
+    log = EventLog(path, rank=0)
+    wd = NonFiniteWatchdog(log, every=2)
+    assert wd.enabled
+    # off-cadence steps probe nothing
+    assert wd.observe(1, loss=float("nan")) is None
+    for step in range(0, 6, 2):
+        rec = wd.observe(step, loss=1.0, grad_norm=0.5, loss_scale=2.0**15)
+        assert rec["bad_leaf"] is None
+    assert wd.probes == 3 and wd.nonfinite_event is None
+    # a backoff followed by the overflow: one latched event, scale staircase kept
+    wd.observe(6, loss=1.0, loss_scale=2.0**14)
+    wd.observe(8, loss=float("inf"), loss_scale=2.0**13)
+    wd.observe(10, loss=float("nan"), loss_scale=2.0**13)  # latched: no 2nd event
+    assert wd.nonfinite_event is not None
+    assert wd.nonfinite_event["leaf"] == "loss"
+    assert wd.scale_backoffs == 2
+    log.close()
+    events = read_events(path)
+    assert sum(1 for e in events if e.get("name") == "nonfinite") == 1
+    scales = [e for e in events if e.get("name") == "loss_scale"]
+    assert [e["scale"] for e in scales] == [2.0**15, 2.0**14, 2.0**13]
+    s = wd.summary()
+    assert s["nonfinite"] and s["first_bad_leaf"] == "loss"
+    assert s["loss_scale"]["backoffs"] == 2 and s["loss_scale"]["max"] == 2.0**15
+    assert not math.isnan(s["loss_scale"]["current"])
+
+
+def test_nonfinite_watchdog_names_first_bad_grad_leaf():
+    import numpy as np
+
+    from accelerate_tpu.telemetry import NonFiniteWatchdog
+
+    wd = NonFiniteWatchdog(every=1)
+    rec = wd.observe(
+        0, grads={"w": np.ones(4), "inner": {"b": np.array([0.0, float("nan")])}}
+    )
+    assert rec["bad_leaf"] == "grads['inner']['b']"
+    assert wd.nonfinite_event["leaf"] == "grads['inner']['b']"
+
+
+def test_nonfinite_summarize_section(tmp_path):
+    from accelerate_tpu.telemetry import NonFiniteWatchdog
+    from accelerate_tpu.telemetry.eventlog import EventLog
+    from accelerate_tpu.telemetry.summarize import render_text, summarize_file
+
+    path = str(tmp_path / "run.jsonl")
+    log = EventLog(path, rank=0)
+    wd = NonFiniteWatchdog(log, every=1)
+    wd.observe(0, loss=1.0, loss_scale=1024.0)
+    wd.observe(1, loss=float("nan"), loss_scale=512.0)
+    log.close()
+    report = summarize_file(path)
+    assert report["nonfinite"]["events"][0]["leaf"] == "loss"
+    assert report["nonfinite"]["loss_scale"]["backoffs"] == 1
+    text = render_text(report)
+    assert "NONFINITE at step 1" in text and "loss scale" in text
+
+
+def test_fast_path_probes_nonfinite_watchdog(tmp_path):
+    """TelemetryKwargs(nonfinite_every=N) wires the probe into the fast
+    path: a clean run stays silent; the fp16 loss-scale value lands in
+    the trajectory."""
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, linear_loss_fn
+    from accelerate_tpu.utils import TelemetryKwargs
+
+    path = str(tmp_path / "run.jsonl")
+    acc = Accelerator(
+        mixed_precision="fp16",
+        kwargs_handlers=[TelemetryKwargs(output_path=path, nonfinite_every=2)],
+    )
+    acc.telemetry  # arm before building the step
+    model = acc.prepare_model(RegressionModel())
+    acc.prepare_optimizer(optax.sgd(0.05))
+    loader = acc.prepare_data_loader(RegressionDataset(length=64))
+    loader.batch_size = 16 // max(1, acc.num_data_shards)
+    step = acc.build_train_step(linear_loss_fn)
+    done = 0
+    while done < 6:
+        for batch in loader:
+            step(batch)
+            done += 1
+            if done >= 6:
+                break
+    wd = acc.telemetry.nonfinite
+    assert wd.probes >= 2
+    # grad overflow during fp16 scale calibration is the SCALER's job
+    # (skip + backoff), counted but never latched; the loss stays finite
+    assert wd.nonfinite_event is None
+    assert wd.scale_trajectory and wd.scale_trajectory[-1][1] >= 1.0
+    summary = acc.telemetry.summary()
+    assert summary["nonfinite"]["nonfinite"] is False
+    assert summary["nonfinite"]["scaler_skips"] >= 0
