@@ -41,17 +41,32 @@ parity-plus. Design notes:
   (``pool_blocks``), admission waits when the pool is exhausted, and
   prefix blocks are refcount-shared across requests rather than copied.
   The decode tick becomes ONE batched program (per-row frontiers are
-  native to the paged layout) and outputs stay token-exact vs dense.
+  native to the paged layout) and outputs stay token-exact vs dense;
+* **token-budget continuous batching** (``scheduler=SchedulerConfig``,
+  :mod:`accelerate_tpu.scheduling`): each tick spends at most
+  ``token_budget`` tokens — active decodes claim theirs first, and the
+  remainder streams *chunks* of pending prefills through the existing
+  chunked-prefill windows, so a long prompt makes TTFT progress without
+  ever stalling a running decode for its whole prefill. Priority-class
+  admission, SLO-aware load shedding (structured :class:`ShedError` +
+  ``shed`` events instead of silent queueing), and decode preemption
+  (the youngest low-priority decode releases its slot and KV blocks,
+  requeues, and resumes by prefix-style recomputation — token- and
+  logprob-exact) ride on the same tick loop. The default config is
+  behavior-preserving: unlimited budget, one priority class, no
+  shedding, no preemption.
 """
 
 from __future__ import annotations
 
-import collections
+import bisect
 import dataclasses
 import time
 from typing import Optional
 
 import numpy as np
+
+from .scheduling import Scheduler, SchedulerConfig, ShedError
 
 
 def _jax():
@@ -99,6 +114,17 @@ class _Request:
     stop_sequences: tuple = ()
     # log P(tok) for each generated token, aligned with out_tokens
     out_lps: list = dataclasses.field(default_factory=list)
+    # scheduling state (accelerate_tpu.scheduling): admission class (lower
+    # admits sooner), submit timestamp (queue-wait SLO + metrics), and the
+    # preemption/resume carry — a preempted decode requeues with its
+    # generated-so-far tokens plus its sampling key so the resumed stream
+    # is token- and logprob-exact
+    priority: int = 0
+    submit_ts: float = 0.0
+    preempted: bool = False
+    deprioritized: bool = False
+    ttft_done: bool = False
+    resume_key: object = None
 
 
 class ServingEngine:
@@ -139,6 +165,7 @@ class ServingEngine:
         telemetry_log=None,
         program_cache=None,
         auto_bucketing: bool = False,
+        scheduler=None,
     ):
         jax = _jax()
         jnp = jax.numpy
@@ -176,6 +203,21 @@ class ServingEngine:
             from .aot import ShapeBucketer
 
             self.bucketer = ShapeBucketer(self.prompt_buckets, max_size=self.max_len)
+        # Scheduling policy (accelerate_tpu.scheduling): accepts a
+        # SchedulerConfig, a Scheduler, or anything with
+        # ``to_scheduler_config()`` (utils.ServingSchedulerKwargs). The
+        # default is behavior-preserving: unlimited budget, one priority
+        # class, no shedding, no preemption.
+        if scheduler is None:
+            scheduler = SchedulerConfig()
+        if hasattr(scheduler, "to_scheduler_config"):
+            scheduler = scheduler.to_scheduler_config()
+        self._sched = scheduler if isinstance(scheduler, Scheduler) else Scheduler(scheduler)
+        if draft_model is not None and self._sched.config.enable_preemption:
+            raise NotImplementedError(
+                "decode preemption does not compose with speculative serving yet "
+                "(resume recomputes only the target cache)"
+            )
         # Speculative continuous batching: a draft model proposes gamma
         # tokens per slot, ONE target forward verifies them (greedy
         # accept-prefix; emitted tokens are exactly the target's own
@@ -309,7 +351,20 @@ class ServingEngine:
         self.slot_req: list[Optional[_Request]] = [None] * num_slots
         self.slot_tok = np.zeros((num_slots,), np.int32)
         self.slot_pos = np.zeros((num_slots,), np.int32)
-        self.queue: collections.deque[_Request] = collections.deque()
+        # slot phase: None (free) | "prefill" (streaming its prompt into a
+        # row cache across ticks) | "decode" (advanced by the decode tick)
+        self.slot_phase: list[Optional[str]] = [None] * num_slots
+        self._prefill_state: list[Optional[dict]] = [None] * num_slots
+        self._prefill_order: list[int] = []  # prefilling slots, admission order
+        # pending requests, kept sorted by the scheduler's order key
+        # (priority class, then submission order)
+        self.queue: list[_Request] = []
+        # uid -> ("queued"|"active"|"done", req|None): the O(1) lookup
+        # behind every streaming accessor (admit/retire/cancel/preempt
+        # maintain it; a linear slot+queue scan per poll() would be
+        # O(requests) under thousands of queued uids)
+        self._index: dict[int, tuple] = {}
+        self._shed: dict[int, ShedError] = {}  # uid -> structured rejection
         self.done: dict[int, np.ndarray] = {}
         self._done_new: dict[int, np.ndarray] = {}  # uid -> generated suffix only
         self._done_lps: dict[int, np.ndarray] = {}  # uid -> per-generated-token logprobs
@@ -400,6 +455,32 @@ class ServingEngine:
             return reset_cache_index(cache, n)
 
         self._reset_idx = ctx_jit(reset_idx)
+
+        if draft_model is None:
+            # The resume-recompute program (preempt -> requeue -> resume
+            # rebuilds the evicted KV by warm chunk windows) registered for
+            # perf_check()/numerics_check(): the analysis stack must cover
+            # every program the scheduler can launch, and this one is the
+            # only engine program that reads AND extends a warm row cache.
+            # The row-cache aval is the dense per-row template (chunk
+            # windows run outside paged_mode in both layouts).
+            _, row_aval = jax.eval_shape(
+                lambda p, i: apply_fn(
+                    p, i, positions=jnp.zeros((1, 1), jnp.int32), decode=True, cache=None
+                ),
+                params,
+                jnp.zeros((1, 1), jnp.int32),
+            )
+            self._perf_programs["resume_recompute"] = (
+                chunk_warm,
+                lambda b: (
+                    params,
+                    jax.ShapeDtypeStruct((1, self._chunk), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    row_aval,
+                ),
+                (self._trace_ctx,),
+            )
 
         # registered shared prefixes: id -> {"len", "cache", "tokens"}
         self._prefixes: dict[int, dict] = {}
@@ -503,7 +584,21 @@ class ServingEngine:
             def dense_step(params, caches, toks, poss, keys):
                 return jax.vmap(one_step, in_axes=(None, 0, 0, 0, 0))(params, caches, toks, poss, keys)
 
-            raw_dense_tick = make_tick(dense_step)
+            if draft_model is None:
+                raw_dense_tick = make_tick(dense_step)
+            else:
+                # the spec engine's PLAIN tick (scheduler gating can route
+                # ticks away from speculation): advance only the target
+                # half of the {t, d} slot pytree. The draft cache goes
+                # stale for plainly-decoded tokens — harmless, because
+                # greedy speculative emission is the target's own argmax
+                # stream regardless of what the draft proposes; staleness
+                # costs acceptance rate, never tokens.
+                def pair_step(params, caches, toks, poss, keys):
+                    t_caches, nxt, lps, keys = dense_step(params, caches["t"], toks, poss, keys)
+                    return {"t": t_caches, "d": caches["d"]}, nxt, lps, keys
+
+                raw_dense_tick = make_tick(pair_step)
             self._decode_tick = ctx_jit(raw_dense_tick)
             self._perf_programs["decode_tick"] = (
                 raw_dense_tick,
@@ -607,42 +702,57 @@ class ServingEngine:
         are overwritten by decode, exactly as in bucket prefill. Returns
         ``(next_tok | None, cache, key)`` with the cache write index reset
         to ``len(full_tokens)``; sampling happens only when ``key`` is given
-        (prefix registration skips it)."""
-        jax = _jax()
-        jnp = jax.numpy
-        c = self._chunk
+        (prefix registration skips it).
+
+        The continuous-batching scheduler does NOT call this loop — it
+        advances the same :meth:`_run_window` steps one budget-claimed
+        window per tick, so a long prompt never stalls running decodes."""
+        jnp = _jax().numpy
         t = len(full_tokens)
         logits, s_last = None, 0
         s = done_upto
         while s < t:
-            # window width = smallest bucket covering the remainder (a short
-            # suffix after a long prefix runs a suffix-sized program, not a
-            # full chunk), else the largest; jit specializes per width, so
-            # the compile count stays O(buckets). Auto-bucketing consults
-            # the CURRENT learned set without growing it (lookup, not
-            # bucket) — long-remainder chunks must not mint new buckets.
-            if self.bucketer is not None:
-                w = self.bucketer.lookup(t - s) or c
-            else:
-                w = next((b for b in self.prompt_buckets if b >= t - s), c)
-            e = min(s + w, t)
-            s_adj = max(0, e - w)  # end-aligned window [s_adj, s_adj + w)
-            window = np.zeros((1, w), np.int32)
-            real = full_tokens[s_adj : s_adj + w]
-            window[0, : len(real)] = real
-            if row_cache is None:
-                logits, row_cache = self._chunk_cold(self.model.params, jnp.asarray(window))
-            else:
-                row_cache = self._reset_idx(row_cache, jnp.int32(s_adj))
-                logits, row_cache = self._chunk_warm(
-                    self.model.params, jnp.asarray(window), jnp.int32(s_adj), row_cache
-                )
-            s_last, s = s_adj, e
+            logits, row_cache, s_last, s = self._run_window(full_tokens, s, row_cache)
         row_cache = self._reset_idx(row_cache, jnp.int32(t))
         next_tok = lp = None
         if key is not None:
             next_tok, lp, key = self._sample_at(logits, jnp.int32(t - 1 - s_last), key)
         return next_tok, lp, row_cache, key
+
+    def _next_window(self, t: int, s: int):
+        """Plan the next end-aligned prefill window over ``full[ s, t)``:
+        ``(w, s_adj, e)`` — width = smallest bucket covering the remainder
+        (a short suffix after a long prefix runs a suffix-sized program,
+        not a full chunk), else the largest chunk; jit specializes per
+        width, so the compile count stays O(buckets). Auto-bucketing
+        consults the CURRENT learned set without growing it (lookup, not
+        bucket) — long-remainder chunks must not mint unbounded buckets.
+        The width is also the window's token-budget claim."""
+        c = self._chunk
+        if self.bucketer is not None:
+            w = self.bucketer.lookup(t - s) or c
+        else:
+            w = next((b for b in self.prompt_buckets if b >= t - s), c)
+        e = min(s + w, t)
+        return w, max(0, e - w), e  # end-aligned window [s_adj, s_adj + w)
+
+    def _run_window(self, full_tokens: np.ndarray, s: int, row_cache):
+        """Execute ONE prefill window starting at new-token offset ``s``;
+        returns ``(logits, cache, s_adj, e)``."""
+        jnp = _jax().numpy
+        t = len(full_tokens)
+        w, s_adj, e = self._next_window(t, s)
+        window = np.zeros((1, w), np.int32)
+        real = full_tokens[s_adj : s_adj + w]
+        window[0, : len(real)] = real
+        if row_cache is None:
+            logits, row_cache = self._chunk_cold(self.model.params, jnp.asarray(window))
+        else:
+            row_cache = self._reset_idx(row_cache, jnp.int32(s_adj))
+            logits, row_cache = self._chunk_warm(
+                self.model.params, jnp.asarray(window), jnp.int32(s_adj), row_cache
+            )
+        return logits, row_cache, s_adj, e
 
     # ---- public API ----------------------------------------------------
 
@@ -730,6 +840,7 @@ class ServingEngine:
         max_new_tokens: int = 32,
         prefix_id: Optional[int] = None,
         stop_sequences=None,
+        priority: int = 0,
     ) -> int:
         """Queue a prompt; returns a request id resolved via :meth:`poll`.
         With ``prefix_id``, ``prompt_ids`` is the SUFFIX after the registered
@@ -737,7 +848,13 @@ class ServingEngine:
         ``stop_sequences``: per-request token-id sequences (each a list of
         ints) that end generation when they appear in the generated tail —
         the token-level analogue of vLLM's ``stop``; the matched tokens stay
-        in the output like an EOS does."""
+        in the output like an EOS does. ``priority``: admission class —
+        lower admits sooner; sheddable/preemptible classes are configured
+        by the engine's :class:`~accelerate_tpu.scheduling.SchedulerConfig`.
+        When the queue-depth SLO is blown, sheddable submissions raise a
+        structured :class:`~accelerate_tpu.scheduling.ShedError` (or are
+        demoted, with ``shed_action="deprioritize"``) instead of silently
+        queueing into a blown latency target."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         if len(prompt) == 0:
             raise ValueError("empty prompt" + (" suffix" if prefix_id is not None else ""))
@@ -778,41 +895,76 @@ class ServingEngine:
                     f"request needs {need} pool blocks but the pool has "
                     f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
                 )
+        priority = int(priority)
+        reason = self._sched.shed_on_submit(priority, len(self.queue))
+        if reason is not None:
+            cfg = self._sched.config
+            if cfg.shed_action == "deprioritize":
+                self.metrics.on_deprioritize(None)
+                self._log.event(
+                    "shed", action="deprioritize", priority=priority,
+                    queue_depth=len(self.queue), reason=reason,
+                )
+                priority = max(priority, cfg.deprioritize_to)
+            else:
+                self.metrics.on_shed(None)
+                self._log.event(
+                    "shed", action="reject", priority=priority,
+                    queue_depth=len(self.queue), reason=reason,
+                )
+                raise ShedError(reason, priority=priority, queue_depth=len(self.queue))
         uid = self._uid
         self._uid += 1
-        self.queue.append(_Request(uid, prompt, max_new_tokens, [], prefix_id, stops))
+        req = _Request(
+            uid, prompt, max_new_tokens, [], prefix_id, stops,
+            priority=priority, submit_ts=time.monotonic(),
+        )
+        self._queue_push(req)
+        self._index[uid] = ("queued", req)
         self.metrics.on_submit(uid)
         return uid
 
+    def _queue_push(self, req: _Request) -> None:
+        """Insert by the scheduler's order key (priority class, then
+        submission order) — a preempted request's original uid keeps its
+        place ahead of later arrivals in the same class."""
+        bisect.insort(self.queue, req, key=lambda r: self._sched.order_key(r.priority, r.uid))
+
     def poll(self, uid: int):
-        """The finished [S + new] tokens for ``uid``, or None if pending."""
+        """The finished [S + new] tokens for ``uid``, or None if pending.
+        Raises the request's structured :class:`ShedError` if the
+        scheduler shed it from the queue (SLO load shedding)."""
+        if uid in self._shed:
+            raise self._shed[uid]
         return self.done.get(uid)
 
     def _locate(self, uid: int):
         """``("done"|"active"|"queued", req)`` for a known id (``req`` is
-        None once done); raises KeyError for unknown/cancelled ids. The
-        ONE request-lookup ladder behind every streaming accessor."""
-        if uid in self._done_new:
-            return "done", None
-        for req in self.slot_req:
-            if req is not None and req.uid == uid:
-                return "active", req
-        for req in self.queue:
-            if req.uid == uid:
-                return "queued", req
-        raise KeyError(f"unknown request id {uid}")
+        None once done); raises KeyError for unknown/cancelled ids and the
+        stored ShedError for shed ids. O(1): admit/retire/cancel/preempt
+        maintain the uid index — streaming accessors never scan slots or
+        the queue, so ``poll``/``partial`` stay flat under thousands of
+        queued requests."""
+        if uid in self._shed:
+            raise self._shed[uid]
+        try:
+            return self._index[uid]
+        except KeyError:
+            raise KeyError(f"unknown request id {uid}") from None
 
     def partial(self, uid: int) -> np.ndarray:
         """Tokens generated SO FAR for ``uid`` (streaming surface) —
         ALWAYS the generated suffix (empty while queued), including after
         completion, so a delta-by-length streamer never re-emits prompt
         tokens; ``poll`` returns the full prompt+output sequence. Raises
-        KeyError for unknown (or cancelled) ids."""
+        KeyError for unknown (or cancelled) ids. A preempted-and-requeued
+        request keeps exposing its already-streamed tokens while it waits
+        to resume — a delta streamer sees no regression across the
+        eviction."""
         state, req = self._locate(uid)
         if state == "done":
             return self._done_new[uid]
-        out = req.out_tokens if state == "active" else ()
-        return np.asarray(out, np.int32)
+        return np.asarray(req.out_tokens, np.int32)
 
     def logprobs(self, uid: int) -> np.ndarray:
         """log P(token) for each GENERATED token so far, under the model's
@@ -824,27 +976,29 @@ class ServingEngine:
         state, req = self._locate(uid)
         if state == "done":
             return self._done_lps[uid]
-        out = req.out_lps if state == "active" else ()
-        return np.asarray(out, np.float32)
+        return np.asarray(req.out_lps, np.float32)
 
     def cancel(self, uid: int) -> np.ndarray:
-        """Abort a queued or decoding request, returning whatever tokens it
-        had generated. Its slot/pool blocks free immediately; ``poll``
-        never resolves a cancelled id. Raises ValueError if already
-        finished, KeyError if unknown."""
+        """Abort a queued, prefilling, or decoding request, returning
+        whatever tokens it had generated (a preempted-and-requeued request
+        returns its carried tokens). Its slot/pool blocks free
+        immediately; ``poll`` never resolves a cancelled id. Raises
+        ValueError if already finished, KeyError if unknown or shed."""
         if uid in self.done:
             raise ValueError(f"request {uid} already finished; poll() it instead")
-        for slot, req in enumerate(self.slot_req):
-            if req is not None and req.uid == uid:
-                out = np.asarray(req.out_tokens, np.int32)
-                self._release(slot)
-                self.metrics.on_cancel(uid)
-                return out
-        for req in list(self.queue):
-            if req.uid == uid:
-                self.queue.remove(req)
-                self.metrics.on_cancel(uid)
-                return np.zeros((0,), np.int32)
+        state, req = self._index.get(uid, (None, None))
+        if state == "active":
+            slot = next(s for s, r in enumerate(self.slot_req) if r is req)
+            out = np.asarray(req.out_tokens, np.int32)
+            self._release(slot)
+            del self._index[uid]
+            self.metrics.on_cancel(uid)
+            return out
+        if state == "queued":
+            self.queue.remove(req)
+            del self._index[uid]
+            self.metrics.on_cancel(uid)
+            return np.asarray(req.out_tokens, np.int32)
         raise KeyError(f"unknown request id {uid}")
 
     @property
@@ -852,114 +1006,335 @@ class ServingEngine:
         return sum(r is not None for r in self.slot_req)
 
     def step(self) -> int:
-        """One engine tick: fill free slots from the queue (one prefill
-        each), then ONE vmapped decode step for all slots. Returns the
-        number of active slots after the tick."""
-        jax = _jax()
-        jnp = jax.numpy
+        """One engine tick under the token-budget continuous-batching
+        scheduler: shed over-SLO queue entries, advance in-flight prefill
+        chunks and admissions inside the tick's remaining token budget
+        (active decodes claim ``n_decoding x tick_block`` first), then
+        ONE decode tick for every decoding slot. Returns the number of
+        occupied slots after the tick.
 
-        # admit queued requests into free slots
+        With the default config (unlimited budget) every admitted prefill
+        completes in its admission tick — the pre-scheduler behavior.
+        With a budget, a long prompt streams one chunk window per tick
+        while decodes keep ticking: new requests make TTFT progress
+        without ever stalling running decodes. The engine always forces
+        at least one unit of progress per tick, so no budget setting can
+        livelock ``run()``."""
+        now = time.monotonic()
         self._pool_blocked = False
-        for slot in range(self.num_slots):
-            if self.slot_req[slot] is not None or not self.queue:
-                continue
-            if self.paged:
-                # reserve pool blocks BEFORE dequeuing; if the pool can't
-                # satisfy the head request, the whole queue waits (FIFO —
-                # no starvation of large requests by later small ones)
-                head = self.queue[0]
-                hp = self._prefixes[head.prefix_id]["len"] if head.prefix_id is not None else 0
-                lo, hi, alias_hi = self._plan_blocks(hp, len(head.prompt), head.max_new_tokens)
-                shared_entries: dict[int, int] = {}
-                if head.prefix_id is not None:
-                    pids = self._prefixes[head.prefix_id]["block_ids"]
-                    # every i in [lo, alias_hi) is registered: the prefix's
-                    # lo_min (suffix length 1) lower-bounds any request's lo
-                    shared_entries = {i: pids[i] for i in range(lo, alias_hi)}
-                new_ids = self._alloc.alloc((hi - lo) - len(shared_entries))
-                if new_ids is None:
-                    self._pool_blocked = True
-                    self.metrics.on_pool_blocked()
+        self._shed_pass(now)
+        n_dec = sum(1 for ph in self.slot_phase if ph == "decode")
+        budget = self._sched.tick_budget(n_dec, self.tick_block)
+        # Admissions run FIRST and one admission per tick may overrun the
+        # budget: a queued request's TTFT progress must not wait for an
+        # in-flight long prefill to finish streaming (head-of-line
+        # blocking is exactly what this scheduler removes). In-flight
+        # prefills then take the leftover budget oldest-first, with a
+        # one-window anti-starvation guarantee so a long prompt finishes
+        # in at most windows-many ticks under sustained arrivals. Decodes
+        # tick every step regardless — the per-tick prefill stall is
+        # bounded by budget + two forced windows, never a whole prompt.
+        force = True
+        while self.queue:
+            if budget <= 0 and not force:
+                break
+            slot = next((s for s in range(self.num_slots) if self.slot_req[s] is None), None)
+            if slot is None:
+                # priority inversion: a strictly more important request
+                # waits while a lower class decodes — evict the youngest
+                # such decode (policy-gated; None without preemption)
+                slot = self._sched.pick_victim(self.queue[0].priority, self._decoding_info())
+                if slot is None:
                     break
-                for bid in shared_entries.values():
-                    self._shared_refs[bid] += 1
-                table = np.zeros((self._mb,), np.int32)  # pad/out-of-band -> trash sink
-                owned: dict[int, int] = {}
-                ids = iter(new_ids)
-                for i in range(lo, hi):
-                    if i in shared_entries:
-                        table[i] = shared_entries[i]
-                    else:
-                        owned[i] = table[i] = next(ids)
-                # the paste writes ONLY this request's own blocks: shared
-                # prefix entries go to the trash sink in the write row
-                # (their canonical content was written at registration)
-                write_row = table.copy()
-                for i in shared_entries:
-                    write_row[i] = 0
-            req = self.queue.popleft()
-            key = jax.random.fold_in(jax.random.key(self._seed), req.uid)
-            if self.draft_model is not None:
+                self._preempt(slot)
+            if not self._admit(slot):
+                break  # pool blocked: the whole queue waits on its head
+            budget = self._advance_prefill(slot, budget, force=force)
+            force = False
+        force = True
+        for slot in list(self._prefill_order):
+            budget = self._advance_prefill(slot, budget, force=force)
+            force = False
+        if any(ph == "decode" for ph in self.slot_phase):
+            if self.draft_model is not None and self._sched.use_speculative(
+                [p for _, p, _ in self._decoding_info()]
+            ):
+                self._spec_decode_pass()
+            else:
+                self._plain_decode_pass()
+        self._expire_window_blocks()
+        return self.active_count
+
+    # ---- scheduler passes (one step() = one tick) -----------------------
+
+    def _decoding_info(self) -> list:
+        """``[(slot, priority, uid), ...]`` for decode-phase slots — the
+        scheduler's victim-selection / speculative-gating view."""
+        return [
+            (slot, req.priority, req.uid)
+            for slot, req in enumerate(self.slot_req)
+            if req is not None and self.slot_phase[slot] == "decode"
+        ]
+
+    def _shed_pass(self, now: float) -> None:
+        """SLO queue-wait enforcement: sheddable requests whose wait has
+        blown ``max_queue_wait_s`` are rejected with a structured
+        :class:`ShedError` (surfaced by the next ``poll``) or demoted
+        once (``shed_action="deprioritize"``) — never silently queued."""
+        cfg = self._sched.config
+        if cfg.max_queue_wait_s is None or not self.queue:
+            return
+        for req in list(self.queue):
+            wait_s = now - req.submit_ts
+            reason = self._sched.shed_on_wait(req.priority, wait_s)
+            if reason is None:
+                continue
+            if cfg.shed_action == "deprioritize":
+                if req.deprioritized or req.priority >= cfg.deprioritize_to:
+                    continue
+                self.queue.remove(req)
+                req.deprioritized = True
+                req.priority = cfg.deprioritize_to
+                self._queue_push(req)
+                self.metrics.on_deprioritize(req.uid)
+                self._log.event(
+                    "shed", action="deprioritize", uid=req.uid, priority=req.priority,
+                    queue_wait_ms=round(wait_s * 1000.0, 3), reason=reason,
+                )
+            else:
+                self.queue.remove(req)
+                err = ShedError(
+                    reason, uid=req.uid, priority=req.priority,
+                    queue_depth=len(self.queue), queue_wait_ms=wait_s * 1000.0,
+                )
+                self._shed[req.uid] = err
+                self._index.pop(req.uid, None)
+                self.metrics.on_shed(req.uid)
+                self._log.event(
+                    "shed", action="reject", uid=req.uid, priority=req.priority,
+                    queue_wait_ms=round(wait_s * 1000.0, 3), reason=reason,
+                )
+
+    def _reserve_blocks(self, req: _Request):
+        """Reserve the paged pool blocks a request needs (resume-aware);
+        ``(owned, shared_entries, table, write_row)`` or None when the
+        pool cannot satisfy it."""
+        plen, prompt_len, max_new = self._request_block_dims(req)
+        lo, hi, alias_hi = self._plan_blocks(plen, prompt_len, max_new)
+        shared_entries: dict[int, int] = {}
+        if req.prefix_id is not None:
+            pids = self._prefixes[req.prefix_id]["block_ids"]
+            # every i in [lo, alias_hi) is registered: the prefix's
+            # lo_min (suffix length 1) lower-bounds any request's lo
+            shared_entries = {i: pids[i] for i in range(lo, alias_hi)}
+        new_ids = self._alloc.alloc((hi - lo) - len(shared_entries))
+        if new_ids is None:
+            return None
+        for bid in shared_entries.values():
+            self._shared_refs[bid] += 1
+        table = np.zeros((self._mb,), np.int32)  # pad/out-of-band -> trash sink
+        owned: dict[int, int] = {}
+        ids = iter(new_ids)
+        for i in range(lo, hi):
+            if i in shared_entries:
+                table[i] = shared_entries[i]
+            else:
+                owned[i] = table[i] = next(ids)
+        # the paste writes ONLY this request's own blocks: shared prefix
+        # entries go to the trash sink in the write row (their canonical
+        # content was written at registration)
+        write_row = table.copy()
+        for i in shared_entries:
+            write_row[i] = 0
+        return owned, shared_entries, table, write_row
+
+    def _admit(self, slot: int) -> bool:
+        """Move the queue head into ``slot`` in the prefill phase,
+        reserving its pool blocks first (paged). Under pool exhaustion,
+        policy may evict the youngest lower-priority decode and retry
+        once; failing that, admission blocks (returns False) and the
+        whole queue waits on its head — no starvation of large requests
+        by later small ones."""
+        jax = _jax()
+        req = self.queue[0]
+        if self.paged:
+            plan = self._reserve_blocks(req)
+            if plan is None:
+                victim = self._sched.pick_victim(req.priority, self._decoding_info())
+                if victim is not None:
+                    self._preempt(victim)
+                    plan = self._reserve_blocks(req)
+            if plan is None:
+                self._pool_blocked = True
+                self.metrics.on_pool_blocked()
+                return False
+            owned, shared_entries, table, write_row = plan
+        self.queue.pop(0)
+        resume = req.preempted and len(req.out_tokens) > 0
+        st: dict = {"req": req, "resume": resume, "bucket": None}
+        if self.paged:
+            self._slot_blocks[slot], self._slot_shared[slot] = owned, shared_entries
+            self._slot_table[slot] = table
+            st["table"], st["write_row"] = table, write_row
+        # the per-request sampling chain: fold the uid at first admission,
+        # carry the evicted chain across a preemption — the resumed stream
+        # continues the SAME chain, so sampled outputs stay request-exact
+        if resume and req.resume_key is not None:
+            st["key"] = req.resume_key
+        else:
+            st["key"] = jax.random.fold_in(jax.random.key(self._seed), req.uid)
+        if self.draft_model is not None:
+            st["bucket"], st["spec"] = self._bucket_for(len(req.prompt)), True
+        elif not resume and req.prefix_id is None and (b := self._bucket_for(len(req.prompt))) is not None:
+            # short prompt, no prefix: the one-shot fused program
+            # (auto-bucketing: the bucketer can mint a new covering
+            # bucket here, so "short" stretches to any prompt <= max_len)
+            st["bucket"] = b
+        else:
+            # prefix-seeded, long, or resumed prompt: chunk windows. The
+            # stored prefix cache is never mutated — jax arrays are
+            # immutable, each request builds on its own copy. A resumed
+            # request recomputes prompt + all-but-last generated tokens;
+            # its last token is re-fed at the recomputed frontier.
+            pre = self._prefixes[req.prefix_id] if req.prefix_id is not None else None
+            parts = ([] if pre is None else [pre["tokens"]]) + [req.prompt]
+            if resume:
+                parts.append(np.asarray(req.out_tokens[:-1], np.int32))
+            st["full"] = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            st["done"] = 0 if pre is None else pre["len"]
+            st["cache"] = None if pre is None else pre["cache"]
+            st["logits"], st["s_last"] = None, 0
+        self.slot_req[slot] = req
+        self.slot_phase[slot] = "prefill"
+        self._prefill_state[slot] = st
+        self._prefill_order.append(slot)
+        self._index[req.uid] = ("active", req)
+        wait_ms = (time.monotonic() - req.submit_ts) * 1000.0
+        self.metrics.on_admit(req.uid, priority=req.priority, queue_wait_ms=wait_ms)
+        if not resume:
+            self._log.event(
+                "admit", uid=req.uid, priority=req.priority, queue_wait_ms=round(wait_ms, 3)
+            )
+        return True
+
+    def _advance_prefill(self, slot: int, budget: float, force: bool = False) -> float:
+        """Spend tick budget on one slot's prefill: whole fused-bucket
+        programs or chunk windows, each claiming its width in tokens.
+        ``force`` lets the first window run even over budget (admission
+        TTFT progress / anti-starvation — also why no budget setting can
+        livelock ``run()``); an unaffordable later window waits for the
+        next tick's budget."""
+        jnp = _jax().numpy
+        st = self._prefill_state[slot]
+        if st is None:
+            return budget
+        req = st["req"]
+        if st["bucket"] is not None:
+            b = st["bucket"]
+            if budget < b and not force:
+                return budget
+            padded = np.zeros((1, b), np.int32)
+            padded[0, : len(req.prompt)] = req.prompt
+            if st.get("spec"):
                 # speculative admit: both models prefill the prompt (greedy)
-                bucket = self._bucket_for(len(req.prompt))
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, : len(req.prompt)] = req.prompt
-                next_tok, lp, row_cache = self._spec_prefill[bucket](
+                next_tok, lp, row_cache = self._spec_prefill[b](
                     self.model.params, self.draft_model.params,
                     jnp.asarray(padded), jnp.int32(len(req.prompt)),
                 )
-                total = len(req.prompt)
-            elif req.prefix_id is None and (bucket := self._bucket_for(len(req.prompt))) is not None:
-                # short prompt, no prefix: the one-shot fused program
-                # (auto-bucketing: the bucketer can mint a new covering
-                # bucket here, so "short" stretches to any prompt <= max_len)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, : len(req.prompt)] = req.prompt
-                next_tok, lp, row_cache, key = self._prefill[bucket](
-                    self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), key
-                )
-                total = len(req.prompt)
+                key = st["key"]
             else:
-                # prefix-seeded and/or long prompt: chunked prefill. The
-                # stored prefix cache is never mutated — jax arrays are
-                # immutable, each request builds on its own copy
-                pre = self._prefixes[req.prefix_id] if req.prefix_id is not None else None
-                full = req.prompt if pre is None else np.concatenate([pre["tokens"], req.prompt])
-                next_tok, lp, row_cache, key = self._chunked_prefill(
-                    full,
-                    row_cache=None if pre is None else pre["cache"],
-                    done_upto=0 if pre is None else pre["len"],
-                    key=key,
+                next_tok, lp, row_cache, key = self._prefill[b](
+                    self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), st["key"]
                 )
-                total = len(full)
-            self._slot_keys = self._slot_keys.at[slot].set(key)
-            if self.paged:
-                self._slot_blocks[slot], self._slot_shared[slot] = owned, shared_entries
-                self._slot_table[slot] = table
-                self.slot_caches = self._paste(
-                    self.slot_caches, row_cache, jnp.asarray(write_row), jnp.asarray(table),
-                    jnp.int32(slot), jnp.int32(total),
-                )
-            else:
-                self.slot_caches = self._insert(self.slot_caches, row_cache, jnp.int32(slot))
-            tok = int(next_tok)
-            self.slot_req[slot] = req
-            req.out_tokens.append(tok)
-            req.out_lps.append(float(lp))
-            self.metrics.on_first_token(req.uid)  # TTFT: prefill's tail token
-            self.metrics.on_tokens(1)
-            if self._finished(req, tok):
-                self._retire(slot)
-                continue
-            self.slot_tok[slot] = tok
+            self._finalize_prefill(slot, row_cache, len(req.prompt), next_tok, lp, key)
+            return budget - b
+        full = st["full"]
+        t = len(full)
+        while st["done"] < t:
+            w, _, _ = self._next_window(t, st["done"])
+            if budget < w and not force:
+                return budget
+            st["logits"], st["cache"], st["s_last"], st["done"] = self._run_window(
+                full, st["done"], st["cache"]
+            )
+            budget -= w
+            force = False
+        cache = self._reset_idx(st["cache"], jnp.int32(t))
+        if st["resume"]:
+            self._finalize_prefill(slot, cache, t, None, None, st["key"])
+        else:
+            next_tok, lp, key = self._sample_at(
+                st["logits"], jnp.int32(t - 1 - st["s_last"]), st["key"]
+            )
+            self._finalize_prefill(slot, cache, t, next_tok, lp, key)
+        return budget
+
+    def _finalize_prefill(self, slot: int, row_cache, total: int, next_tok, lp, key) -> None:
+        """Prefill complete: paste/insert the row cache, move the slot to
+        the decode phase, and either emit the sampled first token (TTFT)
+        or — resume — re-feed the carried last token at the recomputed
+        frontier without sampling anything."""
+        jnp = _jax().numpy
+        st = self._prefill_state[slot]
+        req = st["req"]
+        self._slot_keys = self._slot_keys.at[slot].set(key)
+        if self.paged:
+            self.slot_caches = self._paste(
+                self.slot_caches, row_cache, jnp.asarray(st["write_row"]),
+                jnp.asarray(st["table"]), jnp.int32(slot), jnp.int32(total),
+            )
+        else:
+            self.slot_caches = self._insert(self.slot_caches, row_cache, jnp.int32(slot))
+        self._prefill_state[slot] = None
+        self._prefill_order.remove(slot)
+        self.slot_phase[slot] = "decode"
+        if st["resume"]:
+            # token- and logprob-exact by construction: nothing is
+            # re-sampled; already-streamed tokens/logprobs are untouched
+            self.slot_tok[slot] = int(req.out_tokens[-1])
             self.slot_pos[slot] = total
+            self.metrics.on_resume(req.uid)
+            self._log.event(
+                "resume", uid=req.uid, priority=req.priority,
+                recomputed_tokens=int(total), generated=len(req.out_tokens),
+            )
+            return
+        tok = int(next_tok)
+        req.out_tokens.append(tok)
+        req.out_lps.append(float(lp))
+        if not req.ttft_done:
+            req.ttft_done = True
+            self.metrics.on_first_token(req.uid)  # TTFT: prefill's tail token
+        self.metrics.on_tokens(1)
+        if self._finished(req, tok):
+            self._retire(slot)
+            return
+        self.slot_tok[slot] = tok
+        self.slot_pos[slot] = total
 
-        if self.active_count == 0:
-            return 0
+    def _preempt(self, slot: int) -> None:
+        """Evict a decoding slot: requeue its request with the
+        generated-so-far tokens and its sampling chain, free the slot and
+        its KV blocks now. The resume admission rebuilds the cache by
+        chunked recomputation — see :meth:`_finalize_prefill`."""
+        req = self.slot_req[slot]
+        req.resume_key = self._slot_keys[slot]
+        req.preempted = True
+        self._release(slot)
+        self._queue_push(req)
+        self._index[req.uid] = ("queued", req)
+        self.metrics.on_preempt_decode(req.uid)
+        self._log.event(
+            "preempt_decode", uid=req.uid, priority=req.priority,
+            generated=len(req.out_tokens),
+        )
 
-        if self.draft_model is not None:
-            return self._spec_decode_pass()
-
+    def _plain_decode_pass(self) -> None:
+        """ONE jitted K-step tick for every decode-phase slot, then the
+        host walk that streams tokens/logprobs out. Prefilling slots
+        compute garbage rows by construction (static shapes) — their
+        caches are fully replaced at prefill paste/insert."""
+        jnp = _jax().numpy
         self.slot_caches, toks_k, lps_k, self._slot_keys = self._decode_tick(
             self.model.params, self.slot_caches,
             jnp.asarray(self.slot_tok), jnp.asarray(self.slot_pos), self._slot_keys
@@ -967,43 +1342,52 @@ class ServingEngine:
         toks_k = np.asarray(toks_k)  # [K, slots] — ONE host sync per block
         lps_k = np.asarray(lps_k)
         for slot, req in enumerate(self.slot_req):
-            if req is None:
+            if req is None or self.slot_phase[slot] != "decode":
                 continue
+            n_new, retired = 0, False
             for k in range(self.tick_block):
                 tok = int(toks_k[k, slot])
                 req.out_tokens.append(tok)
                 req.out_lps.append(float(lps_k[k, slot]))
                 self.metrics.on_tokens(1)
+                n_new += 1
                 self.slot_pos[slot] += 1
                 self.slot_tok[slot] = tok
                 if self._finished(req, tok):
-                    self._retire(slot)
+                    retired = True
                     break  # remaining block tokens are overshoot — discarded
+            if n_new:
+                self.metrics.on_tick_tokens(req.uid, n_new)
+            if retired:
+                self._retire(slot)
 
-        if self.paged and self._window is not None:
-            # expire blocks the band can no longer read: entries fully
-            # below frontier - W + 1 return to the pool (owned) or drop a
-            # refcount (shared); their table entries point at the trash
-            # sink before the next tick, so the (masked) reads stay valid
-            bs_ = self._pcfg.block_size
-            for slot, req in enumerate(self.slot_req):
-                if req is None:
-                    continue
-                keep_from = max(0, int(self.slot_pos[slot]) - self._window + 1) // bs_
-                dead_own = [i for i in self._slot_blocks[slot] if i < keep_from]
-                dead_shared = [i for i in self._slot_shared[slot] if i < keep_from]
-                if not dead_own and not dead_shared:
-                    continue
-                for i in dead_own:
-                    self._alloc.free([self._slot_blocks[slot].pop(i)])
-                    self._slot_table[slot][i] = 0
-                for i in dead_shared:
-                    self._shared_refs[self._slot_shared[slot].pop(i)] -= 1
-                    self._slot_table[slot][i] = 0
-                self.slot_caches = self._set_table(
-                    self.slot_caches, jnp.int32(slot), jnp.asarray(self._slot_table[slot])
-                )
-        return self.active_count
+    def _expire_window_blocks(self) -> None:
+        """Sliding-window models: expire blocks the band can no longer
+        read — entries fully below frontier - W + 1 return to the pool
+        (owned) or drop a refcount (shared); their table entries point at
+        the trash sink before the next tick, so the (masked) reads stay
+        valid."""
+        if not self.paged or self._window is None:
+            return
+        jnp = _jax().numpy
+        bs_ = self._pcfg.block_size
+        for slot, req in enumerate(self.slot_req):
+            if req is None or self.slot_phase[slot] != "decode":
+                continue
+            keep_from = max(0, int(self.slot_pos[slot]) - self._window + 1) // bs_
+            dead_own = [i for i in self._slot_blocks[slot] if i < keep_from]
+            dead_shared = [i for i in self._slot_shared[slot] if i < keep_from]
+            if not dead_own and not dead_shared:
+                continue
+            for i in dead_own:
+                self._alloc.free([self._slot_blocks[slot].pop(i)])
+                self._slot_table[slot][i] = 0
+            for i in dead_shared:
+                self._shared_refs[self._slot_shared[slot].pop(i)] -= 1
+                self._slot_table[slot][i] = 0
+            self.slot_caches = self._set_table(
+                self.slot_caches, jnp.int32(slot), jnp.asarray(self._slot_table[slot])
+            )
 
     def run(self) -> dict:
         """Drive ticks until queue and slots drain; returns {uid: tokens}."""
@@ -1049,9 +1433,9 @@ class ServingEngine:
         lps_k = np.asarray(lps_k)
         n_k = np.asarray(n_k)  # [K, slots]
         for slot, req in enumerate(self.slot_req):
-            if req is None:
+            if req is None or self.slot_phase[slot] != "decode":
                 continue
-            retired = False
+            retired, n_new = False, 0
             for k in range(self.tick_block):
                 n = int(n_k[k, slot])
                 self.spec_stats["steps"] += 1  # one target forward spent
@@ -1062,10 +1446,10 @@ class ServingEngine:
                     req.out_lps.append(float(lps_k[k, slot, j]))
                     self.metrics.on_tokens(1)
                     walked += 1
+                    n_new += 1
                     self.slot_pos[slot] += 1
                     self.slot_tok[slot] = tok
                     if self._finished(req, tok):
-                        self._retire(slot)
                         retired = True
                         break
                 # only USED tokens count (a mid-run EOS discards the rest;
@@ -1075,6 +1459,10 @@ class ServingEngine:
                 self.spec_stats["accepted"] += min(walked, n - 1)
                 if retired:
                     break
+            if n_new:
+                self.metrics.on_tick_tokens(req.uid, n_new)
+            if retired:
+                self._retire(slot)
         return self.active_count
 
     def _finished(self, req: _Request, tok: int) -> bool:
@@ -1178,6 +1566,12 @@ class ServingEngine:
         )
 
     @property
+    def scheduler_config(self) -> SchedulerConfig:
+        """The active :class:`~accelerate_tpu.scheduling.SchedulerConfig`
+        (budget, priorities, SLO thresholds, preemption)."""
+        return self._sched.config
+
+    @property
     def program_cache(self):
         """The engine's :class:`~accelerate_tpu.aot.ProgramCache` (every
         prefill bucket and tick program routes through it)."""
@@ -1210,10 +1604,21 @@ class ServingEngine:
         lo, hi, alias_hi = self._plan_blocks(plen, prompt_len, max_new)
         return (hi - lo) - max(0, alias_hi - lo)
 
+    def _request_block_dims(self, req: _Request) -> tuple:
+        """``(plen, prompt_len, max_new)`` for block planning — a
+        preempted request resumes as prompt + all-but-last generated
+        tokens with the remaining budget, which reserves exactly the
+        blocks the original request would have (``hi`` is invariant
+        across preemptions, so resume can never deadlock a pool the
+        original admission fit)."""
+        plen = self._prefixes[req.prefix_id]["len"] if req.prefix_id is not None else 0
+        g = len(req.out_tokens)
+        if req.preempted and g:
+            return plen, len(req.prompt) + g - 1, req.max_new_tokens - g + 1
+        return plen, len(req.prompt), req.max_new_tokens
+
     def _head_new_blocks(self) -> int:
-        head = self.queue[0]
-        plen = self._prefixes[head.prefix_id]["len"] if head.prefix_id is not None else 0
-        return self._new_blocks_for(plen, len(head.prompt), head.max_new_tokens)
+        return self._new_blocks_for(*self._request_block_dims(self.queue[0]))
 
     @property
     def pool_free_blocks(self) -> Optional[int]:
@@ -1229,11 +1634,16 @@ class ServingEngine:
         self._done_new[req.uid] = np.asarray(req.out_tokens, np.int32)
         self._done_lps[req.uid] = np.asarray(req.out_lps, np.float32)
         self._release(slot)
+        self._index[req.uid] = ("done", None)
         self.metrics.on_complete(req.uid)
 
     def _release(self, slot: int):
         """Free a slot's resources without publishing a result (shared by
-        retirement and cancellation)."""
+        retirement, cancellation, and decode preemption)."""
+        self.slot_phase[slot] = None
+        self._prefill_state[slot] = None
+        if slot in self._prefill_order:
+            self._prefill_order.remove(slot)
         if self.paged:
             # Validate shared refcounts BEFORE any mutation (must survive
             # python -O): a tripped invariant must leave the slot, pool, and

@@ -21,6 +21,15 @@ before the causal frontier reaches it — verified token-exact in
 Both models run inside a handful of fixed-shape jitted programs (one
 per (prompt_bucket, gamma)); the host loop only reads the per-iteration
 accept count.
+
+A load-bearing corollary of greedy acceptance: the emitted stream is the
+target's argmax stream for ANY draft behavior — a cold, stale, or even
+garbage draft cache can only lower the acceptance rate, never change a
+token. The serving scheduler's per-priority speculative gating
+(``SchedulerConfig.speculative_priorities``) leans on exactly this: a
+tick whose decode set includes a non-speculative priority class runs the
+plain target tick and leaves the draft caches stale, and the next
+speculative tick is still token-exact.
 """
 
 from __future__ import annotations

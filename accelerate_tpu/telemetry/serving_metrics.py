@@ -52,11 +52,23 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.prefills = 0
         self.preemptions = 0  # admission passes blocked on pool exhaustion
+        # scheduler decisions (accelerate_tpu.scheduling)
+        self.requests_shed = 0  # SLO load shedding (submit reject + queue-wait shed)
+        self.requests_deprioritized = 0
+        self.decode_preemptions = 0  # decoding slots evicted + requeued
+        self.resumes = 0  # preempted requests resumed by recompute
         # latency windows
         self.ttft_ms: collections.deque = collections.deque(maxlen=window)
         self.e2e_ms: collections.deque = collections.deque(maxlen=window)
+        # inter-token latency: one sample per (request, tick) = elapsed
+        # since the request's previous token delivery / tokens delivered
+        # this tick — the per-token stream latency a client observes
+        self.itl_ms: collections.deque = collections.deque(maxlen=window)
+        # submit -> admission wait (the SLO the shed threshold guards)
+        self.queue_wait_ms: collections.deque = collections.deque(maxlen=window)
         # per-inflight-request timing
         self._submit_ts: dict[int, float] = {}
+        self._last_tok_ts: dict[int, float] = {}
         # tokens/sec over a sliding window of (ts, cumulative tokens)
         self._token_marks: collections.deque = collections.deque(maxlen=window)
 
@@ -72,16 +84,32 @@ class ServingMetrics:
         """Called when a request's first generated token lands (the tail
         of its prefill) — the TTFT sample."""
         self.prefills += 1
+        now = self._clock()
+        self._last_tok_ts[uid] = now
         t0 = self._submit_ts.get(uid)
         if t0 is not None:
-            self.ttft_ms.append((self._clock() - t0) * 1000.0)
+            self.ttft_ms.append((now - t0) * 1000.0)
+
+    def on_admit(self, uid: int, priority: int = 0, queue_wait_ms: Optional[float] = None):
+        """Queue-wait sample at the moment a request claims a slot."""
+        if queue_wait_ms is not None:
+            self.queue_wait_ms.append(queue_wait_ms)
 
     def on_tokens(self, n: int = 1):
         self.tokens_generated += n
         self._token_marks.append((self._clock(), self.tokens_generated))
 
+    def on_tick_tokens(self, uid: int, n: int):
+        """ITL sample: ``n`` tokens delivered to ``uid`` this tick."""
+        now = self._clock()
+        t0 = self._last_tok_ts.get(uid)
+        if t0 is not None and n > 0:
+            self.itl_ms.append((now - t0) * 1000.0 / n)
+        self._last_tok_ts[uid] = now
+
     def on_complete(self, uid: int):
         self.requests_completed += 1
+        self._last_tok_ts.pop(uid, None)
         t0 = self._submit_ts.pop(uid, None)
         if t0 is not None:
             self.e2e_ms.append((self._clock() - t0) * 1000.0)
@@ -89,9 +117,31 @@ class ServingMetrics:
     def on_cancel(self, uid: int):
         self.requests_cancelled += 1
         self._submit_ts.pop(uid, None)
+        self._last_tok_ts.pop(uid, None)
 
     def on_pool_blocked(self):
         self.preemptions += 1
+
+    def on_shed(self, uid: Optional[int]):
+        """SLO load shed — submit-time reject (uid None) or a queued
+        request dropped after blowing the wait threshold."""
+        self.requests_shed += 1
+        if uid is not None:
+            self._submit_ts.pop(uid, None)
+
+    def on_deprioritize(self, uid: Optional[int]):
+        self.requests_deprioritized += 1
+
+    def on_preempt_decode(self, uid: int):
+        """A decoding slot was evicted and requeued; the preemption gap
+        must not pollute the ITL window, so the chain restarts at the
+        first post-resume delivery."""
+        self.decode_preemptions += 1
+        self._last_tok_ts.pop(uid, None)
+
+    def on_resume(self, uid: int):
+        self.resumes += 1
+        self._last_tok_ts[uid] = self._clock()
 
     # ------------------------------------------------------------------ #
     # read surface
@@ -147,6 +197,14 @@ class ServingMetrics:
             "ttft_ms_p95": _pct(self.ttft_ms, 95),
             "e2e_ms_p50": _pct(self.e2e_ms, 50),
             "e2e_ms_p95": _pct(self.e2e_ms, 95),
+            "itl_ms_p50": _pct(self.itl_ms, 50),
+            "itl_ms_p95": _pct(self.itl_ms, 95),
+            "queue_wait_ms_p50": _pct(self.queue_wait_ms, 50),
+            "queue_wait_ms_p95": _pct(self.queue_wait_ms, 95),
+            "requests_shed": self.requests_shed,
+            "requests_deprioritized": self.requests_deprioritized,
+            "decode_preemptions": self.decode_preemptions,
+            "resumes": self.resumes,
         }
         return snap
 
@@ -181,6 +239,14 @@ class ServingMetrics:
                [("", self.prefills)])
         metric("preemptions_total", "counter", "Admission passes blocked on KV pool exhaustion",
                [("", self.preemptions)])
+        metric("requests_shed_total", "counter", "Requests rejected by SLO load shedding",
+               [("", self.requests_shed)])
+        metric("requests_deprioritized_total", "counter", "Requests demoted by SLO load shedding",
+               [("", self.requests_deprioritized)])
+        metric("decode_preemptions_total", "counter", "Decoding slots evicted and requeued",
+               [("", self.decode_preemptions)])
+        metric("resumes_total", "counter", "Preempted requests resumed by recompute",
+               [("", self.resumes)])
         metric("queue_depth", "gauge", "Requests waiting for a slot",
                [("", self.queue_depth)])
         metric("active_slots", "gauge", "Slots currently decoding",
@@ -198,4 +264,12 @@ class ServingMetrics:
                [('{quantile="0.5"}', _pct(self.e2e_ms, 50)),
                 ('{quantile="0.95"}', _pct(self.e2e_ms, 95)),
                 ("_count", len(self.e2e_ms))])
+        metric("itl_ms", "summary", "Inter-token latency (ms) per delivered token",
+               [('{quantile="0.5"}', _pct(self.itl_ms, 50)),
+                ('{quantile="0.95"}', _pct(self.itl_ms, 95)),
+                ("_count", len(self.itl_ms))])
+        metric("queue_wait_ms", "summary", "Submit-to-admission queue wait (ms)",
+               [('{quantile="0.5"}', _pct(self.queue_wait_ms, 50)),
+                ('{quantile="0.95"}', _pct(self.queue_wait_ms, 95)),
+                ("_count", len(self.queue_wait_ms))])
         return "\n".join(lines) + "\n"

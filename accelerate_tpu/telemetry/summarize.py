@@ -108,6 +108,26 @@ def summarize(events: list[dict]) -> dict:
     if serving:
         report["serving"] = serving
 
+    # scheduler decisions (serving.py + scheduling.py): every admit /
+    # shed / preempt_decode / resume lands as one event with priority and
+    # queue wait attached, so the report can say WHICH class paid
+    sched = {name: [e for e in events if e.get("kind") == "event" and e.get("name") == name]
+             for name in ("admit", "shed", "preempt_decode", "resume")}
+    if any(sched.values()):
+        waits = [e["queue_wait_ms"] for e in sched["admit"] if e.get("queue_wait_ms") is not None]
+        report["scheduler"] = {
+            "admitted": len(sched["admit"]),
+            "shed": len(sched["shed"]),
+            "preempted": len(sched["preempt_decode"]),
+            "resumed": len(sched["resume"]),
+            "mean_queue_wait_ms": _mean(waits),
+            "p95_queue_wait_ms": _pct(sorted(waits), 95),
+            "shed_by_priority": {
+                str(p): sum(1 for e in sched["shed"] if e.get("priority") == p)
+                for p in sorted({e.get("priority") for e in sched["shed"]})
+            },
+        }
+
     # compile cache (aot/): hit/miss/deserialize + per-bucket serving builds
     cc_hits = [e for e in events if e.get("kind") == "event" and e.get("name") == "compile_cache_hit"]
     cc_miss = [e for e in events if e.get("kind") == "event" and e.get("name") == "compile_cache_miss"]
@@ -234,6 +254,20 @@ def render_text(report: dict) -> str:
         for key, val in serving.items():
             if key not in order and val is not None:
                 lines.append(f"    {key:<18}: {val}")
+    sched = report.get("scheduler")
+    if sched:
+        lines.append("  scheduler:")
+        lines.append(
+            f"    decisions         : {sched['admitted']} admitted | {sched['shed']} shed | "
+            f"{sched['preempted']} preempted | {sched['resumed']} resumed"
+        )
+        if sched.get("mean_queue_wait_ms") is not None:
+            lines.append(
+                f"    queue wait        : mean {sched['mean_queue_wait_ms']} ms / "
+                f"p95 {sched['p95_queue_wait_ms']} ms"
+            )
+        for prio, n in (sched.get("shed_by_priority") or {}).items():
+            lines.append(f"    shed priority {prio}   : {n}")
     cc = report.get("compile_cache")
     if cc:
         lines.append("  compile cache:")

@@ -231,6 +231,49 @@ class TelemetryKwargs(KwargsHandler):
 
 
 @dataclass
+class ServingSchedulerKwargs(KwargsHandler):
+    """Continuous-batching scheduler knobs for
+    :class:`~accelerate_tpu.serving.ServingEngine` — the kwargs-handler
+    mirror of :class:`~accelerate_tpu.scheduling.SchedulerConfig`, so
+    serving deployments configure the scheduler the same way training
+    configures telemetry/compile management. Pass it as
+    ``ServingEngine(..., scheduler=ServingSchedulerKwargs(...))``.
+
+    ``token_budget``: model-compute tokens per engine tick — active
+    decodes claim ``n_decoding x tick_block`` first, the remainder runs
+    prefill *chunks*, so long prompts stream in without stalling running
+    decodes (``None`` = unlimited: prefills complete at admission).
+    ``max_queue_depth`` / ``max_queue_wait_s``: SLO shed thresholds for
+    priorities >= ``shed_priority_floor`` (``shed_action`` picks
+    reject-with-:class:`~accelerate_tpu.scheduling.ShedError` or
+    demote-to-``deprioritize_to``). ``enable_preemption``: evict the
+    youngest decode with priority >= ``preempt_priority_floor`` when a
+    strictly more important request cannot admit; it requeues and
+    resumes token-exactly by recompute. ``speculative_priorities``:
+    with a draft model, restrict the speculative tick to these classes.
+    ``mode="fifo"`` pins the legacy strict-FIFO behavior (benchmark
+    baseline)."""
+
+    mode: str = "continuous"
+    token_budget: Optional[int] = None
+    max_queue_depth: Optional[int] = None
+    max_queue_wait_s: Optional[float] = None
+    shed_priority_floor: int = 1
+    shed_action: str = "reject"
+    deprioritize_to: int = 99
+    enable_preemption: bool = False
+    preempt_priority_floor: int = 1
+    speculative_priorities: Optional[tuple] = None
+
+    def to_scheduler_config(self):
+        """The :class:`~accelerate_tpu.scheduling.SchedulerConfig` the
+        engine consumes (validation happens there)."""
+        from ..scheduling import SchedulerConfig
+
+        return SchedulerConfig(**dataclasses.asdict(self))
+
+
+@dataclass
 class CompileKwargs(KwargsHandler):
     """Compile-management knobs consumed by ``Accelerator.program_cache``
     (see :mod:`accelerate_tpu.aot` and ``docs/usage_guides/compilation.md``).

@@ -692,7 +692,7 @@ def test_serving_engine_numerics_dogfood():
     model = create_llama_model(LlamaConfig.tiny(), seq_len=16)
     eng = ServingEngine(model, num_slots=2, prompt_buckets=(8, 16))
     reports = eng.numerics_check()
-    assert set(reports) == {"prefill", "decode_tick"}
+    assert set(reports) == {"prefill", "decode_tick", "resume_recompute"}
     for name, rep in reports.items():
         assert rep.n_eqns > 50, name
         # the strict-gate rule and the whole tier must be clean on the

@@ -493,7 +493,9 @@ def test_serving_engine_perf_check_dogfood():
     model = create_llama_model(LlamaConfig.tiny(), seq_len=16)
     eng = ServingEngine(model, num_slots=2, prompt_buckets=(8, 16))
     reports = eng.perf_check()
-    assert set(reports) == {"prefill", "decode_tick"}
+    # resume_recompute = the preempt->resume warm chunk window: the
+    # analysis stack covers every program the scheduler can launch
+    assert set(reports) == {"prefill", "decode_tick", "resume_recompute"}
     for name, rep in reports.items():
         assert rep.total_flops > 0, name
         assert rep.predicted_step_ms > 0, name
