@@ -522,6 +522,26 @@ class Accelerator:
         if model is None:
             return
         jax = _jax()
+        if self._zero1_active():
+            layout = self._zero1_layout_for(model)
+            if layout is not None:
+                # ZeRO-1 explicit mode: the state is created over the FLAT
+                # padded parameter vector and *born sharded* over the data
+                # axes (jit + out_shardings) — per-device optimizer HBM is
+                # 1/n from step 0, never materialised replicated
+                def init_flat(p):
+                    return opt.optimizer.init(layout.flatten_pad(p))
+
+                state_shapes = jax.eval_shape(init_flat, model.params)
+                shardings = layout.state_shardings(state_shapes, self.mesh)
+                opt.opt_state = jax.jit(init_flat, out_shardings=shardings)(model.params)
+                opt._zero_shardings = shardings
+                opt._zero1_layout = layout
+                # per-state-leaf true sizes: what elastic restore needs to
+                # re-pad a shard checkpoint onto a different mesh
+                opt._zero1_state_sizes = layout.state_true_sizes(state_shapes)
+                opt._model = model
+                return
         shardings = self._zero_state_shardings(opt.optimizer, model)
         init_shardings = shardings
         plugin = self.state.parallelism_plugin
@@ -614,6 +634,50 @@ class Accelerator:
         return zero_optimizer_shardings(
             state_shapes, getattr(model, "param_shardings", None), self.mesh
         )
+
+    def _zero1_active(self) -> bool:
+        plugin = self.state.parallelism_plugin
+        return plugin is not None and getattr(plugin, "zero_stage", 0) == 1
+
+    def _zero1_layout_for(self, model: Model):
+        """The :class:`~accelerate_tpu.parallel.zero.Zero1Layout` for this
+        model on this mesh, or ``None`` when the data-parallel degree is 1
+        (ZeRO-1 degenerates to the replicated update — nothing to shard).
+        Validates the mode's preconditions: the only non-trivial mesh axes
+        are the batch axes, and params are replicated over them."""
+        from .parallel.mesh import BATCH_AXES
+        from .parallel.zero import Zero1Layout, zero1_axes
+
+        axes = zero1_axes(self.mesh)
+        if not axes:
+            return None
+        bad = [a for a, s in dict(self.mesh.shape).items() if s > 1 and a not in BATCH_AXES]
+        if bad:
+            raise ValueError(
+                f"zero_stage=1 shards the update over the batch axes only; "
+                f"shard-bearing axes {bad} would need their own update semantics"
+            )
+        shardings = getattr(model, "param_shardings", None)
+        if shardings is not None:
+            import jax as _j
+
+            for kp, s in _j.tree_util.tree_flatten_with_path(shardings)[0]:
+                spec_axes = {
+                    a
+                    for entry in tuple(getattr(s, "spec", s) or ())
+                    if entry is not None
+                    for a in (entry if isinstance(entry, tuple) else (entry,))
+                }
+                used = spec_axes & set(axes)
+                if used:
+                    from .parallel.sharding import path_str
+
+                    raise ValueError(
+                        f"zero_stage=1 needs params replicated over the data axes, but "
+                        f"{path_str(kp)} is sharded over {sorted(used)} — use plain FSDP "
+                        "(ZeRO-3 layout) for parameter sharding instead"
+                    )
+        return Zero1Layout(model.params, self.mesh, axes=axes)
 
     def prepare_data_loader(
         self, data_loader, device_placement: Optional[bool] = None, slice_fn_for_dispatch=None, **kwargs
@@ -961,6 +1025,16 @@ class Accelerator:
         ``(loss, (new_state, aux))`` with ``has_aux``). The state updates
         every microbatch, gradient-free. The reference has no analogue
         (torch BN mutates buffers in place); in JAX the state is explicit.
+
+        With ``ParallelismPlugin(zero_stage=1)`` the grad-pmean →
+        replicated-update wire is replaced by reduce-scatter grads →
+        per-replica 1/n flat-segment optimizer update (state born
+        sharded) → all-gather updates, optionally with int8/fp8/bf16
+        quantized legs carrying error feedback
+        (``grad_compression``) — see
+        ``docs/usage_guides/zero_redundancy.md``. fp32 parity with the
+        replicated path is bit-exact; ``do_sync`` turns static (two
+        compiled variants, the offload pattern).
         """
         jax = _jax()
         jnp = _jnp()
@@ -1017,22 +1091,216 @@ class Accelerator:
         backoff_factor = float(getattr(h, "backoff_factor", 0.5))
         growth_interval = int(getattr(h, "growth_interval", 2000))
 
+        def update_scale_state(scale_state, finite, do_sync):
+            """The fp16 dynamic-loss-scale transition (torch GradScaler
+            semantics, applied only on sync boundaries) — shared by the
+            replicated, compressed, and ZeRO-1 paths."""
+            if not use_fp16:
+                return scale_state
+            loss_scale = scale_state["scale"]
+            grown = scale_state["growth"] + 1
+            do_grow = grown >= growth_interval
+            upd_scale = jnp.where(
+                finite,
+                jnp.where(do_grow, loss_scale * growth_factor, loss_scale),
+                jnp.maximum(1.0, loss_scale * backoff_factor),
+            )
+            upd_growth = jnp.where(finite & ~do_grow, grown, 0)
+            return {
+                "scale": jnp.where(do_sync, upd_scale, loss_scale),
+                "growth": jnp.where(do_sync, upd_growth, scale_state["growth"]),
+            }
+
         compress_method = getattr(self.state.parallelism_plugin, "grad_compression", None)
+        zero_layout = getattr(optimizer, "_zero1_layout", None)
         psgd_rank = None
-        if compress_method is not None:
-            if has_state or has_aux:
-                raise ValueError("grad_compression does not compose with has_state/has_aux yet")
+        if compress_method is not None and zero_layout is None:
             bad = [a for a, s in dict(self.mesh.shape).items() if s > 1 and a != "data"]
             if bad:
                 raise ValueError(
                     f"grad_compression reduces over the 'data' axis only; shard-bearing axes {bad} "
-                    "would need their own reduction semantics"
+                    "would need their own reduction semantics (or compose with zero_stage=1, "
+                    "which shards the update over the batch axes)"
                 )
             from .parallel.compression import powersgd_rank
 
             psgd_rank = powersgd_rank(compress_method)
 
+        def parse_out(out, mstate_in):
+            """Normalise a loss_fn return to ``(loss, new_state, aux)``
+            under the has_state/has_aux contract — shared by the implicit
+            path, the compressed-psum path, and the ZeRO-1 path (one
+            definition, so the three can never disagree on the protocol)."""
+            if has_state:
+                loss, rest = out
+                new_state, aux = rest if has_aux else (rest, None)
+            else:
+                loss, aux = out if has_aux else (out, None)
+                new_state = mstate_in
+            return loss, new_state, aux
+
         offload_pull, offload_push = self._offload_transfers(optimizer)
+
+        zero_fns = None
+        if zero_layout is not None:
+            # ZeRO-1 explicit wire: reduce-scatter grads -> per-segment
+            # optimizer update -> all-gather updates, the whole update
+            # inside ONE shard_map over the batch axes. Two compiled
+            # variants keyed on a STATIC do_sync (the offload pattern):
+            # the non-sync microbatch program is grads + reduce-scatter +
+            # accumulate only, and no collective ever sits under a
+            # value-dependent cond (TPU301).
+            from jax.sharding import PartitionSpec as P
+
+            from .parallel.collectives import pmean_floats
+            from .parallel.zero import (
+                all_gather_updates,
+                reduce_scatter_grads,
+                shard_index,
+                sharded_global_norm,
+                zero1_comp_specs,
+            )
+            from .utils.compat import shard_map as _shard_map
+
+            zaxes, z_n = zero_layout.axes, zero_layout.n
+            z_tx = optimizer.optimizer
+            inv_n = 1.0 / z_n  # powers of two stay exact scalings
+            opt_specs = zero_layout.state_specs(optimizer.opt_state)
+            buf_specs = jax.tree_util.tree_unflatten(
+                zero_layout.treedef, [zero_layout.flat_spec()] * len(zero_layout.padded)
+            )
+            comp_specs = zero1_comp_specs(zero_layout, compress_method)
+
+            def zero_body(sync):
+                def body(params, opt_local, buf_local, mstate_in, local_batch, ls, key, clip, cstate):
+                    def local_loss(q):
+                        out = call_loss(compute_cast(q), mstate_in, local_batch, key)
+                        loss, new_state, aux = parse_out(out, mstate_in)
+                        return loss.astype(jnp.float32) * ls, (loss, new_state, aux)
+
+                    g, (loss, new_state, aux) = jax.grad(local_loss, has_aux=True)(params)
+                    # 1/n BEFORE the wire: local losses are means over the
+                    # LOCAL shard, so the reduce-scatter sum of g/n equals
+                    # the baseline's implicit global pmean — and the later
+                    # /denom lands AFTER the reduction, exactly where the
+                    # replicated path divides (bit-exact fp32 parity)
+                    if compress_method is None:
+                        g = jax.tree_util.tree_map(lambda l: l.astype(jnp.float32) * inv_n, g)
+                        g_shard, _ = reduce_scatter_grads(
+                            zero_layout.flatten_pad(g), zaxes, z_n, None, None
+                        )
+                        denom = jnp.maximum(ls, 1.0) * accum
+                        g_shard = jax.tree_util.tree_map(lambda l: l / denom, g_shard)
+                        new_cstate = cstate
+                    else:
+                        # unscale BEFORE quantizing: the error-feedback
+                        # residual must live in true gradient units, or a
+                        # dynamic loss-scale change mis-weights the carry.
+                        # The scaler clamps the scale at >= 1 (backoff
+                        # floor), so the maximum() is an exact no-op that
+                        # makes the division provably guarded (TPU603)
+                        g = jax.tree_util.tree_map(
+                            lambda l: l.astype(jnp.float32) / jnp.maximum(ls, 1.0) * inv_n, g
+                        )
+                        rs_err = jax.tree_util.tree_map(lambda e: e[0], cstate["rs_error"])
+                        if use_fp16:
+                            # one overflowed microbatch must not poison the
+                            # carried residual (the PowerSGD discipline):
+                            # keep the old carry and hand NaN shards to the
+                            # sync-boundary finite gate
+                            ok = jnp.bool_(True)
+                            for l in jax.tree_util.tree_leaves(g):
+                                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(l)))
+                            ok = jax.lax.psum(ok.astype(jnp.int32), zaxes) == jax.lax.psum(1, zaxes)
+                        g_shard, new_rs = reduce_scatter_grads(
+                            zero_layout.flatten_pad(g), zaxes, z_n, compress_method, rs_err
+                        )
+                        if use_fp16:
+                            g_shard = jax.tree_util.tree_map(
+                                lambda l: jnp.where(ok, l, jnp.float32(jnp.nan)), g_shard
+                            )
+                            new_rs = jax.tree_util.tree_map(
+                                lambda new, old: jnp.where(ok, new, old), new_rs, rs_err
+                            )
+                        g_shard = jax.tree_util.tree_map(lambda l: l / accum, g_shard)
+                        new_cstate = {
+                            "rs_error": jax.tree_util.tree_map(lambda e: e[None], new_rs),
+                            "ag_error": cstate["ag_error"],
+                        }
+                    buf_local = jax.tree_util.tree_map(lambda b, s: b + s, buf_local, g_shard)
+                    loss = jax.lax.pmean(loss, zaxes)
+                    new_state = pmean_floats(new_state, zaxes)
+                    aux = pmean_floats(aux, zaxes)
+                    if not sync:
+                        return (
+                            params, opt_local, buf_local, new_state, loss,
+                            jnp.float32(0.0), jnp.bool_(True), aux, new_cstate,
+                        )
+                    # sync boundary: the global norm is a psum of local
+                    # partial sums over the shards — never a gather
+                    gnorm = sharded_global_norm(buf_local, zaxes)
+                    cscale = jnp.where(clip >= 0, jnp.minimum(1.0, clip / (gnorm + 1e-6)), 1.0)
+                    gbuf = jax.tree_util.tree_map(lambda t: t * cscale, buf_local)
+                    finite = jnp.isfinite(gnorm)
+                    idx = shard_index(zaxes, zero_layout.mesh_shape)
+                    p_local = zero_layout.local_slice(zero_layout.flatten_pad(params), idx)
+
+                    def do_update(_):
+                        return z_tx.update(gbuf, opt_local, p_local)
+
+                    def hold(_):
+                        return jax.tree_util.tree_map(jnp.zeros_like, gbuf), opt_local
+
+                    if use_fp16:
+                        updates, new_opt = jax.lax.cond(finite, do_update, hold, operand=None)
+                    else:
+                        updates, new_opt = do_update(None)
+                    if compress_method is None:
+                        # exact path: apply the update to the param segment
+                        # INSIDE the shard body — the add fuses with the
+                        # optimizer chain exactly as the replicated path's
+                        # does (same FMA opportunities, bit-exact fp32
+                        # parity) — and all-gather the new segments
+                        new_seg = jax.tree_util.tree_map(
+                            lambda p, u: p + u.astype(p.dtype), p_local, updates
+                        )
+                        p_full, _ = all_gather_updates(new_seg, zaxes, z_n, None, None)
+                        new_params = zero_layout.unflatten(p_full)
+                    else:
+                        # quantized path: gather the quantized UPDATES (not
+                        # params — update deltas are small-range and carry
+                        # per-rank error feedback; every replica applies the
+                        # IDENTICAL decoded vector, so params never drift)
+                        ag_err = cstate["ag_error"]
+                        if use_fp16:
+                            # a held step must not flush the pending
+                            # residual into the params
+                            ag_err = jax.tree_util.tree_map(
+                                lambda e: jnp.where(finite, e, jnp.zeros_like(e)), ag_err
+                            )
+                        u_full, new_ag = all_gather_updates(
+                            updates, zaxes, z_n, compress_method, ag_err
+                        )
+                        if use_fp16:
+                            new_ag = jax.tree_util.tree_map(
+                                lambda a, b: jnp.where(finite, a, b), new_ag, cstate["ag_error"]
+                            )
+                        new_cstate = {**new_cstate, "ag_error": new_ag}
+                        new_params = jax.tree_util.tree_map(
+                            lambda p, u: p + u.astype(p.dtype), params, zero_layout.unflatten(u_full)
+                        )
+                    zero_buf = jax.tree_util.tree_map(jnp.zeros_like, buf_local)
+                    return new_params, new_opt, zero_buf, new_state, loss, gnorm, finite, aux, new_cstate
+
+                return _shard_map(
+                    body,
+                    mesh=self.mesh,
+                    in_specs=(P(), opt_specs, buf_specs, P(), P(zaxes), P(), P(), P(), comp_specs),
+                    out_specs=(P(), opt_specs, buf_specs, P(), P(), P(), P(), P(), comp_specs),
+                    check_vma=False,
+                )
+
+            zero_fns = {True: zero_body(True), False: zero_body(False)}
 
         def step_fn(params, opt_state, grad_buf, mstate, batch, scale_state, do_sync, rng, clip_norm, comp_state):
             # With offload, do_sync is a STATIC python bool (two compiled
@@ -1048,29 +1316,47 @@ class Accelerator:
             loss_scale = scale_state["scale"]
             new_comp_state = comp_state
 
+            if zero_fns is not None:
+                # the whole reduce-scatter/update/all-gather step runs in
+                # one shard_map; do_sync is static (see zero_fns above)
+                (new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_comp_state) = (
+                    zero_fns[bool(do_sync)](
+                        params, opt_state, grad_buf, mstate, batch, loss_scale, rng, clip_norm, comp_state
+                    )
+                )
+                return (
+                    new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux,
+                    update_scale_state(scale_state, finite, do_sync), new_comp_state,
+                )
+
             def scaled_loss(p):
                 out = call_loss(compute_cast(p), mstate, batch, rng)
-                if has_state:
-                    loss, rest = out
-                    new_state, aux = rest if has_aux else (rest, None)
-                else:
-                    loss, aux = (out if has_aux else (out, None))
-                    new_state = mstate
+                loss, new_state, aux = parse_out(out, mstate)
                 return loss.astype(jnp.float32) * loss_scale, (loss, new_state, aux)
 
             if compress_method is not None:
                 # explicit per-shard grads + compressed psum (the DDP comm
-                # hook analogue) instead of XLA's implicit f32 reduction
+                # hook analogue) instead of XLA's implicit f32 reduction.
+                # Mutable state / aux ride along per microbatch: each shard
+                # computes them on its local batch and the float leaves are
+                # pmean'd (cross-replica BatchNorm-sync semantics — the
+                # closest SPMD analogue of the implicit path's global-batch
+                # statistics).
                 from jax.sharding import PartitionSpec as P
 
+                from .parallel.collectives import pmean_floats
                 from .parallel.compression import compressed_psum_mean, powersgd_psum_mean
 
-                def local_grads(p, local_batch, ls, key, cstate):
+                def local_grads(p, mstate_in, local_batch, ls, key, cstate):
                     def local_loss(q):
-                        out = call_loss(compute_cast(q), None, local_batch, key)
-                        return out.astype(jnp.float32) * ls, out
+                        out = call_loss(compute_cast(q), mstate_in, local_batch, key)
+                        loss, new_state, aux = parse_out(out, mstate_in)
+                        return loss.astype(jnp.float32) * ls, (loss, new_state, aux)
 
-                    g, local_l = jax.grad(local_loss, has_aux=True)(p)
+                    g, (local_l, new_state, aux) = jax.grad(local_loss, has_aux=True)(p)
+                    local_l = jax.lax.pmean(local_l, "data")
+                    new_state = pmean_floats(new_state, "data")
+                    aux = pmean_floats(aux, "data")
                     # unscale BEFORE compressing: the PowerSGD residual (and
                     # the int8 quantization error) must live in true gradient
                     # units, or every dynamic loss-scale change mis-weights
@@ -1078,7 +1364,7 @@ class Accelerator:
                     g = jax.tree_util.tree_map(lambda l: l.astype(jnp.float32) / ls, g)
                     if psgd_rank is None:
                         g = compressed_psum_mean(g, "data", compress_method)
-                        return g, jax.lax.pmean(local_l, "data"), cstate
+                        return g, local_l, new_state, aux, cstate
                     # PowerSGD: one non-finite microbatch (fp16 overflow)
                     # must not poison the carried residual/Q — keep the old
                     # state and let the non-finite reduced gradient trip the
@@ -1100,7 +1386,7 @@ class Accelerator:
                         "error": jax.tree_util.tree_map(lambda e: e[None], new_local["error"]),
                         "q": new_local["q"],
                     }
-                    return g, jax.lax.pmean(local_l, "data"), new_cstate
+                    return g, local_l, new_state, aux, new_cstate
 
                 comp_spec = {"error": P("data"), "q": P()} if psgd_rank is not None else {}
                 from .utils.compat import shard_map as _shard_map
@@ -1108,12 +1394,13 @@ class Accelerator:
                 sm = _shard_map(
                     local_grads,
                     mesh=self.mesh,
-                    in_specs=(P(), P(("data", "fsdp")), P(), P(), comp_spec),
-                    out_specs=(P(), P(), comp_spec),
+                    in_specs=(P(), P(), P(("data", "fsdp")), P(), P(), comp_spec),
+                    out_specs=(P(), P(), P(), P(), comp_spec),
                     check_vma=False,
                 )
-                grads, loss, new_comp_state = sm(params, batch, loss_scale, rng, comp_state)
-                new_state, aux = mstate, None
+                grads, loss, new_state, aux, new_comp_state = sm(
+                    params, mstate, batch, loss_scale, rng, comp_state
+                )
             else:
                 grads, (loss, new_state, aux) = jax.grad(scaled_loss, has_aux=True)(params)
             # compressed grads are already unscaled inside local_grads.
@@ -1152,28 +1439,21 @@ class Accelerator:
                     new_opt = jax.lax.with_sharding_constraint(new_opt, zero_shardings)
                 new_buf = jax.lax.with_sharding_constraint(new_buf, buf_shardings)
 
-            new_scale_state = scale_state
-            if use_fp16:
-                # dynamic loss scale lives ON DEVICE (torch GradScaler
-                # semantics, applied only on sync boundaries): no host
-                # round-trip per boundary — the 5 MB/s-tunnel/stall fix
-                grown = scale_state["growth"] + 1
-                do_grow = grown >= growth_interval
-                upd_scale = jnp.where(
-                    finite,
-                    jnp.where(do_grow, loss_scale * growth_factor, loss_scale),
-                    jnp.maximum(1.0, loss_scale * backoff_factor),
-                )
-                upd_growth = jnp.where(finite & ~do_grow, grown, 0)
-                new_scale_state = {
-                    "scale": jnp.where(do_sync, upd_scale, loss_scale),
-                    "growth": jnp.where(do_sync, upd_growth, scale_state["growth"]),
-                }
+            # dynamic loss scale lives ON DEVICE (torch GradScaler
+            # semantics, applied only on sync boundaries): no host
+            # round-trip per boundary — the 5 MB/s-tunnel/stall fix
+            new_scale_state = update_scale_state(scale_state, finite, do_sync)
             return new_params, new_opt, new_buf, new_state, loss, gnorm, finite, aux, new_scale_state, new_comp_state
 
-        zero_shardings = getattr(optimizer, "_zero_shardings", None)
+        zero_shardings = None if zero_layout is not None else getattr(optimizer, "_zero_shardings", None)
         buf_shardings = None
-        if zero_shardings is not None:
+        if zero_layout is not None:
+            # the accumulation buffer lives in the flat 1/n-per-device
+            # layout (the ZeRO-2 flavour rides along for free: grads are
+            # reduce-scattered every microbatch, so the buffer never
+            # materialises replicated)
+            buf_shardings = zero_layout.flat_shardings(self.mesh)
+        elif zero_shardings is not None:
             from .parallel.sharding import zero_optimizer_shardings
 
             buf_shardings = zero_optimizer_shardings(
@@ -1181,7 +1461,7 @@ class Accelerator:
             )
 
         donate_args = ((0, 1, 2, 3) if has_state else (0, 1, 2)) if donate else ()
-        if donate and psgd_rank is not None:
+        if donate and (psgd_rank is not None or (zero_layout is not None and compress_method is not None)):
             donate_args = donate_args + (9,)  # the params-sized error-feedback carry
         if offload_pull is not None:
             # the host-resident state can't be donated to device outputs
@@ -1189,6 +1469,11 @@ class Accelerator:
             # do_sync turns static (two program variants) so non-sync
             # microbatches never stream the state — see step_fn.
             donate_args = tuple(i for i in donate_args if i != 1)
+            jitted = jax.jit(step_fn, donate_argnums=donate_args, static_argnums=(6,))
+            step_statics = (6,)
+        elif zero_layout is not None:
+            # static do_sync: two program variants, no collective under a
+            # value-dependent cond (see zero_fns)
             jitted = jax.jit(step_fn, donate_argnums=donate_args, static_argnums=(6,))
             step_statics = (6,)
         else:
@@ -1203,14 +1488,37 @@ class Accelerator:
                 jitted, name="train_step", static_argnums=step_statics
             )
 
-        grad_buf = jax.jit(
-            lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p),
-            out_shardings=buf_shardings,
-        )(model.params)
+        if zero_layout is not None:
+            # flat-padded buffer leaves, born 1/n-per-device
+            grad_buf = jax.jit(
+                lambda p: jax.tree_util.tree_map(
+                    lambda x: jnp.zeros_like(x, dtype=jnp.float32), zero_layout.flatten_pad(p)
+                ),
+                out_shardings=buf_shardings,
+            )(model.params)
+        else:
+            grad_buf = jax.jit(
+                lambda p: jax.tree_util.tree_map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), p),
+                out_shardings=buf_shardings,
+            )(model.params)
         if not hasattr(self, "_fast_scale_boxes"):
             self._fast_scale_boxes = []
         comp_state0 = {}
-        if psgd_rank is not None:
+        if zero_layout is not None and compress_method is not None:
+            from .parallel.zero import zero1_comp_shardings, zero1_comp_template
+
+            template = zero1_comp_template(zero_layout, compress_method)
+            # build the residual carries ALREADY sharded (jit +
+            # out_shardings): the rs_error carry is n x params f32 global —
+            # materializing it replicated first would put all of it on one
+            # device
+            comp_state0 = jax.jit(
+                lambda: jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), template
+                ),
+                out_shardings=zero1_comp_shardings(zero_layout, compress_method, self.mesh),
+            )()
+        elif psgd_rank is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from .parallel.compression import powersgd_init_state
@@ -1230,16 +1538,26 @@ class Accelerator:
                     ),
                 },
             )(model.params)
+        from jax.sharding import NamedSharding as _NS, PartitionSpec as _PS
+
         state_box = {
             "grad_buf": grad_buf,
             "micro": 0,
             # fp16 dynamic loss scale as carried device arrays (no host
             # fetch per boundary); refreshed to the host copy every
-            # _SCALE_REFRESH boundaries for introspection/checkpointing
-            "scale_state": {
-                "scale": jnp.float32(self._loss_scale),
-                "growth": jnp.int32(self._scale_growth_tracker),
-            },
+            # _SCALE_REFRESH boundaries for introspection/checkpointing.
+            # Committed mesh-replicated UP FRONT: after the first step the
+            # carried scale comes back replicated over the whole mesh, and
+            # a device-0-committed initial value would give the program a
+            # second (then third, with static do_sync variants) cache
+            # entry — a recompile the watchdog rightly flags
+            "scale_state": jax.device_put(
+                {
+                    "scale": jnp.float32(self._loss_scale),
+                    "growth": jnp.int32(self._scale_growth_tracker),
+                },
+                _NS(self.mesh, _PS()),
+            ),
             "boundaries": 0,
             # PowerSGD error-feedback + warm-start factors (empty unless
             # grad_compression="powersgd[:r]")
@@ -1269,7 +1587,7 @@ class Accelerator:
                     getattr(model, "state", None) if has_state else None,
                     batch,
                     state_box["scale_state"],
-                    bool(do_sync) if offload_push is not None else jnp.bool_(do_sync),
+                    bool(do_sync) if (offload_push is not None or zero_layout is not None) else jnp.bool_(do_sync),
                     key_for_step(self.step),
                     jnp.float32(-1.0 if self._clip_max_norm is None else self._clip_max_norm),
                     state_box["comp_state"],
@@ -1503,6 +1821,12 @@ class Accelerator:
         jnp = _jnp()
         model = getattr(opt, "_model", None) or self._models[-1]
         self._ensure_opt_state(opt, model)
+        if getattr(opt, "_zero1_layout", None) is not None:
+            raise NotImplementedError(
+                "zero_stage=1 shards the update across replicas inside the jitted fast "
+                "path; drive training through build_train_step (the imperative "
+                "backward/step path would need a replicated optimizer state)"
+            )
         _, grad_buffer = self._buffer_for(model)
         if grad_buffer is None:
             return True
@@ -1689,13 +2013,21 @@ class Accelerator:
 
     def _seed_loss_scale_to_device(self):
         """Push the host scale into every built train step's carried device
-        state (load_state must take effect on steps built BEFORE the load)."""
+        state (load_state must take effect on steps built BEFORE the load).
+        Mesh-replicated like the build-time init, so re-seeding never
+        hands the jitted step a differently-committed scale (= recompile)."""
+        jax = _jax()
         jnp = _jnp()
+        from jax.sharding import NamedSharding, PartitionSpec
+
         for box in getattr(self, "_fast_scale_boxes", []) or []:
-            box["scale_state"] = {
-                "scale": jnp.float32(self._loss_scale),
-                "growth": jnp.int32(self._scale_growth_tracker),
-            }
+            box["scale_state"] = jax.device_put(
+                {
+                    "scale": jnp.float32(self._loss_scale),
+                    "growth": jnp.int32(self._scale_growth_tracker),
+                },
+                NamedSharding(self.mesh, PartitionSpec()),
+            )
 
     def save_state(self, output_dir: Optional[str] = None, **save_model_func_kwargs):
         """Atomic checkpoint save (tmp-dir write -> barrier -> manifest ->

@@ -138,6 +138,47 @@ _WIRE_FACTORS = {
 
 COLLECTIVE_PRIMS = frozenset(_WIRE_FACTORS)
 
+#: n->inf limits of the ring factors ON THE TOTAL PAYLOAD (the
+#: convention :func:`ring_wire_bytes` prices): an all-reduce tends to 2
+#: payload transfers, reduce-scatter / all-gather / all-to-all to 1, a
+#: permute is always 1. Stated by hand (not computed) so the historical
+#: asymptotic accounting in ``parallel.compression.wire_bytes`` stays
+#: exact integers.
+_WIRE_FACTOR_LIMITS = {
+    "psum": 2.0,
+    "pmean": 2.0,
+    "pmax": 2.0,
+    "pmin": 2.0,
+    "all_gather": 1.0,
+    "all_to_all": 1.0,
+    "psum_scatter": 1.0,
+    "reduce_scatter": 1.0,
+    "ppermute": 1.0,
+    "pshuffle": 1.0,
+}
+
+
+def ring_wire_bytes(prim_name: str, total_bytes: int, n: Optional[int] = None) -> int:
+    """Per-device ring wire bytes for ``prim_name`` moving/reducing a
+    TOTAL payload of ``total_bytes`` over an ``n``-group — THE shared
+    formula: ``parallel.compression.wire_bytes`` and the telemetry HLO
+    wire counter both delegate here, so the units of truth cannot drift
+    from :data:`_WIRE_FACTORS` (which price the jaxpr *operand*: note the
+    all_gather operand there is the per-shard input, ``total/n``).
+
+    ``n=None`` is the large-``n`` limit (:data:`_WIRE_FACTOR_LIMITS`) —
+    the mesh-independent accounting the compression docs quote."""
+    if n is None:
+        return int(round(total_bytes * _WIRE_FACTOR_LIMITS[prim_name]))
+    if n <= 1:
+        return 0
+    factor = _WIRE_FACTORS[prim_name]
+    # _WIRE_FACTORS operand conventions: all_gather takes the per-shard
+    # input; everything else takes the full payload
+    if prim_name == "all_gather":
+        return int(round((total_bytes / n) * factor(n)))
+    return int(round(total_bytes * factor(n)))
+
 
 @dataclass
 class CollectiveRecord:

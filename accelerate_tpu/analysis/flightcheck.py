@@ -192,7 +192,15 @@ def _nbytes(aval) -> int:
     dtype = getattr(aval, "dtype", None)
     if shape is None or dtype is None:
         return 0
-    return int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (PRNG keys) aren't numpy dtypes; they expose
+        # itemsize directly (or contribute nothing to the byte model) —
+        # without this, flight-checking any step that threads an rng key
+        # dies on `key<fry>`
+        itemsize = int(getattr(dtype, "itemsize", 0) or 0)
+    return int(np.prod(shape or (1,))) * itemsize
 
 
 def _describe(aval) -> str:

@@ -450,6 +450,17 @@ COMPRESSION_NUMERICS: dict[str, CompressionNumerics] = {
         bound=lambda amax, n: amax / 254.0 + amax * (1.0 + 1.0 / 254.0) / 254.0,
         describe="per-element |error| <= amax/254 per phase (~amax/127 end-to-end)",
     ),
+    "fp8": CompressionNumerics(
+        method="fp8",
+        wire_dtype="float8_e4m3fn",
+        error_feedback=False,
+        # values scale so amax -> 240; near the top of the range e4m3's
+        # ulp is 16, so per phase |err| <= 8/240*amax = amax/30 (3
+        # mantissa bits: relative 2^-4 everywhere else); two phases with
+        # amax2 <= amax*(1+1/30)
+        bound=lambda amax, n: amax / 30.0 + amax * (1.0 + 1.0 / 30.0) / 30.0,
+        describe="per-element |error| <= amax/30 per phase (~amax/15 end-to-end; e4m3 ulp at the range top)",
+    ),
     "powersgd": CompressionNumerics(
         method="powersgd",
         wire_dtype="float32",

@@ -194,6 +194,9 @@ COLLECTIVE_EFFECTS: dict = {
     # host-level preemption agreement: every rank participates, the
     # result is uniform by construction (it's a max-reduce)
     "agree_preempt_max": CallEffect(("collective:agree_preempt_max",)),
+    # float-leaves-only pmean over a pytree (ZeRO-1 / compressed-path
+    # mutable-state sync): every rank participates per float leaf
+    "pmean_floats": CallEffect(("collective:pmean_floats",)),
 }
 
 #: jax-level collective primitives (any receiver except numpy-likes).

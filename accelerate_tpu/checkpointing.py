@@ -217,6 +217,65 @@ def _load_pytree(path: Path, like, mesh=None):
         return ckptr.restore(path.absolute(), abstract)
 
 
+def _load_zero1_opt_state(path: Path, opt, saved_topo, mesh=None, log=None, ckpt: str = ""):
+    """Elastic restore of ZeRO-1 flat-shard optimizer state across a mesh
+    change.
+
+    The state's flat leaves have GLOBAL length ``n*ceil(size/n)`` — a
+    function of the saving run's data-parallel degree ``n`` — so a
+    changed mesh changes the saved shapes and a same-shape orbax restore
+    cannot apply. The segment concatenation order is rank order, so the
+    first ``size`` elements of each saved flat vector are the true values
+    (padding is always the tail): restore at the SAVED padding
+    (replicated hosts are fine — these arrays are 1/n-sized), strip to
+    the true size recorded by ``Zero1Layout.state_true_sizes``, re-pad
+    for the live degree, and ``device_put`` onto the live 1/n shardings.
+    Scalars and unmatched leaves restore as-is."""
+    import orbax.checkpoint as ocp
+    import jax
+
+    from .parallel.zero import Zero1Layout
+
+    layout = opt._zero1_layout
+    true_sizes = getattr(opt, "_zero1_state_sizes", None) or []
+    saved_n = int((saved_topo or {}).get("data_parallel_degree") or layout.n)
+
+    leaves, treedef = jax.tree_util.tree_flatten(opt.opt_state)
+    if len(true_sizes) != len(leaves):  # defensive: stale metadata
+        true_sizes = [None] * len(leaves)
+
+    def saved_abstract(leaf, size):
+        if size is None:
+            return jax.ShapeDtypeStruct(np.shape(leaf), leaf.dtype)
+        saved_len = ((size + saved_n - 1) // saved_n) * saved_n
+        return jax.ShapeDtypeStruct((saved_len,), leaf.dtype)
+
+    abstract = jax.tree_util.tree_unflatten(
+        treedef, [saved_abstract(l, s) for l, s in zip(leaves, true_sizes)]
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path.absolute(), abstract)
+    restored_leaves = treedef.flatten_up_to(restored)
+
+    placed = []
+    repadded = 0
+    for live, saved, size in zip(leaves, restored_leaves, true_sizes):
+        arr = np.asarray(jax.device_get(saved))
+        if size is not None and arr.shape != np.shape(live):
+            arr = Zero1Layout.repad(arr, size, layout.n)
+            repadded += 1
+        placed.append(jax.device_put(arr.astype(live.dtype), live.sharding))
+    if log is not None:
+        log.event(
+            "ckpt_zero1_repad",
+            dir=ckpt,
+            saved_degree=saved_n,
+            live_degree=layout.n,
+            repadded_leaves=repadded,
+        )
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
 def _telemetry_log(accelerator):
     """The live telemetry EventLog, or None. Reads the private slot on
     purpose: checkpointing must not be the thing that instantiates
@@ -568,7 +627,20 @@ def load_accelerator_state(accelerator, input_dir: Optional[str] = None, **kwarg
     for i, opt in enumerate(accelerator._optimizers):
         path = inp / (f"{OPTIMIZER_NAME}_{i}" if i > 0 else OPTIMIZER_NAME)
         if path.exists() and opt.opt_state is not None:
-            opt.opt_state = _load_pytree(path, opt.opt_state, mesh=mesh)
+            layout = getattr(opt, "_zero1_layout", None)
+            if layout is not None and elastic:
+                # ZeRO-1 flat-shard state: the GLOBAL flat length is
+                # n*ceil(size/n), so a mesh change changes the saved
+                # arrays' shapes — restore at the SAVED padding (the
+                # manifest records the saving run's data-parallel
+                # degree), strip the tail padding, re-pad for the live
+                # degree, and land the leaves back on their 1/n-per-
+                # device homes
+                opt.opt_state = _load_zero1_opt_state(
+                    path, opt, saved_topo, mesh=mesh, log=log, ckpt=str(inp)
+                )
+            else:
+                opt.opt_state = _load_pytree(path, opt.opt_state, mesh=mesh)
             host = getattr(opt, "_offload_shardings", None)
             if host is not None:
                 # orbax restores into default (device) memory even when the

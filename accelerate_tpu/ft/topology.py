@@ -121,6 +121,7 @@ def build_topology_record(accelerator, array_trees: Sequence[tuple]) -> dict:
     for tag, tree in array_trees:
         arrays.update(capture_array_specs(tag, tree))
     mesh = accelerator.mesh
+    plugin = getattr(accelerator.state, "parallelism_plugin", None)
     return {
         "schema_version": TOPOLOGY_SCHEMA_VERSION,
         "process_count": int(accelerator.num_processes),
@@ -128,6 +129,10 @@ def build_topology_record(accelerator, array_trees: Sequence[tuple]) -> dict:
         "mesh_devices": int(mesh.size),
         "dcn_axes": list(dcn_axes()),
         "data_parallel_degree": int(data_parallel_size(mesh)),
+        # ZeRO-1 flat-shard optimizer state is padded to a multiple of the
+        # data-parallel degree; an elastic restore re-pads using the two
+        # degrees, and `checkpoints describe` surfaces the mode
+        "zero_stage": int(getattr(plugin, "zero_stage", 0) or 0) if plugin is not None else 0,
         "seed": get_seed(),
         "arrays": arrays,
     }

@@ -106,10 +106,24 @@ class AcceleratedOptimizer:
         new = state_dict["leaves"]
         if len(new) != len(leaves):
             raise ValueError(f"optimizer state has {len(leaves)} leaves, checkpoint has {len(new)}")
+        # ZeRO-1 flat-shard state: the global flat length is a function of
+        # the data-parallel degree (n*ceil(size/n)); a snapshot taken at a
+        # different degree is re-padded — padding is always the tail, so
+        # strip-then-pad preserves every true value (the orbax checkpoint
+        # path does the same in checkpointing._load_zero1_opt_state)
+        layout = getattr(self, "_zero1_layout", None)
+        sizes = getattr(self, "_zero1_state_sizes", None) or [None] * len(leaves)
         placed = []
-        for old, arr in zip(leaves, new):
+        for old, arr, size in zip(leaves, new, sizes):
+            arr = np.asarray(arr)
+            if (
+                layout is not None
+                and size is not None
+                and arr.shape != getattr(old, "shape", None)
+            ):
+                arr = layout.repad(arr, size, layout.n)
             if hasattr(old, "sharding"):
-                arr = jax.device_put(np.asarray(arr).astype(old.dtype), old.sharding)
+                arr = jax.device_put(arr.astype(old.dtype), old.sharding)
             placed.append(arr)
         self.opt_state = jax.tree_util.tree_unflatten(treedef, placed)
 

@@ -23,4 +23,11 @@ from .sharding import (
     spec_for_path,
 )
 from .pipeline import PipelinedModel, pipeline_apply, prepare_pipeline, stage_sharding
+from .zero import (
+    Zero1Layout,
+    all_gather_updates,
+    reduce_scatter_grads,
+    sharded_global_norm,
+    zero1_axes,
+)
 from . import collectives

@@ -39,6 +39,25 @@ def reduce_scatter_sum(x: Any, axis_name: str, *, scatter_dimension: int = 0):
     )
 
 
+def pmean_floats(x: Any, axis_name):
+    """Mean-reduce only the floating leaves of a pytree over ``axis_name``
+    (inside ``shard_map``); everything else passes through shard-local.
+    This is the cross-replica semantics for mutable model state and aux
+    outputs on the explicit per-shard-grad paths (ZeRO-1 and compressed
+    reductions): float statistics (BatchNorm running stats, metric means)
+    average across replicas — the SPMD analogue of the implicit path's
+    global-batch statistics — while integer/bool leaves (counters, masks)
+    stay local."""
+    import jax.numpy as jnp
+
+    def reduce_leaf(t):
+        if hasattr(t, "dtype") and jnp.issubdtype(t.dtype, jnp.floating):
+            return lax.pmean(t, axis_name)
+        return t
+
+    return jax.tree_util.tree_map(reduce_leaf, x)
+
+
 def ppermute_next(x: Any, axis_name: str, axis_size: int):
     """Rotate values to the next rank on a ring (the building block of ring
     attention and pipeline microbatch hand-off)."""

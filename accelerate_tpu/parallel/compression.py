@@ -15,11 +15,12 @@ ethernet case. There the step computes per-shard gradients under
 
 * ``bf16``: cast each leaf to bfloat16, psum, cast back — 2x fewer bytes,
   the reference's bf16_compress_hook.
-* ``int8``: per-leaf symmetric quantization reduced in two phases
-  (all_to_all codes -> local f32 segment sum -> re-quantize -> all_gather
-  codes) so int8 stays on the wire end to end: ~2 B/elem moved vs ~8 for
-  an f32 ring allreduce. Shared pmax'd scales keep every host's decode
-  identical.
+* ``int8`` / ``fp8``: per-leaf symmetric quantization reduced in two
+  phases (all_to_all codes -> local f32 segment sum -> re-quantize ->
+  all_gather codes) so the 1-byte codes stay on the wire end to end:
+  ~2 B/elem moved vs ~8 for an f32 ring allreduce. Shared pmax'd scales
+  keep every host's decode identical; fp8 codes are ``float8_e4m3fn``
+  bit-cast to int8 for the wire.
 * ``powersgd`` / ``powersgd:<rank>``: rank-r power-iteration low-rank
   approximation with per-rank error feedback (Vogels et al., NeurIPS'19 —
   the reference's ``DDPCommunicationHookType.POWER_SGD``,
@@ -32,8 +33,11 @@ ethernet case. There the step computes per-shard gradients under
   is created by :func:`powersgd_init_state` and threaded through the
   train step by ``build_train_step``.
 
-Enable via ``ParallelismPlugin(grad_compression="bf16"|"int8"|"powersgd[:r]")``
-or ``ACCELERATE_GRAD_COMPRESSION``.
+Enable via ``ParallelismPlugin(grad_compression="bf16"|"int8"|"fp8"|
+"powersgd[:r]")`` or ``ACCELERATE_GRAD_COMPRESSION``. With
+``ParallelismPlugin(zero_stage=1)`` the same methods instead quantize the
+ZeRO-1 reduce-scatter/all-gather pair with per-rank error feedback — see
+``parallel.zero`` and ``docs/usage_guides/zero_redundancy.md``.
 """
 
 from __future__ import annotations
@@ -43,7 +47,7 @@ import re
 import jax
 import jax.numpy as jnp
 
-METHODS = ("bf16", "int8", "powersgd")
+METHODS = ("bf16", "int8", "fp8", "powersgd")
 
 
 def powersgd_rank(method: str | None):
@@ -180,32 +184,33 @@ def compressed_psum_mean(tree, axis_name, method: str):
             summed = jax.lax.psum(g.astype(jnp.bfloat16), axis_name)
             return summed.astype(jnp.float32) / n
 
-    elif method == "int8":
+    elif method in ("int8", "fp8"):
+        from .zero import _amax_scale, _decode, _encode
+
         def reduce_leaf(g):
             # A psum of int32-widened codes would put 4 B/elem on the wire —
-            # no better than f32. Keeping int8 on the wire needs the
+            # no better than f32. Keeping 1-byte codes on the wire needs the
             # two-phase shape every int-compressed allreduce uses (DeepSpeed
             # 1-bit family): all_to_all the codes (1 B/elem), decode+sum
             # each segment locally in f32, re-quantize the reduced segment,
             # all_gather the segment codes (1 B/elem). ~2 B/elem total vs 8
-            # for an f32 ring allreduce.
+            # for an f32 ring allreduce. fp8 rides the same shape with
+            # float8_e4m3fn codes bit-cast to int8 for the wire.
             g32 = g.astype(jnp.float32)
             shape = g32.shape
             pad = (-g32.size) % n
             flat = jnp.pad(g32.reshape(-1), (0, pad))
             k = flat.size // n
 
-            amax = jax.lax.pmax(jnp.max(jnp.abs(g32)), axis_name)
-            scale = jnp.maximum(amax, 1e-30) / 127.0
-            codes = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8).reshape(n, k)
+            scale = _amax_scale(g32, method, axis_name=axis_name)
+            codes = _encode(flat, scale, method).reshape(n, k)
             # phase 1: shard i receives every peer's segment-i codes
             recv = jax.lax.all_to_all(codes, axis_name, split_axis=0, concat_axis=0, tiled=True)
-            seg = jnp.sum(recv.reshape(n, k).astype(jnp.float32), axis=0) * scale / n
+            seg = jnp.sum(_decode(recv.reshape(n, k), scale, method), axis=0) / n
             # phase 2: re-quantize the reduced segment, share it back
-            amax2 = jax.lax.pmax(jnp.max(jnp.abs(seg)), axis_name)
-            scale2 = jnp.maximum(amax2, 1e-30) / 127.0
-            codes2 = jnp.clip(jnp.round(seg / scale2), -127, 127).astype(jnp.int8)
-            full = jax.lax.all_gather(codes2, axis_name, tiled=True).astype(jnp.float32) * scale2
+            scale2 = _amax_scale(seg, method, axis_name=axis_name)
+            codes2 = _encode(seg, scale2, method)
+            full = _decode(jax.lax.all_gather(codes2, axis_name, tiled=True), scale2, method)
             return full[: g32.size].reshape(shape)
 
     else:
@@ -214,27 +219,88 @@ def compressed_psum_mean(tree, axis_name, method: str):
     return jax.tree.map(reduce_leaf, tree)
 
 
-def wire_bytes(tree, method: str | None) -> int:
-    """Wire bytes one gradient reduction moves per device for ``tree``
-    (ring-collective accounting, (N-1)/N ~ 1): f32 allreduce moves ~2
-    payload-sized transfers (reduce-scatter + all-gather); bf16 the same at
-    half width; int8 one all_to_all + one all_gather of code bytes;
-    powersgd two f32 allreduces of the rank-r factors per matrix (exact
-    f32 for the ineligible leaves)."""
+def wire_plan(
+    tree, method: str | None, *, zero_stage: int = 0, n: int | None = None
+) -> list[tuple[str, int]]:
+    """The collectives one gradient sync fires for ``tree``, as
+    ``(collective primitive, total payload bytes)`` pairs — priced through
+    ``analysis.costmodel.ring_wire_bytes`` (ONE set of ring formulas for
+    prediction, accounting, and the telemetry HLO counter).
+
+    ``zero_stage=0`` is the replicated update: one all-reduce-shaped sync
+    per leaf (f32/bf16 psum, or the two-phase int8/fp8
+    all_to_all+all_gather, or PowerSGD's two factor psums).
+    ``zero_stage=1`` is reduce-scatter grads + all-gather updates over an
+    ``n``-way data axis (``n`` required: flat leaves pad to a multiple of
+    ``n``), with 1-byte codes on both legs when quantized."""
     rank = powersgd_rank(method)
+    plan: list[tuple[str, int]] = []
+    if zero_stage:
+        if n is None or n < 1:
+            raise ValueError("zero_stage=1 wire accounting needs the data-parallel degree n")
+        if rank is not None:
+            raise ValueError("zero_stage=1 does not compose with powersgd (psum-shaped)")
+        for leaf in jax.tree.leaves(tree):
+            padded = ((int(leaf.size) + n - 1) // n) * n
+            if method is None:
+                plan += [("psum_scatter", 4 * padded), ("all_gather", 4 * padded)]
+            elif method == "bf16":
+                plan += [("psum_scatter", 2 * padded), ("all_gather", 2 * padded)]
+            else:
+                # pmax'd reduce-scatter scale, 1 B/elem codes both legs,
+                # plus the per-rank f32 all-gather scales
+                plan += [
+                    ("pmax", 4),
+                    ("all_to_all", padded),
+                    ("all_gather", padded),
+                    ("all_gather", 4 * n),
+                ]
+        return plan
     if rank is not None:
-        total = 0
         for leaf in jax.tree.leaves(tree):
             if _psgd_eligible(leaf, rank):
-                n, m = _psgd_matrix_dims(leaf.shape)
-                total += 2 * 4 * rank * (n + m)  # P and Q allreduces
+                nn, m = _psgd_matrix_dims(leaf.shape)
+                plan += [("psum", 4 * rank * nn), ("psum", 4 * rank * m)]
             else:
-                total += 2 * 4 * leaf.size
-        return int(total)
-    per_elem = {None: 2 * 4, "bf16": 2 * 2, "int8": 2 * 1}[method]
-    total = 0
+                plan.append(("psum", 4 * leaf.size))
+        return plan
     for leaf in jax.tree.leaves(tree):
-        total += leaf.size * per_elem
-        if method == "int8":
-            total += 8  # the two pmax'd amax scalars
-    return int(total)
+        if method is None:
+            plan.append(("psum", 4 * leaf.size))
+        elif method == "bf16":
+            plan.append(("psum", 2 * leaf.size))
+        else:  # int8 / fp8: two quantization phases, two pmax'd scales
+            # the two-phase reduce pads each leaf to a multiple of the
+            # group internally; with no n the asymptotic size stands
+            padded = ((int(leaf.size) + n - 1) // n) * n if n else int(leaf.size)
+            plan += [
+                ("pmax", 4),
+                ("all_to_all", padded),
+                ("pmax", 4),
+                ("all_gather", padded),
+            ]
+    return plan
+
+
+def wire_bytes(
+    tree, method: str | None, *, n: int | None = None, zero_stage: int = 0
+) -> int:
+    """Wire bytes one gradient sync moves per device for ``tree``,
+    delegating every term to ``analysis.costmodel.ring_wire_bytes`` so
+    this accounting and the cost model can never disagree (the
+    cross-check test in tests/test_compression.py pins them equal).
+
+    With ``n=None`` (the historical default) the factors are the
+    large-``n`` limits — f32 allreduce ~2 payload transfers, bf16 the
+    same at half width, int8/fp8 ~1 B/elem per leg; with an explicit
+    ``n`` the exact ``(n-1)/n`` ring terms apply. ``zero_stage=1``
+    prices the reduce-scatter/all-gather pair instead (see
+    :func:`wire_plan`)."""
+    from ..analysis.costmodel import ring_wire_bytes
+
+    return int(
+        sum(
+            ring_wire_bytes(prim, nbytes, n)
+            for prim, nbytes in wire_plan(tree, method, zero_stage=zero_stage, n=n)
+        )
+    )

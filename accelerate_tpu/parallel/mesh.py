@@ -10,7 +10,8 @@ reference strategy  mesh layout
 =================  ==========================================================
 DDP                 ``MeshConfig(data=N)`` — params replicated, batch sharded
 FSDP / ZeRO-3       ``MeshConfig(fsdp=N)`` — params+opt state sharded
-ZeRO-1/2            ``MeshConfig(data=N)`` + ``ParallelismPlugin(shard_optimizer_state=True)``
+ZeRO-1/2 (passive)  ``MeshConfig(data=N)`` + ``ParallelismPlugin(shard_optimizer_state=True)``
+ZeRO-1 (explicit)   ``MeshConfig(data=N)`` + ``ParallelismPlugin(zero_stage=1)`` — reduce-scatter/update/all-gather wire, quantizable
 TP (Megatron)       ``MeshConfig(tensor=K)`` — column/row param splits
 SP (Megatron)       ``MeshConfig(seq=K)`` — activation seq-dim sharding
 PP                  ``MeshConfig(pipe=K)`` — stage axis (shard_map+ppermute)

@@ -1,0 +1,416 @@
+"""ZeRO-1 cross-replica weight-update sharding: the flat-shard layout and
+the (optionally quantized) reduce-scatter / all-gather pair around it.
+
+Reference analogue: DeepSpeed ZeRO stage 1 (reference:
+src/accelerate/utils/deepspeed.py:253-294) and "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training" (PAPERS.md). The
+data-parallel training wire normally moves every gradient twice (an f32
+ring all-reduce) and every replica redundantly holds and updates the full
+optimizer state. ZeRO-1 splits the *update*:
+
+1. **reduce-scatter** the gradients over the data axes — each replica
+   receives the reduced sum for its own ``1/n`` contiguous segment of the
+   flattened parameter vector (half the all-reduce's wire bytes);
+2. each replica runs the optimizer **only on its segment** — optimizer
+   state is *born sharded* (``Zero1Layout.state_shardings`` +
+   ``jit(init, out_shardings=...)``), so per-device optimizer HBM divides
+   by the data-parallel degree from step 0;
+3. **all-gather** the per-segment parameter *updates* and apply them to
+   the (replicated) master params — every replica adds the identical
+   gathered update vector, so params never drift across replicas.
+
+Composed with EQuARX-style quantized collectives
+(``grad_compression="int8"|"fp8"|"bf16"``), both wire legs carry 1-2 byte
+payloads with **error feedback**: each rank keeps the residual between
+what it wanted to send and what the quantizer could encode, and adds it
+back before the next quantization — the biased compressor then converges
+because nothing is dropped, only delayed (the same contract
+``COMPRESSION_NUMERICS`` prices for TPU606 and
+``powersgd_psum_mean`` already carries for low-rank compression).
+
+The layout is the torch-XLA/DeepSpeed flat-buffer idiom: every leaf is
+flattened, zero-padded to a multiple of ``n`` and split into ``n``
+contiguous segments, so the shard math is shape-free and any elementwise
+optax transformation (sgd/adam/adamw/lion/...) updates a segment exactly
+as it would the full leaf. Transformations that couple elements *within*
+a leaf (per-leaf norm scaling, adafactor's factored moments) are outside
+this contract — use ``shard_optimizer_state`` (the passive GSPMD layout)
+for those. Global-norm clipping stays exact: the train step computes the
+norm as ``sqrt(psum(local_sq))`` over the shards (never by gathering).
+
+Everything here runs inside ``shard_map`` over the batch axes (the
+``data``/``fsdp`` product); jax is imported at module top because every
+entry point is trace-time code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import BATCH_AXES
+
+#: wire methods the ZeRO-1 collectives accept (powersgd is psum-shaped
+#: and does not reduce-scatter; ``None`` = exact f32)
+ZERO1_WIRE_METHODS = (None, "bf16", "int8", "fp8")
+
+
+def zero1_axes(mesh) -> tuple[str, ...]:
+    """The non-trivial batch axes the update is sharded over."""
+    return tuple(a for a in BATCH_AXES if int(mesh.shape.get(a, 1)) > 1)
+
+
+def _pad_to(size: int, n: int) -> int:
+    return ((size + n - 1) // n) * n
+
+
+def shard_index(axes: Sequence[str], mesh_shape: dict) -> Any:
+    """This rank's segment index inside a ``shard_map`` body: row-major
+    over ``axes`` in the given order — the same ordering jax collectives
+    use for a multi-axis group, so segment ``i`` of a
+    ``psum_scatter``/``all_gather`` over ``axes`` belongs to the rank
+    whose ``shard_index`` is ``i``."""
+    idx = jnp.int32(0)
+    for a in axes:
+        idx = idx * int(mesh_shape[a]) + lax.axis_index(a)
+    return idx
+
+
+class Zero1Layout:
+    """Flat-pad-shard bookkeeping for one parameter pytree.
+
+    ``n`` is the shard count (the data-parallel degree), ``axes`` the mesh
+    axes it comes from. Per leaf ``i``: ``sizes[i]`` true elements,
+    ``padded[i] = ceil(sizes[i]/n)*n`` flat length, segment length
+    ``padded[i]//n``. The concatenation order of segments is rank order,
+    so the first ``sizes[i]`` elements of the flat vector are the true
+    values in C order — which is what makes a checkpoint written at one
+    ``n`` re-padddable to another (``repad``).
+    """
+
+    def __init__(self, params_template: Any, mesh, axes: Optional[Sequence[str]] = None):
+        self.axes = tuple(axes) if axes is not None else zero1_axes(mesh)
+        self.mesh_shape = {str(a): int(s) for a, s in dict(mesh.shape).items()}
+        n = 1
+        for a in self.axes:
+            n *= self.mesh_shape.get(a, 1)
+        self.n = int(n)
+        leaves, self.treedef = jax.tree_util.tree_flatten(params_template)
+        self.shapes = [tuple(int(d) for d in getattr(l, "shape", ())) for l in leaves]
+        self.sizes = []
+        for s in self.shapes:
+            size = 1
+            for d in s:
+                size *= d
+            self.sizes.append(int(size))
+        self.padded = [_pad_to(s, self.n) for s in self.sizes]
+
+    # -- flat <-> shaped ------------------------------------------------ #
+
+    def flatten_pad(self, tree: Any) -> Any:
+        """Pytree (same treedef) of ``[padded]`` f32-preserving flat leaves."""
+        leaves = self.treedef.flatten_up_to(tree)
+        out = []
+        for leaf, size, padded in zip(leaves, self.sizes, self.padded):
+            flat = jnp.reshape(leaf, (size,))
+            if padded != size:
+                flat = jnp.pad(flat, (0, padded - size))
+            out.append(flat)
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def unflatten(self, flat_tree: Any) -> Any:
+        """Inverse of :meth:`flatten_pad` (strips padding, restores shapes)."""
+        leaves = self.treedef.flatten_up_to(flat_tree)
+        out = []
+        for leaf, size, shape in zip(leaves, self.sizes, self.shapes):
+            out.append(jnp.reshape(leaf[:size], shape))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def local_slice(self, flat_tree: Any, index) -> Any:
+        """This rank's ``[padded/n]`` segment of each flat leaf (a
+        ``dynamic_slice`` — free on replicated operands, no wire bytes)."""
+        leaves = self.treedef.flatten_up_to(flat_tree)
+        out = []
+        for leaf, padded in zip(leaves, self.padded):
+            k = padded // self.n
+            out.append(lax.dynamic_slice_in_dim(leaf, index * k, k))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    # -- sharding specs -------------------------------------------------- #
+
+    def flat_spec(self) -> PartitionSpec:
+        # bare name for a single axis: shard_map normalises its out_specs
+        # that way, and a PartitionSpec(('data',)) vs PartitionSpec('data')
+        # mismatch — same layout — would split the jit cache key and show
+        # up as a phantom recompile
+        return PartitionSpec(self.axes if len(self.axes) > 1 else self.axes[0])
+
+    def flat_shardings(self, mesh) -> Any:
+        """``NamedSharding`` pytree for flat-padded leaves (the gradient
+        accumulation buffer's global layout: 1/n per device)."""
+        spec = self.flat_spec()
+        return jax.tree_util.tree_unflatten(
+            self.treedef, [NamedSharding(mesh, spec) for _ in self.padded]
+        )
+
+    def state_shardings(self, state_shapes: Any, mesh) -> Any:
+        """``NamedSharding`` pytree for ``jax.eval_shape(init_flat,
+        params)``: flat vector leaves split over the zero axes, scalars
+        (adam's count) replicated — what makes the optimizer state *born*
+        at 1/n per device via ``jit(init, out_shardings=...)``."""
+        spec = self.flat_spec()
+
+        def to_sharding(leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if len(shape) >= 1 and shape[0] % self.n == 0:
+                return NamedSharding(mesh, spec)
+            return NamedSharding(mesh, PartitionSpec())
+
+        return jax.tree_util.tree_map(to_sharding, state_shapes)
+
+    def state_specs(self, state_tree: Any) -> Any:
+        """``PartitionSpec`` pytree for the optimizer state (shard_map
+        in/out specs)."""
+        spec = self.flat_spec()
+
+        def to_spec(leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if len(shape) >= 1 and shape[0] % self.n == 0:
+                return spec
+            return PartitionSpec()
+
+        return jax.tree_util.tree_map(to_spec, state_tree)
+
+    def state_true_sizes(self, state_tree: Any) -> list[Optional[int]]:
+        """Per-state-leaf true (unpadded) element counts, aligned with
+        ``tree_leaves(state_tree)`` order: a state leaf whose key path
+        ends with a parameter's key path (the optax ``mu/<param path>``
+        convention) carries that parameter's size; scalars and unmatched
+        leaves map to ``None``. This is what elastic restore needs to
+        re-pad a shard checkpoint onto a different data-parallel degree."""
+        param_paths = {}
+        flat_params = jax.tree_util.tree_unflatten(
+            self.treedef, list(range(len(self.sizes)))
+        )
+        for kp, i in jax.tree_util.tree_flatten_with_path(flat_params)[0]:
+            param_paths[_path_str(kp)] = self.sizes[i]
+        suffix_lengths = sorted({p.count("/") + 1 for p in param_paths}, reverse=True)
+
+        out: list[Optional[int]] = []
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(state_tree)[0]:
+            shape = tuple(getattr(leaf, "shape", ()))
+            size = None
+            if len(shape) == 1:
+                parts = _path_str(kp).split("/")
+                for length in suffix_lengths:
+                    if length <= len(parts) and "/".join(parts[-length:]) in param_paths:
+                        cand = param_paths["/".join(parts[-length:])]
+                        if _pad_to(cand, self.n) == shape[0]:
+                            size = cand
+                            break
+            out.append(size)
+        return out
+
+    @staticmethod
+    def repad(flat_values, true_size: int, new_n: int):
+        """Re-pad a flat leaf saved at one shard count onto another: the
+        first ``true_size`` elements are the real values (padding is
+        always at the tail), so elastic restore is strip-then-pad."""
+        import numpy as np
+
+        flat = np.asarray(flat_values).reshape(-1)[:true_size]
+        target = _pad_to(true_size, new_n)
+        if target != true_size:
+            flat = np.pad(flat, (0, target - true_size))
+        return flat
+
+
+def _path_str(key_path) -> str:
+    from .sharding import path_str
+
+    return path_str(key_path)
+
+
+# -- quantizers (shared by both wire legs) ---------------------------------
+
+
+def _amax_scale(v, method: str, axis_name=None):
+    """The symmetric quantization scale for ``v``: shared via ``pmax``
+    when ``axis_name`` is given (every rank must decode identically for a
+    reduce), local otherwise (all-gather ships the scales alongside)."""
+    amax = jnp.max(jnp.abs(v))
+    if axis_name is not None:
+        amax = lax.pmax(amax, axis_name)
+    q = 127.0 if method == "int8" else 240.0  # e4m3 top with headroom
+    return jnp.maximum(amax, 1e-30) / q
+
+
+def _encode(v, scale, method: str):
+    """f32 -> 1-byte wire codes under ``scale``."""
+    if method == "int8":
+        return jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
+    f8 = (v / scale).astype(jnp.float8_e4m3fn)
+    return lax.bitcast_convert_type(f8, jnp.int8)
+
+
+def _decode(codes, scale, method: str):
+    if method == "int8":
+        return codes.astype(jnp.float32) * scale
+    f8 = lax.bitcast_convert_type(codes, jnp.float8_e4m3fn)
+    return f8.astype(jnp.float32) * scale
+
+
+# -- the two wire legs ------------------------------------------------------
+
+
+def reduce_scatter_grads(flat_tree, axes, n: int, method: Optional[str], rs_error):
+    """SUM-reduce-scatter a flat-padded gradient pytree over ``axes``
+    inside ``shard_map``: returns ``(shard_tree, new_rs_error)`` where
+    each shard leaf is this rank's ``[padded/n]`` segment of the summed
+    gradient.
+
+    * ``None`` — exact f32 ``psum_scatter`` (one transfer: half an
+      all-reduce's wire bytes). No residual.
+    * ``"bf16"`` — cast, bf16 ``psum_scatter`` (2 B/elem on the wire,
+      bf16 ring accumulation), decode; the local cast error is carried
+      as error feedback.
+    * ``"int8"`` / ``"fp8"`` — 1 B/elem: quantize under a ``pmax``-shared
+      scale, ``all_to_all`` the codes (each rank receives every peer's
+      segment-``i`` codes), decode and sum the segment locally in f32.
+      The local quantization residual is carried as error feedback
+      (EQuARX): ``new_error = (grad + error) - decode(encode(...))``.
+
+    ``rs_error`` is this rank's residual pytree (``None`` when the method
+    carries none) in the same units as ``flat_tree``.
+    """
+    if method is None:
+        shards = jax.tree_util.tree_map(
+            lambda t: lax.psum_scatter(t, axes, scatter_dimension=0, tiled=True), flat_tree
+        )
+        return shards, None
+
+    g_leaves, treedef = jax.tree_util.tree_flatten(flat_tree)
+    e_leaves = treedef.flatten_up_to(rs_error) if rs_error is not None else [None] * len(g_leaves)
+    shards, new_err = [], []
+    for g, e in zip(g_leaves, e_leaves):
+        v = g if e is None else g + e
+        if method == "bf16":
+            codes = v.astype(jnp.bfloat16)
+            new_err.append(v - codes.astype(jnp.float32))
+            shards.append(
+                lax.psum_scatter(codes, axes, scatter_dimension=0, tiled=True).astype(jnp.float32)
+            )
+            continue
+        scale = _amax_scale(v, method, axis_name=axes)
+        codes = _encode(v, scale, method)
+        new_err.append(v - _decode(codes, scale, method))
+        k = v.shape[0] // n
+        # each rank receives every peer's segment-i codes, decodes and
+        # sums in f32 — int8/fp8 stays on the wire end to end (a psum of
+        # widened codes would move 4 B/elem, no better than f32)
+        recv = lax.all_to_all(codes.reshape(n, k), axes, split_axis=0, concat_axis=0, tiled=True)
+        shards.append(jnp.sum(_decode(recv.reshape(n, k), scale, method), axis=0))
+    unf = jax.tree_util.tree_unflatten
+    return unf(treedef, shards), unf(treedef, new_err)
+
+
+def all_gather_updates(shard_tree, axes, n: int, method: Optional[str], ag_error):
+    """All-gather the per-segment parameter updates back to every replica
+    inside ``shard_map``: returns ``(flat_tree, new_ag_error)`` with
+    ``[padded]`` leaves identical on every rank (so replicated params
+    never drift — each replica applies the same decoded update vector).
+
+    Quantized methods ship 1-2 B/elem codes plus (for int8/fp8) one f32
+    scale per rank per leaf; each rank's residual covers its OWN segment
+    and is fed back into its next update (error feedback on the
+    weight-update leg, the second half of the EQuARX composition)."""
+    if method is None:
+        full = jax.tree_util.tree_map(lambda t: lax.all_gather(t, axes, tiled=True), shard_tree)
+        return full, None
+
+    u_leaves, treedef = jax.tree_util.tree_flatten(shard_tree)
+    e_leaves = treedef.flatten_up_to(ag_error) if ag_error is not None else [None] * len(u_leaves)
+    full, new_err = [], []
+    for u, e in zip(u_leaves, e_leaves):
+        v = u if e is None else u + e
+        if method == "bf16":
+            codes = v.astype(jnp.bfloat16)
+            new_err.append(v - codes.astype(jnp.float32))
+            full.append(lax.all_gather(codes, axes, tiled=True).astype(jnp.float32))
+            continue
+        scale = _amax_scale(v, method)  # local: the gather ships scales too
+        codes = _encode(v, scale, method)
+        new_err.append(v - _decode(codes, scale, method))
+        k = u.shape[0]
+        gathered = lax.all_gather(codes, axes, tiled=True)  # [n*k]
+        scales = lax.all_gather(scale[None], axes, tiled=True)  # [n]
+        decoded = _decode(gathered.reshape(n, k), jnp.float32(1.0), method) * scales[:, None]
+        full.append(decoded.reshape(n * k))
+    unf = jax.tree_util.tree_unflatten
+    return unf(treedef, full), unf(treedef, new_err)
+
+
+def sharded_global_norm(shard_tree, axes):
+    """The exact global L2 norm of a shard-distributed pytree: psum of
+    local partial sums of squares — never a gather. This is what keeps
+    ``clip_grad_norm_`` and the NonFiniteWatchdog's grad-norm probe
+    correct on ZeRO-sharded gradients."""
+    local = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(shard_tree):
+        local = local + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return jnp.sqrt(lax.psum(local, axes))
+
+
+def zero1_comp_template(layout: Zero1Layout, method: Optional[str]):
+    """Host-side zero templates for the two error-feedback residual
+    carries (``{}`` when the method needs none):
+
+    * ``rs_error`` — per-rank residual of quantizing the FULL flat
+      gradient, global shape ``[n, padded]`` per leaf, sharded over the
+      zero axes on dim 0 (params-sized f32 per device — the price of
+      error feedback, same as PowerSGD's carry);
+    * ``ag_error`` — per-rank residual of quantizing the OWN update
+      segment, global shape ``[padded]`` sharded over the zero axes
+      (1/n per device)."""
+    if method is None:
+        return {}
+    import numpy as np
+
+    rs = jax.tree_util.tree_unflatten(
+        layout.treedef, [np.zeros((layout.n, p), np.float32) for p in layout.padded]
+    )
+    ag = jax.tree_util.tree_unflatten(
+        layout.treedef, [np.zeros((p,), np.float32) for p in layout.padded]
+    )
+    return {"rs_error": rs, "ag_error": ag}
+
+
+def zero1_comp_specs(layout: Zero1Layout, method: Optional[str]):
+    """shard_map ``PartitionSpec`` pytree for :func:`zero1_comp_template`."""
+    if method is None:
+        return {}
+    spec = layout.flat_spec()
+    return {
+        "rs_error": jax.tree_util.tree_unflatten(
+            layout.treedef, [spec for _ in layout.padded]
+        ),
+        "ag_error": jax.tree_util.tree_unflatten(
+            layout.treedef, [spec for _ in layout.padded]
+        ),
+    }
+
+
+def zero1_comp_shardings(layout: Zero1Layout, method: Optional[str], mesh):
+    """``NamedSharding`` pytree matching :func:`zero1_comp_template` (for
+    building the carry already sharded via ``jit`` + ``out_shardings``)."""
+    if method is None:
+        return {}
+    s = NamedSharding(mesh, layout.flat_spec())
+    return {
+        "rs_error": jax.tree_util.tree_unflatten(layout.treedef, [s for _ in layout.padded]),
+        "ag_error": jax.tree_util.tree_unflatten(layout.treedef, [s for _ in layout.padded]),
+    }

@@ -43,6 +43,7 @@ from .nonfinite import NonFiniteWatchdog
 from .serving_metrics import ServingMetrics
 from .step import StepTelemetry, diff_signatures, signature_of
 from .summarize import render_text, summarize, summarize_file
+from .wire import hlo_collective_sites, hlo_wire_bytes
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -55,6 +56,8 @@ __all__ = [
     "NonFiniteWatchdog",
     "ServingMetrics",
     "Telemetry",
+    "hlo_collective_sites",
+    "hlo_wire_bytes",
     "PEAK_FLOPS_TABLE",
     "HBM_GB_TABLE",
     "device_generation",
@@ -140,6 +143,46 @@ class Telemetry:
     def event(self, name: str, **fields) -> dict:
         return self.log.event(name, **fields)
 
+    def record_wire_bytes(
+        self,
+        predicted_bytes: int,
+        measured_bytes: int,
+        *,
+        label: str = "step",
+        drift_threshold: float = 0.1,
+        by_primitive: Optional[dict] = None,
+    ) -> dict:
+        """Record one wire-byte counter pair: the cost-model prediction
+        vs the compiled-HLO measurement (:func:`~accelerate_tpu.telemetry.
+        hlo_wire_bytes`). Lands as a ``wire_bytes`` event on the run
+        timeline (with a ``severity=warning`` twin when the two disagree
+        by more than ``drift_threshold`` — the byte analogue of
+        ``perf_model_drift``) and accumulates in :attr:`wire_counters`
+        for ``summary()``."""
+        predicted_bytes, measured_bytes = int(predicted_bytes), int(measured_bytes)
+        drift = (
+            abs(measured_bytes - predicted_bytes) / predicted_bytes
+            if predicted_bytes
+            else (1.0 if measured_bytes else 0.0)
+        )
+        rec = {
+            "label": label,
+            "predicted_bytes": predicted_bytes,
+            "measured_bytes": measured_bytes,
+            "drift": round(drift, 4),
+        }
+        if by_primitive:
+            rec["by_primitive"] = {k: int(v) for k, v in by_primitive.items()}
+        if not hasattr(self, "wire_counters"):
+            self.wire_counters: list[dict] = []
+        self.wire_counters.append(rec)
+        self.log.event(
+            "wire_bytes",
+            severity="warning" if drift > drift_threshold else "info",
+            **rec,
+        )
+        return rec
+
     def set_static_hbm_estimate(self, peak_bytes: int):
         """Attach a flight-check peak-HBM prediction after construction
         (``Accelerator.flight_check`` calls this when telemetry is live)."""
@@ -161,6 +204,8 @@ class Telemetry:
             out["static_peak_hbm_bytes"] = int(self.hbm.static_peak_bytes)
         if self.nonfinite.enabled or self.nonfinite.probes:
             out["nonfinite"] = self.nonfinite.summary()
+        if getattr(self, "wire_counters", None):
+            out["wire_bytes"] = list(self.wire_counters)
         return out
 
     def flush(self):

@@ -34,12 +34,31 @@ from .eventlog import EventLog
 def _tree_first_nonfinite(tree) -> Optional[str]:
     """Dotted path of the first non-finite leaf in a pytree, or None.
     Forces a device->host sync for each leaf checked — callers gate on
-    the probe cadence."""
+    the probe cadence.
+
+    Device arrays (including ZeRO/FSDP-sharded gradient shards) are
+    probed with an on-device ``isfinite`` reduction, so only the scalar
+    verdict crosses to the host — probing a sharded leaf must never
+    gather it (``np.asarray`` on a distributed array materialises the
+    FULL array on one host, and fails outright for multi-process
+    non-addressable shards)."""
     import jax
     import numpy as np
 
     leaves = jax.tree_util.tree_leaves_with_path(tree)
     for path, leaf in leaves:
+        if hasattr(leaf, "sharding") or hasattr(leaf, "device"):
+            import jax.numpy as jnp
+
+            try:
+                if jnp.issubdtype(leaf.dtype, jnp.floating) or jnp.issubdtype(
+                    leaf.dtype, jnp.complexfloating
+                ):
+                    if not bool(jnp.all(jnp.isfinite(leaf))):
+                        return jax.tree_util.keystr(path)
+                continue
+            except TypeError:
+                continue
         try:
             arr = np.asarray(leaf, dtype=np.float64)
         except (TypeError, ValueError):

@@ -480,8 +480,20 @@ class ParallelismPlugin(KwargsHandler):
     # rules if available, else fsdp auto-rules when fsdp axis > 1)
     sharding_rules: Optional[Any] = None
     # ZeRO-1/2: shard optimizer state over the data axis even when params
-    # are replicated ("cross-replica weight-update sharding")
+    # are replicated ("cross-replica weight-update sharding"). This is the
+    # PASSIVE layout mode: the update itself stays replicated and GSPMD
+    # moves shards around it. Works with any optax transformation.
     shard_optimizer_state: bool = False
+    # ZeRO-1, the EXPLICIT wire mode (docs/usage_guides/zero_redundancy.md):
+    # reduce-scatter grads over the data axes -> each replica updates only
+    # its 1/n flat segment of params + optimizer state (state *born*
+    # sharded, so per-device optimizer HBM divides by n from step 0) ->
+    # all-gather the updates. Composes with grad_compression
+    # ("bf16"|"int8"|"fp8"): both wire legs carry quantized payloads with
+    # per-rank error feedback. Requires an elementwise optax
+    # transformation (sgd/adam/adamw/...; use shard_optimizer_state for
+    # factored/coupled ones) and the fast path (build_train_step).
+    zero_stage: int = 0
     # ZeRO-offload analogue (reference: DeepSpeedPlugin
     # offload_optimizer_device / FSDP cpu_offload,
     # utils/dataclasses.py:1100-1180): optimizer moments live on
@@ -505,18 +517,40 @@ class ParallelismPlugin(KwargsHandler):
         return cls(
             mesh_config=MeshConfig.from_env(),
             shard_optimizer_state=parse_flag_from_env("ACCELERATE_SHARD_OPTIMIZER_STATE"),
+            zero_stage=int(os.environ.get("ACCELERATE_ZERO_STAGE", "0") or 0),
             offload_optimizer=parse_flag_from_env("ACCELERATE_OFFLOAD_OPTIMIZER"),
             remat_policy=os.environ.get("ACCELERATE_REMAT_POLICY") or None,
             grad_compression=os.environ.get("ACCELERATE_GRAD_COMPRESSION") or None,
         )
 
     def __post_init__(self):
-        if self.grad_compression is not None and self.grad_compression not in ("bf16", "int8"):
+        if self.grad_compression is not None and self.grad_compression not in ("bf16", "int8", "fp8"):
             from ..parallel.compression import powersgd_rank
 
             if powersgd_rank(self.grad_compression) is None:
                 raise ValueError(
-                    f"grad_compression must be bf16|int8|powersgd[:rank], got {self.grad_compression!r}"
+                    f"grad_compression must be bf16|int8|fp8|powersgd[:rank], got {self.grad_compression!r}"
+                )
+        if self.zero_stage not in (0, 1):
+            raise ValueError(f"zero_stage must be 0 or 1, got {self.zero_stage!r}")
+        if self.zero_stage:
+            from ..parallel.compression import powersgd_rank
+
+            if powersgd_rank(self.grad_compression) is not None:
+                raise ValueError(
+                    "zero_stage=1 does not compose with grad_compression='powersgd' "
+                    "(low-rank factors are psum-shaped, not reduce-scatterable); "
+                    "use bf16|int8|fp8"
+                )
+            if self.offload_optimizer:
+                raise ValueError(
+                    "zero_stage=1 already shards the optimizer state 1/n per device; "
+                    "it does not compose with offload_optimizer (pick one)"
+                )
+            if self.shard_optimizer_state:
+                raise ValueError(
+                    "pass either zero_stage=1 (explicit reduce-scatter/all-gather wire) "
+                    "or shard_optimizer_state=True (passive GSPMD layout), not both"
                 )
 
 

@@ -47,6 +47,7 @@ MODULES = [
     "accelerate_tpu.parallel.context",
     "accelerate_tpu.parallel.collectives",
     "accelerate_tpu.parallel.compression",
+    "accelerate_tpu.parallel.zero",
     "accelerate_tpu.ops.attention",
     "accelerate_tpu.ops.flash_attention",
     "accelerate_tpu.ops.pallas_attention",
@@ -95,6 +96,7 @@ MODULES = [
     "accelerate_tpu.telemetry.serving_metrics",
     "accelerate_tpu.telemetry.summarize",
     "accelerate_tpu.telemetry.nonfinite",
+    "accelerate_tpu.telemetry.wire",
     "accelerate_tpu.models",
 ]
 

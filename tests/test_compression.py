@@ -72,8 +72,47 @@ def test_wire_bytes_accounting():
     tree = {"a": jnp.zeros((100, 10)), "b": jnp.zeros((50,))}
     assert wire_bytes(tree, None) == 1050 * 8  # reduce-scatter + all-gather, f32
     assert wire_bytes(tree, "bf16") == 1050 * 4
-    assert wire_bytes(tree, "int8") == 1050 * 2 + 2 * 8  # + per-leaf amax pair
+    # int8: 1 B/elem per leg + two ring-priced pmax'd f32 amax scalars per
+    # leaf (2 transfers x 4 B each in the limit)
+    assert wire_bytes(tree, "int8") == 1050 * 2 + 2 * 2 * 2 * 4
+    assert wire_bytes(tree, "fp8") == wire_bytes(tree, "int8")
     assert wire_bytes(tree, "int8") < wire_bytes(tree, None) // 3
+    # exact ring terms with an explicit group size
+    n = 8
+    assert wire_bytes(tree, None, n=n) == round(1050 * 4 * 2 * (n - 1) / n)
+    # zero_stage=1: reduce-scatter + all-gather legs over padded flats
+    # (100*10 pads to 1000, 50 pads to 56 at n=8)
+    assert wire_bytes(tree, None, n=n, zero_stage=1) == 2 * round(4 * 1000 * (n - 1) / n) + 2 * round(4 * 56 * (n - 1) / n)
+    assert wire_bytes(tree, "int8", n=n, zero_stage=1) < wire_bytes(tree, None, n=n, zero_stage=1) // 3
+    # quantized zero1 vs replicated f32 baseline: the headline claim
+    assert wire_bytes(tree, "int8", n=n, zero_stage=1) <= 0.27 * wire_bytes(tree, None, n=n)
+
+
+def test_wire_bytes_pins_costmodel_ring_formulas(mesh8):
+    """Satellite pin: ``wire_bytes`` must agree with the cost model's ring
+    formulas (``price_collective``) for every collective its plan fires —
+    psum / reduce-scatter / all-gather / all-to-all, across methods and
+    both zero stages. One set of formulas; units of truth cannot drift."""
+    from accelerate_tpu.analysis.costmodel import price_collective, ring_wire_bytes
+    from accelerate_tpu.parallel.compression import wire_plan
+
+    tree = {"k": jnp.zeros((96, 16)), "b": jnp.zeros((50,))}
+    n = 8
+    for zero_stage in (0, 1):
+        for method in (None, "bf16", "int8", "fp8"):
+            total = 0
+            for prim, payload in wire_plan(tree, method, zero_stage=zero_stage, n=n):
+                # price_collective takes the jaxpr operand: the all_gather
+                # operand is the per-shard input, everything else the full
+                # payload
+                operand = payload // n if prim == "all_gather" else payload
+                rec = price_collective(prim, ("data",), operand, mesh8)
+                assert rec is not None, prim
+                assert rec.wire_bytes == ring_wire_bytes(prim, payload, n), (prim, payload)
+                total += rec.wire_bytes
+            assert total == wire_bytes(tree, method, n=n, zero_stage=zero_stage), (
+                zero_stage, method,
+            )
 
 
 def test_int8_keeps_int8_on_the_wire(mesh8):

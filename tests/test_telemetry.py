@@ -700,3 +700,45 @@ def test_fast_path_probes_nonfinite_watchdog(tmp_path):
     summary = acc.telemetry.summary()
     assert summary["nonfinite"]["nonfinite"] is False
     assert summary["nonfinite"]["scaler_skips"] >= 0
+
+
+def test_wire_counter_records_and_flags_drift(tmp_path):
+    """Telemetry.record_wire_bytes: the predicted/measured byte pair lands
+    as a wire_bytes event, accumulates in summary(), and disagreement past
+    the threshold fires the warning twin (the perf_model_drift discipline
+    applied to bytes)."""
+    from accelerate_tpu.telemetry import Telemetry, read_events
+
+    path = str(tmp_path / "wire.jsonl")
+    tel = Telemetry(path)
+    ok = tel.record_wire_bytes(1000, 1005, label="step")
+    assert ok["drift"] <= 0.01
+    bad = tel.record_wire_bytes(1000, 2000, label="step")
+    assert bad["drift"] == 1.0
+    tel.close()
+    events = [e for e in read_events(path) if e.get("name") == "wire_bytes"]
+    assert len(events) == 2
+    assert events[0]["severity"] == "info" and events[1]["severity"] == "warning"
+    assert tel.summary()["wire_bytes"][0]["predicted_bytes"] == 1000
+
+
+def test_hlo_wire_bytes_parses_collectives():
+    """The HLO wire counter prices list- and iota-form replica groups and
+    tuple-shaped collectives through the shared costmodel ring formulas."""
+    from accelerate_tpu.analysis.costmodel import ring_wire_bytes
+    from accelerate_tpu.telemetry.wire import hlo_wire_bytes
+
+    hlo = "\n".join([
+        "  %all-reduce = f32[128]{0} all-reduce(f32[128]{0} %x), replica_groups=[1,8]<=[8]",
+        "  %all-gather.1 = s8[64]{0} all-gather(s8[8]{0} %y), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}",
+        "  %reduce-scatter.2 = f32[16]{0} reduce-scatter(f32[128]{0} %z), replica_groups=[1,8]<=[8]",
+        "  %all-to-all.3 = (s8[1,8]{1,0}, s8[1,8]{1,0}) all-to-all(s8[1,8]{1,0} %a, /*index=1*/s8[1,8]{1,0} %b), replica_groups={{0,1}}",
+        "  %tuple = (f32[4]{0}) tuple(f32[4]{0} %w)",  # not a collective
+    ])
+    out = hlo_wire_bytes(hlo)
+    assert out["by_primitive"]["psum"] == ring_wire_bytes("psum", 128 * 4, 8)
+    assert out["by_primitive"]["all_gather"] == ring_wire_bytes("all_gather", 64, 8)
+    assert out["by_primitive"]["reduce_scatter"] == ring_wire_bytes("reduce_scatter", 16 * 4 * 8, 8)
+    assert out["by_primitive"]["all_to_all"] == ring_wire_bytes("all_to_all", 16, 2)
+    assert out["total"] == sum(out["by_primitive"].values())
+    assert len(out["sites"]) == 4
