@@ -35,7 +35,14 @@ from .optimizer import AcceleratedOptimizer  # noqa: E402
 from .scheduler import AcceleratedScheduler  # noqa: E402
 from .local_sgd import LocalSGD  # noqa: E402
 from .generation import beam_search, generate, generate_seq2seq, per_token_latency  # noqa: E402
-from .scheduling import Scheduler, SchedulerConfig, ShedError  # noqa: E402
+from .scheduling import (  # noqa: E402
+    FleetRoutingPolicy,
+    RoutingConfig,
+    Scheduler,
+    SchedulerConfig,
+    ShedError,
+)
 from .serving import ServingEngine  # noqa: E402
+from .serving_fleet import FleetConfig, FleetRouter, RadixPrefixCache  # noqa: E402
 from .speculative import speculative_generate  # noqa: E402
 from .launchers import debug_launcher, notebook_launcher  # noqa: E402

@@ -522,7 +522,31 @@ class Accelerator:
         if model is None:
             return
         jax = _jax()
+        zero1_fallback = None
         if self._zero1_active():
+            # ZeRO-1's flat-segment update is only correct for transforms
+            # that treat every parameter element independently; a factored
+            # / coupled state (adafactor's row-col moments) would compute
+            # a DIFFERENT update on the flat segments than on the real
+            # leaves. Detect it structurally and fall back LOUDLY to the
+            # passive shard_optimizer_state layout instead of silently
+            # changing the optimizer's semantics.
+            zero1_fallback = _nonelementwise_state_nodes(opt.optimizer)
+            if zero1_fallback:
+                names = ", ".join(sorted(zero1_fallback))
+                if names not in _ZERO1_FALLBACK_WARNED:
+                    _ZERO1_FALLBACK_WARNED.add(names)
+                    logger.warning(
+                        "zero_stage=1 requires an elementwise optax transform, but this "
+                        "optimizer's state couples elements within a leaf (%s); falling "
+                        "back to the passive shard_optimizer_state layout — the optimizer "
+                        "state is GSPMD-sharded over the data axis but the update wire "
+                        "stays the replicated all-reduce (no reduce-scatter/all-gather "
+                        "split, no quantized update legs)",
+                        names,
+                    )
+                opt._zero1_fallback = tuple(sorted(zero1_fallback))
+        if self._zero1_active() and not zero1_fallback:
             layout = self._zero1_layout_for(model)
             if layout is not None:
                 # ZeRO-1 explicit mode: the state is created over the FLAT
@@ -542,7 +566,7 @@ class Accelerator:
                 opt._zero1_state_sizes = layout.state_true_sizes(state_shapes)
                 opt._model = model
                 return
-        shardings = self._zero_state_shardings(opt.optimizer, model)
+        shardings = self._zero_state_shardings(opt.optimizer, model, force=bool(zero1_fallback))
         init_shardings = shardings
         plugin = self.state.parallelism_plugin
         offload = plugin is not None and getattr(plugin, "offload_optimizer", False)
@@ -617,11 +641,13 @@ class Accelerator:
 
         return pull, (lambda st: jax.device_put(st, host))
 
-    def _zero_state_shardings(self, optax_tx, model: Model):
+    def _zero_state_shardings(self, optax_tx, model: Model, force: bool = False):
         """ZeRO-1/2 ``NamedSharding`` pytree for ``optax_tx``'s state, or
-        None when ``shard_optimizer_state`` is off / no data axis."""
+        None when ``shard_optimizer_state`` is off / no data axis.
+        ``force`` takes the passive layout regardless of the plugin flag
+        (the zero_stage=1 non-elementwise fallback)."""
         plugin = self.state.parallelism_plugin
-        if plugin is None or not getattr(plugin, "shard_optimizer_state", False):
+        if not force and (plugin is None or not getattr(plugin, "shard_optimizer_state", False)):
             return None
         from .parallel.mesh import data_parallel_size
 
@@ -638,6 +664,12 @@ class Accelerator:
     def _zero1_active(self) -> bool:
         plugin = self.state.parallelism_plugin
         return plugin is not None and getattr(plugin, "zero_stage", 0) == 1
+
+    def zero1_fallback_reason(self, optimizer) -> Optional[tuple]:
+        """The offending optax state node names if ``zero_stage=1`` fell
+        back to the passive layout for this (prepared) optimizer, else
+        None."""
+        return getattr(optimizer, "_zero1_fallback", None)
 
     def _zero1_layout_for(self, model: Model):
         """The :class:`~accelerate_tpu.parallel.zero.Zero1Layout` for this
@@ -2381,6 +2413,50 @@ class Accelerator:
 
     def __repr__(self):
         return f"Accelerator(mesh={dict(self.mesh.shape)}, mixed_precision={self.mixed_precision!r})"
+
+
+#: zero_stage=1 non-elementwise fallbacks already warned about (one
+#: warning per offending state-node set per process)
+_ZERO1_FALLBACK_WARNED: set = set()
+
+
+def _nonelementwise_state_nodes(optax_tx) -> set:
+    """Names of optax state nodes whose leaves couple elements within a
+    parameter leaf — the structural probe behind the zero_stage=1
+    fallback. An elementwise transform's state leaves are scalars (step
+    counts) or param-shaped (adam moments); anything else (adafactor's
+    ``(rows,)``/``(cols,)`` factored moments) proves the update reads
+    across elements, which the flat-segment ZeRO-1 update would break.
+    Probed via ``eval_shape`` on a tiny 2-D template — nothing runs.
+    Shape-preserving couplings (a per-leaf trust ratio) are outside what
+    a structural probe can see; those transforms keep their documented
+    ``shard_optimizer_state`` contract."""
+    jax = _jax()
+    jnp = _jnp()
+    probe_shape = (4, 6)
+    try:
+        state = jax.eval_shape(optax_tx.init, {"w": jax.ShapeDtypeStruct(probe_shape, jnp.float32)})
+    except Exception:
+        return set()  # unprobeable init: leave the explicit-layout path to its own validation
+    bad: set = set()
+
+    def walk(node, owner: str):
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            for v in node:
+                walk(v, type(node).__name__)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v, owner)
+        elif isinstance(node, dict):
+            for v in node.values():
+                walk(v, owner)
+        else:
+            shape = getattr(node, "shape", None)
+            if shape is not None and tuple(shape) not in ((), probe_shape):
+                bad.add(owner or "optax state")
+
+    walk(state, "")
+    return bad
 
 
 _dropped_profile_options_warned = False

@@ -308,6 +308,42 @@ def reshard_cost(global_bytes: int, mesh_shape: dict, dcn: Optional[Sequence[str
     return {ICI: ici, DCN: dcn_bytes}
 
 
+def price_kv_handoff(
+    bytes_per_token: int,
+    tokens: int,
+    *,
+    fixed_bytes: int = 0,
+    transport: str = ICI,
+    generation: str = "v5e",
+) -> dict:
+    """Price one prefill→decode KV-block handoff BEFORE it happens — the
+    fleet router's decision input (the ``reshard_cost`` pattern applied
+    to serving): a disaggregated prefill replica ships ``tokens`` rows of
+    per-layer K/V (``bytes_per_token`` each, plus ``fixed_bytes`` of
+    per-cache constants like write indices) to a decode replica over
+    ``transport`` (``"ici"`` within a slice / host, ``"dcn"`` across).
+    Returns ``{"bytes", "time_us", "transport"}``; plain host math, no
+    jax — the router's accounting and this prediction must agree
+    byte-for-byte (asserted by ``bench_serving --fleet``)."""
+    if transport not in (ICI, DCN):
+        raise ValueError(f"transport must be {ICI!r}|{DCN!r}, got {transport!r}")
+    total = int(bytes_per_token) * int(tokens) + int(fixed_bytes)
+    bw = BANDWIDTH_TABLE.get(generation, BANDWIDTH_TABLE["v5e"])[transport]
+    return {"bytes": int(total), "time_us": total / bw * 1e6, "transport": transport}
+
+
+def prefill_compute_us(
+    param_count: int, tokens: int, *, generation: str = "v5e", dtype: str = "bf16"
+) -> float:
+    """Roofline lower bound for (re)prefilling ``tokens`` through a
+    ``param_count``-parameter decoder: ``2·P·T`` MACs-as-FLOPs over the
+    generation's peak — the router's *alternative* cost when deciding a
+    KV handoff vs re-prefilling locally on the decode replica. A lower
+    bound is the honest comparator here: if the handoff beats even the
+    best-case local prefill, shipping the blocks wins for sure."""
+    return 2.0 * int(param_count) * int(tokens) / peak_flops(generation, dtype) * 1e6
+
+
 def collect_traffic(jaxpr, mesh, *, dcn: Optional[Sequence[str]] = None) -> TrafficReport:
     """Walk ``jaxpr`` (recursing through pjit/shard_map/control flow) and
     price every explicit collective. ``scan`` bodies multiply the firing

@@ -11,6 +11,7 @@ from .config import config_parser
 from .divergence import divergence_parser
 from .env import env_parser
 from .estimate import estimate_parser
+from .fleet import fleet_parser
 from .flightcheck import flightcheck_parser
 from .launch import launch_parser
 from .lint import lint_parser
@@ -43,6 +44,7 @@ def main():
     telemetry_parser(subparsers)
     checkpoints_parser(subparsers)
     compile_cache_parser(subparsers)
+    fleet_parser(subparsers)
     tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)

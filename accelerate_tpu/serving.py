@@ -75,6 +75,20 @@ def _jax():
     return jax
 
 
+def _row_axis(shape: tuple, cap: int):
+    """Index of a cache leaf's position-row axis (the one sized to the
+    model's cache capacity), or None for non-row leaves (write-index
+    scalars). K/V buffers are at least [B, rows, heads, dim]-shaped —
+    possibly with a leading scan-over-layers axis — so the first
+    ``cap``-sized axis of an ndim >= 3 leaf is the row axis."""
+    if len(shape) < 3:
+        return None
+    for i, d in enumerate(shape):
+        if d == cap:
+            return i
+    return None
+
+
 class _LazyBuckets:
     """dict-like ``bucket -> compiled program`` that compiles on FIRST
     use instead of eagerly at engine construction: startup pays only for
@@ -125,6 +139,10 @@ class _Request:
     deprioritized: bool = False
     ttft_done: bool = False
     resume_key: object = None
+    # disaggregated serving (serving_fleet): a request whose prefill ran
+    # on ANOTHER replica carries the handed-off KV payload; consumed once
+    # at admission (a later preemption resumes by ordinary recompute)
+    handoff: object = None
 
 
 class ServingEngine:
@@ -471,6 +489,10 @@ class ServingEngine:
                 params,
                 jnp.zeros((1, 1), jnp.int32),
             )
+            # the dense per-row cache template: what a KV handoff ships
+            # (trimmed to true_len rows) and what the receiving replica
+            # pads back before its paste/insert
+            self._row_template = row_aval
             self._perf_programs["resume_recompute"] = (
                 chunk_warm,
                 lambda b: (
@@ -895,24 +917,7 @@ class ServingEngine:
                     f"request needs {need} pool blocks but the pool has "
                     f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
                 )
-        priority = int(priority)
-        reason = self._sched.shed_on_submit(priority, len(self.queue))
-        if reason is not None:
-            cfg = self._sched.config
-            if cfg.shed_action == "deprioritize":
-                self.metrics.on_deprioritize(None)
-                self._log.event(
-                    "shed", action="deprioritize", priority=priority,
-                    queue_depth=len(self.queue), reason=reason,
-                )
-                priority = max(priority, cfg.deprioritize_to)
-            else:
-                self.metrics.on_shed(None)
-                self._log.event(
-                    "shed", action="reject", priority=priority,
-                    queue_depth=len(self.queue), reason=reason,
-                )
-                raise ShedError(reason, priority=priority, queue_depth=len(self.queue))
+        priority = self._admission_shed_check(int(priority))
         uid = self._uid
         self._uid += 1
         req = _Request(
@@ -923,6 +928,200 @@ class ServingEngine:
         self._index[uid] = ("queued", req)
         self.metrics.on_submit(uid)
         return uid
+
+    # ---- disaggregated prefill / KV handoff (serving_fleet) -------------
+
+    def kv_handoff_dims(self) -> tuple:
+        """``(bytes_per_token, fixed_bytes)`` of this engine's dense
+        per-row KV cache — the inputs
+        :func:`~accelerate_tpu.analysis.costmodel.price_kv_handoff` needs
+        to price a prefill→decode handoff BEFORE the prefill runs.
+        Row-axis leaves (one K/V row per position) contribute per-token
+        bytes; everything else (the write-index scalar) is fixed. The
+        prediction and a router's post-transfer accounting
+        (``handoff["wire_bytes"]``) must agree byte-for-byte."""
+        jax = _jax()
+        if self.draft_model is not None:
+            raise NotImplementedError("disaggregated prefill does not compose with speculative serving")
+        cap = self.model.config.max_position_embeddings
+        per_tok = fixed = 0
+        for leaf in jax.tree_util.tree_leaves(self._row_template):
+            shape = tuple(int(d) for d in leaf.shape)
+            n = 1
+            for d in shape:
+                n *= d
+            nbytes = n * np.dtype(leaf.dtype).itemsize
+            if _row_axis(shape, cap) is not None:
+                per_tok += nbytes // cap
+            else:
+                fixed += nbytes
+        return per_tok, fixed
+
+    def _trim_row_cache(self, cache, n: int):
+        """Host-side copy of a dense row cache keeping only its first
+        ``n`` K/V rows — the handoff wire payload (garbage pad rows past
+        the frontier never ship). Non-row leaves (the write index) pass
+        through whole."""
+        jax = _jax()
+        cap = self.model.config.max_position_embeddings
+
+        def trim(t, leaf):
+            ax = _row_axis(tuple(int(d) for d in t.shape), cap)
+            if ax is None:
+                return np.asarray(leaf)
+            idx = (slice(None),) * ax + (slice(0, n),)
+            return np.asarray(leaf[idx])
+
+        return jax.tree_util.tree_map(trim, self._row_template, cache)
+
+    def _untrim_row_cache(self, cache, n: int):
+        """Pad a trimmed handoff cache back to the full row template
+        (zeros past row ``n`` — beyond the causal frontier by
+        construction, overwritten by decode exactly like prefill pad)."""
+        jax = _jax()
+        jnp = jax.numpy
+        cap = self.model.config.max_position_embeddings
+
+        def pad(t, leaf):
+            arr = np.asarray(leaf)
+            shape = tuple(int(d) for d in t.shape)
+            if tuple(arr.shape) != shape:
+                ax = _row_axis(shape, cap)
+                full = np.zeros(shape, t.dtype)
+                full[(slice(None),) * ax + (slice(0, n),)] = arr
+                arr = full
+            return jnp.asarray(arr.astype(t.dtype, copy=False))
+
+        return jax.tree_util.tree_map(pad, self._row_template, cache)
+
+    def prefill_detached(
+        self,
+        prompt_ids,
+        max_new_tokens: int = 32,
+        *,
+        uid_key: int = 0,
+        prefix_id: Optional[int] = None,
+    ) -> dict:
+        """Run ONE request's prefill on THIS engine and return a
+        host-transferable KV handoff instead of admitting it — the
+        prefill half of disaggregated serving
+        (:mod:`accelerate_tpu.serving_fleet`). The handoff carries the
+        full prompt, the trimmed-to-``total``-rows KV cache as numpy
+        leaves, the sampled first token + its logprob, and the advanced
+        sampling-key data, so :meth:`submit_prefilled` on ANOTHER replica
+        continues token- and logprob-exactly where a local prefill would
+        have. ``wire_bytes`` is the payload a router accounts after the
+        move; it equals ``price_kv_handoff``'s prediction exactly.
+
+        ``uid_key`` seeds the per-request sampling chain (use the fleet
+        uid: the stream is then deterministic per ``(seed, uid_key)``).
+        With ``prefix_id``, ``prompt_ids`` is still the FULL prompt; its
+        head must equal the registered prefix, whose cache seeds the
+        chunk windows (radix-cache reuse composes with disaggregation on
+        the prefill side)."""
+        jax = _jax()
+        if self.draft_model is not None:
+            raise NotImplementedError("disaggregated prefill does not compose with speculative serving")
+        prompt = np.asarray(prompt_ids, np.int32).ravel()
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        plen, pre = 0, None
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}; call register_prefix first")
+            pre = self._prefixes[prefix_id]
+            plen = pre["len"]
+            if len(prompt) < plen + 1 or not np.array_equal(prompt[:plen], pre["tokens"]):
+                raise ValueError("prompt does not start with the registered prefix tokens")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the slot cache ({self.max_len})"
+            )
+        key = jax.random.fold_in(jax.random.key(self._seed), int(uid_key))
+        next_tok, lp, cache, key = self._chunked_prefill(
+            prompt, row_cache=None if pre is None else pre["cache"], done_upto=plen, key=key
+        )
+        total = len(prompt)
+        trimmed = self._trim_row_cache(cache, total)
+        wire = int(sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(trimmed)))
+        return {
+            "prompt": prompt,
+            "total": total,
+            "max_new_tokens": int(max_new_tokens),
+            "next_tok": int(next_tok),
+            "lp": float(lp),
+            "key_data": np.asarray(jax.random.key_data(key)),
+            "cache": trimmed,
+            "wire_bytes": wire,
+            "reused_prefix_tokens": int(plen),
+        }
+
+    def submit_prefilled(self, handoff: dict, stop_sequences=None, priority: int = 0) -> int:
+        """Queue a request whose prefill already ran on another replica
+        (:meth:`prefill_detached`): admission pastes the handed-off KV
+        rows and emits the carried first token — ZERO prefill compute and
+        zero tick token budget on this engine. Same shed/priority
+        semantics as :meth:`submit`; outputs (tokens AND logprobs) are
+        exact vs a local prefill by construction. A later preemption
+        resumes by ordinary prefix recompute — the handoff payload is
+        consumed at first admission."""
+        if self.draft_model is not None:
+            raise NotImplementedError("disaggregated prefill does not compose with speculative serving")
+        prompt = np.asarray(handoff["prompt"], np.int32).ravel()
+        total, max_new = int(handoff["total"]), int(handoff["max_new_tokens"])
+        if total != len(prompt):
+            raise ValueError(f"handoff total {total} != prompt length {len(prompt)}")
+        stops = tuple(tuple(int(t) for t in s) for s in (stop_sequences or ()))
+        if any(len(s) == 0 for s in stops):
+            raise ValueError("empty stop sequence")
+        if total + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({total}) + max_new_tokens ({max_new}) "
+                f"exceeds the slot cache ({self.max_len})"
+            )
+        if self.paged:
+            need = self._new_blocks_for(0, total, max_new)
+            if need > self._pcfg.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {need} pool blocks but the pool has "
+                    f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
+                )
+        priority = self._admission_shed_check(int(priority))
+        uid = self._uid
+        self._uid += 1
+        req = _Request(
+            uid, prompt, max_new, [], None, stops,
+            priority=priority, submit_ts=time.monotonic(), handoff=dict(handoff),
+        )
+        self._queue_push(req)
+        self._index[uid] = ("queued", req)
+        self.metrics.on_submit(uid)
+        return uid
+
+    def _admission_shed_check(self, priority: int) -> int:
+        """Shared submit-time SLO gate (:meth:`submit` /
+        :meth:`submit_prefilled`): returns the possibly-demoted priority,
+        or raises the structured :class:`ShedError` rejection."""
+        reason = self._sched.shed_on_submit(priority, len(self.queue))
+        if reason is None:
+            return priority
+        cfg = self._sched.config
+        if cfg.shed_action == "deprioritize":
+            self.metrics.on_deprioritize(None)
+            self._log.event(
+                "shed", action="deprioritize", priority=priority,
+                queue_depth=len(self.queue), reason=reason,
+            )
+            return max(priority, cfg.deprioritize_to)
+        self.metrics.on_shed(None)
+        self._log.event(
+            "shed", action="reject", priority=priority,
+            queue_depth=len(self.queue), reason=reason,
+        )
+        raise ShedError(reason, priority=priority, queue_depth=len(self.queue))
 
     def _queue_push(self, req: _Request) -> None:
         """Insert by the scheduler's order key (priority class, then
@@ -1183,7 +1382,15 @@ class ServingEngine:
             st["key"] = req.resume_key
         else:
             st["key"] = jax.random.fold_in(jax.random.key(self._seed), req.uid)
-        if self.draft_model is not None:
+        if req.handoff is not None and not resume:
+            # disaggregated admission: the KV rows, first token, and the
+            # advanced sampling chain all arrived with the handoff — no
+            # prefill program runs here. Consumed once: a preemption
+            # resumes by the ordinary recompute path below.
+            st["handoff"] = req.handoff
+            st["key"] = jax.random.wrap_key_data(jax.numpy.asarray(req.handoff["key_data"]))
+            req.handoff = None
+        elif self.draft_model is not None:
             st["bucket"], st["spec"] = self._bucket_for(len(req.prompt)), True
         elif not resume and req.prefix_id is None and (b := self._bucket_for(len(req.prompt))) is not None:
             # short prompt, no prefix: the one-shot fused program
@@ -1229,6 +1436,14 @@ class ServingEngine:
         if st is None:
             return budget
         req = st["req"]
+        if st.get("handoff") is not None:
+            # the prefill compute already happened on another replica:
+            # pad the trimmed rows back onto the template and paste —
+            # zero tokens of this tick's budget are spent
+            h = st.pop("handoff")
+            cache = self._untrim_row_cache(h["cache"], h["total"])
+            self._finalize_prefill(slot, cache, h["total"], h["next_tok"], h["lp"], st["key"])
+            return budget
         if st["bucket"] is not None:
             b = st["bucket"]
             if budget < b and not force:

@@ -1,0 +1,840 @@
+"""Fleet-scale serving: a multi-replica router over N ``ServingEngine``
+replicas, with disaggregated prefill/decode roles, cost-model-priced KV
+handoff, cross-request radix prefix reuse, and zero-compile replica
+spin-up from a shared executable store.
+
+A single :class:`~accelerate_tpu.serving.ServingEngine` is one process'
+worth of serving; production traffic needs a *fleet*. This module owns
+the layer above the engine:
+
+* **routing** — :class:`FleetRouter` spreads an open-loop request stream
+  over replicas. Policy (least-loaded / round-robin, fleet-level SLO
+  shedding) lives in :class:`~accelerate_tpu.scheduling.RoutingConfig` /
+  :class:`~accelerate_tpu.scheduling.FleetRoutingPolicy` — the same
+  policy/mechanism split (and the same priority classes + structured
+  :class:`~accelerate_tpu.scheduling.ShedError`) as the per-engine
+  scheduler. Prefix affinity beats the load policy: a replica that
+  already holds a request's shared preamble in its radix cache serves it
+  without re-prefilling the preamble;
+
+* **disaggregated prefill/decode** — with ``roles=("prefill", ...,
+  "decode", ...)``, prefill replicas run prompt prefills and hand the KV
+  rows to decode replicas (``ServingEngine.prefill_detached`` →
+  ``submit_prefilled``; token- and logprob-exact by construction). Every
+  handoff is priced BEFORE it happens by
+  :func:`~accelerate_tpu.analysis.costmodel.price_kv_handoff` (per-token
+  KV bytes × prompt length over the configured ICI/DCN transport), and
+  under ``handoff="auto"`` the router compares that against
+  :func:`~accelerate_tpu.analysis.costmodel.prefill_compute_us` — short
+  prompts decode locally, long ones ship their blocks. The router's
+  post-transfer accounting must equal the prediction byte-for-byte
+  (``bench_serving --fleet`` asserts it);
+
+* **radix prefix cache** — :class:`RadixPrefixCache` is a compressed
+  token trie over observed prompts. When ``promote_after`` prompts share
+  a preamble of at least ``min_prefix_tokens`` tokens, the shared part
+  is registered with the engine ONCE (``register_prefix``) and every
+  later prompt starting with it prefills only its suffix — the dominant
+  p95-TTFT lever under realistic traffic where most prompt tokens are a
+  shared system preamble. Reuse is token- and logprob-exact because the
+  engine's prefix path copies the registered cache bit-identically.
+  Entries evict LRU (``max_entries``), never while referenced by an
+  active/queued request; hit/miss/eviction counters land in
+  :class:`~accelerate_tpu.telemetry.serving_metrics.ServingMetrics`;
+
+* **zero-compile spin-up** — replicas built over one shared
+  :class:`~accelerate_tpu.aot.ExecutableStore` deserialize every engine
+  program a sibling already compiled: :meth:`FleetRouter.spin_up` warms
+  a new replica and reports its compile count (asserted 0 in the bench
+  and the fleet tests — the PR-7 warm-replica story at fleet level).
+
+Everything is CPU-runnable: replicas are in-process engines (optionally
+over device subsets via ``MeshConfig.num_devices``-built meshes), driven
+either deterministically (:meth:`FleetRouter.step` round-robin) or by
+one thread per replica (:meth:`FleetRouter.drain_threaded` — each
+replica's lock serializes host bookkeeping; XLA releases the GIL during
+device compute, so replicas overlap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .scheduling import FleetRoutingPolicy, RoutingConfig, ShedError
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# --------------------------------------------------------------------- #
+# radix prefix cache
+# --------------------------------------------------------------------- #
+
+
+class _RadixNode:
+    """One node of the compressed token trie. ``edge`` is the token label
+    on the edge INTO this node; children key on their edge's first
+    token. ``count`` = observed prompts whose path passes through;
+    ``prefix_id`` = the engine prefix registered at this depth (None =
+    structural node only)."""
+
+    __slots__ = ("edge", "children", "count", "prefix_id", "depth", "last_used")
+
+    def __init__(self, edge=(), depth: int = 0):
+        self.edge = tuple(edge)
+        self.children: dict = {}
+        self.count = 0
+        self.prefix_id: Optional[int] = None
+        self.depth = depth
+        self.last_used = 0.0
+
+
+class RadixPrefixCache:
+    """Cross-request prefix reuse over one engine's KV-block prefix store.
+
+    The engine mechanism (``register_prefix`` / ``submit(prefix_id=)``)
+    is token-exact but manual; this cache decides WHICH preambles are
+    worth a registration and matches every prompt against them:
+
+    * :meth:`lookup` — longest registered preamble that is a proper
+      prefix of the prompt (at least one suffix token must remain —
+      its logits seed the first sample). Counts a hit (+ reused tokens)
+      or a miss in the engine's :class:`ServingMetrics`;
+    * :meth:`observe` — inserts the prompt's path into the trie. A trie
+      node exists exactly where observed prompts diverge, so the deepest
+      node with ``count >= promote_after`` and ``depth >=
+      min_prefix_tokens`` IS the longest preamble shared often enough to
+      pay for a registration — it gets registered (one engine prefill +
+      one pinned KV row cache);
+    * **eviction** — past ``max_entries`` registrations, the
+      least-recently-used entry is unregistered (its HBM rows freed).
+      An entry still referenced by an active/queued request is skipped
+      this round (the engine refuses to drop it) and retried on the
+      next eviction pass. :meth:`invalidate` drops one/all entries
+      explicitly — required after anything that changes what the
+      registered tokens would prefill to (new model weights, changed
+      tokenizer); the cache itself never goes stale within a process
+      because jax caches are immutable and requests copy them.
+
+    The trie observes at most ``max_observe_tokens`` leading tokens per
+    prompt (promotion candidates never exceed it), so trie memory is
+    O(distinct preambles), not O(total traffic).
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        min_prefix_tokens: int = 8,
+        promote_after: int = 2,
+        max_entries: int = 8,
+        max_observe_tokens: int = 4096,
+        clock=time.monotonic,
+    ):
+        if min_prefix_tokens < 1:
+            raise ValueError(f"min_prefix_tokens must be >= 1, got {min_prefix_tokens}")
+        if promote_after < 2:
+            raise ValueError(f"promote_after must be >= 2, got {promote_after}")
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.engine = engine
+        self.min_prefix_tokens = int(min_prefix_tokens)
+        self.promote_after = int(promote_after)
+        self.max_entries = int(max_entries)
+        self.max_observe_tokens = int(max_observe_tokens)
+        self._clock = clock
+        self.root = _RadixNode()
+        self.entries: dict[int, _RadixNode] = {}  # prefix_id -> owning node
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.registrations = 0
+        self.tokens_reused = 0
+
+    # -- matching -------------------------------------------------------- #
+
+    def _walk(self, toks: tuple):
+        """Yield trie nodes along ``toks``' path (root excluded), stopping
+        at the first divergence."""
+        node, i = self.root, 0
+        while i < len(toks):
+            nxt = node.children.get(toks[i])
+            if nxt is None:
+                return
+            e = nxt.edge
+            if len(toks) - i < len(e) or toks[i : i + len(e)] != e:
+                return
+            i += len(e)
+            node = nxt
+            yield node
+
+    def lookup(self, prompt_ids) -> Optional[tuple]:
+        """``(prefix_id, length)`` of the longest registered preamble
+        that properly prefixes ``prompt_ids`` (>= 1 suffix token left),
+        or None. Counts the hit/miss and refreshes the entry's LRU
+        stamp."""
+        toks = tuple(int(t) for t in np.asarray(prompt_ids).ravel())
+        best = None
+        for node in self._walk(toks):
+            if node.prefix_id is not None and node.depth < len(toks):
+                best = node
+        m = self.engine.metrics
+        if best is None:
+            self.misses += 1
+            m.on_prefix_miss()
+            return None
+        best.last_used = self._clock()
+        self.hits += 1
+        self.tokens_reused += best.depth
+        m.on_prefix_hit(best.depth)
+        return best.prefix_id, best.depth
+
+    # -- observation + promotion ----------------------------------------- #
+
+    def observe(self, prompt_ids) -> Optional[int]:
+        """Insert the prompt's (capped) path into the trie; register the
+        deepest preamble that just crossed the promotion threshold.
+        Returns the newly registered ``prefix_id`` or None."""
+        toks = tuple(int(t) for t in np.asarray(prompt_ids).ravel())
+        # a registered preamble must leave >= 1 suffix token AND fit the
+        # slot cache with one generated token of headroom
+        cap = min(len(toks) - 1, self.max_observe_tokens, self.engine.max_len - 2)
+        if cap < self.min_prefix_tokens:
+            return None
+        toks = toks[:cap]
+        node, i = self.root, 0
+        promoted: Optional[_RadixNode] = None
+        while i < len(toks):
+            nxt = node.children.get(toks[i])
+            if nxt is None:
+                child = _RadixNode(toks[i:], depth=len(toks))
+                child.count = 1
+                node.children[toks[i]] = child
+                break
+            e = nxt.edge
+            common = 0
+            limit = min(len(e), len(toks) - i)
+            while common < limit and e[common] == toks[i + common]:
+                common += 1
+            if common < len(e):
+                # split the edge at the divergence point: the new middle
+                # node's depth IS the shared-preamble length
+                mid = _RadixNode(e[:common], depth=nxt.depth - (len(e) - common))
+                mid.count = nxt.count
+                nxt.edge = e[common:]
+                mid.children[nxt.edge[0]] = nxt
+                node.children[toks[i]] = mid
+                nxt = mid
+            i += common if common < len(e) else len(e)
+            nxt.count += 1
+            node = nxt
+            if (
+                nxt.count >= self.promote_after
+                and nxt.depth >= self.min_prefix_tokens
+                and nxt.prefix_id is None
+                and i == nxt.depth  # full edge consumed: toks[:i] ends here
+            ):
+                promoted = nxt  # keep the deepest qualifying node
+            if common < len(e):
+                # remainder of the prompt diverges below the split
+                if i < len(toks):
+                    child = _RadixNode(toks[i:], depth=len(toks))
+                    child.count = 1
+                    nxt.children[toks[i]] = child
+                break
+        if promoted is None:
+            return None
+        return self._register(promoted, toks[: promoted.depth])
+
+    def _register(self, node: _RadixNode, tokens: tuple) -> Optional[int]:
+        try:
+            pid = self.engine.register_prefix(np.asarray(tokens, np.int32))
+        except ValueError:
+            # pool exhaustion (paged) or headroom: skip this round — the
+            # node keeps its count and a later observe retries
+            return None
+        node.prefix_id = pid
+        node.last_used = self._clock()
+        self.entries[pid] = node
+        self.registrations += 1
+        self.engine.metrics.on_prefix_register()
+        self._evict_over_budget()
+        return pid
+
+    def _evict_over_budget(self) -> None:
+        while len(self.entries) > self.max_entries:
+            ordered = sorted(self.entries.items(), key=lambda kv: kv[1].last_used)
+            evicted = False
+            # never the hottest entry: when an older entry is pinned by
+            # in-flight requests, churning the just-registered one would
+            # throw away exactly the cache the next request hits
+            for pid, node in ordered[:-1]:
+                try:
+                    self.engine.unregister_prefix(pid)
+                except ValueError:
+                    continue  # still referenced; try the next-oldest
+                node.prefix_id = None
+                del self.entries[pid]
+                self.evictions += 1
+                self.engine.metrics.on_prefix_evict()
+                evicted = True
+                break
+            if not evicted:
+                return  # everything evictable is pinned: over budget until drains
+
+    def invalidate(self, prefix_id: Optional[int] = None) -> int:
+        """Unregister one entry (or all, ``prefix_id=None``) — the
+        explicit invalidation hook for weight swaps / tokenizer changes.
+        Raises ValueError if a targeted entry is still referenced by an
+        active or queued request. Returns the number of entries
+        dropped."""
+        pids = [prefix_id] if prefix_id is not None else list(self.entries)
+        dropped = 0
+        for pid in pids:
+            node = self.entries.get(pid)
+            if node is None:
+                raise ValueError(f"unknown prefix_id {pid}")
+            self.engine.unregister_prefix(pid)
+            node.prefix_id = None
+            del self.entries[pid]
+            dropped += 1
+        return dropped
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "registrations": self.registrations,
+            "entries": len(self.entries),
+            "tokens_reused": self.tokens_reused,
+        }
+
+
+# --------------------------------------------------------------------- #
+# fleet configuration + replicas
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knobs for :class:`FleetRouter`.
+
+    ``roles``: per-replica role tuple (``"mixed"`` | ``"prefill"`` |
+    ``"decode"``). None = every replica mixed (no disaggregation).
+    Disaggregation needs at least one prefill and one decode replica;
+    mixed replicas count as both.
+
+    ``handoff``: ``"auto"`` ships KV blocks only when the priced
+    transfer beats the priced local re-prefill, ``"always"`` /
+    ``"never"`` pin the decision (the bench's A/B arms).
+
+    ``transport`` / ``generation``: what the cost model prices the
+    replica-to-replica link as (``"ici"`` within a slice or host,
+    ``"dcn"`` across) — see
+    :func:`~accelerate_tpu.analysis.costmodel.price_kv_handoff`.
+
+    ``prefix_reuse`` + radix knobs: see :class:`RadixPrefixCache`.
+    """
+
+    routing: RoutingConfig = dataclasses.field(default_factory=RoutingConfig)
+    roles: Optional[tuple] = None
+    handoff: str = "auto"
+    transport: str = "ici"
+    generation: str = "cpu"
+    prefix_reuse: bool = True
+    min_prefix_tokens: int = 8
+    promote_after: int = 2
+    max_prefix_entries: int = 8
+
+    def __post_init__(self):
+        if self.handoff not in ("auto", "always", "never"):
+            raise ValueError(f"handoff must be auto|always|never, got {self.handoff!r}")
+        if self.transport not in ("ici", "dcn"):
+            raise ValueError(f"transport must be ici|dcn, got {self.transport!r}")
+        if self.roles is not None:
+            bad = [r for r in self.roles if r not in ("mixed", "prefill", "decode")]
+            if bad:
+                raise ValueError(f"roles must be mixed|prefill|decode, got {bad}")
+
+
+class Replica:
+    """One engine + its fleet-side state. ``lock`` serializes host
+    bookkeeping between the router and a per-replica drain thread; the
+    engine itself is single-threaded by contract."""
+
+    def __init__(self, engine, name: str, role: str = "mixed"):
+        self.engine = engine
+        self.name = name
+        self.role = role
+        self.radix: Optional[RadixPrefixCache] = None
+        self.lock = threading.RLock()
+        engine.metrics.replica = name
+
+    @property
+    def load(self) -> int:
+        return len(self.engine.queue) + self.engine.active_count
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.engine.queue or self.engine.active_count)
+
+    def can_prefill(self) -> bool:
+        return self.role in ("mixed", "prefill")
+
+    def can_decode(self) -> bool:
+        return self.role in ("mixed", "decode")
+
+
+# --------------------------------------------------------------------- #
+# the router
+# --------------------------------------------------------------------- #
+
+
+class FleetRouter:
+    """Route an open-loop request stream over N engine replicas.
+
+    Build it from pre-constructed engines (tests, heterogeneous meshes)
+    or :meth:`from_model` (N uniform replicas, optionally over one
+    shared executable store so spin-up never compiles). The public
+    surface mirrors the engine: :meth:`submit` → fleet uid,
+    :meth:`step` / :meth:`run` / :meth:`drain_threaded` drive,
+    :meth:`poll` / :meth:`partial` / :meth:`logprobs` / :meth:`cancel`
+    resolve, :meth:`metrics_merged` / :meth:`prometheus_text` observe.
+    """
+
+    def __init__(self, engines: Sequence, config: Optional[FleetConfig] = None, names=None):
+        if not engines:
+            raise ValueError("need at least one engine")
+        self.config = config or FleetConfig()
+        roles = self.config.roles or ("mixed",) * len(engines)
+        if len(roles) != len(engines):
+            raise ValueError(f"{len(roles)} roles for {len(engines)} engines")
+        names = names or [f"r{i}" for i in range(len(engines))]
+        self.replicas = [Replica(e, n, r) for e, n, r in zip(engines, names, roles)]
+        self.disaggregated = any(r.role == "prefill" for r in self.replicas)
+        if self.disaggregated and not any(r.can_decode() for r in self.replicas):
+            raise ValueError("disaggregated fleet needs at least one decode-capable replica")
+        if self.config.prefix_reuse:
+            for rep in self.replicas:
+                if rep.can_prefill() and rep.engine.draft_model is None:
+                    rep.radix = RadixPrefixCache(
+                        rep.engine,
+                        min_prefix_tokens=self.config.min_prefix_tokens,
+                        promote_after=self.config.promote_after,
+                        max_entries=self.config.max_prefix_entries,
+                    )
+        self._policy = FleetRoutingPolicy(self.config.routing)
+        self._uid = 0
+        # fleet uid -> ("replica", idx, local_uid) | ("pending", entry)
+        self._map: dict[int, tuple] = {}
+        self._shed: dict[int, ShedError] = {}
+        self._pending: list[dict] = []  # disaggregated requests awaiting prefill+handoff
+        self._lock = threading.RLock()
+        self._mk_engine = None  # set by from_model: spin_up's factory
+        # KV-handoff accounting: predictions are priced BEFORE each
+        # transfer; moved bytes are what actually shipped — the two must
+        # agree exactly (bench-asserted)
+        self.handoffs = 0
+        self.handoffs_local = 0  # auto-decision chose local re-prefill
+        self.handoff_bytes_predicted = 0
+        self.handoff_bytes_moved = 0
+        self.handoff_time_us_predicted = 0.0
+        self.fleet_shed = 0  # fleet-level SLO rejections (router edge)
+
+    # -- construction ---------------------------------------------------- #
+
+    @classmethod
+    def from_model(
+        cls,
+        model,
+        num_replicas: int = 2,
+        config: Optional[FleetConfig] = None,
+        store_dir: Optional[str] = None,
+        **engine_kwargs,
+    ) -> "FleetRouter":
+        """N uniform replicas over one model. With ``store_dir``, every
+        replica's :class:`~accelerate_tpu.aot.ProgramCache` shares one
+        :class:`~accelerate_tpu.aot.ExecutableStore` — the first replica
+        to build a program stores it, every later replica (including
+        :meth:`spin_up` at runtime) deserializes it with zero XLA
+        compiles. Replicas over device *subsets* come from building each
+        replica's model on a ``MeshConfig(num_devices=...)`` mesh and
+        using the engine-list constructor instead."""
+        from .serving import ServingEngine
+
+        def mk(name: str) -> "ServingEngine":
+            pc = None
+            if store_dir is not None:
+                from .aot import ExecutableStore, ProgramCache
+
+                pc = ProgramCache(store=ExecutableStore(store_dir), name=name)
+            return ServingEngine(model, program_cache=pc, **engine_kwargs)
+
+        router = cls([mk(f"r{i}") for i in range(num_replicas)], config=config)
+        router._mk_engine = mk
+        return router
+
+    def spin_up(self, warm_prompt_lens=(4,), max_new_tokens: int = 2, role: str = "mixed") -> dict:
+        """Add one replica at runtime and warm its serving programs.
+        Returns ``{"replica", "spinup_ms", "compiles", "deserialized"}``
+        — over a shared store the compile count is 0 (every program
+        deserializes; the zero-compile spin-up contract the fleet bench
+        asserts). Only available on a :meth:`from_model` router."""
+        if self._mk_engine is None:
+            raise ValueError("spin_up needs a from_model router (an engine factory)")
+        name = f"r{len(self.replicas)}"
+        t0 = time.perf_counter()
+        engine = self._mk_engine(name)
+        rep = Replica(engine, name, role)
+        if self.config.prefix_reuse and rep.can_prefill():
+            rep.radix = RadixPrefixCache(
+                engine,
+                min_prefix_tokens=self.config.min_prefix_tokens,
+                promote_after=self.config.promote_after,
+                max_entries=self.config.max_prefix_entries,
+            )
+        rng = np.random.default_rng(0)
+        for n in warm_prompt_lens:
+            engine.submit(rng.integers(1, 100, size=int(n)).astype(np.int32), max_new_tokens)
+        engine.run()
+        ms = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self.replicas.append(rep)
+        pc = engine.program_cache
+        return {
+            "replica": name,
+            "spinup_ms": round(ms, 3),
+            "compiles": pc.misses,
+            "deserialized": pc.deserialized,
+        }
+
+    # -- submission ------------------------------------------------------ #
+
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: int = 32,
+        priority: int = 0,
+        stop_sequences=None,
+    ) -> int:
+        """Route one request; returns a FLEET uid (resolve via
+        :meth:`poll`). Fleet-level SLO shedding raises the structured
+        :class:`ShedError` before any replica is touched; per-replica
+        scheduler SLOs still apply after routing."""
+        prompt = np.asarray(prompt_ids, np.int32).ravel()
+        with self._lock:
+            depth = sum(len(r.engine.queue) for r in self.replicas) + len(self._pending)
+            reason = self._policy.shed_on_submit(int(priority), depth)
+            if reason is not None:
+                self.fleet_shed += 1
+                raise ShedError(reason, priority=int(priority), queue_depth=depth)
+            fuid = self._uid
+            self._uid += 1
+            if self.disaggregated and not self._handoff_decision(len(prompt)):
+                self.handoffs_local += 1
+            elif self.disaggregated:
+                self._pending.append(
+                    {
+                        "fuid": fuid,
+                        "prompt": prompt,
+                        "max_new_tokens": int(max_new_tokens),
+                        "priority": int(priority),
+                        "stop_sequences": stop_sequences,
+                    }
+                )
+                self._map[fuid] = ("pending", None)
+                return fuid
+            idx = self._route_local(prompt)
+        rep = self.replicas[idx]
+        with rep.lock:
+            prefix = rep.radix.lookup(prompt) if rep.radix is not None else None
+            if prefix is not None:
+                pid, plen = prefix
+                local = rep.engine.submit(
+                    prompt[plen:], max_new_tokens, prefix_id=pid,
+                    stop_sequences=stop_sequences, priority=priority,
+                )
+            else:
+                local = rep.engine.submit(
+                    prompt, max_new_tokens, stop_sequences=stop_sequences, priority=priority
+                )
+                if rep.radix is not None:
+                    rep.radix.observe(prompt)
+        with self._lock:
+            self._map[fuid] = ("replica", idx, local)
+        return fuid
+
+    def _route_local(self, prompt: np.ndarray) -> int:
+        """Replica index for a locally-prefilled request: prefix affinity
+        first (the replica already holding the longest registered
+        preamble), else the routing policy over decode-capable load."""
+        eligible = [i for i, r in enumerate(self.replicas) if r.can_decode() and r.can_prefill()]
+        if not eligible:  # disaggregated fleet deciding "local": decode side prefills
+            eligible = [i for i, r in enumerate(self.replicas) if r.can_decode()]
+        best_i, best_len = None, 0
+        toks = tuple(int(t) for t in prompt)
+        for i in eligible:
+            radix = self.replicas[i].radix
+            if radix is None:
+                continue
+            # peek without counting a hit/miss: only the routed replica's
+            # lookup() is the real match
+            depth = 0
+            for node in radix._walk(toks):
+                if node.prefix_id is not None and node.depth < len(toks):
+                    depth = node.depth
+            if depth > best_len:
+                best_i, best_len = i, depth
+        if best_i is not None:
+            return best_i
+        loads = [r.load for r in self.replicas]
+        return self._policy.pick_replica(loads, eligible)
+
+    def _handoff_decision(self, prompt_len: int) -> bool:
+        """Ship the KV blocks (True) or let the decode replica re-prefill
+        locally (False) — priced before anything runs."""
+        mode = self.config.handoff
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        pred, alt_us = self._price_handoff(prompt_len)
+        return pred["time_us"] <= alt_us
+
+    def _price_handoff(self, tokens: int):
+        """(price_kv_handoff dict, local re-prefill us) for one prompt."""
+        from .analysis.costmodel import prefill_compute_us, price_kv_handoff
+
+        src = next(r for r in self.replicas if r.can_prefill())
+        per_tok, fixed = src.engine.kv_handoff_dims()
+        pred = price_kv_handoff(
+            per_tok, tokens, fixed_bytes=fixed,
+            transport=self.config.transport, generation=self.config.generation,
+        )
+        if not hasattr(self, "_param_count"):
+            jax = _jax()
+            self._param_count = sum(
+                int(np.prod(leaf.shape)) if getattr(leaf, "shape", None) else 1
+                for leaf in jax.tree_util.tree_leaves(src.engine.model.params)
+            )
+        return pred, prefill_compute_us(
+            self._param_count, tokens, generation=self.config.generation
+        )
+
+    # -- driving --------------------------------------------------------- #
+
+    def dispatch_pending(self, limit: Optional[int] = None) -> int:
+        """Run queued disaggregated prefills: each pending request
+        prefills on the least-loaded prefill replica (radix reuse
+        applies), its KV rows hand off to the least-loaded decode
+        replica, and the router's byte accounting updates. Returns the
+        number dispatched."""
+        n = 0
+        while True:
+            with self._lock:
+                if not self._pending or (limit is not None and n >= limit):
+                    return n
+                entry = self._pending.pop(0)
+                loads = [r.load for r in self.replicas]
+                p_idx = self._policy.pick_replica(
+                    loads, [i for i, r in enumerate(self.replicas) if r.can_prefill()]
+                )
+                d_idx = self._policy.pick_replica(
+                    loads, [i for i, r in enumerate(self.replicas) if r.can_decode()]
+                )
+                pred, _ = self._price_handoff(len(entry["prompt"]))
+            p_rep, d_rep = self.replicas[p_idx], self.replicas[d_idx]
+            with p_rep.lock:
+                prefix = (
+                    p_rep.radix.lookup(entry["prompt"]) if p_rep.radix is not None else None
+                )
+                handoff = p_rep.engine.prefill_detached(
+                    entry["prompt"], entry["max_new_tokens"],
+                    uid_key=entry["fuid"],
+                    prefix_id=None if prefix is None else prefix[0],
+                )
+                if p_rep.radix is not None and prefix is None:
+                    p_rep.radix.observe(entry["prompt"])
+            with d_rep.lock:
+                local = d_rep.engine.submit_prefilled(
+                    handoff, stop_sequences=entry["stop_sequences"],
+                    priority=entry["priority"],
+                )
+            with self._lock:
+                self._map[entry["fuid"]] = ("replica", d_idx, local)
+                self.handoffs += 1
+                self.handoff_bytes_predicted += pred["bytes"]
+                self.handoff_bytes_moved += handoff["wire_bytes"]
+                self.handoff_time_us_predicted += pred["time_us"]
+            p_rep.engine._log.event(
+                "kv_handoff", fuid=entry["fuid"], src=p_rep.name, dst=d_rep.name,
+                tokens=handoff["total"], predicted_bytes=pred["bytes"],
+                moved_bytes=handoff["wire_bytes"],
+                predicted_us=round(pred["time_us"], 3),
+                reused_prefix_tokens=handoff["reused_prefix_tokens"],
+            )
+            n += 1
+
+    def step(self) -> int:
+        """One fleet tick: dispatch pending handoffs, then one engine
+        tick per busy replica. Returns occupied slots across the fleet
+        (plus pending handoffs)."""
+        self.dispatch_pending()
+        active = 0
+        for rep in self.replicas:
+            with rep.lock:
+                if rep.busy:
+                    active += rep.engine.step()
+        with self._lock:
+            return active + len(self._pending)
+
+    def run(self) -> dict:
+        """Drive ticks until every replica drains; returns
+        ``{fleet_uid: full token array}``."""
+        while self._work_remaining():
+            self.step()
+        out = {}
+        with self._lock:
+            items = list(self._map.items())
+        for fuid, loc in items:
+            if loc[0] == "replica":
+                got = self.replicas[loc[1]].engine.done.get(loc[2])
+                if got is not None:
+                    out[fuid] = got
+        return out
+
+    def drain_threaded(self) -> float:
+        """Drain all queued/pending work with one thread per replica
+        (wall-clock overlap across replicas — XLA releases the GIL during
+        compute); the caller's thread keeps dispatching handoffs.
+        Returns elapsed seconds. Use :meth:`step` when determinism
+        matters more than wall-clock."""
+        t0 = time.perf_counter()
+        stop = threading.Event()
+
+        def worker(rep: Replica):
+            while not stop.is_set():
+                with rep.lock:
+                    busy = rep.busy
+                    if busy:
+                        rep.engine.step()
+                if not busy:
+                    time.sleep(0.0005)
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True) for r in self.replicas]
+        for t in threads:
+            t.start()
+        try:
+            while self._work_remaining():
+                self.dispatch_pending()
+                time.sleep(0.0005)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        return time.perf_counter() - t0
+
+    def _work_remaining(self) -> bool:
+        with self._lock:
+            if self._pending:
+                return True
+        return any(r.busy for r in self.replicas)
+
+    # -- request resolution ---------------------------------------------- #
+
+    def _locate(self, fuid: int):
+        with self._lock:
+            if fuid in self._shed:
+                raise self._shed[fuid]
+            loc = self._map.get(fuid)
+        if loc is None:
+            raise KeyError(f"unknown request id {fuid}")
+        return loc
+
+    def poll(self, fuid: int):
+        """Finished [prompt + generated] tokens, or None while pending.
+        Raises the structured ShedError for a shed request (fleet- or
+        replica-level)."""
+        loc = self._locate(fuid)
+        if loc[0] == "pending":
+            return None
+        rep = self.replicas[loc[1]]
+        with rep.lock:
+            try:
+                return rep.engine.poll(loc[2])
+            except ShedError as e:
+                with self._lock:
+                    self._shed[fuid] = e
+                raise
+
+    def partial(self, fuid: int) -> np.ndarray:
+        """Tokens generated so far (streaming surface; empty while the
+        request is queued or awaiting its handoff)."""
+        loc = self._locate(fuid)
+        if loc[0] == "pending":
+            return np.zeros((0,), np.int32)
+        rep = self.replicas[loc[1]]
+        with rep.lock:
+            return rep.engine.partial(loc[2])
+
+    def logprobs(self, fuid: int) -> np.ndarray:
+        loc = self._locate(fuid)
+        if loc[0] == "pending":
+            return np.zeros((0,), np.float32)
+        rep = self.replicas[loc[1]]
+        with rep.lock:
+            return rep.engine.logprobs(loc[2])
+
+    def cancel(self, fuid: int) -> np.ndarray:
+        """Abort a request anywhere in the fleet (still-pending handoffs
+        cancel before any prefill runs)."""
+        loc = self._locate(fuid)
+        with self._lock:
+            if loc[0] == "pending":
+                self._pending = [e for e in self._pending if e["fuid"] != fuid]
+                del self._map[fuid]
+                return np.zeros((0,), np.int32)
+        rep = self.replicas[loc[1]]
+        with rep.lock:
+            return rep.engine.cancel(loc[2])
+
+    # -- observability ---------------------------------------------------- #
+
+    def metrics_merged(self):
+        """One fleet-view :class:`ServingMetrics` (summed counters,
+        pooled latency windows — see ``ServingMetrics.merge``)."""
+        from .telemetry.serving_metrics import ServingMetrics
+
+        return ServingMetrics.merge([r.engine.metrics for r in self.replicas])
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition of every replica's metrics as ONE scrape
+        (one HELP/TYPE block per metric, a ``replica`` label per
+        sample)."""
+        from .telemetry.serving_metrics import fleet_prometheus_text
+
+        return fleet_prometheus_text([r.engine.metrics for r in self.replicas])
+
+    def handoff_accounting(self) -> dict:
+        with self._lock:
+            return {
+                "handoffs": self.handoffs,
+                "handoffs_local": self.handoffs_local,
+                "bytes_predicted": self.handoff_bytes_predicted,
+                "bytes_moved": self.handoff_bytes_moved,
+                "time_us_predicted": round(self.handoff_time_us_predicted, 3),
+            }
+
+    def radix_stats(self) -> dict:
+        return {
+            r.name: r.radix.stats() for r in self.replicas if r.radix is not None
+        }

@@ -43,7 +43,7 @@ from .nonfinite import NonFiniteWatchdog
 from .serving_metrics import ServingMetrics
 from .step import StepTelemetry, diff_signatures, signature_of
 from .summarize import render_text, summarize, summarize_file
-from .wire import hlo_collective_sites, hlo_wire_bytes
+from .wire import hlo_collective_sites, hlo_wire_bytes, wire_dtype_upcast
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -58,6 +58,7 @@ __all__ = [
     "Telemetry",
     "hlo_collective_sites",
     "hlo_wire_bytes",
+    "wire_dtype_upcast",
     "PEAK_FLOPS_TABLE",
     "HBM_GB_TABLE",
     "device_generation",
@@ -151,6 +152,9 @@ class Telemetry:
         label: str = "step",
         drift_threshold: float = 0.1,
         by_primitive: Optional[dict] = None,
+        requested_wire_dtype: Optional[str] = None,
+        sites: Optional[list] = None,
+        platform: Optional[str] = None,
     ) -> dict:
         """Record one wire-byte counter pair: the cost-model prediction
         vs the compiled-HLO measurement (:func:`~accelerate_tpu.telemetry.
@@ -158,7 +162,16 @@ class Telemetry:
         timeline (with a ``severity=warning`` twin when the two disagree
         by more than ``drift_threshold`` — the byte analogue of
         ``perf_model_drift``) and accumulates in :attr:`wire_counters`
-        for ``summary()``."""
+        for ``summary()``.
+
+        With ``requested_wire_dtype`` (a ``grad_compression`` scheme:
+        ``"bf16"|"int8"|"fp8"``) and the measurement's ``sites`` list,
+        a ONE-TIME ``wire_dtype_upcast`` warning event fires when the
+        compiled program's dominant collective moves a wider dtype than
+        requested — naming the platform, because this is a backend
+        lowering property (XLA:CPU upcasts bf16 collectives to f32; TPU
+        backends keep the narrow wire), so the compression saving being
+        absent here does NOT mean it is absent on TPU."""
         predicted_bytes, measured_bytes = int(predicted_bytes), int(measured_bytes)
         drift = (
             abs(measured_bytes - predicted_bytes) / predicted_bytes
@@ -181,6 +194,33 @@ class Telemetry:
             severity="warning" if drift > drift_threshold else "info",
             **rec,
         )
+        if requested_wire_dtype is not None and sites:
+            from .wire import wire_dtype_upcast
+
+            up = wire_dtype_upcast(sites, requested_wire_dtype)
+            if up is not None and requested_wire_dtype not in getattr(self, "_upcast_warned", set()):
+                if not hasattr(self, "_upcast_warned"):
+                    self._upcast_warned: set = set()
+                self._upcast_warned.add(requested_wire_dtype)
+                if platform is None:
+                    import sys
+
+                    jax = sys.modules.get("jax")
+                    platform = jax.default_backend() if jax is not None else "unknown"
+                self.log.event(
+                    "wire_dtype_upcast",
+                    severity="warning",
+                    label=label,
+                    platform=platform,
+                    message=(
+                        f"requested a {up['requested']} wire but the compiled program's "
+                        f"dominant collective moves {up['measured_dtype']} on the "
+                        f"{platform} backend — the compression saving is backend-gated "
+                        "(TPU backends keep the narrow dtype on the wire)"
+                    ),
+                    **up,
+                )
+                rec["dtype_upcast"] = up
         return rec
 
     def set_static_hbm_estimate(self, peak_bytes: int):

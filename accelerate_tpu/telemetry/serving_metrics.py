@@ -33,18 +33,83 @@ def _pct(values, q: float) -> Optional[float]:
     return vals[k]
 
 
+def _render_prom(rows) -> str:
+    """Render ``(name, mtype, help, suffix, label_str, value)`` rows as
+    text exposition: one HELP/TYPE block per metric (first-seen order),
+    then every sample of that metric — the grouping a multi-replica
+    scrape needs."""
+    by_name: dict = {}
+    order = []
+    for name, mtype, help_text, suffix, labels, value in rows:
+        if name not in by_name:
+            by_name[name] = (mtype, help_text, [])
+            order.append(name)
+        by_name[name][2].append((suffix, labels, value))
+    lines = []
+    for name in order:
+        mtype, help_text, samples = by_name[name]
+        lines.append(f"# HELP {_PREFIX}_{name} {help_text}")
+        lines.append(f"# TYPE {_PREFIX}_{name} {mtype}")
+        for suffix, labels, value in samples:
+            if value is None:
+                continue
+            lines.append(f"{_PREFIX}_{name}{suffix}{labels} {value:g}")
+    return "\n".join(lines) + "\n"
+
+
+def fleet_prometheus_text(metrics) -> str:
+    """One scrape body for N replicas' :class:`ServingMetrics`: a single
+    HELP/TYPE block per metric with one ``replica``-labeled sample per
+    replica — what a fleet exposes on its shared ``/metrics`` endpoint
+    (aggregate with ``sum by`` in the scraper, or serve
+    ``ServingMetrics.merge(...).prometheus_text()`` for a pre-merged
+    view)."""
+    rows = []
+    for i, m in enumerate(metrics):
+        if m.replica is None:
+            m = _with_replica(m, f"r{i}")
+        rows.extend(m._prom_samples())
+    return _render_prom(rows)
+
+
+def _with_replica(metrics: "ServingMetrics", name: str) -> "ServingMetrics":
+    """Label an unlabeled instance for one render without mutating it."""
+    import copy
+
+    clone = copy.copy(metrics)
+    clone.replica = name
+    return clone
+
+
 class ServingMetrics:
     """Counter/latency surface for one :class:`ServingEngine`.
 
     ``log`` (optional): mirror every snapshot to a telemetry
     :class:`EventLog` as ``serving.*`` counters, so a serving run and a
     training run summarize through the same CLI.
+
+    ``replica`` (optional): a fleet replica name; when set, every
+    Prometheus sample carries a ``replica="..."`` label so N replicas'
+    engines scrape as one fleet view (:func:`fleet_prometheus_text`),
+    and :meth:`merge` aggregates them into one fleet-level instance.
     """
 
-    def __init__(self, engine=None, *, log: Optional[EventLog] = None, window: int = 1024, clock=time.monotonic):
+    def __init__(
+        self,
+        engine=None,
+        *,
+        log: Optional[EventLog] = None,
+        window: int = 1024,
+        clock=time.monotonic,
+        replica: Optional[str] = None,
+    ):
         self._engine = engine
         self.log = log if log is not None else EventLog(None)
         self._clock = clock
+        self.replica = replica
+        # set by merge(): the source instances a fleet view aggregates
+        # its live gauges (queue depth, tokens/sec) over
+        self._sources: Optional[list] = None
         # monotonically increasing counters
         self.requests_submitted = 0
         self.requests_completed = 0
@@ -57,6 +122,14 @@ class ServingMetrics:
         self.requests_deprioritized = 0
         self.decode_preemptions = 0  # decoding slots evicted + requeued
         self.resumes = 0  # preempted requests resumed by recompute
+        # cross-request prefix reuse (serving_fleet.RadixPrefixCache):
+        # a hit means the request skipped re-prefilling that many shared
+        # preamble tokens — the fleet's dominant p95-TTFT lever
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_evictions = 0
+        self.prefix_registrations = 0
+        self.prefix_tokens_reused = 0
         # latency windows
         self.ttft_ms: collections.deque = collections.deque(maxlen=window)
         self.e2e_ms: collections.deque = collections.deque(maxlen=window)
@@ -143,21 +216,45 @@ class ServingMetrics:
         self.resumes += 1
         self._last_tok_ts[uid] = self._clock()
 
+    def on_prefix_hit(self, tokens_reused: int = 0):
+        """A request matched a registered shared preamble and skipped
+        re-prefilling ``tokens_reused`` tokens."""
+        self.prefix_hits += 1
+        self.prefix_tokens_reused += int(tokens_reused)
+
+    def on_prefix_miss(self):
+        self.prefix_misses += 1
+
+    def on_prefix_evict(self):
+        self.prefix_evictions += 1
+
+    def on_prefix_register(self):
+        self.prefix_registrations += 1
+
     # ------------------------------------------------------------------ #
     # read surface
     # ------------------------------------------------------------------ #
 
     @property
     def queue_depth(self) -> int:
+        if self._sources:
+            return sum(m.queue_depth for m in self._sources)
         return len(self._engine.queue) if self._engine is not None else 0
 
     @property
     def active_slots(self) -> int:
+        if self._sources:
+            return sum(m.active_slots for m in self._sources)
         return self._engine.active_count if self._engine is not None else 0
 
     @property
     def kv_block_utilization(self) -> Optional[float]:
-        """Fraction of the paged pool in use (None in dense mode)."""
+        """Fraction of the paged pool in use (None in dense mode; a
+        fleet view averages its paged replicas)."""
+        if self._sources:
+            utils = [m.kv_block_utilization for m in self._sources]
+            utils = [u for u in utils if u is not None]
+            return sum(utils) / len(utils) if utils else None
         if self._engine is None or not getattr(self._engine, "paged", False):
             return None
         total = self._engine._pcfg.num_blocks - 1  # minus the trash sink
@@ -167,7 +264,12 @@ class ServingMetrics:
 
     def tokens_per_sec(self, window_s: float = 10.0) -> Optional[float]:
         """Decode throughput over the trailing ``window_s`` seconds of
-        token marks (None until two marks exist)."""
+        token marks (None until two marks exist; a fleet view sums its
+        replicas' rates)."""
+        if self._sources:
+            rates = [m.tokens_per_sec(window_s) for m in self._sources]
+            rates = [r for r in rates if r is not None]
+            return sum(rates) if rates else None
         if len(self._token_marks) < 2:
             return None
         now = self._clock()
@@ -205,71 +307,121 @@ class ServingMetrics:
             "requests_deprioritized": self.requests_deprioritized,
             "decode_preemptions": self.decode_preemptions,
             "resumes": self.resumes,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_registrations": self.prefix_registrations,
+            "prefix_tokens_reused": self.prefix_tokens_reused,
         }
+        if self.replica is not None:
+            snap["replica"] = self.replica
         return snap
+
+    #: counters merge() sums and prometheus exposes as *_total samples
+    _COUNTERS = (
+        "requests_submitted", "requests_completed", "requests_cancelled",
+        "tokens_generated", "prefills", "preemptions", "requests_shed",
+        "requests_deprioritized", "decode_preemptions", "resumes",
+        "prefix_hits", "prefix_misses", "prefix_evictions",
+        "prefix_registrations", "prefix_tokens_reused",
+    )
+    _WINDOWS = ("ttft_ms", "e2e_ms", "itl_ms", "queue_wait_ms")
+
+    @classmethod
+    def merge(cls, metrics, replica: str = "fleet") -> "ServingMetrics":
+        """One fleet-level view over N replicas' metrics: counters sum,
+        latency windows pool (so fleet p50/p95 are quantiles over EVERY
+        replica's samples, not an average of averages), and the live
+        gauges (queue depth, active slots, tokens/sec) read through to
+        the sources at scrape time. The result renders/scrapes exactly
+        like a single engine's metrics."""
+        metrics = list(metrics)
+        out = cls(None, replica=replica)
+        out._sources = metrics
+        for name in cls._COUNTERS:
+            setattr(out, name, sum(getattr(m, name) for m in metrics))
+        for name in cls._WINDOWS:
+            pooled = collections.deque(
+                (v for m in metrics for v in getattr(m, name)),
+                maxlen=sum(getattr(m, name).maxlen for m in metrics) or 1,
+            )
+            setattr(out, name, pooled)
+        return out
 
     def emit(self):
         """Write the snapshot to the attached event log as ``serving.*``
-        counters (no-op when the log is disabled)."""
+        counters (no-op when the log is disabled). The ``replica`` name
+        is attached as a tag on each counter, not emitted as a value."""
+        tags = {"replica": self.replica} if self.replica is not None else {}
         for name, value in self.snapshot().items():
-            if value is not None:
-                self.log.counter(f"serving.{name}", value)
+            if name != "replica" and value is not None:
+                self.log.counter(f"serving.{name}", value, **tags)
+
+    #: (metric name, type, help, attribute/window) rows the exposition
+    #: renders — shared by the single-engine and fleet renderers so a
+    #: fleet scrape emits ONE ``# HELP``/``# TYPE`` block per metric with
+    #: a sample per replica (the Prometheus contract for labeled series).
+    _PROM_COUNTERS = (
+        ("requests_submitted_total", "Requests accepted by submit()", "requests_submitted"),
+        ("requests_completed_total", "Requests retired with a result", "requests_completed"),
+        ("requests_cancelled_total", "Requests cancelled mid-flight or queued", "requests_cancelled"),
+        ("tokens_generated_total", "Generated tokens across all requests", "tokens_generated"),
+        ("prefills_total", "Prompt prefills executed", "prefills"),
+        ("preemptions_total", "Admission passes blocked on KV pool exhaustion", "preemptions"),
+        ("requests_shed_total", "Requests rejected by SLO load shedding", "requests_shed"),
+        ("requests_deprioritized_total", "Requests demoted by SLO load shedding", "requests_deprioritized"),
+        ("decode_preemptions_total", "Decoding slots evicted and requeued", "decode_preemptions"),
+        ("resumes_total", "Preempted requests resumed by recompute", "resumes"),
+        ("prefix_hits_total", "Requests that reused a registered shared preamble", "prefix_hits"),
+        ("prefix_misses_total", "Requests with no registered preamble match", "prefix_misses"),
+        ("prefix_evictions_total", "Radix-cache prefix entries evicted (LRU)", "prefix_evictions"),
+        ("prefix_registrations_total", "Shared preambles promoted into the radix cache", "prefix_registrations"),
+        ("prefix_tokens_reused_total", "Prompt tokens served from cached prefixes (no re-prefill)", "prefix_tokens_reused"),
+    )
+    _PROM_SUMMARIES = (
+        ("ttft_ms", "Time to first token (ms)", "ttft_ms"),
+        ("e2e_ms", "Request end-to-end latency (ms)", "e2e_ms"),
+        ("itl_ms", "Inter-token latency (ms) per delivered token", "itl_ms"),
+        ("queue_wait_ms", "Submit-to-admission queue wait (ms)", "queue_wait_ms"),
+    )
+    _PROM_GAUGES = (
+        ("queue_depth", "Requests waiting for a slot", "queue_depth"),
+        ("active_slots", "Slots currently decoding", "active_slots"),
+        ("kv_block_utilization", "Fraction of the paged KV pool in use", "kv_block_utilization"),
+        ("tokens_per_sec", "Decode throughput over the trailing window", "tokens_per_sec"),
+    )
+
+    def _label_str(self, extra: Optional[dict] = None) -> str:
+        labels = {}
+        if self.replica is not None:
+            labels["replica"] = self.replica
+        labels.update(extra or {})
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        return "{" + inner + "}"
+
+    def _prom_samples(self):
+        """``(name, mtype, help, suffix, label_str, value)`` rows for this
+        instance (None values are dropped at render time)."""
+        rows = []
+        for name, help_text, attr in self._PROM_COUNTERS:
+            rows.append((name, "counter", help_text, "", self._label_str(), getattr(self, attr)))
+        for name, help_text, attr in self._PROM_GAUGES:
+            val = getattr(self, attr)
+            if callable(val):
+                val = val()
+            rows.append((name, "gauge", help_text, "", self._label_str(), val))
+        for name, help_text, attr in self._PROM_SUMMARIES:
+            window = getattr(self, attr)
+            rows.append((name, "summary", help_text, "",
+                         self._label_str({"quantile": "0.5"}), _pct(window, 50)))
+            rows.append((name, "summary", help_text, "",
+                         self._label_str({"quantile": "0.95"}), _pct(window, 95)))
+            rows.append((name, "summary", help_text, "_count", self._label_str(), len(window)))
+        return rows
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition (v0.0.4) of the snapshot."""
-        lines = []
-
-        def metric(name, mtype, help_text, samples):
-            lines.append(f"# HELP {_PREFIX}_{name} {help_text}")
-            lines.append(f"# TYPE {_PREFIX}_{name} {mtype}")
-            for labels, value in samples:
-                if value is None:
-                    continue
-                lines.append(f"{_PREFIX}_{name}{labels} {value:g}")
-
-        metric("requests_submitted_total", "counter", "Requests accepted by submit()",
-               [("", self.requests_submitted)])
-        metric("requests_completed_total", "counter", "Requests retired with a result",
-               [("", self.requests_completed)])
-        metric("requests_cancelled_total", "counter", "Requests cancelled mid-flight or queued",
-               [("", self.requests_cancelled)])
-        metric("tokens_generated_total", "counter", "Generated tokens across all requests",
-               [("", self.tokens_generated)])
-        metric("prefills_total", "counter", "Prompt prefills executed",
-               [("", self.prefills)])
-        metric("preemptions_total", "counter", "Admission passes blocked on KV pool exhaustion",
-               [("", self.preemptions)])
-        metric("requests_shed_total", "counter", "Requests rejected by SLO load shedding",
-               [("", self.requests_shed)])
-        metric("requests_deprioritized_total", "counter", "Requests demoted by SLO load shedding",
-               [("", self.requests_deprioritized)])
-        metric("decode_preemptions_total", "counter", "Decoding slots evicted and requeued",
-               [("", self.decode_preemptions)])
-        metric("resumes_total", "counter", "Preempted requests resumed by recompute",
-               [("", self.resumes)])
-        metric("queue_depth", "gauge", "Requests waiting for a slot",
-               [("", self.queue_depth)])
-        metric("active_slots", "gauge", "Slots currently decoding",
-               [("", self.active_slots)])
-        util = self.kv_block_utilization
-        metric("kv_block_utilization", "gauge", "Fraction of the paged KV pool in use",
-               [("", util)])
-        metric("tokens_per_sec", "gauge", "Decode throughput over the trailing window",
-               [("", self.tokens_per_sec())])
-        metric("ttft_ms", "summary", "Time to first token (ms)",
-               [('{quantile="0.5"}', _pct(self.ttft_ms, 50)),
-                ('{quantile="0.95"}', _pct(self.ttft_ms, 95)),
-                ("_count", len(self.ttft_ms))])
-        metric("e2e_ms", "summary", "Request end-to-end latency (ms)",
-               [('{quantile="0.5"}', _pct(self.e2e_ms, 50)),
-                ('{quantile="0.95"}', _pct(self.e2e_ms, 95)),
-                ("_count", len(self.e2e_ms))])
-        metric("itl_ms", "summary", "Inter-token latency (ms) per delivered token",
-               [('{quantile="0.5"}', _pct(self.itl_ms, 50)),
-                ('{quantile="0.95"}', _pct(self.itl_ms, 95)),
-                ("_count", len(self.itl_ms))])
-        metric("queue_wait_ms", "summary", "Submit-to-admission queue wait (ms)",
-               [('{quantile="0.5"}', _pct(self.queue_wait_ms, 50)),
-                ('{quantile="0.95"}', _pct(self.queue_wait_ms, 95)),
-                ("_count", len(self.queue_wait_ms))])
-        return "\n".join(lines) + "\n"
+        """Prometheus text exposition (v0.0.4) of the snapshot. With
+        :attr:`replica` set, every sample carries the ``replica`` label."""
+        return _render_prom(self._prom_samples())

@@ -49,8 +49,11 @@ _GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
 _GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[\d+\]")
 
 
-def _result_bytes(result: str) -> int:
+def _result_bytes(result: str) -> tuple:
+    """(total payload bytes, {dtype: bytes}) over every shape in the
+    result portion (tuples sum their members)."""
     total = 0
+    by_dtype: dict[str, int] = {}
     for dtype, dims in _SHAPE_RE.findall(result):
         if dtype not in _DTYPE_BYTES:
             continue
@@ -58,8 +61,10 @@ def _result_bytes(result: str) -> int:
         for d in dims.split(","):
             if d:
                 elems *= int(d)
-        total += elems * _DTYPE_BYTES[dtype]
-    return total
+        nbytes = elems * _DTYPE_BYTES[dtype]
+        total += nbytes
+        by_dtype[dtype] = by_dtype.get(dtype, 0) + nbytes
+    return total, by_dtype
 
 
 def _group_size(line: str, default: int) -> int:
@@ -98,11 +103,13 @@ def hlo_collective_sites(hlo_text: str, *, default_group: int = 1) -> list[dict]
             if eq < 0 or eq > hit:
                 continue
             result = line[eq + 2 : hit]
+            nbytes, by_dtype = _result_bytes(result)
             sites.append(
                 {
                     "op": op,
                     "prim": _HLO_TO_PRIM[op],
-                    "result_bytes": _result_bytes(result),
+                    "result_bytes": nbytes,
+                    "dtypes": by_dtype,
                     "group_size": _group_size(line, default_group),
                 }
             )
@@ -138,3 +145,42 @@ def hlo_wire_bytes(hlo_text: str, *, default_group: Optional[int] = None) -> dic
         by_prim[s["prim"]] = by_prim.get(s["prim"], 0) + wire
         total += wire
     return {"total": int(total), "by_primitive": by_prim, "sites": sites}
+
+
+#: requested compression-scheme name -> expected wire payload width
+_WIRE_DTYPE_WIDTH = {"bf16": 2, "f16": 2, "fp8": 1, "f8": 1, "int8": 1, "s8": 1}
+
+
+def wire_dtype_upcast(sites, requested_dtype: str) -> Optional[dict]:
+    """Did the compiled program's dominant collective move a WIDER dtype
+    than the compression scheme requested? Some backends upcast narrow
+    collectives during lowering (XLA:CPU runs bf16 all-reduces in f32),
+    which silently erases the wire saving the scheme was chosen for —
+    TPU backends keep the narrow dtype on the wire.
+
+    ``sites`` is :func:`hlo_collective_sites` output (or the ``sites``
+    list of :func:`hlo_wire_bytes`). Only the payload-dominant site is
+    judged: tiny control collectives (an f32 loss pmean, a grad-norm
+    psum) legitimately stay wide next to a quantized gradient leg.
+    Returns ``{"requested", "requested_bytes", "measured_dtype",
+    "measured_bytes", "site_bytes"}`` when an upcast is detected, else
+    None."""
+    want = _WIRE_DTYPE_WIDTH.get(str(requested_dtype).lower())
+    if want is None or not sites:
+        return None
+    dominant = max(sites, key=lambda s: s.get("result_bytes", 0))
+    dtypes = dominant.get("dtypes") or {}
+    if not dtypes:
+        return None
+    # the dominant site's dominant dtype (a fused tuple may mix)
+    dtype = max(dtypes, key=dtypes.get)
+    width = _DTYPE_BYTES.get(dtype, 0)
+    if width <= want:
+        return None
+    return {
+        "requested": str(requested_dtype),
+        "requested_bytes": want,
+        "measured_dtype": dtype,
+        "measured_bytes": width,
+        "site_bytes": int(dominant.get("result_bytes", 0)),
+    }

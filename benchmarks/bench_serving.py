@@ -168,9 +168,305 @@ def run_one(name, scheduler, model, args, vocab, events, rng):
     }
 
 
+# ===================================================================== #
+# fleet mode (--fleet): multi-replica router benchmark
+# ===================================================================== #
+
+
+def fleet_model():
+    """Small enough that a 4-replica fleet drains on a CI box, big enough
+    that re-prefilling a multi-chunk preamble visibly costs TTFT."""
+    from accelerate_tpu.models import LlamaConfig, create_llama_model
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=384,
+    )
+    return create_llama_model(cfg, seq_len=384), cfg
+
+
+def fleet_workload(args, vocab, rng):
+    """Shared-preamble open-loop schedule: every request is one of
+    ``n_preambles`` system prompts (several chunk windows long — the
+    tokens prefix reuse saves) plus a short unique suffix. Generated once
+    so every arm replays the identical offered load."""
+    preambles = [
+        rng.integers(1, vocab - 1, size=args.preamble_len).astype(np.int32)
+        for _ in range(args.n_preambles)
+    ]
+    events, t = [], 0.0
+    for _ in range(args.fleet_clients):
+        t += float(rng.exponential(1.0 / args.fleet_rate))
+        pre = preambles[int(rng.integers(0, len(preambles)))]
+        suffix = rng.integers(1, vocab - 1, size=int(rng.integers(2, max(args.buckets)))).astype(np.int32)
+        prompt = np.concatenate([pre, suffix])
+        events.append((t, prompt, int(rng.choice(args.decode_budgets))))
+    return events
+
+
+def make_fleet(model, args, *, replicas, prefix_reuse=True, roles=None, handoff="auto", store_dir=None):
+    from accelerate_tpu.serving_fleet import FleetConfig, FleetRouter
+
+    return FleetRouter.from_model(
+        model, num_replicas=replicas,
+        config=FleetConfig(
+            roles=roles, handoff=handoff, prefix_reuse=prefix_reuse,
+            min_prefix_tokens=args.buckets[0], promote_after=2, max_prefix_entries=8,
+        ),
+        store_dir=store_dir,
+        num_slots=args.slots, prompt_buckets=tuple(args.buckets),
+        tick_block=args.tick_block, max_len=model.config.max_position_embeddings,
+    )
+
+
+def fleet_warmup(router, args, vocab, rng):
+    """Prime every program any arm can reach on EVERY replica: fused
+    buckets, chunk windows at each width (plain + as a suffix window),
+    the decode tick — and, for disaggregated fleets, one handoff (its
+    paste sees host-resident arrays, a distinct input signature). After
+    this, steady state must be replay-only across radix hits AND
+    misses."""
+    chunk = max(args.buckets)
+    lens = list(args.buckets) + [chunk + b for b in args.buckets] + [2 * chunk + 2]
+    for rep in router.replicas:
+        eng = rep.engine
+        for n in lens:
+            eng.submit(rng.integers(1, vocab - 1, size=n).astype(np.int32), max_new_tokens=2)
+        eng.run()
+        if rep.can_prefill():
+            # prefix-seeded suffix windows (the radix-hit path): register +
+            # serve one suffix per bucket width, then drop the prefix
+            pid = eng.register_prefix(rng.integers(1, vocab - 1, size=chunk + 2).astype(np.int32))
+            for b in args.buckets:
+                eng.submit(rng.integers(1, vocab - 1, size=b).astype(np.int32),
+                           max_new_tokens=2, prefix_id=pid)
+            eng.run()
+            eng.unregister_prefix(pid)
+    if router.disaggregated:
+        src = next(r for r in router.replicas if r.can_prefill())
+        for rep in router.replicas:
+            if rep.can_decode():
+                h = src.engine.prefill_detached(
+                    rng.integers(1, vocab - 1, size=args.buckets[0]).astype(np.int32),
+                    max_new_tokens=2, uid_key=2**30,
+                )
+                rep.engine.submit_prefilled(h)
+                rep.engine.run()
+
+
+def fleet_compiles(router) -> int:
+    return sum(r.engine.program_cache.misses for r in router.replicas)
+
+
+def fleet_drive(router, events):
+    """Replay the arrival schedule in real time against the router;
+    returns ``(elapsed_s, ttft_ms list in submission order, outputs,
+    logprobs)`` with TTFT measured at the harness (arrival -> first
+    streamed token via ``partial``)."""
+    t0 = time.monotonic()
+    pending = list(events)
+    waiting, ttft, uids = {}, {}, []
+    while pending or router._work_remaining():
+        now = time.monotonic() - t0
+        while pending and pending[0][0] <= now:
+            at, prompt, n_new = pending.pop(0)
+            uid = router.submit(prompt, max_new_tokens=n_new)
+            uids.append(uid)
+            waiting[uid] = at
+        if router._work_remaining():
+            router.step()
+        elif pending:
+            time.sleep(min(0.002, max(0.0, pending[0][0] - (time.monotonic() - t0))))
+        now = time.monotonic() - t0
+        for uid, at in list(waiting.items()):
+            if router.partial(uid).size > 0:
+                ttft[uid] = (now - at) * 1000.0
+                del waiting[uid]
+    elapsed = time.monotonic() - t0
+    outs = [np.asarray(router.poll(u)) for u in uids]
+    lps = [np.asarray(router.logprobs(u)) for u in uids]
+    return elapsed, [ttft[u] for u in uids], outs, lps
+
+
+def run_fleet(args) -> int:
+    """The fleet benchmark: prefix-reuse A/B (p95 TTFT + exactness),
+    aggregate-throughput scaling vs replica count, cold-vs-warm replica
+    spin-up over a shared executable store, and KV-handoff byte
+    accounting vs the cost-model prediction. Prints the JSON report;
+    exit code 1 unless every criterion holds."""
+    import tempfile
+
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(1)
+    model, cfg = fleet_model()
+    vocab = cfg.vocab_size
+    args.buckets = (16, 32)
+    args.decode_budgets = (8, 16, 24)
+    args.preamble_len = args.preamble_len or 96
+    args.n_preambles = args.n_preambles or 3
+    args.fleet_clients = args.fleet_clients or 40
+    args.fleet_rate = args.fleet_rate or 6.0
+    args.slots = args.slots or 2
+    args.tick_block = args.tick_block or 4
+    rng = np.random.default_rng(args.seed)
+    events = fleet_workload(args, vocab, rng)
+    report = {
+        "bench": "bench_serving --fleet",
+        "clients": args.fleet_clients,
+        "rate_req_per_s": args.fleet_rate,
+        "preamble_len": args.preamble_len,
+        "n_preambles": args.n_preambles,
+        "slots_per_replica": args.slots,
+        "buckets": list(args.buckets),
+    }
+
+    # -- arm 1: prefix reuse A/B under shared-preamble traffic ----------- #
+    arms = {}
+    for name, reuse in (("no_reuse", False), ("reuse", True)):
+        router = make_fleet(model, args, replicas=2, prefix_reuse=reuse)
+        fleet_warmup(router, args, vocab, np.random.default_rng(args.seed + 1))
+        for rep in router.replicas:
+            for w in (rep.engine.metrics.ttft_ms, rep.engine.metrics.e2e_ms,
+                      rep.engine.metrics.itl_ms, rep.engine.metrics.queue_wait_ms):
+                w.clear()
+        c0 = fleet_compiles(router)
+        elapsed, ttft, outs, lps = fleet_drive(router, events)
+        merged = router.metrics_merged()
+        arms[name] = {
+            "elapsed_s": round(elapsed, 2),
+            "ttft_ms_p50": _pct(ttft, 50),
+            "ttft_ms_p95": _pct(ttft, 95),
+            "tokens_per_sec": round(merged.tokens_generated / elapsed, 1),
+            "prefix_hits": merged.prefix_hits,
+            "prefix_misses": merged.prefix_misses,
+            "prefix_tokens_reused": merged.prefix_tokens_reused,
+            "post_warmup_compiles": fleet_compiles(router) - c0,
+            "_outs": outs,
+            "_lps": lps,
+        }
+    exact_tokens = all(
+        np.array_equal(a, b) for a, b in zip(arms["no_reuse"]["_outs"], arms["reuse"]["_outs"])
+    )
+    exact_lps = all(
+        np.array_equal(a, b) for a, b in zip(arms["no_reuse"]["_lps"], arms["reuse"]["_lps"])
+    )
+    for arm in arms.values():
+        del arm["_outs"], arm["_lps"]
+    report["prefix_reuse_ab"] = arms
+    report["reuse_exact_tokens"] = exact_tokens
+    report["reuse_exact_logprobs"] = exact_lps
+    report["reuse_ttft_p95_speedup"] = round(
+        arms["no_reuse"]["ttft_ms_p95"] / max(1e-9, arms["reuse"]["ttft_ms_p95"]), 3
+    )
+
+    # -- arm 2: aggregate throughput scaling vs replica count ------------ #
+    # One drain thread per replica; XLA releases the GIL during device
+    # compute, so replicas overlap exactly as far as the host has cores.
+    # On a single-core host the honest claim is NOT scale-up (physically
+    # impossible in-process) but bounded serialization overhead — the
+    # criteria below pick the claim that matches the hardware and the
+    # report names which one was enforced.
+    scaling = {}
+    drain_events = [(0.0, p, n) for _, p, n in events]
+    for n_rep in (1, 2, 4):
+        router = make_fleet(model, args, replicas=n_rep, prefix_reuse=True)
+        fleet_warmup(router, args, vocab, np.random.default_rng(args.seed + 1))
+        toks0 = sum(r.engine.metrics.tokens_generated for r in router.replicas)
+        for _, p, n in drain_events:
+            router.submit(p, max_new_tokens=n)
+        elapsed = router.drain_threaded()
+        toks = sum(r.engine.metrics.tokens_generated for r in router.replicas) - toks0
+        scaling[str(n_rep)] = {
+            "tokens_per_sec": round(toks / elapsed, 1),
+            "elapsed_s": round(elapsed, 3),
+            "tokens": int(toks),
+            "aggregate_slots": n_rep * args.slots,
+        }
+    report["scaling"] = scaling
+    report["host_cores"] = os.cpu_count() or 1
+
+    # -- arm 3: replica spin-up, cold vs warm over a shared store -------- #
+    warm_lens = (args.buckets[0], 2 * max(args.buckets) + 2)
+    with tempfile.TemporaryDirectory() as store_dir:
+        router = make_fleet(model, args, replicas=1, prefix_reuse=True, store_dir=store_dir)
+        cold = router.spin_up(warm_prompt_lens=warm_lens)
+        warm = router.spin_up(warm_prompt_lens=warm_lens)
+        router2 = make_fleet(model, args, replicas=1, prefix_reuse=True, store_dir=None)
+        nostore = router2.spin_up(warm_prompt_lens=warm_lens)
+    report["spinup"] = {
+        "cold_store": cold,
+        "warm_store": warm,
+        "no_store": nostore,
+        "speedup": round(nostore["spinup_ms"] / max(1e-9, warm["spinup_ms"]), 3),
+    }
+
+    # -- arm 4: disaggregated prefill/decode + handoff accounting -------- #
+    router = make_fleet(model, args, replicas=2, prefix_reuse=False,
+                        roles=("prefill", "decode"), handoff="always")
+    fleet_warmup(router, args, vocab, np.random.default_rng(args.seed + 1))
+    c0 = fleet_compiles(router)
+    ref_router = make_fleet(model, args, replicas=1, prefix_reuse=False)
+    fleet_warmup(ref_router, args, vocab, np.random.default_rng(args.seed + 1))
+    handoff_events = events[:10]
+    uids = [router.submit(p, max_new_tokens=n) for _, p, n in handoff_events]
+    refs = [ref_router.submit(p, max_new_tokens=n) for _, p, n in handoff_events]
+    done, ref_done = router.run(), ref_router.run()
+    acct = router.handoff_accounting()
+    disagg_exact = all(
+        np.array_equal(done[u], ref_done[r]) for u, r in zip(uids, refs)
+    )
+    report["disaggregated"] = {
+        **acct,
+        "requests": len(uids),
+        "exact_vs_local": disagg_exact,
+        "post_warmup_compiles": fleet_compiles(router) - c0,
+        "bytes_match": acct["bytes_predicted"] == acct["bytes_moved"],
+    }
+
+    # -- criteria -------------------------------------------------------- #
+    criteria = {
+        "reuse_p95_wins": (arms["reuse"]["ttft_ms_p95"] or 1e9)
+        < (arms["no_reuse"]["ttft_ms_p95"] or 0),
+        "reuse_exact": exact_tokens and exact_lps,
+        "reuse_hits": arms["reuse"]["prefix_hits"] > 0
+        and arms["no_reuse"]["prefix_hits"] == 0,
+        "zero_post_warmup_compiles": arms["reuse"]["post_warmup_compiles"] == 0
+        and arms["no_reuse"]["post_warmup_compiles"] == 0
+        and report["disaggregated"]["post_warmup_compiles"] == 0,
+        # multi-core host: the fleet must actually scale aggregate
+        # throughput; single-core host: in-process replicas serialize, so
+        # the enforceable claim is that fleet overhead stays bounded
+        "scaling_up (multi-core)" if (os.cpu_count() or 1) > 1 else "serial_overhead_bounded (1 core)": (
+            max(scaling["2"]["tokens_per_sec"], scaling["4"]["tokens_per_sec"])
+            > 1.15 * scaling["1"]["tokens_per_sec"]
+            if (os.cpu_count() or 1) > 1
+            else scaling["4"]["tokens_per_sec"] >= 0.5 * scaling["1"]["tokens_per_sec"]
+        ),
+        "warm_spinup_zero_compiles": warm["compiles"] == 0 and warm["deserialized"] > 0,
+        "cold_spinup_compiles": nostore["compiles"] > 0,
+        "warm_spinup_faster": warm["spinup_ms"] < nostore["spinup_ms"],
+        "handoff_bytes_match": report["disaggregated"]["bytes_match"]
+        and acct["bytes_moved"] > 0,
+        "disagg_exact": disagg_exact,
+    }
+    report["criteria"] = criteria
+    report["ok"] = all(criteria.values())
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true", help="CPU CI mode: tiny model, bounded load")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode: multi-replica router benchmark (reuse A/B, "
+                         "scaling, spin-up, handoff accounting)")
+    ap.add_argument("--preamble-len", dest="preamble_len", type=int, default=None)
+    ap.add_argument("--n-preambles", dest="n_preambles", type=int, default=None)
+    ap.add_argument("--fleet-clients", dest="fleet_clients", type=int, default=None)
+    ap.add_argument("--fleet-rate", dest="fleet_rate", type=float, default=None)
     ap.add_argument("--clients", type=int, default=None, help="number of synthetic clients")
     ap.add_argument("--rate", type=float, default=None, help="Poisson arrival rate (req/s)")
     ap.add_argument("--slots", type=int, default=None)
@@ -188,6 +484,9 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--schedulers", default="fifo,continuous")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        raise SystemExit(run_fleet(args))
 
     if args.smoke or "--smoke" in (argv or sys.argv):
         from accelerate_tpu.utils.environment import force_host_platform

@@ -127,7 +127,12 @@ def measure_arm(name, zero, method, args_ns):
     hlo = step._jitted.lower(*sample).compile().as_text()
     measured = hlo_wire_bytes(hlo)
     wire_rec = tel.record_wire_bytes(
-        predicted, measured["total"], label=name, by_primitive=measured["by_primitive"]
+        predicted, measured["total"], label=name, by_primitive=measured["by_primitive"],
+        # one-time backend-upcast warning: a compressed arm whose dominant
+        # collective got widened by the backend (XLA:CPU bf16->f32) is
+        # named instead of silently losing its wire saving
+        requested_wire_dtype=method, sites=measured["sites"],
+        platform=jax.default_backend(),
     )
 
     # -- static peak HBM (flight-check sees the sharded opt state) ------

@@ -33,6 +33,7 @@ MODULES = [
     "accelerate_tpu.generation",
     "accelerate_tpu.diffusion",
     "accelerate_tpu.serving",
+    "accelerate_tpu.serving_fleet",
     "accelerate_tpu.scheduling",
     "accelerate_tpu.speculative",
     "accelerate_tpu.big_modeling",

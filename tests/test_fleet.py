@@ -1,0 +1,534 @@
+"""Fleet-scale serving (serving_fleet.py): radix prefix cache semantics,
+router policy, disaggregated KV handoff exactness + cost-model byte
+accounting, fleet SLO shedding, and zero-compile replica spin-up."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import LlamaConfig, create_llama_model
+from accelerate_tpu.scheduling import FleetRoutingPolicy, RoutingConfig, ShedError
+from accelerate_tpu.serving import ServingEngine
+from accelerate_tpu.serving_fleet import FleetConfig, FleetRouter, RadixPrefixCache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    return create_llama_model(LlamaConfig.tiny(), seq_len=16)
+
+
+@pytest.fixture(autouse=True)
+def bound_live_executables_per_test():
+    """This module builds several engines (= many resident programs) per
+    test; clearing per TEST keeps the process-wide live-executable set
+    tiny (the conftest-documented XLA:CPU late-fresh-compile segfault
+    class). Cross-test recompiles hit the persistent disk cache."""
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
+def _reference(model, prompt, n):
+    return np.asarray(generate(model, np.asarray(prompt, np.int32)[None], max_new_tokens=n))[0]
+
+
+def _engine(model, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("prompt_buckets", (4, 8))
+    return ServingEngine(model, **kw)
+
+
+# --------------------------------------------------------------------- #
+# routing policy (scheduling.py)
+# --------------------------------------------------------------------- #
+
+
+def test_routing_policy_least_loaded_and_round_robin():
+    p = FleetRoutingPolicy(RoutingConfig(policy="least_loaded"))
+    assert p.pick_replica([3, 1, 2], [0, 1, 2]) == 1
+    assert p.pick_replica([1, 1, 2], [0, 1, 2]) == 0  # tie -> lowest index
+    assert p.pick_replica([0, 9, 0], [1, 2]) == 2  # eligibility filters
+    rr = FleetRoutingPolicy(RoutingConfig(policy="round_robin"))
+    picks = [rr.pick_replica([0, 0, 0], [0, 1, 2]) for _ in range(6)]
+    assert picks == [0, 1, 2, 0, 1, 2]
+
+
+def test_routing_policy_fleet_shed_respects_priority_floor():
+    p = FleetRoutingPolicy(RoutingConfig(max_fleet_queue_depth=4))
+    assert p.shed_on_submit(0, 100) is None  # priority 0 unsheddable
+    assert p.shed_on_submit(1, 3) is None
+    assert "fleet queue depth" in p.shed_on_submit(1, 4)
+
+
+def test_routing_config_validation():
+    with pytest.raises(ValueError, match="policy"):
+        RoutingConfig(policy="random")
+    with pytest.raises(ValueError, match="max_fleet_queue_depth"):
+        RoutingConfig(max_fleet_queue_depth=0)
+    with pytest.raises(ValueError, match="roles"):
+        FleetConfig(roles=("mixed", "oracle"))
+    with pytest.raises(ValueError, match="handoff"):
+        FleetConfig(handoff="sometimes")
+
+
+# --------------------------------------------------------------------- #
+# radix prefix cache
+# --------------------------------------------------------------------- #
+
+
+def test_radix_promotes_shared_preamble_and_reuse_is_exact(tiny_llama):
+    eng = _engine(tiny_llama)
+    rad = RadixPrefixCache(eng, min_prefix_tokens=4, promote_after=2)
+    pre = (np.arange(1, 7) % 250).astype(np.int32)
+    p1 = np.concatenate([pre, [41, 42]]).astype(np.int32)
+    p2 = np.concatenate([pre, [51, 52, 53]]).astype(np.int32)
+    assert rad.lookup(p1) is None and rad.observe(p1) is None
+    assert rad.lookup(p2) is None
+    pid = rad.observe(p2)  # second prompt through the shared preamble
+    assert pid is not None
+    assert rad.lookup(p2) == (pid, 6)  # the 6-token divergence point
+    # engine-path exactness: suffix prefill over the registered cache
+    uid = eng.submit(p2[6:], max_new_tokens=4, prefix_id=pid)
+    eng.run()
+    np.testing.assert_array_equal(eng.poll(uid), _reference(tiny_llama, p2, 4))
+    st = rad.stats()
+    assert st["hits"] == 1 and st["registrations"] == 1
+    assert eng.metrics.prefix_hits == 1 and eng.metrics.prefix_tokens_reused == 6
+
+
+def test_radix_min_tokens_and_proper_prefix_rules(tiny_llama):
+    eng = _engine(tiny_llama)
+    rad = RadixPrefixCache(eng, min_prefix_tokens=8, promote_after=2)
+    short = np.arange(1, 6, dtype=np.int32)  # 5-token LCP < min 8
+    rad.observe(np.concatenate([short, [9]]))
+    assert rad.observe(np.concatenate([short, [10]])) is None
+    # a prompt EQUAL to a registered prefix must not match (no suffix)
+    rad2 = RadixPrefixCache(eng, min_prefix_tokens=4, promote_after=2)
+    pre = np.arange(20, 29, dtype=np.int32)
+    rad2.observe(np.concatenate([pre, [1]]))
+    pid = rad2.observe(np.concatenate([pre, [2]]))
+    assert pid is not None
+    assert rad2.lookup(pre) is None  # nothing left to prefill
+    assert rad2.lookup(np.concatenate([pre, [3]])) == (pid, 9)
+
+
+def test_radix_lru_eviction_frees_engine_prefix(tiny_llama):
+    eng = _engine(tiny_llama)
+    rad = RadixPrefixCache(eng, min_prefix_tokens=4, promote_after=2, max_entries=1)
+    pre_a = np.arange(1, 6, dtype=np.int32)
+    pre_b = np.arange(30, 36, dtype=np.int32)
+    rad.observe(np.concatenate([pre_a, [7]]))
+    pid_a = rad.observe(np.concatenate([pre_a, [8]]))
+    assert pid_a is not None and len(eng._prefixes) == 1
+    rad.observe(np.concatenate([pre_b, [7]]))
+    pid_b = rad.observe(np.concatenate([pre_b, [8]]))
+    assert pid_b is not None
+    # budget 1: the older entry was unregistered from the engine too
+    assert rad.stats()["evictions"] == 1 and len(rad.entries) == 1
+    assert pid_a not in eng._prefixes and pid_b in eng._prefixes
+    assert eng.metrics.prefix_evictions == 1
+    assert rad.lookup(np.concatenate([pre_a, [9]])) is None
+
+
+def test_radix_eviction_skips_referenced_entry(tiny_llama):
+    eng = _engine(tiny_llama)
+    rad = RadixPrefixCache(eng, min_prefix_tokens=4, promote_after=2, max_entries=1)
+    pre_a = np.arange(1, 6, dtype=np.int32)
+    rad.observe(np.concatenate([pre_a, [7]]))
+    pid_a = rad.observe(np.concatenate([pre_a, [8]]))
+    m = rad.lookup(np.concatenate([pre_a, [9]]))
+    eng.submit(np.asarray([9], np.int32), max_new_tokens=2, prefix_id=m[0])
+    # a queued request pins pid_a: the new registration may not evict it
+    pre_b = np.arange(30, 36, dtype=np.int32)
+    rad.observe(np.concatenate([pre_b, [7]]))
+    rad.observe(np.concatenate([pre_b, [8]]))
+    assert pid_a in eng._prefixes  # still registered (referenced)
+    assert len(rad.entries) == 2  # over budget until the reference drains
+    eng.run()
+    pre_c = np.arange(60, 66, dtype=np.int32)
+    rad.observe(np.concatenate([pre_c, [7]]))
+    rad.observe(np.concatenate([pre_c, [8]]))
+    assert len(rad.entries) <= 2  # eviction caught up after the drain
+
+
+def test_radix_invalidate(tiny_llama):
+    eng = _engine(tiny_llama)
+    rad = RadixPrefixCache(eng, min_prefix_tokens=4, promote_after=2)
+    pre = np.arange(1, 7, dtype=np.int32)
+    rad.observe(np.concatenate([pre, [1]]))
+    pid = rad.observe(np.concatenate([pre, [2]]))
+    assert rad.invalidate(pid) == 1
+    assert rad.lookup(np.concatenate([pre, [3]])) is None
+    assert pid not in eng._prefixes
+    with pytest.raises(ValueError, match="unknown prefix_id"):
+        rad.invalidate(pid)
+
+
+# --------------------------------------------------------------------- #
+# KV handoff (engine surface)
+# --------------------------------------------------------------------- #
+
+
+def test_handoff_token_and_logprob_exact_dense_and_paged(tiny_llama):
+    prompt = (np.arange(1, 10) % 250).astype(np.int32)
+    ref = _reference(tiny_llama, prompt, 5)
+    src = _engine(tiny_llama)
+    h = src.prefill_detached(prompt, max_new_tokens=5, uid_key=3)
+    for dst_kw in ({}, {"paged_block_size": 4}):
+        dst = _engine(tiny_llama, **dst_kw)
+        uid = dst.submit_prefilled(dict(h))
+        dst.run()
+        np.testing.assert_array_equal(dst.poll(uid), ref)
+        # logprob-exact vs a local submit on a fresh engine
+        local = _engine(tiny_llama)
+        lu = local.submit(prompt, max_new_tokens=5)
+        local.run()
+        np.testing.assert_array_equal(dst.logprobs(uid), local.logprobs(lu))
+
+
+def test_handoff_sampled_stream_matches_local_submit(tiny_llama):
+    """temperature>0: the handoff carries the advanced sampling chain, so
+    a disaggregated request's sampled stream equals the single-engine
+    stream for the same (seed, uid)."""
+    prompt = (np.arange(1, 9) % 250).astype(np.int32)
+    local = _engine(tiny_llama, temperature=0.9, seed=5, num_slots=1)
+    lu = local.submit(prompt, max_new_tokens=6)
+    local.run()
+    src = _engine(tiny_llama, temperature=0.9, seed=5, num_slots=1)
+    dst = _engine(tiny_llama, temperature=0.9, seed=5, num_slots=1)
+    uid = dst.submit_prefilled(src.prefill_detached(prompt, max_new_tokens=6, uid_key=lu))
+    dst.run()
+    np.testing.assert_array_equal(dst.poll(uid), local.poll(lu))
+    np.testing.assert_array_equal(dst.logprobs(uid), local.logprobs(lu))
+
+
+def test_handoff_bytes_match_costmodel_prediction(tiny_llama):
+    from accelerate_tpu.analysis.costmodel import price_kv_handoff
+
+    eng = _engine(tiny_llama)
+    per_tok, fixed = eng.kv_handoff_dims()
+    assert per_tok > 0
+    for n in (3, 8, 11):
+        prompt = (np.arange(1, n + 1) % 250).astype(np.int32)
+        h = eng.prefill_detached(prompt, max_new_tokens=2, uid_key=n)
+        pred = price_kv_handoff(per_tok, n, fixed_bytes=fixed, generation="cpu")
+        assert pred["bytes"] == h["wire_bytes"] == per_tok * n + fixed
+        assert pred["time_us"] > 0
+
+
+def test_handoff_validation(tiny_llama):
+    eng = _engine(tiny_llama)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.prefill_detached(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="exceeds the slot cache"):
+        eng.prefill_detached(np.ones((8,), np.int32), max_new_tokens=150)
+    h = eng.prefill_detached(np.ones((4,), np.int32), max_new_tokens=4)
+    bad = dict(h)
+    bad["total"] = 3
+    with pytest.raises(ValueError, match="handoff total"):
+        eng.submit_prefilled(bad)
+    big = dict(h)
+    big["max_new_tokens"] = 150
+    with pytest.raises(ValueError, match="exceeds the slot cache"):
+        eng.submit_prefilled(big)
+
+
+def test_handoff_request_survives_preemption(tiny_llama):
+    """A handed-off request evicted mid-decode resumes by ordinary
+    recompute (the handoff is consumed at first admission) and stays
+    token-exact."""
+    from accelerate_tpu.scheduling import SchedulerConfig
+
+    prompt = (np.arange(1, 9) % 250).astype(np.int32)
+    ref = _reference(tiny_llama, prompt, 8)
+    src = _engine(tiny_llama)
+    dst = ServingEngine(
+        tiny_llama, num_slots=1, prompt_buckets=(4, 8), tick_block=2,
+        scheduler=SchedulerConfig(enable_preemption=True),
+    )
+    uid = dst.submit_prefilled(
+        src.prefill_detached(prompt, max_new_tokens=8, uid_key=0), priority=1
+    )
+    dst.step()  # handoff admitted, decoding
+    assert dst.partial(uid).size > 0
+    hi = dst.submit(np.asarray([5, 6], np.int32), max_new_tokens=2, priority=0)
+    dst.run()  # priority-0 arrival preempts the handoff decode
+    assert dst.metrics.decode_preemptions >= 1
+    np.testing.assert_array_equal(dst.poll(uid), ref)
+    assert dst.poll(hi) is not None
+
+
+# --------------------------------------------------------------------- #
+# the router
+# --------------------------------------------------------------------- #
+
+
+def test_fleet_outputs_exact_and_prefix_affinity(tiny_llama):
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(min_prefix_tokens=4, promote_after=2),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    pre = (np.arange(1, 7) % 250).astype(np.int32)
+    prompts = [np.concatenate([pre, [40 + i]]).astype(np.int32) for i in range(6)]
+    uids = [fr.submit(p, max_new_tokens=4) for p in prompts]
+    out = fr.run()
+    for u, p in zip(uids, prompts):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 4))
+    stats = fr.radix_stats()
+    # after promotion, affinity routes every preamble-sharing request to
+    # the owning replica: exactly one replica holds the entry + the hits
+    owners = [n for n, s in stats.items() if s["entries"] > 0]
+    assert len(owners) == 1
+    assert stats[owners[0]]["hits"] >= 1
+    merged = fr.metrics_merged()
+    assert merged.prefix_hits == sum(s["hits"] for s in stats.values())
+    assert merged.requests_completed == len(prompts)
+
+
+def test_fleet_no_reuse_config(tiny_llama):
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2, config=FleetConfig(prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    assert all(r.radix is None for r in fr.replicas)
+    p = (np.arange(1, 9) % 250).astype(np.int32)
+    u = fr.submit(p, max_new_tokens=3)
+    out = fr.run()
+    np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
+
+
+def test_fleet_level_shed(tiny_llama):
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(routing=RoutingConfig(max_fleet_queue_depth=1), prefix_reuse=False),
+        num_slots=1, prompt_buckets=(4, 8),
+    )
+    fr.submit(np.ones((4,), np.int32), max_new_tokens=2)
+    fr.submit(np.ones((4,), np.int32), max_new_tokens=2)
+    # aggregate queue depth (minus in-flight) crosses the fleet SLO for a
+    # sheddable class; priority 0 stays admissible
+    with pytest.raises(ShedError, match="fleet queue depth"):
+        while True:
+            fr.submit(np.ones((4,), np.int32), max_new_tokens=2, priority=1)
+    fr.submit(np.ones((4,), np.int32), max_new_tokens=2, priority=0)
+    assert fr.fleet_shed == 1
+    fr.run()
+
+
+def test_fleet_disaggregated_exact_and_accounted(tiny_llama):
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(roles=("prefill", "decode"), handoff="always", prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    prompts = [(np.arange(1, 8 + i) % 250).astype(np.int32) for i in range(3)]
+    uids = [fr.submit(p, max_new_tokens=4) for p in prompts]
+    out = fr.run()
+    for u, p in zip(uids, prompts):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 4))
+    acct = fr.handoff_accounting()
+    assert acct["handoffs"] == 3
+    assert acct["bytes_predicted"] == acct["bytes_moved"] > 0
+    # decode replica did all the decoding; prefill replica served no slots
+    assert fr.replicas[1].engine.metrics.requests_completed == 3
+    assert fr.replicas[0].engine.metrics.requests_completed == 0
+
+
+def test_fleet_disaggregated_auto_decision(tiny_llama):
+    """auto mode prices every candidate transfer BEFORE it happens and
+    takes exactly one decision per request (handoff or local re-prefill),
+    and handoff=never pins the local path."""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(roles=("prefill", "decode"), handoff="auto", prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    u = fr.submit((np.arange(1, 9) % 250).astype(np.int32), max_new_tokens=3)
+    out = fr.run()
+    assert u in out
+    acct = fr.handoff_accounting()
+    assert acct["handoffs"] + acct["handoffs_local"] == 1
+    fr2 = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(roles=("prefill", "decode"), handoff="never", prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    u2 = fr2.submit((np.arange(1, 9) % 250).astype(np.int32), max_new_tokens=3)
+    out2 = fr2.run()
+    np.testing.assert_array_equal(out2[u2], _reference(tiny_llama, (np.arange(1, 9) % 250), 3))
+    assert fr2.handoff_accounting() == {
+        "handoffs": 0, "handoffs_local": 1, "bytes_predicted": 0,
+        "bytes_moved": 0, "time_us_predicted": 0.0,
+    }
+
+
+def test_fleet_partial_logprobs_cancel(tiny_llama):
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2, config=FleetConfig(prefix_reuse=False),
+        num_slots=1, prompt_buckets=(4, 8), tick_block=2,
+    )
+    p = (np.arange(1, 9) % 250).astype(np.int32)
+    u1 = fr.submit(p, max_new_tokens=6)
+    u2 = fr.submit(p, max_new_tokens=6)
+    assert fr.partial(u1).size == 0 and fr.poll(u1) is None
+    fr.step()
+    got = fr.cancel(u2)
+    assert isinstance(got, np.ndarray)
+    with pytest.raises(KeyError):
+        fr.partial(u2)
+    fr.run()
+    assert fr.poll(u1) is not None
+    assert fr.logprobs(u1).shape[0] == len(fr.partial(u1))
+    with pytest.raises(KeyError, match="unknown request id"):
+        fr.poll(10_000)
+
+
+def test_fleet_drain_threaded_matches_sequential(tiny_llama):
+    prompts = [(np.arange(1, 5 + i) % 250).astype(np.int32) for i in range(8)]
+    outs = {}
+    for mode in ("seq", "thr"):
+        fr = FleetRouter.from_model(
+            tiny_llama, num_replicas=2, config=FleetConfig(prefix_reuse=False),
+            num_slots=2, prompt_buckets=(4, 8),
+        )
+        uids = [fr.submit(p, max_new_tokens=3) for p in prompts]
+        if mode == "thr":
+            fr.drain_threaded()
+        out = fr.run()  # seq drive / collect
+        outs[mode] = [out[u] for u in uids]
+    for a, b in zip(outs["seq"], outs["thr"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fleet_watchdog_silent_across_radix_hits_and_misses(tiny_llama):
+    """Post-warmup compile count stays 0 across prefix registrations,
+    hits, misses, and evictions — the recompile-watchdog discipline at
+    fleet level."""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=1,
+        config=FleetConfig(min_prefix_tokens=4, promote_after=2, max_prefix_entries=1),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    eng = fr.replicas[0].engine
+    rng = np.random.default_rng(0)
+    # warm every width: buckets, chunk windows, prefix-suffix windows
+    for n in (4, 8, 10, 13):
+        eng.submit(rng.integers(1, 250, size=n).astype(np.int32), max_new_tokens=2)
+    eng.run()
+    pid = eng.register_prefix(rng.integers(1, 250, size=9).astype(np.int32))
+    for b in (4, 8):
+        eng.submit(rng.integers(1, 250, size=b).astype(np.int32), max_new_tokens=2, prefix_id=pid)
+    eng.run()
+    eng.unregister_prefix(pid)
+    c0 = eng.program_cache.misses
+    pre_a = rng.integers(1, 250, size=6).astype(np.int32)
+    pre_b = rng.integers(1, 250, size=7).astype(np.int32)
+    uids = []
+    for pre in (pre_a, pre_a, pre_a, pre_b, pre_b, pre_b):
+        sfx = rng.integers(1, 250, size=int(rng.integers(2, 5))).astype(np.int32)
+        uids.append(fr.submit(np.concatenate([pre, sfx]), max_new_tokens=3))
+    out = fr.run()
+    assert len(out) == len(uids)
+    stats = fr.radix_stats()["r0"]
+    assert stats["registrations"] >= 2 and stats["hits"] >= 2
+    assert eng.program_cache.misses - c0 == 0, "radix traffic must not compile"
+
+
+def test_fleet_spin_up_warm_starts_from_shared_store(tiny_llama, tmp_path):
+    """In-process spin-up over a shared store: every program either
+    deserializes or is a reject-and-heal recompile — never a silent cold
+    compile. (The STRICT 0-compile contract holds for fresh-process
+    replicas — bench_serving --fleet and the subprocess test below — and
+    in-process under a single-device backend; under the suite's 8-device
+    fake mesh XLA:CPU can emit non-self-contained blobs from a long-lived
+    process, the PR-7-documented class the reject path heals.)"""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=1, config=FleetConfig(prefix_reuse=False),
+        store_dir=str(tmp_path / "fleet_store"),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    cold = fr.spin_up(warm_prompt_lens=(4,))
+    assert cold["compiles"] > 0 and cold["deserialized"] == 0
+    warm = fr.spin_up(warm_prompt_lens=(4,))
+    pc = fr.replicas[2].engine.program_cache
+    assert warm["deserialized"] > 0
+    assert warm["compiles"] == pc.rejected, "only healed rejects may recompile"
+    assert warm["deserialized"] + warm["compiles"] == cold["compiles"]
+    assert len(fr.replicas) == 3
+    # the spun-up replica serves real traffic
+    p = (np.arange(1, 6) % 250).astype(np.int32)
+    u = fr.submit(p, max_new_tokens=3)
+    out = fr.run()
+    np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
+
+
+# --------------------------------------------------------------------- #
+# fleet-level cross-process warm spin-up (promotes the PR-7 test)
+# --------------------------------------------------------------------- #
+
+_CHILD_FLEET_REPLICA = """
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from accelerate_tpu.utils.environment import force_host_platform
+force_host_platform(1)
+from accelerate_tpu.models import LlamaConfig, create_llama_model
+from accelerate_tpu.serving_fleet import FleetConfig, FleetRouter
+
+model = create_llama_model(LlamaConfig.tiny(), seq_len=16)
+router = FleetRouter.from_model(
+    model, num_replicas=1,
+    config=FleetConfig(min_prefix_tokens=4, promote_after=2),
+    store_dir={store!r}, num_slots=2, prompt_buckets=(4, 8),
+)
+pre = (np.arange(1, 7) % 250).astype(np.int32)
+prompts = [np.concatenate([pre, [40 + i]]).astype(np.int32) for i in range(4)]
+uids = [router.submit(p, max_new_tokens=3) for p in prompts]
+out = router.run()
+eng = router.replicas[0].engine
+radix = router.radix_stats()["r0"]
+toks = " ".join(str(t) for t in np.concatenate([out[u] for u in uids]))
+print("FLEETREP", eng.program_cache.misses, eng.program_cache.deserialized,
+      radix["hits"], radix["registrations"], toks)
+"""
+
+
+@pytest.mark.slow
+def test_fleet_warm_replica_subprocess_zero_compiles(tmp_path):
+    """The fleet-level warm-replica assertion: a FRESH SUBPROCESS builds
+    a replica over the shared ExecutableStore and serves shared-preamble
+    traffic with 0 XLA compiles — with its radix cache starting COLD
+    (prefix registration replays the chunk programs from the store too).
+    Promotes the PR-7 two-subprocess engine test to the fleet layer."""
+    store = str(tmp_path / "store")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.pop("XLA_FLAGS", None)
+
+    def replica():
+        out = subprocess.run(
+            [sys.executable, "-c", _CHILD_FLEET_REPLICA.format(repo=REPO, store=store)],
+            capture_output=True, text=True, env=env, timeout=600,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        tag, misses, deser, hits, regs, *tokens = out.stdout.strip().splitlines()[-1].split()
+        assert tag == "FLEETREP"
+        return int(misses), int(deser), int(hits), int(regs), tokens
+
+    cold_misses, cold_deser, cold_hits, cold_regs, ref = replica()
+    assert cold_misses >= 1 and cold_deser == 0
+    assert cold_regs == 1 and cold_hits >= 1  # radix promoted + reused
+
+    warm_misses, warm_deser, warm_hits, warm_regs, got = replica()
+    assert warm_misses == 0, "warm fleet replica must not compile"
+    assert warm_deser == cold_misses  # every program came from the store
+    assert warm_regs == 1 and warm_hits == cold_hits  # radix started cold, re-promoted
+    assert got == ref  # token-exact across processes

@@ -416,6 +416,48 @@ def test_serving_metrics_prometheus_exposition(tiny_llama):
         float(value)
 
 
+def test_serving_metrics_replica_label(tiny_llama):
+    eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,))
+    eng.metrics.replica = "r7"
+    eng.generate_many([np.ones((4,), np.int32)], max_new_tokens=3)
+    text = eng.metrics.prometheus_text()
+    assert 'accelerate_tpu_serving_requests_completed_total{replica="r7"} 1' in text
+    assert 'accelerate_tpu_serving_ttft_ms{replica="r7",quantile="0.5"}' in text
+    assert 'accelerate_tpu_serving_ttft_ms_count{replica="r7"} 1' in text
+    assert eng.metrics.snapshot()["replica"] == "r7"
+
+
+def test_serving_metrics_merge_aggregates_fleet_view(tiny_llama):
+    from accelerate_tpu.telemetry.serving_metrics import ServingMetrics, fleet_prometheus_text
+
+    engines = []
+    for name in ("r0", "r1"):
+        eng = ServingEngine(tiny_llama, num_slots=2, prompt_buckets=(8,))
+        eng.metrics.replica = name
+        eng.generate_many([np.ones((4,), np.int32)], max_new_tokens=3)
+        engines.append(eng)
+    merged = ServingMetrics.merge([e.metrics for e in engines])
+    assert merged.requests_completed == 2
+    assert merged.tokens_generated == 6
+    # pooled latency windows: fleet percentiles see every replica's samples
+    assert len(merged.ttft_ms) == 2
+    snap = merged.snapshot()
+    assert snap["replica"] == "fleet" and snap["requests_completed"] == 2
+    text = merged.prometheus_text()
+    assert 'accelerate_tpu_serving_tokens_generated_total{replica="fleet"} 6' in text
+    # one scrape body for the whole fleet: ONE HELP/TYPE block per metric,
+    # one labeled sample per replica
+    fleet_text = fleet_prometheus_text([e.metrics for e in engines])
+    assert fleet_text.count("# TYPE accelerate_tpu_serving_requests_completed_total counter") == 1
+    assert 'requests_completed_total{replica="r0"} 1' in fleet_text
+    assert 'requests_completed_total{replica="r1"} 1' in fleet_text
+    for line in fleet_text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)
+
+
 def test_serving_metrics_mirror_to_event_log(tiny_llama, tmp_path):
     from accelerate_tpu.telemetry import EventLog, read_events
 

@@ -742,3 +742,39 @@ def test_hlo_wire_bytes_parses_collectives():
     assert out["by_primitive"]["all_to_all"] == ring_wire_bytes("all_to_all", 16, 2)
     assert out["total"] == sum(out["by_primitive"].values())
     assert len(out["sites"]) == 4
+
+
+def test_wire_dtype_upcast_detection_and_one_time_warning():
+    """A compressed wire whose dominant collective moves a wider dtype
+    than requested (the XLA:CPU bf16->f32 upcast) fires ONE
+    ``wire_dtype_upcast`` warning naming the platform; a narrow wire and
+    small wide control collectives stay silent."""
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.telemetry.wire import hlo_collective_sites, wire_dtype_upcast
+
+    upcast_hlo = "\n".join([
+        # the big gradient leg got upcast to f32...
+        "  %ar = f32[4096]{0} all-reduce(f32[4096]{0} %g), replica_groups=[1,8]<=[8]",
+        # ...while a tiny f32 loss pmean is legitimate next to any scheme
+        "  %loss = f32[] all-reduce(f32[] %l), replica_groups=[1,8]<=[8]",
+    ])
+    sites = hlo_collective_sites(upcast_hlo)
+    assert sites[0]["dtypes"] == {"f32": 4096 * 4}
+    up = wire_dtype_upcast(sites, "bf16")
+    assert up["measured_dtype"] == "f32" and up["requested_bytes"] == 2
+    narrow = hlo_collective_sites(
+        "  %ar = bf16[4096]{0} all-reduce(bf16[4096]{0} %g), replica_groups=[1,8]<=[8]\n"
+        "  %loss = f32[] all-reduce(f32[] %l), replica_groups=[1,8]<=[8]\n"
+    )
+    assert wire_dtype_upcast(narrow, "bf16") is None  # dominant site is narrow
+    assert wire_dtype_upcast(sites, None) is None  # no compression requested
+
+    tel = Telemetry(None)
+    r1 = tel.record_wire_bytes(
+        100, 100, requested_wire_dtype="bf16", sites=sites, platform="cpu"
+    )
+    assert r1["dtype_upcast"]["measured_dtype"] == "f32"
+    r2 = tel.record_wire_bytes(
+        100, 100, requested_wire_dtype="bf16", sites=sites, platform="cpu"
+    )
+    assert "dtype_upcast" not in r2, "warning must latch after the first firing"
