@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check tune-selfcheck tune-bench telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -40,6 +40,7 @@ lint:
 	$(MAKE) --no-print-directory divergence
 	$(MAKE) --no-print-directory perf-check
 	$(MAKE) --no-print-directory numerics-check
+	$(MAKE) --no-print-directory tune-selfcheck
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
@@ -87,6 +88,27 @@ numerics-check:
 		examples/by_feature/numerics_check.py::train_step --mesh data=8
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check accelerate_tpu
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check examples
+
+# Config tier (autotuner): prove TPU701-705 fire on their seeded
+# misconfigurations (TPU701 end to end through a real single-candidate
+# tune whose static peak HBM cannot fit a tiny budget) and every clean
+# twin stays silent — then dogfood a real tune over the example train
+# workload. The gate is STRICT for TPU701 (an infeasible declared config
+# cannot run) via its error severity; TPU702-705 warnings report but
+# pass.
+tune-selfcheck:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli tune --selfcheck \
+		examples/by_feature/tune.py::train_workload --mesh data=8 \
+		--meshes "data=8;data=4,tensor=2" --compressions none,int8 --generation cpu
+
+# Autotuner oracle A/B on CPU (committed evidence: BENCH_TUNE.json):
+# static ranking vs StepTelemetry-measured step time on the train
+# (mesh x zero x compression) and serving (buckets x token budget)
+# toy workloads, exact predicted-vs-HLO wire agreement, the TPU701
+# prune exercised, zero post-warmup recompiles. Exits nonzero unless
+# report.ok.
+tune-bench:
+	env JAX_PLATFORMS=cpu python benchmarks/bench_tune.py --smoke
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake

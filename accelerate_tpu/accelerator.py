@@ -1028,6 +1028,82 @@ class Accelerator:
             )
         return report
 
+    def tune(
+        self,
+        workload: Callable,
+        *sample_args,
+        space=None,
+        generation: Optional[str] = None,
+        hbm_gb: Optional[float] = None,
+        top_k: int = 3,
+        confirm: bool = False,
+        confirm_steps: int = 8,
+        shape_histogram=None,
+        optimizer=None,
+        ignore=(),
+    ):
+        """Search configuration space for the fastest feasible config of
+        ``workload`` with the static analyzers as the oracle — ROADMAP
+        item 4 paid off: every candidate the
+        :class:`~accelerate_tpu.analysis.SearchSpace` enumerates is
+        constraint-pruned, flight-checked (static peak HBM vs the
+        generation's capacity — the TPU701 feasibility prune), and
+        rooflined (:meth:`perf_check`'s predicted step time / MFU bound,
+        costmodel wire bytes as the tiebreak), all statically, in
+        milliseconds per candidate, before anything compiles.
+
+        ``workload`` is a plain step function (``sample_args`` traced
+        abstractly; the mesh/bucket knobs vary around it) or a workload
+        factory — any callable with a truthy ``tune_factory`` attribute,
+        called as ``workload(point) -> (step_fn, sample_args)`` per
+        candidate. ``space=None`` searches the default neighborhood over
+        this accelerator's device pool
+        (:func:`~accelerate_tpu.analysis.default_space`). With
+        ``confirm=True`` the top-``top_k`` candidates are measured with
+        short :class:`~accelerate_tpu.telemetry.StepTelemetry` runs and
+        the report carries predicted-vs-measured rank agreement.
+
+        Returns a :class:`~accelerate_tpu.analysis.TuneReport`
+        (``.render_text()``, ``.as_dict()``, ``.winner``,
+        ``.chosen_toml()`` — the ``[tune.chosen]`` block to commit into
+        ``.tpulint.toml``; ``analysis.load_chosen()`` +
+        ``ConfigPoint.parallelism_kwargs()`` feed it back into
+        :class:`~accelerate_tpu.utils.ParallelismPlugin`). The winner is
+        logged. See ``docs/usage_guides/autotuning.md``.
+        """
+        from .analysis import default_space
+        from .analysis.tuner import tune as _tune
+
+        jax = _jax()
+        if space is None:
+            space = default_space(len(jax.devices()))
+        report = _tune(
+            workload,
+            space,
+            *sample_args,
+            base_mesh=self.mesh,
+            generation=generation,
+            hbm_gb=hbm_gb,
+            top_k=top_k,
+            confirm=confirm,
+            confirm_steps=confirm_steps,
+            shape_histogram=shape_histogram,
+            optimizer=optimizer,
+            ignore=ignore,
+        )
+        if report.winner is not None:
+            logger.info(
+                "tune: winner %s — predicted %.3f ms (of %d candidates, %d pruned, %d infeasible)",
+                report.winner.label,
+                (report.winner.predicted_step_us or 0.0) / 1000.0,
+                len(report.candidates),
+                report.pruned_count,
+                report.infeasible_count,
+            )
+        else:
+            logger.warning("tune: no feasible candidate (of %d)", len(report.candidates))
+        return report
+
     def build_train_step(
         self,
         loss_fn: Callable,

@@ -28,6 +28,20 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   the TPU5xx efficiency rules (``perf_rules``): MXU tile misalignment,
   redundant collectives, latency-bound small DCN collectives, missed
   collective/compute overlap, f32 matmuls that are safely bf16.
+* **config tier** (``tune`` / ``check_config_rules``) — the static
+  autotuner: ``searchspace`` types the repo's knob surface (mesh layout
+  + DCN axes, ZeRO stage, grad compression, shape buckets, serving
+  token budget / tick block / slots, routing, handoff mode) into an
+  enumerable, constraint-pruned :class:`SearchSpace`; ``tuner`` scores
+  every candidate with the analyzers as the oracle (flight-check peak
+  HBM as the feasibility prune, perfmodel predicted step time + MFU
+  bound as the score, costmodel wire bytes as the tiebreak), optionally
+  confirms the top-k with short measured ``StepTelemetry`` runs, and
+  emits the winner as a loadable ``[tune.chosen]`` block; the TPU7xx
+  rules (``tune_rules``) catch one-off misconfigurations — infeasible
+  HBM (error, strict gate), dominated comms-bound configs, bucket
+  padding waste, quantized wire the platform upcasts, ZeRO-1 with a
+  non-elementwise optimizer — without a full search.
 * **numerics tier** (``numerics_check``) — the value-interval +
   dtype-provenance abstract interpretation (``numerics``): per-value
   bounds derived from stated input assumptions (widening through
@@ -41,9 +55,10 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
 
 Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
 ``accelerate-tpu divergence`` / ``accelerate-tpu perf-check`` /
-``accelerate-tpu numerics-check`` (commands/) and ``Accelerator.lint`` /
-``Accelerator.flight_check`` / ``Accelerator.perf_check`` /
-``Accelerator.numerics_check``. Suppress a finding inline with
+``accelerate-tpu numerics-check`` / ``accelerate-tpu tune`` (commands/)
+and ``Accelerator.lint`` / ``Accelerator.flight_check`` /
+``Accelerator.perf_check`` / ``Accelerator.numerics_check`` /
+``Accelerator.tune``. Suppress a finding inline with
 ``# tpu-lint: disable=TPU201``, or project-wide via ``.tpulint.toml``
 (``project_config``).
 """
@@ -59,9 +74,26 @@ from .perf_rules import check_perf_rules
 from .perfmodel import OpRecord, PerfReport, perf_check, walk_ops
 from .project_config import ProjectConfig, find_project_config, load_project_config
 from .ranksim import ACCELERATOR_EFFECTS, COLLECTIVE_EFFECTS, ModuleSimulator
-from .report import exit_code, format_finding, render_json, render_sarif, render_text
+from .report import exit_code, format_finding, render_json, render_sarif, render_sarif_run, render_text
 from .rules import ERROR, RULES, WARNING, Finding, Rule, apply_suppressions, filter_findings
-from .selfcheck import run_divergence_selfcheck, run_numerics_selfcheck, run_perf_selfcheck, run_selfcheck
+from .searchspace import (
+    ConfigPoint,
+    SearchSpace,
+    chosen_toml,
+    default_space,
+    load_chosen,
+    load_tune_section,
+    prune_reason,
+)
+from .selfcheck import (
+    run_divergence_selfcheck,
+    run_numerics_selfcheck,
+    run_perf_selfcheck,
+    run_selfcheck,
+    run_tune_selfcheck,
+)
+from .tune_rules import check_config_rules
+from .tuner import CandidateResult, TuneReport, spearman, tune
 
 __all__ = [
     "ERROR",
@@ -100,6 +132,20 @@ __all__ = [
     "run_divergence_selfcheck",
     "run_perf_selfcheck",
     "run_numerics_selfcheck",
+    "run_tune_selfcheck",
+    "ConfigPoint",
+    "SearchSpace",
+    "default_space",
+    "prune_reason",
+    "chosen_toml",
+    "load_chosen",
+    "load_tune_section",
+    "tune",
+    "TuneReport",
+    "CandidateResult",
+    "spearman",
+    "check_config_rules",
+    "render_sarif_run",
     "numerics_check",
     "check_numerics_rules",
     "check_key_reuse_source",

@@ -20,6 +20,16 @@ Schema::
     [perf]
     regress_pct = 10           # default --regress-pct for perf-check --baseline
 
+    [tune]
+    meshes = ["data=8", "data=4,tensor=2"]   # autotuner search axes
+    zero_stages = [0, 1]       # (see docs/usage_guides/autotuning.md)
+    compressions = ["none", "int8"]
+    top_k = 3                  # candidates measured by `tune --confirm`
+
+    [tune.chosen]              # emitted by `accelerate-tpu tune` — the
+    mesh = "data=8"            # committed winner (analysis.load_chosen)
+    zero_stage = 1
+
     [[suppress]]
     path = "examples/*"        # fnmatch glob or directory prefix
     rules = ["TPU405"]         # omitted = every rule suppressed there
@@ -32,16 +42,81 @@ property either way.
 
 from __future__ import annotations
 
+import difflib
 import fnmatch
 import os
 import pathlib
 import re
+import warnings
 from dataclasses import dataclass
 from typing import Optional
 
 from .rules import Finding
 
 CONFIG_FILENAME = ".tpulint.toml"
+
+#: the documented schema: section -> known keys (``None`` = free-form).
+#: Unknown sections/keys WARN with the nearest valid name — a typo'd
+#: ``[tunne]`` or ``formt =`` must not be silently ignored.
+KNOWN_SCHEMA: dict[str, Optional[frozenset]] = {
+    "lint": frozenset({"format", "disable", "enable"}),
+    "divergence": frozenset({"ranks"}),
+    "perf": frozenset({"regress_pct"}),
+    "tune": frozenset({
+        "meshes", "dcn_axes", "zero_stages", "compressions", "bucket_sets",
+        "token_budgets", "tick_blocks", "slots", "routings", "handoffs",
+        "generation", "hbm_gb", "top_k", "confirm_steps", "waste_threshold",
+        "optimizer", "histogram", "chosen",
+    }),
+    "tune.chosen": frozenset({
+        "mesh", "dcn_axes", "zero_stage", "compression", "buckets",
+        "token_budget", "tick_block", "num_slots", "routing", "handoff",
+    }),
+    "suppress": frozenset({"path", "rules"}),
+}
+
+
+def _nearest(name: str, candidates) -> str:
+    match = difflib.get_close_matches(name, list(candidates), n=1, cutoff=0.5)
+    return f" — did you mean {match[0]!r}?" if match else ""
+
+
+def warn_unknown_names(doc: dict, path: str) -> list[str]:
+    """Warn (once per load) about sections/keys the schema doesn't know,
+    each with the nearest valid name. Returns the warning texts (the
+    tests' hook). Unknowns are still ignored — a stale config must not
+    kill a lint run — but no longer silently."""
+    messages: list[str] = []
+
+    def check_keys(section: str, table: dict):
+        known = KNOWN_SCHEMA.get(section)
+        if known is None or not isinstance(table, dict):
+            return
+        for key in table:
+            if key in known:
+                continue
+            if section == "tune" and key == "chosen":
+                continue
+            messages.append(
+                f"{path}: unknown key {key!r} in [{section}]{_nearest(key, known)}"
+            )
+
+    for section, value in (doc or {}).items():
+        if section not in KNOWN_SCHEMA:
+            messages.append(
+                f"{path}: unknown section [{section}]{_nearest(section, KNOWN_SCHEMA)}"
+            )
+            continue
+        if section == "suppress":
+            for entry in value or []:
+                check_keys("suppress", entry)
+        elif isinstance(value, dict):
+            check_keys(section, value)
+            if section == "tune" and isinstance(value.get("chosen"), dict):
+                check_keys("tune.chosen", value["chosen"])
+    for msg in messages:
+        warnings.warn(msg, stacklevel=3)
+    return messages
 
 
 @dataclass(frozen=True)
@@ -190,6 +265,7 @@ def load_project_config(start: Optional[str] = None) -> ProjectConfig:
         doc = _load_toml(path)
     except Exception:
         return ProjectConfig(path=path)
+    warn_unknown_names(doc, path)
     lint = doc.get("lint", {}) or {}
     div = doc.get("divergence", {}) or {}
     perf = doc.get("perf", {}) or {}

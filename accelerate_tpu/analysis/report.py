@@ -1,6 +1,9 @@
 """Finding reporters: the ``path:line: TPUxxx message`` text format that
 editors and CI annotators parse, a JSON format for tooling, and a SARIF
-2.1.0 format for CI PR annotation (GitHub code scanning et al.).
+2.1.0 format for CI PR annotation (GitHub code scanning et al.) —
+including :func:`render_sarif_run` for CLI surfaces whose results aren't
+registry findings (``checkpoints describe``, ``fleet price-handoff``),
+so every analysis surface merges into one ``merge_sarif.py`` artifact.
 
 The text format is the contract shared by ``accelerate-tpu lint``,
 ``scripts/check_repo.py`` and ``make lint`` — one finding per line, the
@@ -37,6 +40,70 @@ def render_json(findings: list[Finding]) -> str:
 
 #: finding severity -> SARIF result level
 _SARIF_LEVELS = {ERROR: "error"}  # everything else downgrades to "warning"
+
+SARIF_SCHEMA = "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+
+
+def _sarif_doc(runs: list[dict]) -> str:
+    return json.dumps({"$schema": SARIF_SCHEMA, "version": "2.1.0", "runs": runs}, indent=2)
+
+
+def render_sarif_run(
+    tool_name: str,
+    entries: list[dict],
+    *,
+    tool_version: str = "0",
+) -> str:
+    """One SARIF 2.1.0 document from ad-hoc entries — the shared reporter
+    behind every NON-lint CLI analysis surface (``checkpoints
+    describe``, ``fleet price-handoff``), so their output merges into
+    the same ``scripts/merge_sarif.py`` artifact as the lint tiers.
+
+    Each entry: ``{"rule_id", "name", "summary", "level", "message"}``
+    plus optional ``"uri"``/``"line"``. Rule descriptors are tool-local
+    (SARIF rules are scoped to their driver), so these surfaces don't
+    need registry TPUxxx IDs."""
+    used: dict[str, dict] = {}
+    for e in entries:
+        used.setdefault(
+            e["rule_id"],
+            {
+                "id": e["rule_id"],
+                "name": e.get("name", e["rule_id"]),
+                "shortDescription": {"text": e.get("summary", e.get("name", e["rule_id"]))},
+                "defaultConfiguration": {"level": e.get("level", "note")},
+            },
+        )
+    rule_index = {rid: i for i, rid in enumerate(used)}
+    results = [
+        {
+            "ruleId": e["rule_id"],
+            "ruleIndex": rule_index[e["rule_id"]],
+            "level": e.get("level", "note"),
+            "message": {"text": e["message"]},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": e.get("uri") or f"<{tool_name}>"},
+                        "region": {"startLine": e.get("line") or 1},
+                    }
+                }
+            ],
+        }
+        for e in entries
+    ]
+    run = {
+        "tool": {
+            "driver": {
+                "name": tool_name,
+                "informationUri": "https://github.com/",
+                "version": tool_version,
+                "rules": list(used.values()),
+            }
+        },
+        "results": results,
+    }
+    return _sarif_doc([run])
 
 
 def render_sarif(findings: list[Finding], *, tool_version: str = "0") -> str:
@@ -75,24 +142,18 @@ def render_sarif(findings: list[Finding], *, tool_version: str = "0") -> str:
         }
         for f in findings
     ]
-    doc = {
-        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
-        "version": "2.1.0",
-        "runs": [
-            {
-                "tool": {
-                    "driver": {
-                        "name": "accelerate-tpu-lint",
-                        "informationUri": "https://github.com/",
-                        "version": tool_version,
-                        "rules": rules,
-                    }
-                },
-                "results": results,
+    run = {
+        "tool": {
+            "driver": {
+                "name": "accelerate-tpu-lint",
+                "informationUri": "https://github.com/",
+                "version": tool_version,
+                "rules": rules,
             }
-        ],
+        },
+        "results": results,
     }
-    return json.dumps(doc, indent=2)
+    return _sarif_doc([run])
 
 
 def exit_code(findings: list[Finding], *, strict: bool = False) -> int:

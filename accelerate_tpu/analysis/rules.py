@@ -36,6 +36,15 @@ static_analysis.md for the worked catalogue):
   compressed/quantized collectives without error feedback. Every
   finding prices its impact (relative error, overflow margin, or
   lost-update ulp).
+* ``TPU7xx`` — configuration rules (``analysis.tune_rules``) over a
+  declared :class:`~accelerate_tpu.analysis.searchspace.ConfigPoint`:
+  statically-infeasible peak HBM (error severity — the strict gate),
+  comms-bound configs strictly dominated by an enumerated neighbor,
+  bucket sets whose padding waste exceeds a threshold against the
+  declared shape histogram, quantized wire requested where the
+  platform's collective lowering upcasts it, and ``zero_stage=1`` with
+  a knowably non-elementwise optax transform. The one-off-misconfig
+  twin of the full ``accelerate-tpu tune`` search.
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -59,6 +68,7 @@ TIER_FLIGHT = "flight"
 TIER_DIVERGENCE = "divergence"
 TIER_PERF = "perf"
 TIER_NUMERICS = "numerics"
+TIER_CONFIG = "config"
 
 
 @dataclass(frozen=True)
@@ -113,6 +123,12 @@ RULES: dict[str, Rule] = {
         Rule("TPU604", "update-below-param-ulp", WARNING, TIER_NUMERICS, "mixed-precision weight update smaller than the ulp of the param dtype — the update rounds away (keep f32 master weights)"),
         Rule("TPU605", "prng-key-reuse", WARNING, TIER_NUMERICS, "the same PRNG key is consumed by two or more random draws without a split — the streams are bit-identical"),
         Rule("TPU606", "unbounded-compressed-collective", WARNING, TIER_NUMERICS, "compressed/quantized collective without error feedback — the per-step quantization error biases the reduction"),
+        # -- tier 7: configuration (analysis.tune_rules) -------------------
+        Rule("TPU701", "config-infeasible", ERROR, TIER_CONFIG, "static peak HBM exceeds the generation's per-device capacity — the config cannot run"),
+        Rule("TPU702", "dominated-comms-bound-config", WARNING, TIER_CONFIG, "comms-bound config with a strictly-dominating alternative (faster AND fewer wire bytes) in the enumerated neighborhood"),
+        Rule("TPU703", "bucket-padding-waste", WARNING, TIER_CONFIG, "bucket set pads the declared batch/shape histogram past the waste threshold — compute burned on padding"),
+        Rule("TPU704", "quantized-wire-upcast", WARNING, TIER_CONFIG, "quantized wire requested on a platform whose collective lowering upcasts the dtype — the wire saving silently evaporates"),
+        Rule("TPU705", "zero1-non-elementwise-optimizer", WARNING, TIER_CONFIG, "zero_stage=1 requested with a knowably non-elementwise optax transform — the runtime falls back to the passive layout"),
     )
 }
 

@@ -1,0 +1,466 @@
+"""Typed configuration search space for the static autotuner.
+
+Five PRs of analyzers made every interesting knob *scoreable in
+milliseconds* (flight-check peak HBM, perfmodel step time, costmodel
+wire bytes) — this module makes the knob surface itself a first-class,
+enumerable object so ``analysis.tuner`` can search it:
+
+* :class:`ConfigPoint` — one candidate configuration over the knobs the
+  repo has grown: mesh layout + DCN axes, ZeRO stage, gradient
+  compression, shape buckets, serving token budget / tick block / slot
+  count, fleet routing policy, and KV-handoff mode. Hashable, labelled,
+  and convertible to the kwargs the runtime actually consumes
+  (:meth:`ConfigPoint.parallelism_kwargs` /
+  :meth:`ConfigPoint.serving_kwargs`).
+* :class:`SearchSpace` — per-knob candidate lists whose cartesian
+  product :meth:`SearchSpace.enumerate_points` walks, with
+  **constraint pruning** (:func:`prune_reason`): points that cannot run
+  (mesh larger than the device pool, ``zero_stage=1`` without a data
+  axis or with tensor-sharded axes, a token budget that starves decode)
+  are rejected with a human-readable reason *before* any tracing, so
+  the tuner never pays an oracle call for a config the runtime would
+  refuse.
+* the ``[tune]`` section of ``.tpulint.toml``
+  (:func:`load_tune_section`) and the emitted ``[tune.chosen]`` winner
+  block (:func:`chosen_toml` / :func:`load_chosen`) — the tuner's
+  input spec and output artifact share the project-config file, so a
+  committed winner is picked up by every later ``accelerate-tpu tune``
+  run (and by :meth:`ConfigPoint.parallelism_kwargs` at training time).
+
+Everything here is host-side math over plain Python values — no jax —
+so the space can be spec'd, enumerated, and pruned from a login node.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, fields
+from typing import Any, Optional
+
+#: knob value vocabularies (prune_reason rejects anything else)
+ROUTING_POLICIES = ("least_loaded", "round_robin")
+HANDOFF_MODES = ("auto", "always", "never")
+COMPRESSIONS = ("bf16", "int8", "fp8")
+ZERO_STAGES = (0, 1)
+
+#: mesh axes the data-parallel update shards over, and the full axis
+#: vocabulary (mirrors ``parallel.mesh.BATCH_AXES``/``AXIS_NAMES``
+#: without importing the jax-adjacent module)
+_BATCH_AXES = ("data", "fsdp")
+_MESH_AXES = ("data", "fsdp", "tensor", "seq", "pipe", "expert")
+
+
+def parse_mesh_spec(spec) -> dict[str, int]:
+    """``"data=4,tensor=2"`` (or an ``{axis: size}`` dict) -> a plain
+    shape dict — the flight-check CLI's ``--mesh`` convention."""
+    if isinstance(spec, dict):
+        return {str(k): int(v) for k, v in spec.items()}
+    shape: dict[str, int] = {}
+    for part in str(spec).split(","):
+        if not part.strip():
+            continue
+        axis, sep, size = part.partition("=")
+        if not sep or not axis.strip() or not size.strip():
+            raise ValueError(f"bad mesh spec entry {part!r}; expected axis=size")
+        shape[axis.strip()] = int(size)
+    return shape
+
+
+def format_mesh_spec(shape: dict[str, int]) -> str:
+    return ",".join(f"{a}={n}" for a, n in shape.items())
+
+
+def _mesh_devices(shape: dict[str, int]) -> int:
+    n = 1
+    for v in shape.values():
+        n *= max(1, int(v))
+    return n
+
+
+def _batch_degree(shape: dict[str, int]) -> int:
+    n = 1
+    for a in _BATCH_AXES:
+        n *= max(1, int(shape.get(a, 1)))
+    return n
+
+
+def _as_int_tuple(raw) -> tuple[int, ...]:
+    if raw is None:
+        return ()
+    if isinstance(raw, str):
+        return tuple(int(p) for p in raw.replace(";", ",").split(",") if p.strip())
+    return tuple(int(v) for v in raw)
+
+
+@dataclass(frozen=True)
+class ConfigPoint:
+    """One candidate configuration. Every field is optional — ``None``
+    means "this knob is not part of the point" (the workload's own
+    default applies), so a train-side point and a serving-side point are
+    the same type with different knobs populated."""
+
+    mesh: Optional[tuple] = None  # (("data", 8), ("tensor", 2)) pairs
+    dcn_axes: tuple = ()
+    zero_stage: Optional[int] = None
+    compression: Optional[str] = None
+    buckets: Optional[tuple] = None
+    token_budget: Optional[int] = None
+    tick_block: Optional[int] = None
+    num_slots: Optional[int] = None
+    routing: Optional[str] = None
+    handoff: Optional[str] = None
+
+    def __post_init__(self):
+        # normalise permissive inputs into the hashable canonical forms
+        if self.mesh is not None and not isinstance(self.mesh, tuple):
+            object.__setattr__(self, "mesh", tuple(parse_mesh_spec(self.mesh).items()))
+        elif isinstance(self.mesh, tuple) and self.mesh and not isinstance(self.mesh[0], tuple):
+            object.__setattr__(self, "mesh", tuple(parse_mesh_spec(dict([self.mesh])).items()))
+        if isinstance(self.dcn_axes, str):
+            object.__setattr__(
+                self, "dcn_axes", tuple(a.strip() for a in self.dcn_axes.split(",") if a.strip())
+            )
+        else:
+            object.__setattr__(self, "dcn_axes", tuple(self.dcn_axes or ()))
+        if self.buckets is not None:
+            object.__setattr__(self, "buckets", _as_int_tuple(self.buckets) or None)
+        if isinstance(self.compression, str) and self.compression.lower() in ("", "none"):
+            object.__setattr__(self, "compression", None)
+
+    # -- views ---------------------------------------------------------- #
+
+    @property
+    def mesh_shape(self) -> Optional[dict[str, int]]:
+        return dict(self.mesh) if self.mesh is not None else None
+
+    @property
+    def mesh_devices(self) -> int:
+        return _mesh_devices(self.mesh_shape or {})
+
+    def label(self) -> str:
+        """Compact human label for ranked-report rows."""
+        parts = []
+        if self.mesh is not None:
+            parts.append(format_mesh_spec(self.mesh_shape))
+        if self.dcn_axes:
+            parts.append(f"dcn={','.join(self.dcn_axes)}")
+        if self.zero_stage:
+            parts.append(f"zero{self.zero_stage}")
+        if self.compression:
+            parts.append(self.compression)
+        if self.buckets:
+            parts.append(f"buckets={','.join(str(b) for b in self.buckets)}")
+        if self.token_budget is not None:
+            parts.append(f"budget={self.token_budget}")
+        if self.tick_block is not None:
+            parts.append(f"tick={self.tick_block}")
+        if self.num_slots is not None:
+            parts.append(f"slots={self.num_slots}")
+        if self.routing:
+            parts.append(self.routing)
+        if self.handoff:
+            parts.append(f"handoff={self.handoff}")
+        return " ".join(parts) or "<defaults>"
+
+    def as_dict(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.mesh is not None:
+            out["mesh"] = format_mesh_spec(self.mesh_shape)
+        if self.dcn_axes:
+            out["dcn_axes"] = list(self.dcn_axes)
+        for key in ("zero_stage", "compression", "token_budget", "tick_block",
+                    "num_slots", "routing", "handoff"):
+            val = getattr(self, key)
+            if val is not None:
+                out[key] = val
+        if self.buckets:
+            out["buckets"] = list(self.buckets)
+        return out
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "ConfigPoint":
+        known = {f.name for f in fields(cls)}
+        kwargs = {k: v for k, v in (raw or {}).items() if k in known}
+        return cls(**kwargs)
+
+    # -- runtime consumption ------------------------------------------- #
+
+    def parallelism_kwargs(self) -> dict:
+        """Kwargs for :class:`~accelerate_tpu.utils.ParallelismPlugin`
+        (imports ``MeshConfig`` lazily — jax-adjacent)."""
+        out: dict[str, Any] = {}
+        if self.mesh is not None:
+            from ..parallel.mesh import MeshConfig
+
+            out["mesh_config"] = MeshConfig(**self.mesh_shape)
+        if self.zero_stage is not None:
+            out["zero_stage"] = int(self.zero_stage)
+        if self.compression is not None:
+            out["grad_compression"] = self.compression
+        return out
+
+    def serving_kwargs(self) -> dict:
+        """Engine/scheduler kwargs a serving-side point pins:
+        ``prompt_buckets``/``num_slots`` for ``ServingEngine`` and a
+        ``scheduler`` dict for ``SchedulerConfig``."""
+        out: dict[str, Any] = {}
+        if self.buckets:
+            out["prompt_buckets"] = tuple(self.buckets)
+        if self.num_slots is not None:
+            out["num_slots"] = int(self.num_slots)
+        sched: dict[str, Any] = {}
+        if self.token_budget is not None:
+            sched["token_budget"] = int(self.token_budget)
+        if self.tick_block is not None:
+            sched["tick_block"] = int(self.tick_block)
+        if sched:
+            out["scheduler"] = sched
+        if self.routing is not None:
+            out["routing"] = self.routing
+        if self.handoff is not None:
+            out["handoff"] = self.handoff
+        return out
+
+
+def prune_reason(point: ConfigPoint, *, max_devices: Optional[int] = None) -> Optional[str]:
+    """Why ``point`` cannot run at all, or ``None`` when it is a valid
+    candidate. These are *hard* constraints (the runtime would raise or
+    hang) — soft misconfigurations are the TPU7xx rules' job."""
+    shape = point.mesh_shape
+    if shape is not None:
+        unknown = [a for a in shape if a not in _MESH_AXES]
+        if unknown:
+            return f"unknown mesh axis {unknown[0]!r} (valid: {', '.join(_MESH_AXES)})"
+        if any(int(v) < 1 for v in shape.values()):
+            return f"mesh {format_mesh_spec(shape)} has a non-positive axis"
+        n = _mesh_devices(shape)
+        if max_devices is not None and n > max_devices:
+            return f"mesh {format_mesh_spec(shape)} needs {n} devices, only {max_devices} available"
+        missing = [a for a in point.dcn_axes if a not in shape]
+        if missing:
+            return f"dcn axis {missing[0]!r} is not a mesh axis"
+    if point.zero_stage is not None and point.zero_stage not in ZERO_STAGES:
+        return f"unknown zero_stage {point.zero_stage}"
+    if point.zero_stage == 1 and shape is not None:
+        if _batch_degree(shape) <= 1:
+            return "zero_stage=1 needs a data axis > 1"
+        bad = [a for a, s in shape.items() if int(s) > 1 and a not in _BATCH_AXES]
+        if bad:
+            return f"zero_stage=1 shards the update over batch axes only (mesh has {bad[0]}={shape[bad[0]]})"
+    if point.compression is not None:
+        if point.compression not in COMPRESSIONS:
+            return f"unknown compression {point.compression!r}"
+        if shape is not None and _batch_degree(shape) <= 1:
+            return "grad compression has no data axis to compress over"
+    if point.buckets is not None:
+        if any(b <= 0 for b in point.buckets) or list(point.buckets) != sorted(set(point.buckets)):
+            return f"buckets {list(point.buckets)} must be strictly ascending and positive"
+    for key in ("token_budget", "tick_block", "num_slots"):
+        val = getattr(point, key)
+        if val is not None and int(val) <= 0:
+            return f"{key} must be positive"
+    if point.token_budget is not None and point.tick_block is not None:
+        floor = (point.num_slots or 1) * point.tick_block
+        if point.token_budget < floor:
+            return (
+                f"token_budget {point.token_budget} starves decode "
+                f"(< slots x tick_block = {floor})"
+            )
+    if point.routing is not None and point.routing not in ROUTING_POLICIES:
+        return f"unknown routing policy {point.routing!r}"
+    if point.handoff is not None and point.handoff not in HANDOFF_MODES:
+        return f"unknown handoff mode {point.handoff!r}"
+    return None
+
+
+@dataclass
+class SearchSpace:
+    """Per-knob candidate lists. An empty axis means "not searched" —
+    the cartesian product substitutes the single value ``None`` there,
+    so the number of enumerated points is the product of the non-empty
+    axis lengths only."""
+
+    meshes: tuple = ()  # of mesh-shape dicts / "data=8" specs
+    dcn_axes_options: tuple = ()  # of axis tuples / "data" specs
+    zero_stages: tuple = ()
+    compressions: tuple = ()  # "none" allowed (normalises to None)
+    bucket_sets: tuple = ()  # of int tuples / "32,128" specs
+    token_budgets: tuple = ()
+    tick_blocks: tuple = ()
+    slot_counts: tuple = ()
+    routings: tuple = ()
+    handoffs: tuple = ()
+    max_devices: Optional[int] = None
+
+    def __post_init__(self):
+        self.meshes = tuple(parse_mesh_spec(m) for m in self.meshes)
+        self.dcn_axes_options = tuple(
+            tuple(a.strip() for a in opt.split(",") if a.strip()) if isinstance(opt, str)
+            else tuple(opt or ())
+            for opt in self.dcn_axes_options
+        )
+        self.zero_stages = tuple(int(z) for z in self.zero_stages)
+        self.compressions = tuple(
+            None if str(c).lower() in ("", "none") else str(c) for c in self.compressions
+        )
+        self.bucket_sets = tuple(_as_int_tuple(b) for b in self.bucket_sets)
+        self.token_budgets = _as_int_tuple(self.token_budgets)
+        self.tick_blocks = _as_int_tuple(self.tick_blocks)
+        self.slot_counts = _as_int_tuple(self.slot_counts)
+        self.routings = tuple(str(r) for r in self.routings)
+        self.handoffs = tuple(str(h) for h in self.handoffs)
+
+    def size(self) -> int:
+        n = 1
+        for axis in self._axes():
+            n *= len(axis)
+        return n
+
+    def _axes(self) -> list[tuple]:
+        return [
+            tuple(self.meshes) or (None,),
+            tuple(self.dcn_axes_options) or ((),),
+            tuple(self.zero_stages) or (None,),
+            tuple(self.compressions) or (None,),
+            tuple(self.bucket_sets) or (None,),
+            tuple(self.token_budgets) or (None,),
+            tuple(self.tick_blocks) or (None,),
+            tuple(self.slot_counts) or (None,),
+            tuple(self.routings) or (None,),
+            tuple(self.handoffs) or (None,),
+        ]
+
+    def enumerate_points(self) -> list[tuple[ConfigPoint, Optional[str]]]:
+        """The full cartesian product as ``(point, prune_reason_or_None)``
+        pairs, deduplicated, in deterministic enumeration order."""
+        out: list[tuple[ConfigPoint, Optional[str]]] = []
+        seen: set = set()
+        for mesh, dcn, zero, comp, buckets, budget, tick, slots, routing, handoff in itertools.product(
+            *self._axes()
+        ):
+            point = ConfigPoint(
+                mesh=tuple(mesh.items()) if mesh else None,
+                dcn_axes=dcn,
+                zero_stage=zero,
+                compression=comp,
+                buckets=buckets,
+                token_budget=budget,
+                tick_block=tick,
+                num_slots=slots,
+                routing=routing,
+                handoff=handoff,
+            )
+            if point in seen:
+                continue
+            seen.add(point)
+            out.append((point, prune_reason(point, max_devices=self.max_devices)))
+        return out
+
+    def valid_points(self) -> list[ConfigPoint]:
+        return [p for p, reason in self.enumerate_points() if reason is None]
+
+    # -- spec parsing --------------------------------------------------- #
+
+    #: ``[tune]`` keys that feed the space axes (everything else in the
+    #: section is a scalar tuner knob — generation, hbm_gb, top_k, ...)
+    _SPEC_KEYS = {
+        "meshes": "meshes",
+        "dcn_axes": "dcn_axes_options",
+        "zero_stages": "zero_stages",
+        "compressions": "compressions",
+        "bucket_sets": "bucket_sets",
+        "token_budgets": "token_budgets",
+        "tick_blocks": "tick_blocks",
+        "slots": "slot_counts",
+        "routings": "routings",
+        "handoffs": "handoffs",
+    }
+
+    @classmethod
+    def from_spec(cls, spec: dict, *, max_devices: Optional[int] = None) -> "SearchSpace":
+        """Build a space from a ``[tune]`` section dict (or CLI-merged
+        equivalent). List values arrive as TOML arrays; scalar strings
+        are accepted as one-element axes."""
+        kwargs: dict[str, Any] = {"max_devices": max_devices}
+        for key, attr in cls._SPEC_KEYS.items():
+            raw = (spec or {}).get(key)
+            if raw is None:
+                continue
+            if isinstance(raw, (str, int)):
+                raw = [raw]
+            kwargs[attr] = tuple(raw)
+        return cls(**kwargs)
+
+
+def default_space(n_devices: int) -> SearchSpace:
+    """The zero-config neighborhood ``accelerate-tpu tune`` searches when
+    neither flags nor a ``[tune]`` section spec one: the pure-data mesh
+    plus the tensor-sharded layouts the device pool supports, crossed
+    with the ZeRO-1 and int8-wire knobs (pruning drops the combinations a
+    layout cannot run)."""
+    meshes: list[dict] = [{"data": n_devices}]
+    if n_devices >= 4 and n_devices % 2 == 0:
+        meshes.append({"data": n_devices // 2, "tensor": 2})
+    if n_devices >= 8 and n_devices % 4 == 0:
+        meshes.append({"data": n_devices // 4, "tensor": 4})
+    return SearchSpace(
+        meshes=tuple(meshes),
+        zero_stages=(0, 1),
+        compressions=("none", "int8"),
+        max_devices=n_devices,
+    )
+
+
+# -- .tpulint.toml [tune] / [tune.chosen] ---------------------------------
+
+
+def load_tune_section(start: Optional[str] = None) -> dict:
+    """The ``[tune]`` section of the nearest ``.tpulint.toml`` (with any
+    nested ``[tune.chosen]`` table split out under ``"chosen"``), or
+    ``{}``. Tolerates both tomllib nesting and the minimal fallback
+    parser's flat ``"tune.chosen"`` table name."""
+    from .project_config import _load_toml, find_project_config
+
+    path = find_project_config(start)
+    if path is None:
+        return {}
+    try:
+        doc = _load_toml(path)
+    except Exception:
+        return {}
+    tune = dict(doc.get("tune", {}) or {})
+    chosen = tune.pop("chosen", None) or doc.get("tune.chosen")
+    if chosen:
+        tune["chosen"] = dict(chosen)
+    return tune
+
+
+def load_chosen(start: Optional[str] = None) -> Optional[ConfigPoint]:
+    """The committed ``[tune.chosen]`` winner as a :class:`ConfigPoint`,
+    or ``None`` when no project config records one."""
+    chosen = load_tune_section(start).get("chosen")
+    if not chosen:
+        return None
+    return ConfigPoint.from_dict(chosen)
+
+
+def chosen_toml(point: ConfigPoint, *, predicted_step_ms: Optional[float] = None) -> str:
+    """The ``[tune.chosen]`` block the tuner emits — paste (or
+    ``--emit``) into ``.tpulint.toml`` and :func:`load_chosen` /
+    :meth:`ConfigPoint.parallelism_kwargs` pick it up."""
+
+    def val(v) -> str:
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, (int, float)):
+            return str(v)
+        if isinstance(v, (list, tuple)):
+            return "[" + ", ".join(val(x) for x in v) + "]"
+        return f'"{v}"'
+
+    lines = ["[tune.chosen]"]
+    if predicted_step_ms is not None:
+        lines.append(f"# predicted step time: {predicted_step_ms:.4f} ms (accelerate-tpu tune)")
+    for key, value in point.as_dict().items():
+        lines.append(f"{key} = {val(value)}")
+    return "\n".join(lines)

@@ -14,7 +14,10 @@ seeded inefficiency AND a repaired clean twin per TPU5xx rule and a
 hand-computed roofline reference the report must match exactly, plus
 (numerics tier) one seeded precision defect AND a repaired clean twin per
 TPU6xx rule and a hand-computed interval-arithmetic reference the
-interpreter must match exactly. A CI run that passes
+interpreter must match exactly, plus (config tier) one seeded
+misconfiguration AND a clean twin per TPU7xx rule — TPU701 end to end
+through a real single-candidate ``analysis.tuner.tune`` run whose static
+peak HBM cannot fit a deliberately tiny budget. A CI run that passes
 selfcheck has proven the linter end-to-end on the CPU backend, so a clean
 repo lint actually means something.
 
@@ -669,6 +672,101 @@ def run_numerics_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     return ok, lines
 
 
+def run_tune_selfcheck(mesh=None) -> tuple[bool, list[str]]:
+    """Prove TPU701-TPU705 each fire on a seeded misconfiguration and
+    each clean twin stays silent. TPU701 runs END TO END — a real
+    single-candidate ``analysis.tuner.tune`` over a traced step whose
+    static peak cannot fit a deliberately tiny HBM budget — so the
+    strict gate covers the flight-check prune, not just the predicate;
+    the other four rules are host-math fixtures."""
+    from .searchspace import ConfigPoint, SearchSpace
+    from .tune_rules import (
+        check_bucket_waste,
+        check_dominated,
+        check_wire_upcast,
+        check_zero1_optimizer,
+    )
+    from .tuner import tune
+
+    if mesh is None:
+        from ..parallel.mesh import MeshConfig
+
+        mesh = MeshConfig().build()
+    lines: list[str] = []
+    ok = True
+
+    def record(rule: str, fired: bool, twin_findings):
+        nonlocal ok
+        ok &= fired
+        lines.append(f"[tune selfcheck] {rule} fixture: {'detected' if fired else 'MISSED'}")
+        quiet = not twin_findings
+        ok &= quiet
+        lines.append(
+            f"[tune selfcheck] {rule} clean twin: "
+            + ("zero findings" if quiet else "DIRTY: " + ", ".join(f.rule for f in twin_findings))
+        )
+
+    # TPU701 — end to end: a 512x512 f32 matmul chain peaks ~MBs; a
+    # 0.0005 GB (~0.5 MB) budget cannot hold it, a 16 GB one can
+    import jax
+    import jax.numpy as jnp
+
+    def fat_step(w):
+        h = jnp.tanh(w @ w)
+        return (h @ w).sum()
+
+    args = (jax.ShapeDtypeStruct((512, 512), jnp.float32),)
+    space = SearchSpace(meshes=({"data": 1},))
+    seeded = tune(fat_step, space, *args, generation="cpu", hbm_gb=0.0005, rules=True)
+    fired = any(f.rule == "TPU701" for f in seeded.findings) and seeded.winner is None
+    twin = tune(fat_step, space, *args, generation="cpu", hbm_gb=16.0, rules=True)
+    record("TPU701", fired, twin.findings)
+
+    # TPU702 — comms-bound candidate strictly dominated by a neighbor
+    seeded_cand = {"label": "data=4 dcn=data", "bound": "comms",
+                   "predicted_step_us": 900.0, "wire_bytes": 4_000_000}
+    dominator = {"label": "data=4", "bound": "compute",
+                 "predicted_step_us": 300.0, "wire_bytes": 1_000_000}
+    fired = any(f.rule == "TPU702" for f in check_dominated(seeded_cand, [dominator]))
+    # clean twin: the neighbor is faster but moves MORE bytes — a real
+    # tradeoff, not a domination
+    tradeoff = {"label": "data=8", "bound": "compute",
+                "predicted_step_us": 300.0, "wire_bytes": 9_000_000}
+    record("TPU702", fired, check_dominated(seeded_cand, [tradeoff]))
+
+    # TPU703 — one giant bucket against a histogram of tiny requests
+    fired = any(
+        f.rule == "TPU703"
+        for f in check_bucket_waste((1024,), {8: 100, 16: 20}, threshold=0.25)
+    )
+    record("TPU703", fired, check_bucket_waste((8, 16), {8: 100, 16: 20}, threshold=0.25))
+
+    # TPU704 — bf16 wire on XLA:CPU (known upcast to f32); int8 bit-cast
+    # wires stay narrow everywhere
+    fired = any(f.rule == "TPU704" for f in check_wire_upcast("bf16", platform="cpu"))
+    record("TPU704", fired, check_wire_upcast("int8", platform="cpu"))
+
+    # TPU705 — zero_stage=1 with adafactor's factored moments; adamw's
+    # param-shaped state is elementwise-safe
+    fired = any(f.rule == "TPU705" for f in check_zero1_optimizer(1, "adafactor"))
+    record("TPU705", fired, check_zero1_optimizer(1, "adamw"))
+
+    # constraint pruning sanity: an impossible point never reaches the
+    # oracle (the enumerator rejects it with a reason)
+    bad = ConfigPoint(mesh={"data": 4, "tensor": 2}, zero_stage=1)
+    from .searchspace import prune_reason
+
+    reason = prune_reason(bad)
+    pruned = reason is not None and "batch axes" in reason
+    ok &= pruned
+    lines.append(
+        "[tune selfcheck] constraint pruning: "
+        + ("zero1-on-tensor-mesh rejected before tracing" if pruned else "BROKEN")
+    )
+
+    return ok, lines
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -709,6 +807,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     num_ok, num_lines = run_numerics_selfcheck(mesh)
     ok &= num_ok
     lines.extend(num_lines)
+
+    tune_ok, tune_lines = run_tune_selfcheck(mesh)
+    ok &= tune_ok
+    lines.extend(tune_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

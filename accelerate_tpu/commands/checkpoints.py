@@ -85,7 +85,7 @@ def checkpoints_parser(subparsers=None):
         "--dcn-axes", default=None,
         help="comma-separated target mesh axes that cross DCN (default: the saved topology's)",
     )
-    p_desc.add_argument("--format", choices=("text", "json"), default="text")
+    p_desc.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p_desc.set_defaults(checkpoints_func=describe_command)
 
     if subparsers is not None:
@@ -262,6 +262,35 @@ def describe_checkpoint(path, target_mesh: dict = None, target_processes: int = 
     }
 
 
+def describe_sarif_entries(info: dict) -> list[dict]:
+    """``describe`` output as shared-reporter entries: an uncommitted
+    manifest is an error; a topology mismatch that forces an elastic
+    (resharding) restore is a warning carrying the priced traffic; an
+    identical topology is a note."""
+    uri = info.get("name")
+    if not info.get("committed"):
+        return [{
+            "rule_id": "CKPT001", "name": "uncommitted-manifest", "level": "error",
+            "summary": "checkpoint has no readable commit manifest",
+            "message": f"{uri}: no readable commit manifest (uncommitted or corrupt)",
+            "uri": uri,
+        }]
+    compat = info.get("compatibility")
+    r = info.get("reshard", {})
+    level = "note" if compat == "identical" else "warning"
+    detail = (
+        f"{uri}: {info.get('verdict')} — predicted reshard traffic "
+        f"{r.get('total_bytes', 0):,} B (ICI {r.get('ici_bytes', 0):,} B, "
+        f"DCN {r.get('dcn_bytes', 0):,} B; {r.get('arrays_moved', 0)}/"
+        f"{r.get('array_count', 0)} arrays move)"
+    )
+    return [{
+        "rule_id": "CKPT002", "name": "topology-compatibility", "level": level,
+        "summary": "restore-compatibility verdict for the target topology",
+        "message": detail, "uri": uri,
+    }]
+
+
 def describe_command(args) -> int:
     from accelerate_tpu.ft.manager import CheckpointManager
     from accelerate_tpu.ft.manifest import MANIFEST_NAME
@@ -286,6 +315,14 @@ def describe_command(args) -> int:
     if args.dcn_axes is not None:
         target_dcn = [a.strip() for a in args.dcn_axes.split(",") if a.strip()]
     info = describe_checkpoint(path, target_mesh, args.processes, target_dcn)
+    if args.format == "sarif":
+        # the shared SARIF reporter (analysis.report) so this surface
+        # merges into the same scripts/merge_sarif.py artifact as the
+        # lint tiers (CI uploads ONE code-scanning file)
+        from accelerate_tpu.analysis import render_sarif_run
+
+        print(render_sarif_run("accelerate-tpu-checkpoints", describe_sarif_entries(info)))
+        return 0 if info["committed"] else 1
     if args.format == "json":
         print(json.dumps(info, indent=2))
         return 0 if info["committed"] else 1

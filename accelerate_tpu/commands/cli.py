@@ -22,6 +22,7 @@ from .perfcheck import perfcheck_parser
 from .telemetry import telemetry_parser
 from .test import test_parser
 from .tpu import tpu_command_parser
+from .tune import tune_parser
 
 
 def main():
@@ -38,6 +39,7 @@ def main():
     flightcheck_parser(subparsers)
     perfcheck_parser(subparsers)
     numericscheck_parser(subparsers)
+    tune_parser(subparsers)
     divergence_parser(subparsers)
     merge_parser(subparsers)
     migrate_parser(subparsers)

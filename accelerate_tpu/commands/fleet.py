@@ -46,7 +46,7 @@ def fleet_parser(subparsers=None):
                          help="model parameter count (enables the re-prefill comparison)")
     p_price.add_argument("--transport", choices=("ici", "dcn"), default="ici")
     p_price.add_argument("--generation", default="v5e")
-    p_price.add_argument("--format", choices=("text", "json"), default="text")
+    p_price.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p_price.set_defaults(fleet_func=price_handoff_command)
 
     p_demo = sub.add_parser(
@@ -85,7 +85,26 @@ def price_handoff_command(args) -> int:
         alt = prefill_compute_us(int(args.params), args.tokens, generation=args.generation)
         out["reprefill_us"] = round(alt, 3)
         out["decision"] = "handoff" if pred["time_us"] <= alt else "local-prefill"
-    if args.format == "json":
+    if args.format == "sarif":
+        # shared reporter (analysis.report): this pricing surface merges
+        # into the one scripts/merge_sarif.py code-scanning artifact.
+        # A handoff the router would REFUSE (re-prefill is cheaper) is a
+        # warning — shipping those bytes anyway is the misconfiguration.
+        from ..analysis import render_sarif_run
+
+        level = "warning" if out.get("decision") == "local-prefill" else "note"
+        msg = (
+            f"KV handoff of {args.tokens} tokens = {pred['bytes']:,} B over "
+            f"{args.transport} ({args.generation}): ~{out['handoff_us']} us"
+        )
+        if "reprefill_us" in out:
+            msg += f"; re-prefill ~{out['reprefill_us']} us -> {out['decision']}"
+        print(render_sarif_run("accelerate-tpu-fleet", [{
+            "rule_id": "FLEET001", "name": "kv-handoff-pricing", "level": level,
+            "summary": "priced prefill->decode KV handoff vs local re-prefill",
+            "message": msg,
+        }]))
+    elif args.format == "json":
         print(json.dumps(out, indent=2))
     else:
         print(f"KV handoff: {per_token} B/token x {args.tokens} tokens = "

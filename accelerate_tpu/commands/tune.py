@@ -1,0 +1,243 @@
+"""``accelerate-tpu tune`` — search the configuration space of a step
+function with the static analyzers as the oracle.
+
+Same target conventions as ``flight-check`` (``path/to/file.py::fn`` or
+``pkg.module:fn``, repeatable ``--arg dtype[shape]`` specs or the
+module's ``<fn>_sample_args()`` / ``SAMPLE_ARGS``), plus the tuner's
+factory extension: a target whose function carries a truthy
+``tune_factory`` attribute is called as ``fn(point) -> (step_fn,
+sample_args)`` per candidate, so shapes and wire legs can depend on the
+config point (serving workloads, ZeRO/compression arms).
+
+The search space comes from CLI flags, the ``[tune]`` section of
+``.tpulint.toml``, or (neither given) a small default neighborhood over
+the attached device pool. Every candidate is constraint-pruned, then
+flight-checked (static peak HBM vs the generation's capacity — the
+TPU701 feasibility prune), then rooflined (predicted step time, MFU
+bound, bound classification) with costmodel wire bytes as the tiebreak.
+``--top-k N --confirm`` additionally measures the top-k with short
+StepTelemetry runs and reports predicted-vs-measured rank agreement
+(top-1 + Spearman) and the post-warmup recompile count. The winner is
+printed as a loadable ``[tune.chosen]`` block (``--emit`` writes it).
+
+Examples::
+
+    accelerate-tpu tune examples/by_feature/tune.py::train_workload --mesh data=8
+    accelerate-tpu tune train.py::step --arg "f32[32,128]" \\
+        --meshes "data=8;data=4,tensor=2" --zero-stages 0,1 --compressions none,int8
+    accelerate-tpu tune serve.py::serving_workload \\
+        --bucket-sets "32,128;64,256" --token-budgets 64,128 --top-k 3 --confirm
+    accelerate-tpu tune train.py::step --format json > tune.json
+    accelerate-tpu tune --selfcheck   # prove TPU701-705 fire, twins clean
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def tune_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "tune", help="Static config-space autotuner (analyzers as the oracle) for a step fn"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tune")
+    parser.add_argument("target", nargs="?", help="step fn or workload factory: file.py::fn or pkg.module:fn")
+    parser.add_argument("--arg", action="append", default=[], help="sample arg spec like f32[8,128] (repeatable)")
+    parser.add_argument("--mesh", default=None, help="base mesh for candidates without a mesh knob, e.g. data=8")
+    parser.add_argument("--dcn-axes", default=None, help="default DCN-crossing axes, e.g. data")
+    # search-space axes (semicolon separates candidates; comma separates
+    # values inside one candidate — "data=4,tensor=2;data=8" is two meshes)
+    parser.add_argument("--meshes", default=None, help='candidate meshes, e.g. "data=8;data=4,tensor=2"')
+    parser.add_argument("--zero-stages", default=None, help="candidate ZeRO stages, e.g. 0,1")
+    parser.add_argument("--compressions", default=None, help="candidate grad compressions, e.g. none,int8")
+    parser.add_argument("--bucket-sets", default=None, help='candidate bucket sets, e.g. "32,128;64,256"')
+    parser.add_argument("--token-budgets", default=None, help="candidate serving token budgets, e.g. 64,128")
+    parser.add_argument("--tick-blocks", default=None, help="candidate decode tick blocks, e.g. 4,8")
+    parser.add_argument("--slots", default=None, help="candidate serving slot counts, e.g. 2,4")
+    parser.add_argument("--routings", default=None, help="candidate routing policies, e.g. least_loaded,round_robin")
+    parser.add_argument("--handoffs", default=None, help="candidate KV-handoff modes, e.g. auto,never")
+    # oracle knobs
+    parser.add_argument(
+        "--generation", default=None,
+        help="TPU generation for the roofline/HBM tables (v4/v5e/v5p/v6e/cpu; default: attached backend)",
+    )
+    parser.add_argument("--hbm-gb", type=float, default=None,
+                        help="per-device HBM budget override for the TPU701 feasibility prune")
+    parser.add_argument("--histogram", default=None,
+                        help='declared batch/shape histogram for TPU703, e.g. "8:100,16:20" (size:count)')
+    parser.add_argument("--optimizer", default=None,
+                        help="declared optimizer name for the TPU705 check, e.g. adamw or adafactor")
+    # confirmation
+    parser.add_argument("--top-k", type=int, default=None,
+                        help="candidates to measure with --confirm (default: [tune].top_k, else 3)")
+    parser.add_argument("--confirm", action="store_true",
+                        help="measure the top-k with short StepTelemetry runs and report rank agreement")
+    parser.add_argument("--confirm-steps", type=int, default=None,
+                        help="steady steps per confirm run (default: [tune].confirm_steps, else 8)")
+    # reporting
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--emit", default=None, help="write the winner's [tune.chosen] block to this file")
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU701-705 fire on seeded misconfigs and clean twins stay silent",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=tune_command)
+    return parser
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)
+    from accelerate_tpu.analysis.selfcheck import run_tune_selfcheck
+
+    ok, lines = run_tune_selfcheck()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("tune selfcheck FAILED")
+        return 1
+    return 0
+
+
+def _split_axis(raw) -> tuple:
+    """``"data=8;data=4,tensor=2"`` / ``"0,1"`` -> candidate tuple.
+    Semicolons separate candidates when present; else commas do."""
+    if raw is None:
+        return ()
+    text = str(raw)
+    parts = text.split(";") if ";" in text else text.split(",")
+    return tuple(p.strip() for p in parts if p.strip())
+
+
+def _parse_histogram(raw) -> dict:
+    out: dict[int, int] = {}
+    for part in str(raw).split(","):
+        if not part.strip():
+            continue
+        size, _, count = part.partition(":")
+        out[int(size)] = int(count) if count.strip() else 1
+    return out
+
+
+def build_space(args, tune_cfg: dict, n_devices: int):
+    """The search space: CLI flags win per axis; then the ``[tune]``
+    section; then (no axes anywhere) the default neighborhood."""
+    from accelerate_tpu.analysis.searchspace import SearchSpace, default_space
+
+    spec = dict(tune_cfg)
+    spec.pop("chosen", None)
+    flag_axes = {
+        "meshes": _split_axis(args.meshes) or None,
+        "dcn_axes": _split_axis(args.dcn_axes) if args.dcn_axes and args.meshes else None,
+        "zero_stages": _split_axis(args.zero_stages) or None,
+        "compressions": _split_axis(args.compressions) or None,
+        "bucket_sets": _split_axis(args.bucket_sets) or None,
+        "token_budgets": _split_axis(args.token_budgets) or None,
+        "tick_blocks": _split_axis(args.tick_blocks) or None,
+        "slots": _split_axis(args.slots) or None,
+        "routings": _split_axis(args.routings) or None,
+        "handoffs": _split_axis(args.handoffs) or None,
+    }
+    for key, val in flag_axes.items():
+        if val is not None:
+            spec[key] = list(val)
+    if not any(spec.get(k) for k in SearchSpace._SPEC_KEYS):
+        return default_space(n_devices)
+    return SearchSpace.from_spec(spec, max_devices=n_devices)
+
+
+def tune_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not args.target:
+            return rc
+
+    if not args.target:
+        print("usage: accelerate-tpu tune file.py::step_fn [--arg f32[8,128] ...] "
+              "[--meshes ...] [--top-k 3 --confirm]")
+        return 2
+
+    from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+    base_mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.target)
+    from accelerate_tpu.analysis.tuner import is_factory
+
+    sample_args = () if is_factory(fn) else resolve_sample_args(module, fn, args.arg)
+
+    import jax
+
+    from accelerate_tpu.analysis import exit_code, render_sarif
+    from accelerate_tpu.analysis.project_config import load_project_config
+    from accelerate_tpu.analysis.searchspace import load_tune_section
+    from accelerate_tpu.analysis.tuner import tune
+
+    cfg = load_project_config()
+    tune_cfg = load_tune_section()
+    space = build_space(args, tune_cfg, len(jax.devices()))
+    dcn = _split_axis(args.dcn_axes) or None
+    histogram = args.histogram if args.histogram else tune_cfg.get("histogram")
+    if isinstance(histogram, str):
+        histogram = _parse_histogram(histogram)
+    elif isinstance(histogram, dict):
+        histogram = {int(k): int(v) for k, v in histogram.items()}
+    generation = args.generation or tune_cfg.get("generation")
+    hbm_gb = args.hbm_gb if args.hbm_gb is not None else tune_cfg.get("hbm_gb")
+    top_k = args.top_k if args.top_k is not None else int(tune_cfg.get("top_k", 3))
+    confirm_steps = (
+        args.confirm_steps if args.confirm_steps is not None
+        else int(tune_cfg.get("confirm_steps", 8))
+    )
+
+    report = tune(
+        fn,
+        space,
+        *sample_args,
+        base_mesh=base_mesh,
+        generation=generation,
+        hbm_gb=float(hbm_gb) if hbm_gb is not None else None,
+        dcn=dcn,
+        top_k=top_k,
+        confirm=args.confirm,
+        confirm_steps=confirm_steps,
+        shape_histogram=histogram,
+        waste_threshold=float(tune_cfg.get("waste_threshold", 0.25)),
+        optimizer=args.optimizer or tune_cfg.get("optimizer"),
+        ignore=tuple(cfg.disable),
+    )
+    findings = cfg.apply_suppressions(report.findings)
+    fmt = cfg.resolve_format(args.format)
+    if fmt == "json":
+        print(json.dumps(report.as_dict(), indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(report.render_text())
+
+    if args.emit:
+        block = report.chosen_toml()
+        if block is None:
+            print("tune: no winner to emit (every candidate pruned or infeasible)")
+            return 1
+        with open(args.emit, "w") as fh:
+            fh.write(block + "\n")
+        print(f"wrote winner to {args.emit} (paste into .tpulint.toml or keep as a fragment)")
+
+    rc = exit_code(findings, strict=args.strict)
+    if report.winner is None:
+        rc = rc or 1
+    return rc
+
+
+def main():
+    raise SystemExit(tune_command(tune_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -286,5 +286,9 @@ class Telemetry:
 
 
 def default_path(logging_dir: Optional[str] = None) -> str:
-    """Default event-log location: ``{logging_dir}/telemetry.jsonl``."""
-    return os.path.join(logging_dir or ".", "telemetry.jsonl")
+    """Default event-log location: ``{logging_dir}/telemetry.jsonl``.
+    With no logging/project dir configured the log lands under
+    ``runs/`` (created on first write, and gitignored) instead of the
+    working directory — a bare ``Accelerator`` in a repo checkout must
+    not litter the tree with run logs."""
+    return os.path.join(logging_dir or "runs", "telemetry.jsonl")

@@ -196,7 +196,8 @@ class TelemetryKwargs(KwargsHandler):
     (see :mod:`accelerate_tpu.telemetry`). No reference analogue — the
     reference has no runtime observability layer.
 
-    ``output_path=None`` writes to ``{logging_dir}/telemetry.jsonl``;
+    ``output_path=None`` writes to ``{logging_dir}/telemetry.jsonl``
+    (``runs/telemetry.jsonl`` when no logging/project dir is set);
     ``fence=False`` drops the per-step ``block_until_ready`` (the
     data-wait/dispatch/execute split then degrades but overhead reaches
     zero); ``forward_to_trackers_every=N`` pushes a rolling summary

@@ -1,0 +1,349 @@
+"""Autotuner oracle A/B: static ranking vs measured step time on two CPU
+toy workloads (the ROADMAP item-4 payoff, measured end to end).
+
+Two workloads exercise the two halves of the knob surface:
+
+* **train** — one SGD step spanning **mesh x zero x compression** on the
+  full 8-device fake pool. The workload factory builds the REAL wire leg
+  per candidate (``parallel.zero.reduce_scatter_grads`` /
+  ``all_gather_updates`` inside ``shard_map``, or
+  ``compressed_psum_mean``, or an exact f32 ``pmean``), so the oracle
+  prices the collectives the program actually runs — and the compiled
+  HLO's collectives (``telemetry.wire.hlo_wire_bytes``) are counted as
+  an independent check that must agree with the prediction per arm.
+  All candidate meshes use the SAME device pool, which makes the
+  time-rank criterion portable: per-device work and single-core total
+  work are order-isomorphic (replication multiplies both), so the
+  predicted ordering must match the measured one on any core count.
+* **serving** — a decode-tick-shaped program spanning **buckets x
+  token-budget**: each tick pads its prefill chunk to the covering
+  bucket and decodes ``budget`` rows, so padded tokens drive both the
+  roofline prediction and the measured wall time; the statically
+  predicted winner must equal the measured winner (top-1) with
+  Spearman >= 0.8 over the whole candidate set.
+
+Also measured, not asserted-by-hand: the HBM feasibility prune (a
+deliberately tiny budget must classify every candidate infeasible with
+a TPU701 error) and ZERO post-warmup recompiles in every confirm run.
+
+Writes the JSON report to stdout:
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_tune.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu.utils.environment import force_host_platform  # noqa: E402
+
+HIDDEN = 256
+GLOBAL_BATCH = 256
+SERVE_HIDDEN = 512
+
+
+def _covering(buckets, size):
+    asc = sorted(int(b) for b in buckets)
+    return next((b for b in asc if b >= size), asc[-1])
+
+
+def make_train_factory(hidden: int, global_batch: int):
+    """Factory over mesh x zero x compression: the gradient sync is the
+    real wire leg for the candidate — exact pmean, compressed psum, or
+    the ZeRO-1 reduce-scatter/all-gather pair — inside a shard_map whose
+    in_specs shard the batch over ``data`` (so the traced per-device
+    shapes ARE per-device: the oracle sees what each chip would do)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from accelerate_tpu.analysis.tuner import build_point_mesh
+
+    def factory(point):
+        mesh = build_point_mesh(point)
+        n_data = int(mesh.shape.get("data", 1))
+        method = point.compression
+        zero = point.zero_stage == 1
+        lr = 0.01
+
+        def flatten(tree):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            flat = jnp.concatenate([l.ravel() for l in leaves])
+            pad = (-flat.shape[0]) % n_data
+            return jnp.pad(flat, (0, pad)), (leaves, treedef, pad)
+
+        def unflatten(flat, spec):
+            leaves, treedef, pad = spec
+            flat = flat[: flat.shape[0] - pad] if pad else flat
+            out, off = [], 0
+            for l in leaves:
+                out.append(flat[off: off + l.size].reshape(l.shape))
+                off += l.size
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        def body(params, batch):
+            def loss_fn(p):
+                h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+                pred = h @ p["w2"] + p["b2"]
+                return jnp.mean((pred - batch["y"]) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            if zero:
+                from accelerate_tpu.parallel.zero import (
+                    all_gather_updates,
+                    reduce_scatter_grads,
+                )
+
+                g_flat, spec = flatten(grads)
+                p_flat, _ = flatten(params)
+                shard, _ = reduce_scatter_grads({"g": g_flat}, "data", n_data, method, None)
+                # this rank owns segment [idx*seg_len : (idx+1)*seg_len];
+                # sgd's update is a pure function of the grad segment, so
+                # only the -lr*g delta rides the all-gather leg
+                upd = -lr * (shard["g"] / n_data)
+                full, _ = all_gather_updates({"u": upd}, "data", n_data, method, None)
+                new_params = unflatten(p_flat + full["u"], spec)
+            else:
+                if method:
+                    from accelerate_tpu.parallel.compression import compressed_psum_mean
+
+                    grads = compressed_psum_mean(grads, "data", method)
+                else:
+                    grads = jax.lax.pmean(grads, "data")
+                new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+            return new_params, jax.lax.pmean(loss, "data")
+
+        step = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P("data")), out_specs=(P(), P()),
+            check_rep=False,
+        )
+        f32 = jnp.float32
+        params = {
+            "w1": jax.ShapeDtypeStruct((hidden, hidden), f32),
+            "b1": jax.ShapeDtypeStruct((hidden,), f32),
+            "w2": jax.ShapeDtypeStruct((hidden, hidden), f32),
+            "b2": jax.ShapeDtypeStruct((hidden,), f32),
+        }
+        batch = {
+            "x": jax.ShapeDtypeStruct((global_batch, hidden), f32),
+            "y": jax.ShapeDtypeStruct((global_batch, hidden), f32),
+        }
+        return step, (params, batch)
+
+    factory.tune_factory = True
+    factory.__name__ = "train_workload"
+    return factory
+
+
+def make_serving_factory(hidden: int):
+    """Factory over buckets x token-budget: a tick prefills one chunk
+    padded to the covering bucket and decodes ``budget`` rows — padded
+    tokens drive compute in both the oracle and the wall clock."""
+    import jax
+    import jax.numpy as jnp
+
+    def factory(point):
+        buckets = point.buckets or (64, 256)
+        budget = point.token_budget or 64
+        prefill = _covering(buckets, budget)
+        decode = budget
+
+        def tick_step(w1, w2, prompt_h, decode_h):
+            pre = jnp.tanh(jnp.tanh(prompt_h @ w1) @ w2)
+            dec = jnp.tanh(jnp.tanh(decode_h @ w1) @ w2)
+            return pre.sum() + dec.sum()
+
+        f32 = jnp.float32
+        args = (
+            jax.ShapeDtypeStruct((hidden, hidden), f32),
+            jax.ShapeDtypeStruct((hidden, hidden), f32),
+            jax.ShapeDtypeStruct((prefill, hidden), f32),
+            jax.ShapeDtypeStruct((decode, hidden), f32),
+        )
+        return tick_step, args
+
+    factory.tune_factory = True
+    factory.__name__ = "serving_workload"
+    return factory
+
+
+def _rank_pairs(report):
+    return [
+        (c.predicted_step_us, c.measured_step_us, c.label, c.point)
+        for c in report.ranked
+        if c.measured_step_us is not None
+    ]
+
+
+def measure_train_wire(factory, report) -> dict:
+    """Per-arm independent wire check: the compiled program's HLO
+    collectives (shared ring formulas) vs the oracle's per-device
+    prediction."""
+    import jax
+
+    from accelerate_tpu.analysis.tuner import _materialize, resolve_workload
+    from accelerate_tpu.telemetry.wire import hlo_wire_bytes
+
+    out = {}
+    for cand in report.ranked:
+        step, args = resolve_workload(factory, cand.point, ())
+        concrete = _materialize(args)
+        hlo = jax.jit(step).lower(*concrete).compile().as_text()
+        measured = hlo_wire_bytes(hlo)["total"]
+        predicted = cand.wire_bytes
+        out[cand.label] = {
+            "predicted": int(predicted),
+            "measured": int(measured),
+            "agree_pct": round(
+                100.0 * (1.0 - abs(measured - predicted) / max(1, max(measured, predicted))), 2
+            ),
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="CI sizing: fewer steps")
+    ap.add_argument("--steps", type=int, default=None, help="steady confirm steps per arm")
+    args = ap.parse_args(argv)
+    steps = args.steps or (4 if args.smoke else 8)
+
+    force_host_platform(8)
+    import jax
+
+    from accelerate_tpu.analysis.searchspace import SearchSpace
+    from accelerate_tpu.analysis.tuner import spearman, tune
+    from accelerate_tpu.parallel.mesh import MeshConfig
+
+    report: dict = {
+        "env": {
+            "backend": jax.default_backend(),
+            "cpu_count": os.cpu_count(),
+            "jax": jax.__version__,
+            "smoke": bool(args.smoke),
+            "steps": steps,
+        },
+        "criteria": {},
+    }
+
+    # ---- train: mesh x zero x compression on the full 8-device pool ----
+    train_factory = make_train_factory(HIDDEN, GLOBAL_BATCH)
+    train_space = SearchSpace(
+        meshes=("data=8", "data=4,tensor=2", "data=2,tensor=4"),
+        zero_stages=(0, 1),
+        compressions=("none", "int8"),
+        max_devices=8,
+    )
+    train = tune(
+        train_factory, train_space, generation="cpu",
+        top_k=99, confirm=True, confirm_steps=steps,
+    )
+    pairs = _rank_pairs(train)
+    train_rho = spearman([p for p, *_ in pairs], [m for _, m, *_ in pairs])
+    pred_winner = min(pairs, key=lambda t: t[0]) if pairs else None
+    meas_winner = min(pairs, key=lambda t: t[1]) if pairs else None
+    # mesh-level ordering: group arms by mesh, compare mean predicted vs
+    # mean measured ordering — the portable criterion (same device pool,
+    # so per-device predicted work and total measured work are
+    # order-isomorphic on ANY core count)
+    by_mesh: dict = {}
+    for p, m, _, point in pairs:
+        key = json.dumps(point.mesh_shape, sort_keys=True)
+        by_mesh.setdefault(key, []).append((p, m))
+    mesh_pred = [sum(p for p, _ in v) / len(v) for v in by_mesh.values()]
+    mesh_meas = [sum(m for _, m in v) / len(v) for v in by_mesh.values()]
+    mesh_rho = spearman(mesh_pred, mesh_meas)
+    wire = measure_train_wire(train_factory, train)
+    train_recompiles = train.confirm["recompiles"] if train.confirm else None
+    report["train"] = {
+        "candidates": [c.as_dict() for c in train.candidates],
+        "winner": train.winner.label if train.winner else None,
+        "measured_winner": meas_winner[2] if meas_winner else None,
+        "top1": bool(pred_winner and meas_winner and pred_winner[3] == meas_winner[3]),
+        "spearman": round(train_rho, 4) if train_rho is not None else None,
+        "mesh_rank_spearman": round(mesh_rho, 4) if mesh_rho is not None else None,
+        "wire": wire,
+        "recompiles": train_recompiles,
+        "chosen_toml": train.chosen_toml(),
+    }
+
+    # ---- serving: buckets x token budget (single device) ---------------
+    serve_factory = make_serving_factory(SERVE_HIDDEN)
+    serve_space = SearchSpace(
+        bucket_sets=("64,256", "128,512"),
+        token_budgets=(64, 128, 256),
+    )
+    base_mesh = MeshConfig(data=1).build(jax.devices()[:1])
+    serving = tune(
+        serve_factory, serve_space, base_mesh=base_mesh, generation="cpu",
+        top_k=99, confirm=True, confirm_steps=steps,
+    )
+    s_pairs = _rank_pairs(serving)
+    s_rho = spearman([p for p, *_ in s_pairs], [m for _, m, *_ in s_pairs])
+    s_pred = min(s_pairs, key=lambda t: t[0]) if s_pairs else None
+    s_meas = min(s_pairs, key=lambda t: t[1]) if s_pairs else None
+    serve_recompiles = serving.confirm["recompiles"] if serving.confirm else None
+    report["serving"] = {
+        "candidates": [c.as_dict() for c in serving.candidates],
+        "winner": serving.winner.label if serving.winner else None,
+        "measured_winner": s_meas[2] if s_meas else None,
+        "top1": bool(s_pred and s_meas and s_pred[3] == s_meas[3]),
+        "spearman": round(s_rho, 4) if s_rho is not None else None,
+        "recompiles": serve_recompiles,
+        "chosen_toml": serving.chosen_toml(),
+    }
+
+    # ---- HBM feasibility prune, exercised for real ---------------------
+    pruned = tune(
+        train_factory,
+        SearchSpace(meshes=("data=8",), max_devices=8),
+        generation="cpu",
+        hbm_gb=0.0001,
+    )
+    report["hbm_prune"] = {
+        "infeasible": pruned.infeasible_count,
+        "tpu701": sum(1 for f in pruned.findings if f.rule == "TPU701"),
+    }
+
+    # ---- criteria ------------------------------------------------------
+    wire_ok = all(w["agree_pct"] >= 95.0 for w in wire.values()) and len(wire) > 0
+    crit = {
+        "serving_top1_predicted_equals_measured": bool(report["serving"]["top1"]),
+        "serving_spearman_ge_0.8": bool(s_rho is not None and s_rho >= 0.8),
+        "train_top1_predicted_equals_measured": bool(report["train"]["top1"]),
+        "train_mesh_rank_spearman_eq_1": bool(mesh_rho is not None and mesh_rho >= 0.999),
+        "train_wire_predicted_matches_hlo_measured_95pct": bool(wire_ok),
+        "hbm_prune_fires_tpu701": bool(
+            report["hbm_prune"]["infeasible"] >= 1 and report["hbm_prune"]["tpu701"] >= 1
+        ),
+        "zero_postwarmup_recompiles": bool(
+            (train_recompiles or 0) == 0 and (serve_recompiles or 0) == 0
+        ),
+    }
+    report["criteria"] = crit
+    report["notes"] = (
+        "All train candidate meshes use the same 8-device pool, so predicted per-device "
+        "work and measured total work are order-isomorphic on any core count — the "
+        "top-1 and mesh-level rank gates are portable. The full train spearman is "
+        "reported but not gated: within-mesh wire-knob deltas are below wall-clock "
+        "noise on small steps (the wire itself is gated exactly instead — predicted "
+        "bytes must match the compiled HLO's collectives per arm, the core-count-"
+        "independent evidence for the comms half of the oracle)."
+    )
+    report["ok"] = all(crit.values())
+    json.dump(report, sys.stdout, indent=2)
+    print()
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -88,6 +88,9 @@ MODULES = [
     "accelerate_tpu.analysis.numerics_rules",
     "accelerate_tpu.analysis.ranksim",
     "accelerate_tpu.analysis.divergence",
+    "accelerate_tpu.analysis.searchspace",
+    "accelerate_tpu.analysis.tuner",
+    "accelerate_tpu.analysis.tune_rules",
     "accelerate_tpu.analysis.project_config",
     "accelerate_tpu.analysis.report",
     "accelerate_tpu.telemetry",
