@@ -344,6 +344,43 @@ def prefill_compute_us(
     return 2.0 * int(param_count) * int(tokens) / peak_flops(generation, dtype) * 1e6
 
 
+def price_failover(
+    bytes_per_token: int,
+    prompt_tokens: int,
+    generated_tokens: int,
+    param_count: int,
+    *,
+    fixed_bytes: int = 0,
+    transport: str = ICI,
+    generation: str = "v5e",
+    dtype: str = "bf16",
+    kv_exportable: bool = True,
+) -> dict:
+    """Price BOTH legs of migrating one in-flight request off a failing
+    replica BEFORE the router moves anything — the fleet failover
+    decision input: ship the request's exact KV frontier (``prompt +
+    generated - 1`` rows; the last generated token is re-fed, its row not
+    yet written) over ``transport``, or recompute the same rows on the
+    survivor (the PR-10 resume path). Returns ``{"rows", "handoff"
+    (a :func:`price_kv_handoff` dict), "recompute_us", "path"}`` with
+    ``path`` the cheaper leg — forced to ``"recompute"`` when the dying
+    replica cannot export (``kv_exportable=False``: poisoned numerics, or
+    a paged/speculative layout with no dense row export). Plain host
+    math, no jax; when the handoff leg runs, the router's post-migration
+    byte accounting must equal ``handoff["bytes"]`` exactly."""
+    rows = max(1, int(prompt_tokens) + max(0, int(generated_tokens) - 1))
+    pred = price_kv_handoff(
+        bytes_per_token, rows, fixed_bytes=fixed_bytes,
+        transport=transport, generation=generation,
+    )
+    alt = prefill_compute_us(param_count, rows, generation=generation, dtype=dtype)
+    if not kv_exportable or pred["time_us"] > alt:
+        path = "recompute"
+    else:
+        path = "handoff"
+    return {"rows": rows, "handoff": pred, "recompute_us": alt, "path": path}
+
+
 def collect_traffic(jaxpr, mesh, *, dcn: Optional[Sequence[str]] = None) -> TrafficReport:
     """Walk ``jaxpr`` (recursing through pjit/shard_map/control flow) and
     price every explicit collective. ``scan`` bodies multiply the firing

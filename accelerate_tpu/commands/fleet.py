@@ -49,6 +49,27 @@ def fleet_parser(subparsers=None):
     p_price.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p_price.set_defaults(fleet_func=price_handoff_command)
 
+    p_fo = sub.add_parser(
+        "price-failover",
+        help="Price migrating one in-flight request off a dying replica (no jax)",
+    )
+    p_fo.add_argument("--layers", type=int, required=True, help="decoder layers")
+    p_fo.add_argument("--kv-heads", dest="kv_heads", type=int, required=True)
+    p_fo.add_argument("--head-dim", dest="head_dim", type=int, required=True)
+    p_fo.add_argument("--dtype-bytes", dest="dtype_bytes", type=int, default=2,
+                      help="bytes per cache element (2 = bf16)")
+    p_fo.add_argument("--prompt-tokens", dest="prompt_tokens", type=int, required=True)
+    p_fo.add_argument("--generated-tokens", dest="generated_tokens", type=int, default=0,
+                      help="tokens already generated when the replica died")
+    p_fo.add_argument("--params", type=float, required=True,
+                      help="model parameter count (for the recompute arm)")
+    p_fo.add_argument("--no-kv", dest="kv_exportable", action="store_false",
+                      help="KV not exportable (paged/speculative/poisoned): recompute only")
+    p_fo.add_argument("--transport", choices=("ici", "dcn"), default="ici")
+    p_fo.add_argument("--generation", default="v5e")
+    p_fo.add_argument("--format", choices=("text", "json"), default="text")
+    p_fo.set_defaults(fleet_func=price_failover_command)
+
     p_demo = sub.add_parser(
         "demo", help="Run a tiny in-process fleet on the CPU backend and print its metrics"
     )
@@ -112,6 +133,41 @@ def price_handoff_command(args) -> int:
         print(f"  transfer  ~ {out['handoff_us']} us")
         if "reprefill_us" in out:
             print(f"  re-prefill ~ {out['reprefill_us']} us  ->  {out['decision']}")
+    return 0
+
+
+def price_failover_command(args) -> int:
+    from ..analysis.costmodel import price_failover
+
+    per_token = 2 * args.layers * args.kv_heads * args.head_dim * args.dtype_bytes
+    priced = price_failover(
+        per_token, args.prompt_tokens, args.generated_tokens, int(args.params),
+        transport=args.transport, generation=args.generation,
+        kv_exportable=args.kv_exportable,
+    )
+    out = {
+        "bytes_per_token": per_token,
+        "prompt_tokens": args.prompt_tokens,
+        "generated_tokens": args.generated_tokens,
+        "kv_exportable": args.kv_exportable,
+        "transport": args.transport,
+        "generation": args.generation,
+        "rows": priced["rows"],
+        "handoff_bytes": priced["handoff"]["bytes"],
+        "handoff_us": round(priced["handoff"]["time_us"], 3),
+        "recompute_us": round(priced["recompute_us"], 3),
+        "path": priced["path"],
+    }
+    if args.format == "json":
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"failover of {priced['rows']} KV rows "
+              f"({args.prompt_tokens} prompt + {args.generated_tokens} generated):")
+        print(f"  KV handoff  {priced['handoff']['bytes']:,} B over "
+              f"{args.transport} ({args.generation}) ~ {out['handoff_us']} us"
+              + ("" if args.kv_exportable else "  [unavailable: --no-kv]"))
+        print(f"  recompute   ~ {out['recompute_us']} us")
+        print(f"  -> router picks: {out['path']}")
     return 0
 
 

@@ -30,6 +30,23 @@ lands on it again). The restore labels, in restore order:
   params in memory, optimizer state not yet)
 * ``pre_restore_rng``   — arrays/schedulers/samplers restored, host RNG
   not yet touched
+
+The serving fleet is instrumented with the same mechanism
+(``test_utils.fault_injection.ReplicaChaos`` drives the serving chaos
+matrix). Every serving label sits at a state-consistent boundary — the
+engine's host bookkeeping (queue, slot state, sampling keys, KV frontier)
+is exact at each one, so a crash there is always failover-recoverable:
+
+* ``pre_tick``    — top of ``ServingEngine.step`` (nothing this tick ran)
+* ``mid_prefill`` — a prefill slot about to advance (its chunk/bucket
+  state untouched; the pre-sample key still in the slot state)
+* ``mid_decode``  — decode slots about to run the jitted K-step tick
+  (cache rows = prompt + out[:-1]; the fed token not yet written)
+* ``pre_handoff`` — a disaggregated dispatch picked its replicas but the
+  detached prefill has not run (the pending entry is requeue-safe)
+
+Serving calls pass ``replica=<name>`` context so a chaos hook can target
+one replica of a fleet; checkpoint calls pass no context.
 """
 
 from __future__ import annotations
@@ -44,21 +61,28 @@ CRASH_POINTS = ("pre_write", "mid_pytree", "pre_manifest", "pre_rename", "mid_pr
 #: these must leave it as valid as it was
 RESTORE_CRASH_POINTS = ("pre_restore", "mid_restore_arrays", "pre_restore_rng")
 
+#: serving-fleet points (ServingEngine tick phases + the router's
+#: disaggregated dispatch) — each at a boundary where the engine's host
+#: state is consistent, so in-flight work is exactly exportable
+SERVING_CRASH_POINTS = ("pre_tick", "mid_prefill", "mid_decode", "pre_handoff")
+
 #: the full label set CrashPoint accepts
-ALL_CRASH_POINTS = CRASH_POINTS + RESTORE_CRASH_POINTS
+ALL_CRASH_POINTS = CRASH_POINTS + RESTORE_CRASH_POINTS + SERVING_CRASH_POINTS
 
-_hook: Optional[Callable[[str], None]] = None
+_hook: Optional[Callable[..., None]] = None
 
 
-def set_crash_hook(hook: Optional[Callable[[str], None]]):
+def set_crash_hook(hook: Optional[Callable[..., None]]):
     """Install (or clear, with ``None``) the process-wide crash hook.
     Test-only machinery — production code never sets a hook."""
     global _hook
     _hook = hook
 
 
-def crash_point(label: str):
-    """Invoke the crash hook, if any, with ``label``. Called by the save
-    path at each protocol transition; a no-op unless a hook is installed."""
+def crash_point(label: str, **ctx):
+    """Invoke the crash hook, if any, with ``label`` (+ context kwargs —
+    serving passes ``replica=``). Called by the save path at each
+    protocol transition and by the serving tick phases; a no-op unless a
+    hook is installed."""
     if _hook is not None:
-        _hook(label)
+        _hook(label, **ctx)

@@ -194,6 +194,17 @@ class FleetRoutingPolicy:
             )
         return None
 
+    def shed_on_capacity(self, n_routable: int) -> Optional[str]:
+        """Reason string if the fleet has NO routable capacity left (every
+        replica quarantined/dead/draining) — the circuit-breaker edge: a
+        submission that cannot be served anywhere is rejected with a
+        structured :class:`ShedError` instead of queueing into a black
+        hole. Unlike depth shedding this ignores the priority floor: no
+        class is servable when nothing is serving."""
+        if n_routable <= 0:
+            return "no serving replicas (fleet capacity lost)"
+        return None
+
     def pick_replica(self, loads: Sequence[float], eligible: Sequence[int]) -> int:
         """Index (into ``loads``) of the replica a request should route
         to, among ``eligible`` indices. ``loads`` is queued + active per

@@ -66,6 +66,7 @@ from typing import Optional
 
 import numpy as np
 
+from .ft.crashpoints import crash_point
 from .scheduling import Scheduler, SchedulerConfig, ShedError
 
 
@@ -1101,6 +1102,155 @@ class ServingEngine:
         self.metrics.on_submit(uid)
         return uid
 
+    # ---- fleet failover: in-flight export / import (serving_fleet) ------
+
+    def _snapshot_request(self, req: _Request) -> dict:
+        """Portable base snapshot of one request: the FULL prompt (a
+        registered prefix is inlined — the destination replica may not
+        have it), the generated-so-far tokens/logprobs, and the admission
+        metadata. The caller adds the sampling-chain ``key_data`` (which
+        depends on where the request currently lives)."""
+        prompt = req.prompt
+        if req.prefix_id is not None:
+            pre = self._prefixes[req.prefix_id]
+            prompt = np.concatenate([np.asarray(pre["tokens"], np.int32), prompt])
+        return {
+            "uid": int(req.uid),
+            "prompt": np.asarray(prompt, np.int32),
+            "max_new_tokens": int(req.max_new_tokens),
+            "out_tokens": [int(t) for t in req.out_tokens],
+            "out_lps": [float(v) for v in req.out_lps],
+            "stop_sequences": req.stop_sequences,
+            "priority": int(req.priority),
+        }
+
+    def export_inflight(self, include_kv: bool = True) -> list:
+        """Snapshot EVERY in-flight request (queued + active) for
+        migration to another replica — the failover half of
+        :mod:`accelerate_tpu.serving_fleet`. Non-mutating: the engine is
+        left exactly as found (the router decides what to do with the
+        husk). Each snapshot carries the request plus its sampling-chain
+        ``key_data``, so :meth:`import_inflight` on a survivor continues
+        token- and logprob-exactly; decoding slots additionally export
+        their trimmed KV rows (``cache`` + ``rows``) when ``include_kv``
+        and the layout allows (dense, non-speculative — paged/speculative
+        slots fail over by prefix recompute, which is equally exact).
+
+        Safe at every labeled serving crash point by construction: the
+        crash hooks fire BEFORE the jitted tick calls, so the host
+        bookkeeping (out_tokens, slot_pos, slot keys, unconsumed
+        handoffs) is always consistent when a failover export runs."""
+        jax = _jax()
+        kv_ok = include_kv and not self.paged and self.draft_model is None
+        snaps = []
+
+        def handoff_snap(req, h):
+            # an unconsumed handoff payload (queued or awaiting paste):
+            # fold its sampled first token into the output stream — the
+            # importer re-feeds it at the pasted frontier (or recomputes)
+            snap = self._snapshot_request(req)
+            if h["next_tok"] is not None:
+                snap["out_tokens"] = snap["out_tokens"] + [int(h["next_tok"])]
+                snap["out_lps"] = snap["out_lps"] + [float(h["lp"])]
+            snap["key_data"] = np.asarray(h["key_data"])
+            if kv_ok and h.get("cache") is not None:
+                snap["cache"], snap["rows"] = h["cache"], int(h["total"])
+            return snap
+
+        for slot, req in enumerate(self.slot_req):
+            if req is None:
+                continue
+            if self.slot_phase[slot] == "decode":
+                snap = self._snapshot_request(req)
+                snap["key_data"] = np.asarray(jax.random.key_data(self._slot_keys[slot]))
+                if kv_ok:
+                    rows = int(self.slot_pos[slot])
+                    row = jax.tree_util.tree_map(lambda big: big[slot], self.slot_caches)
+                    snap["cache"], snap["rows"] = self._trim_row_cache(row, rows), rows
+                snaps.append(snap)
+                continue
+            st = self._prefill_state[slot]
+            if st is not None and st.get("handoff") is not None:
+                snaps.append(handoff_snap(req, st["handoff"]))
+                continue
+            snap = self._snapshot_request(req)
+            key = st["key"] if st is not None else jax.random.fold_in(
+                jax.random.key(self._seed), req.uid
+            )
+            snap["key_data"] = np.asarray(jax.random.key_data(key))
+            snaps.append(snap)
+        for req in self.queue:
+            if req.handoff is not None:
+                snaps.append(handoff_snap(req, req.handoff))
+                continue
+            snap = self._snapshot_request(req)
+            key = req.resume_key if req.resume_key is not None else jax.random.fold_in(
+                jax.random.key(self._seed), req.uid
+            )
+            snap["key_data"] = np.asarray(jax.random.key_data(key))
+            snaps.append(snap)
+        return snaps
+
+    def import_inflight(self, snap: dict) -> int:
+        """Admit a migrated request exported by another replica's
+        :meth:`export_inflight`, continuing its stream token- and
+        logprob-exactly: the carried ``key_data`` pins the sampling chain
+        and the resume machinery re-feeds the last generated token at the
+        recomputed (or KV-pasted, when ``cache`` shipped) frontier.
+        Bypasses the submit-time shed gate — migrated work already passed
+        admission once; shedding it now would LOSE it. Returns this
+        engine's local uid for the request."""
+        jax = _jax()
+        if self.draft_model is not None:
+            raise NotImplementedError("failover import does not compose with speculative serving")
+        prompt = np.asarray(snap["prompt"], np.int32).ravel()
+        out = [int(t) for t in snap.get("out_tokens") or []]
+        lps = [float(v) for v in snap.get("out_lps") or []]
+        max_new = int(snap["max_new_tokens"])
+        if len(prompt) == 0:
+            raise ValueError("empty prompt in failover snapshot")
+        if len(lps) != len(out):
+            raise ValueError(f"snapshot logprobs ({len(lps)}) misaligned with tokens ({len(out)})")
+        if len(out) > max_new:
+            raise ValueError(f"snapshot carries {len(out)} tokens > max_new_tokens {max_new}")
+        if len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the slot cache ({self.max_len})"
+            )
+        cache, rows = snap.get("cache"), int(snap.get("rows") or 0)
+        if cache is not None:
+            if not out:
+                raise ValueError("KV failover import needs a generated token to re-feed")
+            if rows != len(prompt) + len(out) - 1:
+                raise ValueError(
+                    f"KV rows ({rows}) != prompt ({len(prompt)}) + "
+                    f"generated ({len(out)}) - 1 — not a consistent decode frontier"
+                )
+        stops = tuple(tuple(int(t) for t in s) for s in (snap.get("stop_sequences") or ()))
+        uid = self._uid
+        self._uid += 1
+        req = _Request(
+            uid, prompt, max_new, out, None, stops,
+            out_lps=lps, priority=int(snap.get("priority", 0)),
+            submit_ts=time.monotonic(), preempted=bool(out), ttft_done=bool(out),
+            resume_key=jax.random.wrap_key_data(jax.numpy.asarray(snap["key_data"])),
+        )
+        if cache is not None:
+            req.handoff = {
+                "cache": cache, "total": rows, "next_tok": None, "lp": None,
+                "key_data": np.asarray(snap["key_data"]),
+            }
+        self._queue_push(req)
+        self._index[uid] = ("queued", req)
+        self.metrics.on_submit(uid)
+        self.metrics.on_failover_in()
+        self._log.event(
+            "failover_in", uid=uid, source_uid=int(snap.get("uid", -1)),
+            generated=len(out), kv_rows=rows if cache is not None else 0,
+        )
+        return uid
+
     def _admission_shed_check(self, priority: int) -> int:
         """Shared submit-time SLO gate (:meth:`submit` /
         :meth:`submit_prefilled`): returns the possibly-demoted priority,
@@ -1219,6 +1369,7 @@ class ServingEngine:
         without ever stalling running decodes. The engine always forces
         at least one unit of progress per tick, so no budget setting can
         livelock ``run()``."""
+        crash_point("pre_tick", replica=self.metrics.replica)
         now = time.monotonic()
         self._pool_blocked = False
         self._shed_pass(now)
@@ -1378,15 +1529,19 @@ class ServingEngine:
         # the per-request sampling chain: fold the uid at first admission,
         # carry the evicted chain across a preemption — the resumed stream
         # continues the SAME chain, so sampled outputs stay request-exact
-        if resume and req.resume_key is not None:
+        if req.resume_key is not None:
             st["key"] = req.resume_key
         else:
             st["key"] = jax.random.fold_in(jax.random.key(self._seed), req.uid)
-        if req.handoff is not None and not resume:
+        if req.handoff is not None:
             # disaggregated admission: the KV rows, first token, and the
             # advanced sampling chain all arrived with the handoff — no
             # prefill program runs here. Consumed once: a preemption
-            # resumes by the ordinary recompute path below.
+            # resumes by the ordinary recompute path below. A FAILOVER
+            # import (export_inflight -> import_inflight) rides the same
+            # path with resume=True: the pasted rows are the migrated
+            # request's exact KV frontier, and the resume finalize re-feeds
+            # its carried last token instead of emitting h["next_tok"].
             st["handoff"] = req.handoff
             st["key"] = jax.random.wrap_key_data(jax.numpy.asarray(req.handoff["key_data"]))
             req.handoff = None
@@ -1435,6 +1590,7 @@ class ServingEngine:
         st = self._prefill_state[slot]
         if st is None:
             return budget
+        crash_point("mid_prefill", replica=self.metrics.replica)
         req = st["req"]
         if st.get("handoff") is not None:
             # the prefill compute already happened on another replica:
@@ -1549,6 +1705,7 @@ class ServingEngine:
         host walk that streams tokens/logprobs out. Prefilling slots
         compute garbage rows by construction (static shapes) — their
         caches are fully replaced at prefill paste/insert."""
+        crash_point("mid_decode", replica=self.metrics.replica)
         jnp = _jax().numpy
         self.slot_caches, toks_k, lps_k, self._slot_keys = self._decode_tick(
             self.model.params, self.slot_caches,

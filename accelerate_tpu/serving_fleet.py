@@ -46,7 +46,31 @@ the layer above the engine:
   :class:`~accelerate_tpu.aot.ExecutableStore` deserialize every engine
   program a sibling already compiled: :meth:`FleetRouter.spin_up` warms
   a new replica and reports its compile count (asserted 0 in the bench
-  and the fleet tests — the PR-7 warm-replica story at fleet level).
+  and the fleet tests — the PR-7 warm-replica story at fleet level);
+
+* **fault tolerance** — every :class:`Replica` runs a ``healthy →
+  degraded → quarantined → dead`` health state machine driven by error
+  classification (engine exceptions, tick wall-time SLO violations,
+  :class:`NonFinitePoison` from the non-finite watchdog) with a circuit
+  breaker: the routing policy never sees quarantined/dead replicas, and
+  when surviving capacity is gone submissions shed at the fleet edge
+  with the structured :class:`~accelerate_tpu.scheduling.ShedError`. On
+  failure (or :meth:`FleetRouter.drain`) every in-flight request
+  migrates to a survivor **token- and logprob-exactly** — by prefix
+  recompute (the preemption/resume machinery: carried sampling key +
+  re-fed last token) or, when the dying replica can still export its
+  dense KV rows, by the same handoff path disaggregated serving uses
+  (``export_inflight`` → ``import_inflight``), the choice priced
+  BEFORE the move by
+  :func:`~accelerate_tpu.analysis.costmodel.price_failover` and the
+  handoff leg hardened with :func:`~accelerate_tpu.utils.retry.retry_call`
+  jittered backoff. Capacity recovers by :meth:`FleetRouter.add_replica`
+  over the shared store (zero compiles). The serving chaos matrix
+  (``test_utils.fault_injection.ReplicaChaos`` at the labeled
+  ``ft.crashpoints.SERVING_CRASH_POINTS``) proves every crash point
+  loses zero requests; :class:`HandoffCodec` serializes the handoff
+  payload to bytes — the first step toward a socket/queue replica
+  transport.
 
 Everything is CPU-runnable: replicas are in-process engines (optionally
 over device subsets via ``MeshConfig.num_devices``-built meshes), driven
@@ -59,19 +83,134 @@ device compute, so replicas overlap).
 from __future__ import annotations
 
 import dataclasses
+import io
 import threading
 import time
 from typing import Optional, Sequence
 
 import numpy as np
 
+from .ft.crashpoints import crash_point
 from .scheduling import FleetRoutingPolicy, RoutingConfig, ShedError
+from .utils.retry import retry_call
 
 
 def _jax():
     import jax
 
     return jax
+
+
+#: replica health levels, in degradation order; the index is the
+#: ``replica_state`` gauge value Prometheus exposes
+HEALTH_STATES = ("healthy", "degraded", "quarantined", "dead")
+
+
+class NonFinitePoison(RuntimeError):
+    """A replica's numerics are poisoned (the non-finite watchdog
+    latched, or a tick surfaced NaN/Inf). Unlike a plain crash the
+    replica's KV caches are SUSPECT: the router quarantines it and fails
+    its in-flight work over by recompute only — shipped KV rows from a
+    poisoned engine would carry the corruption to the survivor."""
+
+
+class FleetRequestError(KeyError):
+    """Structured lookup failure for a fleet request id, naming the
+    request's last known state (``unknown`` / ``lost`` / a failed
+    replica) — a client can distinguish "you never submitted this" from
+    "the fleet lost it at a failover" and react accordingly. Subclasses
+    ``KeyError`` so existing bare-lookup handling keeps working."""
+
+    def __init__(self, fuid: int, state: str, detail: Optional[str] = None):
+        self.fuid = int(fuid)
+        self.state = state
+        self.detail = detail
+        if state == "unknown":
+            msg = f"unknown request id {fuid} (never submitted, already cancelled, or shed)"
+        else:
+            msg = f"request id {fuid} last known state: {state}"
+        if detail:
+            msg += f" — {detail}"
+        super().__init__(msg)
+
+
+class HandoffCodec:
+    """Serialize a ``prefill_detached`` / ``export_inflight`` KV handoff
+    payload to bytes and back — the subprocess-readiness shim for the
+    roadmap's socket/queue replica transport: today's in-process handoff
+    passes live numpy trees between engines; a process-per-replica fleet
+    passes ``HandoffCodec.encode(handoff)`` over the wire instead, and
+    the decode side is token- and logprob-exact by the same round-trip
+    the tests pin.
+
+    The wire format is a single ``.npz`` blob: prompt, sampling
+    ``key_data``, scalar metadata, and each KV leaf as raw bytes + shape
+    (dtype-agnostic on purpose — bf16 and friends round-trip through the
+    receiving engine's row template, which is the single source of truth
+    for leaf dtypes and tree structure)."""
+
+    @staticmethod
+    def encode(handoff: dict) -> bytes:
+        jax = _jax()
+        leaves = jax.tree_util.tree_leaves(handoff["cache"])
+        arrays = {
+            "prompt": np.asarray(handoff["prompt"], np.int32),
+            "key_data": np.asarray(handoff["key_data"]),
+            "imeta": np.asarray(
+                [
+                    int(handoff["total"]),
+                    int(handoff["max_new_tokens"]),
+                    int(handoff["next_tok"]),
+                    int(handoff["wire_bytes"]),
+                    int(handoff.get("reused_prefix_tokens", 0)),
+                    len(leaves),
+                ],
+                np.int64,
+            ),
+            "fmeta": np.asarray([float(handoff["lp"])], np.float64),
+        }
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            arrays[f"leaf_{i}"] = np.frombuffer(arr.tobytes(), np.uint8)
+            arrays[f"shape_{i}"] = np.asarray(arr.shape, np.int64)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    @staticmethod
+    def decode(data: bytes, engine) -> dict:
+        """Rebuild the handoff dict against ``engine``'s row template
+        (leaf dtypes + tree structure); the result feeds
+        ``engine.submit_prefilled`` unchanged."""
+        jax = _jax()
+        with np.load(io.BytesIO(data)) as z:
+            imeta = z["imeta"]
+            n_leaves = int(imeta[5])
+            template = jax.tree_util.tree_leaves(engine._row_template)
+            if n_leaves != len(template):
+                raise ValueError(
+                    f"payload has {n_leaves} KV leaves; this engine's row "
+                    f"template has {len(template)} — engine/model mismatch"
+                )
+            leaves = []
+            for i, t in enumerate(template):
+                shape = tuple(int(d) for d in z[f"shape_{i}"])
+                raw = z[f"leaf_{i}"].tobytes()
+                leaves.append(np.frombuffer(raw, dtype=t.dtype).reshape(shape))
+            cache = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(engine._row_template), leaves
+            )
+            return {
+                "prompt": np.asarray(z["prompt"], np.int32),
+                "total": int(imeta[0]),
+                "max_new_tokens": int(imeta[1]),
+                "next_tok": int(imeta[2]),
+                "lp": float(z["fmeta"][0]),
+                "key_data": np.asarray(z["key_data"]),
+                "cache": cache,
+                "wire_bytes": int(imeta[3]),
+                "reused_prefix_tokens": int(imeta[4]),
+            }
 
 
 # --------------------------------------------------------------------- #
@@ -343,6 +482,19 @@ class FleetConfig:
     :func:`~accelerate_tpu.analysis.costmodel.price_kv_handoff`.
 
     ``prefix_reuse`` + radix knobs: see :class:`RadixPrefixCache`.
+
+    Fault tolerance: ``tick_timeout_s`` (None = no tick wall-time SLO)
+    degrades a replica on one slow tick and quarantines it after
+    ``quarantine_after_timeouts`` consecutive ones (its in-flight work
+    migrates); ``heal_after_ticks`` clean ticks promote a degraded
+    replica back to healthy. ``failover`` picks the migration path —
+    ``"auto"`` prices KV handoff vs recompute per request
+    (:func:`~accelerate_tpu.analysis.costmodel.price_failover`),
+    ``"handoff"`` / ``"recompute"`` pin it (the chaos matrix's A/B
+    arms; handoff silently falls back to recompute when the dying
+    replica cannot export). The handoff leg retries with jittered
+    backoff (``failover_retry_attempts`` ×
+    ``failover_retry_base_delay_s``) before falling back.
     """
 
     routing: RoutingConfig = dataclasses.field(default_factory=RoutingConfig)
@@ -354,6 +506,12 @@ class FleetConfig:
     min_prefix_tokens: int = 8
     promote_after: int = 2
     max_prefix_entries: int = 8
+    tick_timeout_s: Optional[float] = None
+    quarantine_after_timeouts: int = 2
+    heal_after_ticks: int = 16
+    failover: str = "auto"
+    failover_retry_attempts: int = 3
+    failover_retry_base_delay_s: float = 0.02
 
     def __post_init__(self):
         if self.handoff not in ("auto", "always", "never"):
@@ -364,12 +522,35 @@ class FleetConfig:
             bad = [r for r in self.roles if r not in ("mixed", "prefill", "decode")]
             if bad:
                 raise ValueError(f"roles must be mixed|prefill|decode, got {bad}")
+        if self.failover not in ("auto", "handoff", "recompute"):
+            raise ValueError(f"failover must be auto|handoff|recompute, got {self.failover!r}")
+        if self.tick_timeout_s is not None and self.tick_timeout_s <= 0:
+            raise ValueError(f"tick_timeout_s must be > 0, got {self.tick_timeout_s}")
+        if self.quarantine_after_timeouts < 1:
+            raise ValueError(
+                f"quarantine_after_timeouts must be >= 1, got {self.quarantine_after_timeouts}"
+            )
+        if self.heal_after_ticks < 1:
+            raise ValueError(f"heal_after_ticks must be >= 1, got {self.heal_after_ticks}")
+        if self.failover_retry_attempts < 1:
+            raise ValueError(
+                f"failover_retry_attempts must be >= 1, got {self.failover_retry_attempts}"
+            )
 
 
 class Replica:
     """One engine + its fleet-side state. ``lock`` serializes host
     bookkeeping between the router and a per-replica drain thread; the
-    engine itself is single-threaded by contract."""
+    engine itself is single-threaded by contract.
+
+    Health (router-driven, see :meth:`FleetRouter._tick_replica`):
+    ``healthy`` serves normally; ``degraded`` (a tick blew the wall-time
+    SLO) still serves but is one strike from quarantine and heals after
+    ``heal_after_ticks`` clean ticks; ``quarantined`` (circuit broken:
+    repeated timeouts or poisoned numerics) and ``dead`` (the engine
+    raised) never tick or receive routes again — their in-flight work
+    has already migrated. ``draining`` additionally blocks NEW routes
+    while :meth:`FleetRouter.drain` moves the existing work off."""
 
     def __init__(self, engine, name: str, role: str = "mixed"):
         self.engine = engine
@@ -377,6 +558,11 @@ class Replica:
         self.role = role
         self.radix: Optional[RadixPrefixCache] = None
         self.lock = threading.RLock()
+        self.health = "healthy"
+        self.draining = False
+        self.consecutive_timeouts = 0
+        self.clean_ticks = 0
+        self.last_error: Optional[str] = None
         engine.metrics.replica = name
 
     @property
@@ -386,6 +572,17 @@ class Replica:
     @property
     def busy(self) -> bool:
         return bool(self.engine.queue or self.engine.active_count)
+
+    @property
+    def is_serving(self) -> bool:
+        """Still ticking: healthy or degraded (a dead/quarantined
+        engine's host state is a read-only husk for failover export)."""
+        return self.health in ("healthy", "degraded")
+
+    @property
+    def routable(self) -> bool:
+        """Eligible for NEW work: serving and not draining."""
+        return self.is_serving and not self.draining
 
     def can_prefill(self) -> bool:
         return self.role in ("mixed", "prefill")
@@ -434,12 +631,16 @@ class FleetRouter:
                     )
         self._policy = FleetRoutingPolicy(self.config.routing)
         self._uid = 0
-        # fleet uid -> ("replica", idx, local_uid) | ("pending", entry)
+        # fleet uid -> ("replica", idx, local_uid) | ("pending", None)
+        #            | ("done", full, new, lps)  — results salvaged off a
+        #              failed/drained replica before it left the fleet
         self._map: dict[int, tuple] = {}
         self._shed: dict[int, ShedError] = {}
+        self._lost: dict[int, str] = {}  # fuid -> why failover could not save it
         self._pending: list[dict] = []  # disaggregated requests awaiting prefill+handoff
         self._lock = threading.RLock()
         self._mk_engine = None  # set by from_model: spin_up's factory
+        self._replica_seq = len(self.replicas)  # monotonic spin_up naming
         # KV-handoff accounting: predictions are priced BEFORE each
         # transfer; moved bytes are what actually shipped — the two must
         # agree exactly (bench-asserted)
@@ -449,6 +650,16 @@ class FleetRouter:
         self.handoff_bytes_moved = 0
         self.handoff_time_us_predicted = 0.0
         self.fleet_shed = 0  # fleet-level SLO rejections (router edge)
+        # failover accounting — same predicted-vs-moved discipline as the
+        # KV handoffs (the pin the chaos tests assert)
+        self.failovers = 0
+        self.failovers_kv = 0
+        self.failovers_recompute = 0
+        self.failovers_lost = 0
+        self.failover_bytes_predicted = 0
+        self.failover_bytes_moved = 0
+        self.failover_time_us_predicted = 0.0
+        self.failover_recompute_us_predicted = 0.0
 
     # -- construction ---------------------------------------------------- #
 
@@ -491,7 +702,15 @@ class FleetRouter:
         asserts). Only available on a :meth:`from_model` router."""
         if self._mk_engine is None:
             raise ValueError("spin_up needs a from_model router (an engine factory)")
-        name = f"r{len(self.replicas)}"
+        with self._lock:
+            # monotonic sequence, skipping anything still (or ever) taken:
+            # after a drain removed "r1", the next spin-up must NOT mint a
+            # second "r1" and alias its metrics/events
+            taken = {r.name for r in self.replicas}
+            while f"r{self._replica_seq}" in taken:
+                self._replica_seq += 1
+            name = f"r{self._replica_seq}"
+            self._replica_seq += 1
         t0 = time.perf_counter()
         engine = self._mk_engine(name)
         rep = Replica(engine, name, role)
@@ -517,6 +736,18 @@ class FleetRouter:
             "deserialized": pc.deserialized,
         }
 
+    def add_replica(
+        self, role: str = "mixed", warm_prompt_lens=(4,), max_new_tokens: int = 2
+    ) -> dict:
+        """Hot re-add: recover capacity lost to a quarantine/death/drain
+        by spinning up a fresh replica over the shared executable store —
+        zero XLA compiles when every program was already stored
+        (:meth:`spin_up` reports the count). The recovery half of the
+        fault-tolerance story; returns the spin-up report."""
+        return self.spin_up(
+            warm_prompt_lens=warm_prompt_lens, max_new_tokens=max_new_tokens, role=role
+        )
+
     # -- submission ------------------------------------------------------ #
 
     def submit(
@@ -532,11 +763,34 @@ class FleetRouter:
         scheduler SLOs still apply after routing."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         with self._lock:
-            depth = sum(len(r.engine.queue) for r in self.replicas) + len(self._pending)
-            reason = self._policy.shed_on_submit(int(priority), depth)
+            routable = self._routable_indices()
+            # circuit breaker: with zero serving capacity, reject at the
+            # edge instead of queueing into replicas that will never tick
+            reason = self._policy.shed_on_capacity(len(routable))
+            if reason is None:
+                depth = sum(
+                    len(self.replicas[i].engine.queue) for i in routable
+                ) + len(self._pending)
+                reason = self._policy.shed_on_submit(int(priority), depth)
+            else:
+                depth = len(self._pending)
             if reason is not None:
                 self.fleet_shed += 1
                 raise ShedError(reason, priority=int(priority), queue_depth=depth)
+            if self.disaggregated:
+                # validate BEFORE queueing a pending entry: a bad request
+                # must fail the caller here, not blow up a prefill replica
+                # at dispatch (where an engine error means replica death)
+                if len(prompt) == 0:
+                    raise ValueError("empty prompt")
+                if int(max_new_tokens) < 1:
+                    raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+                cap = min(self.replicas[i].engine.max_len for i in routable)
+                if len(prompt) + int(max_new_tokens) > cap:
+                    raise ValueError(
+                        f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                        f"exceeds the slot cache ({cap})"
+                    )
             fuid = self._uid
             self._uid += 1
             if self.disaggregated and not self._handoff_decision(len(prompt)):
@@ -573,13 +827,35 @@ class FleetRouter:
             self._map[fuid] = ("replica", idx, local)
         return fuid
 
+    def _routable_indices(self, *, prefill: bool = False, decode: bool = False, exclude=None):
+        """Replica indices the circuit breaker allows NEW work onto
+        (serving, not draining), optionally role-filtered and excluding
+        one replica (a failover's source)."""
+        out = []
+        for i, r in enumerate(self.replicas):
+            if not r.routable or r is exclude:
+                continue
+            if prefill and not r.can_prefill():
+                continue
+            if decode and not r.can_decode():
+                continue
+            out.append(i)
+        return out
+
     def _route_local(self, prompt: np.ndarray) -> int:
         """Replica index for a locally-prefilled request: prefix affinity
         first (the replica already holding the longest registered
-        preamble), else the routing policy over decode-capable load."""
-        eligible = [i for i, r in enumerate(self.replicas) if r.can_decode() and r.can_prefill()]
+        preamble), else the routing policy over decode-capable load.
+        Quarantined/dead/draining replicas are never candidates."""
+        eligible = [
+            i for i in self._routable_indices(decode=True)
+            if self.replicas[i].can_prefill()
+        ]
         if not eligible:  # disaggregated fleet deciding "local": decode side prefills
-            eligible = [i for i, r in enumerate(self.replicas) if r.can_decode()]
+            eligible = self._routable_indices(decode=True)
+        if not eligible:
+            self.fleet_shed += 1
+            raise ShedError("no decode-capable serving replicas (fleet capacity lost)")
         best_i, best_len = None, 0
         toks = tuple(int(t) for t in prompt)
         for i in eligible:
@@ -614,7 +890,10 @@ class FleetRouter:
         """(price_kv_handoff dict, local re-prefill us) for one prompt."""
         from .analysis.costmodel import prefill_compute_us, price_kv_handoff
 
-        src = next(r for r in self.replicas if r.can_prefill())
+        src = next(
+            (r for r in self.replicas if r.routable and r.can_prefill()),
+            next((r for r in self.replicas if r.can_prefill()), self.replicas[0]),
+        )
         per_tok, fixed = src.engine.kv_handoff_dims()
         pred = price_kv_handoff(
             per_tok, tokens, fixed_bytes=fixed,
@@ -630,6 +909,316 @@ class FleetRouter:
             self._param_count, tokens, generation=self.config.generation
         )
 
+    # -- replica health + failover ---------------------------------------- #
+
+    def _replica_by_name(self, name: str) -> Replica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise ValueError(f"unknown replica {name!r} (have {[r.name for r in self.replicas]})")
+
+    def _set_health(self, rep: Replica, state: str, reason: str = "") -> None:
+        if rep.health == state:
+            return
+        prev, rep.health = rep.health, state
+        rep.engine.metrics.on_replica_state(HEALTH_STATES.index(state))
+        rep.engine._log.event(
+            "replica_state", replica=rep.name, prev=prev, state=state, reason=reason
+        )
+
+    @staticmethod
+    def _classify(exc: BaseException) -> str:
+        """``"poison"`` (numerics suspect — quarantine, recompute-only
+        failover) or ``"crash"`` (process-style death — dead, KV export
+        still trusted). Non-finite surfaces either as the typed
+        :class:`NonFinitePoison` or as a message from the watchdog's
+        ``nonfinite`` vocabulary."""
+        if isinstance(exc, NonFinitePoison):
+            return "poison"
+        if "nonfinite" in str(exc).lower().replace("-", "").replace(" ", ""):
+            return "poison"
+        return "crash"
+
+    def _on_replica_error(self, rep: Replica, exc: BaseException) -> None:
+        """An engine raised (or was declared failed): classify, break the
+        circuit, and migrate every in-flight request to survivors."""
+        kind = self._classify(exc)
+        rep.last_error = f"{type(exc).__name__}: {exc}"
+        rep.engine.metrics.on_replica_error()
+        self._set_health(
+            rep, "quarantined" if kind == "poison" else "dead", reason=rep.last_error
+        )
+        self._migrate_all(rep, reason=kind, allow_kv=(kind != "poison"))
+
+    def _on_replica_timeout(self, rep: Replica, dt: float) -> None:
+        rep.consecutive_timeouts += 1
+        rep.clean_ticks = 0
+        rep.engine.metrics.on_replica_timeout()
+        rep.engine._log.event(
+            "replica_timeout", replica=rep.name, tick_s=round(dt, 4),
+            consecutive=rep.consecutive_timeouts,
+        )
+        if rep.consecutive_timeouts >= self.config.quarantine_after_timeouts:
+            rep.last_error = (
+                f"tick timeout x{rep.consecutive_timeouts} "
+                f"({dt:.3f}s > {self.config.tick_timeout_s}s)"
+            )
+            self._set_health(rep, "quarantined", reason=rep.last_error)
+            # a hung-then-quarantined replica's host state is intact (the
+            # tick finished, just late) — its KV rows are trustworthy
+            self._migrate_all(rep, reason="timeout", allow_kv=True)
+        elif rep.health == "healthy":
+            self._set_health(
+                rep, "degraded", reason=f"tick {dt:.3f}s > {self.config.tick_timeout_s}s"
+            )
+
+    def _on_replica_clean(self, rep: Replica) -> None:
+        rep.consecutive_timeouts = 0
+        if rep.health == "degraded":
+            rep.clean_ticks += 1
+            if rep.clean_ticks >= self.config.heal_after_ticks:
+                rep.clean_ticks = 0
+                self._set_health(rep, "healthy", reason="clean ticks")
+
+    def _tick_replica(self, rep: Replica) -> int:
+        """One guarded engine tick: exceptions classify the replica
+        failed (and migrate its work); wall-time drives the
+        degraded/quarantined transitions when ``tick_timeout_s`` is
+        set."""
+        try:
+            with rep.lock:
+                if not rep.busy:
+                    return 0
+                t0 = time.perf_counter()
+                active = rep.engine.step()
+                dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — any engine death is a replica fault
+            self._on_replica_error(rep, e)
+            return 0
+        if self.config.tick_timeout_s is not None and dt > self.config.tick_timeout_s:
+            self._on_replica_timeout(rep, dt)
+        else:
+            self._on_replica_clean(rep)
+        return active if rep.is_serving else 0
+
+    def _migrate_all(self, rep: Replica, reason: str, allow_kv: bool = True) -> dict:
+        """Move EVERY in-flight request owned by ``rep`` to survivors:
+        finished results are salvaged as-is, shed requests keep their
+        structured error, and live requests fail over token-exactly via
+        :meth:`ServingEngine.export_inflight`. Anything unsnapshottable
+        lands in ``_lost`` with a reason (surfaced by
+        :class:`FleetRequestError`) — counted, never silent."""
+        with self._lock:
+            idx = self.replicas.index(rep)
+            owned = {
+                loc[2]: fuid
+                for fuid, loc in self._map.items()
+                if loc[0] == "replica" and loc[1] == idx
+            }
+        migrated = lost = 0
+        with rep.lock:
+            eng = rep.engine
+            for local, fuid in list(owned.items()):
+                got = eng.done.get(local)
+                if got is not None:
+                    with self._lock:
+                        self._map[fuid] = (
+                            "done", got, eng._done_new.get(local), eng._done_lps.get(local)
+                        )
+                    del owned[local]
+                    continue
+                err = eng._shed.get(local)
+                if err is not None:
+                    with self._lock:
+                        self._shed[fuid] = err
+                        self._map.pop(fuid, None)
+                    del owned[local]
+            by_uid = {}
+            if owned:
+                try:
+                    by_uid = {
+                        int(s["uid"]): s for s in eng.export_inflight(include_kv=allow_kv)
+                    }
+                except Exception as e:  # noqa: BLE001 — a husk too broken to export
+                    eng._log.event(
+                        "failover_export_failed", replica=rep.name,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+        for local, fuid in owned.items():
+            snap = by_uid.get(local)
+            if snap is None:
+                with self._lock:
+                    self._map.pop(fuid, None)
+                    self._lost[fuid] = (
+                        f"in-flight on replica {rep.name!r} at {reason}; no snapshot recovered"
+                    )
+                    self.failovers_lost += 1
+                rep.engine.metrics.on_failover_lost()
+                lost += 1
+                continue
+            if self._failover_one(rep, fuid, snap, reason):
+                migrated += 1
+            else:
+                lost += 1
+        return {"migrated": migrated, "lost": lost}
+
+    def _failover_choice(self, snap: dict):
+        """``(path, handoff_pred, recompute_us)`` for one snapshot,
+        priced BEFORE anything moves
+        (:func:`~accelerate_tpu.analysis.costmodel.price_failover`);
+        ``config.failover`` pins the path for the A/B arms."""
+        if snap.get("cache") is None:
+            return "recompute", {"bytes": 0, "time_us": 0.0}, 0.0
+        from .analysis.costmodel import price_failover
+
+        src = next(
+            (r for r in self.replicas if r.can_prefill()), self.replicas[0]
+        )
+        per_tok, fixed = src.engine.kv_handoff_dims()
+        self._price_handoff(1)  # ensures _param_count is cached
+        priced = price_failover(
+            per_tok,
+            len(snap["prompt"]),
+            len(snap.get("out_tokens") or []),
+            self._param_count,
+            fixed_bytes=fixed,
+            transport=self.config.transport,
+            generation=self.config.generation,
+        )
+        mode = self.config.failover
+        path = priced["path"] if mode == "auto" else mode
+        return path, priced["handoff"], priced["recompute_us"]
+
+    def _failover_one(self, src_rep: Replica, fuid: int, snap: dict, reason: str) -> bool:
+        """Migrate ONE snapshotted request to a surviving replica; the
+        KV-handoff leg retries with jittered backoff and falls back to
+        recompute (always available) rather than losing the request."""
+        cfg = self.config
+        cand = self._routable_indices(decode=True, exclude=src_rep)
+        if not cand:
+            cand = self._routable_indices(exclude=src_rep)
+        if not cand:
+            with self._lock:
+                self._map.pop(fuid, None)
+                self._lost[fuid] = f"no surviving replica to migrate to ({reason})"
+                self.failovers_lost += 1
+            src_rep.engine.metrics.on_failover_lost()
+            return False
+        with self._lock:
+            loads = [r.load for r in self.replicas]
+            d_idx = self._policy.pick_replica(loads, cand)
+        dst = self.replicas[d_idx]
+        path, pred, recompute_us = self._failover_choice(snap)
+        moved = 0
+        local = None
+        if path == "handoff":
+            jax = _jax()
+            moved = int(
+                sum(np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(snap["cache"]))
+            )
+
+            def leg():
+                with dst.lock:
+                    return dst.engine.import_inflight(snap)
+
+            try:
+                local = retry_call(
+                    leg,
+                    attempts=cfg.failover_retry_attempts,
+                    base_delay=cfg.failover_retry_base_delay_s,
+                    max_delay=0.5,
+                    on_retry=lambda attempt, delay, e: dst.engine._log.event(
+                        "failover_retry", fuid=fuid, dst=dst.name, attempt=attempt,
+                        delay_s=round(delay, 4), error=f"{type(e).__name__}: {e}",
+                    ),
+                )
+            except Exception:  # noqa: BLE001 — the KV leg is an optimisation, never a requirement
+                path, moved, local = "recompute", 0, None
+        if local is None:
+            slim = {k: v for k, v in snap.items() if k not in ("cache", "rows")}
+            with dst.lock:
+                local = dst.engine.import_inflight(slim)
+        with self._lock:
+            self._map[fuid] = ("replica", d_idx, local)
+            self.failovers += 1
+            if path == "handoff":
+                self.failovers_kv += 1
+                self.failover_bytes_predicted += int(pred["bytes"])
+                self.failover_bytes_moved += moved
+                self.failover_time_us_predicted += float(pred["time_us"])
+            else:
+                self.failovers_recompute += 1
+                self.failover_recompute_us_predicted += float(recompute_us)
+        src_rep.engine.metrics.on_failover_out()
+        dst.engine._log.event(
+            "failover", fuid=fuid, src=src_rep.name, dst=dst.name, path=path,
+            reason=reason, generated=len(snap.get("out_tokens") or []),
+            predicted_bytes=int(pred["bytes"]) if path == "handoff" else 0,
+            moved_bytes=moved, predicted_us=round(float(pred["time_us"]), 3),
+            recompute_us=round(float(recompute_us), 3),
+        )
+        return True
+
+    def fail_replica(self, name: str, error: Optional[BaseException] = None) -> dict:
+        """Operator surface: declare a replica failed out-of-band (its
+        pod died, its host is being reclaimed) — classifies, breaks the
+        circuit, migrates its in-flight work. Returns the replica's
+        post-transition health entry."""
+        rep = self._replica_by_name(name)
+        self._on_replica_error(
+            rep, error if error is not None else RuntimeError("declared failed by operator")
+        )
+        return self.health()[rep.name]
+
+    def drain(self, name: str) -> dict:
+        """Gracefully remove one replica: stop admissions to it, migrate
+        its in-flight work to survivors (token- and logprob-exact, same
+        machinery as failure — but the engine is healthy so its KV is
+        always exportable), then drop it from the fleet. Returns
+        ``{"replica", "migrated", "lost"}``."""
+        rep = self._replica_by_name(name)
+        with self._lock:
+            if not [r for r in self.replicas if r is not rep and r.routable]:
+                raise ValueError(
+                    f"cannot drain {name!r}: no other serving replica to take its work"
+                )
+            rep.draining = True
+        res = self._migrate_all(rep, reason="drain", allow_kv=True)
+        self._remove_replica(rep)
+        rep.engine._log.event(
+            "replica_drain", replica=rep.name, migrated=res["migrated"], lost=res["lost"]
+        )
+        return {"replica": rep.name, **res}
+
+    def _remove_replica(self, rep: Replica) -> None:
+        with self._lock:
+            idx = self.replicas.index(rep)
+            self.replicas.pop(idx)
+            for fuid, loc in list(self._map.items()):
+                if loc[0] != "replica":
+                    continue
+                if loc[1] == idx:  # only if a migration leg failed above
+                    self._map.pop(fuid)
+                    self._lost[fuid] = f"replica {rep.name!r} removed"
+                elif loc[1] > idx:
+                    self._map[fuid] = ("replica", loc[1] - 1, loc[2])
+
+    def health(self) -> dict:
+        """Per-replica health view: ``{name: {health, role, draining,
+        consecutive_timeouts, last_error, load}}``."""
+        with self._lock:
+            return {
+                r.name: {
+                    "health": r.health,
+                    "role": r.role,
+                    "draining": r.draining,
+                    "consecutive_timeouts": r.consecutive_timeouts,
+                    "last_error": r.last_error,
+                    "load": r.load,
+                }
+                for r in self.replicas
+            }
+
     # -- driving --------------------------------------------------------- #
 
     def dispatch_pending(self, limit: Optional[int] = None) -> int:
@@ -643,27 +1232,49 @@ class FleetRouter:
             with self._lock:
                 if not self._pending or (limit is not None and n >= limit):
                     return n
+                d_cand = self._routable_indices(decode=True)
+                if not d_cand:
+                    # terminal for pending work: nothing can ever decode
+                    # these — account them lost instead of leaking
+                    # forever-pending entries
+                    for entry in self._pending:
+                        self._map.pop(entry["fuid"], None)
+                        self._lost[entry["fuid"]] = (
+                            "no decode-capable serving replica for pending handoff"
+                        )
+                        self.failovers_lost += 1
+                    self._pending.clear()
+                    return n
+                # prefill side lost? decode replicas self-prefill detached
+                # (role is a preference, not a capability — and uid_key
+                # keeps the sampling chain identical either way)
+                p_cand = self._routable_indices(prefill=True) or d_cand
                 entry = self._pending.pop(0)
                 loads = [r.load for r in self.replicas]
-                p_idx = self._policy.pick_replica(
-                    loads, [i for i, r in enumerate(self.replicas) if r.can_prefill()]
-                )
-                d_idx = self._policy.pick_replica(
-                    loads, [i for i, r in enumerate(self.replicas) if r.can_decode()]
-                )
+                p_idx = self._policy.pick_replica(loads, p_cand)
+                d_idx = self._policy.pick_replica(loads, d_cand)
                 pred, _ = self._price_handoff(len(entry["prompt"]))
             p_rep, d_rep = self.replicas[p_idx], self.replicas[d_idx]
-            with p_rep.lock:
-                prefix = (
-                    p_rep.radix.lookup(entry["prompt"]) if p_rep.radix is not None else None
-                )
-                handoff = p_rep.engine.prefill_detached(
-                    entry["prompt"], entry["max_new_tokens"],
-                    uid_key=entry["fuid"],
-                    prefix_id=None if prefix is None else prefix[0],
-                )
-                if p_rep.radix is not None and prefix is None:
-                    p_rep.radix.observe(entry["prompt"])
+            try:
+                with p_rep.lock:
+                    crash_point("pre_handoff", replica=p_rep.name)
+                    prefix = (
+                        p_rep.radix.lookup(entry["prompt"]) if p_rep.radix is not None else None
+                    )
+                    handoff = p_rep.engine.prefill_detached(
+                        entry["prompt"], entry["max_new_tokens"],
+                        uid_key=entry["fuid"],
+                        prefix_id=None if prefix is None else prefix[0],
+                    )
+                    if p_rep.radix is not None and prefix is None:
+                        p_rep.radix.observe(entry["prompt"])
+            except Exception as e:  # noqa: BLE001 — prefill replica died mid-dispatch
+                with self._lock:
+                    # the entry never left the router: requeue at the head
+                    # (nothing ran — redispatch is exact by construction)
+                    self._pending.insert(0, entry)
+                self._on_replica_error(p_rep, e)
+                continue
             with d_rep.lock:
                 local = d_rep.engine.submit_prefilled(
                     handoff, stop_sequences=entry["stop_sequences"],
@@ -685,21 +1296,23 @@ class FleetRouter:
             n += 1
 
     def step(self) -> int:
-        """One fleet tick: dispatch pending handoffs, then one engine
-        tick per busy replica. Returns occupied slots across the fleet
-        (plus pending handoffs)."""
+        """One fleet tick: dispatch pending handoffs, then one guarded
+        engine tick per busy SERVING replica (quarantined/dead replicas
+        never tick — an engine exception fails the replica over instead
+        of propagating). Returns occupied slots across the fleet (plus
+        pending handoffs)."""
         self.dispatch_pending()
         active = 0
-        for rep in self.replicas:
-            with rep.lock:
-                if rep.busy:
-                    active += rep.engine.step()
+        for rep in list(self.replicas):
+            if rep.is_serving:
+                active += self._tick_replica(rep)
         with self._lock:
             return active + len(self._pending)
 
     def run(self) -> dict:
         """Drive ticks until every replica drains; returns
-        ``{fleet_uid: full token array}``."""
+        ``{fleet_uid: full token array}`` — including results salvaged
+        off failed/drained replicas."""
         while self._work_remaining():
             self.step()
         out = {}
@@ -710,6 +1323,8 @@ class FleetRouter:
                 got = self.replicas[loc[1]].engine.done.get(loc[2])
                 if got is not None:
                     out[fuid] = got
+            elif loc[0] == "done":
+                out[fuid] = loc[1]
         return out
 
     def drain_threaded(self) -> float:
@@ -717,57 +1332,116 @@ class FleetRouter:
         (wall-clock overlap across replicas — XLA releases the GIL during
         compute); the caller's thread keeps dispatching handoffs.
         Returns elapsed seconds. Use :meth:`step` when determinism
-        matters more than wall-clock."""
+        matters more than wall-clock.
+
+        Worker-thread exceptions are NEVER invisible: each worker
+        captures its exception, the caller's loop classifies it
+        (:meth:`_on_replica_error` — replica marked failed, in-flight
+        work failed over to survivors) and keeps draining. Only when no
+        serving replica remains is the first captured exception
+        re-raised — otherwise the fault is surfaced through replica
+        health/events and the drain completes on the survivors."""
         t0 = time.perf_counter()
         stop = threading.Event()
+        errors: list = []
+        err_lock = threading.Lock()
 
         def worker(rep: Replica):
             while not stop.is_set():
-                with rep.lock:
-                    busy = rep.busy
-                    if busy:
-                        rep.engine.step()
+                if not rep.is_serving:
+                    return
+                try:
+                    with rep.lock:
+                        busy = rep.busy
+                        if busy:
+                            rep.engine.step()
+                except Exception as e:  # noqa: BLE001 — surfaced by the caller's loop
+                    with err_lock:
+                        errors.append((rep, e))
+                    return
                 if not busy:
                     time.sleep(0.0005)
 
         threads = [threading.Thread(target=worker, args=(r,), daemon=True) for r in self.replicas]
         for t in threads:
             t.start()
+        first_exc: Optional[BaseException] = None
+
+        def handle_errors():
+            nonlocal first_exc
+            with err_lock:
+                batch, errors[:] = list(errors), []
+            for rep, exc in batch:
+                if first_exc is None:
+                    first_exc = exc
+                # failover runs on the CALLER's thread: the dead worker
+                # already released its lock on the way out, and survivors'
+                # locks are only held a tick at a time
+                self._on_replica_error(rep, exc)
+
         try:
-            while self._work_remaining():
+            while True:
+                handle_errors()
+                if not self._work_remaining():
+                    break
                 self.dispatch_pending()
                 time.sleep(0.0005)
         finally:
             stop.set()
             for t in threads:
                 t.join()
+            handle_errors()
+        if first_exc is not None and not any(r.is_serving for r in self.replicas):
+            raise first_exc
         return time.perf_counter() - t0
 
     def _work_remaining(self) -> bool:
         with self._lock:
-            if self._pending:
+            if self._pending and self._routable_indices(decode=True):
                 return True
-        return any(r.busy for r in self.replicas)
+        return any(r.is_serving and r.busy for r in self.replicas)
 
     # -- request resolution ---------------------------------------------- #
 
     def _locate(self, fuid: int):
+        """Raises the stored :class:`ShedError` for shed requests and a
+        structured :class:`FleetRequestError` naming the last known state
+        for unknown / failover-lost ids."""
         with self._lock:
             if fuid in self._shed:
                 raise self._shed[fuid]
             loc = self._map.get(fuid)
-        if loc is None:
-            raise KeyError(f"unknown request id {fuid}")
+            if loc is None:
+                if fuid in self._lost:
+                    raise FleetRequestError(fuid, "lost", self._lost[fuid])
+                raise FleetRequestError(fuid, "unknown")
         return loc
+
+    def _live_replica(self, fuid: int, loc) -> Replica:
+        """The serving replica a map entry points at — raises the
+        structured error instead of touching a failed engine (a transient
+        state: failover re-homes the entry, after which the accessors
+        resolve on the survivor)."""
+        rep = self.replicas[loc[1]]
+        if not rep.is_serving:
+            raise FleetRequestError(
+                fuid, f"on {rep.health} replica {rep.name!r}",
+                rep.last_error or "failing over",
+            )
+        return rep
 
     def poll(self, fuid: int):
         """Finished [prompt + generated] tokens, or None while pending.
         Raises the structured ShedError for a shed request (fleet- or
-        replica-level)."""
+        replica-level) and :class:`FleetRequestError` for unknown or
+        failover-lost ids. A request salvaged off a failed/drained
+        replica resolves here exactly like a live one."""
         loc = self._locate(fuid)
         if loc[0] == "pending":
             return None
-        rep = self.replicas[loc[1]]
+        if loc[0] == "done":
+            return loc[1]
+        rep = self._live_replica(fuid, loc)
         with rep.lock:
             try:
                 return rep.engine.poll(loc[2])
@@ -778,11 +1452,16 @@ class FleetRouter:
 
     def partial(self, fuid: int) -> np.ndarray:
         """Tokens generated so far (streaming surface; empty while the
-        request is queued or awaiting its handoff)."""
+        request is queued or awaiting its handoff). A failed-over
+        request keeps exposing its already-streamed tokens from the
+        survivor — a delta streamer sees no regression across the
+        migration."""
         loc = self._locate(fuid)
         if loc[0] == "pending":
             return np.zeros((0,), np.int32)
-        rep = self.replicas[loc[1]]
+        if loc[0] == "done":
+            return loc[2]
+        rep = self._live_replica(fuid, loc)
         with rep.lock:
             return rep.engine.partial(loc[2])
 
@@ -790,20 +1469,39 @@ class FleetRouter:
         loc = self._locate(fuid)
         if loc[0] == "pending":
             return np.zeros((0,), np.float32)
-        rep = self.replicas[loc[1]]
+        if loc[0] == "done":
+            return loc[3]
+        rep = self._live_replica(fuid, loc)
         with rep.lock:
             return rep.engine.logprobs(loc[2])
 
     def cancel(self, fuid: int) -> np.ndarray:
         """Abort a request anywhere in the fleet (still-pending handoffs
-        cancel before any prefill runs)."""
-        loc = self._locate(fuid)
+        cancel before any prefill runs). Cancelling a request stranded
+        on a quarantined/dead replica — or already LOST to a failed
+        migration — succeeds WITHOUT touching the failed engine: the
+        fleet-side tracking is dropped and the empty token array
+        returned (the death already cancelled it for real)."""
         with self._lock:
+            if fuid in self._shed:
+                raise self._shed[fuid]
+            loc = self._map.get(fuid)
+            if loc is None:
+                if fuid in self._lost:
+                    del self._lost[fuid]
+                    return np.zeros((0,), np.int32)
+                raise FleetRequestError(fuid, "unknown")
             if loc[0] == "pending":
                 self._pending = [e for e in self._pending if e["fuid"] != fuid]
                 del self._map[fuid]
                 return np.zeros((0,), np.int32)
+            if loc[0] == "done":
+                raise ValueError(f"request {fuid} already finished; poll() it instead")
         rep = self.replicas[loc[1]]
+        if not rep.is_serving:
+            with self._lock:
+                self._map.pop(fuid, None)
+            return np.zeros((0,), np.int32)
         with rep.lock:
             return rep.engine.cancel(loc[2])
 
@@ -832,6 +1530,24 @@ class FleetRouter:
                 "bytes_predicted": self.handoff_bytes_predicted,
                 "bytes_moved": self.handoff_bytes_moved,
                 "time_us_predicted": round(self.handoff_time_us_predicted, 3),
+            }
+
+    def failover_accounting(self) -> dict:
+        """Byte/step accounting for every failover the router performed.
+        ``bytes_predicted`` (the costmodel's pre-priced KV payload) is
+        pinned equal to ``bytes_moved`` (actual leaf bytes shipped) by the
+        test suite — failovers are priced BEFORE they happen, and the
+        price must be honest."""
+        with self._lock:
+            return {
+                "failovers": self.failovers,
+                "failovers_kv": self.failovers_kv,
+                "failovers_recompute": self.failovers_recompute,
+                "failovers_lost": self.failovers_lost,
+                "bytes_predicted": self.failover_bytes_predicted,
+                "bytes_moved": self.failover_bytes_moved,
+                "time_us_predicted": round(self.failover_time_us_predicted, 3),
+                "recompute_us_predicted": round(self.failover_recompute_us_predicted, 3),
             }
 
     def radix_stats(self) -> dict:

@@ -130,6 +130,15 @@ class ServingMetrics:
         self.prefix_evictions = 0
         self.prefix_registrations = 0
         self.prefix_tokens_reused = 0
+        # fleet fault tolerance (serving_fleet): failover flow counters
+        # and this replica's health level (0 healthy, 1 degraded,
+        # 2 quarantined, 3 dead — a fleet view exposes the worst source)
+        self.failovers_in = 0  # migrated requests imported by this engine
+        self.failovers_out = 0  # in-flight requests migrated off this engine
+        self.failovers_lost = 0  # in-flight requests unrecoverable at failover
+        self.replica_errors = 0  # engine exceptions classified by the router
+        self.replica_timeouts = 0  # tick wall-time SLO violations
+        self._replica_state = 0
         # latency windows
         self.ttft_ms: collections.deque = collections.deque(maxlen=window)
         self.e2e_ms: collections.deque = collections.deque(maxlen=window)
@@ -231,6 +240,29 @@ class ServingMetrics:
     def on_prefix_register(self):
         self.prefix_registrations += 1
 
+    def on_failover_in(self):
+        """A migrated in-flight request was imported by this engine."""
+        self.failovers_in += 1
+
+    def on_failover_out(self):
+        """An in-flight request was exported off this engine's replica."""
+        self.failovers_out += 1
+
+    def on_failover_lost(self):
+        """An in-flight request could not be recovered at failover."""
+        self.failovers_lost += 1
+
+    def on_replica_error(self):
+        self.replica_errors += 1
+
+    def on_replica_timeout(self):
+        self.replica_timeouts += 1
+
+    def on_replica_state(self, level: int):
+        """Router health transition: 0 healthy, 1 degraded, 2 quarantined,
+        3 dead."""
+        self._replica_state = int(level)
+
     # ------------------------------------------------------------------ #
     # read surface
     # ------------------------------------------------------------------ #
@@ -246,6 +278,15 @@ class ServingMetrics:
         if self._sources:
             return sum(m.active_slots for m in self._sources)
         return self._engine.active_count if self._engine is not None else 0
+
+    @property
+    def replica_state(self) -> int:
+        """Health level of this replica (0 healthy, 1 degraded,
+        2 quarantined, 3 dead); a fleet view reports its WORST source —
+        the alerting-relevant aggregate."""
+        if self._sources:
+            return max(m.replica_state for m in self._sources)
+        return self._replica_state
 
     @property
     def kv_block_utilization(self) -> Optional[float]:
@@ -312,6 +353,12 @@ class ServingMetrics:
             "prefix_evictions": self.prefix_evictions,
             "prefix_registrations": self.prefix_registrations,
             "prefix_tokens_reused": self.prefix_tokens_reused,
+            "failovers_in": self.failovers_in,
+            "failovers_out": self.failovers_out,
+            "failovers_lost": self.failovers_lost,
+            "replica_errors": self.replica_errors,
+            "replica_timeouts": self.replica_timeouts,
+            "replica_state": self.replica_state,
         }
         if self.replica is not None:
             snap["replica"] = self.replica
@@ -324,6 +371,8 @@ class ServingMetrics:
         "requests_deprioritized", "decode_preemptions", "resumes",
         "prefix_hits", "prefix_misses", "prefix_evictions",
         "prefix_registrations", "prefix_tokens_reused",
+        "failovers_in", "failovers_out", "failovers_lost",
+        "replica_errors", "replica_timeouts",
     )
     _WINDOWS = ("ttft_ms", "e2e_ms", "itl_ms", "queue_wait_ms")
 
@@ -377,6 +426,11 @@ class ServingMetrics:
         ("prefix_evictions_total", "Radix-cache prefix entries evicted (LRU)", "prefix_evictions"),
         ("prefix_registrations_total", "Shared preambles promoted into the radix cache", "prefix_registrations"),
         ("prefix_tokens_reused_total", "Prompt tokens served from cached prefixes (no re-prefill)", "prefix_tokens_reused"),
+        ("failovers_in_total", "Migrated in-flight requests imported from a failed replica", "failovers_in"),
+        ("failovers_out_total", "In-flight requests migrated off this replica at failure/drain", "failovers_out"),
+        ("failovers_lost_total", "In-flight requests unrecoverable at failover", "failovers_lost"),
+        ("replica_errors_total", "Engine exceptions classified by the fleet router", "replica_errors"),
+        ("replica_timeouts_total", "Tick wall-time SLO violations", "replica_timeouts"),
     )
     _PROM_SUMMARIES = (
         ("ttft_ms", "Time to first token (ms)", "ttft_ms"),
@@ -389,6 +443,7 @@ class ServingMetrics:
         ("active_slots", "Slots currently decoding", "active_slots"),
         ("kv_block_utilization", "Fraction of the paged KV pool in use", "kv_block_utilization"),
         ("tokens_per_sec", "Decode throughput over the trailing window", "tokens_per_sec"),
+        ("replica_state", "Replica health (0 healthy, 1 degraded, 2 quarantined, 3 dead)", "replica_state"),
     )
 
     def _label_str(self, extra: Optional[dict] = None) -> str:

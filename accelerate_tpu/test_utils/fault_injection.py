@@ -22,16 +22,25 @@ manifest's size/crc32 detection.
 from __future__ import annotations
 
 import os
+import time
 from pathlib import Path
 
-from ..ft.crashpoints import ALL_CRASH_POINTS, CRASH_POINTS, RESTORE_CRASH_POINTS, set_crash_hook
+from ..ft.crashpoints import (
+    ALL_CRASH_POINTS,
+    CRASH_POINTS,
+    RESTORE_CRASH_POINTS,
+    SERVING_CRASH_POINTS,
+    set_crash_hook,
+)
 
 __all__ = [
     "SimulatedCrash",
     "CrashPoint",
+    "ReplicaChaos",
     "corrupt_file",
     "CRASH_POINTS",
     "RESTORE_CRASH_POINTS",
+    "SERVING_CRASH_POINTS",
     "ALL_CRASH_POINTS",
 ]
 
@@ -67,7 +76,7 @@ class CrashPoint:
         self.fired = False
         self._seen = 0
 
-    def _hook(self, label: str):
+    def _hook(self, label: str, **ctx):
         if label != self.label:
             return
         self._seen += 1
@@ -77,6 +86,94 @@ class CrashPoint:
         if self.action == "kill":
             os._exit(self.EXIT_CODE)
         raise SimulatedCrash(f"simulated crash at checkpoint save point {self.label!r}")
+
+    def __enter__(self):
+        set_crash_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc):
+        set_crash_hook(None)
+        return False
+
+
+class ReplicaChaos:
+    """Chaos controller for the serving fleet — the serving twin of
+    :class:`CrashPoint`, driving the crash-at-every-point failover matrix
+    in ``tests/test_fleet.py``.
+
+    Targets one labeled serving point
+    (:data:`~accelerate_tpu.ft.crashpoints.SERVING_CRASH_POINTS`:
+    ``pre_tick``/``mid_prefill``/``mid_decode`` inside
+    ``ServingEngine.step`` and ``pre_handoff`` in the router's
+    disaggregated dispatch), optionally on ONE named replica of a fleet
+    (serving crash points pass ``replica=<name>`` context; ``replica=None``
+    matches any). ``action``:
+
+    * ``"crash"``   — raise :class:`SimulatedCrash` (the replica process
+      died; its KV may still be exportable)
+    * ``"poison"``  — raise ``serving_fleet.NonFinitePoison`` (the PR-9
+      non-finite watchdog tripped: numerics are suspect, so the router
+      quarantines and fails over by recompute only, never trusting the
+      replica's KV)
+    * ``"hang"``    — sleep ``hang_s`` (drives tick-timeout degradation)
+    * ``"latency"`` — sleep ``latency_s`` (slow-replica jitter)
+
+    ``hits`` delays firing to the Nth matching visit; with ``repeat`` the
+    hook keeps firing on every later visit too (a persistently sick
+    replica), otherwise it fires once. ``fired``/``count`` record what
+    happened. Like :class:`CrashPoint`, both raise actions use exception
+    types that are deliberately NOT ``OSError`` — the failover handoff
+    leg's ``utils.retry`` wrapper must never absorb a simulated death."""
+
+    def __init__(
+        self,
+        label: str,
+        replica: str = None,
+        action: str = "crash",
+        hits: int = 1,
+        repeat: bool = False,
+        latency_s: float = 0.005,
+        hang_s: float = 0.05,
+    ):
+        if label not in SERVING_CRASH_POINTS:
+            raise ValueError(
+                f"unknown serving crash point {label!r}; choose from {SERVING_CRASH_POINTS}"
+            )
+        if action not in ("crash", "poison", "hang", "latency"):
+            raise ValueError(f"action must be crash|poison|hang|latency, got {action!r}")
+        self.label = label
+        self.replica = replica
+        self.action = action
+        self.hits = max(1, int(hits))
+        self.repeat = bool(repeat)
+        self.latency_s = float(latency_s)
+        self.hang_s = float(hang_s)
+        self.fired = False
+        self.count = 0
+        self._seen = 0
+
+    def _hook(self, label: str, **ctx):
+        if label != self.label:
+            return
+        if self.replica is not None and ctx.get("replica") != self.replica:
+            return
+        self._seen += 1
+        if self._seen < self.hits or (self.fired and not self.repeat):
+            return
+        self.fired = True
+        self.count += 1
+        if self.action == "hang":
+            time.sleep(self.hang_s)
+            return
+        if self.action == "latency":
+            time.sleep(self.latency_s)
+            return
+        where = f"{self.label!r}" + (f" on replica {self.replica!r}" if self.replica else "")
+        if self.action == "poison":
+            from ..serving_fleet import NonFinitePoison
+
+            raise NonFinitePoison(f"simulated non-finite poison at {where}")
+        raise SimulatedCrash(f"simulated replica crash at {where}")
 
     def __enter__(self):
         set_crash_hook(self._hook)

@@ -205,13 +205,14 @@ def fleet_workload(args, vocab, rng):
     return events
 
 
-def make_fleet(model, args, *, replicas, prefix_reuse=True, roles=None, handoff="auto", store_dir=None):
+def make_fleet(model, args, *, replicas, prefix_reuse=True, roles=None, handoff="auto",
+               failover="auto", store_dir=None):
     from accelerate_tpu.serving_fleet import FleetConfig, FleetRouter
 
     return FleetRouter.from_model(
         model, num_replicas=replicas,
         config=FleetConfig(
-            roles=roles, handoff=handoff, prefix_reuse=prefix_reuse,
+            roles=roles, handoff=handoff, prefix_reuse=prefix_reuse, failover=failover,
             min_prefix_tokens=args.buckets[0], promote_after=2, max_prefix_entries=8,
         ),
         store_dir=store_dir,
@@ -457,12 +458,215 @@ def run_fleet(args) -> int:
     return 0 if report["ok"] else 1
 
 
+# ===================================================================== #
+# chaos mode (--chaos): kill a replica mid-flight, hold the fleet exact
+# ===================================================================== #
+
+
+def chaos_drive(router, events):
+    """``fleet_drive`` variant that tolerates requests lost to a replica
+    failure: a ``KeyError`` from ``partial``/``poll`` marks the request
+    lost instead of aborting the harness, so the bench can FAIL the
+    ``zero_lost`` criterion honestly. Returns ``(elapsed_s, ttft_ms by
+    uid, uids, outputs by uid, logprobs by uid, lost uids)``."""
+    t0 = time.monotonic()
+    pending = list(events)
+    waiting, ttft, uids, lost = {}, {}, [], []
+    while pending or router._work_remaining():
+        now = time.monotonic() - t0
+        while pending and pending[0][0] <= now:
+            at, prompt, n_new = pending.pop(0)
+            uid = router.submit(prompt, max_new_tokens=n_new)
+            uids.append(uid)
+            waiting[uid] = at
+        if router._work_remaining():
+            router.step()
+        elif pending:
+            time.sleep(min(0.002, max(0.0, pending[0][0] - (time.monotonic() - t0))))
+        now = time.monotonic() - t0
+        for uid, at in list(waiting.items()):
+            try:
+                streamed = router.partial(uid).size > 0
+            except KeyError:
+                lost.append(uid)
+                del waiting[uid]
+                continue
+            if streamed:
+                ttft[uid] = (now - at) * 1000.0
+                del waiting[uid]
+    elapsed = time.monotonic() - t0
+    outs, lps = {}, {}
+    for u in uids:
+        try:
+            outs[u] = np.asarray(router.poll(u))
+            lps[u] = np.asarray(router.logprobs(u))
+        except KeyError:
+            if u not in lost:
+                lost.append(u)
+    return elapsed, ttft, uids, outs, lps, lost
+
+
+def run_chaos(args) -> int:
+    """The serving chaos benchmark (``--chaos``): crash a replica
+    mid-flight under the open-loop schedule and hold the fleet to
+    token-exact failover. A no-fault control arm and the chaos arm
+    replay the identical arrivals over 3 mixed replicas sharing one
+    executable store; the chaos arm kills ``r1`` at its Nth busy tick
+    (``ReplicaChaos("pre_tick")``), survivors absorb every in-flight
+    request via priced KV handoff (or prefix recompute when no KV was
+    exportable), and ``add_replica()`` then restores capacity from the
+    store with zero XLA compiles. Prints the JSON report; exit code 1
+    unless every criterion holds."""
+    import tempfile
+
+    from accelerate_tpu.test_utils.fault_injection import ReplicaChaos
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(1)
+    model, cfg = fleet_model()
+    vocab = cfg.vocab_size
+    args.buckets = (16, 32)
+    args.decode_budgets = (8, 16, 24)
+    args.preamble_len = args.preamble_len or (48 if args.smoke else 64)
+    args.n_preambles = args.n_preambles or 2
+    args.fleet_clients = args.fleet_clients or (24 if args.smoke else 48)
+    args.fleet_rate = args.fleet_rate or 8.0
+    args.slots = args.slots or 2
+    args.tick_block = args.tick_block or 4
+    crash_tick = 6 if args.smoke else 10
+    rng = np.random.default_rng(args.seed)
+    events = fleet_workload(args, vocab, rng)
+    report = {
+        "bench": "bench_serving --chaos",
+        "clients": args.fleet_clients,
+        "rate_req_per_s": args.fleet_rate,
+        "replicas": 3,
+        "slots_per_replica": args.slots,
+        "buckets": list(args.buckets),
+        "crash": {"replica": "r1", "point": "pre_tick", "busy_tick": crash_tick,
+                  "action": "crash"},
+    }
+
+    def paste_warm(router, wrng):
+        # the handoff-import paste (host-resident arrays) is a distinct
+        # input signature fleet_warmup only covers for disaggregated
+        # fleets; failover ships KV between MIXED replicas, so warm it
+        # everywhere or the first migration compiles on the survivor
+        src = router.replicas[0].engine
+        for i, rep in enumerate(router.replicas):
+            h = src.prefill_detached(
+                wrng.integers(1, vocab - 1, size=args.buckets[0]).astype(np.int32),
+                max_new_tokens=2, uid_key=2**30 + i,
+            )
+            rep.engine.submit_prefilled(dict(h))
+            rep.engine.run()
+
+    def build(store):
+        router = make_fleet(model, args, replicas=3, prefix_reuse=False,
+                            failover="handoff", store_dir=store)
+        fleet_warmup(router, args, vocab, np.random.default_rng(args.seed + 1))
+        paste_warm(router, np.random.default_rng(args.seed + 2))
+        return router, fleet_compiles(router)
+
+    with tempfile.TemporaryDirectory() as store:
+        # -- control arm: identical schedule, no fault ------------------- #
+        control, c0 = build(store)
+        elapsed_c, ttft_c, uids_c, outs_c, lps_c, lost_c = chaos_drive(control, events)
+        ttft_c_list = [ttft_c[u] for u in uids_c if u in ttft_c]
+        merged_c = control.metrics_merged()
+        report["control"] = {
+            "elapsed_s": round(elapsed_c, 2),
+            "ttft_ms_p50": _pct(ttft_c_list, 50),
+            "ttft_ms_p95": _pct(ttft_c_list, 95),
+            "tokens_per_sec": round(merged_c.tokens_generated / elapsed_c, 1),
+            "completed": len(outs_c),
+            "lost": len(lost_c),
+            "post_warmup_compiles": fleet_compiles(control) - c0,
+        }
+
+        # -- chaos arm: crash r1 at its Nth busy tick -------------------- #
+        router, c0 = build(store)
+        with ReplicaChaos("pre_tick", replica="r1", action="crash",
+                          hits=crash_tick) as chaos:
+            elapsed_x, ttft_x, uids_x, outs_x, lps_x, lost_x = chaos_drive(router, events)
+        survivor_compiles = fleet_compiles(router) - c0
+        acct = router.failover_accounting()
+        ttft_x_list = [ttft_x[u] for u in uids_x if u in ttft_x]
+        merged_x = router.metrics_merged()
+        report["chaos"] = {
+            "elapsed_s": round(elapsed_x, 2),
+            "ttft_ms_p50": _pct(ttft_x_list, 50),
+            "ttft_ms_p95": _pct(ttft_x_list, 95),
+            "tokens_per_sec": round(merged_x.tokens_generated / elapsed_x, 1),
+            "completed": len(outs_x),
+            "lost": len(lost_x),
+            "crash_fired": chaos.fired,
+            "post_warmup_compiles_survivors": survivor_compiles,
+            "failover_accounting": acct,
+            "health": {n: {"health": h["health"], "last_error": h["last_error"]}
+                       for n, h in router.health().items()},
+        }
+        exact_tokens = len(outs_x) == len(outs_c) and all(
+            np.array_equal(outs_x[u], outs_c[u]) for u in uids_c if u in outs_c
+        )
+        exact_lps = len(lps_x) == len(lps_c) and all(
+            np.array_equal(lps_x[u], lps_c[u]) for u in uids_c if u in lps_c
+        )
+
+        # -- recovery: hot re-add over the store, then fresh traffic ----- #
+        readd = router.add_replica(warm_prompt_lens=(16, 32, 48, 64, 66))
+        new = router.replicas[-1]
+        m0 = new.engine.program_cache.misses
+        followup = [router.submit(p, max_new_tokens=n) for _, p, n in events[:6]]
+        done = router.run()
+        followup_ok = all(u in done for u in followup)
+        readd["post_traffic_compiles"] = new.engine.program_cache.misses - m0
+        serving = sum(
+            1 for v in router.health().values()
+            if v["health"] in ("healthy", "degraded") and not v["draining"]
+        )
+        readd["serving_replicas"] = serving
+        readd["followup_completed"] = sum(1 for u in followup if u in done)
+        report["readd"] = readd
+
+    # in-process CPU fleet: survivors absorb the dead replica's load on
+    # the same host cores, so the honest claim is BOUNDED p95 TTFT
+    # degradation under the fault, not zero impact; the report names the
+    # core count the bound was enforced on.
+    report["host_cpu_count"] = os.cpu_count() or 1
+    ttft_bound = 10.0 * report["control"]["ttft_ms_p95"] + 250.0
+    criteria = {
+        "chaos_completion_100": report["chaos"]["completed"] == len(events)
+        and not lost_x,
+        "zero_lost": acct["failovers_lost"] == 0 and not lost_x and not lost_c,
+        "failover_exercised": chaos.fired and acct["failovers"] >= 1,
+        "failover_kv_exercised": acct["failovers_kv"] >= 1,
+        "accounting_pinned": acct["bytes_predicted"] == acct["bytes_moved"]
+        and acct["bytes_moved"] > 0,
+        "token_exact_vs_control": exact_tokens,
+        "logprob_exact_vs_control": exact_lps,
+        "survivor_zero_new_compiles": survivor_compiles == 0,
+        "ttft_p95_bounded (single-host)": report["chaos"]["ttft_ms_p95"] <= ttft_bound,
+        "readd_zero_compiles": readd["compiles"] == 0 and readd["deserialized"] > 0
+        and readd["post_traffic_compiles"] == 0,
+        "capacity_recovered": serving == 3 and followup_ok,
+    }
+    report["ttft_p95_bound_ms"] = round(ttft_bound, 3)
+    report["criteria"] = criteria
+    report["ok"] = all(criteria.values())
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true", help="CPU CI mode: tiny model, bounded load")
     ap.add_argument("--fleet", action="store_true",
                     help="fleet mode: multi-replica router benchmark (reuse A/B, "
                          "scaling, spin-up, handoff accounting)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos mode: crash a replica mid-flight and hold the fleet to "
+                         "token-exact failover + zero-compile capacity recovery")
     ap.add_argument("--preamble-len", dest="preamble_len", type=int, default=None)
     ap.add_argument("--n-preambles", dest="n_preambles", type=int, default=None)
     ap.add_argument("--fleet-clients", dest="fleet_clients", type=int, default=None)
@@ -485,6 +689,8 @@ def main(argv=None):
     ap.add_argument("--schedulers", default="fifo,continuous")
     args = ap.parse_args(argv)
 
+    if args.chaos:
+        raise SystemExit(run_chaos(args))
     if args.fleet:
         raise SystemExit(run_fleet(args))
 

@@ -13,7 +13,14 @@ from accelerate_tpu.generation import generate
 from accelerate_tpu.models import LlamaConfig, create_llama_model
 from accelerate_tpu.scheduling import FleetRoutingPolicy, RoutingConfig, ShedError
 from accelerate_tpu.serving import ServingEngine
-from accelerate_tpu.serving_fleet import FleetConfig, FleetRouter, RadixPrefixCache
+from accelerate_tpu.serving_fleet import (
+    FleetConfig,
+    FleetRequestError,
+    FleetRouter,
+    HandoffCodec,
+    RadixPrefixCache,
+)
+from accelerate_tpu.test_utils.fault_injection import ReplicaChaos, SimulatedCrash
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -468,6 +475,413 @@ def test_fleet_spin_up_warm_starts_from_shared_store(tiny_llama, tmp_path):
     u = fr.submit(p, max_new_tokens=3)
     out = fr.run()
     np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
+
+
+# --------------------------------------------------------------------- #
+# fault tolerance: health machine, token-exact failover, chaos matrix
+# --------------------------------------------------------------------- #
+
+_FT_PROMPTS = [(np.arange(1, 6 + i) % 250).astype(np.int32) for i in range(6)]
+_FT_NEW = 4
+
+
+def _ft_fleet(model, *, failover="auto", tick_block=8, **cfg_kw):
+    cfg_kw.setdefault("prefix_reuse", False)
+    return FleetRouter.from_model(
+        model, num_replicas=2, config=FleetConfig(failover=failover, **cfg_kw),
+        num_slots=2, prompt_buckets=(4, 8), tick_block=tick_block,
+    )
+
+
+@pytest.fixture(scope="module")
+def ft_control(tiny_llama):
+    """No-fault control run of the chaos workload: per-submission-index
+    full token streams and logprobs every chaos arm must reproduce."""
+    fr = _ft_fleet(tiny_llama)
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS]
+    out = fr.run()
+    ctl = [(np.asarray(out[u]), np.asarray(fr.logprobs(u))) for u in uids]
+    import jax
+
+    jax.clear_caches()
+    return ctl
+
+
+@pytest.mark.parametrize("failover", ["recompute", "handoff"])
+@pytest.mark.parametrize("label", ["pre_tick", "mid_prefill", "mid_decode"])
+def test_chaos_crash_matrix_token_and_logprob_exact(tiny_llama, ft_control, label, failover):
+    """The crash-at-every-point failover matrix: kill replica r0 at each
+    labeled serving point with requests queued, mid-prefill, and
+    mid-decode; every in-flight request must complete on the survivor
+    token- AND logprob-exact vs the no-fault control, zero lost, zero
+    duplicated — whichever migration path the router is pinned to."""
+    fr = _ft_fleet(tiny_llama, failover=failover)
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS]
+    fr.step()  # some requests decoding on r0, one still queued
+    with ReplicaChaos(label, replica="r0", action="crash") as chaos:
+        out = fr.run()
+    assert chaos.fired
+    assert fr.health()["r0"]["health"] == "dead"
+    assert sorted(out) == sorted(uids)  # all complete, none duplicated
+    for u, (ref_toks, ref_lps) in zip(uids, ft_control):
+        np.testing.assert_array_equal(out[u], ref_toks)
+        np.testing.assert_array_equal(fr.logprobs(u), ref_lps)
+    acct = fr.failover_accounting()
+    assert acct["failovers"] >= 1 and acct["failovers_lost"] == 0
+    if failover == "recompute":
+        assert acct["failovers_kv"] == 0
+
+
+def test_chaos_pre_handoff_disaggregated_fails_over(tiny_llama):
+    """Killing the prefill replica at the pre_handoff dispatch point must
+    not lose the pending requests: the dispatcher requeues them, marks
+    the prefill replica dead, and the decode replica self-prefills with
+    the same uid_key — token-exact."""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(roles=("prefill", "decode"), handoff="always", prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    prompts = [(np.arange(1, 8 + i) % 250).astype(np.int32) for i in range(3)]
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in prompts]
+    with ReplicaChaos("pre_handoff", replica="r0", action="crash") as chaos:
+        out = fr.run()
+    assert chaos.fired
+    assert fr.health()["r0"]["health"] == "dead"
+    assert fr.failover_accounting()["failovers_lost"] == 0
+    for u, p in zip(uids, prompts):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, _FT_NEW))
+
+
+def test_chaos_poison_quarantines_and_never_ships_kv(tiny_llama, ft_control):
+    """A non-finite watchdog trip quarantines (numerics suspect, the
+    replica itself may be fine) and fails over by recompute ONLY — the
+    poisoned KV must never be pasted into a survivor."""
+    fr = _ft_fleet(tiny_llama, failover="auto")
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS]
+    fr.step()
+    with ReplicaChaos("mid_decode", replica="r0", action="poison") as chaos:
+        out = fr.run()
+    assert chaos.fired
+    h = fr.health()["r0"]
+    assert h["health"] == "quarantined" and "NonFinitePoison" in h["last_error"]
+    acct = fr.failover_accounting()
+    assert acct["failovers"] >= 1 and acct["failovers_kv"] == 0
+    assert acct["failovers_lost"] == 0 and acct["bytes_moved"] == 0
+    for u, (ref_toks, ref_lps) in zip(uids, ft_control):
+        np.testing.assert_array_equal(out[u], ref_toks)
+        np.testing.assert_array_equal(fr.logprobs(u), ref_lps)
+
+
+@pytest.mark.parametrize("failover", ["recompute", "handoff"])
+def test_chaos_sampled_failover_exact(tiny_llama, failover):
+    """temperature>0: the exported key_data pins each request's sampling
+    chain, so a failed-over sampled stream equals the no-fault control —
+    over the KV-paste path AND the full recompute path."""
+    prompts = [(np.arange(1, 7 + i) % 250).astype(np.int32) for i in range(4)]
+
+    def build():
+        return FleetRouter.from_model(
+            tiny_llama, num_replicas=2,
+            config=FleetConfig(prefix_reuse=False, failover=failover),
+            num_slots=2, prompt_buckets=(4, 8), tick_block=2, temperature=0.9, seed=7,
+        )
+
+    ctl = build()
+    cu = [ctl.submit(p, max_new_tokens=_FT_NEW) for p in prompts]
+    ctl_out = ctl.run()
+    fr = build()
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in prompts]
+    fr.step()
+    with ReplicaChaos("pre_tick", replica="r0", action="crash") as chaos:
+        out = fr.run()
+    assert chaos.fired and fr.failover_accounting()["failovers"] >= 1
+    for u, c in zip(uids, cu):
+        np.testing.assert_array_equal(out[u], ctl_out[c])
+        np.testing.assert_array_equal(fr.logprobs(u), ctl.logprobs(c))
+
+
+def test_chaos_survivor_serves_with_zero_new_compiles(tiny_llama):
+    """The recompile-watchdog discipline survives a replica death: after
+    warming fused buckets, chunk windows, and the decode tick on the
+    survivor, absorbing r0's failed-over load compiles NOTHING new."""
+    fr = _ft_fleet(tiny_llama, failover="handoff")
+    rng = np.random.default_rng(3)
+    for rep in fr.replicas:  # warm both so pre-crash traffic is covered too
+        for n in (4, 8, 10, 13):
+            rep.engine.submit(rng.integers(1, 250, size=n).astype(np.int32), max_new_tokens=2)
+        rep.engine.run()
+        # the KV paste sees host-resident arrays — a distinct signature
+        h = fr.replicas[0].engine.prefill_detached(
+            rng.integers(1, 250, size=4).astype(np.int32), max_new_tokens=2, uid_key=2**30
+        )
+        rep.engine.submit_prefilled(dict(h))
+        rep.engine.run()
+    survivor = fr.replicas[1].engine
+    c0 = survivor.program_cache.misses
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS]
+    fr.step()
+    with ReplicaChaos("pre_tick", replica="r0", action="crash"):
+        out = fr.run()
+    assert sorted(out) == sorted(uids)
+    assert fr.failover_accounting()["failovers"] >= 1
+    assert survivor.program_cache.misses - c0 == 0, "failover absorption must not compile"
+
+
+def test_failover_priced_before_it_happens_and_pinned(tiny_llama):
+    """The router prices every KV failover with the costmodel BEFORE
+    moving bytes; the accounting pins prediction == actual bytes moved
+    (and carries the recompute alternative it was judged against)."""
+    fr = _ft_fleet(tiny_llama, failover="handoff", tick_block=2)
+    uids = [fr.submit(p, max_new_tokens=6) for p in _FT_PROMPTS[:4]]
+    fr.step()  # decode phase on both replicas -> exports carry KV rows
+    with ReplicaChaos("pre_tick", replica="r0", action="crash"):
+        out = fr.run()
+    assert sorted(out) == sorted(uids)
+    acct = fr.failover_accounting()
+    assert acct["failovers_kv"] >= 1
+    assert acct["bytes_predicted"] == acct["bytes_moved"] > 0
+    assert acct["time_us_predicted"] > 0
+
+
+def test_price_failover_costmodel():
+    from accelerate_tpu.analysis.costmodel import price_failover
+
+    p = price_failover(4096, 512, 100, 7_000_000_000)
+    assert p["rows"] == 611 and p["handoff"]["bytes"] >= 4096 * 611
+    assert p["path"] in ("handoff", "recompute")
+    # KV not exportable (paged / speculative / poisoned) -> recompute,
+    # even when the wire would have been cheaper
+    assert price_failover(4096, 512, 100, 7_000_000_000, kv_exportable=False)["path"] == "recompute"
+    # a zero-generated failover still re-prefills the full prompt
+    assert price_failover(4096, 16, 0, 7_000_000_000)["rows"] == 16
+
+
+def test_hang_degrades_then_quarantines_and_heals(tiny_llama):
+    """Tick-timeout state machine: one slow tick degrades, consecutive
+    slow ticks quarantine (work migrates with KV intact — the tick
+    finished, just late); a degraded replica heals after clean ticks."""
+    fr = _ft_fleet(tiny_llama, tick_block=2, quarantine_after_timeouts=2, heal_after_ticks=3)
+    rng = np.random.default_rng(11)
+    for rep in fr.replicas:  # every program compiles OUTSIDE the timeout window
+        for n in (4, 8, 10, 13):
+            rep.engine.submit(rng.integers(1, 250, size=n).astype(np.int32), max_new_tokens=2)
+        rep.engine.run()
+    uids = [fr.submit(p, max_new_tokens=8) for p in _FT_PROMPTS[:4]]
+    fr.step()
+    fr.config.tick_timeout_s = 0.05
+    with ReplicaChaos("pre_tick", replica="r0", action="hang", hang_s=0.2, repeat=True):
+        fr.step()
+        assert fr.health()["r0"]["health"] == "degraded"
+        out = fr.run()  # second slow tick -> quarantined, work migrates
+    assert fr.health()["r0"]["health"] == "quarantined"
+    assert sorted(out) == sorted(uids)
+    assert fr.failover_accounting()["failovers_lost"] == 0
+    for u, p in zip(uids, _FT_PROMPTS):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 8))
+    # heal: a single hiccup degrades, then clean BUSY ticks restore healthy
+    fr2 = FleetRouter.from_model(
+        tiny_llama, num_replicas=2,
+        config=FleetConfig(prefix_reuse=False, heal_after_ticks=2),
+        num_slots=2, prompt_buckets=(4, 8), tick_block=2,
+    )
+    warm = fr2.replicas[0].engine
+    warm.submit((np.arange(1, 5) % 250).astype(np.int32), max_new_tokens=4)
+    warm.run()  # prefill + decode programs compiled OUTSIDE the window
+    fr2.submit((np.arange(1, 5) % 250).astype(np.int32), max_new_tokens=10)
+    fr2.step()
+    fr2.config.tick_timeout_s = 0.05
+    with ReplicaChaos("pre_tick", replica="r0", action="hang", hang_s=0.2):
+        fr2.step()
+    assert fr2.health()["r0"]["health"] == "degraded"
+    fr2.step()  # tick_block=2: plenty of clean busy ticks left
+    fr2.step()
+    assert fr2.health()["r0"]["health"] == "healthy"
+
+
+def test_drain_under_load_and_unique_respawn_names(tiny_llama):
+    """drain() migrates every in-flight request and removes the replica
+    without losing a token; a later add_replica must never reuse a
+    retired name."""
+    fr = _ft_fleet(tiny_llama)
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS[:4]]
+    fr.step()
+    res = fr.drain("r0")
+    assert res["replica"] == "r0" and res["lost"] == 0
+    assert [r.name for r in fr.replicas] == ["r1"]
+    out = fr.run()
+    assert sorted(out) == sorted(uids)
+    for u, p in zip(uids, _FT_PROMPTS):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, _FT_NEW))
+    info = fr.add_replica(warm_prompt_lens=(4,))
+    names = [r.name for r in fr.replicas]
+    assert names == ["r1", "r2"], "retired names must never be reused"
+    assert info["replica"] == "r2"
+    u = fr.submit(_FT_PROMPTS[0], max_new_tokens=2)
+    assert u in fr.run()
+    fr.drain("r1")
+    with pytest.raises(ValueError, match="no other serving replica"):
+        fr.drain("r2")
+
+
+def test_capacity_lost_sheds_until_add_replica(tiny_llama):
+    """Killing the last serving replica sheds new submissions at the
+    fleet edge with a structured ShedError; add_replica restores
+    admission (the zero-compile spin-up path) and the fleet serves
+    again."""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=1, config=FleetConfig(prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    u_doomed = fr.submit(_FT_PROMPTS[0], max_new_tokens=2)
+    fr.fail_replica("r0")
+    assert fr.health()["r0"]["health"] == "dead"
+    # nowhere to migrate: the in-flight request is honestly LOST
+    assert fr.failover_accounting()["failovers_lost"] == 1
+    with pytest.raises(KeyError, match="lost"):
+        fr.poll(u_doomed)
+    with pytest.raises(ShedError, match="capacity lost"):
+        fr.submit(_FT_PROMPTS[1], max_new_tokens=2)
+    fr.add_replica(warm_prompt_lens=(4,))
+    p = (np.arange(1, 6) % 250).astype(np.int32)
+    u = fr.submit(p, max_new_tokens=3)
+    out = fr.run()
+    np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
+
+
+def test_fleet_request_error_surfaces(tiny_llama, monkeypatch):
+    """poll/partial/logprobs/cancel on unknown or failed-over ids raise
+    the structured error naming the last known state; cancel on a dead
+    replica succeeds WITHOUT touching the dead engine."""
+    fr = _ft_fleet(tiny_llama)
+    with pytest.raises(FleetRequestError, match="unknown request id"):
+        fr.poll(12345)
+    with pytest.raises(KeyError):  # it is still a KeyError for old callers
+        fr.logprobs(12345)
+    # lost: export dies with the replica -> nothing to salvage
+    u1 = fr.submit(_FT_PROMPTS[0], max_new_tokens=_FT_NEW)
+    monkeypatch.setattr(
+        fr.replicas[0].engine, "export_inflight",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError("export channel down")),
+    )
+    fr.fail_replica("r0", error=RuntimeError("host unreachable"))
+    with pytest.raises(FleetRequestError, match="no snapshot recovered"):
+        fr.partial(u1)
+    got = fr.cancel(u1)  # cancelling a lost request succeeds, once
+    assert isinstance(got, np.ndarray) and got.size == 0
+    with pytest.raises(FleetRequestError, match="unknown request id"):
+        fr.cancel(u1)
+    # stranded on a dead replica (white-box: dodge the auto-migration)
+    fr2 = _ft_fleet(tiny_llama)
+    u2 = fr2.submit(_FT_PROMPTS[0], max_new_tokens=_FT_NEW)
+    fr2.step()
+    fr2.replicas[0].health = "dead"
+    fr2.replicas[0].last_error = "RuntimeError: kernel panic"
+    with pytest.raises(FleetRequestError, match="dead replica 'r0'"):
+        fr2.poll(u2)
+    called = []
+    monkeypatch.setattr(fr2.replicas[0].engine, "cancel",
+                        lambda uid: called.append(uid))
+    got2 = fr2.cancel(u2)
+    assert got2.size == 0 and called == [], "must not touch the dead engine"
+    # done requests refuse cancel with a pointer to poll()
+    fr3 = _ft_fleet(tiny_llama)
+    u3 = fr3.submit(_FT_PROMPTS[0], max_new_tokens=2)
+    fr3.run()
+    fr3.drain("r0") if fr3._map[u3][1] == 0 else fr3.drain("r1")
+    with pytest.raises(ValueError, match="poll"):
+        fr3.cancel(u3)
+
+
+def test_handoff_codec_roundtrip_exact(tiny_llama):
+    """The wire codec: a prefill_detached payload serializes to ONE bytes
+    blob and back (dtype-agnostic — the receiving engine's row template
+    is the source of truth) with the decoded handoff token- and
+    logprob-exact, greedy and sampled."""
+    prompt = (np.arange(1, 10) % 250).astype(np.int32)
+    for kw in ({}, {"temperature": 0.9, "seed": 5}):
+        src = _engine(tiny_llama, **kw)
+        local = _engine(tiny_llama, **kw)
+        lu = local.submit(prompt, max_new_tokens=5)
+        local.run()
+        h = src.prefill_detached(prompt, max_new_tokens=5, uid_key=lu)
+        blob = HandoffCodec.encode(h)
+        assert isinstance(blob, bytes) and len(blob) >= h["wire_bytes"]
+        dst = _engine(tiny_llama, **kw)
+        h2 = HandoffCodec.decode(blob, dst)
+        assert h2["total"] == h["total"] and h2["wire_bytes"] == h["wire_bytes"]
+        uid = dst.submit_prefilled(h2)
+        dst.run()
+        np.testing.assert_array_equal(dst.poll(uid), local.poll(lu))
+        np.testing.assert_array_equal(dst.logprobs(uid), local.logprobs(lu))
+
+
+def test_drain_threaded_surfaces_and_survives_worker_crash(tiny_llama):
+    """drain_threaded must never hang on a worker death: with a survivor
+    the fleet completes via failover (the fault surfaces through health
+    + metrics); with NO survivor the first captured exception is
+    re-raised on the caller's thread after join."""
+    fr = _ft_fleet(tiny_llama)
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS[:4]]
+    with ReplicaChaos("pre_tick", replica="r0", action="crash") as chaos:
+        fr.drain_threaded()
+    assert chaos.fired
+    assert fr.health()["r0"]["health"] == "dead"
+    for u, p in zip(uids, _FT_PROMPTS):
+        np.testing.assert_array_equal(fr.poll(u), _reference(tiny_llama, p, _FT_NEW))
+    solo = FleetRouter.from_model(
+        tiny_llama, num_replicas=1, config=FleetConfig(prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    solo.submit(_FT_PROMPTS[0], max_new_tokens=2)
+    with ReplicaChaos("pre_tick", replica="r0", action="crash"):
+        with pytest.raises(SimulatedCrash):
+            solo.drain_threaded()
+
+
+def test_failover_metrics_and_prometheus(tiny_llama):
+    fr = _ft_fleet(tiny_llama, tick_block=2)
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS[:4]]
+    fr.step()
+    with ReplicaChaos("pre_tick", replica="r0", action="crash"):
+        out = fr.run()
+    assert sorted(out) == sorted(uids)
+    m = fr.metrics_merged()
+    snap = m.snapshot()
+    assert snap["failovers_out"] >= 1 and snap["failovers_in"] >= 1
+    assert snap["failovers_lost"] == 0 and snap["replica_errors"] == 1
+    assert snap["replica_state"] == 3  # merged gauge: worst replica (dead)
+    text = m.prometheus_text()
+    for needle in ("failovers_in_total", "failovers_out_total", "failovers_lost_total",
+                   "replica_errors_total", 'replica_state{replica="fleet"} 3'):
+        assert needle in text, needle
+
+
+def test_failover_handoff_leg_retries_transient_io(tiny_llama, monkeypatch):
+    """The KV import leg rides utils.retry: one transient OSError on the
+    destination must not lose the request or downgrade it to recompute."""
+    fr = _ft_fleet(tiny_llama, failover="handoff", tick_block=2, failover_retry_base_delay_s=0.001)
+    uids = [fr.submit(p, max_new_tokens=6) for p in _FT_PROMPTS[:4]]
+    fr.step()
+    dst = fr.replicas[1].engine
+    real = dst.import_inflight
+    flaky = {"left": 1}
+
+    def import_flaky(snap):
+        if snap.get("cache") is not None and flaky["left"]:
+            flaky["left"] -= 1
+            raise OSError("transient transport failure")
+        return real(snap)
+
+    monkeypatch.setattr(dst, "import_inflight", import_flaky)
+    with ReplicaChaos("pre_tick", replica="r0", action="crash"):
+        out = fr.run()
+    assert sorted(out) == sorted(uids)
+    assert flaky["left"] == 0  # the fault actually fired
+    acct = fr.failover_accounting()
+    assert acct["failovers_kv"] >= 1 and acct["failovers_lost"] == 0
+    for u, p in zip(uids, _FT_PROMPTS):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 6))
 
 
 # --------------------------------------------------------------------- #
