@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check tune-selfcheck tune-bench telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -41,6 +41,7 @@ lint:
 	$(MAKE) --no-print-directory perf-check
 	$(MAKE) --no-print-directory numerics-check
 	$(MAKE) --no-print-directory tune-selfcheck
+	$(MAKE) --no-print-directory pipe-check
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
@@ -63,7 +64,9 @@ lint-sarif:
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --format sarif > .cache/lint.sarif
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --format sarif > .cache/divergence.sarif
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check accelerate_tpu --format sarif > .cache/numerics.sarif
-	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif -o lint-merged.sarif
+	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli pipe-check \
+		examples/by_feature/pipe_check.py::train_step --mesh pipe=4,data=2 --format sarif > .cache/pipe.sarif
+	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif -o lint-merged.sarif
 
 # Static perf tier: prove TPU501-505 fire on their seeded defects, each
 # clean twin stays silent, and the roofline math matches the hand-computed
@@ -109,6 +112,26 @@ tune-selfcheck:
 # report.ok.
 tune-bench:
 	env JAX_PLATFORMS=cpu python benchmarks/bench_tune.py --smoke
+
+# Pipeline tier (pipemodel): prove TPU801-805 fire on their seeded
+# schedule defects, every clean twin stays silent, and the bubble /
+# roofline arithmetic matches the hand-computed reference exactly — then
+# analyze the example's real pipeline_apply step on a fake 8-device CPU
+# mesh (pipe=4 x data=2). The gate is STRICT for TPU804 (a collective
+# over the pipe axis inside the tick body deadlocks or serializes the
+# MPMD schedule) via its error severity; TPU801-803/805 warnings report
+# but pass.
+pipe-check:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli pipe-check --selfcheck \
+		examples/by_feature/pipe_check.py::train_step --mesh pipe=4,data=2
+
+# Pipeline analyzer A/B on CPU (committed evidence: BENCH_PIPE.json):
+# pipemodel's bubble-adjusted prediction vs StepTelemetry-measured step
+# time across num_microbatches x stage counts on a real pipeline_apply
+# workload: the predicted-best schedule must be the measured-best, with
+# zero post-warmup recompiles. Exits nonzero unless report.ok.
+pipeline-bench:
+	env JAX_PLATFORMS=cpu python benchmarks/bench_pipeline.py --smoke
 
 # SPMD flight-check: prove TPU301/302/303 fire on their seeded defects,
 # then report the example step (peak HBM + collective traffic) on a fake

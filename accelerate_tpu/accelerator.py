@@ -979,6 +979,74 @@ class Accelerator:
             self._telemetry.set_static_step_estimate(report.predicted_step_ms)
         return report
 
+    def pipe_check(
+        self,
+        target,
+        *sample_args,
+        num_microbatches: Optional[int] = None,
+        axis_name: str = "pipe",
+        interleave: int = 1,
+        remat: bool = False,
+        stage_layers=None,
+        dcn=None,
+        generation: Optional[str] = None,
+        hbm_gb: Optional[float] = None,
+        ignore=(),
+    ):
+        """Static pipeline-schedule analysis of ``target`` *before*
+        paying a multi-chip compile: per-stage rooflines and remat-aware
+        peak HBM, bubble fraction vs the ideal ``(S-1)/(M+S-1)``,
+        exposed-vs-hidden handoff time under ``interleave``, and the
+        bubble-adjusted predicted step time ``(M+S-1) x max-stage tick``,
+        plus the TPU8xx schedule rules (pipeline cut on the fast link
+        while DCN exists, stage imbalance, bubble over threshold with
+        the covering ``num_microbatches`` priced, collectives over the
+        pipe axis inside the tick body — error severity — and per-stage
+        activations over the HBM budget).
+
+        ``target`` is a step function whose trace contains the
+        ``parallel.pipeline`` schedule (analyzed against this
+        accelerator's mesh), a
+        :class:`~accelerate_tpu.analysis.PipelineSpec`, or a
+        :class:`~accelerate_tpu.parallel.pipeline.PipelinedModel` (plus
+        its sample inputs) — specs and models carry their own mesh.
+        Returns a :class:`~accelerate_tpu.analysis.PipeReport`
+        (``.render_text()`` / ``.as_dict()``). Error-severity findings
+        are logged. When telemetry is live, the bubble-adjusted
+        prediction seeds the runtime ``perf_model_drift`` cross-check,
+        same as :meth:`perf_check`. See
+        ``docs/usage_guides/pipeline.md`` and
+        ``docs/usage_guides/static_analysis.md``.
+        """
+        from .analysis import render_text
+        from .analysis.pipemodel import PipelineSpec, pipe_check as _pipe_check
+        from .parallel.pipeline import PipelinedModel
+
+        report = _pipe_check(
+            target,
+            *sample_args,
+            mesh=None if isinstance(target, (PipelineSpec, PipelinedModel)) else self.mesh,
+            num_microbatches=num_microbatches,
+            axis_name=axis_name,
+            interleave=interleave,
+            remat=remat,
+            stage_layers=stage_layers,
+            dcn=dcn,
+            generation=generation,
+            hbm_gb=hbm_gb,
+            ignore=ignore,
+        )
+        if not report.ok:
+            logger.warning(
+                "pipe-check found issues in %s:\n%s",
+                report.fn_name,
+                render_text(report.findings),
+            )
+        if self._telemetry is not None and report.predicted_step_ms > 0:
+            # the bubble-adjusted prediction seeds the drift watchdog
+            self._telemetry.set_static_step_estimate(report.predicted_step_ms)
+        return report
+
     def numerics_check(
         self,
         step_fn: Callable,

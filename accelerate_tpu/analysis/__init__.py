@@ -52,13 +52,27 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   strict gate), unguarded div/log/rsqrt over zero, weight updates below
   the param ulp, PRNG key reuse, compressed collectives without error
   feedback.
+* **pipe tier** (``pipe_check``) — the pipeline-schedule analyzer
+  (``pipemodel``): recognise the GPipe region (shard_map-over-``pipe``
+  + scan-of-ticks + ``ppermute``, or a declared
+  :class:`PipelineSpec`/``PipelinedModel``), price each stage's
+  sub-program on its own roofline with a remat-aware per-stage peak-HBM
+  walk, and predict bubble fraction, exposed-vs-hidden handoff time
+  (the ``interleave`` overlap model) and the bubble-adjusted step time
+  ``(M+S-1) x max-stage tick``; the TPU8xx rules (``pipe_rules``):
+  pipeline cut on the fast link while DCN exists, stage imbalance,
+  bubble over threshold with the covering ``num_microbatches`` priced,
+  stage-synchronous collectives inside the tick body (error — the
+  strict gate), per-stage activations over the HBM budget.
 
 Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
 ``accelerate-tpu divergence`` / ``accelerate-tpu perf-check`` /
-``accelerate-tpu numerics-check`` / ``accelerate-tpu tune`` (commands/)
+``accelerate-tpu numerics-check`` / ``accelerate-tpu tune`` /
+``accelerate-tpu pipe-check`` (commands/)
 and ``Accelerator.lint`` / ``Accelerator.flight_check`` /
 ``Accelerator.perf_check`` / ``Accelerator.numerics_check`` /
-``Accelerator.tune``. Suppress a finding inline with
+``Accelerator.tune`` / ``Accelerator.pipe_check``. Suppress a finding
+inline with
 ``# tpu-lint: disable=TPU201``, or project-wide via ``.tpulint.toml``
 (``project_config``).
 """
@@ -72,6 +86,8 @@ from .numerics import AbsVal, Interval, NumericsInterpreter, NumericsReport, num
 from .numerics_rules import COMPRESSION_NUMERICS, check_key_reuse_source, check_numerics_rules
 from .perf_rules import check_perf_rules
 from .perfmodel import OpRecord, PerfReport, perf_check, walk_ops
+from .pipe_rules import check_pipe_rules
+from .pipemodel import PipeReport, PipelineSpec, StageProfile, analyze_pipeline, from_pipelined_model, pipe_check
 from .project_config import ProjectConfig, find_project_config, load_project_config
 from .ranksim import ACCELERATOR_EFFECTS, COLLECTIVE_EFFECTS, ModuleSimulator
 from .report import exit_code, format_finding, render_json, render_sarif, render_sarif_run, render_text
@@ -89,6 +105,7 @@ from .selfcheck import (
     run_divergence_selfcheck,
     run_numerics_selfcheck,
     run_perf_selfcheck,
+    run_pipe_selfcheck,
     run_selfcheck,
     run_tune_selfcheck,
 )
@@ -133,6 +150,14 @@ __all__ = [
     "run_perf_selfcheck",
     "run_numerics_selfcheck",
     "run_tune_selfcheck",
+    "run_pipe_selfcheck",
+    "pipe_check",
+    "analyze_pipeline",
+    "from_pipelined_model",
+    "check_pipe_rules",
+    "PipeReport",
+    "PipelineSpec",
+    "StageProfile",
     "ConfigPoint",
     "SearchSpace",
     "default_space",

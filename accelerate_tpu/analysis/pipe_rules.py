@@ -1,0 +1,195 @@
+"""TPU8xx pipeline-schedule rules over a
+:class:`~accelerate_tpu.analysis.pipemodel.PipeReport`.
+
+All host-side arithmetic over the priced report — no tracing happens
+here. The catalogue:
+
+* **TPU801** — the pipeline cut sits on the fast (ICI) link while the
+  mesh has a DCN axis. Pipeline handoff traffic is tiny (one activation
+  per tick) and point-to-point, so it is the one parallelism that
+  belongs on the slow link; the finding prices the re-placement delta
+  from the costmodel transport tables.
+* **TPU802** — per-stage roofline spread: the slowest stage paces every
+  tick, so imbalance inflates the bubble beyond the ideal
+  ``(S-1)/(M+S-1)``. Worst stage named, inflation priced.
+* **TPU803** — bubble fraction above threshold; names the covering
+  ``num_microbatches`` (the smallest M with ideal bubble under the
+  threshold) and prices the predicted step-time saving.
+* **TPU804** [ERROR] — a non-ppermute collective over the ``pipe`` axis
+  inside the tick body. Stages run *different* microbatches at a tick
+  (MPMD): a psum/all_gather over ``pipe`` either deadlocks under
+  divergent control flow or serializes the whole schedule. Strict gate.
+* **TPU805** — per-stage live activations exceed the HBM budget with
+  remat off; prices the saving from checkpointing the stage boundary
+  only.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+from .rules import Finding
+
+__all__ = [
+    "PIPE_BUBBLE_THRESHOLD",
+    "PIPE_IMBALANCE_THRESHOLD",
+    "check_pipe_placement",
+    "check_stage_imbalance",
+    "check_bubble_fraction",
+    "check_tick_collectives",
+    "check_stage_hbm",
+    "check_pipe_rules",
+]
+
+#: TPU803 fires when the (actual) bubble fraction exceeds this.
+PIPE_BUBBLE_THRESHOLD = 0.25
+
+#: TPU802 fires when max/min per-stage tick compute exceeds this ratio.
+PIPE_IMBALANCE_THRESHOLD = 1.2
+
+
+def _human(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"
+
+
+def check_pipe_placement(report, mesh, dcn: Optional[Sequence[str]]) -> list[Finding]:
+    """TPU801: a DCN axis exists but the pipeline cut is on ICI."""
+    from .costmodel import BANDWIDTH_TABLE
+
+    if not dcn or report.transport != "ici":
+        return []
+    dcn_present = any(
+        a != report.axis_name and int(report.mesh_axes.get(a, 1)) > 1 for a in dcn
+    )
+    if not dcn_present:
+        return []
+    row = BANDWIDTH_TABLE.get(report.generation, BANDWIDTH_TABLE["v5e"])
+    wire = report.permute_wire_bytes_per_step
+    delta_us = wire / row["dcn"] * 1e6 - wire / row["ici"] * 1e6
+    return [
+        Finding(
+            "TPU801",
+            f"pipeline axis {report.axis_name!r} is on the fast ICI link while DCN "
+            f"axes {sorted(set(dcn) - {report.axis_name})} exist — the pipeline's "
+            f"point-to-point handoffs ({_human(wire)}/step) are the traffic that "
+            f"belongs on the slow link; re-placing {report.axis_name!r} on DCN "
+            f"costs +{delta_us:.1f}us/step and frees ICI for the dense collectives",
+        )
+    ]
+
+
+def check_stage_imbalance(report, threshold: float = PIPE_IMBALANCE_THRESHOLD) -> list[Finding]:
+    """TPU802: per-stage roofline spread inflating the bubble."""
+    computes = [s.compute_us for s in report.stages]
+    if len(computes) < 2:
+        return []
+    lo, hi = min(computes), max(computes)
+    if lo <= 0 or hi / lo <= threshold:
+        return []
+    worst = max(report.stages, key=lambda s: s.compute_us)
+    inflation = report.bubble_fraction - report.ideal_bubble_fraction
+    return [
+        Finding(
+            "TPU802",
+            f"stage {worst.index} ({worst.layers} layer(s), {worst.compute_us:.1f}us/tick) "
+            f"is {hi / lo:.2f}x the fastest stage ({lo:.1f}us) — every tick is paced by it, "
+            f"inflating the bubble to {report.bubble_fraction:.3f} vs the ideal "
+            f"{report.ideal_bubble_fraction:.3f} (+{inflation:.3f}); rebalance the layer cut",
+        )
+    ]
+
+
+def covering_microbatches(n_stages: int, threshold: float = PIPE_BUBBLE_THRESHOLD) -> int:
+    """Smallest M whose IDEAL bubble ``(S-1)/(M+S-1)`` is <= threshold."""
+    if n_stages <= 1:
+        return 1
+    return max(1, math.ceil((n_stages - 1) * (1.0 - threshold) / threshold))
+
+
+def check_bubble_fraction(report, threshold: float = PIPE_BUBBLE_THRESHOLD) -> list[Finding]:
+    """TPU803: bubble over threshold, covering M named and priced."""
+    bubble = report.bubble_fraction
+    if bubble <= threshold:
+        return []
+    m_cover = covering_microbatches(report.n_stages, threshold)
+    saving_us = report.predicted_step_us - report.predict_step_us_at(m_cover)
+    return [
+        Finding(
+            "TPU803",
+            f"bubble fraction {bubble:.3f} exceeds {threshold:.2f} at "
+            f"num_microbatches={report.num_microbatches} (S={report.n_stages}); "
+            f"num_microbatches={m_cover} covers it (ideal bubble "
+            f"{(report.n_stages - 1) / (m_cover + report.n_stages - 1):.3f}), "
+            f"predicted step-time saving {saving_us:.1f}us",
+        )
+    ]
+
+
+def check_tick_collectives(report) -> list[Finding]:
+    """TPU804 [ERROR]: non-ppermute collective over the pipe axis inside
+    the tick body / a stage program."""
+    out = []
+    for site in report.tick_collectives:
+        out.append(
+            Finding(
+                "TPU804",
+                f"{site['primitive']} over pipeline axis {report.axis_name!r} inside "
+                f"the tick body{site.get('location') or ''} — stages run different "
+                f"microbatches at a tick (MPMD), so a stage-synchronous collective "
+                f"either deadlocks under divergent control flow or serializes the "
+                f"schedule; move it outside the pipelined region (after the scan)",
+                path=site.get("path"),
+                line=site.get("line"),
+            )
+        )
+    return out
+
+
+def check_stage_hbm(report, *, hbm_gb: Optional[float] = None) -> list[Finding]:
+    """TPU805: per-stage live activations over the HBM budget, remat off."""
+    from .tune_rules import hbm_budget_bytes
+
+    if report.remat:
+        return []
+    budget = hbm_budget_bytes(report.generation, hbm_gb)
+    out = []
+    for s in report.stages:
+        if s.peak_hbm_bytes <= budget:
+            continue
+        saving = (s.layers - 1) * report.num_microbatches * report.activation_bytes
+        out.append(
+            Finding(
+                "TPU805",
+                f"stage {s.index} peak HBM {_human(s.peak_hbm_bytes)} exceeds the "
+                f"{report.generation} budget {_human(budget)} with remat off — "
+                f"{report.num_microbatches} microbatches x {s.layers} layers of live "
+                f"activations; remat=True keeps only stage boundaries, saving "
+                f"{_human(saving)}",
+            )
+        )
+    return out
+
+
+def check_pipe_rules(
+    report,
+    *,
+    mesh=None,
+    dcn: Optional[Sequence[str]] = None,
+    bubble_threshold: float = PIPE_BUBBLE_THRESHOLD,
+    imbalance_threshold: float = PIPE_IMBALANCE_THRESHOLD,
+    hbm_gb: Optional[float] = None,
+) -> list[Finding]:
+    """All TPU80x checks over one report, in rule-ID order."""
+    findings: list[Finding] = []
+    findings += check_pipe_placement(report, mesh, dcn)
+    findings += check_stage_imbalance(report, imbalance_threshold)
+    findings += check_bubble_fraction(report, bubble_threshold)
+    findings += check_tick_collectives(report)
+    findings += check_stage_hbm(report, hbm_gb=hbm_gb)
+    return findings

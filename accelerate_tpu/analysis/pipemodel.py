@@ -1,0 +1,712 @@
+"""Static pipeline-schedule analysis: per-stage rooflines, bubble
+fraction, and the ``interleave`` overlap model for the GPipe schedule in
+``parallel.pipeline``.
+
+The schedule under analysis is a single ``lax.scan`` over ``M + S - 1``
+ticks inside ``shard_map`` over the ``pipe`` axis: every tick each of the
+``S`` stages applies its layer chunk to one microbatch (of ``M``) and
+hands the activation to its neighbour via ``lax.ppermute``. The analyzer
+recognises that region two ways:
+
+* **declared** — a :class:`PipelineSpec` (or a
+  :class:`~accelerate_tpu.parallel.pipeline.PipelinedModel` via
+  :func:`from_pipelined_model`) names the layer function, stacked params
+  and schedule knobs directly; each stage's sub-program is traced and
+  priced on its own, so per-stage *imbalance* (``stage_layers``) is
+  visible.
+* **traced** — an arbitrary step function is traced and the
+  shard_map-over-``pipe`` + scan-of-ticks + ``ppermute`` pattern is
+  located in the jaxpr; the tick body is priced as the (SPMD-identical)
+  per-stage program.
+
+From the per-stage rooflines (``analysis.perfmodel.walk_ops``) and
+handoff pricing (``analysis.costmodel.price_collective``) the model
+predicts, per the MPMD pipeline cost model:
+
+* **tick time** ``t_i = compute_i + exposed_permute`` per stage;
+* **step time** ``(M + S - 1) x max_i t_i`` — every tick is paced by the
+  slowest stage;
+* **bubble fraction** ``1 - M * sum_i(compute_i) / (S * (M+S-1) *
+  max_tick)`` — the ideal GPipe bubble ``(S-1)/(M+S-1)`` inflated by
+  stage imbalance and exposed handoff time;
+* **exposed vs hidden permute time** — with ``interleave = k`` row
+  blocks per tick, block *j*'s ppermute overlaps block *j+1*'s compute,
+  so ``k - 1`` of the ``k`` per-tick permutes hide behind compute when
+  per-block compute covers them; the last is always exposed;
+* **per-stage peak HBM** — stage params + the traced transient + the
+  live-activation term ``M x layers_per_stage x act_bytes`` (just
+  ``M x act_bytes`` under remat: only stage-boundary activations are
+  saved for the backward pass).
+
+The TPU80x findings over this report live in ``analysis.pipe_rules``;
+the CLI surface is ``accelerate-tpu pipe-check``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from .rules import Finding
+
+__all__ = [
+    "PipelineSpec",
+    "StageProfile",
+    "PipeReport",
+    "from_pipelined_model",
+    "analyze_pipeline",
+    "pipe_check",
+]
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _prod(it) -> int:
+    out = 1
+    for v in it:
+        out *= int(v)
+    return out
+
+
+def _human(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"
+
+
+def _aval_of(x):
+    """ShapeDtypeStruct-ish view of a sample value (array, SDS, aval)."""
+    jax = _jax()
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    raise TypeError(f"cannot derive an aval from {type(x).__name__}")
+
+
+def _sds_bytes(sds) -> int:
+    import numpy as np
+
+    return _prod(sds.shape or (1,)) * np.dtype(sds.dtype).itemsize
+
+
+# -- declared schedule -----------------------------------------------------
+
+
+@dataclass
+class PipelineSpec:
+    """A declared GPipe schedule for analysis (no tracing of the full
+    program needed — each stage is traced on its own).
+
+    ``layer_params`` leaves are stacked ``[L, ...]`` (the
+    scan-over-layers layout :func:`~accelerate_tpu.parallel.pipeline.
+    pipeline_apply` takes); arrays and ``ShapeDtypeStruct``\\ s are both
+    fine — only shapes/dtypes are read. ``x`` is the activation batch
+    ONE data shard sees (``[B_local, ...]``); it must divide into
+    ``num_microbatches``. ``stage_layers`` optionally gives per-stage
+    layer counts to express an imbalanced cut (default: ``L / S`` each).
+    """
+
+    layer_fn: Callable
+    layer_params: Any
+    x: Any
+    mesh: Any
+    num_microbatches: int = 1
+    axis_name: str = "pipe"
+    interleave: int = 1
+    remat: bool = False
+    stage_layers: Optional[Sequence[int]] = None
+    broadcast_args: tuple = ()
+    fn_name: str = ""
+
+
+def from_pipelined_model(pm, *inputs) -> PipelineSpec:
+    """Build a :class:`PipelineSpec` from a
+    :class:`~accelerate_tpu.parallel.pipeline.PipelinedModel` plus sample
+    model inputs (what ``pm(params, *inputs)`` takes after ``params``):
+    the trunk activation shape comes from abstractly evaluating
+    ``pre_fn``, and the per-shard batch from the mesh's batch axes."""
+    jax = _jax()
+    from ..parallel.mesh import axis_size
+
+    h, bcast = jax.eval_shape(pm.pre_fn, pm.params["pre"], *inputs)
+    d_shards = axis_size(pm.mesh, pm.batch_axes)
+    if h.shape[0] % d_shards:
+        raise ValueError(f"batch {h.shape[0]} does not divide over {d_shards} data shards")
+    local = jax.ShapeDtypeStruct((h.shape[0] // d_shards,) + tuple(h.shape[1:]), h.dtype)
+    return PipelineSpec(
+        layer_fn=pm.layer_fn,
+        layer_params=pm.params["layers"],
+        x=local,
+        mesh=pm.mesh,
+        num_microbatches=pm.num_microbatches,
+        axis_name=pm.axis_name,
+        remat=pm.remat,
+        broadcast_args=tuple(jax.tree.leaves(bcast, is_leaf=lambda v: hasattr(v, "shape"))),
+        fn_name=getattr(pm.layer_fn, "__name__", "PipelinedModel"),
+    )
+
+
+# -- report ----------------------------------------------------------------
+
+
+@dataclass
+class StageProfile:
+    """One pipeline stage, priced per tick (one microbatch pass)."""
+
+    index: int
+    layers: int
+    compute_us: float  # per-tick compute (all interleave blocks)
+    flops: int  # per tick
+    hbm_bytes: int  # per tick
+    param_bytes: int
+    peak_hbm_bytes: int  # params + transient + saved activations
+
+    def as_dict(self) -> dict:
+        return {
+            "index": self.index,
+            "layers": self.layers,
+            "compute_us": round(self.compute_us, 3),
+            "flops": self.flops,
+            "hbm_bytes": self.hbm_bytes,
+            "param_bytes": self.param_bytes,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+        }
+
+
+@dataclass
+class PipeReport:
+    """Everything ``pipe_check`` learns about one pipelined program."""
+
+    fn_name: str
+    source: str  # "declared" | "traced"
+    mesh_axes: dict[str, int] = field(default_factory=dict)
+    axis_name: str = "pipe"
+    n_stages: int = 1
+    num_microbatches: int = 1
+    interleave: int = 1
+    remat: bool = False
+    generation: str = "v5e"
+    transport: str = "ici"  # transport of the pipe axis
+    stages: list[StageProfile] = field(default_factory=list)
+    activation_bytes: int = 0  # one microbatch activation
+    permute_block_us: float = 0.0  # one interleave block's handoff
+    permute_wire_bytes_per_step: int = 0
+    exposed_permute_us: float = 0.0  # per tick
+    hidden_permute_us: float = 0.0  # per tick
+    tick_collectives: list[dict] = field(default_factory=list)  # TPU804 sites
+    findings: list[Finding] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.is_error for f in self.findings)
+
+    @property
+    def ticks(self) -> int:
+        return self.num_microbatches + self.n_stages - 1
+
+    def tick_us(self, i: int) -> float:
+        return self.stages[i].compute_us + self.exposed_permute_us
+
+    @property
+    def max_tick_us(self) -> float:
+        return max((self.tick_us(i) for i in range(len(self.stages))), default=0.0)
+
+    @property
+    def predicted_step_us(self) -> float:
+        return self.ticks * self.max_tick_us
+
+    @property
+    def predicted_step_ms(self) -> float:
+        return self.predicted_step_us / 1000.0
+
+    @property
+    def ideal_bubble_fraction(self) -> float:
+        return (self.n_stages - 1) / self.ticks
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle fraction of total device-time: useful compute is each
+        stage's M microbatch passes; everything else — fill/drain ticks,
+        waiting on the slowest stage, exposed handoffs — is bubble."""
+        total = self.n_stages * self.ticks * self.max_tick_us
+        if total <= 0:
+            return 0.0
+        useful = self.num_microbatches * sum(s.compute_us for s in self.stages)
+        return max(0.0, 1.0 - useful / total)
+
+    def predict_step_us_at(self, m: int) -> float:
+        """Predicted step time at a different ``num_microbatches`` for
+        the SAME per-shard batch: per-microbatch work scales by M/m (the
+        microbatch shrinks), the tick count grows to ``m + S - 1``."""
+        scale = self.num_microbatches / m
+        computes = [s.compute_us * scale for s in self.stages]
+        block = self.permute_block_us * scale
+        k = max(1, self.interleave)
+        block_compute = max(computes) / k if computes else 0.0
+        exposed = block + (k - 1) * max(0.0, block - block_compute)
+        tick = (max(computes) if computes else 0.0) + exposed
+        return (m + self.n_stages - 1) * tick
+
+    def as_dict(self) -> dict:
+        return {
+            "fn": self.fn_name,
+            "source": self.source,
+            "mesh": dict(self.mesh_axes),
+            "axis_name": self.axis_name,
+            "generation": self.generation,
+            "transport": self.transport,
+            "schedule": {
+                "n_stages": self.n_stages,
+                "num_microbatches": self.num_microbatches,
+                "interleave": self.interleave,
+                "remat": self.remat,
+                "ticks": self.ticks,
+            },
+            "totals": {
+                "predicted_step_ms": round(self.predicted_step_ms, 4),
+                "max_tick_us": round(self.max_tick_us, 3),
+                "bubble_fraction": round(self.bubble_fraction, 5),
+                "ideal_bubble_fraction": round(self.ideal_bubble_fraction, 5),
+                "activation_bytes": self.activation_bytes,
+                "permute_wire_bytes_per_step": self.permute_wire_bytes_per_step,
+                "exposed_permute_us_per_tick": round(self.exposed_permute_us, 3),
+                "hidden_permute_us_per_tick": round(self.hidden_permute_us, 3),
+            },
+            "stages": [s.as_dict() for s in self.stages],
+            "findings": [f.as_dict() for f in self.findings],
+        }
+
+    def render_text(self) -> str:
+        mesh = ", ".join(f"{a}={n}" for a, n in self.mesh_axes.items() if n > 1) or "1 device"
+        lines = [
+            f"pipe-check: {self.fn_name} on mesh ({mesh}), {self.generation} roofline [{self.source}]",
+            f"  schedule              : S={self.n_stages} stages x M={self.num_microbatches} microbatches"
+            f" = {self.ticks} ticks (interleave={self.interleave}, remat={'on' if self.remat else 'off'})",
+            f"  pipe axis transport   : {self.axis_name!r} on {self.transport}",
+            f"  bubble fraction       : {self.bubble_fraction:.3f} (ideal {self.ideal_bubble_fraction:.3f})",
+            f"  handoff per tick      : {self.exposed_permute_us:.1f}us exposed"
+            f" + {self.hidden_permute_us:.1f}us hidden"
+            f" ({_human(self.activation_bytes)} activation/microbatch)",
+            f"  predicted step time   : {self.predicted_step_ms:.3f} ms"
+            f" ({self.ticks} x {self.max_tick_us:.1f}us max-stage tick)",
+            "  stages:",
+        ]
+        for s in self.stages:
+            lines.append(
+                f"    stage {s.index}: {s.layers} layer(s), {s.compute_us:>8.1f}us/tick, "
+                f"peak HBM {_human(s.peak_hbm_bytes)} (params {_human(s.param_bytes)})"
+            )
+        if self.findings:
+            from .report import format_finding
+
+            lines.append("  findings:")
+            lines.extend(f"    {format_finding(f)}" for f in self.findings)
+        else:
+            lines.append("  findings: none")
+        return "\n".join(lines)
+
+
+# -- pricing helpers -------------------------------------------------------
+
+
+def _price_permute(block_bytes: int, mesh, axis_name: str, dcn, generation: str) -> tuple[float, int, str]:
+    """(time_us, wire_bytes, transport) for one block handoff."""
+    from .costmodel import price_collective
+
+    rec = price_collective("ppermute", (axis_name,), block_bytes, mesh, dcn=dcn)
+    if rec is None:
+        return 0.0, 0, "ici"
+    return rec.time_us(generation), rec.wire_bytes, rec.transport
+
+
+def _overlap(permute_block_us: float, block_compute_us: float, k: int) -> tuple[float, float]:
+    """(exposed, hidden) permute time per tick under ``interleave=k``:
+    the last block's permute is always exposed; each of the other k-1
+    overlaps one block's compute and only its excess is exposed."""
+    exposed = permute_block_us + (k - 1) * max(0.0, permute_block_us - block_compute_us)
+    return exposed, max(0.0, k * permute_block_us - exposed)
+
+
+def _tick_collective_sites(jaxpr, axis_name: str) -> list[dict]:
+    """Non-ppermute collectives over the pipe axis inside a per-stage /
+    tick-body program — the TPU804 (MPMD deadlock/serialization) sites."""
+    from .costmodel import COLLECTIVE_PRIMS
+    from .jaxpr_lint import _axis_names_in_params, _walk_eqns
+    from .perfmodel import _eqn_loc, eqn_path_line
+
+    sites = []
+    for eqn in _walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS and name not in ("ppermute", "pshuffle"):
+            if axis_name in _axis_names_in_params(eqn.params):
+                path, line = eqn_path_line(eqn)
+                sites.append(
+                    {"primitive": name, "location": _eqn_loc(eqn), "path": path, "line": line}
+                )
+    return sites
+
+
+def _layers_split(n_layers: int, n_stages: int, stage_layers) -> tuple[int, ...]:
+    if stage_layers is not None:
+        split = tuple(int(v) for v in stage_layers)
+        if len(split) != n_stages:
+            raise ValueError(f"stage_layers has {len(split)} entries for {n_stages} stages")
+        if any(v <= 0 for v in split):
+            raise ValueError("stage_layers entries must be positive")
+        return split
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers do not divide over {n_stages} stages")
+    return (n_layers // n_stages,) * n_stages
+
+
+# -- declared path ---------------------------------------------------------
+
+
+def analyze_pipeline(
+    spec: PipelineSpec,
+    *,
+    dcn: Optional[Sequence[str]] = None,
+    generation: Optional[str] = None,
+) -> PipeReport:
+    """Price a declared schedule: trace each stage's sub-program (the
+    inner scan over its layer chunk on one interleave block), roofline it
+    with ``walk_ops``, and assemble the bubble model. Rule findings are
+    NOT attached here — :func:`pipe_check` does that."""
+    jax = _jax()
+    from ..parallel.mesh import axis_transport
+    from .costmodel import device_generation
+    from .flightcheck import _main_jaxpr, estimate_peak_hbm
+    from .jaxpr_lint import _trace
+    from .perfmodel import walk_ops
+
+    mesh = spec.mesh
+    if spec.axis_name not in mesh.shape:
+        raise ValueError(f"mesh has no {spec.axis_name!r} axis (axes: {list(mesh.shape)})")
+    n_stages = int(mesh.shape[spec.axis_name])
+    generation = generation or device_generation() or "v5e"
+
+    leaves = jax.tree_util.tree_leaves(spec.layer_params)
+    n_layers = int(leaves[0].shape[0])
+    splits = _layers_split(n_layers, n_stages, spec.stage_layers)
+
+    x_sds = _aval_of(spec.x)
+    m = int(spec.num_microbatches)
+    if m < 1 or x_sds.shape[0] % m:
+        raise ValueError(f"batch {x_sds.shape[0]} must divide into {m} microbatches")
+    b_mb = x_sds.shape[0] // m
+    k = spec.interleave if spec.interleave > 1 and b_mb % spec.interleave == 0 else 1
+    b_blk = b_mb // k
+    act_bytes = _sds_bytes(x_sds) // m
+    block_bytes = act_bytes // k
+
+    barg_sds = tuple(_aval_of(a) for a in spec.broadcast_args)
+    # batch-shaped extras are microbatched alongside x (pipeline_apply's
+    # heuristic); the rest pass through whole
+    barg_blk = tuple(
+        jax.ShapeDtypeStruct((b_blk,) + tuple(a.shape[1:]), a.dtype)
+        if a.shape and a.shape[0] == x_sds.shape[0]
+        else a
+        for a in barg_sds
+    )
+
+    def stage_fn(stage_params, h, *bargs):
+        def body(carry, p):
+            return spec.layer_fn(p, carry, *bargs), None
+
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
+
+    permute_us, permute_wire, transport = _price_permute(
+        block_bytes, mesh, spec.axis_name, dcn, generation
+    )
+
+    stages: list[StageProfile] = []
+    tick_collectives: list[dict] = []
+    trace_findings: list[Finding] = []
+    h_sds = jax.ShapeDtypeStruct((b_blk,) + tuple(x_sds.shape[1:]), x_sds.dtype)
+    for i, layers_i in enumerate(splits):
+        params_i = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((layers_i,) + tuple(l.shape[1:]), l.dtype),
+            spec.layer_params,
+        )
+        sample = (params_i, h_sds) + barg_blk
+        closed, f101 = _trace(stage_fn, sample, mesh)
+        trace_findings.extend(f101)
+        if closed is None:
+            stages.append(StageProfile(i, layers_i, 0.0, 0, 0, 0, 0))
+            continue
+        records = walk_ops(closed, sample, mesh, dcn=dcn, generation=generation)
+        block_compute = sum(r.time_us for r in records if r.transport is None)
+        param_bytes = sum(_sds_bytes(l) for l in jax.tree_util.tree_leaves(params_i))
+        transient, _, _, _ = estimate_peak_hbm(closed, sample, mesh)
+        saved = m * (layers_i if not spec.remat else 1) * act_bytes
+        stages.append(
+            StageProfile(
+                index=i,
+                layers=layers_i,
+                compute_us=k * block_compute,
+                flops=k * sum(r.flops for r in records if r.transport is None),
+                hbm_bytes=k * sum(r.hbm_bytes for r in records if r.transport is None),
+                param_bytes=param_bytes,
+                peak_hbm_bytes=transient + saved,
+            )
+        )
+        for site in _tick_collective_sites(_main_jaxpr(closed), spec.axis_name):
+            if site not in tick_collectives:  # identical stages re-report the same site
+                tick_collectives.append(site)
+
+    max_block_compute = max((s.compute_us / k for s in stages), default=0.0)
+    exposed, hidden = _overlap(permute_us, max_block_compute, k)
+    report = PipeReport(
+        fn_name=spec.fn_name or getattr(spec.layer_fn, "__name__", "<pipeline>"),
+        source="declared",
+        mesh_axes={a: int(n) for a, n in mesh.shape.items()},
+        axis_name=spec.axis_name,
+        n_stages=n_stages,
+        num_microbatches=m,
+        interleave=k,
+        remat=spec.remat,
+        generation=generation,
+        transport=axis_transport(mesh, spec.axis_name, dcn),
+        stages=stages,
+        activation_bytes=act_bytes,
+        permute_block_us=permute_us,
+        permute_wire_bytes_per_step=permute_wire * k * (m + n_stages - 1),
+        exposed_permute_us=exposed,
+        hidden_permute_us=hidden,
+        tick_collectives=tick_collectives,
+        findings=trace_findings,
+    )
+    return report
+
+
+# -- traced path -----------------------------------------------------------
+
+
+def _find_pipeline_region(jaxpr, axis_name: str):
+    """Locate the tick scan: the (unique) ``scan`` whose DIRECT body
+    contains a ``ppermute`` over ``axis_name``. Returns ``(scan_eqn,
+    body_jaxpr, permute_eqns)`` or None."""
+    from .jaxpr_lint import _axis_names_in_params, _iter_subjaxprs
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            for sub in _iter_subjaxprs(eqn.params):
+                perms = [
+                    e
+                    for e in sub.eqns
+                    if e.primitive.name == "ppermute"
+                    and axis_name in _axis_names_in_params(e.params)
+                ]
+                if perms:
+                    return eqn, sub, perms
+        for sub in _iter_subjaxprs(eqn.params):
+            found = _find_pipeline_region(sub, axis_name)
+            if found is not None:
+                return found
+    return None
+
+
+def _nbytes(aval) -> int:
+    from .perfmodel import _nbytes as nb
+
+    return nb(aval)
+
+
+def _shard_map_mesh(jaxpr, axis_name: str):
+    """The mesh a traced program binds its own pipeline to: the first
+    ``shard_map`` whose mesh has a non-trivial ``axis_name`` axis. A step
+    that builds its mesh internally (the ``pipeline_apply`` idiom) is
+    analyzable even when the ANALYSIS mesh has no pipe axis."""
+    from .jaxpr_lint import _iter_subjaxprs
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "shard_map":
+            m = eqn.params.get("mesh")
+            shape = dict(getattr(m, "shape", None) or {})
+            if int(shape.get(axis_name, 1)) > 1:
+                return m
+        for sub in _iter_subjaxprs(eqn.params):
+            found = _shard_map_mesh(sub, axis_name)
+            if found is not None:
+                return found
+    return None
+
+
+def _analyze_traced(
+    fn,
+    sample_args,
+    mesh,
+    *,
+    axis_name: str = "pipe",
+    num_microbatches: Optional[int] = None,
+    dcn: Optional[Sequence[str]] = None,
+    generation: Optional[str] = None,
+) -> PipeReport:
+    """Recognise the pipelined region in a traced program and price it.
+    All stages run the same SPMD program, so the per-stage profiles are
+    identical — imbalance is only visible to the declared path."""
+    import types
+
+    from ..parallel.mesh import axis_transport
+    from .costmodel import device_generation
+    from .flightcheck import _jaxpr_transient_peak, _main_jaxpr
+    from .jaxpr_lint import _trace, _walk_eqns
+    from .perfmodel import walk_ops
+
+    generation = generation or device_generation() or "v5e"
+    closed, findings = _trace(fn, sample_args, mesh)
+    if closed is None:
+        raise ValueError(
+            "cannot trace target: " + "; ".join(f.message for f in findings)
+        )
+    region = _find_pipeline_region(_main_jaxpr(closed), axis_name)
+    if region is None:
+        raise ValueError(
+            f"no pipelined region found: expected a scan-of-ticks with a "
+            f"ppermute over {axis_name!r} (the parallel.pipeline schedule), "
+            f"or pass a PipelineSpec/PipelinedModel instead"
+        )
+    scan_eqn, body, perms = region
+    pipe_mesh = mesh
+    if int(mesh.shape.get(axis_name, 1)) <= 1:
+        traced_mesh = _shard_map_mesh(_main_jaxpr(closed), axis_name)
+        if traced_mesh is not None:
+            pipe_mesh = traced_mesh
+    n_stages = int(pipe_mesh.shape.get(axis_name, 1))
+    ticks = int(scan_eqn.params.get("length", 1) or 1)
+    k = len(perms)
+    m = int(num_microbatches) if num_microbatches else max(1, ticks - n_stages + 1)
+
+    block_aval = perms[0].invars[0].aval
+    block_bytes = _nbytes(block_aval)
+    act_bytes = block_bytes * k
+    remat = any(
+        e.primitive.name in ("remat", "remat2", "checkpoint") for e in _walk_eqns(body)
+    )
+    layers = max(
+        (int(e.params.get("length", 1) or 1) for e in _walk_eqns(body) if e.primitive.name == "scan"),
+        default=1,
+    )
+
+    shim = types.SimpleNamespace(jaxpr=body)
+    records = walk_ops(shim, None, mesh, dcn=dcn, generation=generation)
+    tick_compute = sum(r.time_us for r in records if r.transport is None)
+    tick_flops = sum(r.flops for r in records if r.transport is None)
+    tick_hbm = sum(r.hbm_bytes for r in records if r.transport is None)
+
+    num_consts = int(scan_eqn.params.get("num_consts", 0) or 0)
+    param_bytes = sum(_nbytes(v.aval) for v in body.invars[:num_consts])
+    resident = sum(_nbytes(v.aval) for v in body.invars) + sum(
+        _nbytes(v.aval) for v in body.constvars
+    )
+    saved = m * (layers if not remat else 1) * act_bytes
+    peak = resident + _jaxpr_transient_peak(body) + saved
+
+    permute_us, permute_wire, transport = _price_permute(
+        block_bytes, pipe_mesh, axis_name, dcn, generation
+    )
+    exposed, hidden = _overlap(permute_us, tick_compute / k if k else 0.0, k)
+
+    profile = lambda i: StageProfile(  # noqa: E731 — S identical stages
+        index=i,
+        layers=layers,
+        compute_us=tick_compute,
+        flops=tick_flops,
+        hbm_bytes=tick_hbm,
+        param_bytes=param_bytes,
+        peak_hbm_bytes=peak,
+    )
+    return PipeReport(
+        fn_name=getattr(fn, "__name__", "<fn>"),
+        source="traced",
+        mesh_axes={a: int(n) for a, n in pipe_mesh.shape.items()},
+        axis_name=axis_name,
+        n_stages=n_stages,
+        num_microbatches=m,
+        interleave=k,
+        remat=remat,
+        generation=generation,
+        transport=axis_transport(pipe_mesh, axis_name, dcn),
+        stages=[profile(i) for i in range(n_stages)],
+        activation_bytes=act_bytes,
+        permute_block_us=permute_us,
+        permute_wire_bytes_per_step=permute_wire * k * ticks,
+        exposed_permute_us=exposed,
+        hidden_permute_us=hidden,
+        tick_collectives=_tick_collective_sites(body, axis_name),
+        findings=findings,
+    )
+
+
+# -- entry point -----------------------------------------------------------
+
+
+def pipe_check(
+    target,
+    *sample_args: Any,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+    axis_name: str = "pipe",
+    interleave: int = 1,
+    remat: bool = False,
+    stage_layers: Optional[Sequence[int]] = None,
+    dcn: Optional[Sequence[str]] = None,
+    generation: Optional[str] = None,
+    hbm_gb: Optional[float] = None,
+    rules: bool = True,
+    select=None,
+    ignore=(),
+) -> PipeReport:
+    """Analyze a pipelined program and run the TPU80x rules over it.
+
+    ``target`` is a :class:`PipelineSpec`, a
+    :class:`~accelerate_tpu.parallel.pipeline.PipelinedModel` (plus its
+    sample inputs), or any step function (plus sample args) whose trace
+    contains the ``parallel.pipeline`` schedule. ``mesh`` defaults to
+    the spec/model's own mesh. Findings honour inline ``# tpu-lint:
+    disable`` comments and the usual ``select``/``ignore`` filters."""
+    from ..parallel.pipeline import PipelinedModel
+    from .perfmodel import _apply_inline_suppressions
+    from .rules import filter_findings
+
+    if isinstance(target, PipelinedModel):
+        target = from_pipelined_model(target, *sample_args)
+        sample_args = ()
+    if isinstance(target, PipelineSpec):
+        if num_microbatches:
+            target.num_microbatches = int(num_microbatches)
+        if interleave and interleave > 1:
+            target.interleave = int(interleave)
+        if remat:
+            target.remat = True
+        if stage_layers is not None:
+            target.stage_layers = tuple(stage_layers)
+        report = analyze_pipeline(target, dcn=dcn, generation=generation)
+    else:
+        if mesh is None:
+            raise ValueError("pipe_check of a plain function needs mesh=")
+        report = _analyze_traced(
+            target,
+            sample_args,
+            mesh,
+            axis_name=axis_name,
+            num_microbatches=num_microbatches,
+            dcn=dcn,
+            generation=generation,
+        )
+
+    if rules:
+        from .pipe_rules import check_pipe_rules
+
+        mesh_obj = mesh if mesh is not None else getattr(target, "mesh", None)
+        report.findings.extend(check_pipe_rules(report, mesh=mesh_obj, dcn=dcn, hbm_gb=hbm_gb))
+    report.findings = _apply_inline_suppressions(report.findings)
+    report.findings = filter_findings(report.findings, select=select, ignore=ignore)
+    return report

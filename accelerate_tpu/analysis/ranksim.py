@@ -189,6 +189,10 @@ COLLECTIVE_EFFECTS: dict = {
     "all_gather": CallEffect(("collective:all_gather",)),
     "reduce_scatter_sum": CallEffect(("collective:reduce_scatter_sum",)),
     "ppermute_next": CallEffect(("collective:ppermute_next",)),
+    # raw lax.ppermute in shard_map-level code (the pipeline handoff):
+    # every rank participates — the MPMD hazard is a ppermute *guarded* by
+    # the (divergent) stage index, which TPU401 then catches
+    "ppermute": CallEffect(("collective:ppermute",)),
     "barrier_value": CallEffect(("barrier:barrier_value",)),
     "axis_index": CallEffect((), returns=DIVERGENT),
     # host-level preemption agreement: every rank participates, the
@@ -230,6 +234,13 @@ DIVERGENT_ATTRS = frozenset(
         "is_main_process",
         "is_local_main_process",
         "is_last_process",
+        # pipeline-stage identity: under the GPipe schedule each device
+        # group IS a different stage, so the stage index diverges exactly
+        # like the rank — TPU401-403 then cover per-stage (MPMD) programs
+        "stage_index",
+        "pipe_rank",
+        "is_first_stage",
+        "is_last_stage",
     }
 )
 
@@ -308,11 +319,11 @@ def solo_rank(fn, n_ranks: int) -> Optional[int]:
 
 
 def _attr_per_rank(attr: str, n: int) -> Optional[tuple]:
-    if attr in ("process_index", "process_index_host", "local_process_index"):
+    if attr in ("process_index", "process_index_host", "local_process_index", "stage_index", "pipe_rank"):
         return tuple(range(n))
-    if attr in ("is_main_process", "is_local_main_process"):
+    if attr in ("is_main_process", "is_local_main_process", "is_first_stage"):
         return tuple(i == 0 for i in range(n))
-    if attr == "is_last_process":
+    if attr in ("is_last_process", "is_last_stage"):
         return tuple(i == n - 1 for i in range(n))
     return None
 
@@ -956,8 +967,8 @@ class _RankRun:
         if fname in JAX_COLLECTIVES and root not in ("np", "numpy"):
             self.emit("collective", fname, line)
             return UNKNOWN
-        # 3. the rank itself, in call form
-        if fname in ("axis_index", "process_index"):
+        # 3. the rank (or pipeline-stage index) itself, in call form
+        if fname in ("axis_index", "process_index", "stage_index"):
             return Value(DIVERGENT, tuple(range(self.sim.n_ranks)), fname)
         # 4. parallel.collectives wrappers (the shard_map vocabulary)
         if fname in COLLECTIVE_EFFECTS:

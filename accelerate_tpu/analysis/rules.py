@@ -45,6 +45,15 @@ static_analysis.md for the worked catalogue):
   platform's collective lowering upcasts it, and ``zero_stage=1`` with
   a knowably non-elementwise optax transform. The one-off-misconfig
   twin of the full ``accelerate-tpu tune`` search.
+* ``TPU8xx`` — pipeline-schedule rules (``analysis.pipe_rules``) over
+  the per-stage roofline/bubble model (``analysis.pipemodel``) of the
+  GPipe schedule in ``parallel.pipeline``: the pipeline cut left on the
+  fast link while a DCN axis exists, stage imbalance inflating the
+  bubble past the ideal ``(S-1)/(M+S-1)``, bubble fraction over
+  threshold with the covering ``num_microbatches`` priced, a
+  stage-synchronous collective inside the tick body (the MPMD
+  deadlock/serialization class — error severity, the strict gate), and
+  per-stage live activations over the HBM budget with remat off.
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -69,6 +78,7 @@ TIER_DIVERGENCE = "divergence"
 TIER_PERF = "perf"
 TIER_NUMERICS = "numerics"
 TIER_CONFIG = "config"
+TIER_PIPE = "pipe"
 
 
 @dataclass(frozen=True)
@@ -129,6 +139,12 @@ RULES: dict[str, Rule] = {
         Rule("TPU703", "bucket-padding-waste", WARNING, TIER_CONFIG, "bucket set pads the declared batch/shape histogram past the waste threshold — compute burned on padding"),
         Rule("TPU704", "quantized-wire-upcast", WARNING, TIER_CONFIG, "quantized wire requested on a platform whose collective lowering upcasts the dtype — the wire saving silently evaporates"),
         Rule("TPU705", "zero1-non-elementwise-optimizer", WARNING, TIER_CONFIG, "zero_stage=1 requested with a knowably non-elementwise optax transform — the runtime falls back to the passive layout"),
+        # -- tier 8: pipeline schedule (analysis.pipe_rules) ---------------
+        Rule("TPU801", "pipeline-cut-on-fast-link", WARNING, TIER_PIPE, "pipeline axis on ICI while a DCN axis exists — the point-to-point handoffs are the traffic that belongs on the slow link"),
+        Rule("TPU802", "pipeline-stage-imbalance", WARNING, TIER_PIPE, "per-stage roofline spread: the slowest stage paces every tick, inflating the bubble beyond the ideal (S-1)/(M+S-1)"),
+        Rule("TPU803", "pipeline-bubble-over-threshold", WARNING, TIER_PIPE, "bubble fraction above threshold — too few microbatches for the stage count; the covering num_microbatches is named and priced"),
+        Rule("TPU804", "collective-over-pipe-axis-in-tick", ERROR, TIER_PIPE, "non-ppermute collective over the pipe axis inside the tick body — stages run different microbatches (MPMD), so it deadlocks or serializes the schedule"),
+        Rule("TPU805", "pipeline-stage-hbm-over-budget", WARNING, TIER_PIPE, "per-stage live activations exceed the HBM budget with remat off — checkpointing the stage boundary is priced"),
     )
 }
 

@@ -8,10 +8,14 @@ enumerable object so ``analysis.tuner`` can search it:
 * :class:`ConfigPoint` — one candidate configuration over the knobs the
   repo has grown: mesh layout + DCN axes, ZeRO stage, gradient
   compression, shape buckets, serving token budget / tick block / slot
-  count, fleet routing policy, and KV-handoff mode. Hashable, labelled,
-  and convertible to the kwargs the runtime actually consumes
+  count, fleet routing policy, KV-handoff mode, and the pipeline
+  schedule knobs (``num_microbatches`` / ``interleave`` / ``remat`` —
+  scored by ``analysis.pipemodel``'s bubble-adjusted step time when the
+  mesh carries a ``pipe`` axis). Hashable, labelled, and convertible to
+  the kwargs the runtime actually consumes
   (:meth:`ConfigPoint.parallelism_kwargs` /
-  :meth:`ConfigPoint.serving_kwargs`).
+  :meth:`ConfigPoint.serving_kwargs` /
+  :meth:`ConfigPoint.pipeline_kwargs`).
 * :class:`SearchSpace` — per-knob candidate lists whose cartesian
   product :meth:`SearchSpace.enumerate_points` walks, with
   **constraint pruning** (:func:`prune_reason`): points that cannot run
@@ -109,6 +113,9 @@ class ConfigPoint:
     num_slots: Optional[int] = None
     routing: Optional[str] = None
     handoff: Optional[str] = None
+    num_microbatches: Optional[int] = None
+    interleave: Optional[int] = None
+    remat: Optional[bool] = None
 
     def __post_init__(self):
         # normalise permissive inputs into the hashable canonical forms
@@ -160,6 +167,12 @@ class ConfigPoint:
             parts.append(self.routing)
         if self.handoff:
             parts.append(f"handoff={self.handoff}")
+        if self.num_microbatches is not None:
+            parts.append(f"mb={self.num_microbatches}")
+        if self.interleave is not None and self.interleave > 1:
+            parts.append(f"interleave={self.interleave}")
+        if self.remat:
+            parts.append("remat")
         return " ".join(parts) or "<defaults>"
 
     def as_dict(self) -> dict:
@@ -169,7 +182,8 @@ class ConfigPoint:
         if self.dcn_axes:
             out["dcn_axes"] = list(self.dcn_axes)
         for key in ("zero_stage", "compression", "token_budget", "tick_block",
-                    "num_slots", "routing", "handoff"):
+                    "num_slots", "routing", "handoff", "num_microbatches",
+                    "interleave", "remat"):
             val = getattr(self, key)
             if val is not None:
                 out[key] = val
@@ -221,6 +235,27 @@ class ConfigPoint:
             out["handoff"] = self.handoff
         return out
 
+    @property
+    def has_pipeline_knobs(self) -> bool:
+        return (
+            self.num_microbatches is not None
+            or self.interleave is not None
+            or self.remat is not None
+        )
+
+    def pipeline_kwargs(self) -> dict:
+        """Kwargs a pipelined workload (``parallel.pipeline.
+        pipeline_apply`` / ``PipelinedModel``) consumes from this point —
+        a workload factory typically splats these."""
+        out: dict[str, Any] = {}
+        if self.num_microbatches is not None:
+            out["num_microbatches"] = int(self.num_microbatches)
+        if self.interleave is not None:
+            out["interleave"] = int(self.interleave)
+        if self.remat is not None:
+            out["remat"] = bool(self.remat)
+        return out
+
 
 def prune_reason(point: ConfigPoint, *, max_devices: Optional[int] = None) -> Optional[str]:
     """Why ``point`` cannot run at all, or ``None`` when it is a valid
@@ -270,6 +305,12 @@ def prune_reason(point: ConfigPoint, *, max_devices: Optional[int] = None) -> Op
         return f"unknown routing policy {point.routing!r}"
     if point.handoff is not None and point.handoff not in HANDOFF_MODES:
         return f"unknown handoff mode {point.handoff!r}"
+    if point.num_microbatches is not None and int(point.num_microbatches) < 1:
+        return "num_microbatches must be >= 1"
+    if point.interleave is not None and int(point.interleave) < 1:
+        return "interleave must be >= 1"
+    if point.has_pipeline_knobs and shape is not None and int(shape.get("pipe", 1)) <= 1:
+        return "pipeline knobs (num_microbatches/interleave/remat) need a pipe axis > 1"
     return None
 
 
@@ -290,6 +331,9 @@ class SearchSpace:
     slot_counts: tuple = ()
     routings: tuple = ()
     handoffs: tuple = ()
+    microbatch_counts: tuple = ()
+    interleaves: tuple = ()
+    remats: tuple = ()
     max_devices: Optional[int] = None
 
     def __post_init__(self):
@@ -309,6 +353,9 @@ class SearchSpace:
         self.slot_counts = _as_int_tuple(self.slot_counts)
         self.routings = tuple(str(r) for r in self.routings)
         self.handoffs = tuple(str(h) for h in self.handoffs)
+        self.microbatch_counts = _as_int_tuple(self.microbatch_counts)
+        self.interleaves = _as_int_tuple(self.interleaves)
+        self.remats = tuple(bool(r) for r in self.remats)
 
     def size(self) -> int:
         n = 1
@@ -328,6 +375,9 @@ class SearchSpace:
             tuple(self.slot_counts) or (None,),
             tuple(self.routings) or (None,),
             tuple(self.handoffs) or (None,),
+            tuple(self.microbatch_counts) or (None,),
+            tuple(self.interleaves) or (None,),
+            tuple(self.remats) or (None,),
         ]
 
     def enumerate_points(self) -> list[tuple[ConfigPoint, Optional[str]]]:
@@ -335,7 +385,7 @@ class SearchSpace:
         pairs, deduplicated, in deterministic enumeration order."""
         out: list[tuple[ConfigPoint, Optional[str]]] = []
         seen: set = set()
-        for mesh, dcn, zero, comp, buckets, budget, tick, slots, routing, handoff in itertools.product(
+        for mesh, dcn, zero, comp, buckets, budget, tick, slots, routing, handoff, mb, il, rm in itertools.product(
             *self._axes()
         ):
             point = ConfigPoint(
@@ -349,6 +399,9 @@ class SearchSpace:
                 num_slots=slots,
                 routing=routing,
                 handoff=handoff,
+                num_microbatches=mb,
+                interleave=il,
+                remat=rm,
             )
             if point in seen:
                 continue
@@ -374,6 +427,9 @@ class SearchSpace:
         "slots": "slot_counts",
         "routings": "routings",
         "handoffs": "handoffs",
+        "microbatches": "microbatch_counts",
+        "interleaves": "interleaves",
+        "remats": "remats",
     }
 
     @classmethod

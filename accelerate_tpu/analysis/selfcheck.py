@@ -17,7 +17,11 @@ TPU6xx rule and a hand-computed interval-arithmetic reference the
 interpreter must match exactly, plus (config tier) one seeded
 misconfiguration AND a clean twin per TPU7xx rule — TPU701 end to end
 through a real single-candidate ``analysis.tuner.tune`` run whose static
-peak HBM cannot fit a deliberately tiny budget. A CI run that passes
+peak HBM cannot fit a deliberately tiny budget, plus (pipe tier) one
+seeded pipeline-schedule defect AND a clean twin per TPU8xx rule and a
+hand-computed bubble/roofline reference (a four-stage single-matmul
+pipeline priced from the costmodel tables by hand) the ``pipemodel``
+prediction must match exactly. A CI run that passes
 selfcheck has proven the linter end-to-end on the CPU backend, so a clean
 repo lint actually means something.
 
@@ -767,6 +771,181 @@ def run_tune_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     return ok, lines
 
 
+def _pipe_reference(pmesh) -> tuple[bool, list[str]]:
+    """The executable spec of the pipeline cost model: an S-stage
+    single-matmul pipeline (2 layers/stage, M = S microbatches) whose
+    bubble and roofline are computed BY HAND from the costmodel tables
+    here — per-layer time is ``max(2*b*w^2 / (bf16_peak/2), bytes/hbm_bw)``
+    (f32 matmul at half rate), the handoff is one activation over ICI at
+    wire factor 1.0, tick = stage compute + exposed permute, step =
+    ``(M+S-1) x max tick`` — and must match the analyzer EXACTLY."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from .costmodel import BANDWIDTH_TABLE, hbm_bandwidth, peak_flops
+    from .pipemodel import PipelineSpec, analyze_pipeline
+
+    s = int(pmesh.shape["pipe"])
+    width = batch = 16
+    m = s  # M = S -> 2S - 1 ticks
+    n_layers = 2 * s  # 2 layers per stage
+
+    def mm(p, h):
+        return h @ p
+
+    spec = PipelineSpec(
+        mm,
+        jax.ShapeDtypeStruct((n_layers, width, width), jnp.float32),
+        jax.ShapeDtypeStruct((batch, width), jnp.float32),
+        pmesh,
+        num_microbatches=m,
+    )
+    report = analyze_pipeline(spec, generation="cpu")
+
+    # -- the hand arithmetic, straight from the tables ---------------------
+    b_mb = batch // m
+    flops = 2 * b_mb * width * width  # one (b,w)@(w,w) matmul
+    hbm = (b_mb * width + width * width + b_mb * width) * 4  # in + weights + out, f32
+    t_layer = max(flops / (peak_flops("cpu", "bf16") / 2.0) * 1e6, hbm / hbm_bandwidth("cpu") * 1e6)
+    stage_c = 2 * t_layer
+    act = batch * width * 4 // m  # one microbatch activation
+    p_us = act / BANDWIDTH_TABLE["cpu"]["ici"] * 1e6  # ppermute wire factor 1.0
+    tick = stage_c + p_us
+    ticks = m + s - 1
+    step = ticks * tick
+    bubble = 1.0 - (m * s * stage_c) / (s * ticks * tick)
+
+    def close(a, b):
+        return math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-12)
+
+    checks = [
+        (f"{s} stages x 2 layers", [st.layers for st in report.stages] == [2] * s),
+        (
+            f"stage compute == {stage_c:.6g}us",
+            all(close(st.compute_us, stage_c) for st in report.stages),
+        ),
+        (
+            f"exposed permute == {p_us:.6g}us, hidden == 0",
+            close(report.exposed_permute_us, p_us) and report.hidden_permute_us == 0.0,
+        ),
+        (f"activation == {act}B", report.activation_bytes == act),
+        (f"max tick == {tick:.6g}us", close(report.max_tick_us, tick)),
+        (f"step == {ticks} x max tick = {step:.6g}us", close(report.predicted_step_us, step)),
+        (f"ideal bubble == {s - 1}/{ticks}", close(report.ideal_bubble_fraction, (s - 1) / ticks)),
+        (f"bubble == {bubble:.6g}", close(report.bubble_fraction, bubble)),
+    ]
+    ok = all(passed for _, passed in checks)
+    lines = [
+        f"[pipe selfcheck] bubble/roofline reference (S={s}, M={m}, single-matmul stages): "
+        + ("exact" if ok else "MISMATCH: " + ", ".join(name for name, passed in checks if not passed))
+    ]
+    return ok, lines
+
+
+def run_pipe_selfcheck(mesh=None) -> tuple[bool, list[str]]:
+    """Prove TPU801-TPU805 each fire on a seeded schedule defect, each
+    clean twin stays silent, and the bubble/roofline prediction matches
+    the hand-computed reference exactly. Fixtures run on a dedicated
+    ``(pipe, data)`` mesh carved out of the selfcheck devices (pipe=4
+    with 8+ devices, else pipe=2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .pipemodel import PipelineSpec, pipe_check
+
+    if mesh is None:
+        from ..parallel.mesh import MeshConfig
+
+        mesh = MeshConfig().build()
+    devs = np.asarray(mesh.devices).reshape(-1)
+    if devs.size < 4:
+        return False, [f"[pipe selfcheck] SKIPPED: needs >= 4 devices (have {devs.size})"]
+    s = 4 if devs.size >= 8 else 2
+    pmesh = jax.sharding.Mesh(devs[: s * 2].reshape(s, 2), ("pipe", "data"))
+
+    lines: list[str] = []
+    ok = True
+
+    def record(rule: str, fired: bool, twin_findings):
+        nonlocal ok
+        ok &= fired
+        lines.append(f"[pipe selfcheck] {rule} fixture: {'detected' if fired else 'MISSED'}")
+        quiet = not twin_findings
+        ok &= quiet
+        lines.append(
+            f"[pipe selfcheck] {rule} clean twin: "
+            + ("zero findings" if quiet else "DIRTY: " + ", ".join(f.rule for f in twin_findings))
+        )
+
+    n_layers = 2 * s  # 2 layers per stage under the balanced cut
+
+    def mm(p, h):
+        return h @ p
+
+    def pipe_psum(p, h):
+        return jax.lax.psum(h @ p, "pipe")
+
+    def spec(layer_fn, *, m, width=16, batch=16, **kw):
+        return PipelineSpec(
+            layer_fn,
+            jax.ShapeDtypeStruct((n_layers, width, width), jnp.float32),
+            jax.ShapeDtypeStruct((batch, width), jnp.float32),
+            pmesh,
+            num_microbatches=m,
+            **kw,
+        )
+
+    # TPU801 — pipeline handoffs on ICI while a >1 DCN axis ('data')
+    # exists; the repair re-places the pipe axis itself on DCN (width
+    # bumped so the slower handoff still hides under compute)
+    seeded = pipe_check(spec(mm, m=16, width=64), dcn=("data",), generation="cpu", select=("TPU801",))
+    fired = any(f.rule == "TPU801" for f in seeded.findings)
+    twin = pipe_check(spec(mm, m=16, width=64), dcn=("pipe",), generation="cpu")
+    record("TPU801", fired, twin.findings)
+
+    # TPU802 — one stage carries all but S-1 layers; the twin is the
+    # balanced L/S cut
+    lop = (n_layers - (s - 1),) + (1,) * (s - 1)
+    seeded = pipe_check(spec(mm, m=16, stage_layers=lop), generation="cpu", select=("TPU802",))
+    fired = any(f.rule == "TPU802" for f in seeded.findings)
+    twin = pipe_check(spec(mm, m=16), generation="cpu")
+    record("TPU802", fired, twin.findings)
+
+    # TPU803 — a single microbatch maximises the fill/drain bubble at
+    # (S-1)/S; 16 microbatches cover it
+    seeded = pipe_check(spec(mm, m=1), generation="cpu", select=("TPU803",))
+    fired = any(f.rule == "TPU803" for f in seeded.findings)
+    twin = pipe_check(spec(mm, m=16), generation="cpu")
+    record("TPU803", fired, twin.findings)
+
+    # TPU804 — a psum over the pipe axis inside the layer body: stages
+    # run different microbatches at a tick, so this deadlocks/serializes
+    seeded = pipe_check(spec(pipe_psum, m=16), generation="cpu", select=("TPU804",))
+    fired = any(f.rule == "TPU804" for f in seeded.findings)
+    twin = pipe_check(spec(mm, m=16), generation="cpu")
+    record("TPU804", fired, twin.findings)
+
+    # TPU805 — 16 microbatches x 2 layers of 64KB live activations (~2MB)
+    # cannot fit a deliberately tiny 0.5MB budget with remat off; the
+    # twin keeps only stage boundaries (remat=True)
+    seeded = pipe_check(
+        spec(mm, m=16, width=64, batch=4096), generation="cpu", hbm_gb=0.0005, select=("TPU805",)
+    )
+    fired = any(f.rule == "TPU805" for f in seeded.findings)
+    twin = pipe_check(
+        spec(mm, m=16, width=64, batch=4096, remat=True), generation="cpu", hbm_gb=0.0005
+    )
+    record("TPU805", fired, twin.findings)
+
+    ref_ok, ref_lines = _pipe_reference(pmesh)
+    ok &= ref_ok
+    lines.extend(ref_lines)
+    return ok, lines
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -811,6 +990,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     tune_ok, tune_lines = run_tune_selfcheck(mesh)
     ok &= tune_ok
     lines.extend(tune_lines)
+
+    pipe_ok, pipe_lines = run_pipe_selfcheck(mesh)
+    ok &= pipe_ok
+    lines.extend(pipe_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

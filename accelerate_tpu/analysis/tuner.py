@@ -82,6 +82,7 @@ class CandidateResult:
     predicted_step_us: Optional[float] = None
     mfu_upper_bound: Optional[float] = None
     bound: Optional[str] = None  # dominant roofline side: compute|memory|comms
+    bubble_fraction: Optional[float] = None  # set when pipemodel rescored the point
     wire_bytes: int = 0
     peak_hbm_bytes: Optional[int] = None
     findings: list = field(default_factory=list)
@@ -116,6 +117,8 @@ class CandidateResult:
             "peak_hbm_bytes": self.peak_hbm_bytes,
             "findings": [f.as_dict() for f in self.findings],
         }
+        if self.bubble_fraction is not None:
+            out["bubble_fraction"] = round(self.bubble_fraction, 5)
         if self.measured_step_us is not None:
             out["measured_step_us"] = round(self.measured_step_us, 3)
             out["measured_recompiles"] = self.measured_recompiles
@@ -534,6 +537,30 @@ def tune(
         by_bound = perf.time_by_bound()
         cand.bound = max(by_bound, key=by_bound.get) if perf.ops else None
         cand.wire_bytes = perf.total_wire_bytes
+        # pipeline-aware rescoring: the serial roofline sums the stage
+        # work but cannot see the fill/drain bubble. When the point
+        # carries pipeline knobs (or its mesh has a pipe axis), score
+        # with pipemodel's bubble-adjusted step time instead — that is
+        # what makes num_microbatches/interleave/remat *rankable*.
+        pipe_shape = point.mesh_shape or {}
+        if point.has_pipeline_knobs or int(pipe_shape.get("pipe", 1)) > 1:
+            try:
+                from .pipemodel import pipe_check as _pipe_check
+
+                pipe = _pipe_check(
+                    step_fn,
+                    *args,
+                    mesh=mesh,
+                    dcn=point_dcn,
+                    generation=generation,
+                    rules=False,
+                    **point.pipeline_kwargs(),
+                )
+            except ValueError:
+                pipe = None  # no pipelined region: keep the serial roofline
+            if pipe is not None and pipe.predicted_step_us:
+                cand.predicted_step_us = pipe.predicted_step_us
+                cand.bubble_fraction = pipe.bubble_fraction
         scored.append(cand)
         report.candidates.append(cand)
 

@@ -19,6 +19,7 @@ from .merge import merge_parser
 from .migrate import migrate_parser
 from .numericscheck import numericscheck_parser
 from .perfcheck import perfcheck_parser
+from .pipecheck import pipecheck_parser
 from .telemetry import telemetry_parser
 from .test import test_parser
 from .tpu import tpu_command_parser
@@ -38,6 +39,7 @@ def main():
     lint_parser(subparsers)
     flightcheck_parser(subparsers)
     perfcheck_parser(subparsers)
+    pipecheck_parser(subparsers)
     numericscheck_parser(subparsers)
     tune_parser(subparsers)
     divergence_parser(subparsers)

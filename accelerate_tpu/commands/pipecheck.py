@@ -1,0 +1,163 @@
+"""``accelerate-tpu pipe-check`` — the static pipeline-schedule analyzer
++ TPU8xx rules over a pipelined step, before any XLA compile.
+
+Same target conventions as ``flight-check`` (``path/to/file.py::fn`` or
+``pkg.module:fn``, repeatable ``--arg dtype[shape]`` specs or the
+module's ``<fn>_sample_args()`` / ``SAMPLE_ARGS``), same fake CPU mesh.
+The target may be:
+
+* a step function whose trace contains the ``parallel.pipeline``
+  schedule (shard_map over ``pipe`` + scan-of-ticks + ``ppermute``) —
+  the region is recognised in the jaxpr;
+* a :class:`~accelerate_tpu.analysis.pipemodel.PipelineSpec` constant —
+  analyzed directly, no ``--arg`` needed;
+* a :class:`~accelerate_tpu.parallel.pipeline.PipelinedModel` constant —
+  ``--arg`` specs are the model inputs.
+
+The report: per-stage rooflines (compute time, FLOPs, peak HBM with the
+remat-aware live-activation term), bubble fraction vs the ideal
+``(S-1)/(M+S-1)``, exposed-vs-hidden handoff time under ``interleave``,
+and the bubble-adjusted predicted step time ``(M+S-1) x max-stage
+tick``, plus the TPU801–805 findings (TPU804, collective over the pipe
+axis inside the tick body, is error-severity — the strict part of the
+``make pipe-check`` gate).
+
+Examples::
+
+    accelerate-tpu pipe-check train.py::step --arg "f32[32,128]" --mesh pipe=4,data=2
+    accelerate-tpu pipe-check train.py::step --mesh pipe=4 --microbatches 8 --dcn-axes data
+    accelerate-tpu pipe-check model.py::PIPE_SPEC --format json
+    accelerate-tpu pipe-check --selfcheck   # prove TPU801-805 fire, twins clean, bubble math exact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def pipecheck_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "pipe-check", help="Static pipeline-schedule analysis + TPU8xx rules for a step fn"
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu pipe-check")
+    parser.add_argument(
+        "target", nargs="?",
+        help="pipelined step: file.py::fn or pkg.module:fn (a function, PipelineSpec, or PipelinedModel)",
+    )
+    parser.add_argument("--arg", action="append", default=[], help="sample arg spec like f32[8,128] (repeatable)")
+    parser.add_argument("--mesh", default=None, help="mesh shape, e.g. pipe=4,data=2 (default: all devices on data)")
+    parser.add_argument("--dcn-axes", default=None, help="axes that cross DCN, e.g. data (default: env/single-slice)")
+    parser.add_argument("--axis", default="pipe", help="pipeline mesh axis name (default: pipe)")
+    parser.add_argument(
+        "--microbatches", type=int, default=None,
+        help="num_microbatches M (default: from the spec, or ticks-S+1 from the trace)",
+    )
+    parser.add_argument("--interleave", type=int, default=1, help="interleave blocks per tick (declared specs)")
+    parser.add_argument("--remat", action="store_true", help="assume stage-boundary remat (declared specs)")
+    parser.add_argument(
+        "--stage-layers", default=None,
+        help="per-stage layer counts for an imbalanced cut, e.g. 5,1,1,1 (declared specs)",
+    )
+    parser.add_argument(
+        "--generation", default=None,
+        help="TPU generation for the roofline tables (v4/v5e/v5p/v6e/cpu; default: attached backend)",
+    )
+    parser.add_argument("--hbm-gb", type=float, default=None, help="per-device HBM budget for TPU805")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU801-805 fire on seeded defects, clean twins stay silent, bubble math is exact",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=pipecheck_command)
+    return parser
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)
+    from accelerate_tpu.analysis.selfcheck import run_pipe_selfcheck
+
+    ok, lines = run_pipe_selfcheck()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("pipe-check selfcheck FAILED")
+        return 1
+    return 0
+
+
+def pipecheck_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not args.target:
+            return rc
+
+    if not args.target:
+        print("usage: accelerate-tpu pipe-check file.py::step_fn [--arg f32[8,128] ...] [--mesh pipe=4]")
+        return 2
+
+    from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+    mesh = build_mesh(args.mesh)
+    module, fn = load_step(args.target)
+
+    from accelerate_tpu.analysis.pipemodel import PipelineSpec
+    from accelerate_tpu.parallel.pipeline import PipelinedModel
+
+    if isinstance(fn, PipelineSpec):
+        sample_args = ()  # the spec carries its own shapes
+    elif isinstance(fn, PipelinedModel):
+        from .flightcheck import parse_arg_spec
+
+        sample_args = tuple(parse_arg_spec(s) for s in args.arg)
+    else:
+        sample_args = resolve_sample_args(module, fn, args.arg)
+    dcn = tuple(a.strip() for a in args.dcn_axes.split(",") if a.strip()) if args.dcn_axes else None
+    stage_layers = (
+        tuple(int(v) for v in args.stage_layers.split(",") if v.strip())
+        if args.stage_layers
+        else None
+    )
+
+    from accelerate_tpu.analysis import exit_code, render_sarif
+    from accelerate_tpu.analysis.pipemodel import pipe_check
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    report = pipe_check(
+        fn,
+        *sample_args,
+        mesh=None if isinstance(fn, (PipelineSpec, PipelinedModel)) else mesh,
+        num_microbatches=args.microbatches,
+        axis_name=args.axis,
+        interleave=args.interleave,
+        remat=args.remat,
+        stage_layers=stage_layers,
+        dcn=dcn,
+        generation=args.generation,
+        hbm_gb=args.hbm_gb,
+        ignore=tuple(cfg.disable),
+    )
+    findings = cfg.apply_suppressions(report.findings)
+    fmt = cfg.resolve_format(args.format)
+    if fmt == "json":
+        print(json.dumps(report.as_dict(), indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(report.render_text())
+    return exit_code(findings, strict=args.strict)
+
+
+def main():
+    raise SystemExit(pipecheck_command(pipecheck_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

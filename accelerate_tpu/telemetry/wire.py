@@ -47,6 +47,7 @@ _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 
 _GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
 _GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[\d+\]")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
 
 
 def _result_bytes(result: str) -> tuple:
@@ -74,6 +75,20 @@ def _group_size(line: str, default: int) -> int:
     m = _GROUPS_IOTA_RE.search(line)
     if m:  # [num_groups, group_size] <= [total]
         return int(m.group(2))
+    m = _PAIRS_RE.search(line)
+    if m:
+        # collective-permute carries source_target_pairs, not
+        # replica_groups: the "group" is the permutation cycle (a ring
+        # handoff over an n-axis is n pairs per ring; follow one cycle)
+        nxt = {}
+        for pair in m.group(1).split("},{"):
+            src, dst = pair.strip("{}").split(",")
+            nxt[int(src)] = int(dst)
+        start = min(nxt)
+        cur, hops = nxt[start], 1
+        while cur != start and cur in nxt and hops <= len(nxt):
+            cur, hops = nxt[cur], hops + 1
+        return hops
     return default
 
 

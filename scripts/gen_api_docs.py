@@ -91,6 +91,8 @@ MODULES = [
     "accelerate_tpu.analysis.searchspace",
     "accelerate_tpu.analysis.tuner",
     "accelerate_tpu.analysis.tune_rules",
+    "accelerate_tpu.analysis.pipemodel",
+    "accelerate_tpu.analysis.pipe_rules",
     "accelerate_tpu.analysis.project_config",
     "accelerate_tpu.analysis.report",
     "accelerate_tpu.telemetry",

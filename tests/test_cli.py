@@ -752,7 +752,7 @@ def test_lint_selfcheck():
     """Every rule detects its seeded-defect fixture (CPU fake mesh)."""
     result = run_cli("lint", "--selfcheck")
     assert result.returncode == 0, result.stdout + result.stderr
-    assert result.stdout.count("detected") == 34  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf + 6 numerics + 5 config
+    assert result.stdout.count("detected") == 39  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf + 6 numerics + 5 config + 5 pipe
     assert "honoured" in result.stdout
     assert "clean idiomatic script: zero findings" in result.stdout
 
