@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check fleet-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -42,6 +42,7 @@ lint:
 	$(MAKE) --no-print-directory numerics-check
 	$(MAKE) --no-print-directory tune-selfcheck
 	$(MAKE) --no-print-directory pipe-check
+	$(MAKE) --no-print-directory fleet-check
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
@@ -55,10 +56,11 @@ lint:
 divergence:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --selfcheck
 
-# Merged SARIF 2.1.0 artifact for GitHub code scanning: the AST tier and
-# the divergence tier each contribute one runs[] entry. Findings don't
-# fail this target (make lint is the gate); the artifact is for PR
-# annotation.
+# Merged SARIF 2.1.0 artifact for GitHub code scanning: the AST,
+# divergence, numerics, pipe, and fleet tiers each contribute one runs[]
+# entry (five runs; scripts/merge_sarif.py's test pins the count).
+# Findings don't fail this target (make lint is the gate); the artifact
+# is for PR annotation.
 lint-sarif:
 	@mkdir -p .cache
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli lint accelerate_tpu --format sarif > .cache/lint.sarif
@@ -66,7 +68,9 @@ lint-sarif:
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli numerics-check accelerate_tpu --format sarif > .cache/numerics.sarif
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli pipe-check \
 		examples/by_feature/pipe_check.py::train_step --mesh pipe=4,data=2 --format sarif > .cache/pipe.sarif
-	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif -o lint-merged.sarif
+	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli fleet-check \
+		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft --format sarif > .cache/fleet.sarif
+	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif .cache/fleet.sarif -o lint-merged.sarif
 
 # Static perf tier: prove TPU501-505 fire on their seeded defects, each
 # clean twin stays silent, and the roofline math matches the hand-computed
@@ -124,6 +128,20 @@ tune-bench:
 pipe-check:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli pipe-check --selfcheck \
 		examples/by_feature/pipe_check.py::train_step --mesh pipe=4,data=2
+
+# Fleet tier (hostsim + fleet_rules): prove TPU901-905 fire on their
+# seeded defects (ABBA deadlock, unlocked cross-thread attribute,
+# sleep-under-lock, protocol-invariant breaks, unjoined worker) and
+# every clean twin stays silent — then dogfood the host-concurrency lint
+# over the real fleet surface AND model-check the replica health state
+# machine extracted from serving_fleet.py against the PR-15 invariants.
+# The gate is STRICT for TPU901 (a reachable ABBA deadlock) and TPU904
+# (a protocol invariant violation or an unpinned failure path) via their
+# error severity; TPU902/903/905 warnings report but pass. Pure stdlib —
+# the fastest gate in the chain.
+fleet-check:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli fleet-check --selfcheck \
+		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft
 
 # Pipeline analyzer A/B on CPU (committed evidence: BENCH_PIPE.json):
 # pipemodel's bubble-adjusted prediction vs StepTelemetry-measured step

@@ -64,11 +64,25 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   bubble over threshold with the covering ``num_microbatches`` priced,
   stage-synchronous collectives inside the tick body (error — the
   strict gate), per-stage activations over the HBM budget.
+* **fleet tier** (``fleet-check``) — host concurrency + the replica
+  protocol, the one tier that analyzes the *host* program instead of
+  the device program (``hostsim`` + ``fleet_rules``, pure stdlib): a
+  per-class lock-order graph and a thread-context-partitioned
+  shared-attribute map over the orchestration layer's own Python yield
+  the TPU90x rules — lock-order inversion (error — the strict gate),
+  cross-thread attribute without its owning lock, blocking call under a
+  lock (stall priced), unjoined/swallowed worker threads — and the
+  replica health state machine is extracted from ``serving_fleet.py``
+  into a :class:`ProtocolSpec` and exhaustively model-checked against
+  the PR-15 invariants (no stranded requests, poisoned KV never ships,
+  the capacity breaker trips iff the last serving replica leaves),
+  every explored failure path pinned to a ``ReplicaChaos`` test.
 
 Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
 ``accelerate-tpu divergence`` / ``accelerate-tpu perf-check`` /
 ``accelerate-tpu numerics-check`` / ``accelerate-tpu tune`` /
-``accelerate-tpu pipe-check`` (commands/)
+``accelerate-tpu pipe-check`` / ``accelerate-tpu fleet-check``
+(commands/)
 and ``Accelerator.lint`` / ``Accelerator.flight_check`` /
 ``Accelerator.perf_check`` / ``Accelerator.numerics_check`` /
 ``Accelerator.tune`` / ``Accelerator.pipe_check``. Suppress a finding
@@ -78,9 +92,21 @@ inline with
 """
 
 from .ast_lint import LintConfig, iter_python_files, lint_file, lint_paths, lint_source
+from .changed import changed_python_files
 from .costmodel import BANDWIDTH_TABLE, CollectiveRecord, TrafficReport, collect_traffic, price_collective
 from .divergence import analyze_file, analyze_paths, analyze_source
+from .fleet_rules import (
+    CHAOS_COVERAGE,
+    CheckReport,
+    ProtocolSpec,
+    coverage_map,
+    extract_protocol_spec,
+    fleet_protocol_check,
+    load_protocol_spec,
+    model_check,
+)
 from .flightcheck import FlightReport, LiveBuffer, estimate_peak_hbm, flight_check
+from .hostsim import host_check_file, host_check_paths, host_check_source
 from .jaxpr_lint import lint_step
 from .numerics import AbsVal, Interval, NumericsInterpreter, NumericsReport, numerics_check
 from .numerics_rules import COMPRESSION_NUMERICS, check_key_reuse_source, check_numerics_rules
@@ -103,6 +129,7 @@ from .searchspace import (
 )
 from .selfcheck import (
     run_divergence_selfcheck,
+    run_fleet_selfcheck,
     run_numerics_selfcheck,
     run_perf_selfcheck,
     run_pipe_selfcheck,
@@ -151,6 +178,19 @@ __all__ = [
     "run_numerics_selfcheck",
     "run_tune_selfcheck",
     "run_pipe_selfcheck",
+    "run_fleet_selfcheck",
+    "host_check_source",
+    "host_check_file",
+    "host_check_paths",
+    "changed_python_files",
+    "ProtocolSpec",
+    "CheckReport",
+    "CHAOS_COVERAGE",
+    "extract_protocol_spec",
+    "load_protocol_spec",
+    "model_check",
+    "fleet_protocol_check",
+    "coverage_map",
     "pipe_check",
     "analyze_pipeline",
     "from_pipelined_model",

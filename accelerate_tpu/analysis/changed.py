@@ -1,0 +1,90 @@
+"""Git-diff-scoped file selection shared by ``lint`` / ``divergence`` /
+``fleet-check`` ``--changed``.
+
+``make lint`` wall-time must stay flat as tiers multiply; the cheap way
+is to lint only what a branch touched. One resolver, used by every
+surface so "changed" means the same thing everywhere:
+
+* diff base = the merge-base with ``origin/main`` (or ``main``) when one
+  exists, else ``HEAD~1``, else the empty tree — so it works on a PR
+  branch, on main itself, and on a fresh repo's first commit;
+* uncommitted work counts (``git diff`` + ``git status`` untracked): the
+  files being edited are exactly the ones worth checking before commit;
+* only existing ``.py`` files are returned (a deleted file has nothing
+  to lint).
+
+When git is unavailable or the directory is not a work tree the
+resolver returns ``None`` and callers fall back to the full path set —
+``--changed`` degrades to a no-op, never to a silent skip of real
+findings.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+from typing import Optional
+
+_CANDIDATE_BASES = ("origin/main", "main")
+
+
+def _git(args, cwd) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", *args],
+            cwd=cwd,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout if out.returncode == 0 else None
+
+
+def diff_base(repo_root=".") -> Optional[str]:
+    """The ref changes are measured against: merge-base with main when it
+    exists and differs from HEAD, else the parent commit."""
+    for ref in _CANDIDATE_BASES:
+        base = _git(["merge-base", "HEAD", ref], repo_root)
+        if base:
+            base = base.strip()
+            head = _git(["rev-parse", "HEAD"], repo_root)
+            if head and base != head.strip():
+                return base
+    if _git(["rev-parse", "HEAD~1"], repo_root):
+        return "HEAD~1"
+    return None
+
+
+def changed_python_files(repo_root=".", base: Optional[str] = None):
+    """``.py`` paths touched since ``base`` (committed, staged, unstaged,
+    and untracked), or ``None`` when git cannot answer — the caller
+    falls back to its full path set."""
+    root = pathlib.Path(repo_root)
+    if _git(["rev-parse", "--is-inside-work-tree"], root) is None:
+        return None
+    base = base or diff_base(root)
+    names: list[str] = []
+    if base is not None:
+        committed = _git(["diff", "--name-only", base, "HEAD"], root)
+        if committed is None:
+            return None
+        names.extend(committed.splitlines())
+    working = _git(["diff", "--name-only", "HEAD"], root)
+    if working is not None:
+        names.extend(working.splitlines())
+    untracked = _git(["ls-files", "--others", "--exclude-standard"], root)
+    if untracked is not None:
+        names.extend(untracked.splitlines())
+    out = []
+    seen = set()
+    for name in names:
+        name = name.strip()
+        if not name.endswith(".py") or name in seen:
+            continue
+        seen.add(name)
+        p = root / name
+        if p.exists():
+            out.append(str(p))
+    return sorted(out)

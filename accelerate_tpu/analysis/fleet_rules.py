@@ -1,0 +1,733 @@
+"""Tier-9b fleet-protocol model checker: the replica health state machine,
+proved instead of sampled.
+
+PR 15's chaos harness *samples* the failure space — crash one replica
+mid-flight, observe token-exactness. This module *enumerates* it: the
+health state machine (``healthy/degraded/quarantined/dead``) and its
+failover/drain/breaker transitions are extracted from
+``serving_fleet.py``'s AST into a declared :class:`ProtocolSpec`, then a
+bounded-but-exhaustive BFS explores every interleaving of the fleet
+events (tick timeout, heal, poison, crash, drain, add_replica, submit,
+migrate) and checks the three invariants the chaos tests can only spot-
+check:
+
+1. **No stranded requests** — after every transition, each request is in
+   exactly one accounted location: pending, a *serving* replica, done,
+   shed, or lost-with-reason. A request owned by a dead/quarantined
+   replica after its migration completed, or routed into a fleet with
+   zero capacity, is stranded.
+2. **Poisoned KV never ships** — a replica quarantined for numerics
+   (``allow_kv=False``) must fail its work over by recompute only; no
+   reachable path takes the KV-handoff edge from a poisoned source.
+3. **The capacity breaker trips iff the last serving replica leaves** —
+   ``shed_on_capacity`` sheds exactly when zero routable replicas
+   remain: never earlier (false sheds), never later (black-hole queue).
+
+Any violation is TPU904 [ERROR] with the event-sequence counterexample.
+The checker also emits a **coverage map**: every explored failure path
+gets a canonical key that :data:`CHAOS_COVERAGE` must pin to a named
+``ReplicaChaos`` test in ``tests/test_fleet.py`` — model-checks =
+chaos-observes, the predicted==measured discipline applied to
+correctness. An explored-but-unpinned path is TPU904 too: new protocol
+states cannot land untested.
+
+Extraction is genuine (a mini constant-evaluator walks ``_classify`` /
+``_on_replica_error`` / ``_on_replica_timeout`` / ``_on_replica_clean``
+/ ``drain`` / ``shed_on_capacity``), so a drive-by edit to the health
+machine drifts the spec and the strict ``make fleet-check`` gate sees it
+before the chaos suite runs. Stdlib-only, like every tier-9 module.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .rules import Finding
+
+#: default extraction sources, relative to the repo/package root.
+_FLEET_MODULE = "serving_fleet.py"
+_SCHED_MODULE = "scheduling.py"
+
+#: exploration bounds: 2 seed replicas + 1 add_replica, 2 requests, and
+#: thresholds capped at 2 keep the reachable set in the low thousands
+#: while still crossing every transition edge (quarantine needs 2
+#: consecutive timeouts; heal needs 2 clean ticks).
+_MAX_REPLICAS = 3
+_N_SEED_REPLICAS = 2
+_N_REQUESTS = 2
+_MAX_ADDS = 1
+_THRESHOLD_CAP = 2
+_STATE_CAP = 500_000
+
+
+# --------------------------------------------------------------------- #
+# the declared protocol
+# --------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    """The replica health protocol as extracted from ``serving_fleet.py``
+    — the model checker's single input, so a seeded defect is one
+    ``dataclasses.replace`` away from the real thing."""
+
+    states: tuple = ("healthy", "degraded", "quarantined", "dead")
+    initial: str = "healthy"
+    serving: frozenset = frozenset({"healthy", "degraded"})
+    #: failure kind -> health state it transitions the replica to
+    target_state: tuple = (("crash", "dead"), ("poison", "quarantined"), ("timeout", "quarantined"))
+    #: failure kind -> is the husk's KV export trusted (allow_kv)? (sorted)
+    kv_trust: tuple = (("crash", True), ("drain", True), ("poison", False), ("timeout", True))
+    #: failure kind -> does the transition migrate the in-flight work? (sorted)
+    migrates: tuple = (("crash", True), ("drain", True), ("poison", True), ("timeout", True))
+    quarantine_after_timeouts: int = 2
+    heal_after_ticks: int = 2
+    #: shed_on_capacity sheds when n_routable <= this; None = breaker absent
+    breaker_trips_at: Optional[int] = 0
+    #: drain refuses to remove the last routable replica
+    drain_requires_other_routable: bool = True
+    #: a sub-threshold timeout demotes healthy -> this state
+    timeout_soft_state: str = "degraded"
+    #: heal_after_ticks clean ticks promote degraded -> this state
+    heal_state: str = "healthy"
+
+    def kind_target(self, kind: str) -> str:
+        return dict(self.target_state)[kind]
+
+    def kind_kv(self, kind: str) -> bool:
+        return dict(self.kv_trust)[kind]
+
+    def kind_migrates(self, kind: str) -> bool:
+        return dict(self.migrates)[kind]
+
+
+# --------------------------------------------------------------------- #
+# chaos coverage: explored failure path -> the ReplicaChaos test that
+# observes it (tests/test_fleet.py). test_fleet_rules drift-gates both
+# directions: every explored path pinned, every pin a real passing test.
+# --------------------------------------------------------------------- #
+
+CHAOS_COVERAGE = {
+    ("crash", "failover"): "test_chaos_crash_matrix_token_and_logprob_exact",
+    ("crash", "capacity_lost"): "test_capacity_lost_sheds_until_add_replica",
+    ("poison", "quarantine_no_kv"): "test_chaos_poison_quarantines_and_never_ships_kv",
+    ("poison", "capacity_lost"): "test_chaos_poison_sole_replica_capacity_lost",
+    ("timeout", "degraded"): "test_hang_degrades_then_quarantines_and_heals",
+    ("timeout", "quarantine"): "test_hang_degrades_then_quarantines_and_heals",
+    ("timeout", "capacity_lost"): "test_chaos_hang_sole_replica_capacity_lost",
+    ("degraded", "heal"): "test_hang_degrades_then_quarantines_and_heals",
+    ("drain", "migrate"): "test_drain_under_load_and_unique_respawn_names",
+    ("drain", "refused_last"): "test_drain_under_load_and_unique_respawn_names",
+    ("capacity_lost", "shed"): "test_capacity_lost_sheds_until_add_replica",
+    ("capacity_lost", "add_replica_recovers"): "test_capacity_lost_sheds_until_add_replica",
+    ("failover", "lost_counted"): "test_fleet_request_error_surfaces",
+}
+
+
+# --------------------------------------------------------------------- #
+# spec extraction: a mini constant-evaluator over the fleet AST
+# --------------------------------------------------------------------- #
+
+
+class _Unknown(Exception):
+    """The mini-evaluator met an expression it cannot fold."""
+
+
+def _const_eval(node: ast.AST, env: dict):
+    """Fold ``node`` to a Python value under ``env`` bindings. Handles
+    exactly the shapes the health-transition call sites use: constants,
+    bound names, attribute tails (``kind``), ``IfExp``, ``==/!=/in/not
+    in`` compares, bool ops, ``not``, and tuples."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise _Unknown(node.id)
+    if isinstance(node, ast.Tuple):
+        return tuple(_const_eval(e, env) for e in node.elts)
+    if isinstance(node, ast.IfExp):
+        return (
+            _const_eval(node.body, env)
+            if _const_eval(node.test, env)
+            else _const_eval(node.orelse, env)
+        )
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return not _const_eval(node.operand, env)
+    if isinstance(node, ast.BoolOp):
+        vals = [_const_eval(v, env) for v in node.values]
+        return all(vals) if isinstance(node.op, ast.And) else any(vals)
+    if isinstance(node, ast.Compare) and len(node.ops) == 1:
+        left = _const_eval(node.left, env)
+        right = _const_eval(node.comparators[0], env)
+        op = node.ops[0]
+        if isinstance(op, ast.Eq):
+            return left == right
+        if isinstance(op, ast.NotEq):
+            return left != right
+        if isinstance(op, ast.In):
+            return left in right
+        if isinstance(op, ast.NotIn):
+            return left not in right
+    raise _Unknown(ast.dump(node))
+
+
+def _find_method(tree: ast.Module, cls: str, name: str) -> Optional[ast.FunctionDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) and item.name == name:
+                    return item
+    return None
+
+
+def _calls_named(func: ast.AST, method: str):
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == method:
+                yield node
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def extract_protocol_spec(
+    fleet_source: str, scheduling_source: str, path: str = _FLEET_MODULE
+):
+    """``(spec, problems)`` — the health protocol read out of the real
+    sources. Every extraction miss lands in ``problems`` (and becomes a
+    TPU904 "spec drifted" finding): the model can only prove what it can
+    still see in the code."""
+    problems: list[str] = []
+    fields: dict = {}
+    try:
+        tree = ast.parse(fleet_source, filename=path)
+    except SyntaxError as e:
+        return None, [f"cannot parse {path}: {e.msg} (line {e.lineno})"]
+
+    # 1. HEALTH_STATES and the serving subset (Replica.is_serving)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "HEALTH_STATES":
+                    try:
+                        fields["states"] = tuple(_const_eval(node.value, {}))
+                    except _Unknown:
+                        problems.append("HEALTH_STATES is not a literal tuple")
+    if "states" not in fields:
+        problems.append("HEALTH_STATES not found at module level")
+    serving_fn = _find_method(tree, "Replica", "is_serving")
+    serving = None
+    if serving_fn is not None:
+        for node in ast.walk(serving_fn):
+            if isinstance(node, ast.Compare) and isinstance(node.ops[0], ast.In):
+                try:
+                    serving = frozenset(_const_eval(node.comparators[0], {}))
+                except _Unknown:
+                    pass
+    if serving is None:
+        problems.append("Replica.is_serving: could not extract the serving-state set")
+    else:
+        fields["serving"] = serving
+
+    # 2. failure kinds from _classify's return constants
+    classify = _find_method(tree, "FleetRouter", "_classify")
+    kinds = []
+    if classify is not None:
+        for node in ast.walk(classify):
+            if isinstance(node, ast.Return) and isinstance(node.value, ast.Constant):
+                if node.value.value not in kinds:
+                    kinds.append(node.value.value)
+    if sorted(kinds) != ["crash", "poison"]:
+        problems.append(f"FleetRouter._classify: expected kinds crash/poison, extracted {kinds}")
+
+    # 3. _on_replica_error: target state + allow_kv per classified kind
+    on_error = _find_method(tree, "FleetRouter", "_on_replica_error")
+    target, kv, migrates = {}, {}, {}
+    if on_error is None:
+        problems.append("FleetRouter._on_replica_error not found")
+    else:
+        set_health = list(_calls_named(on_error, "_set_health"))
+        migrate = list(_calls_named(on_error, "_migrate_all"))
+        for kind in ("crash", "poison"):
+            env = {"kind": kind}
+            try:
+                if set_health:
+                    target[kind] = _const_eval(set_health[0].args[1], env)
+                else:
+                    problems.append("_on_replica_error: no _set_health call")
+                if migrate:
+                    migrates[kind] = True
+                    kv_expr = _kw(migrate[0], "allow_kv")
+                    kv[kind] = (
+                        _const_eval(kv_expr, env) if kv_expr is not None else True
+                    )
+                else:
+                    migrates[kind] = False
+            except _Unknown as e:
+                problems.append(f"_on_replica_error: cannot fold {e} under kind={kind!r}")
+
+    # 4. _on_replica_timeout: threshold field, hard + soft transitions
+    on_timeout = _find_method(tree, "FleetRouter", "_on_replica_timeout")
+    if on_timeout is None:
+        problems.append("FleetRouter._on_replica_timeout not found")
+    else:
+        threshold_seen = soft_seen = False
+        for node in ast.walk(on_timeout):
+            if isinstance(node, ast.If):
+                test_src = ast.dump(node.test)
+                if "quarantine_after_timeouts" in test_src:
+                    threshold_seen = True
+                    sh = list(_calls_named(node, "_set_health"))
+                    mg = list(_calls_named(node, "_migrate_all"))
+                    try:
+                        if sh and isinstance(sh[0].args[1], ast.Constant):
+                            target["timeout"] = sh[0].args[1].value
+                        if mg:
+                            migrates["timeout"] = True
+                            kv_expr = _kw(mg[0], "allow_kv")
+                            kv["timeout"] = (
+                                _const_eval(kv_expr, {}) if kv_expr is not None else True
+                            )
+                        else:
+                            migrates["timeout"] = False
+                    except _Unknown as e:
+                        problems.append(f"_on_replica_timeout: cannot fold {e}")
+                    for sub in node.orelse:
+                        for sh2 in _calls_named(sub, "_set_health"):
+                            if isinstance(sh2.args[1], ast.Constant):
+                                fields["timeout_soft_state"] = sh2.args[1].value
+                                soft_seen = True
+        if not threshold_seen:
+            problems.append(
+                "_on_replica_timeout: no quarantine_after_timeouts threshold branch"
+            )
+        if not soft_seen:
+            problems.append("_on_replica_timeout: no sub-threshold degrade branch")
+
+    # 5. _on_replica_clean: heal transition
+    on_clean = _find_method(tree, "FleetRouter", "_on_replica_clean")
+    heal_seen = False
+    if on_clean is not None:
+        for node in ast.walk(on_clean):
+            if isinstance(node, ast.If) and "heal_after_ticks" in ast.dump(node.test):
+                for sh in _calls_named(node, "_set_health"):
+                    if isinstance(sh.args[1], ast.Constant):
+                        fields["heal_state"] = sh.args[1].value
+                        heal_seen = True
+    if not heal_seen:
+        problems.append("_on_replica_clean: no heal_after_ticks promotion branch")
+
+    # 6. drain: last-replica guard + allow_kv
+    drain = _find_method(tree, "FleetRouter", "drain")
+    if drain is None:
+        problems.append("FleetRouter.drain not found")
+    else:
+        guard = any(
+            isinstance(n, ast.If)
+            and "routable" in ast.dump(n.test)
+            and any(isinstance(s, ast.Raise) for s in n.body)
+            for n in ast.walk(drain)
+        )
+        fields["drain_requires_other_routable"] = guard
+        if not guard:
+            problems.append("FleetRouter.drain: last-routable-replica guard not found")
+        mg = list(_calls_named(drain, "_migrate_all"))
+        if mg:
+            migrates["drain"] = True
+            kv_expr = _kw(mg[0], "allow_kv")
+            try:
+                kv["drain"] = _const_eval(kv_expr, {}) if kv_expr is not None else True
+            except _Unknown as e:
+                problems.append(f"drain: cannot fold allow_kv ({e})")
+        else:
+            migrates["drain"] = False
+            problems.append("FleetRouter.drain: no _migrate_all call")
+
+    # 7. the capacity breaker (scheduling.py shed_on_capacity)
+    breaker = None
+    try:
+        sched_tree = ast.parse(scheduling_source, filename=_SCHED_MODULE)
+    except SyntaxError as e:
+        sched_tree = None
+        problems.append(f"cannot parse {_SCHED_MODULE}: {e.msg}")
+    if sched_tree is not None:
+        fn = None
+        for node in ast.walk(sched_tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == "shed_on_capacity":
+                fn = node
+        if fn is None:
+            problems.append("shed_on_capacity not found in scheduling.py")
+        else:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If) and isinstance(node.test, ast.Compare):
+                    t = node.test
+                    if (
+                        len(t.ops) == 1
+                        and isinstance(t.ops[0], (ast.LtE, ast.Lt, ast.Eq))
+                        and isinstance(t.comparators[0], ast.Constant)
+                        and any(isinstance(s, ast.Return) for s in node.body)
+                    ):
+                        c = t.comparators[0].value
+                        breaker = c if isinstance(t.ops[0], (ast.LtE, ast.Eq)) else c - 1
+            if breaker is None:
+                problems.append("shed_on_capacity: no zero-capacity shed branch")
+    fields["breaker_trips_at"] = breaker
+
+    for kind in ("crash", "poison", "timeout"):
+        if kind not in target:
+            problems.append(f"no extracted target state for kind {kind!r}")
+    fields["target_state"] = tuple(sorted(target.items()))
+    fields["kv_trust"] = tuple(sorted(kv.items()))
+    fields["migrates"] = tuple(sorted(migrates.items()))
+    fields["quarantine_after_timeouts"] = _THRESHOLD_CAP
+    fields["heal_after_ticks"] = _THRESHOLD_CAP
+
+    if problems:
+        return None, problems
+    return ProtocolSpec(**fields), []
+
+
+def load_protocol_spec(package_root=None):
+    """Extract the spec from the installed package sources; ``(spec,
+    problems)``."""
+    root = pathlib.Path(package_root) if package_root else pathlib.Path(__file__).resolve().parent.parent
+    fleet = root / _FLEET_MODULE
+    sched = root / _SCHED_MODULE
+    missing = [str(p) for p in (fleet, sched) if not p.exists()]
+    if missing:
+        return None, [f"source not found: {m}" for m in missing]
+    return extract_protocol_spec(fleet.read_text(), sched.read_text(), path=str(fleet))
+
+
+# --------------------------------------------------------------------- #
+# the model checker
+# --------------------------------------------------------------------- #
+
+
+@dataclass
+class CheckReport:
+    explored_states: int = 0
+    explored_paths: set = field(default_factory=set)
+    violations: list = field(default_factory=list)  # (invariant, trace, detail)
+    truncated: bool = False
+
+
+def _trace(parents, key) -> list[str]:
+    events = []
+    while key in parents:
+        key, ev = parents[key]
+        events.append(ev)
+    return list(reversed(events))
+
+
+def model_check(spec: ProtocolSpec, chaos_coverage=None) -> CheckReport:
+    """Bounded-exhaustive BFS over the fleet protocol. Replica slots carry
+    ``(health, timeouts, clean_ticks, draining)`` or ``None`` once
+    removed; requests carry a location tag. Each transition mirrors one
+    code path in ``serving_fleet.py``; the three invariants are checked
+    on every reachable state."""
+    report = CheckReport()
+    serving = spec.serving
+
+    def routable(reps):
+        return [
+            i
+            for i, r in enumerate(reps)
+            if r is not None and r[0] in serving and not r[3]
+        ]
+
+    def migrate(reps, reqs, src, kind, paths):
+        """Move src's requests to survivors (the _migrate_all semantics):
+        recompute-or-handoff to a routable survivor, else lost-with-
+        reason. Returns the new reqs tuple."""
+        out = list(reqs)
+        survivors = [i for i in routable(reps) if i != src]
+        for q, loc in enumerate(reqs):
+            if loc == ("rep", src):
+                if survivors:
+                    out[q] = ("rep", survivors[0])
+                    if spec.kind_kv(kind):
+                        paths.add(("handoff", kind))
+                else:
+                    out[q] = ("lost",)
+                    paths.add(("failover", "lost_counted"))
+        return tuple(out)
+
+    def check_invariants(reps, reqs, key, parents, event):
+        # invariant 1: every request accounted for, never owned by a
+        # non-serving or removed replica
+        for loc in reqs:
+            if loc[0] == "rep":
+                r = reps[loc[1]] if loc[1] < len(reps) else None
+                if r is None or r[0] not in serving:
+                    report.violations.append(
+                        (
+                            "stranded-request",
+                            _trace(parents, key) + [event],
+                            f"request owned by replica {loc[1]} "
+                            f"({'removed' if r is None else r[0]}) after {event}",
+                        )
+                    )
+                    return False
+            elif loc[0] not in ("pending", "done", "shed", "lost", "unsubmitted"):
+                report.violations.append(
+                    ("stranded-request", _trace(parents, key) + [event], f"unaccounted location {loc}")
+                )
+                return False
+        return True
+
+    # initial state: N healthy replicas, all requests unsubmitted
+    reps0 = tuple(
+        (spec.initial, 0, 0, False) for _ in range(_N_SEED_REPLICAS)
+    )
+    reqs0 = tuple(("unsubmitted",) for _ in range(_N_REQUESTS))
+    init = (reps0, reqs0, 0)  # (replicas, requests, adds_used)
+    seen = {init}
+    parents: dict = {}
+    queue = deque([init])
+
+    while queue:
+        if report.explored_states >= _STATE_CAP:
+            report.truncated = True
+            break
+        state = queue.popleft()
+        report.explored_states += 1
+        reps, reqs, adds = state
+        rt = routable(reps)
+
+        successors = []  # (event-name, new-state, paths-added)
+
+        # -- submit: breaker decision on each unsubmitted request -------- #
+        for q, loc in enumerate(reqs):
+            if loc != ("unsubmitted",):
+                continue
+            sheds = spec.breaker_trips_at is not None and len(rt) <= spec.breaker_trips_at
+            if sheds and len(rt) > 0:
+                report.violations.append(
+                    (
+                        "breaker-mistimed",
+                        _trace(parents, state) + [f"submit(req{q})"],
+                        f"capacity breaker shed with {len(rt)} replica(s) still serving",
+                    )
+                )
+                continue
+            if sheds:
+                paths = {("capacity_lost", "shed")}
+                nr = list(reqs)
+                nr[q] = ("shed",)
+                successors.append((f"submit(req{q})->shed", (reps, tuple(nr), adds), paths))
+            elif not rt:
+                report.violations.append(
+                    (
+                        "breaker-missing",
+                        _trace(parents, state) + [f"submit(req{q})"],
+                        "submit with zero routable replicas did not shed — the request "
+                        "queues into a fleet that can never serve it",
+                    )
+                )
+            else:
+                was_capacity_lost = any(
+                    loc2 == ("shed",) for loc2 in reqs
+                ) and adds > 0
+                for i in rt:
+                    paths = set()
+                    if was_capacity_lost:
+                        paths.add(("capacity_lost", "add_replica_recovers"))
+                    nr = list(reqs)
+                    nr[q] = ("rep", i)
+                    successors.append(
+                        (f"submit(req{q})->rep{i}", (reps, tuple(nr), adds), paths)
+                    )
+            break  # requests are interchangeable; submitting req_q covers all
+
+        # -- completion: a served request finishes ----------------------- #
+        for q, loc in enumerate(reqs):
+            if loc[0] == "rep" and reps[loc[1]] is not None and reps[loc[1]][0] in serving:
+                nr = list(reqs)
+                nr[q] = ("done",)
+                successors.append((f"complete(req{q})", (reps, tuple(nr), adds), set()))
+                break
+
+        # -- per-replica failure / tick events ---------------------------- #
+        for i, r in enumerate(reps):
+            if r is None or r[0] not in serving:
+                continue
+            health, timeouts, clean, draining = r
+
+            # crash / poison
+            for kind in ("crash", "poison"):
+                paths = set()
+                nreps = list(reps)
+                nreps[i] = (spec.kind_target(kind), timeouts, clean, draining)
+                if spec.kind_migrates(kind):
+                    nreqs = migrate(nreps, reqs, i, kind, paths)
+                else:
+                    nreqs = reqs  # seeded-defect shape: work stays behind
+                left = routable(tuple(nreps))
+                owned = any(loc == ("rep", i) for loc in reqs)
+                if kind == "poison":
+                    paths.add(
+                        ("poison", "capacity_lost") if not left else ("poison", "quarantine_no_kv")
+                    )
+                else:
+                    paths.add(("crash", "capacity_lost") if not left else ("crash", "failover"))
+                successors.append((f"{kind}(rep{i})", (tuple(nreps), nreqs, adds), paths))
+
+            # tick timeout
+            paths = set()
+            nreps = list(reps)
+            if timeouts + 1 >= spec.quarantine_after_timeouts:
+                nreps[i] = (spec.kind_target("timeout"), 0, 0, draining)
+                if spec.kind_migrates("timeout"):
+                    nreqs = migrate(nreps, reqs, i, "timeout", paths)
+                else:
+                    nreqs = reqs
+                left = routable(tuple(nreps))
+                paths.add(
+                    ("timeout", "capacity_lost") if not left else ("timeout", "quarantine")
+                )
+            else:
+                soft = spec.timeout_soft_state if health == "healthy" else health
+                nreps[i] = (soft, timeouts + 1, 0, draining)
+                nreqs = reqs
+                paths.add(("timeout", "degraded"))
+            successors.append((f"timeout(rep{i})", (tuple(nreps), nreqs, adds), paths))
+
+            # clean tick (heal path)
+            if health == spec.timeout_soft_state:
+                paths = set()
+                nreps = list(reps)
+                if clean + 1 >= spec.heal_after_ticks:
+                    nreps[i] = (spec.heal_state, 0, 0, draining)
+                    paths.add(("degraded", "heal"))
+                else:
+                    nreps[i] = (health, 0, clean + 1, draining)
+                successors.append((f"clean(rep{i})", (tuple(nreps), reqs, adds), paths))
+
+            # drain
+            others = [j for j in rt if j != i]
+            if spec.drain_requires_other_routable and not others:
+                successors.append((f"drain(rep{i})-refused", state, {("drain", "refused_last")}))
+            else:
+                paths = {("drain", "migrate")}
+                nreps = list(reps)
+                nreps[i] = (health, timeouts, clean, True)
+                if spec.kind_migrates("drain"):
+                    nreqs = migrate(nreps, reqs, i, "drain", paths)
+                else:
+                    nreqs = reqs
+                nreps[i] = None  # _remove_replica
+                # removal must not strand anything that was still owned
+                successors.append((f"drain(rep{i})", (tuple(nreps), nreqs, adds), paths))
+
+        # -- add_replica -------------------------------------------------- #
+        if adds < _MAX_ADDS and len([r for r in reps if r is not None]) < _MAX_REPLICAS:
+            nreps = reps + ((spec.initial, 0, 0, False),)
+            successors.append(("add_replica", (nreps, reqs, adds + 1), set()))
+
+        for event, nstate, paths in successors:
+            # poisoned-KV invariant: a handoff edge from a poison kind
+            if ("handoff", "poison") in paths:
+                report.violations.append(
+                    (
+                        "poisoned-kv-shipped",
+                        _trace(parents, state) + [event],
+                        "a replica quarantined for numerics exported KV on the handoff "
+                        "edge — allow_kv=False must force the recompute path",
+                    )
+                )
+                continue
+            report.explored_paths |= {p for p in paths if p[0] != "handoff"}
+            if not check_invariants(nstate[0], nstate[1], state, parents, event):
+                continue
+            if nstate not in seen:
+                seen.add(nstate)
+                parents[nstate] = (state, event)
+                queue.append(nstate)
+
+    return report
+
+
+# --------------------------------------------------------------------- #
+# findings
+# --------------------------------------------------------------------- #
+
+
+def fleet_protocol_check(
+    spec: Optional[ProtocolSpec] = None,
+    chaos_coverage=None,
+    package_root=None,
+    path: str = "accelerate_tpu/" + _FLEET_MODULE,
+):
+    """``(findings, report)`` — extract (unless a spec is injected), model
+    check, and map violations + unpinned failure paths to TPU904."""
+    findings: list[Finding] = []
+    if spec is None:
+        spec, problems = load_protocol_spec(package_root)
+        if spec is None:
+            for p in problems:
+                findings.append(
+                    Finding(
+                        "TPU904",
+                        f"protocol spec extraction drifted: {p} — the model checker can "
+                        "no longer see the health machine; re-anchor the extractor or the code",
+                        path=path,
+                        line=1,
+                    )
+                )
+            return findings, CheckReport()
+    coverage = CHAOS_COVERAGE if chaos_coverage is None else chaos_coverage
+    report = model_check(spec, coverage)
+    for invariant, trace, detail in report.violations[:8]:
+        findings.append(
+            Finding(
+                "TPU904",
+                f"fleet protocol invariant violated [{invariant}]: {detail} "
+                f"(counterexample: {' -> '.join(trace) if trace else 'initial state'})",
+                path=path,
+                line=1,
+            )
+        )
+    if report.truncated:
+        findings.append(
+            Finding(
+                "TPU904",
+                f"model checker truncated at {_STATE_CAP} states — the protocol grew past "
+                "the exploration bound; raise it or shrink the state",
+                path=path,
+                line=1,
+            )
+        )
+    if not report.violations:
+        for pathkey in sorted(report.explored_paths):
+            if pathkey not in coverage:
+                findings.append(
+                    Finding(
+                        "TPU904",
+                        f"explored failure path {pathkey!r} is pinned to no ReplicaChaos "
+                        "test — model-checks must equal chaos-observes; add the test and "
+                        "the CHAOS_COVERAGE entry",
+                        path=path,
+                        line=1,
+                    )
+                )
+    return findings, report
+
+
+def coverage_map(report: CheckReport, chaos_coverage=None) -> dict:
+    """``{path -> test-or-None}`` for every explored failure path — the
+    emitted model-checks = chaos-observes artifact."""
+    coverage = CHAOS_COVERAGE if chaos_coverage is None else chaos_coverage
+    return {
+        "/".join(p): coverage.get(p)
+        for p in sorted(report.explored_paths)
+    }

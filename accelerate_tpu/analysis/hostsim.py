@@ -1,0 +1,792 @@
+"""Tier-9a host-concurrency lint: locks, threads, and shared state in the
+orchestration layer's plain Python — no jax needed.
+
+Every other tier analyzes the *device* program; this one analyzes the
+host-side code that drives it (``serving_fleet``, ``scheduling``,
+``ft/``): the threads, locks and queues that ROADMAP item 1's
+multi-process fleet grows. The analysis is the ranksim pattern applied
+to concurrency — an AST interpretation that builds three maps and checks
+them against the TPU90x rules:
+
+* a **lock-order graph**: every ``with <lock>:`` nesting (followed one
+  call level deep through ``self.method()`` / local calls, including
+  ``@property`` bodies) adds an edge *held-lock → acquired-lock*; a
+  cycle is TPU901 — two paths that interleave into an ABBA deadlock.
+  Lock identity is normalised per class (``self._lock`` in
+  ``FleetRouter`` and ``rep.lock`` on a ``Replica`` are different
+  nodes even when other code reaches them through different variable
+  names).
+* a **shared-attribute access map** partitioned by thread context (main
+  vs each ``threading.Thread`` target, one call level deep) and by the
+  locks held at each access; an attribute with ≥1 write that is touched
+  from two contexts without a common owning lock is TPU902. Reads
+  through ``@property`` bodies resolve to the attributes the property
+  reads, so ``rep.is_serving`` counts as a read of ``Replica.health``.
+* a **blocking-call set** (``join``/``Queue.get``/``sleep``/
+  ``block_until_ready``/``result``/``wait``/socket ``recv``/``accept``)
+  intersected with held locks: TPU903, with the stall priced like
+  TPU504 (a constant ``sleep`` names the per-call floor; unbounded
+  waits say so).
+* thread lifecycle: a non-daemon ``threading.Thread`` that is never
+  ``join``ed in its creating scope, or a worker-side ``except`` that
+  swallows the exception (``pass``/``continue`` with no re-raise or
+  recording) — TPU905, the pre-PR-15 ``drain_threaded`` bug class.
+
+This module must stay stdlib-only (the ``ast_lint`` contract): it runs
+where jax is absent and is part of the strict ``make fleet-check`` gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .ast_lint import _attr_chain, iter_python_files
+from .rules import Finding, apply_suppressions, filter_findings
+
+#: threading constructors that create a lock-like object.
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"})
+
+#: attribute names treated as locks even without a discovered constructor.
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex)$")
+
+#: threading constructors whose ``.wait()`` blocks.
+_WAITABLE_CTORS = frozenset({"Event", "Condition", "Barrier"})
+
+#: queue constructors whose ``.get()`` blocks.
+_QUEUE_CTORS = frozenset({"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"})
+
+_MAIN = "main"
+
+
+@dataclass
+class _Access:
+    attr: str  # normalised "Class.attr" or "*.attr"
+    line: int
+    write: bool
+    locks: frozenset
+    func: str  # qualified name of the enclosing function
+
+
+@dataclass
+class _LockEdge:
+    src: str
+    dst: str
+    line: int
+    func: str
+
+
+@dataclass
+class _BlockingCall:
+    what: str
+    line: int
+    locks: frozenset
+    stall: str  # priced stall description
+
+
+@dataclass
+class _ThreadSpawn:
+    line: int
+    target: Optional[str]  # resolved function qualname, when local
+    daemon: bool
+    joined: bool
+    var: Optional[str]
+
+
+@dataclass
+class _FuncInfo:
+    qualname: str
+    cls: Optional[str]
+    node: ast.AST
+    is_property: bool = False
+    # locks acquired anywhere in the body (for one-deep edge expansion)
+    acquired: list = field(default_factory=list)  # (lock_key, line)
+    accesses: list = field(default_factory=list)  # _Access
+    edges: list = field(default_factory=list)  # _LockEdge
+    blocking: list = field(default_factory=list)  # _BlockingCall
+    spawns: list = field(default_factory=list)  # _ThreadSpawn
+    calls: list = field(default_factory=list)  # (callee qualname candidates, locks, line)
+    swallows: list = field(default_factory=list)  # except-pass lines
+
+
+class _ModuleModel:
+    """Everything hostsim learns about one module before rule evaluation."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        self.tree = tree
+        self.threading_aliases = self._aliases(tree, "threading")
+        self.time_aliases = self._aliases(tree, "time")
+        # class -> {attr} assigned a lock ctor anywhere in the class
+        self.class_locks: dict[str, set[str]] = {}
+        # class -> {attr} assigned a queue / waitable ctor
+        self.class_queues: dict[str, set[str]] = {}
+        self.class_waitables: dict[str, set[str]] = {}
+        # class -> {attr written via self.attr = ...} (any method)
+        self.class_attrs: dict[str, set[str]] = {}
+        # class -> property name -> attrs read (transitively resolved)
+        self.class_properties: dict[str, dict[str, set[str]]] = {}
+        self.functions: dict[str, _FuncInfo] = {}
+        self._discover()
+
+    @staticmethod
+    def _aliases(tree: ast.Module, module: str) -> set[str]:
+        names = {module}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == module:
+                        names.add(a.asname or a.name)
+        return names
+
+    # -- discovery pass ------------------------------------------------ #
+
+    def _ctor_kind(self, value: ast.AST) -> Optional[str]:
+        """'lock' / 'queue' / 'waitable' when ``value`` constructs one."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _attr_chain(value.func)
+        name = chain[-1] if chain else (value.func.id if isinstance(value.func, ast.Name) else None)
+        if name in _LOCK_CTORS:
+            return "lock"
+        if name in _QUEUE_CTORS:
+            return "queue"
+        if name in _WAITABLE_CTORS:
+            return "waitable"
+        return None
+
+    def _discover(self):
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._discover_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._register_function(node, cls=None, prefix="")
+
+    def _discover_class(self, cls: ast.ClassDef):
+        locks, queues, waits, attrs = set(), set(), set(), set()
+        props: dict[str, set[str]] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            is_prop = any(
+                (isinstance(d, ast.Name) and d.id == "property")
+                or (_attr_chain(d)[-1:] == ["property"])
+                for d in item.decorator_list
+            )
+            if is_prop:
+                props[item.name] = self._self_attr_reads(item)
+            for stmt in ast.walk(item):
+                if isinstance(stmt, ast.Assign):
+                    targets = []
+                    for t in stmt.targets:
+                        targets.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            attrs.add(t.attr)
+                            kind = self._ctor_kind(stmt.value)
+                            if kind == "lock":
+                                locks.add(t.attr)
+                            elif kind == "queue":
+                                queues.add(t.attr)
+                            elif kind == "waitable":
+                                waits.add(t.attr)
+                elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Attribute):
+                    if isinstance(stmt.target.value, ast.Name) and stmt.target.value.id == "self":
+                        attrs.add(stmt.target.attr)
+        self.class_locks[cls.name] = locks
+        self.class_queues[cls.name] = queues
+        self.class_waitables[cls.name] = waits
+        self.class_attrs[cls.name] = attrs
+        self.class_properties[cls.name] = props
+        # transitively resolve property-reads-property within the class
+        for _ in range(3):
+            for p, reads in props.items():
+                extra = set()
+                for r in list(reads):
+                    if r in props and r != p:
+                        extra |= props[r]
+                reads |= extra
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._register_function(item, cls=cls.name, prefix=cls.name + ".")
+
+    @staticmethod
+    def _self_attr_reads(func) -> set[str]:
+        reads = set()
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and isinstance(node.ctx, ast.Load)
+            ):
+                reads.add(node.attr)
+        return reads
+
+    def _register_function(self, node, cls: Optional[str], prefix: str):
+        qual = prefix + node.name
+        is_prop = any(
+            (isinstance(d, ast.Name) and d.id == "property") or (_attr_chain(d)[-1:] == ["property"])
+            for d in node.decorator_list
+        )
+        self.functions[qual] = _FuncInfo(qual, cls, node, is_property=is_prop)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested worker functions get their own summary
+                self._register_function(item, cls=cls, prefix=qual + ".")
+
+    # -- lock identity -------------------------------------------------- #
+
+    def _owner_of(self, attr: str, table: dict[str, set[str]]) -> Optional[str]:
+        owners = [c for c, attrs in table.items() if attr in attrs]
+        return owners[0] if len(owners) == 1 else None
+
+    def lock_key(self, expr: ast.AST, cls: Optional[str], local_kinds: dict) -> Optional[str]:
+        """Normalised lock identity for a ``with`` context expression, or
+        None when it is not a lock. ``ClassName.attr`` when the owner
+        class is known (``self`` receiver, or a unique defining class),
+        ``*.attr`` otherwise; bare names use their local discovery."""
+        if isinstance(expr, ast.Name):
+            if local_kinds.get(expr.id) == "lock" or _LOCK_NAME_RE.search(expr.id):
+                return f"local:{expr.id}"
+            return None
+        chain = _attr_chain(expr)
+        if len(chain) < 2:
+            return None
+        attr = chain[-1]
+        known = any(attr in locks for locks in self.class_locks.values())
+        if not known and not _LOCK_NAME_RE.search(attr):
+            return None
+        if chain[0] == "self" and cls is not None and (attr in self.class_locks.get(cls, ()) or not known):
+            return f"{cls}.{attr}"
+        owner = self._owner_of(attr, self.class_locks)
+        return f"{owner}.{attr}" if owner else f"*.{attr}"
+
+    def attr_key(self, receiver: str, attr: str, cls: Optional[str]) -> Optional[str]:
+        """Normalised shared-attribute identity, or None when the owner
+        cannot be resolved (unknown receiver classes are skipped — the
+        noise would drown the real findings)."""
+        if receiver == "self" and cls is not None:
+            return f"{cls}.{attr}"
+        owner = self._owner_of(attr, self.class_attrs)
+        if owner is None:
+            # a property read resolves to its owner class too
+            owner = self._owner_of(attr, {c: set(p) for c, p in self.class_properties.items()})
+        return f"{owner}.{attr}" if owner else None
+
+    def property_reads(self, key: str) -> Optional[set[str]]:
+        """When ``key`` names a ``@property``, the underlying attr keys it
+        reads (same class); else None."""
+        if "." not in key:
+            return None
+        cls, name = key.split(".", 1)
+        props = self.class_properties.get(cls, {})
+        if name not in props:
+            return None
+        return {f"{cls}.{a}" for a in props[name]}
+
+
+# -- per-function summary pass --------------------------------------------
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Summarise one function: lock nesting edges, attribute accesses with
+    held locks, blocking calls, thread spawns, local calls."""
+
+    def __init__(self, model: _ModuleModel, info: _FuncInfo):
+        self.m = model
+        self.info = info
+        self.held: list[str] = []
+        self.local_kinds: dict[str, str] = {}  # name -> lock/queue/waitable/thread/threads
+        self.thread_vars: dict[str, _ThreadSpawn] = {}
+        self.list_spawns: dict[str, list[_ThreadSpawn]] = {}  # listvar -> spawns
+
+    # -- helpers -------------------------------------------------------- #
+
+    def _locks(self) -> frozenset:
+        return frozenset(self.held)
+
+    def _record_access(self, node: ast.Attribute, write: bool):
+        if not isinstance(node.value, ast.Name):
+            return
+        key = self.m.attr_key(node.value.id, node.attr, self.info.cls)
+        if key is None:
+            return
+        resolved = self.m.property_reads(key)
+        for k in resolved if (resolved and not write) else [key]:
+            self.info.accesses.append(
+                _Access(k, node.lineno, write, self._locks(), self.info.qualname)
+            )
+
+    def _spawn_from_call(self, call: ast.Call) -> Optional[_ThreadSpawn]:
+        chain = _attr_chain(call.func)
+        if not (
+            (chain[-1:] == ["Thread"] and (len(chain) == 1 or chain[0] in self.m.threading_aliases))
+        ):
+            return None
+        target = daemon = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                tchain = _attr_chain(kw.value)
+                if tchain[:1] == ["self"] and self.info.cls and len(tchain) == 2:
+                    target = f"{self.info.cls}.{tchain[1]}"
+                elif len(tchain) == 1:
+                    nested = f"{self.info.qualname}.{tchain[0]}"
+                    target = nested if nested in self.m.functions else tchain[0]
+            elif kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        return _ThreadSpawn(call.lineno, target, bool(daemon), joined=False, var=None)
+
+    # -- statements ----------------------------------------------------- #
+
+    def visit_With(self, node: ast.With):
+        pushed = []
+        for item in node.items:
+            key = self.m.lock_key(item.context_expr, self.info.cls, self.local_kinds)
+            if key is not None:
+                for holder in self.held:
+                    self.info.edges.append(
+                        _LockEdge(holder, key, item.context_expr.lineno, self.info.qualname)
+                    )
+                self.info.acquired.append((key, item.context_expr.lineno))
+                self.held.append(key)
+                pushed.append(key)
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in pushed:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Assign(self, node: ast.Assign):
+        kind = self.m._ctor_kind(node.value)
+        spawn = self._spawn_from_call(node.value) if isinstance(node.value, ast.Call) else None
+        if isinstance(node.value, ast.ListComp) and isinstance(node.value.elt, ast.Call):
+            inner = self._spawn_from_call(node.value.elt)
+            if inner is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.list_spawns[t.id] = [inner]
+                        self.info.spawns.append(inner)
+        for t in node.targets:
+            targets = t.elts if isinstance(t, ast.Tuple) else [t]
+            for tt in targets:
+                if isinstance(tt, ast.Name):
+                    if kind:
+                        self.local_kinds[tt.id] = kind
+                    if spawn is not None:
+                        spawn.var = tt.id
+                        self.thread_vars[tt.id] = spawn
+                        self.info.spawns.append(spawn)
+                elif isinstance(tt, ast.Attribute):
+                    self._record_access(tt, write=True)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if isinstance(node.target, ast.Attribute):
+            self._record_access(node.target, write=True)
+            # += reads the old value too
+            self._record_access(node.target, write=False)
+        self.visit(node.value)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        self._record_access(node, write=isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try):
+        for handler in node.handlers:
+            body = [s for s in handler.body if not isinstance(s, (ast.Expr,)) or not isinstance(s.value, ast.Constant)]
+            if all(isinstance(s, (ast.Pass, ast.Continue)) for s in body):
+                self.info.swallows.append(handler.lineno)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        return  # nested functions get their own walker
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- calls ---------------------------------------------------------- #
+
+    def _blocking(self, node: ast.Call) -> Optional[tuple[str, str]]:
+        """(description, priced stall) when this call blocks."""
+        chain = _attr_chain(node.func)
+        if not chain:
+            return None
+        name = chain[-1]
+        if name == "sleep" and (chain[0] in self.m.time_aliases or len(chain) == 1):
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+                return "time.sleep", f">={arg.value:g}s per call"
+            return "time.sleep", "unbounded"
+        if name == "block_until_ready":
+            return "block_until_ready", "one full device step"
+        if name == "result" and not node.args and len(chain) >= 2:
+            return f"{chain[-2]}.result()", "until the future resolves"
+        if name in ("recv", "accept") and len(chain) >= 2 and chain[0] not in ("os", "signal"):
+            return f"{chain[-2]}.{name}()", "until the peer sends"
+        if name == "select" and chain[0] == "select":
+            return "select.select", "until an fd is ready"
+        recv_kind = self.local_kinds.get(chain[0]) if len(chain) == 2 else None
+        if name == "join" and not node.args and len(chain) >= 2:
+            base = chain[0]
+            if base in ("os", "path", "posixpath", "ntpath") or "path" in chain[:-1]:
+                return None
+            return f"{chain[-2]}.join()", "until the thread exits"
+        if name == "get" and not node.args and len(chain) >= 2:
+            base, attr = chain[0], chain[-2]
+            owner_q = any(attr in qs for qs in self.m.class_queues.values())
+            if recv_kind == "queue" or owner_q or "queue" in attr.lower() or (len(chain) == 2 and "queue" in base.lower()):
+                return f"{attr}.get()", "until an item arrives"
+        if name == "wait":
+            base = chain[-2] if len(chain) >= 2 else chain[0]
+            owner_w = any(base in ws for ws in self.m.class_waitables.values())
+            if self.local_kinds.get(chain[0]) == "waitable" or owner_w:
+                return f"{base}.wait()", "until the event is set"
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        blk = self._blocking(node)
+        if blk is not None:
+            # recorded even lock-free: _expand_one_deep needs the callee's
+            # blocking calls to price them under the caller's held locks
+            what, stall = blk
+            self.info.blocking.append(_BlockingCall(what, node.lineno, self._locks(), stall))
+        # thread lifecycle: t.start()/t.join(), and `for t in threads: t.join()`
+        if chain and chain[-1] == "join" and len(chain) == 2:
+            spawn = self.thread_vars.get(chain[0])
+            if spawn is not None:
+                spawn.joined = True
+            for sp in self.list_spawns.get(chain[0], ()):  # threads.join()? (defensive)
+                sp.joined = True
+        if self._spawn_from_call(node) is not None and not isinstance(
+            getattr(node, "_hostsim_claimed", None), bool
+        ):
+            # bare `threading.Thread(...).start()` expression spawns
+            parent_claimed = any(s.line == node.lineno for s in self.info.spawns)
+            if not parent_claimed:
+                sp = self._spawn_from_call(node)
+                self.info.spawns.append(sp)
+        # local call (one-deep following): self.m(), bare f(), nested f()
+        callee = None
+        if chain[:1] == ["self"] and len(chain) == 2 and self.info.cls:
+            callee = f"{self.info.cls}.{chain[1]}"
+        elif len(chain) == 1:
+            nested = f"{self.info.qualname}.{chain[0]}"
+            callee = nested if nested in self.m.functions else chain[0]
+        if callee is not None and callee in self.m.functions:
+            self.info.calls.append((callee, self._locks(), node.lineno))
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        # `for t in threads: t.join()` marks every spawn in `threads` joined
+        if isinstance(node.iter, ast.Name) and isinstance(node.target, ast.Name):
+            spawns = self.list_spawns.get(node.iter.id)
+            if spawns:
+                for stmt in ast.walk(node):
+                    if (
+                        isinstance(stmt, ast.Call)
+                        and _attr_chain(stmt.func) == [node.target.id, "join"]
+                    ):
+                        for sp in spawns:
+                            sp.joined = True
+        self.generic_visit(node)
+
+
+# -- rule evaluation -------------------------------------------------------
+
+
+def _summarise(model: _ModuleModel):
+    for info in model.functions.values():
+        walker = _FuncWalker(model, info)
+        # walk the body, not the def itself — visit_FunctionDef is a no-op
+        # so *nested* defs are summarised separately, and that would eat
+        # the entry node too
+        for stmt in info.node.body:
+            walker.visit(stmt)
+
+
+def _thread_entry_functions(model: _ModuleModel) -> set[str]:
+    entries = set()
+    for info in model.functions.values():
+        for sp in info.spawns:
+            if sp.target and sp.target in model.functions:
+                entries.add(sp.target)
+    # one call level deep: functions a thread entry calls
+    for entry in list(entries):
+        for callee, _locks, _line in model.functions[entry].calls:
+            entries.add(callee)
+    return entries
+
+
+def _expand_one_deep(model: _ModuleModel):
+    """Propagate one call level: a callee's lock acquisitions become edges
+    from the caller's held locks; callee accesses/blocking inherit the
+    caller's held locks (unioned with their own)."""
+    for info in model.functions.values():
+        for callee, locks, line in info.calls:
+            c = model.functions[callee]
+            for key, kline in c.acquired:
+                for holder in locks:
+                    info.edges.append(_LockEdge(holder, key, line, info.qualname))
+            if locks:
+                for b in c.blocking:
+                    info.blocking.append(
+                        _BlockingCall(b.what, b.line, b.locks | locks, b.stall)
+                    )
+
+
+def _check_lock_order(model: _ModuleModel) -> list[Finding]:
+    edges: dict[tuple[str, str], _LockEdge] = {}
+    # self-loops: re-entering an RLock is legal; a plain Lock self-nest is not.
+    rlock_keys = set()
+    for cls_node in ast.walk(model.tree):
+        if isinstance(cls_node, ast.Assign) and isinstance(cls_node.value, ast.Call):
+            chain = _attr_chain(cls_node.value.func)
+            if chain[-1:] == ["RLock"]:
+                for t in cls_node.targets:
+                    tchain = _attr_chain(t)
+                    if tchain[:1] == ["self"] and len(tchain) == 2:
+                        owner = None
+                        for c, ls in model.class_locks.items():
+                            if tchain[1] in ls:
+                                owner = c
+                                break
+                        rlock_keys.add(f"{owner}.{tchain[1]}" if owner else f"*.{tchain[1]}")
+                    elif len(tchain) == 1:
+                        rlock_keys.add(f"local:{tchain[0]}")
+    for info in model.functions.values():
+        for e in info.edges:
+            if e.src == e.dst and e.src in rlock_keys:
+                continue  # re-entrant by construction
+            edges.setdefault((e.src, e.dst), e)
+    graph: dict[str, set[str]] = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+
+    findings = []
+    reported = set()
+    # find cycles via DFS from each node; report each cycle once (canonical order)
+    def dfs(start, node, path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) >= 1:
+                cyc = tuple(sorted(set(path + [start])))
+                if cyc in reported:
+                    continue
+                reported.add(cyc)
+                hops = path + [start]
+                sites = []
+                for a, b in zip(hops, hops[1:] + [hops[0]]):
+                    if (a, b) in edges:
+                        e = edges[(a, b)]
+                        sites.append(f"{a} -> {b} at line {e.line} ({e.func})")
+                first = edges[(hops[0], hops[1])] if (hops[0], hops[1]) in edges else edges[(hops[-1], hops[0])]
+                findings.append(
+                    Finding(
+                        "TPU901",
+                        "lock-order inversion: "
+                        + "; ".join(sites)
+                        + " — concurrent callers interleave into a deadlock; pick one order and hold it everywhere",
+                        path=model.path,
+                        line=first.line,
+                    )
+                )
+            elif nxt not in path and nxt != start:
+                dfs(start, nxt, path + [nxt])
+
+    for (src, dst), e in sorted(edges.items(), key=lambda kv: kv[1].line):
+        if src == dst:  # non-reentrant self-nest
+            key = tuple(sorted({src}))
+            if key not in reported:
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        "TPU901",
+                        f"non-reentrant lock {src} acquired while already held "
+                        f"(line {e.line}, {e.func}) — a plain Lock self-nest blocks forever; use RLock or restructure",
+                        path=model.path,
+                        line=e.line,
+                    )
+                )
+    for node in sorted(graph):
+        dfs(node, node, [node])
+    return findings
+
+
+def _check_shared_attributes(model: _ModuleModel, entries: set[str]) -> list[Finding]:
+    def context_of(qual: str) -> str:
+        return qual if qual in entries else _MAIN
+
+    by_attr: dict[str, list[tuple[str, _Access]]] = {}
+    for info in model.functions.values():
+        ctx = context_of(info.qualname)
+        for acc in info.accesses:
+            by_attr.setdefault(acc.attr, []).append((ctx, acc))
+        for callee, locks, _line in info.calls:
+            # one-deep: callee accesses run in this caller's context with
+            # the caller's locks added
+            for acc in model.functions[callee].accesses:
+                merged = _Access(acc.attr, acc.line, acc.write, acc.locks | locks, acc.func)
+                by_attr.setdefault(acc.attr, []).append((ctx, merged))
+
+    findings = []
+    for attr, sites in sorted(by_attr.items()):
+        # __init__ runs before the object is published to any other
+        # thread — its unguarded accesses are fine and must not poison
+        # the common-lock intersection
+        sites = [
+            (c, a)
+            for c, a in sites
+            if not (a.func.endswith(".__init__") or a.func == "__init__")
+        ]
+        ctxs = {c for c, _ in sites}
+        if len(ctxs) < 2:
+            continue
+        writes = [(c, a) for c, a in sites if a.write]
+        if not writes:
+            continue
+        # a race is a (write, access) PAIR in different thread contexts
+        # with no lock in common — same-thread pairs never race, and a
+        # properly-guarded cross-thread pair is fine even when some
+        # same-thread access elsewhere skips the lock
+        racing = None
+        for w_ctx, w in writes:
+            for a_ctx, a in sites:
+                if a_ctx != w_ctx and not (w.locks & a.locks):
+                    racing = (w_ctx, w, a_ctx, a)
+                    break
+            if racing:
+                break
+        if racing is None:
+            continue
+        w_ctx, w, a_ctx, a = racing
+        owner_locks = set()
+        for _c, ww in writes:
+            owner_locks |= ww.locks
+        owner = sorted(owner_locks)[0] if owner_locks else None
+        findings.append(
+            Finding(
+                "TPU902",
+                f"{attr} is written at line {w.line} ({w_ctx} context) and "
+                f"{'written' if a.write else 'read'} at line {a.line} ({a_ctx}) with no "
+                "lock in common"
+                + (
+                    f" — hold {owner} on both sides"
+                    if owner
+                    else " — no lock guards any access; pick one and hold it everywhere"
+                ),
+                path=model.path,
+                line=w.line,
+            )
+        )
+    return findings
+
+
+def _check_blocking(model: _ModuleModel) -> list[Finding]:
+    findings = []
+    seen = set()
+    for info in model.functions.values():
+        for b in info.blocking:
+            if not b.locks:
+                continue  # lock-free waits are fine; kept only for expansion
+            key = (b.line, b.what)
+            if key in seen:
+                continue
+            seen.add(key)
+            locks = ", ".join(sorted(b.locks))
+            findings.append(
+                Finding(
+                    "TPU903",
+                    f"blocking call {b.what} while holding {locks} — every thread contending "
+                    f"the lock stalls {b.stall}; move the wait outside the critical section",
+                    path=model.path,
+                    line=b.line,
+                )
+            )
+    return findings
+
+
+def _check_thread_lifecycle(model: _ModuleModel, entries: set[str]) -> list[Finding]:
+    findings = []
+    for info in model.functions.values():
+        for sp in info.spawns:
+            if not sp.daemon and not sp.joined:
+                findings.append(
+                    Finding(
+                        "TPU905",
+                        f"non-daemon thread spawned in {info.qualname} is never joined — "
+                        "the process cannot exit while it runs and its exception (if any) vanishes; "
+                        "join it (or pass daemon=True for a best-effort worker)",
+                        path=model.path,
+                        line=sp.line,
+                    )
+                )
+    for entry in sorted(entries):
+        for line in model.functions[entry].swallows:
+            findings.append(
+                Finding(
+                    "TPU905",
+                    f"worker {entry} swallows its exception (except: pass) — the thread dies "
+                    "silently and the fleet never observes the fault; record it for the "
+                    "spawning thread to classify (the drain_threaded errors-list pattern)",
+                    path=model.path,
+                    line=line,
+                )
+            )
+    return findings
+
+
+# -- entry points ----------------------------------------------------------
+
+
+def host_check_source(
+    text: str, path: str = "<string>", select=None, ignore=()
+) -> list[Finding]:
+    """Run the TPU901/902/903/905 host-concurrency lint over one module's
+    source text; suppressions and select/ignore applied."""
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Finding("TPU003", f"syntax error: {e.msg}", path=path, line=e.lineno or 1)]
+    model = _ModuleModel(tree, path)
+    _summarise(model)
+    _expand_one_deep(model)
+    entries = _thread_entry_functions(model)
+    findings = (
+        _check_lock_order(model)
+        + _check_shared_attributes(model, entries)
+        + _check_blocking(model)
+        + _check_thread_lifecycle(model, entries)
+    )
+    findings = apply_suppressions(findings, text.splitlines())
+    findings = filter_findings(findings, select=select, ignore=ignore)
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.path, f.line, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    unique.sort(key=lambda f: (f.path or "", f.line or 0, f.rule))
+    return unique
+
+
+def host_check_file(path, select=None, ignore=()) -> list[Finding]:
+    p = pathlib.Path(path)
+    return host_check_source(p.read_text(), path=str(p), select=select, ignore=ignore)
+
+
+def host_check_paths(paths: Iterable, select=None, ignore=()) -> list[Finding]:
+    """Host-concurrency lint over every ``.py`` file under ``paths``."""
+    findings: list[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(host_check_file(f, select=select, ignore=ignore))
+    return findings

@@ -54,6 +54,20 @@ static_analysis.md for the worked catalogue):
   stage-synchronous collective inside the tick body (the MPMD
   deadlock/serialization class — error severity, the strict gate), and
   per-stage live activations over the HBM budget with remat off.
+* ``TPU9xx`` — host-concurrency & fleet-protocol rules
+  (``analysis.hostsim`` + ``analysis.fleet_rules``) over the host-side
+  Python the other tiers never see (threads, locks, the replica health
+  protocol in ``serving_fleet``): lock-order inversion cycles in the
+  per-class ``with lock:`` nesting graph followed one call level deep
+  (error severity — a reachable ABBA deadlock, the strict gate),
+  attributes shared across thread contexts without their owning lock,
+  blocking calls (join/Queue.get/sleep/``block_until_ready``/socket
+  recv) while a lock is held with the stall priced, a violated
+  fleet-protocol invariant found by exhaustively model-checking the
+  declared replica health state machine (error severity — the strict
+  gate; also fired for an explored failure path with no pinned
+  ``ReplicaChaos`` test), and non-daemon threads never joined / worker
+  exceptions swallowed (the pre-PR-15 ``drain_threaded`` bug class).
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -79,6 +93,7 @@ TIER_PERF = "perf"
 TIER_NUMERICS = "numerics"
 TIER_CONFIG = "config"
 TIER_PIPE = "pipe"
+TIER_HOST = "host"
 
 
 @dataclass(frozen=True)
@@ -145,6 +160,12 @@ RULES: dict[str, Rule] = {
         Rule("TPU803", "pipeline-bubble-over-threshold", WARNING, TIER_PIPE, "bubble fraction above threshold — too few microbatches for the stage count; the covering num_microbatches is named and priced"),
         Rule("TPU804", "collective-over-pipe-axis-in-tick", ERROR, TIER_PIPE, "non-ppermute collective over the pipe axis inside the tick body — stages run different microbatches (MPMD), so it deadlocks or serializes the schedule"),
         Rule("TPU805", "pipeline-stage-hbm-over-budget", WARNING, TIER_PIPE, "per-stage live activations exceed the HBM budget with remat off — checkpointing the stage boundary is priced"),
+        # -- tier 9: host concurrency & fleet protocol (analysis.hostsim + analysis.fleet_rules)
+        Rule("TPU901", "lock-order-inversion", ERROR, TIER_HOST, "two locks are nested in opposite orders on different paths — a reachable ABBA deadlock under concurrent callers"),
+        Rule("TPU902", "unlocked-cross-thread-attribute", WARNING, TIER_HOST, "attribute written in one thread context and accessed in another without the owning lock — a data race the GIL only hides per-bytecode"),
+        Rule("TPU903", "blocking-call-under-lock", WARNING, TIER_HOST, "blocking call (join/Queue.get/sleep/block_until_ready/socket recv) while holding a lock — every contender stalls for the full wait"),
+        Rule("TPU904", "fleet-protocol-invariant-violated", ERROR, TIER_HOST, "exhaustive exploration of the replica health state machine reaches a state violating a declared invariant (stranded request, poisoned-KV handoff, mistimed capacity breaker) or an unpinned failure path"),
+        Rule("TPU905", "unjoined-thread-or-swallowed-worker-error", WARNING, TIER_HOST, "non-daemon thread never joined, or a worker except-clause that drops the exception — the fault is invisible to the fleet"),
     )
 }
 

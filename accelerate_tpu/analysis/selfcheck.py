@@ -946,6 +946,200 @@ def run_pipe_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     return ok, lines
 
 
+# --------------------------------------------------------------------- #
+# tier-9 fixtures: host concurrency (TPU901/902/903/905 source pairs) and
+# the fleet protocol (TPU904 seeded-defect specs). Pure stdlib — this
+# selfcheck needs neither jax nor a mesh, matching the fleet-check CLI's
+# no-device contract.
+# --------------------------------------------------------------------- #
+
+_HOST_FIXTURES = {
+    # (seeded source, clean twin). Twins fix exactly the seeded defect.
+    "TPU901": (
+        """
+import threading
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def route(self):
+        with self._lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            with self._lock:
+                pass
+""",
+        """
+import threading
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def route(self):
+        with self._lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._lock:
+            with self._stats_lock:
+                pass
+""",
+    ),
+    "TPU902": (
+        """
+import threading
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.health = "healthy"
+
+    def set_health(self, v):
+        self.health = v
+
+    def drain(self):
+        def worker():
+            if self.health == "healthy":
+                pass
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        self.set_health("dead")
+""",
+        """
+import threading
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.health = "healthy"
+
+    def set_health(self, v):
+        with self._lock:
+            self.health = v
+
+    def drain(self):
+        def worker():
+            with self._lock:
+                if self.health == "healthy":
+                    pass
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        self.set_health("dead")
+""",
+    ),
+    "TPU903": (
+        """
+import threading, time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        with self._lock:
+            time.sleep(0.5)
+""",
+        """
+import threading, time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        time.sleep(0.5)
+        with self._lock:
+            pass
+""",
+    ),
+    "TPU905": (
+        """
+import threading
+
+def launch(work):
+    t = threading.Thread(target=work)
+    t.start()
+""",
+        """
+import threading
+
+def launch(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+""",
+    ),
+}
+
+
+def run_fleet_selfcheck() -> tuple[bool, list[str]]:
+    """Prove TPU901-TPU905 each fire on a seeded defect and each clean
+    twin stays silent. The host-lint fixtures are source pairs; the
+    TPU904 fixtures are seeded :class:`ProtocolSpec` defects (migration
+    dropped, poisoned KV trusted, breaker unwired) with the spec
+    extracted from the REAL ``serving_fleet.py`` as the clean twin — so
+    this selfcheck is also the proof that the three PR-15 invariants
+    hold over the shipped state machine."""
+    import dataclasses
+
+    from .fleet_rules import fleet_protocol_check, load_protocol_spec
+    from .hostsim import host_check_source
+
+    lines: list[str] = []
+    ok = True
+
+    def record(rule: str, fired: bool, twin_findings):
+        nonlocal ok
+        ok &= fired
+        lines.append(f"[fleet selfcheck] {rule} fixture: {'detected' if fired else 'MISSED'}")
+        quiet = not twin_findings
+        ok &= quiet
+        lines.append(
+            f"[fleet selfcheck] {rule} clean twin: "
+            + ("zero findings" if quiet else "DIRTY: " + ", ".join(f.rule for f in twin_findings))
+        )
+
+    for rule, (seeded, twin) in sorted(_HOST_FIXTURES.items()):
+        found = host_check_source(seeded, path=f"<selfcheck:{rule}>", select=(rule,))
+        fired = any(f.rule == rule for f in found)
+        twin_found = host_check_source(twin, path=f"<selfcheck:{rule}:twin>")
+        record(rule, fired, twin_found)
+
+    # TPU904: three seeded protocol defects, one per invariant; the clean
+    # twin is the spec extracted from the real fleet sources
+    spec, problems = load_protocol_spec()
+    if spec is None:
+        ok = False
+        lines.append(
+            "[fleet selfcheck] TPU904 fixture: MISSED (spec extraction drifted: "
+            + "; ".join(problems) + ")"
+        )
+        return ok, lines
+    defects = [
+        dataclasses.replace(
+            spec, migrates=tuple((k, k != "crash" and v) for k, v in spec.migrates)
+        ),
+        dataclasses.replace(
+            spec, kv_trust=tuple((k, True if k == "poison" else v) for k, v in spec.kv_trust)
+        ),
+        dataclasses.replace(spec, breaker_trips_at=None),
+    ]
+    fired = all(
+        any(f.rule == "TPU904" for f in fleet_protocol_check(spec=d)[0]) for d in defects
+    )
+    twin_found, _report = fleet_protocol_check(spec=spec)
+    record("TPU904", fired, twin_found)
+    return ok, lines
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -994,6 +1188,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     pipe_ok, pipe_lines = run_pipe_selfcheck(mesh)
     ok &= pipe_ok
     lines.extend(pipe_lines)
+
+    fleet_ok, fleet_lines = run_fleet_selfcheck()
+    ok &= fleet_ok
+    lines.extend(fleet_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

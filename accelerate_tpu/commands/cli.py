@@ -12,6 +12,7 @@ from .divergence import divergence_parser
 from .env import env_parser
 from .estimate import estimate_parser
 from .fleet import fleet_parser
+from .fleetcheck import fleetcheck_parser
 from .flightcheck import flightcheck_parser
 from .launch import launch_parser
 from .lint import lint_parser
@@ -40,6 +41,7 @@ def main():
     flightcheck_parser(subparsers)
     perfcheck_parser(subparsers)
     pipecheck_parser(subparsers)
+    fleetcheck_parser(subparsers)
     numericscheck_parser(subparsers)
     tune_parser(subparsers)
     divergence_parser(subparsers)

@@ -34,6 +34,10 @@ def divergence_parser(subparsers=None):
     else:
         parser = argparse.ArgumentParser("accelerate-tpu divergence")
     parser.add_argument("targets", nargs="*", help="Files, directories, or file.py::fn entry points")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="Analyze only git-touched .py files (falls back to the given targets without git)",
+    )
     parser.add_argument("--ranks", type=int, default=None, help="Synthetic ranks to simulate (default: 3, or .tpulint.toml)")
     parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
     parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
@@ -61,9 +65,20 @@ def divergence_command(args) -> int:
     cfg = load_project_config()
     fmt = cfg.resolve_format(args.format)
 
-    if not args.targets and not args.selfcheck:
-        print("usage: accelerate-tpu divergence [file.py | file.py::fn | dir ...] [--selfcheck]")
+    if not args.targets and not args.selfcheck and not args.changed:
+        print("usage: accelerate-tpu divergence [file.py | file.py::fn | dir ...] [--changed] [--selfcheck]")
         return 2
+
+    if args.changed:
+        from accelerate_tpu.analysis.changed import changed_python_files
+
+        scoped = changed_python_files()
+        if scoped is None:
+            import sys
+
+            print("divergence: --changed needs a git work tree; analyzing the full targets", file=sys.stderr)
+        else:
+            args.targets = scoped
 
     if args.selfcheck:
         from accelerate_tpu.analysis.selfcheck import run_divergence_selfcheck

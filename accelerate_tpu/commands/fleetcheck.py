@@ -1,0 +1,167 @@
+"""``accelerate-tpu fleet-check`` — the TPU9xx host-concurrency +
+fleet-protocol gate, before any thread is spawned.
+
+Two halves, both pure stdlib (no jax, no devices — this is the one
+analyzer that runs identically on a laptop and in the lint CI job):
+
+* the **host lint** (``analysis.hostsim``) over the given paths:
+  TPU901 lock-order inversion [ERROR, strict gate], TPU902 cross-thread
+  attribute without its owning lock, TPU903 blocking call under a lock
+  (stall priced), TPU905 unjoined non-daemon thread / swallowed worker
+  exception;
+* the **protocol model checker** (``analysis.fleet_rules``): extracts
+  the replica health state machine from ``serving_fleet.py``,
+  exhaustively explores the event interleavings, and proves the PR-15
+  invariants — no stranded requests, poisoned KV never ships, the
+  capacity breaker trips iff the last serving replica leaves — TPU904
+  [ERROR] on any violation or any explored failure path not pinned to a
+  ``ReplicaChaos`` test. It runs by default (it needs no paths);
+  ``--no-protocol`` skips it when linting non-fleet code.
+
+Examples::
+
+    accelerate-tpu fleet-check accelerate_tpu/serving_fleet.py accelerate_tpu/ft
+    accelerate-tpu fleet-check --changed            # only git-touched files
+    accelerate-tpu fleet-check --selfcheck          # prove TPU901-905 fire, twins clean
+    accelerate-tpu fleet-check pkg/ --format sarif  # CI PR annotation
+
+``--format json`` embeds the model checker's coverage map (explored
+failure path -> the chaos test that observes it) next to the findings.
+A ``.tpulint.toml`` supplies default format, disabled rules, and
+per-path suppressions; CLI flags win.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def fleetcheck_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "fleet-check",
+            help="Host-concurrency lint + fleet-protocol model check (TPU9xx)",
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu fleet-check")
+    parser.add_argument("paths", nargs="*", help="Files or directories to lint (.py files)")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="Lint only git-touched .py files (falls back to the given paths without git)",
+    )
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
+    parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
+    parser.add_argument(
+        "--no-protocol", action="store_true",
+        help="Skip the serving_fleet.py protocol model check (lint paths only)",
+    )
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU901-905 fire on seeded defects and the clean twins stay silent",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=fleetcheck_command)
+    return parser
+
+
+def _split_ids(raw):
+    return frozenset(p.strip().upper() for p in raw.split(",") if p.strip()) or None
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.analysis.selfcheck import run_fleet_selfcheck
+
+    ok, lines = run_fleet_selfcheck()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("fleet-check selfcheck FAILED")
+        return 1
+    return 0
+
+
+def fleetcheck_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not (args.paths or args.changed):
+            return rc
+
+    if not args.paths and not args.changed and args.no_protocol:
+        print(
+            "usage: accelerate-tpu fleet-check [paths ...] [--changed] [--selfcheck]"
+        )
+        return 2
+
+    from accelerate_tpu.analysis import exit_code, render_sarif, render_text
+    from accelerate_tpu.analysis.fleet_rules import coverage_map, fleet_protocol_check
+    from accelerate_tpu.analysis.hostsim import host_check_paths
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    fmt = cfg.resolve_format(args.format)
+    select = cfg.merge_select(_split_ids(args.select) if args.select else None)
+    ignore = cfg.merge_ignore(_split_ids(args.ignore) or frozenset())
+
+    paths = list(args.paths)
+    if args.changed:
+        from accelerate_tpu.analysis.changed import changed_python_files
+
+        scoped = changed_python_files()
+        if scoped is None:
+            import sys
+
+            print(
+                "fleet-check: --changed needs a git work tree; linting the full paths",
+                file=sys.stderr,
+            )
+        else:
+            paths = scoped
+
+    findings = host_check_paths(paths, select=select, ignore=ignore) if paths else []
+    protocol = None
+    if not args.no_protocol:
+        proto_findings, report = fleet_protocol_check()
+        if select is not None:
+            proto_findings = [f for f in proto_findings if f.rule in select]
+        if ignore:
+            proto_findings = [f for f in proto_findings if f.rule not in ignore]
+        findings = findings + proto_findings
+        protocol = {
+            "explored_states": report.explored_states,
+            "truncated": report.truncated,
+            "coverage": coverage_map(report),
+        }
+    findings = cfg.apply_suppressions(findings)
+
+    if fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in findings],
+                    "protocol": protocol,
+                },
+                indent=2,
+            )
+        )
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        if protocol is not None:
+            pinned = sum(1 for t in protocol["coverage"].values() if t)
+            print(
+                f"protocol: {protocol['explored_states']} states explored, "
+                f"{len(protocol['coverage'])} failure paths, {pinned} pinned to chaos tests"
+            )
+        print(render_text(findings))
+    return exit_code(findings, strict=args.strict)
+
+
+def main():
+    raise SystemExit(fleetcheck_command(fleetcheck_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,11 @@ def lint_parser(subparsers=None):
     else:
         parser = argparse.ArgumentParser("accelerate-tpu lint")
     parser.add_argument("paths", nargs="*", help="Files or directories to lint (.py files)")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="Lint only git-touched .py files (keeps make lint flat as tiers grow; "
+        "falls back to the given paths without git)",
+    )
     parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
     parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
     parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
@@ -66,9 +71,20 @@ def lint_command(args) -> int:
     cfg = load_project_config()
     fmt = cfg.resolve_format(args.format)
 
-    if not args.paths and not args.selfcheck:
-        print("usage: accelerate-tpu lint [paths ...] [--selfcheck]")
+    if not args.paths and not args.selfcheck and not args.changed:
+        print("usage: accelerate-tpu lint [paths ...] [--changed] [--selfcheck]")
         return 2
+
+    if args.changed:
+        from accelerate_tpu.analysis.changed import changed_python_files
+
+        scoped = changed_python_files()
+        if scoped is None:
+            import sys
+
+            print("lint: --changed needs a git work tree; linting the full paths", file=sys.stderr)
+        else:
+            args.paths = scoped
 
     rc = 0
     if args.selfcheck:

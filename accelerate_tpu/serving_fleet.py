@@ -918,9 +918,13 @@ class FleetRouter:
         raise ValueError(f"unknown replica {name!r} (have {[r.name for r in self.replicas]})")
 
     def _set_health(self, rep: Replica, state: str, reason: str = "") -> None:
-        if rep.health == state:
-            return
-        prev, rep.health = rep.health, state
+        # rep.lock (an RLock, and always ordered BEFORE self._lock) so the
+        # drain_threaded workers' is_serving checks can't read a torn
+        # transition — the TPU902 finding this tier was built to catch
+        with rep.lock:
+            if rep.health == state:
+                return
+            prev, rep.health = rep.health, state
         rep.engine.metrics.on_replica_state(HEALTH_STATES.index(state))
         rep.engine._log.event(
             "replica_state", replica=rep.name, prev=prev, state=state, reason=reason
@@ -1348,10 +1352,13 @@ class FleetRouter:
 
         def worker(rep: Replica):
             while not stop.is_set():
-                if not rep.is_serving:
-                    return
                 try:
                     with rep.lock:
+                        # health is read under the same lock _set_health
+                        # writes it: a failover on the caller's thread
+                        # can't interleave with a half-observed state
+                        if not rep.is_serving:
+                            return
                         busy = rep.busy
                         if busy:
                             rep.engine.step()

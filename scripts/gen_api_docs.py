@@ -93,6 +93,9 @@ MODULES = [
     "accelerate_tpu.analysis.tune_rules",
     "accelerate_tpu.analysis.pipemodel",
     "accelerate_tpu.analysis.pipe_rules",
+    "accelerate_tpu.analysis.hostsim",
+    "accelerate_tpu.analysis.fleet_rules",
+    "accelerate_tpu.analysis.changed",
     "accelerate_tpu.analysis.project_config",
     "accelerate_tpu.analysis.report",
     "accelerate_tpu.telemetry",

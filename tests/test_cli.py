@@ -752,9 +752,168 @@ def test_lint_selfcheck():
     """Every rule detects its seeded-defect fixture (CPU fake mesh)."""
     result = run_cli("lint", "--selfcheck")
     assert result.returncode == 0, result.stdout + result.stderr
-    assert result.stdout.count("detected") == 39  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf + 6 numerics + 5 config + 5 pipe
+    assert result.stdout.count("detected") == 44  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf + 6 numerics + 5 config + 5 pipe + 5 fleet
     assert "honoured" in result.stdout
     assert "clean idiomatic script: zero findings" in result.stdout
+
+
+# --------------------------------------------------------------------------- #
+# accelerate-tpu fleet-check (TPU9xx host-concurrency + protocol gate)
+# --------------------------------------------------------------------------- #
+
+_DEADLOCK_SRC = """\
+import threading
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def route(self):
+        with self._lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            with self._lock:
+                pass
+"""
+
+
+def test_fleet_check_dogfoods_clean_and_proves_protocol():
+    result = run_cli(
+        "fleet-check",
+        "accelerate_tpu/serving_fleet.py", "accelerate_tpu/scheduling.py", "accelerate_tpu/ft",
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "protocol:" in result.stdout and "states explored" in result.stdout
+    assert "0 finding(s)" in result.stdout
+
+
+def test_fleet_check_selfcheck():
+    result = run_cli("fleet-check", "--selfcheck")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert result.stdout.count("detected") == 5  # TPU901/902/903/905 + 904
+    assert result.stdout.count("clean twin") == 5
+    assert "MISSED" not in result.stdout and "DIRTY" not in result.stdout
+
+
+def test_fleet_check_seeded_deadlock_gates_strictly(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_DEADLOCK_SRC)
+    result = run_cli("fleet-check", str(bad), "--no-protocol")
+    assert result.returncode == 1  # TPU901 is error severity: strict by default
+    assert "TPU901" in result.stdout
+
+    sarif = run_cli("fleet-check", str(bad), "--no-protocol", "--format", "sarif")
+    doc = json.loads(sarif.stdout)
+    assert [r["ruleId"] for r in doc["runs"][0]["results"]] == ["TPU901"]
+
+
+def test_fleet_check_json_embeds_full_coverage_map():
+    result = run_cli("fleet-check", "--format", "json")
+    assert result.returncode == 0, result.stdout + result.stderr
+    doc = json.loads(result.stdout)
+    assert doc["findings"] == []
+    proto = doc["protocol"]
+    assert proto["explored_states"] > 1000 and not proto["truncated"]
+    # model-checks = chaos-observes: every explored path pinned to a test
+    assert proto["coverage"] and all(t for t in proto["coverage"].values())
+    assert proto["coverage"]["poison/quarantine_no_kv"].startswith("test_chaos_poison")
+
+
+def _seed_git_repo(repo):
+    def git(*a):
+        subprocess.run(
+            ["git", *a], cwd=repo, capture_output=True, check=True,
+            env={**CPU_ENV, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t", "HOME": str(repo)},
+        )
+    git("init", "-b", "main")
+    # a committed file with findings that --changed must NOT rescan
+    (repo / "old.py").write_text("import os\n")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+
+
+def test_lint_changed_scopes_to_git_touched_files(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _seed_git_repo(repo)
+    (repo / "new.py").write_text("import os\n")  # untracked: in scope
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "lint", "--changed", "--format", "json"],
+        capture_output=True, text=True, env=CPU_ENV, cwd=repo, timeout=240,
+    )
+    assert result.returncode == 1, result.stdout + result.stderr  # TPU001 is an error
+    paths = {f["path"] for f in json.loads(result.stdout)}
+    assert paths and all(p.endswith("new.py") for p in paths), paths
+
+
+def test_divergence_changed_scopes_too(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _seed_git_repo(repo)
+    (repo / "diverge.py").write_text(
+        '"""Changed file with a rank-divergent gather."""\n'
+        "def main(accelerator):\n"
+        "    if accelerator.is_main_process:\n"
+        "        accelerator.gather(1)\n"
+    )
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "divergence", "--changed", "--format", "json"],
+        capture_output=True, text=True, env=CPU_ENV, cwd=repo, timeout=240,
+    )
+    assert result.returncode == 1, result.stdout + result.stderr
+    findings = json.loads(result.stdout)
+    assert {f["rule"] for f in findings} == {"TPU401"}
+    assert all(f["path"].endswith("diverge.py") for f in findings)
+
+
+def test_fleet_check_changed_scopes_too(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _seed_git_repo(repo)
+    (repo / "dead.py").write_text(_DEADLOCK_SRC)
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "fleet-check",
+         "--changed", "--no-protocol"],
+        capture_output=True, text=True, env=CPU_ENV, cwd=repo, timeout=240,
+    )
+    assert result.returncode == 1
+    assert "TPU901" in result.stdout and "old.py" not in result.stdout
+
+
+def test_lint_sarif_merges_five_runs(tmp_path):
+    """The Makefile's lint-sarif artifact carries one runs[] entry per
+    analysis tier — AST, divergence, numerics, pipe, fleet. Pin the count
+    in the recipe AND prove merge_sarif keeps all five."""
+    makefile = open(os.path.join(os.path.dirname(__file__), "..", "Makefile")).read()
+    recipe = makefile.split("lint-sarif:")[1].split("\n\n")[0]
+    inputs = [tok for tok in recipe.split() if tok.startswith(".cache/") and tok.endswith(".sarif")]
+    merge_line = next(l for l in recipe.splitlines() if "merge_sarif.py" in l)
+    merged_inputs = [t for t in merge_line.split() if t.endswith(".sarif") and t != "lint-merged.sarif"]
+    assert len(merged_inputs) == 5, merged_inputs
+    assert ".cache/fleet.sarif" in merged_inputs and ".cache/pipe.sarif" in merged_inputs
+    assert sorted(set(inputs)) == sorted(merged_inputs)
+
+    from accelerate_tpu.analysis import Finding, render_sarif
+
+    files = []
+    for i in range(5):
+        p = tmp_path / f"run{i}.sarif"
+        p.write_text(render_sarif([Finding("TPU901", f"finding {i}")]))
+        files.append(str(p))
+    merged_path = tmp_path / "merged.sarif"
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    result = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "merge_sarif.py"), *files,
+         "-o", str(merged_path)],
+        capture_output=True, text=True, env=CPU_ENV,
+    )
+    assert result.returncode == 0, result.stderr
+    assert len(json.loads(merged_path.read_text())["runs"]) == 5
 
 
 # --------------------------------------------------------------------------- #

@@ -749,6 +749,88 @@ def test_capacity_lost_sheds_until_add_replica(tiny_llama):
     np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
 
 
+def test_chaos_poison_sole_replica_capacity_lost(tiny_llama):
+    """Poisoning the ONLY replica quarantines it with nowhere to migrate:
+    the in-flight request is honestly lost (allow_kv=False — nothing is
+    pasted anywhere), the breaker sheds new submissions, and add_replica
+    restores service. Pins the model checker's poison/capacity_lost
+    path (analysis.fleet_rules.CHAOS_COVERAGE)."""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=1, config=FleetConfig(prefix_reuse=False),
+        num_slots=2, prompt_buckets=(4, 8),
+    )
+    u_doomed = fr.submit(_FT_PROMPTS[0], max_new_tokens=2)
+    fr.fail_replica("r0", error=RuntimeError("nonfinite logits from watchdog"))
+    h = fr.health()["r0"]
+    assert h["health"] == "quarantined" and "nonfinite" in h["last_error"]
+    acct = fr.failover_accounting()
+    assert acct["failovers_lost"] == 1 and acct["failovers_kv"] == 0
+    with pytest.raises(KeyError, match="lost"):
+        fr.poll(u_doomed)
+    with pytest.raises(ShedError, match="capacity lost"):
+        fr.submit(_FT_PROMPTS[1], max_new_tokens=2)
+    fr.add_replica(warm_prompt_lens=(4,))
+    p = (np.arange(1, 6) % 250).astype(np.int32)
+    u = fr.submit(p, max_new_tokens=3)
+    out = fr.run()
+    np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
+
+
+def test_chaos_hang_sole_replica_capacity_lost(tiny_llama):
+    """Repeated tick timeouts on the ONLY replica quarantine it with no
+    survivor to take the work: lost-with-reason, breaker sheds, and
+    add_replica recovers. Pins the model checker's timeout/capacity_lost
+    path (analysis.fleet_rules.CHAOS_COVERAGE)."""
+    fr = FleetRouter.from_model(
+        tiny_llama, num_replicas=1,
+        config=FleetConfig(prefix_reuse=False, quarantine_after_timeouts=2),
+        num_slots=2, prompt_buckets=(4, 8), tick_block=2,
+    )
+    warm = fr.replicas[0].engine
+    warm.submit((np.arange(1, 5) % 250).astype(np.int32), max_new_tokens=4)
+    warm.run()  # prefill + decode compiled OUTSIDE the timeout window
+    u_doomed = fr.submit((np.arange(1, 5) % 250).astype(np.int32), max_new_tokens=10)
+    fr.step()
+    fr.config.tick_timeout_s = 0.05
+    with ReplicaChaos("pre_tick", replica="r0", action="hang", hang_s=0.2, repeat=True):
+        fr.step()
+        assert fr.health()["r0"]["health"] == "degraded"
+        fr.step()
+    assert fr.health()["r0"]["health"] == "quarantined"
+    assert fr.failover_accounting()["failovers_lost"] == 1
+    with pytest.raises(KeyError, match="lost"):
+        fr.poll(u_doomed)
+    with pytest.raises(ShedError, match="capacity lost"):
+        fr.submit(_FT_PROMPTS[1], max_new_tokens=2)
+    fr.add_replica(warm_prompt_lens=(4,))
+    p = (np.arange(1, 6) % 250).astype(np.int32)
+    u = fr.submit(p, max_new_tokens=3)
+    out = fr.run()
+    np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, 3))
+
+
+def test_drain_threaded_health_writes_hold_replica_lock(tiny_llama):
+    """Regression for the dogfooded TPU902: _set_health mutates
+    Replica.health under rep.lock and the drain_threaded workers read
+    is_serving under the same lock, so a mid-drain failover can't tear a
+    transition. Hammer a threaded drain with a mid-flight crash — the
+    pre-fix race window — and hold the PR-15 exactness claims."""
+    fr = _ft_fleet(tiny_llama)
+    uids = [fr.submit(p, max_new_tokens=_FT_NEW) for p in _FT_PROMPTS[:4]]
+    with ReplicaChaos("pre_tick", replica="r0", action="crash") as chaos:
+        fr.drain_threaded()
+    assert chaos.fired
+    assert fr.health()["r0"]["health"] == "dead"
+    out = {u: fr.poll(u) for u in uids}
+    for u, p in zip(uids, _FT_PROMPTS):
+        np.testing.assert_array_equal(out[u], _reference(tiny_llama, p, _FT_NEW))
+    # the static gate that keeps the fix fixed
+    from accelerate_tpu.analysis.hostsim import host_check_file
+
+    fleet_src = os.path.join(REPO, "accelerate_tpu", "serving_fleet.py")
+    assert [f.rule for f in host_check_file(fleet_src)] == []
+
+
 def test_fleet_request_error_surfaces(tiny_llama, monkeypatch):
     """poll/partial/logprobs/cancel on unknown or failed-over ids raise
     the structured error naming the last known state; cancel on a dead

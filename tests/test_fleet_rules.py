@@ -1,0 +1,194 @@
+"""Tier-9b fleet-protocol model checker (analysis.fleet_rules):
+extraction from the real serving_fleet.py, the bounded-exhaustive BFS,
+the three PR-15 invariants on seeded defects, and the chaos-coverage
+drift gate (model-checks = chaos-observes)."""
+
+import ast
+import dataclasses
+import pathlib
+
+from accelerate_tpu.analysis.fleet_rules import (
+    CHAOS_COVERAGE,
+    ProtocolSpec,
+    coverage_map,
+    extract_protocol_spec,
+    fleet_protocol_check,
+    load_protocol_spec,
+    model_check,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _real_spec():
+    spec, problems = load_protocol_spec()
+    assert problems == [], problems
+    return spec
+
+
+# --------------------------------------------------------------------------- #
+# extraction from the real sources
+# --------------------------------------------------------------------------- #
+
+
+def test_extraction_reads_the_real_health_machine():
+    spec = _real_spec()
+    assert spec.states == ("healthy", "degraded", "quarantined", "dead")
+    assert spec.serving == frozenset({"healthy", "degraded"})
+    assert spec.kind_target("crash") == "dead"
+    assert spec.kind_target("poison") == "quarantined"
+    assert spec.kind_target("timeout") == "quarantined"
+    # the PR-15 contract: poisoned KV is never trusted, everything else is
+    assert spec.kind_kv("poison") is False
+    assert spec.kind_kv("crash") is True
+    assert spec.kind_kv("drain") is True
+    # every failure kind migrates its in-flight work
+    assert all(m for _, m in spec.migrates)
+    # shed_on_capacity trips exactly at zero routable replicas
+    assert spec.breaker_trips_at == 0
+    assert spec.drain_requires_other_routable is True
+    assert spec.timeout_soft_state == "degraded"
+    assert spec.heal_state == "healthy"
+
+
+def test_extraction_drift_is_reported_not_guessed():
+    fleet_src = (REPO / "accelerate_tpu" / "serving_fleet.py").read_text()
+    sched_src = (REPO / "accelerate_tpu" / "scheduling.py").read_text()
+    # rename the health constant: the extractor must say what it lost,
+    # and fleet_protocol_check must turn that into TPU904, not a guess
+    broken = fleet_src.replace("HEALTH_STATES", "HEALTH_STATES_V2")
+    spec, problems = extract_protocol_spec(broken, sched_src)
+    assert spec is None
+    assert any("HEALTH_STATES" in p for p in problems)
+
+    # drop the breaker branch out of scheduling.py
+    sched_broken = sched_src.replace("shed_on_capacity", "shed_on_capacity_v2")
+    spec2, problems2 = extract_protocol_spec(fleet_src, sched_broken)
+    assert spec2 is None
+    assert any("shed_on_capacity" in p for p in problems2)
+
+
+def test_extraction_drift_becomes_tpu904(tmp_path, monkeypatch):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    fleet_src = (REPO / "accelerate_tpu" / "serving_fleet.py").read_text()
+    sched_src = (REPO / "accelerate_tpu" / "scheduling.py").read_text()
+    (pkg / "serving_fleet.py").write_text(fleet_src.replace("HEALTH_STATES", "HS"))
+    (pkg / "scheduling.py").write_text(sched_src)
+    findings, report = fleet_protocol_check(package_root=pkg)
+    assert findings and all(f.rule == "TPU904" for f in findings)
+    assert any("spec extraction drifted" in f.message for f in findings)
+    assert report.explored_states == 0  # nothing provable without a spec
+
+
+def test_unparseable_fleet_source_is_an_extraction_problem():
+    spec, problems = extract_protocol_spec("def broken(:\n", "x = 1\n")
+    assert spec is None
+    assert any("cannot parse" in p for p in problems)
+
+
+# --------------------------------------------------------------------------- #
+# the real protocol proves out
+# --------------------------------------------------------------------------- #
+
+
+def test_real_protocol_has_no_violations_and_full_coverage():
+    findings, report = fleet_protocol_check()
+    assert findings == []
+    assert report.violations == []
+    assert not report.truncated
+    assert report.explored_states > 1000
+    # every explored failure path is pinned, and nothing in the pin map
+    # is unexplorable fiction
+    assert report.explored_paths == set(CHAOS_COVERAGE)
+    cov = coverage_map(report)
+    assert all(test is not None for test in cov.values())
+
+
+def test_chaos_coverage_pins_real_tests():
+    """Drift gate, the other direction: every test name in CHAOS_COVERAGE
+    must exist as a real test function in tests/test_fleet.py."""
+    tree = ast.parse((REPO / "tests" / "test_fleet.py").read_text())
+    defined = {
+        n.name for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)
+    }
+    missing = {t for t in CHAOS_COVERAGE.values() if t not in defined}
+    assert missing == set(), f"CHAOS_COVERAGE pins tests that do not exist: {missing}"
+
+
+# --------------------------------------------------------------------------- #
+# seeded defects: each invariant's violation is found with a counterexample
+# --------------------------------------------------------------------------- #
+
+
+def test_defect_crash_without_migration_strands_requests():
+    spec = dataclasses.replace(
+        _real_spec(),
+        migrates=tuple((k, k != "crash" and v) for k, v in _real_spec().migrates),
+    )
+    report = model_check(spec)
+    kinds = {v[0] for v in report.violations}
+    assert "stranded-request" in kinds
+    # the counterexample must actually reach the defect: a crash event
+    # precedes the stranding
+    trace = next(t for k, t, _ in report.violations if k == "stranded-request")
+    assert any(ev.startswith("crash(") for ev in trace), trace
+
+
+def test_defect_trusting_poisoned_kv_ships_it():
+    spec = dataclasses.replace(
+        _real_spec(),
+        kv_trust=tuple((k, True if k == "poison" else v) for k, v in _real_spec().kv_trust),
+    )
+    report = model_check(spec)
+    kinds = {v[0] for v in report.violations}
+    assert "poisoned-kv-shipped" in kinds
+    trace = next(t for k, t, _ in report.violations if k == "poisoned-kv-shipped")
+    assert any(ev.startswith("poison(") for ev in trace), trace
+
+
+def test_defect_missing_breaker_black_holes_requests():
+    spec = dataclasses.replace(_real_spec(), breaker_trips_at=None)
+    report = model_check(spec)
+    kinds = {v[0] for v in report.violations}
+    assert "breaker-missing" in kinds
+
+
+def test_defect_early_breaker_sheds_with_capacity_left():
+    spec = dataclasses.replace(_real_spec(), breaker_trips_at=1)
+    report = model_check(spec)
+    kinds = {v[0] for v in report.violations}
+    assert "breaker-mistimed" in kinds
+
+
+def test_defects_become_tpu904_findings_with_counterexamples():
+    spec = dataclasses.replace(_real_spec(), breaker_trips_at=None)
+    findings, report = fleet_protocol_check(spec=spec)
+    assert findings and all(f.rule == "TPU904" for f in findings)
+    assert any("breaker-missing" in f.message for f in findings)
+    assert any("counterexample:" in f.message for f in findings)
+
+
+def test_unpinned_explored_path_is_tpu904():
+    # same healthy protocol, but the pin map lost an entry
+    partial = dict(CHAOS_COVERAGE)
+    partial.pop(("crash", "failover"))
+    findings, _report = fleet_protocol_check(spec=_real_spec(), chaos_coverage=partial)
+    assert [f.rule for f in findings] == ["TPU904"]
+    assert "('crash', 'failover')" in findings[0].message
+    assert "pinned to no ReplicaChaos test" in findings[0].message
+
+
+def test_coverage_map_marks_unpinned_paths_none():
+    report = model_check(_real_spec())
+    partial = dict(CHAOS_COVERAGE)
+    partial.pop(("drain", "migrate"))
+    cov = coverage_map(report, chaos_coverage=partial)
+    assert cov["drain/migrate"] is None
+    assert cov["crash/failover"] == "test_chaos_crash_matrix_token_and_logprob_exact"
+
+
+def test_spec_defaults_match_the_extracted_spec():
+    """The dataclass defaults document the protocol; keep them honest
+    against what extraction reads from the code."""
+    assert _real_spec() == ProtocolSpec()

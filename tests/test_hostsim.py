@@ -1,0 +1,507 @@
+"""Tier-9a host-concurrency lint (analysis.hostsim): lock-order graph,
+cross-thread attribute map, blocking-under-lock, thread lifecycle —
+plus the shared --changed git scoping (analysis.changed)."""
+
+import subprocess
+import textwrap
+
+import pytest
+
+from accelerate_tpu.analysis.hostsim import (
+    host_check_file,
+    host_check_paths,
+    host_check_source,
+)
+
+
+def _rules(src, **kw):
+    return [f.rule for f in host_check_source(textwrap.dedent(src), path="<t>", **kw)]
+
+
+# --------------------------------------------------------------------------- #
+# TPU901: lock-order inversion
+# --------------------------------------------------------------------------- #
+
+_ABBA = """
+import threading
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def route(self):
+        with self._lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            with self._lock:
+                pass
+"""
+
+
+def test_tpu901_abba_inversion_detected_and_message_names_both_sites():
+    findings = host_check_source(textwrap.dedent(_ABBA), path="<t>")
+    assert [f.rule for f in findings] == ["TPU901"]
+    msg = findings[0].message
+    assert "Router._lock" in msg and "Router._stats_lock" in msg
+    assert "Router.route" in msg and "Router.report" in msg
+
+
+def test_tpu901_consistent_order_is_clean():
+    clean = _ABBA.replace(
+        "with self._stats_lock:\n            with self._lock:",
+        "with self._lock:\n            with self._stats_lock:",
+    )
+    assert _rules(clean) == []
+
+
+def test_tpu901_one_call_deep_inversion():
+    # the second lock is taken inside a method called while holding the
+    # first — the cycle only exists across the call edge
+    src = """
+    import threading
+
+    class R:
+        def __init__(self):
+            self.a_lock = threading.Lock()
+            self.b_lock = threading.Lock()
+
+        def _inner(self):
+            with self.b_lock:
+                pass
+
+        def path1(self):
+            with self.a_lock:
+                self._inner()
+
+        def path2(self):
+            with self.b_lock:
+                with self.a_lock:
+                    pass
+    """
+    assert "TPU901" in _rules(src)
+
+
+def test_tpu901_plain_lock_self_nest_flagged_rlock_exempt():
+    src = """
+    import threading
+
+    class R:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def m(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    assert "TPU901" in _rules(src)
+    assert _rules(src.replace("threading.Lock()", "threading.RLock()")) == []
+
+
+def test_tpu901_cross_class_nesting_one_direction_is_clean():
+    # the serving_fleet convention: Replica.lock -> FleetRouter._lock,
+    # never reversed
+    src = """
+    import threading
+
+    class Replica:
+        def __init__(self):
+            self.lock = threading.RLock()
+
+    class Router:
+        def __init__(self):
+            self._lock = threading.RLock()
+
+        def migrate(self, rep):
+            with rep.lock:
+                with self._lock:
+                    pass
+
+        def poll(self, rep):
+            with rep.lock:
+                with self._lock:
+                    pass
+    """
+    assert _rules(src) == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU902: cross-thread attribute without the owning lock
+# --------------------------------------------------------------------------- #
+
+_RACE = """
+import threading
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.health = "healthy"
+
+    def set_health(self, v):
+        self.health = v
+
+    def drain(self):
+        def worker():
+            if self.health == "healthy":
+                pass
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        self.set_health("dead")
+"""
+
+
+def test_tpu902_unlocked_cross_thread_write_detected():
+    findings = host_check_source(textwrap.dedent(_RACE), path="<t>")
+    assert [f.rule for f in findings] == ["TPU902"]
+    assert "Fleet.health" in findings[0].message
+    assert "worker" in findings[0].message
+
+
+def test_tpu902_lock_on_both_sides_is_clean():
+    fixed = _RACE.replace(
+        "    def set_health(self, v):\n        self.health = v",
+        "    def set_health(self, v):\n        with self._lock:\n            self.health = v",
+    ).replace(
+        "            if self.health == \"healthy\":\n                pass",
+        "            with self._lock:\n                if self.health == \"healthy\":\n                    pass",
+    )
+    assert _rules(fixed) == []
+
+
+def test_tpu902_init_writes_are_exempt():
+    # construction happens-before thread publication: an unguarded
+    # __init__ write must not fire (nor poison the lock analysis)
+    src = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+
+        def spin(self):
+            def worker():
+                with self._lock:
+                    if self.count:
+                        pass
+            threading.Thread(target=worker, daemon=True).start()
+    """
+    assert _rules(src) == []
+
+
+def test_tpu902_property_reads_resolve_to_backing_attribute():
+    # reading rep.is_serving is reading rep.health — the lint must see
+    # through the property (the real serving_fleet finding's shape)
+    src = """
+    import threading
+
+    class Replica:
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.health = "healthy"
+
+        @property
+        def is_serving(self):
+            return self.health in ("healthy", "degraded")
+
+    class Router:
+        def set_health(self, rep, state):
+            rep.health = state
+
+        def drain(self, rep):
+            def worker():
+                if rep.is_serving:
+                    pass
+            threading.Thread(target=worker, daemon=True).start()
+            self.set_health(rep, "dead")
+    """
+    findings = host_check_source(textwrap.dedent(src), path="<t>")
+    assert [f.rule for f in findings] == ["TPU902"]
+    assert "Replica.health" in findings[0].message
+
+
+def test_tpu902_single_thread_module_is_quiet():
+    src = """
+    class Accounting:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, n):
+            self.total += n
+    """
+    assert _rules(src) == []
+
+
+# --------------------------------------------------------------------------- #
+# TPU903: blocking call while holding a lock
+# --------------------------------------------------------------------------- #
+
+
+def test_tpu903_sleep_under_lock_priced():
+    src = """
+    import threading, time
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poll(self):
+            with self._lock:
+                time.sleep(0.25)
+    """
+    findings = host_check_source(textwrap.dedent(src), path="<t>")
+    assert [f.rule for f in findings] == ["TPU903"]
+    assert ">=0.25s per call" in findings[0].message
+    assert "P._lock" in findings[0].message
+
+
+def test_tpu903_join_and_queue_get_and_device_sync_under_lock():
+    src = """
+    import queue
+    import threading
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.q = queue.Queue()
+
+        def a(self, t):
+            with self._lock:
+                t.join()
+
+        def b(self):
+            with self._lock:
+                item = self.q.get()
+            return item
+
+        def c(self, x):
+            with self._lock:
+                x.block_until_ready()
+    """
+    assert _rules(src) == ["TPU903", "TPU903", "TPU903"]
+
+
+def test_tpu903_sleep_outside_lock_and_str_join_are_clean():
+    src = """
+    import os
+    import threading, time
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poll(self, parts):
+            time.sleep(0.25)
+            with self._lock:
+                name = ",".join(parts)
+                path = os.path.join("a", "b")
+            return name, path
+    """
+    assert _rules(src) == []
+
+
+def test_tpu903_one_call_deep_blocking_inherits_caller_lock():
+    src = """
+    import threading, time
+
+    class P:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _wait(self):
+            time.sleep(1.0)
+
+        def poll(self):
+            with self._lock:
+                self._wait()
+    """
+    assert "TPU903" in _rules(src)
+
+
+# --------------------------------------------------------------------------- #
+# TPU905: thread lifecycle
+# --------------------------------------------------------------------------- #
+
+
+def test_tpu905_unjoined_non_daemon_thread():
+    src = """
+    import threading
+
+    def launch(work):
+        t = threading.Thread(target=work)
+        t.start()
+    """
+    assert _rules(src) == ["TPU905"]
+
+
+def test_tpu905_joined_or_daemon_threads_are_clean():
+    src = """
+    import threading
+
+    def launch(work):
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        d = threading.Thread(target=work, daemon=True)
+        d.start()
+
+    def launch_many(work):
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    """
+    assert _rules(src) == []
+
+
+def test_tpu905_worker_swallowed_exception():
+    src = """
+    import threading
+
+    class W:
+        def run_all(self):
+            def worker():
+                try:
+                    self.step()
+                except Exception:
+                    pass
+            ts = [threading.Thread(target=worker, daemon=True) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+    """
+    findings = host_check_source(textwrap.dedent(src), path="<t>")
+    assert [f.rule for f in findings] == ["TPU905"]
+    assert "swallows its exception" in findings[0].message
+
+
+def test_tpu905_worker_recording_errors_is_clean():
+    # the post-PR-15 drain_threaded shape: error captured for the caller
+    src = """
+    import threading
+
+    class W:
+        def run_all(self):
+            errors = []
+            err_lock = threading.Lock()
+
+            def worker():
+                try:
+                    self.step()
+                except Exception as e:
+                    with err_lock:
+                        errors.append(e)
+            ts = [threading.Thread(target=worker, daemon=True) for _ in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return errors
+    """
+    assert _rules(src) == []
+
+
+# --------------------------------------------------------------------------- #
+# plumbing: suppressions, select/ignore, paths, syntax errors
+# --------------------------------------------------------------------------- #
+
+
+def test_inline_suppression_and_select_ignore():
+    sup = _RACE.replace("self.health = v", "self.health = v  # tpu-lint: disable=TPU902")
+    assert _rules(sup) == []
+    assert _rules(_RACE, select=("TPU901",)) == []
+    assert _rules(_RACE, ignore=("TPU902",)) == []
+
+
+def test_host_check_paths_walks_directories(tmp_path):
+    (tmp_path / "race.py").write_text(textwrap.dedent(_RACE))
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    sub = tmp_path / "__pycache__"
+    sub.mkdir()
+    (sub / "skip.py").write_text(textwrap.dedent(_RACE))
+    findings = host_check_paths([tmp_path])
+    assert [f.rule for f in findings] == ["TPU902"]
+    assert findings[0].path.endswith("race.py")
+
+
+def test_syntax_error_is_tpu003(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings = host_check_file(bad)
+    assert [f.rule for f in findings] == ["TPU003"]
+
+
+def test_dogfood_fleet_surface_is_clean():
+    """The shipped fleet layer passes its own gate: the dogfooded TPU902
+    (Replica.health written without rep.lock while the drain_threaded
+    workers read is_serving) stays fixed."""
+    findings = host_check_paths(
+        [
+            "accelerate_tpu/serving_fleet.py",
+            "accelerate_tpu/scheduling.py",
+            "accelerate_tpu/ft",
+        ]
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# --changed scoping (analysis.changed)
+# --------------------------------------------------------------------------- #
+
+
+def _git(repo, *args):
+    return subprocess.run(
+        ["git", *args], cwd=repo, capture_output=True, text=True, check=True,
+        env={"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t", "GIT_COMMITTER_NAME": "t",
+             "GIT_COMMITTER_EMAIL": "t@t", "HOME": str(repo), "PATH": "/usr/bin:/bin:/usr/local/bin"},
+    )
+
+
+@pytest.fixture
+def git_repo(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-b", "main")
+    (repo / "base.py").write_text("x = 1\n")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-m", "seed")
+    return repo
+
+
+def test_changed_python_files_sees_working_tree_and_untracked(git_repo):
+    from accelerate_tpu.analysis.changed import changed_python_files
+
+    assert changed_python_files(git_repo) == []
+    (git_repo / "base.py").write_text("x = 2\n")  # unstaged edit
+    (git_repo / "fresh.py").write_text("y = 1\n")  # untracked
+    (git_repo / "notes.txt").write_text("no\n")  # not python
+    got = changed_python_files(git_repo)
+    assert [p.split("/")[-1] for p in got] == ["base.py", "fresh.py"]
+
+
+def test_changed_python_files_sees_branch_commits(git_repo):
+    from accelerate_tpu.analysis.changed import changed_python_files
+
+    _git(git_repo, "checkout", "-b", "feature")
+    (git_repo / "feat.py").write_text("z = 1\n")
+    _git(git_repo, "add", "-A")
+    _git(git_repo, "commit", "-m", "feat")
+    got = changed_python_files(git_repo)
+    assert [p.split("/")[-1] for p in got] == ["feat.py"]
+
+
+def test_changed_python_files_none_outside_git(tmp_path):
+    from accelerate_tpu.analysis.changed import changed_python_files
+
+    assert changed_python_files(tmp_path) is None
