@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check fleet-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check fleet-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck trace-selfcheck trace-bench ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -45,6 +45,7 @@ lint:
 	$(MAKE) --no-print-directory fleet-check
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
+	-$(MAKE) --no-print-directory trace-selfcheck
 	-$(MAKE) --no-print-directory ft-selfcheck
 	-$(MAKE) --no-print-directory aot-selfcheck
 
@@ -69,7 +70,9 @@ lint-sarif:
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli pipe-check \
 		examples/by_feature/pipe_check.py::train_step --mesh pipe=4,data=2 --format sarif > .cache/pipe.sarif
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli fleet-check \
-		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft --format sarif > .cache/fleet.sarif
+		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft \
+		accelerate_tpu/telemetry/httpd.py accelerate_tpu/telemetry/flightrec.py \
+		accelerate_tpu/telemetry/trace.py --format sarif > .cache/fleet.sarif
 	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif .cache/fleet.sarif -o lint-merged.sarif
 
 # Static perf tier: prove TPU501-505 fire on their seeded defects, each
@@ -141,7 +144,9 @@ pipe-check:
 # the fastest gate in the chain.
 fleet-check:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli fleet-check --selfcheck \
-		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft
+		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft \
+		accelerate_tpu/telemetry/httpd.py accelerate_tpu/telemetry/flightrec.py \
+		accelerate_tpu/telemetry/trace.py
 
 # Pipeline analyzer A/B on CPU (committed evidence: BENCH_PIPE.json):
 # pipemodel's bubble-adjusted prediction vs StepTelemetry-measured step
@@ -163,6 +168,23 @@ flight-check:
 # summarize CLI agree end to end.
 telemetry-selfcheck:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli telemetry selfcheck
+
+# Request tracing: seeded drift fixture (handoff moved fewer bytes than
+# priced -> exactly ONE latched trace_drift) + clean twin (zero) through
+# the full Tracer -> EventLog -> reconstruction -> chrome-export ->
+# flight-recorder pipeline. Pure stdlib, no jax.
+trace-selfcheck:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli trace selfcheck
+
+# Tracing A/B on CPU (committed evidence: BENCH_TRACE.json): a traced
+# disaggregated fleet under a control arm and a mid-decode crash arm;
+# every request traced, frontier-contiguous segments reconcile with e2e
+# latency, handoff/failover span bytes match the price models exactly,
+# failover tokens+logprobs match the control arm, zero drift latched,
+# and the dead replica's flight dump holds the injected fault. Exits
+# nonzero unless report.ok.
+trace-bench:
+	env JAX_PLATFORMS=cpu python benchmarks/bench_serving.py --trace --smoke
 
 # Fault tolerance: seeded good/uncommitted/corrupt/recoverable checkpoint
 # fixtures -> prove manifest verify (crc32 + sizes), discovery walk-back,

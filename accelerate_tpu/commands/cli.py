@@ -23,6 +23,7 @@ from .perfcheck import perfcheck_parser
 from .pipecheck import pipecheck_parser
 from .telemetry import telemetry_parser
 from .test import test_parser
+from .trace import trace_parser
 from .tpu import tpu_command_parser
 from .tune import tune_parser
 
@@ -48,6 +49,7 @@ def main():
     merge_parser(subparsers)
     migrate_parser(subparsers)
     telemetry_parser(subparsers)
+    trace_parser(subparsers)
     checkpoints_parser(subparsers)
     compile_cache_parser(subparsers)
     fleet_parser(subparsers)

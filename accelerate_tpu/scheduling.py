@@ -59,17 +59,23 @@ class ShedError(RuntimeError):
     """
 
     def __init__(self, reason: str, uid: Optional[int] = None, priority: int = 0,
-                 queue_depth: int = 0, queue_wait_ms: Optional[float] = None):
+                 queue_depth: int = 0, queue_wait_ms: Optional[float] = None,
+                 trace_id: Optional[int] = None):
         self.reason = reason
         self.uid = uid
         self.priority = priority
         self.queue_depth = queue_depth
         self.queue_wait_ms = queue_wait_ms
+        # the request's distributed-tracing id (telemetry.trace), when the
+        # engine/router was tracing — lets a gateway log a correlatable id
+        self.trace_id = trace_id
         detail = f"request shed ({reason}): priority={priority} queue_depth={queue_depth}"
         if queue_wait_ms is not None:
             detail += f" queue_wait_ms={queue_wait_ms:.1f}"
         if uid is not None:
             detail = f"request {uid} shed ({reason}): priority={priority} queue_depth={queue_depth}"
+        if trace_id is not None:
+            detail += f" trace={trace_id}"
         super().__init__(detail)
 
 

@@ -144,6 +144,10 @@ class _Request:
     # on ANOTHER replica carries the handed-off KV payload; consumed once
     # at admission (a later preemption resumes by ordinary recompute)
     handoff: object = None
+    # distributed-tracing context (telemetry.trace): the trace id minted
+    # at submit. Rides the handoff blob and failover snapshots, so one
+    # id follows the request across replicas end to end
+    trace: Optional[int] = None
 
 
 class ServingEngine:
@@ -185,6 +189,7 @@ class ServingEngine:
         program_cache=None,
         auto_bucketing: bool = False,
         scheduler=None,
+        tracer=None,
     ):
         jax = _jax()
         jnp = jax.numpy
@@ -207,6 +212,11 @@ class ServingEngine:
         from .telemetry.eventlog import EventLog
 
         self._log = telemetry_log if telemetry_log is not None else EventLog(None)
+        # request tracing (telemetry.trace.Tracer, usually the fleet
+        # router's shared instance): segments are recorded at admission,
+        # prefill windows, decode ticks, preemption/resume, and retire.
+        # None disables tracing with zero overhead beyond these guards.
+        self.tracer = tracer
         if program_cache is None:
             from .aot import ProgramCache
 
@@ -709,7 +719,9 @@ class ServingEngine:
 
     # ---- chunked prefill (host driver) ----------------------------------
 
-    def _chunked_prefill(self, full_tokens: np.ndarray, row_cache=None, done_upto: int = 0, key=None):
+    def _chunked_prefill(
+        self, full_tokens: np.ndarray, row_cache=None, done_upto: int = 0, key=None, trace=None
+    ):
         """Stream ``full_tokens[done_upto:]`` through the decode path in
         ``self._chunk``-sized end-aligned windows against ``row_cache``
         (None = fresh, ``done_upto`` must then be 0).
@@ -735,7 +747,7 @@ class ServingEngine:
         logits, s_last = None, 0
         s = done_upto
         while s < t:
-            logits, row_cache, s_last, s = self._run_window(full_tokens, s, row_cache)
+            logits, row_cache, s_last, s = self._run_window(full_tokens, s, row_cache, trace=trace)
         row_cache = self._reset_idx(row_cache, jnp.int32(t))
         next_tok = lp = None
         if key is not None:
@@ -759,21 +771,29 @@ class ServingEngine:
         e = min(s + w, t)
         return w, max(0, e - w), e  # end-aligned window [s_adj, s_adj + w)
 
-    def _run_window(self, full_tokens: np.ndarray, s: int, row_cache):
+    def _run_window(self, full_tokens: np.ndarray, s: int, row_cache, trace=None):
         """Execute ONE prefill window starting at new-token offset ``s``;
-        returns ``(logits, cache, s_adj, e)``."""
+        returns ``(logits, cache, s_adj, e)``. With a trace id, each
+        window records one ``prefill`` span — its frontier-contiguous
+        wall time plus the compute-only dispatch in ``compute_ms``."""
         jnp = _jax().numpy
         t = len(full_tokens)
         w, s_adj, e = self._next_window(t, s)
         window = np.zeros((1, w), np.int32)
         real = full_tokens[s_adj : s_adj + w]
         window[0, : len(real)] = real
+        t0 = time.perf_counter()
         if row_cache is None:
             logits, row_cache = self._chunk_cold(self.model.params, jnp.asarray(window))
         else:
             row_cache = self._reset_idx(row_cache, jnp.int32(s_adj))
             logits, row_cache = self._chunk_warm(
                 self.model.params, jnp.asarray(window), jnp.int32(s_adj), row_cache
+            )
+        if self.tracer is not None and trace is not None:
+            self.tracer.seg(
+                trace, "prefill", tokens=int(w),
+                compute_ms=round((time.perf_counter() - t0) * 1000.0, 3),
             )
         return logits, row_cache, s_adj, e
 
@@ -864,6 +884,7 @@ class ServingEngine:
         prefix_id: Optional[int] = None,
         stop_sequences=None,
         priority: int = 0,
+        trace: Optional[int] = None,
     ) -> int:
         """Queue a prompt; returns a request id resolved via :meth:`poll`.
         With ``prefix_id``, ``prompt_ids`` is the SUFFIX after the registered
@@ -918,12 +939,18 @@ class ServingEngine:
                     f"request needs {need} pool blocks but the pool has "
                     f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
                 )
-        priority = self._admission_shed_check(int(priority))
+        priority = self._admission_shed_check(int(priority), trace=trace)
         uid = self._uid
         self._uid += 1
+        if self.tracer is not None:
+            # a router-minted trace arrives via ``trace=``; standalone
+            # engines mint their own here, after the shed gate passed
+            if trace is None:
+                trace = self.tracer.start()
+            self.tracer.attach(trace, uid=uid, prompt_tokens=len(prompt))
         req = _Request(
             uid, prompt, max_new_tokens, [], prefix_id, stops,
-            priority=priority, submit_ts=time.monotonic(),
+            priority=priority, submit_ts=time.monotonic(), trace=trace,
         )
         self._queue_push(req)
         self._index[uid] = ("queued", req)
@@ -1002,6 +1029,7 @@ class ServingEngine:
         *,
         uid_key: int = 0,
         prefix_id: Optional[int] = None,
+        trace: Optional[int] = None,
     ) -> dict:
         """Run ONE request's prefill on THIS engine and return a
         host-transferable KV handoff instead of admitting it — the
@@ -1043,7 +1071,8 @@ class ServingEngine:
             )
         key = jax.random.fold_in(jax.random.key(self._seed), int(uid_key))
         next_tok, lp, cache, key = self._chunked_prefill(
-            prompt, row_cache=None if pre is None else pre["cache"], done_upto=plen, key=key
+            prompt, row_cache=None if pre is None else pre["cache"], done_upto=plen, key=key,
+            trace=trace,
         )
         total = len(prompt)
         trimmed = self._trim_row_cache(cache, total)
@@ -1058,6 +1087,7 @@ class ServingEngine:
             "cache": trimmed,
             "wire_bytes": wire,
             "reused_prefix_tokens": int(plen),
+            "trace": trace,
         }
 
     def submit_prefilled(self, handoff: dict, stop_sequences=None, priority: int = 0) -> int:
@@ -1090,12 +1120,16 @@ class ServingEngine:
                     f"request needs {need} pool blocks but the pool has "
                     f"{self._pcfg.num_blocks - 1}; raise pool_blocks or paged_block_size"
                 )
-        priority = self._admission_shed_check(int(priority))
+        trace = handoff.get("trace")
+        priority = self._admission_shed_check(int(priority), trace=trace)
         uid = self._uid
         self._uid += 1
+        if self.tracer is not None and trace is not None:
+            self.tracer.attach(trace, decode_uid=uid)
         req = _Request(
             uid, prompt, max_new, [], None, stops,
             priority=priority, submit_ts=time.monotonic(), handoff=dict(handoff),
+            trace=trace,
         )
         self._queue_push(req)
         self._index[uid] = ("queued", req)
@@ -1122,6 +1156,7 @@ class ServingEngine:
             "out_lps": [float(v) for v in req.out_lps],
             "stop_sequences": req.stop_sequences,
             "priority": int(req.priority),
+            "trace": req.trace,
         }
 
     def export_inflight(self, include_kv: bool = True) -> list:
@@ -1235,6 +1270,7 @@ class ServingEngine:
             out_lps=lps, priority=int(snap.get("priority", 0)),
             submit_ts=time.monotonic(), preempted=bool(out), ttft_done=bool(out),
             resume_key=jax.random.wrap_key_data(jax.numpy.asarray(snap["key_data"])),
+            trace=snap.get("trace"),
         )
         if cache is not None:
             req.handoff = {
@@ -1248,13 +1284,16 @@ class ServingEngine:
         self._log.event(
             "failover_in", uid=uid, source_uid=int(snap.get("uid", -1)),
             generated=len(out), kv_rows=rows if cache is not None else 0,
+            trace=snap.get("trace"),
         )
         return uid
 
-    def _admission_shed_check(self, priority: int) -> int:
+    def _admission_shed_check(self, priority: int, trace: Optional[int] = None) -> int:
         """Shared submit-time SLO gate (:meth:`submit` /
         :meth:`submit_prefilled`): returns the possibly-demoted priority,
-        or raises the structured :class:`ShedError` rejection."""
+        or raises the structured :class:`ShedError` rejection. A shed
+        rejection closes the request's trace (status ``shed``) — the
+        trace id rides the shed event and the raised error."""
         reason = self._sched.shed_on_submit(priority, len(self.queue))
         if reason is None:
             return priority
@@ -1263,15 +1302,17 @@ class ServingEngine:
             self.metrics.on_deprioritize(None)
             self._log.event(
                 "shed", action="deprioritize", priority=priority,
-                queue_depth=len(self.queue), reason=reason,
+                queue_depth=len(self.queue), reason=reason, trace=trace,
             )
             return max(priority, cfg.deprioritize_to)
         self.metrics.on_shed(None)
         self._log.event(
             "shed", action="reject", priority=priority,
-            queue_depth=len(self.queue), reason=reason,
+            queue_depth=len(self.queue), reason=reason, trace=trace,
         )
-        raise ShedError(reason, priority=priority, queue_depth=len(self.queue))
+        if self.tracer is not None and trace is not None:
+            self.tracer.finish(trace, status="shed", reason=reason)
+        raise ShedError(reason, priority=priority, queue_depth=len(self.queue), trace_id=trace)
 
     def _queue_push(self, req: _Request) -> None:
         """Insert by the scheduler's order key (priority class, then
@@ -1342,11 +1383,15 @@ class ServingEngine:
             self._release(slot)
             del self._index[uid]
             self.metrics.on_cancel(uid)
+            if self.tracer is not None:
+                self.tracer.finish(req.trace, status="cancelled")
             return out
         if state == "queued":
             self.queue.remove(req)
             del self._index[uid]
             self.metrics.on_cancel(uid)
+            if self.tracer is not None:
+                self.tracer.finish(req.trace, status="cancelled")
             return np.asarray(req.out_tokens, np.int32)
         raise KeyError(f"unknown request id {uid}")
 
@@ -1456,14 +1501,17 @@ class ServingEngine:
                 err = ShedError(
                     reason, uid=req.uid, priority=req.priority,
                     queue_depth=len(self.queue), queue_wait_ms=wait_s * 1000.0,
+                    trace_id=req.trace,
                 )
                 self._shed[req.uid] = err
                 self._index.pop(req.uid, None)
                 self.metrics.on_shed(req.uid)
                 self._log.event(
                     "shed", action="reject", uid=req.uid, priority=req.priority,
-                    queue_wait_ms=round(wait_s * 1000.0, 3), reason=reason,
+                    queue_wait_ms=round(wait_s * 1000.0, 3), reason=reason, trace=req.trace,
                 )
+                if self.tracer is not None:
+                    self.tracer.finish(req.trace, status="shed", reason=reason)
 
     def _reserve_blocks(self, req: _Request):
         """Reserve the paged pool blocks a request needs (resume-aware);
@@ -1577,6 +1625,12 @@ class ServingEngine:
             self._log.event(
                 "admit", uid=req.uid, priority=req.priority, queue_wait_ms=round(wait_ms, 3)
             )
+        if self.tracer is not None:
+            # queue_wait absorbs everything since the frontier (for a
+            # fresh submit: since the trace started); accounted_ms is the
+            # scheduler's own number — critpath cross-checks the two
+            self.tracer.seg(req.trace, "queue_wait", accounted_ms=round(wait_ms, 3))
+            self.tracer.seg(req.trace, "admit", resume=resume)
         return True
 
     def _advance_prefill(self, slot: int, budget: float, force: bool = False) -> float:
@@ -1598,6 +1652,11 @@ class ServingEngine:
             # zero tokens of this tick's budget are spent
             h = st.pop("handoff")
             cache = self._untrim_row_cache(h["cache"], h["total"])
+            if self.tracer is not None:
+                # the paste half of the handoff (the router recorded the
+                # priced wire move); no moved_bytes here, so critpath
+                # skips this span's byte check by design
+                self.tracer.seg(req.trace, "kv_handoff", phase="paste", rows=int(h["total"]))
             self._finalize_prefill(slot, cache, h["total"], h["next_tok"], h["lp"], st["key"])
             return budget
         if st["bucket"] is not None:
@@ -1606,6 +1665,7 @@ class ServingEngine:
                 return budget
             padded = np.zeros((1, b), np.int32)
             padded[0, : len(req.prompt)] = req.prompt
+            t0 = time.perf_counter()
             if st.get("spec"):
                 # speculative admit: both models prefill the prompt (greedy)
                 next_tok, lp, row_cache = self._spec_prefill[b](
@@ -1617,6 +1677,11 @@ class ServingEngine:
                 next_tok, lp, row_cache, key = self._prefill[b](
                     self.model.params, jnp.asarray(padded), jnp.int32(len(req.prompt)), st["key"]
                 )
+            if self.tracer is not None:
+                self.tracer.seg(
+                    req.trace, "prefill", tokens=int(b),
+                    compute_ms=round((time.perf_counter() - t0) * 1000.0, 3),
+                )
             self._finalize_prefill(slot, row_cache, len(req.prompt), next_tok, lp, key)
             return budget - b
         full = st["full"]
@@ -1626,7 +1691,7 @@ class ServingEngine:
             if budget < w and not force:
                 return budget
             st["logits"], st["cache"], st["s_last"], st["done"] = self._run_window(
-                full, st["done"], st["cache"]
+                full, st["done"], st["cache"], trace=req.trace
             )
             budget -= w
             force = False
@@ -1669,6 +1734,8 @@ class ServingEngine:
                 "resume", uid=req.uid, priority=req.priority,
                 recomputed_tokens=int(total), generated=len(req.out_tokens),
             )
+            if self.tracer is not None:
+                self.tracer.seg(req.trace, "resume", recomputed_tokens=int(total))
             return
         tok = int(next_tok)
         req.out_tokens.append(tok)
@@ -1699,6 +1766,8 @@ class ServingEngine:
             "preempt_decode", uid=req.uid, priority=req.priority,
             generated=len(req.out_tokens),
         )
+        if self.tracer is not None:
+            self.tracer.seg(req.trace, "preempt", generated=len(req.out_tokens))
 
     def _plain_decode_pass(self) -> None:
         """ONE jitted K-step tick for every decode-phase slot, then the
@@ -1730,6 +1799,8 @@ class ServingEngine:
                     break  # remaining block tokens are overshoot — discarded
             if n_new:
                 self.metrics.on_tick_tokens(req.uid, n_new)
+                if self.tracer is not None:
+                    self.tracer.window(req.trace, "decode", tokens=n_new)
             if retired:
                 self._retire(slot)
 
@@ -1833,6 +1904,8 @@ class ServingEngine:
                     break
             if n_new:
                 self.metrics.on_tick_tokens(req.uid, n_new)
+                if self.tracer is not None:
+                    self.tracer.window(req.trace, "decode", tokens=n_new)
             if retired:
                 self._retire(slot)
         return self.active_count
@@ -2008,6 +2081,8 @@ class ServingEngine:
         self._release(slot)
         self._index[req.uid] = ("done", None)
         self.metrics.on_complete(req.uid)
+        if self.tracer is not None:
+            self.tracer.finish(req.trace, status="ok", tokens=len(req.out_tokens))
 
     def _release(self, slot: int):
         """Free a slot's resources without publishing a result (shared by

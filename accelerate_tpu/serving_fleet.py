@@ -84,6 +84,7 @@ from __future__ import annotations
 
 import dataclasses
 import io
+import os
 import threading
 import time
 from typing import Optional, Sequence
@@ -121,16 +122,22 @@ class FleetRequestError(KeyError):
     "the fleet lost it at a failover" and react accordingly. Subclasses
     ``KeyError`` so existing bare-lookup handling keeps working."""
 
-    def __init__(self, fuid: int, state: str, detail: Optional[str] = None):
+    def __init__(self, fuid: int, state: str, detail: Optional[str] = None,
+                 trace_id: Optional[int] = None):
         self.fuid = int(fuid)
         self.state = state
         self.detail = detail
+        # the request's distributed-tracing id (telemetry.trace), when
+        # the router was tracing — grep the eventlog/flight dumps for it
+        self.trace_id = trace_id
         if state == "unknown":
             msg = f"unknown request id {fuid} (never submitted, already cancelled, or shed)"
         else:
             msg = f"request id {fuid} last known state: {state}"
         if detail:
             msg += f" — {detail}"
+        if trace_id is not None:
+            msg += f" (trace {trace_id})"
         super().__init__(msg)
 
 
@@ -169,6 +176,11 @@ class HandoffCodec:
             ),
             "fmeta": np.asarray([float(handoff["lp"])], np.float64),
         }
+        # v2: the trace id rides the blob so one id follows the request
+        # across hosts; omitted when untraced, so v1 decoders (and v1
+        # blobs fed to this decoder) keep working
+        if handoff.get("trace") is not None:
+            arrays["tmeta"] = np.asarray([int(handoff["trace"])], np.int64)
         for i, leaf in enumerate(leaves):
             arr = np.asarray(leaf)
             arrays[f"leaf_{i}"] = np.frombuffer(arr.tobytes(), np.uint8)
@@ -210,6 +222,8 @@ class HandoffCodec:
                 "cache": cache,
                 "wire_bytes": int(imeta[3]),
                 "reused_prefix_tokens": int(imeta[4]),
+                # absent in v1 blobs — tolerate them forever
+                "trace": int(z["tmeta"][0]) if "tmeta" in z.files else None,
             }
 
 
@@ -557,6 +571,9 @@ class Replica:
         self.name = name
         self.role = role
         self.radix: Optional[RadixPrefixCache] = None
+        # per-replica crash flight recorder (telemetry.flightrec), wired
+        # by a tracing router as a tap on the engine's eventlog
+        self.flightrec = None
         self.lock = threading.RLock()
         self.health = "healthy"
         self.draining = False
@@ -608,7 +625,13 @@ class FleetRouter:
     resolve, :meth:`metrics_merged` / :meth:`prometheus_text` observe.
     """
 
-    def __init__(self, engines: Sequence, config: Optional[FleetConfig] = None, names=None):
+    def __init__(
+        self,
+        engines: Sequence,
+        config: Optional[FleetConfig] = None,
+        names=None,
+        trace=None,
+    ):
         if not engines:
             raise ValueError("need at least one engine")
         self.config = config or FleetConfig()
@@ -660,6 +683,44 @@ class FleetRouter:
         self.failover_bytes_moved = 0
         self.failover_time_us_predicted = 0.0
         self.failover_recompute_us_predicted = 0.0
+        # ---- request tracing + flight recorder (telemetry.trace) ----
+        # `trace` is None (off), True (defaults), or a TraceConfig. One
+        # Tracer spans the whole fleet (trace ids are fleet-global); each
+        # replica gets a bounded flight recorder tapping its eventlog.
+        self.tracer = None
+        self.critpath = None
+        self.trace_config = None
+        self._trace_ids: dict[int, int] = {}  # fuid -> trace id
+        if trace is not None and trace is not False:
+            from .telemetry.critpath import CritPathMonitor
+            from .telemetry.trace import TraceConfig, Tracer
+
+            tcfg = TraceConfig() if trace is True else trace
+            if tcfg.enabled:
+                self.trace_config = tcfg
+                tlog = self.replicas[0].engine._log
+                if tcfg.drift_check:
+                    self.critpath = CritPathMonitor(tlog, thresholds=tcfg.drift_thresholds)
+                self.tracer = Tracer(
+                    max_traces=tcfg.max_traces,
+                    log=tlog,
+                    on_finish=None if self.critpath is None else self.critpath.observe,
+                )
+                for rep in self.replicas:
+                    self._wire_replica_tracing(rep)
+
+    def _wire_replica_tracing(self, rep: Replica) -> None:
+        """Hand the fleet tracer to one replica's engine and tap its
+        eventlog into a per-replica crash flight recorder."""
+        if self.tracer is None:
+            return
+        rep.engine.tracer = self.tracer
+        tcfg = self.trace_config
+        if tcfg.flight_recorder and rep.flightrec is None:
+            from .telemetry.flightrec import FlightRecorder
+
+            rep.flightrec = FlightRecorder(tcfg.flight_capacity, name=rep.name)
+            rep.engine._log.add_tap(rep.flightrec.record)
 
     # -- construction ---------------------------------------------------- #
 
@@ -670,6 +731,7 @@ class FleetRouter:
         num_replicas: int = 2,
         config: Optional[FleetConfig] = None,
         store_dir: Optional[str] = None,
+        trace=None,
         **engine_kwargs,
     ) -> "FleetRouter":
         """N uniform replicas over one model. With ``store_dir``, every
@@ -690,7 +752,7 @@ class FleetRouter:
                 pc = ProgramCache(store=ExecutableStore(store_dir), name=name)
             return ServingEngine(model, program_cache=pc, **engine_kwargs)
 
-        router = cls([mk(f"r{i}") for i in range(num_replicas)], config=config)
+        router = cls([mk(f"r{i}") for i in range(num_replicas)], config=config, trace=trace)
         router._mk_engine = mk
         return router
 
@@ -725,6 +787,9 @@ class FleetRouter:
         for n in warm_prompt_lens:
             engine.submit(rng.integers(1, 100, size=int(n)).astype(np.int32), max_new_tokens)
         engine.run()
+        # wire tracing only AFTER the warm-up requests drained, so the
+        # synthetic warm prompts never show up as traced fleet requests
+        self._wire_replica_tracing(rep)
         ms = (time.perf_counter() - t0) * 1000.0
         with self._lock:
             self.replicas.append(rep)
@@ -793,6 +858,12 @@ class FleetRouter:
                     )
             fuid = self._uid
             self._uid += 1
+            # trace minted AFTER the fleet-edge shed gates: an edge
+            # rejection never touched a replica, so it carries no trace
+            tid = None
+            if self.tracer is not None:
+                tid = self.tracer.start(fuid=fuid, prompt_tokens=int(len(prompt)))
+                self._trace_ids[fuid] = tid
             if self.disaggregated and not self._handoff_decision(len(prompt)):
                 self.handoffs_local += 1
             elif self.disaggregated:
@@ -803,6 +874,7 @@ class FleetRouter:
                         "max_new_tokens": int(max_new_tokens),
                         "priority": int(priority),
                         "stop_sequences": stop_sequences,
+                        "trace": tid,
                     }
                 )
                 self._map[fuid] = ("pending", None)
@@ -815,11 +887,12 @@ class FleetRouter:
                 pid, plen = prefix
                 local = rep.engine.submit(
                     prompt[plen:], max_new_tokens, prefix_id=pid,
-                    stop_sequences=stop_sequences, priority=priority,
+                    stop_sequences=stop_sequences, priority=priority, trace=tid,
                 )
             else:
                 local = rep.engine.submit(
-                    prompt, max_new_tokens, stop_sequences=stop_sequences, priority=priority
+                    prompt, max_new_tokens, stop_sequences=stop_sequences,
+                    priority=priority, trace=tid,
                 )
                 if rep.radix is not None:
                     rep.radix.observe(prompt)
@@ -928,6 +1001,45 @@ class FleetRouter:
         rep.engine.metrics.on_replica_state(HEALTH_STATES.index(state))
         rep.engine._log.event(
             "replica_state", replica=rep.name, prev=prev, state=state, reason=reason
+        )
+        # fatal transitions auto-dump the replica's flight recorder: the
+        # ring already holds the fault's events (the emit above included),
+        # plus the in-flight table and any open trace spans
+        if state in ("quarantined", "dead"):
+            self._flight_dump(rep, reason=f"{state}: {reason}")
+
+    def _flight_dump(self, rep: Replica, reason: str) -> None:
+        """Dump one replica's flight recorder (no-op when tracing is off).
+        Never raises — the dump rides a failure path that must complete."""
+        fr = rep.flightrec
+        if fr is None:
+            return
+        inflight = []
+        try:
+            for uid, (state, req) in list(rep.engine._index.items()):
+                if state == "done" or req is None:
+                    continue
+                inflight.append(
+                    {
+                        "uid": int(uid),
+                        "state": state,
+                        "generated": len(req.out_tokens),
+                        "priority": int(req.priority),
+                        "trace": req.trace,
+                    }
+                )
+        except Exception:  # noqa: BLE001 — a husk's host tables may be torn
+            pass
+        spans = self.tracer.open_spans() if self.tracer is not None else []
+        path = None
+        tcfg = self.trace_config
+        if tcfg is not None and tcfg.flight_dump_dir:
+            path = os.path.join(tcfg.flight_dump_dir, f"flight_{rep.name}.json")
+        doc = fr.dump(reason=reason, inflight=inflight, open_spans=spans, path=path)
+        rep.engine._log.event(
+            "flight_dump", replica=rep.name, reason=reason,
+            events=len(doc["events"]), inflight=len(inflight),
+            open_spans=len(spans), path=path,
         )
 
     @staticmethod
@@ -1058,6 +1170,11 @@ class FleetRouter:
                     )
                     self.failovers_lost += 1
                 rep.engine.metrics.on_failover_lost()
+                if self.tracer is not None:
+                    self.tracer.finish(
+                        self._trace_ids.get(fuid), status="lost",
+                        reason=f"no snapshot recovered ({reason})",
+                    )
                 lost += 1
                 continue
             if self._failover_one(rep, fuid, snap, reason):
@@ -1107,6 +1224,11 @@ class FleetRouter:
                 self._lost[fuid] = f"no surviving replica to migrate to ({reason})"
                 self.failovers_lost += 1
             src_rep.engine.metrics.on_failover_lost()
+            if self.tracer is not None:
+                self.tracer.finish(
+                    snap.get("trace"), status="lost",
+                    reason=f"no surviving replica ({reason})",
+                )
             return False
         with self._lock:
             loads = [r.load for r in self.replicas]
@@ -1154,12 +1276,24 @@ class FleetRouter:
                 self.failovers_recompute += 1
                 self.failover_recompute_us_predicted += float(recompute_us)
         src_rep.engine.metrics.on_failover_out()
+        if self.tracer is not None:
+            # drain migrations get their own segment class so a planned
+            # removal never pollutes the failover latency distribution
+            self.tracer.seg(
+                snap.get("trace"), "drain" if reason == "drain" else "failover",
+                src=src_rep.name, dst=dst.name, path=path, reason=reason,
+                moved_bytes=moved,
+                predicted_bytes=int(pred["bytes"]) if path == "handoff" else 0,
+                predicted_us=round(float(pred["time_us"]), 3),
+                recompute_us=round(float(recompute_us), 3),
+            )
         dst.engine._log.event(
             "failover", fuid=fuid, src=src_rep.name, dst=dst.name, path=path,
             reason=reason, generated=len(snap.get("out_tokens") or []),
             predicted_bytes=int(pred["bytes"]) if path == "handoff" else 0,
             moved_bytes=moved, predicted_us=round(float(pred["time_us"]), 3),
             recompute_us=round(float(recompute_us), 3),
+            trace=snap.get("trace"),
         )
         return True
 
@@ -1204,6 +1338,11 @@ class FleetRouter:
                 if loc[1] == idx:  # only if a migration leg failed above
                     self._map.pop(fuid)
                     self._lost[fuid] = f"replica {rep.name!r} removed"
+                    if self.tracer is not None:
+                        self.tracer.finish(
+                            self._trace_ids.get(fuid), status="lost",
+                            reason=f"replica {rep.name!r} removed",
+                        )
                 elif loc[1] > idx:
                     self._map[fuid] = ("replica", loc[1] - 1, loc[2])
 
@@ -1247,6 +1386,11 @@ class FleetRouter:
                             "no decode-capable serving replica for pending handoff"
                         )
                         self.failovers_lost += 1
+                        if self.tracer is not None:
+                            self.tracer.finish(
+                                entry.get("trace"), status="lost",
+                                reason="no decode-capable serving replica",
+                            )
                     self._pending.clear()
                     return n
                 # prefill side lost? decode replicas self-prefill detached
@@ -1269,6 +1413,7 @@ class FleetRouter:
                         entry["prompt"], entry["max_new_tokens"],
                         uid_key=entry["fuid"],
                         prefix_id=None if prefix is None else prefix[0],
+                        trace=entry.get("trace"),
                     )
                     if p_rep.radix is not None and prefix is None:
                         p_rep.radix.observe(entry["prompt"])
@@ -1290,12 +1435,23 @@ class FleetRouter:
                 self.handoff_bytes_predicted += pred["bytes"]
                 self.handoff_bytes_moved += handoff["wire_bytes"]
                 self.handoff_time_us_predicted += pred["time_us"]
+            if self.tracer is not None:
+                # the router-side handoff span carries both sides of the
+                # price: critpath pins moved_bytes == predicted_bytes
+                self.tracer.seg(
+                    entry.get("trace"), "kv_handoff",
+                    src=p_rep.name, dst=d_rep.name, tokens=int(handoff["total"]),
+                    moved_bytes=int(handoff["wire_bytes"]),
+                    predicted_bytes=int(pred["bytes"]),
+                    predicted_us=round(float(pred["time_us"]), 3),
+                )
             p_rep.engine._log.event(
                 "kv_handoff", fuid=entry["fuid"], src=p_rep.name, dst=d_rep.name,
                 tokens=handoff["total"], predicted_bytes=pred["bytes"],
                 moved_bytes=handoff["wire_bytes"],
                 predicted_us=round(pred["time_us"], 3),
                 reused_prefix_tokens=handoff["reused_prefix_tokens"],
+                trace=entry.get("trace"),
             )
             n += 1
 
@@ -1420,8 +1576,11 @@ class FleetRouter:
             loc = self._map.get(fuid)
             if loc is None:
                 if fuid in self._lost:
-                    raise FleetRequestError(fuid, "lost", self._lost[fuid])
-                raise FleetRequestError(fuid, "unknown")
+                    raise FleetRequestError(
+                        fuid, "lost", self._lost[fuid],
+                        trace_id=self._trace_ids.get(fuid),
+                    )
+                raise FleetRequestError(fuid, "unknown", trace_id=self._trace_ids.get(fuid))
         return loc
 
     def _live_replica(self, fuid: int, loc) -> Replica:
@@ -1434,6 +1593,7 @@ class FleetRouter:
             raise FleetRequestError(
                 fuid, f"on {rep.health} replica {rep.name!r}",
                 rep.last_error or "failing over",
+                trace_id=self._trace_ids.get(fuid),
             )
         return rep
 
@@ -1497,10 +1657,12 @@ class FleetRouter:
                 if fuid in self._lost:
                     del self._lost[fuid]
                     return np.zeros((0,), np.int32)
-                raise FleetRequestError(fuid, "unknown")
+                raise FleetRequestError(fuid, "unknown", trace_id=self._trace_ids.get(fuid))
             if loc[0] == "pending":
                 self._pending = [e for e in self._pending if e["fuid"] != fuid]
                 del self._map[fuid]
+                if self.tracer is not None:
+                    self.tracer.finish(self._trace_ids.get(fuid), status="cancelled")
                 return np.zeros((0,), np.int32)
             if loc[0] == "done":
                 raise ValueError(f"request {fuid} already finished; poll() it instead")

@@ -28,7 +28,10 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional
 
-from .eventlog import SCHEMA_VERSION, EventLog, read_events
+from .critpath import CritPathMonitor, decompose, render_critpath
+from .eventlog import SCHEMA_VERSION, EventLog, merge_events, read_events
+from .flightrec import FlightRecorder, read_dump, render_dump
+from .httpd import TelemetryHTTPD
 from .mfu import (
     HBM_GB_TABLE,
     PEAK_FLOPS_TABLE,
@@ -43,12 +46,25 @@ from .nonfinite import NonFiniteWatchdog
 from .serving_metrics import ServingMetrics
 from .step import StepTelemetry, diff_signatures, signature_of
 from .summarize import render_text, summarize, summarize_file
+from .trace import TraceConfig, Tracer, chrome_trace, traces_from_events
 from .wire import hlo_collective_sites, hlo_wire_bytes, wire_dtype_upcast
 
 __all__ = [
     "SCHEMA_VERSION",
     "EventLog",
     "read_events",
+    "merge_events",
+    "Tracer",
+    "TraceConfig",
+    "traces_from_events",
+    "chrome_trace",
+    "FlightRecorder",
+    "read_dump",
+    "render_dump",
+    "CritPathMonitor",
+    "decompose",
+    "render_critpath",
+    "TelemetryHTTPD",
     "StepTelemetry",
     "signature_of",
     "diff_signatures",

@@ -29,6 +29,7 @@ thing that initialises the backend).
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
 import time
@@ -38,6 +39,13 @@ SCHEMA_VERSION = 1
 
 #: record kinds a well-formed telemetry line may carry
 KINDS = ("span", "counter", "event")
+
+#: per-PROCESS monotonic sequence counter, shared by every EventLog
+#: instance: after a crash, the flight-recorder dump and the main JSONL
+#: merge into one deterministic order by sorting on ``seq`` (wall-clock
+#: ``ts`` ties under coarse clocks; readers stay tolerant of old logs
+#: that predate the field).
+_SEQ = itertools.count()
 
 
 def _resolve_rank() -> int:
@@ -79,6 +87,7 @@ class EventLog:
         self._buffer_lines = max(1, int(buffer_lines))
         self.enabled = path is not None and not (main_process_only and self.rank != 0)
         self._buf: list[str] = []
+        self._taps: list = []
         self._closed = False
         self._atexit_registered = False
         if self.enabled:
@@ -99,13 +108,35 @@ class EventLog:
         can reuse it for in-memory summaries."""
         if kind not in KINDS:
             raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
-        rec = {"v": SCHEMA_VERSION, "ts": self._clock(), "rank": self.rank, "kind": kind, "name": name}
+        rec = {
+            "v": SCHEMA_VERSION,
+            "seq": next(_SEQ),
+            "ts": self._clock(),
+            "rank": self.rank,
+            "kind": kind,
+            "name": name,
+        }
         rec.update(fields)
         if self.enabled and not self._closed:
             self._buf.append(json.dumps(rec, default=_json_default))
             if len(self._buf) >= self._buffer_lines:
                 self.flush()
+        # taps see every record, even on a disabled (path=None) log — the
+        # flight recorder must keep recording when no JSONL is attached.
+        for tap in self._taps:
+            tap(rec)
         return rec
+
+    def add_tap(self, fn) -> None:
+        """Register ``fn(record_dict)`` to observe every emitted record
+        (e.g. a per-replica :class:`~.flightrec.FlightRecorder`). Taps run
+        inline on the emitting thread and must never raise or block."""
+        if fn not in self._taps:
+            self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        if fn in self._taps:
+            self._taps.remove(fn)
 
     def counter(self, name: str, value, **fields) -> dict:
         return self.emit("counter", name, value=value, **fields)
@@ -179,6 +210,17 @@ def _json_default(obj):
     if shape is not None and dtype is not None:
         return f"{dtype}[{','.join(str(d) for d in shape)}]"
     return repr(obj)
+
+
+def merge_events(*event_lists: list[dict]) -> list[dict]:
+    """Merge several record streams (the main JSONL + one or more
+    flight-recorder dumps) into one deterministic order: by the
+    per-process ``seq`` wherever two records' wall clocks tie (coarse
+    clocks make ``ts`` alone ambiguous); records written before the field
+    existed sort by ``ts`` only. Stable, so true ties keep input order."""
+    merged = [rec for lst in event_lists for rec in lst]
+    merged.sort(key=lambda r: (r.get("ts", 0.0), r.get("seq", -1)))
+    return merged
 
 
 def read_events(path: str) -> list[dict]:

@@ -1,0 +1,164 @@
+"""The fleet's first real port: a stdlib threaded HTTP endpoint for
+``/metrics``, ``/healthz``, and ``/traces``.
+
+ROADMAP item 1 ("leave the process") needs the Prometheus exposition on
+an actual socket instead of a method you must already be in-process to
+call. This is that piece, deliberately tiny: ``ThreadingHTTPServer``
+from the stdlib, one daemon accept thread, handlers that *read*
+injected callables and format outside any lock.
+
+* ``GET /metrics``  — byte-identical output of
+  :func:`~accelerate_tpu.telemetry.serving_metrics.fleet_prometheus_text`
+  (``text/plain; version=0.0.4``);
+* ``GET /healthz``  — ``FleetRouter.health()`` as JSON; 200 while any
+  replica still serves, 503 once fleet capacity is lost;
+* ``GET /traces``   — recent completed traces (``?n=`` caps the count).
+
+Host-concurrency discipline (strict ``fleet-check``, TPU901-903): the
+accept loop runs in a module-level function that receives the server
+object as an argument — no shared mutable attribute crosses thread
+contexts, so there is nothing a lock would need to guard; ``stop()``
+shuts the server down and joins the (daemon) thread from the caller's
+context with no lock held.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+#: health states that count as "still serving" for the 503 decision —
+#: mirrors ``Replica.is_serving`` in serving_fleet.py.
+_SERVING_STATES = ("healthy", "degraded")
+
+
+def _serve(srv: ThreadingHTTPServer) -> None:
+    """Accept-loop thread body. Takes the server as an argument so the
+    thread shares no mutable attribute with the owning object."""
+    srv.serve_forever(poll_interval=0.05)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes the three endpoints; all state comes from ``server.app``,
+    a dict of callables frozen before the accept thread starts."""
+
+    server_version = "accelerate-tpu-telemetry/1"
+
+    def do_GET(self):  # noqa: N802 - stdlib handler contract
+        app = self.server.app
+        parsed = urlparse(self.path)
+        route = parsed.path.rstrip("/") or "/"
+        if route == "/metrics":
+            body = app["metrics"]().encode("utf-8")
+            self._reply(200, body, "text/plain; version=0.0.4; charset=utf-8")
+        elif route == "/healthz":
+            health = app["health"]()
+            serving = any(row.get("health") in _SERVING_STATES for row in health.values())
+            body = json.dumps({"serving": serving, "replicas": health}, sort_keys=True).encode("utf-8")
+            self._reply(200 if serving else 503, body, "application/json")
+        elif route == "/traces":
+            qs = parse_qs(parsed.query)
+            try:
+                n = int(qs.get("n", ["64"])[0])
+            except ValueError:
+                n = 64
+            body = json.dumps({"traces": app["traces"](max(0, n))}, default=repr).encode("utf-8")
+            self._reply(200, body, "application/json")
+        else:
+            self._reply(404, b'{"error": "unknown path"}\n', "application/json")
+
+    def _reply(self, status: int, body: bytes, ctype: str):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class TelemetryHTTPD:
+    """Owns one ``ThreadingHTTPServer`` + its daemon accept thread.
+
+    ``port=0`` binds an ephemeral port (tests); :meth:`start` returns
+    the bound port. Usable as a context manager."""
+
+    def __init__(
+        self,
+        *,
+        metrics_fn: Callable[[], str],
+        health_fn: Optional[Callable[[], dict]] = None,
+        traces_fn: Optional[Callable[[int], list]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.host = host
+        self.port = port
+        self._app = {
+            "metrics": metrics_fn,
+            "health": health_fn if health_fn is not None else dict,
+            "traces": traces_fn if traces_fn is not None else (lambda n: []),
+        }
+        self._srv: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def for_router(cls, router, *, host: str = "127.0.0.1", port: int = 0) -> "TelemetryHTTPD":
+        """Wire the three endpoints to a ``FleetRouter``: ``/metrics`` is
+        ``router.prometheus_text`` verbatim, ``/healthz`` is
+        ``router.health()``, ``/traces`` drains the router's tracer."""
+
+        def traces(n: int) -> list:
+            tracer = getattr(router, "tracer", None)
+            return tracer.completed(n) if tracer is not None else []
+
+        return cls(
+            metrics_fn=router.prometheus_text,
+            health_fn=router.health,
+            traces_fn=traces,
+            host=host,
+            port=port,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> int:
+        """Bind (on the caller's thread, so the port is known before the
+        accept thread exists) and start serving; returns the port."""
+        if self._srv is not None:
+            return self.port
+        srv = ThreadingHTTPServer((self.host, self.port), _Handler)
+        srv.daemon_threads = True
+        srv.app = self._app
+        thread = threading.Thread(target=_serve, args=(srv,), name="telemetry-httpd", daemon=True)
+        thread.start()
+        self._srv = srv
+        self._thread = thread
+        self.port = srv.server_address[1]
+        return self.port
+
+    def stop(self) -> None:
+        """Shut down the accept loop and join the thread (caller's
+        context, no lock held)."""
+        srv, thread = self._srv, self._thread
+        self._srv = None
+        self._thread = None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def url(self, path: str = "/") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def __enter__(self) -> "TelemetryHTTPD":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

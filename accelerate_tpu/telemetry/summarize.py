@@ -174,6 +174,38 @@ def summarize(events: list[dict]) -> dict:
             else None,
         }
 
+    # request traces (telemetry.trace): reconstruct completed traces from
+    # their span + trace_complete records, decompose the critical path,
+    # and surface the latched trace_drift warnings + flight-recorder dumps
+    trace_complete = [
+        e for e in events if e.get("kind") == "event" and e.get("name") == "trace_complete"
+    ]
+    if trace_complete:
+        from .critpath import decompose
+        from .trace import traces_from_events
+
+        traces = traces_from_events(events)
+        tdrift = [e for e in events if e.get("kind") == "event" and e.get("name") == "trace_drift"]
+        dumps = [e for e in events if e.get("kind") == "event" and e.get("name") == "flight_dump"]
+        decomp = decompose(traces)
+        report["traces"] = {
+            "count": decomp["count"],
+            "completed": decomp["completed"],
+            "by_class": decomp["by_class"],
+            "drift_events": [
+                {
+                    "segment": e.get("segment"),
+                    "check": e.get("check"),
+                    "observed": e.get("observed"),
+                    "predicted": e.get("predicted"),
+                    "rel_error": e.get("rel_error"),
+                    "trace": e.get("trace"),
+                }
+                for e in tdrift
+            ],
+            "flight_dumps": len(dumps),
+        }
+
     warnings = [
         e for e in events
         if e.get("kind") == "event" and e.get("severity") in ("warning", "error")
@@ -282,6 +314,26 @@ def render_text(report: dict) -> str:
             lines.append(
                 f"    bucket {b.get('program')}[{b.get('bucket')}]: built in {b.get('compile_ms')} ms"
             )
+    traces = report.get("traces")
+    if traces:
+        lines.append("  traces:")
+        lines.append(
+            f"    requests          : {traces['count']} traced, {traces['completed']} completed ok"
+        )
+        if traces.get("by_class"):
+            lines.append("    segment         count   p50_ms    p95_ms    total_ms  share")
+            for name, row in traces["by_class"].items():
+                lines.append(
+                    f"    {name:<15} {row['count']:>5} {row['p50_ms']:>9.3f} {row['p95_ms']:>9.3f}"
+                    f" {row['total_ms']:>11.3f}  {row['share']:.1%}"
+                )
+        for d in traces.get("drift_events", []):
+            lines.append(
+                f"    DRIFT: {d['segment']} vs {d['check']}: observed {d['observed']} "
+                f"vs predicted {d['predicted']} ({d['rel_error']:.0%} off, trace {d['trace']})"
+            )
+        if traces.get("flight_dumps"):
+            lines.append(f"    flight dumps      : {traces['flight_dumps']}")
     nf = report.get("nonfinite")
     if nf:
         lines.append("  non-finite watchdog:")

@@ -221,6 +221,16 @@ class TelemetryKwargs(KwargsHandler):
     forward_to_trackers_every: int = 10
     nonfinite_every: int = 0
     main_process_only: bool = True
+    # serving-side request tracing (telemetry.trace): trace_requests=True
+    # turns :meth:`trace_config` into a TraceConfig suitable for
+    # ``FleetRouter(trace=...)`` — per-request spans, per-replica crash
+    # flight recorders, and the critical-path drift cross-checks
+    trace_requests: bool = False
+    trace_max_traces: int = 4096
+    trace_drift_check: bool = True
+    flight_recorder: bool = True
+    flight_capacity: int = 256
+    flight_dump_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.warmup_steps < 0:
@@ -229,6 +239,26 @@ class TelemetryKwargs(KwargsHandler):
             raise ValueError("hbm_sample_every / forward_to_trackers_every must be >= 0")
         if self.nonfinite_every < 0:
             raise ValueError(f"nonfinite_every must be >= 0, got {self.nonfinite_every}")
+        if self.trace_max_traces < 1:
+            raise ValueError(f"trace_max_traces must be >= 1, got {self.trace_max_traces}")
+        if self.flight_capacity < 8:
+            raise ValueError(f"flight_capacity must be >= 8, got {self.flight_capacity}")
+
+    def trace_config(self):
+        """The serving-trace half of these knobs as a
+        :class:`~accelerate_tpu.telemetry.TraceConfig` (None when
+        ``trace_requests`` is off) — pass as ``FleetRouter(trace=...)``."""
+        if not self.trace_requests:
+            return None
+        from ..telemetry.trace import TraceConfig
+
+        return TraceConfig(
+            max_traces=self.trace_max_traces,
+            drift_check=self.trace_drift_check,
+            flight_recorder=self.flight_recorder,
+            flight_capacity=self.flight_capacity,
+            flight_dump_dir=self.flight_dump_dir,
+        )
 
 
 @dataclass

@@ -206,7 +206,7 @@ def fleet_workload(args, vocab, rng):
 
 
 def make_fleet(model, args, *, replicas, prefix_reuse=True, roles=None, handoff="auto",
-               failover="auto", store_dir=None):
+               failover="auto", store_dir=None, trace=None):
     from accelerate_tpu.serving_fleet import FleetConfig, FleetRouter
 
     return FleetRouter.from_model(
@@ -215,7 +215,7 @@ def make_fleet(model, args, *, replicas, prefix_reuse=True, roles=None, handoff=
             roles=roles, handoff=handoff, prefix_reuse=prefix_reuse, failover=failover,
             min_prefix_tokens=args.buckets[0], promote_after=2, max_prefix_entries=8,
         ),
-        store_dir=store_dir,
+        store_dir=store_dir, trace=trace,
         num_slots=args.slots, prompt_buckets=tuple(args.buckets),
         tick_block=args.tick_block, max_len=model.config.max_position_embeddings,
     )
@@ -658,6 +658,218 @@ def run_chaos(args) -> int:
     return 0 if report["ok"] else 1
 
 
+# ===================================================================== #
+# trace mode (--trace): priced critical paths under disaggregation+chaos
+# ===================================================================== #
+
+
+def _trace_rows(router):
+    """Completed fleet-request traces (warmup traffic is engine-submitted
+    and carries no ``fuid``, so it filters out here)."""
+    return [t for t in router.tracer.completed() if "fuid" in t.get("meta", {})]
+
+
+def _ttft_decomposition(traces):
+    """Per-class p50 of time spent BEFORE the first decode token — the
+    trace-derived TTFT split (queue_wait / admit / prefill / kv_handoff /
+    resume)."""
+    acc = {}
+    for tr in traces:
+        pre = {}
+        for sp in tr["spans"]:
+            if sp["name"] == "decode":
+                break
+            pre[sp["name"]] = pre.get(sp["name"], 0.0) + sp["dur_ms"]
+        for name, ms in pre.items():
+            acc.setdefault(name, []).append(ms)
+    return {name: _pct(vals, 50) for name, vals in sorted(acc.items())}
+
+
+def run_trace(args) -> int:
+    """The tracing benchmark (``--trace``): drive a DISAGGREGATED fleet
+    (prefill replica handing KV to decode replicas) under the open-loop
+    schedule with request tracing on, crash one decode replica
+    mid-decode, and hold the whole telemetry story to account:
+
+    * every completed request's segment sum must reconcile with its
+      measured end-to-end latency within 5% (the spans are
+      frontier-contiguous by construction — this pins that);
+    * every router-side ``kv_handoff`` span's bytes AND microseconds
+      must equal an independent ``price_kv_handoff`` recomputation;
+    * the crashed requests' traces must show the ``failover`` span with
+      ``moved_bytes == predicted_bytes`` (``price_failover``) and their
+      outputs must be token- and logprob-exact vs the no-fault control;
+    * zero ``trace_drift`` latches (the predictors were honest);
+    * the dead replica must leave a flight-recorder dump whose tail
+      holds the fault's ``replica_state`` event.
+
+    Prints the JSON report; exit 1 unless every criterion holds."""
+    import tempfile
+
+    from accelerate_tpu.analysis.costmodel import price_kv_handoff
+    from accelerate_tpu.test_utils.fault_injection import ReplicaChaos
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(1)
+    model, cfg = fleet_model()
+    vocab = cfg.vocab_size
+    args.buckets = (16, 32)
+    args.decode_budgets = (8, 16, 24)
+    args.preamble_len = args.preamble_len or (48 if args.smoke else 64)
+    args.n_preambles = args.n_preambles or 2
+    args.fleet_clients = args.fleet_clients or (16 if args.smoke else 32)
+    args.fleet_rate = args.fleet_rate or 8.0
+    args.slots = args.slots or 2
+    args.tick_block = args.tick_block or 4
+    crash_tick = 4 if args.smoke else 8
+    rng = np.random.default_rng(args.seed)
+    events = fleet_workload(args, vocab, rng)
+    report = {
+        "bench": "bench_serving --trace",
+        "clients": args.fleet_clients,
+        "rate_req_per_s": args.fleet_rate,
+        "replicas": 3,
+        "roles": ["prefill", "decode", "decode"],
+        "slots_per_replica": args.slots,
+        "buckets": list(args.buckets),
+        "crash": {"replica": "r1", "point": "mid_decode", "busy_visit": crash_tick,
+                  "action": "crash"},
+    }
+
+    def build(store):
+        router = make_fleet(
+            model, args, replicas=3, prefix_reuse=False,
+            roles=("prefill", "decode", "decode"), handoff="always",
+            failover="handoff", store_dir=store, trace=True,
+        )
+        fleet_warmup(router, args, vocab, np.random.default_rng(args.seed + 1))
+        return router
+
+    def segment_gaps(traces):
+        gaps = []
+        for tr in traces:
+            if tr["status"] != "ok" or tr["dur_ms"] <= 0:
+                continue
+            seg_sum = sum(sp["dur_ms"] for sp in tr["spans"])
+            gaps.append(abs(tr["dur_ms"] - seg_sum) / tr["dur_ms"])
+        return gaps
+
+    def handoff_span_audit(router, traces):
+        """(spans checked, all bytes exact, all us exact) against an
+        independent price_kv_handoff recomputation."""
+        per_tok, fixed = router.replicas[0].engine.kv_handoff_dims()
+        checked, bytes_ok, us_ok = 0, True, True
+        for tr in traces:
+            for sp in tr["spans"]:
+                if sp["name"] != "kv_handoff" or sp.get("moved_bytes") is None:
+                    continue
+                pred = price_kv_handoff(
+                    per_tok, int(sp["tokens"]), fixed_bytes=fixed,
+                    transport=router.config.transport,
+                    generation=router.config.generation,
+                )
+                checked += 1
+                if not (sp["moved_bytes"] == sp["predicted_bytes"] == pred["bytes"]):
+                    bytes_ok = False
+                if round(float(pred["time_us"]), 3) != sp["predicted_us"]:
+                    us_ok = False
+        return checked, bytes_ok, us_ok
+
+    with tempfile.TemporaryDirectory() as store:
+        # -- control arm: identical schedule, no fault ------------------- #
+        control = build(store)
+        elapsed_c, ttft_c, uids_c, outs_c, lps_c, lost_c = chaos_drive(control, events)
+        traces_c = _trace_rows(control)
+        gaps_c = segment_gaps(traces_c)
+        checked_c, bytes_ok_c, us_ok_c = handoff_span_audit(control, traces_c)
+        report["control"] = {
+            "elapsed_s": round(elapsed_c, 2),
+            "completed": len(outs_c),
+            "lost": len(lost_c),
+            "traced": len(traces_c),
+            "max_segment_gap": round(max(gaps_c), 4) if gaps_c else None,
+            "handoff_spans_checked": checked_c,
+            "ttft_decomposition_ms_p50": _ttft_decomposition(traces_c),
+            "drift_latches": sorted(control.critpath.drift_events),
+        }
+
+        # -- chaos arm: crash decode replica r1 mid-decode --------------- #
+        router = build(store)
+        with ReplicaChaos("mid_decode", replica="r1", action="crash",
+                          hits=crash_tick) as chaos:
+            elapsed_x, ttft_x, uids_x, outs_x, lps_x, lost_x = chaos_drive(router, events)
+        traces_x = _trace_rows(router)
+        gaps_x = segment_gaps(traces_x)
+        checked_x, bytes_ok_x, us_ok_x = handoff_span_audit(router, traces_x)
+        acct = router.failover_accounting()
+
+        failover_spans = [
+            (tr, sp)
+            for tr in traces_x
+            for sp in tr["spans"]
+            if sp["name"] == "failover"
+        ]
+        failover_fuids = sorted({tr["meta"]["fuid"] for tr, _ in failover_spans})
+        failover_bytes_ok = all(
+            sp["moved_bytes"] == sp["predicted_bytes"]
+            for _, sp in failover_spans
+            if sp.get("path") == "handoff"
+        )
+        failover_exact = bool(failover_fuids) and all(
+            u in outs_x and u in outs_c
+            and np.array_equal(outs_x[u], outs_c[u])
+            and np.array_equal(lps_x[u], lps_c[u])
+            for u in failover_fuids
+        )
+
+        dead = next((r for r in router.replicas if r.health == "dead"), None)
+        dump = dead.flightrec.last_dump if dead is not None and dead.flightrec else None
+        dump_has_fault = bool(dump) and any(
+            e.get("name") == "replica_state" and "SimulatedCrash" in str(e.get("reason", ""))
+            for e in dump["events"]
+        )
+        report["chaos"] = {
+            "elapsed_s": round(elapsed_x, 2),
+            "completed": len(outs_x),
+            "lost": len(lost_x),
+            "traced": len(traces_x),
+            "crash_fired": chaos.fired,
+            "max_segment_gap": round(max(gaps_x), 4) if gaps_x else None,
+            "handoff_spans_checked": checked_x,
+            "failover_traced_fuids": failover_fuids,
+            "failover_accounting": acct,
+            "ttft_decomposition_ms_p50": _ttft_decomposition(traces_x),
+            "drift_latches": sorted(router.critpath.drift_events),
+            "flight_dump": None if not dump else {
+                "replica": dead.name,
+                "reason": dump["reason"],
+                "events": len(dump["events"]),
+                "inflight": len(dump["inflight"]),
+                "open_spans": len(dump["open_spans"]),
+            },
+        }
+
+    all_gaps = gaps_c + gaps_x
+    criteria = {
+        "chaos_completion_100": len(outs_x) == len(events) and not lost_x,
+        "every_request_traced": len(traces_c) == len(events) == len(traces_x),
+        "segment_sum_within_5pct": bool(all_gaps) and max(all_gaps) <= 0.05,
+        "handoff_bytes_exact": checked_c + checked_x > 0 and bytes_ok_c and bytes_ok_x,
+        "handoff_us_match_price": us_ok_c and us_ok_x,
+        "failover_span_traced": chaos.fired and bool(failover_fuids),
+        "failover_bytes_exact": failover_bytes_ok
+        and acct["bytes_predicted"] == acct["bytes_moved"],
+        "failover_token_and_logprob_exact": failover_exact,
+        "zero_drift_latched": not control.critpath.drift_events
+        and not router.critpath.drift_events,
+        "flight_dump_holds_fault": dump_has_fault,
+    }
+    report["criteria"] = criteria
+    report["ok"] = all(criteria.values())
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true", help="CPU CI mode: tiny model, bounded load")
@@ -667,6 +879,10 @@ def main(argv=None):
     ap.add_argument("--chaos", action="store_true",
                     help="chaos mode: crash a replica mid-flight and hold the fleet to "
                          "token-exact failover + zero-compile capacity recovery")
+    ap.add_argument("--trace", action="store_true",
+                    help="trace mode: disaggregated fleet with request tracing on — "
+                         "segment-sum reconciliation, priced handoff/failover spans, "
+                         "crash flight dump")
     ap.add_argument("--preamble-len", dest="preamble_len", type=int, default=None)
     ap.add_argument("--n-preambles", dest="n_preambles", type=int, default=None)
     ap.add_argument("--fleet-clients", dest="fleet_clients", type=int, default=None)
@@ -689,6 +905,8 @@ def main(argv=None):
     ap.add_argument("--schedulers", default="fifo,continuous")
     args = ap.parse_args(argv)
 
+    if args.trace:
+        raise SystemExit(run_trace(args))
     if args.chaos:
         raise SystemExit(run_chaos(args))
     if args.fleet:

@@ -106,6 +106,10 @@ MODULES = [
     "accelerate_tpu.telemetry.summarize",
     "accelerate_tpu.telemetry.nonfinite",
     "accelerate_tpu.telemetry.wire",
+    "accelerate_tpu.telemetry.trace",
+    "accelerate_tpu.telemetry.flightrec",
+    "accelerate_tpu.telemetry.critpath",
+    "accelerate_tpu.telemetry.httpd",
     "accelerate_tpu.models",
 ]
 

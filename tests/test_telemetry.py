@@ -48,8 +48,14 @@ def test_eventlog_schema_and_kinds(tmp_path):
     for e in events:
         assert e["v"] == 1 and e["rank"] == 3 and e["ts"] == 123.5
         assert e["kind"] in ("span", "counter", "event")
-    assert events[0] == {"v": 1, "ts": 123.5, "rank": 3, "kind": "counter",
-                         "name": "hbm_bytes_in_use", "value": 1024}
+    # `seq` is the per-process monotonic counter (additive in-place to
+    # v1 — readers tolerate records without it); its absolute value
+    # depends on everything emitted earlier in the process
+    assert {k: v for k, v in events[0].items() if k != "seq"} == {
+        "v": 1, "ts": 123.5, "rank": 3, "kind": "counter",
+        "name": "hbm_bytes_in_use", "value": 1024}
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 3
     assert events[1]["severity"] == "warning" and events[1]["step"] == 7
     assert events[2]["name"] == "prefill" and events[2]["dur_ms"] >= 0
 
