@@ -72,7 +72,8 @@ lint-sarif:
 	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli fleet-check \
 		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft \
 		accelerate_tpu/telemetry/httpd.py accelerate_tpu/telemetry/flightrec.py \
-		accelerate_tpu/telemetry/trace.py --format sarif > .cache/fleet.sarif
+		accelerate_tpu/telemetry/trace.py accelerate_tpu/serving_proc.py \
+		accelerate_tpu/serving_transport.py --format sarif > .cache/fleet.sarif
 	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif .cache/fleet.sarif -o lint-merged.sarif
 
 # Static perf tier: prove TPU501-505 fire on their seeded defects, each
@@ -137,7 +138,9 @@ pipe-check:
 # sleep-under-lock, protocol-invariant breaks, unjoined worker) and
 # every clean twin stays silent — then dogfood the host-concurrency lint
 # over the real fleet surface AND model-check the replica health state
-# machine extracted from serving_fleet.py against the PR-15 invariants.
+# machine extracted from serving_fleet.py against the PR-15 invariants
+# (plus the process supervisor's worker lifecycle from serving_proc.py:
+# respawn cap, restart-storm breaker, shed-on-zero-routable).
 # The gate is STRICT for TPU901 (a reachable ABBA deadlock) and TPU904
 # (a protocol invariant violation or an unpinned failure path) via their
 # error severity; TPU902/903/905 warnings report but pass. Pure stdlib —
@@ -146,7 +149,8 @@ fleet-check:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli fleet-check --selfcheck \
 		accelerate_tpu/serving_fleet.py accelerate_tpu/scheduling.py accelerate_tpu/ft \
 		accelerate_tpu/telemetry/httpd.py accelerate_tpu/telemetry/flightrec.py \
-		accelerate_tpu/telemetry/trace.py
+		accelerate_tpu/telemetry/trace.py accelerate_tpu/serving_proc.py \
+		accelerate_tpu/serving_transport.py
 
 # Pipeline analyzer A/B on CPU (committed evidence: BENCH_PIPE.json):
 # pipemodel's bubble-adjusted prediction vs StepTelemetry-measured step

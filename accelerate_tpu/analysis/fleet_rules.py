@@ -731,3 +731,626 @@ def coverage_map(report: CheckReport, chaos_coverage=None) -> dict:
         "/".join(p): coverage.get(p)
         for p in sorted(report.explored_paths)
     }
+
+
+# ===================================================================== #
+# PR 19: the PROCESS supervisor's health machine (serving_proc.py) —
+# the same prove-don't-sample discipline applied to REAL process death.
+# The in-process FleetRouter above and the ProcessSupervisor implement
+# the same protocol family, but the supervisor adds the lifecycle the
+# router never needed: jittered-backoff respawn with a per-slot attempt
+# cap and a fleet-wide restart-storm circuit breaker. Both are new
+# reachable regions of the state space, so both are extracted, model
+# checked, and pinned to process-level ReplicaChaos tests
+# (tests/test_proc.py) by PROC_CHAOS_COVERAGE.
+# ===================================================================== #
+
+_PROC_MODULE = "serving_proc.py"
+#: model bounds for the respawn lifecycle: a per-slot cap of 2 and a
+#: storm threshold of 3 keep the BFS small while still reaching giveup
+#: (one slot exhausts its cap) AND the breaker (total respawns across
+#: slots trip the window counter) in the same run.
+_PROC_MAX_RESPAWNS = 2
+_PROC_STORM_THRESHOLD = 3
+
+
+@dataclass(frozen=True)
+class ProcSpec:
+    """The supervisor's worker-lifecycle protocol as extracted from
+    ``serving_proc.py`` — :class:`ProtocolSpec`'s process-level sibling,
+    plus the respawn/backoff/storm states only real processes have."""
+
+    states: tuple = ("spawning", "healthy", "degraded", "quarantined", "dead")
+    initial: str = "healthy"  # modeled post-hello: spawning is pre-protocol
+    serving: frozenset = frozenset({"healthy", "degraded"})
+    #: failure kind -> health state (crash = REAL process exit/SIGKILL)
+    target_state: tuple = (
+        ("crash", "dead"), ("poison", "quarantined"), ("timeout", "quarantined")
+    )
+    #: failure kind -> is the husk's last-polled KV snapshot trusted? (sorted)
+    kv_trust: tuple = (
+        ("crash", True), ("drain", True), ("poison", False), ("timeout", True)
+    )
+    #: failure kind -> does the transition migrate in-flight work? (sorted)
+    migrates: tuple = (
+        ("crash", True), ("drain", True), ("poison", True), ("timeout", True)
+    )
+    #: failure kind -> does the transition schedule a respawn? (sorted)
+    respawns_after: tuple = (("crash", True), ("poison", True), ("timeout", True))
+    quarantine_after_timeouts: int = 2
+    heal_after_polls: int = 2
+    timeout_soft_state: str = "degraded"
+    heal_state: str = "healthy"
+    #: submit sheds exactly when ``_route()`` finds zero routable workers
+    sheds_on_zero_routable: bool = True
+    max_respawns: int = _PROC_MAX_RESPAWNS
+    storm_threshold: int = _PROC_STORM_THRESHOLD
+    #: ``_schedule_respawn`` gives up once the per-slot cap is reached
+    respawn_cap_guard: bool = True
+    #: ``_schedule_respawn`` opens the fleet-wide breaker on a restart storm
+    storm_breaker_guard: bool = True
+
+    def kind_target(self, kind: str) -> str:
+        return dict(self.target_state)[kind]
+
+    def kind_kv(self, kind: str) -> bool:
+        return dict(self.kv_trust)[kind]
+
+    def kind_migrates(self, kind: str) -> bool:
+        return dict(self.migrates)[kind]
+
+    def kind_respawns(self, kind: str) -> bool:
+        return dict(self.respawns_after).get(kind, False)
+
+
+#: explored supervisor failure path -> the PROCESS-level ReplicaChaos
+#: test (tests/test_proc.py) that observes it on real subprocesses.
+#: test_proc_rules drift-gates both directions, exactly like
+#: CHAOS_COVERAGE: a new reachable lifecycle path cannot land untested.
+PROC_CHAOS_COVERAGE = {
+    ("crash", "failover"): "test_proc_sigkill_failover_completes_on_survivor",
+    ("crash", "capacity_lost"): "test_proc_sole_worker_death_lost_not_stranded",
+    ("failover", "lost_counted"): "test_proc_sole_worker_death_lost_not_stranded",
+    ("capacity_lost", "shed"): "test_proc_sole_worker_death_lost_not_stranded",
+    ("respawn", "ok"): "test_proc_sigkill_failover_completes_on_survivor",
+    ("respawn", "giveup"): "test_proc_sole_worker_death_lost_not_stranded",
+    ("respawn", "storm_breaker"): "test_proc_restart_storm_opens_breaker",
+    ("timeout", "degraded"): "test_proc_hang_degrades_then_heals",
+    ("degraded", "heal"): "test_proc_hang_degrades_then_heals",
+    ("timeout", "quarantine"): "test_proc_stall_quarantines_and_respawns",
+    ("timeout", "capacity_lost"): "test_proc_sole_worker_stall_lost_not_stranded",
+    ("poison", "quarantine_no_kv"): "test_proc_poison_quarantines_recompute_only",
+    ("poison", "capacity_lost"): "test_proc_sole_worker_poison_lost_not_stranded",
+    ("drain", "migrate"): "test_proc_drain_worker_migrates",
+}
+
+
+def extract_proc_spec(proc_source: str, path: str = _PROC_MODULE):
+    """``(spec, problems)`` — the supervisor lifecycle read out of
+    ``serving_proc.py`` by the same mini-evaluator discipline: every
+    extraction miss is a problem (=> TPU904), never a guess."""
+    problems: list[str] = []
+    fields: dict = {}
+    try:
+        tree = ast.parse(proc_source, filename=path)
+    except SyntaxError as e:
+        return None, [f"cannot parse {path}: {e.msg} (line {e.lineno})"]
+
+    # 1. WORKER_STATES + the serving subset, both module-level literals
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "WORKER_STATES":
+                    try:
+                        fields["states"] = tuple(_const_eval(node.value, {}))
+                    except _Unknown:
+                        problems.append("WORKER_STATES is not a literal tuple")
+                if isinstance(t, ast.Name) and t.id == "SERVING_WORKER_STATES":
+                    try:
+                        fields["serving"] = frozenset(_const_eval(node.value, {}))
+                    except _Unknown:
+                        problems.append("SERVING_WORKER_STATES is not a literal tuple")
+    if "states" not in fields:
+        problems.append("WORKER_STATES not found at module level")
+    if "serving" not in fields:
+        problems.append("SERVING_WORKER_STATES not found at module level")
+
+    target, kv, migrates, respawns = {}, {}, {}, {}
+
+    def read_handler(fn, kind):
+        """_set_health target + _migrate_worker(allow_kv=) + respawn
+        scheduling out of one failure handler."""
+        sh = list(_calls_named(fn, "_set_health"))
+        if sh and isinstance(sh[0].args[1], ast.Constant):
+            target[kind] = sh[0].args[1].value
+        else:
+            problems.append(f"{fn.name}: no constant _set_health target")
+        mg = list(_calls_named(fn, "_migrate_worker"))
+        if mg:
+            migrates[kind] = True
+            kv_expr = _kw(mg[0], "allow_kv")
+            try:
+                kv[kind] = _const_eval(kv_expr, {}) if kv_expr is not None else True
+            except _Unknown as e:
+                problems.append(f"{fn.name}: cannot fold allow_kv ({e})")
+        else:
+            migrates[kind] = False
+        respawns[kind] = bool(list(_calls_named(fn, "_schedule_respawn")))
+
+    # 2. real process death: _on_worker_exit
+    on_exit = _find_method(tree, "ProcessSupervisor", "_on_worker_exit")
+    if on_exit is None:
+        problems.append("ProcessSupervisor._on_worker_exit not found")
+    else:
+        read_handler(on_exit, "crash")
+
+    # 3. _on_worker_timeout: threshold branch (hard) + degrade branch (soft)
+    on_timeout = _find_method(tree, "ProcessSupervisor", "_on_worker_timeout")
+    if on_timeout is None:
+        problems.append("ProcessSupervisor._on_worker_timeout not found")
+    else:
+        threshold_seen = soft_seen = False
+        for node in ast.walk(on_timeout):
+            if isinstance(node, ast.If) and "quarantine_after_timeouts" in ast.dump(node.test):
+                threshold_seen = True
+                sh = list(_calls_named(node, "_set_health"))
+                hard = [
+                    c for c in sh
+                    if isinstance(c.args[1], ast.Constant)
+                    and any(c is w for b in node.body for w in ast.walk(b))
+                ]
+                if hard:
+                    target["timeout"] = hard[0].args[1].value
+                mg = [
+                    c for c in _calls_named(node, "_migrate_worker")
+                    if any(c is w for b in node.body for w in ast.walk(b))
+                ]
+                if mg:
+                    migrates["timeout"] = True
+                    kv_expr = _kw(mg[0], "allow_kv")
+                    try:
+                        kv["timeout"] = (
+                            _const_eval(kv_expr, {}) if kv_expr is not None else True
+                        )
+                    except _Unknown as e:
+                        problems.append(f"_on_worker_timeout: cannot fold allow_kv ({e})")
+                else:
+                    migrates["timeout"] = False
+                respawns["timeout"] = any(
+                    c for c in _calls_named(node, "_schedule_respawn")
+                    if any(c is w for b in node.body for w in ast.walk(b))
+                )
+                for sub in node.orelse:
+                    for sh2 in _calls_named(sub, "_set_health"):
+                        if isinstance(sh2.args[1], ast.Constant):
+                            fields["timeout_soft_state"] = sh2.args[1].value
+                            soft_seen = True
+        if not threshold_seen:
+            problems.append("_on_worker_timeout: no quarantine_after_timeouts branch")
+        if not soft_seen:
+            problems.append("_on_worker_timeout: no sub-threshold degrade branch")
+
+    # 4. _on_worker_poison
+    on_poison = _find_method(tree, "ProcessSupervisor", "_on_worker_poison")
+    if on_poison is None:
+        problems.append("ProcessSupervisor._on_worker_poison not found")
+    else:
+        read_handler(on_poison, "poison")
+
+    # 5. _on_worker_clean: the heal promotion
+    on_clean = _find_method(tree, "ProcessSupervisor", "_on_worker_clean")
+    heal_seen = False
+    if on_clean is not None:
+        for node in ast.walk(on_clean):
+            if isinstance(node, ast.If) and "heal_after_polls" in ast.dump(node.test):
+                for sh in _calls_named(node, "_set_health"):
+                    if isinstance(sh.args[1], ast.Constant):
+                        fields["heal_state"] = sh.args[1].value
+                        heal_seen = True
+    if not heal_seen:
+        problems.append("_on_worker_clean: no heal_after_polls promotion branch")
+
+    # 6. _schedule_respawn: the attempt cap + the restart-storm breaker
+    sched = _find_method(tree, "ProcessSupervisor", "_schedule_respawn")
+    cap_guard = storm_guard = False
+    if sched is None:
+        problems.append("ProcessSupervisor._schedule_respawn not found")
+    else:
+        for node in ast.walk(sched):
+            if isinstance(node, ast.If):
+                dump = ast.dump(node)
+                if "max_respawns" in ast.dump(node.test) and "gave_up" in dump:
+                    cap_guard = True
+                if "storm_threshold" in ast.dump(node.test) and "_breaker_open" in dump:
+                    storm_guard = True
+    fields["respawn_cap_guard"] = cap_guard
+    fields["storm_breaker_guard"] = storm_guard
+    if not cap_guard:
+        problems.append("_schedule_respawn: no max_respawns give-up guard")
+    if not storm_guard:
+        problems.append("_schedule_respawn: no restart-storm breaker guard")
+
+    # 7. drain_worker: migrate with trusted KV, no respawn
+    drain = _find_method(tree, "ProcessSupervisor", "drain_worker")
+    if drain is None:
+        problems.append("ProcessSupervisor.drain_worker not found")
+    else:
+        mg = list(_calls_named(drain, "_migrate_worker"))
+        if mg:
+            migrates["drain"] = True
+            kv_expr = _kw(mg[0], "allow_kv")
+            try:
+                kv["drain"] = _const_eval(kv_expr, {}) if kv_expr is not None else True
+            except _Unknown as e:
+                problems.append(f"drain_worker: cannot fold allow_kv ({e})")
+        else:
+            migrates["drain"] = False
+            problems.append("drain_worker: no _migrate_worker call")
+
+    # 8. _cmd_submit: shed exactly on zero routable workers
+    submit = _find_method(tree, "ProcessSupervisor", "_cmd_submit")
+    sheds = False
+    if submit is None:
+        problems.append("ProcessSupervisor._cmd_submit not found")
+    else:
+        routed = any(True for _ in _calls_named(submit, "_route"))
+        for node in ast.walk(submit):
+            if (
+                isinstance(node, ast.If)
+                and isinstance(node.test, ast.Compare)
+                and isinstance(node.test.ops[0], ast.Is)
+                and isinstance(node.test.comparators[0], ast.Constant)
+                and node.test.comparators[0].value is None
+                and "shed" in ast.dump(node)
+            ):
+                sheds = True
+        sheds = sheds and routed
+    fields["sheds_on_zero_routable"] = sheds
+    if not sheds:
+        problems.append("_cmd_submit: no shed-on-zero-routable-workers branch")
+
+    for kind in ("crash", "poison", "timeout"):
+        if kind not in target:
+            problems.append(f"no extracted target state for kind {kind!r}")
+    fields["target_state"] = tuple(sorted(target.items()))
+    fields["kv_trust"] = tuple(sorted(kv.items()))
+    fields["migrates"] = tuple(sorted(migrates.items()))
+    fields["respawns_after"] = tuple(sorted(respawns.items()))
+    fields["quarantine_after_timeouts"] = _THRESHOLD_CAP
+    fields["heal_after_polls"] = _THRESHOLD_CAP
+    fields["max_respawns"] = _PROC_MAX_RESPAWNS
+    fields["storm_threshold"] = _PROC_STORM_THRESHOLD
+
+    if problems:
+        return None, problems
+    return ProcSpec(**fields), []
+
+
+def load_proc_spec(package_root=None):
+    """Extract the supervisor spec from the installed sources."""
+    root = pathlib.Path(package_root) if package_root else pathlib.Path(__file__).resolve().parent.parent
+    proc = root / _PROC_MODULE
+    if not proc.exists():
+        return None, [f"source not found: {proc}"]
+    return extract_proc_spec(proc.read_text(), path=str(proc))
+
+
+def proc_model_check(spec: ProcSpec, chaos_coverage=None) -> CheckReport:
+    """Bounded-exhaustive BFS over the supervisor lifecycle. Worker slots
+    carry ``(health, timeouts, clean_polls, respawns, gave_up)`` or
+    ``None`` once drained away; fleet state adds the respawn-storm
+    counter and the breaker flag. Invariants: the three protocol ones
+    (stranded / poisoned-KV / shed-iff-zero-routable) plus the two only
+    a process fleet has — the respawn cap must bound every slot's
+    attempts, and the storm breaker must stop fleet-wide restart churn."""
+    report = CheckReport()
+    serving = spec.serving
+
+    def routable(reps):
+        return [i for i, r in enumerate(reps) if r is not None and r[0] in serving]
+
+    def migrate(reps, reqs, src, kind, paths):
+        out = list(reqs)
+        survivors = [i for i in routable(reps) if i != src]
+        for q, loc in enumerate(reqs):
+            if loc == ("rep", src):
+                if survivors:
+                    out[q] = ("rep", survivors[0])
+                    if spec.kind_kv(kind):
+                        paths.add(("handoff", kind))
+                else:
+                    out[q] = ("lost",)
+                    paths.add(("failover", "lost_counted"))
+        return tuple(out)
+
+    def check_invariants(reps, reqs, key, parents, event):
+        for loc in reqs:
+            if loc[0] == "rep":
+                r = reps[loc[1]] if loc[1] < len(reps) else None
+                if r is None or r[0] not in serving:
+                    report.violations.append(
+                        (
+                            "stranded-request",
+                            _trace(parents, key) + [event],
+                            f"request owned by worker {loc[1]} "
+                            f"({'removed' if r is None else r[0]}) after {event}",
+                        )
+                    )
+                    return False
+            elif loc[0] not in ("pending", "done", "shed", "lost", "unsubmitted"):
+                report.violations.append(
+                    ("stranded-request", _trace(parents, key) + [event], f"unaccounted location {loc}")
+                )
+                return False
+        return True
+
+    reps0 = tuple((spec.initial, 0, 0, 0, False) for _ in range(_N_SEED_REPLICAS))
+    reqs0 = tuple(("unsubmitted",) for _ in range(_N_REQUESTS))
+    init = (reps0, reqs0, 0, False)  # (workers, requests, storm_count, breaker)
+    seen = {init}
+    parents: dict = {}
+    queue = deque([init])
+
+    while queue:
+        if report.explored_states >= _STATE_CAP:
+            report.truncated = True
+            break
+        state = queue.popleft()
+        report.explored_states += 1
+        reps, reqs, storm, breaker = state
+        rt = routable(reps)
+
+        successors = []
+
+        # -- submit: shed iff zero routable workers ---------------------- #
+        for q, loc in enumerate(reqs):
+            if loc != ("unsubmitted",):
+                continue
+            if not rt:
+                if spec.sheds_on_zero_routable:
+                    nr = list(reqs)
+                    nr[q] = ("shed",)
+                    successors.append(
+                        (
+                            f"submit(req{q})->shed",
+                            (reps, tuple(nr), storm, breaker),
+                            {("capacity_lost", "shed")},
+                        )
+                    )
+                else:
+                    report.violations.append(
+                        (
+                            "breaker-missing",
+                            _trace(parents, state) + [f"submit(req{q})"],
+                            "submit with zero routable workers did not shed — the "
+                            "request queues into a fleet that can never serve it",
+                        )
+                    )
+            else:
+                for i in rt:
+                    nr = list(reqs)
+                    nr[q] = ("rep", i)
+                    successors.append(
+                        (f"submit(req{q})->w{i}", (reps, tuple(nr), storm, breaker), set())
+                    )
+            break  # requests are interchangeable
+
+        # -- completion --------------------------------------------------- #
+        for q, loc in enumerate(reqs):
+            if loc[0] == "rep" and reps[loc[1]] is not None and reps[loc[1]][0] in serving:
+                nr = list(reqs)
+                nr[q] = ("done",)
+                successors.append(
+                    (f"complete(req{q})", (reps, tuple(nr), storm, breaker), set())
+                )
+                break
+
+        # -- per-worker failure / poll events ----------------------------- #
+        for i, r in enumerate(reps):
+            if r is None:
+                continue
+            health, timeouts, clean, nresp, gave_up = r
+
+            if health in serving:
+                # real process exit (SIGKILL lands here) / poison report
+                for kind in ("crash", "poison"):
+                    paths = set()
+                    nreps = list(reps)
+                    nreps[i] = (spec.kind_target(kind), timeouts, clean, nresp, gave_up)
+                    if spec.kind_migrates(kind):
+                        nreqs = migrate(nreps, reqs, i, kind, paths)
+                    else:
+                        nreqs = reqs
+                    left = routable(tuple(nreps))
+                    if kind == "poison":
+                        paths.add(
+                            ("poison", "capacity_lost") if not left else ("poison", "quarantine_no_kv")
+                        )
+                    else:
+                        paths.add(("crash", "capacity_lost") if not left else ("crash", "failover"))
+                    successors.append(
+                        (f"{kind}(w{i})", (tuple(nreps), nreqs, storm, breaker), paths)
+                    )
+
+                # heartbeat timeout
+                paths = set()
+                nreps = list(reps)
+                if timeouts + 1 >= spec.quarantine_after_timeouts:
+                    nreps[i] = (spec.kind_target("timeout"), 0, 0, nresp, gave_up)
+                    if spec.kind_migrates("timeout"):
+                        nreqs = migrate(nreps, reqs, i, "timeout", paths)
+                    else:
+                        nreqs = reqs
+                    left = routable(tuple(nreps))
+                    paths.add(
+                        ("timeout", "capacity_lost") if not left else ("timeout", "quarantine")
+                    )
+                else:
+                    soft = spec.timeout_soft_state if health == "healthy" else health
+                    nreps[i] = (soft, timeouts + 1, 0, nresp, gave_up)
+                    nreqs = reqs
+                    paths.add(("timeout", "degraded"))
+                successors.append(
+                    (f"timeout(w{i})", (tuple(nreps), nreqs, storm, breaker), paths)
+                )
+
+                # clean poll (heal)
+                if health == spec.timeout_soft_state:
+                    paths = set()
+                    nreps = list(reps)
+                    if clean + 1 >= spec.heal_after_polls:
+                        nreps[i] = (spec.heal_state, 0, 0, nresp, gave_up)
+                        paths.add(("degraded", "heal"))
+                    else:
+                        nreps[i] = (health, 0, clean + 1, nresp, gave_up)
+                    successors.append(
+                        (f"clean(w{i})", (tuple(nreps), reqs, storm, breaker), paths)
+                    )
+
+                # drain_worker: export -> migrate -> shut the slot down
+                if spec.kind_migrates("drain"):
+                    paths = {("drain", "migrate")}
+                    nreps = list(reps)
+                    nreps[i] = ("dead", timeouts, clean, nresp, gave_up)
+                    nreqs = migrate(nreps, reqs, i, "drain", paths)
+                    nreps[i] = None  # _shutdown_slot: never respawned
+                    successors.append(
+                        (f"drain(w{i})", (tuple(nreps), nreqs, storm, breaker), paths)
+                    )
+
+            # respawn of a failed slot (compresses _schedule_respawn +
+            # _respawn_due into one event; giveup/storm decided here,
+            # exactly the order the real code checks them in)
+            elif health in ("dead", "quarantined") and not gave_up and not breaker:
+                paths = set()
+                nreps = list(reps)
+                if spec.respawn_cap_guard and nresp >= spec.max_respawns:
+                    nreps[i] = (health, timeouts, clean, nresp, True)
+                    paths.add(("respawn", "giveup"))
+                    successors.append(
+                        (f"respawn(w{i})-giveup", (tuple(nreps), reqs, storm, breaker), paths)
+                    )
+                elif spec.storm_breaker_guard and storm >= spec.storm_threshold:
+                    nreps[i] = (health, timeouts, clean, nresp, True)
+                    paths.add(("respawn", "storm_breaker"))
+                    successors.append(
+                        (f"respawn(w{i})-storm", (tuple(nreps), reqs, storm, True), paths)
+                    )
+                else:
+                    if nresp + 1 > spec.max_respawns:
+                        report.violations.append(
+                            (
+                                "respawn-unbounded",
+                                _trace(parents, state) + [f"respawn(w{i})"],
+                                f"slot respawned {nresp + 1} times past the "
+                                f"max_respawns={spec.max_respawns} cap — the give-up "
+                                "guard is gone; a crash-looping worker restarts forever",
+                            )
+                        )
+                        continue
+                    if storm + 1 > spec.storm_threshold:
+                        report.violations.append(
+                            (
+                                "restart-storm-unchecked",
+                                _trace(parents, state) + [f"respawn(w{i})"],
+                                f"fleet-wide respawn #{storm + 1} exceeded the "
+                                f"storm_threshold={spec.storm_threshold} window with no "
+                                "breaker — correlated crashes restart-storm the host",
+                            )
+                        )
+                        continue
+                    nreps[i] = (spec.initial, 0, 0, nresp + 1, False)
+                    paths.add(("respawn", "ok"))
+                    successors.append(
+                        (f"respawn(w{i})", (tuple(nreps), reqs, storm + 1, breaker), paths)
+                    )
+
+        for event, nstate, paths in successors:
+            if ("handoff", "poison") in paths:
+                report.violations.append(
+                    (
+                        "poisoned-kv-shipped",
+                        _trace(parents, state) + [event],
+                        "a worker quarantined for numerics shipped its last-polled KV "
+                        "snapshot — allow_kv=False must force the recompute path",
+                    )
+                )
+                continue
+            report.explored_paths |= {p for p in paths if p[0] != "handoff"}
+            if not check_invariants(nstate[0], nstate[1], state, parents, event):
+                continue
+            if nstate not in seen:
+                seen.add(nstate)
+                parents[nstate] = (state, event)
+                queue.append(nstate)
+
+    return report
+
+
+def proc_protocol_check(
+    spec: Optional[ProcSpec] = None,
+    chaos_coverage=None,
+    package_root=None,
+    path: str = "accelerate_tpu/" + _PROC_MODULE,
+):
+    """``(findings, report)`` for the PROCESS supervisor — extraction
+    drift, invariant violations, and unpinned lifecycle paths are all
+    TPU904, exactly like :func:`fleet_protocol_check`."""
+    findings: list[Finding] = []
+    if spec is None:
+        spec, problems = load_proc_spec(package_root)
+        if spec is None:
+            for p in problems:
+                findings.append(
+                    Finding(
+                        "TPU904",
+                        f"supervisor spec extraction drifted: {p} — the model checker "
+                        "can no longer see the worker lifecycle; re-anchor the "
+                        "extractor or the code",
+                        path=path,
+                        line=1,
+                    )
+                )
+            return findings, CheckReport()
+    coverage = PROC_CHAOS_COVERAGE if chaos_coverage is None else chaos_coverage
+    report = proc_model_check(spec, coverage)
+    for invariant, trace, detail in report.violations[:8]:
+        findings.append(
+            Finding(
+                "TPU904",
+                f"supervisor protocol invariant violated [{invariant}]: {detail} "
+                f"(counterexample: {' -> '.join(trace) if trace else 'initial state'})",
+                path=path,
+                line=1,
+            )
+        )
+    if report.truncated:
+        findings.append(
+            Finding(
+                "TPU904",
+                f"supervisor model checker truncated at {_STATE_CAP} states — the "
+                "lifecycle grew past the exploration bound; raise it or shrink the state",
+                path=path,
+                line=1,
+            )
+        )
+    if not report.violations:
+        for pathkey in sorted(report.explored_paths):
+            if pathkey not in coverage:
+                findings.append(
+                    Finding(
+                        "TPU904",
+                        f"explored supervisor path {pathkey!r} is pinned to no process-"
+                        "level chaos test — model-checks must equal chaos-observes; add "
+                        "the test and the PROC_CHAOS_COVERAGE entry",
+                        path=path,
+                        line=1,
+                    )
+                )
+    return findings, report
+
+
+def proc_coverage_map(report: CheckReport, chaos_coverage=None) -> dict:
+    """``{path -> test-or-None}`` for every explored supervisor path."""
+    coverage = PROC_CHAOS_COVERAGE if chaos_coverage is None else chaos_coverage
+    return {"/".join(p): coverage.get(p) for p in sorted(report.explored_paths)}

@@ -21,6 +21,7 @@ from .migrate import migrate_parser
 from .numericscheck import numericscheck_parser
 from .perfcheck import perfcheck_parser
 from .pipecheck import pipecheck_parser
+from .serve import serve_parser
 from .telemetry import telemetry_parser
 from .test import test_parser
 from .trace import trace_parser
@@ -53,6 +54,7 @@ def main():
     checkpoints_parser(subparsers)
     compile_cache_parser(subparsers)
     fleet_parser(subparsers)
+    serve_parser(subparsers)
     tpu_command_parser(subparsers)
     args = parser.parse_args()
     raise SystemExit(args.func(args) or 0)

@@ -15,8 +15,12 @@ analyzer that runs identically on a laptop and in the lint CI job):
   invariants — no stranded requests, poisoned KV never ships, the
   capacity breaker trips iff the last serving replica leaves — TPU904
   [ERROR] on any violation or any explored failure path not pinned to a
-  ``ReplicaChaos`` test. It runs by default (it needs no paths);
-  ``--no-protocol`` skips it when linting non-fleet code.
+  ``ReplicaChaos`` test. The same pass model-checks the PROCESS
+  supervisor's worker lifecycle (``serving_proc.py``: respawn backoff
+  cap, restart-storm breaker, shed-on-zero-routable), pinning every
+  explored path to a process-level chaos test in ``tests/test_proc.py``.
+  It runs by default (it needs no paths); ``--no-protocol`` skips it
+  when linting non-fleet code.
 
 Examples::
 
@@ -96,7 +100,12 @@ def fleetcheck_command(args) -> int:
         return 2
 
     from accelerate_tpu.analysis import exit_code, render_sarif, render_text
-    from accelerate_tpu.analysis.fleet_rules import coverage_map, fleet_protocol_check
+    from accelerate_tpu.analysis.fleet_rules import (
+        coverage_map,
+        fleet_protocol_check,
+        proc_coverage_map,
+        proc_protocol_check,
+    )
     from accelerate_tpu.analysis.hostsim import host_check_paths
     from accelerate_tpu.analysis.project_config import load_project_config
 
@@ -128,11 +137,19 @@ def fleetcheck_command(args) -> int:
             proto_findings = [f for f in proto_findings if f.rule in select]
         if ignore:
             proto_findings = [f for f in proto_findings if f.rule not in ignore]
-        findings = findings + proto_findings
+        proc_findings, proc_report = proc_protocol_check()
+        if select is not None:
+            proc_findings = [f for f in proc_findings if f.rule in select]
+        if ignore:
+            proc_findings = [f for f in proc_findings if f.rule not in ignore]
+        findings = findings + proto_findings + proc_findings
         protocol = {
             "explored_states": report.explored_states,
             "truncated": report.truncated,
             "coverage": coverage_map(report),
+            "proc_explored_states": proc_report.explored_states,
+            "proc_truncated": proc_report.truncated,
+            "proc_coverage": proc_coverage_map(proc_report),
         }
     findings = cfg.apply_suppressions(findings)
 
@@ -154,6 +171,12 @@ def fleetcheck_command(args) -> int:
             print(
                 f"protocol: {protocol['explored_states']} states explored, "
                 f"{len(protocol['coverage'])} failure paths, {pinned} pinned to chaos tests"
+            )
+            proc_pinned = sum(1 for t in protocol["proc_coverage"].values() if t)
+            print(
+                f"supervisor: {protocol['proc_explored_states']} states explored, "
+                f"{len(protocol['proc_coverage'])} lifecycle paths, "
+                f"{proc_pinned} pinned to process chaos tests"
             )
         print(render_text(findings))
     return exit_code(findings, strict=args.strict)

@@ -44,7 +44,11 @@ def trace_parser(subparsers=None):
     sub = parser.add_subparsers(dest="trace_command", required=True)
 
     p_sum = sub.add_parser("summarize", help="Critical-path decomposition of a traced run")
-    p_sum.add_argument("path", help="telemetry JSONL file with trace.* records")
+    p_sum.add_argument(
+        "path",
+        help="telemetry JSONL file with trace.* records, or a supervisor run "
+        "dir (its events_*.jsonl per-process logs merge deterministically)",
+    )
     p_sum.add_argument("--format", choices=("text", "json"), default="text", help="Report format")
     p_sum.add_argument(
         "--strict", action="store_true",
@@ -53,7 +57,7 @@ def trace_parser(subparsers=None):
     p_sum.set_defaults(trace_func=summarize_command)
 
     p_exp = sub.add_parser("export", help="Export traces as Chrome trace-event JSON (Perfetto)")
-    p_exp.add_argument("path", help="telemetry JSONL file with trace.* records")
+    p_exp.add_argument("path", help="telemetry JSONL file with trace.* records, or a supervisor run dir")
     p_exp.add_argument("-o", "--output", default=None, help="Output file (default: stdout)")
     p_exp.set_defaults(trace_func=export_command)
 
@@ -73,15 +77,34 @@ def trace_parser(subparsers=None):
     return parser
 
 
+def _load_events(path: str):
+    """One telemetry JSONL, or a supervisor run dir whose per-process
+    ``events_*.jsonl`` logs merge deterministically (``seq`` counters are
+    per-process; ``merge_events`` disambiguates by the worker id each
+    filename carries). Returns None when nothing is readable."""
+    from accelerate_tpu.telemetry.eventlog import merge_events, read_events
+
+    if os.path.isfile(path):
+        return read_events(path)
+    if os.path.isdir(path):
+        import glob
+
+        files = sorted(glob.glob(os.path.join(path, "events_*.jsonl")))
+        if not files:
+            return None
+        sources = [os.path.basename(f)[len("events_"):-len(".jsonl")] for f in files]
+        return merge_events(*[read_events(f) for f in files], source_ids=sources)
+    return None
+
+
 def summarize_command(args) -> int:
-    if not os.path.exists(args.path):
-        print(f"no such file: {args.path}")
-        return 2
     from accelerate_tpu.telemetry.critpath import decompose, render_critpath
-    from accelerate_tpu.telemetry.eventlog import read_events
     from accelerate_tpu.telemetry.trace import traces_from_events
 
-    events = read_events(args.path)
+    events = _load_events(args.path)
+    if events is None:
+        print(f"no telemetry at: {args.path}")
+        return 2
     traces = traces_from_events(events)
     drift = [
         {
@@ -104,13 +127,13 @@ def summarize_command(args) -> int:
 
 
 def export_command(args) -> int:
-    if not os.path.exists(args.path):
-        print(f"no such file: {args.path}")
-        return 2
-    from accelerate_tpu.telemetry.eventlog import read_events
     from accelerate_tpu.telemetry.trace import chrome_trace, traces_from_events
 
-    traces = traces_from_events(read_events(args.path))
+    events = _load_events(args.path)
+    if events is None:
+        print(f"no telemetry at: {args.path}")
+        return 2
+    traces = traces_from_events(events)
     doc = chrome_trace(traces)
     text = json.dumps(doc, default=repr)
     if args.output:

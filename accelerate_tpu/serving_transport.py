@@ -1,0 +1,282 @@
+"""Framed socket transport for the multi-process serving fleet.
+
+The process supervisor (:mod:`accelerate_tpu.serving_proc`) talks to its
+engine workers over one localhost TCP connection per worker. Every
+message is ONE length-prefixed frame: a fixed 16-byte header, a compact
+JSON control part, and an optional raw binary part — the binary part is
+exactly the PR-15 :class:`~accelerate_tpu.serving_fleet.HandoffCodec`
+npz blob (prefill handoffs) or the failover-snapshot bundle encoded by
+:func:`encode_snapshots` (same raw-leaf-bytes + shape technique; the
+receiving engine's row template stays the single source of truth for
+dtypes and tree structure, and the v2 ``tmeta`` trace id rides each
+snapshot across the process boundary).
+
+Failure is structured, never a hang: a short read at EOF (worker died
+mid-frame) raises :class:`PeerClosedError` with the byte position, a bad
+magic / version / crc32 or an oversized declared length raises
+:class:`FrameError` BEFORE any allocation for the body, and socket
+timeouts propagate as ``socket.timeout`` for the supervisor's
+degraded/quarantined escalation. ``recv_exact`` loops over partial
+reads, so TCP segmentation (short writes on the peer) is invisible to
+the protocol layer.
+
+Concurrency contract (the TPU9xx gate lints this module): all functions
+here are plain blocking socket calls — callers must never hold a lock
+across them. The worker is single-threaded; the supervisor confines all
+transport IO to its pump loop.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import zlib
+
+import numpy as np
+
+#: frame header: magic, version, reserved flags, json bytes, blob bytes,
+#: crc32(json + blob)
+_HEADER = struct.Struct(">2sBBIII")
+MAGIC = b"AT"
+VERSION = 1
+
+#: refuse frames larger than this before reading the body (a corrupt
+#: length field must not allocate gigabytes or desync into a hang)
+MAX_FRAME_BYTES = 256 << 20
+
+
+class TransportError(RuntimeError):
+    """Base class for structured transport failures."""
+
+
+class FrameError(TransportError):
+    """The byte stream is not a valid frame (bad magic/version, crc32
+    mismatch, oversized declared length, or undecodable JSON). The
+    connection is unrecoverable — close it and treat the peer as dead."""
+
+
+class PeerClosedError(TransportError):
+    """EOF before a complete frame — the peer process died (or closed)
+    mid-message. Carries how far the read got."""
+
+    def __init__(self, msg: str, got: int = 0, want: int = 0):
+        super().__init__(msg)
+        self.got = int(got)
+        self.want = int(want)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes, looping over partial reads. EOF
+    mid-read raises :class:`PeerClosedError` (worker death mid-frame);
+    a socket timeout propagates unchanged."""
+    if n == 0:
+        return b""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise PeerClosedError(
+                f"peer closed after {got}/{n} bytes of a frame", got=got, want=n
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_msg(sock: socket.socket, obj: dict, blob: bytes = b"", *,
+             max_frame: int = MAX_FRAME_BYTES) -> int:
+    """Send one frame (``obj`` as compact JSON + optional binary
+    ``blob``). Returns the total bytes written. ``sendall`` under the
+    hood, so short writes are already looped by the socket layer."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) + len(blob) > max_frame:
+        raise FrameError(
+            f"frame of {len(payload) + len(blob)} bytes exceeds the "
+            f"{max_frame}-byte transport cap"
+        )
+    crc = zlib.crc32(blob, zlib.crc32(payload))
+    header = _HEADER.pack(MAGIC, VERSION, 0, len(payload), len(blob), crc)
+    sock.sendall(header + payload + blob)
+    return len(header) + len(payload) + len(blob)
+
+
+def recv_msg(sock: socket.socket, *, max_frame: int = MAX_FRAME_BYTES):
+    """Receive one frame; ``(obj, blob)``. Raises :class:`FrameError`
+    on a corrupt/oversized frame, :class:`PeerClosedError` on EOF
+    mid-frame, and lets ``socket.timeout`` propagate."""
+    header = recv_exact(sock, _HEADER.size)
+    magic, version, _flags, jlen, blen, crc = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameError(f"bad frame magic {magic!r} (expected {MAGIC!r})")
+    if version != VERSION:
+        raise FrameError(f"unsupported transport version {version} (speak {VERSION})")
+    if jlen + blen > max_frame:
+        raise FrameError(
+            f"declared frame of {jlen + blen} bytes exceeds the "
+            f"{max_frame}-byte transport cap"
+        )
+    payload = recv_exact(sock, jlen)
+    blob = recv_exact(sock, blen)
+    if zlib.crc32(blob, zlib.crc32(payload)) != crc:
+        raise FrameError("frame crc32 mismatch — payload corrupt in transit")
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"frame JSON undecodable: {e}") from None
+    return obj, blob
+
+
+def request(sock: socket.socket, obj: dict, blob: bytes = b"", *,
+            timeout=None, max_frame: int = MAX_FRAME_BYTES):
+    """One strict request/response round trip (the supervisor side).
+    ``timeout`` covers both legs; a reply carrying ``{"err": ...}``
+    raises :class:`WorkerError` with the worker's structured detail."""
+    sock.settimeout(timeout)
+    send_msg(sock, obj, blob, max_frame=max_frame)
+    reply, rblob = recv_msg(sock, max_frame=max_frame)
+    if isinstance(reply, dict) and reply.get("err") is not None:
+        raise WorkerError(reply["err"])
+    return reply, rblob
+
+
+class WorkerError(TransportError):
+    """The worker replied with a structured error (``{"err": {...}}``):
+    the request failed application-side (bad uid, import rejected, a
+    poison trip) but the worker and the connection are still alive."""
+
+    def __init__(self, err):
+        detail = err if isinstance(err, dict) else {"detail": str(err)}
+        super().__init__(detail.get("detail") or str(detail))
+        self.kind = detail.get("kind", "error")
+        self.detail = detail
+
+
+# --------------------------------------------------------------------- #
+# failover-snapshot bundle codec
+# --------------------------------------------------------------------- #
+# ``ServingEngine.export_inflight`` snapshots cross the process boundary
+# in one npz bundle: per-snapshot namespaced arrays, KV leaves as raw
+# uint8 + shape exactly like HandoffCodec (dtype-agnostic; the importing
+# engine's ``_row_template`` restores dtype and tree structure). The
+# JSON half of the frame carries ``snapshot_meta`` so the jax-free
+# supervisor can route, price, and account each snapshot without ever
+# decoding the blob.
+
+
+def snapshot_meta(snaps: list) -> list:
+    """Supervisor-visible metadata for each snapshot: identity, progress,
+    and the KV payload size actually serialized (``kv_bytes`` is the
+    byte-for-byte accounting the priced failover pins against the
+    ``rows * bytes_per_token + fixed`` prediction)."""
+    import jax
+
+    meta = []
+    for s in snaps:
+        kv_bytes = 0
+        if s.get("cache") is not None:
+            kv_bytes = sum(
+                np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(s["cache"])
+            )
+        meta.append(
+            {
+                "uid": int(s["uid"]),
+                "prompt_len": int(np.asarray(s["prompt"]).size),
+                "generated": len(s.get("out_tokens") or []),
+                "max_new_tokens": int(s["max_new_tokens"]),
+                "priority": int(s.get("priority", 0)),
+                "rows": int(s.get("rows") or 0),
+                "has_kv": s.get("cache") is not None,
+                "kv_bytes": int(kv_bytes),
+                "trace": s.get("trace"),
+            }
+        )
+    return meta
+
+
+def encode_snapshots(snaps: list) -> tuple:
+    """``(meta, blob)`` for a list of ``export_inflight`` snapshots.
+    Worker-side only (touches jax for the KV leaves)."""
+    import jax
+
+    arrays = {}
+    for i, s in enumerate(snaps):
+        p = f"s{i}_"
+        arrays[p + "prompt"] = np.asarray(s["prompt"], np.int32)
+        arrays[p + "key_data"] = np.asarray(s["key_data"])
+        arrays[p + "out"] = np.asarray(s.get("out_tokens") or [], np.int64)
+        arrays[p + "lps"] = np.asarray(s.get("out_lps") or [], np.float64)
+        stops = s.get("stop_sequences") or ()
+        arrays[p + "stop_flat"] = np.asarray(
+            [t for seq in stops for t in seq], np.int64
+        )
+        arrays[p + "stop_lens"] = np.asarray([len(seq) for seq in stops], np.int64)
+        leaves = jax.tree_util.tree_leaves(s["cache"]) if s.get("cache") is not None else []
+        arrays[p + "imeta"] = np.asarray(
+            [
+                int(s["uid"]),
+                int(s["max_new_tokens"]),
+                int(s.get("priority", 0)),
+                int(s.get("rows") or 0),
+                len(leaves),
+                -1 if s.get("trace") is None else int(s["trace"]),
+            ],
+            np.int64,
+        )
+        for j, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            arrays[p + f"leaf_{j}"] = np.frombuffer(arr.tobytes(), np.uint8)
+            arrays[p + f"shape_{j}"] = np.asarray(arr.shape, np.int64)
+    arrays["count"] = np.asarray([len(snaps)], np.int64)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return snapshot_meta(snaps), buf.getvalue()
+
+
+def decode_snapshots(blob: bytes, engine) -> list:
+    """Rebuild the snapshot dicts against ``engine``'s row template;
+    each result feeds ``engine.import_inflight`` unchanged."""
+    import jax
+
+    template = jax.tree_util.tree_leaves(engine._row_template)
+    treedef = jax.tree_util.tree_structure(engine._row_template)
+    snaps = []
+    with np.load(io.BytesIO(blob)) as z:
+        count = int(z["count"][0])
+        for i in range(count):
+            p = f"s{i}_"
+            imeta = z[p + "imeta"]
+            uid, max_new, priority, rows, n_leaves, trace = (int(v) for v in imeta)
+            stops, flat = [], [int(t) for t in z[p + "stop_flat"]]
+            for ln in z[p + "stop_lens"]:
+                stops.append(tuple(flat[: int(ln)]))
+                flat = flat[int(ln):]
+            snap = {
+                "uid": uid,
+                "prompt": np.asarray(z[p + "prompt"], np.int32),
+                "max_new_tokens": max_new,
+                "out_tokens": [int(t) for t in z[p + "out"]],
+                "out_lps": [float(v) for v in z[p + "lps"]],
+                "stop_sequences": tuple(stops),
+                "priority": priority,
+                "trace": None if trace < 0 else trace,
+                "key_data": np.asarray(z[p + "key_data"]),
+            }
+            if n_leaves:
+                if n_leaves != len(template):
+                    raise ValueError(
+                        f"snapshot has {n_leaves} KV leaves; this engine's row "
+                        f"template has {len(template)} — engines disagree on the "
+                        "cache pytree"
+                    )
+                leaves = []
+                for j, t in enumerate(template):
+                    raw = z[p + f"leaf_{j}"].tobytes()
+                    shape = tuple(int(d) for d in z[p + f"shape_{j}"])
+                    leaves.append(np.frombuffer(raw, t.dtype).reshape(shape))
+                snap["cache"] = jax.tree_util.tree_unflatten(treedef, leaves)
+                snap["rows"] = rows
+            snaps.append(snap)
+    return snaps

@@ -212,15 +212,26 @@ def _json_default(obj):
     return repr(obj)
 
 
-def merge_events(*event_lists: list[dict]) -> list[dict]:
-    """Merge several record streams (the main JSONL + one or more
-    flight-recorder dumps) into one deterministic order: by the
-    per-process ``seq`` wherever two records' wall clocks tie (coarse
-    clocks make ``ts`` alone ambiguous); records written before the field
-    existed sort by ``ts`` only. Stable, so true ties keep input order."""
-    merged = [rec for lst in event_lists for rec in lst]
-    merged.sort(key=lambda r: (r.get("ts", 0.0), r.get("seq", -1)))
-    return merged
+def merge_events(*event_lists: list[dict], source_ids=None) -> list[dict]:
+    """Merge several record streams (the main JSONL + flight-recorder
+    dumps, or one eventlog per worker PROCESS of a supervisor run) into
+    one deterministic order.
+
+    ``seq`` is a per-process counter: two workers' records can carry the
+    same ``(ts, seq)`` with coarse clocks, so ties break by worker id
+    first — the per-list ``source_ids`` entry when given (e.g. the
+    worker name the filename carries), else the record's own ``rank``
+    (workers log with ``rank=<slot>``), else the list position. Within
+    one source, ``seq`` is total and authoritative. Stable, so true ties
+    keep input order."""
+    tagged = []
+    for li, lst in enumerate(event_lists):
+        sid = str(source_ids[li]) if source_ids is not None else None
+        for rec in lst:
+            src = sid if sid is not None else str(rec.get("rank", li))
+            tagged.append(((rec.get("ts", 0.0), src, rec.get("seq", -1)), rec))
+    tagged.sort(key=lambda kr: kr[0])
+    return [rec for _, rec in tagged]
 
 
 def read_events(path: str) -> list[dict]:

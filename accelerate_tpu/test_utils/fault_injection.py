@@ -21,7 +21,9 @@ manifest's size/crc32 detection.
 
 from __future__ import annotations
 
+import json
 import os
+import signal
 import time
 from pathlib import Path
 
@@ -123,7 +125,22 @@ class ReplicaChaos:
     replica), otherwise it fires once. ``fired``/``count`` record what
     happened. Like :class:`CrashPoint`, both raise actions use exception
     types that are deliberately NOT ``OSError`` — the failover handoff
-    leg's ``utils.retry`` wrapper must never absorb a simulated death."""
+    leg's ``utils.retry`` wrapper must never absorb a simulated death.
+
+    **Process-level actions** (the multi-process fleet's REAL faults,
+    installed into one engine-worker subprocess via
+    :meth:`install_from_env` at boot):
+
+    * ``"sigkill"``  — ``os.kill(os.getpid(), SIGKILL)``: the kernel
+      removes the process mid-tick; the supervisor observes a ``-9``
+      exit and fails the worker's in-flight snapshots over
+    * ``"sigstop"``  — ``os.kill(os.getpid(), SIGSTOP)``: the process
+      freezes (a real hang, not a sleep); the supervisor's heartbeat
+      timeouts escalate degraded → quarantined and SIGKILL it
+    """
+
+    #: actions that end (or freeze) the whole process rather than raise
+    PROCESS_ACTIONS = ("sigkill", "sigstop")
 
     def __init__(
         self,
@@ -139,8 +156,11 @@ class ReplicaChaos:
             raise ValueError(
                 f"unknown serving crash point {label!r}; choose from {SERVING_CRASH_POINTS}"
             )
-        if action not in ("crash", "poison", "hang", "latency"):
-            raise ValueError(f"action must be crash|poison|hang|latency, got {action!r}")
+        if action not in ("crash", "poison", "hang", "latency") + self.PROCESS_ACTIONS:
+            raise ValueError(
+                "action must be crash|poison|hang|latency|sigkill|sigstop, "
+                f"got {action!r}"
+            )
         self.label = label
         self.replica = replica
         self.action = action
@@ -168,6 +188,12 @@ class ReplicaChaos:
         if self.action == "latency":
             time.sleep(self.latency_s)
             return
+        if self.action == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # unreachable: SIGKILL is not deliverable-to-self-later
+        if self.action == "sigstop":
+            os.kill(os.getpid(), signal.SIGSTOP)
+            return  # resumes here only if something SIGCONTs the process
         where = f"{self.label!r}" + (f" on replica {self.replica!r}" if self.replica else "")
         if self.action == "poison":
             from ..serving_fleet import NonFinitePoison
@@ -182,6 +208,50 @@ class ReplicaChaos:
     def __exit__(self, *exc):
         set_crash_hook(None)
         return False
+
+    # ------------------------------------------------------------------ #
+    # process-level installation (engine-worker subprocesses)
+    # ------------------------------------------------------------------ #
+
+    def to_env_spec(self, worker: str) -> str:
+        """Serialize this chaos for ONE named worker process as the JSON
+        the ``ACCELERATE_TPU_PROC_CHAOS`` env var carries."""
+        return json.dumps(
+            {
+                "worker": worker,
+                "label": self.label,
+                "action": self.action,
+                "hits": self.hits,
+                "repeat": self.repeat,
+                "hang_s": self.hang_s,
+                "latency_s": self.latency_s,
+            }
+        )
+
+    @classmethod
+    def install_from_env(cls, worker: str, env_var: str = "ACCELERATE_TPU_PROC_CHAOS"):
+        """Worker-boot hook: if the env var names THIS worker, build the
+        chaos and install its hook permanently (no context manager — the
+        process lives inside the chaos until it dies). Returns the
+        installed instance or None. The supervisor only sets the var on
+        the targeted incarnation, so a respawn boots clean."""
+        spec = os.environ.get(env_var)
+        if not spec:
+            return None
+        cfg = json.loads(spec)
+        if cfg.get("worker") not in (None, worker):
+            return None
+        chaos = cls(
+            cfg["label"],
+            replica=worker,
+            action=cfg.get("action", "sigkill"),
+            hits=int(cfg.get("hits", 1)),
+            repeat=bool(cfg.get("repeat", False)),
+            latency_s=float(cfg.get("latency_s", 0.005)),
+            hang_s=float(cfg.get("hang_s", 0.05)),
+        )
+        set_crash_hook(chaos._hook)
+        return chaos
 
 
 def corrupt_file(path, mode: str = "truncate", nbytes: int = 16) -> str:

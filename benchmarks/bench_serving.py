@@ -870,6 +870,238 @@ def run_trace(args) -> int:
     return 0 if report["ok"] else 1
 
 
+# ===================================================================== #
+# proc-chaos mode (--proc-chaos): SIGKILL a real worker PROCESS
+# ===================================================================== #
+
+
+def proc_workload(args, vocab, rng, budgets=(8, 12, 16)):
+    """Open-loop Poisson arrivals for the process fleet — short prompts
+    with mixed decode budgets, generated once so both arms replay the
+    identical offered load."""
+    events, t = [], 0.0
+    for _ in range(args.proc_clients):
+        t += float(rng.exponential(1.0 / args.proc_rate))
+        plen = int(rng.integers(3, 13))
+        prompt = [int(x) for x in rng.integers(1, vocab - 1, size=plen)]
+        events.append((t, prompt, int(rng.choice(budgets))))
+    return events
+
+
+def proc_drive(sup, events, *, settle_s=240.0):
+    """Replay the arrival schedule against a running supervisor; returns
+    ``(elapsed_s, outs, lps, lost)`` keyed by submission index (fuids are
+    minted in submission order in both arms, so index-aligned outputs
+    compare token-exactly across arms)."""
+    from accelerate_tpu.serving_proc import FleetRequestError
+
+    t0 = time.monotonic()
+    pending = list(events)
+    fids, outs, lps, lost = [], {}, {}, {}
+    deadline = t0 + settle_s
+    while (pending or len(outs) + len(lost) < len(fids) or not fids) and time.monotonic() < deadline:
+        now = time.monotonic() - t0
+        while pending and pending[0][0] <= now:
+            _at, prompt, n_new = pending.pop(0)
+            fids.append(sup.submit(prompt, max_new_tokens=n_new))
+        sup.pump()
+        for i, f in enumerate(fids):
+            if i in outs or i in lost:
+                continue
+            try:
+                r = sup.poll(f)
+            except FleetRequestError as e:
+                lost[i] = str(e)
+                continue
+            if r is not None:
+                outs[i] = np.asarray(r)
+                lps[i] = np.asarray(sup.logprobs(f))
+        if pending and not sup._work_remaining():
+            time.sleep(min(0.002, max(0.0, pending[0][0] - (time.monotonic() - t0))))
+    return time.monotonic() - t0, outs, lps, lost
+
+
+def run_proc_chaos(args) -> int:
+    """The process-fleet chaos benchmark (``--proc-chaos``): 3 REAL
+    engine-worker subprocesses behind the :class:`ProcessSupervisor`,
+    warm-started from one shared executable store. A no-fault control arm
+    and a chaos arm replay identical arrivals; in the chaos arm worker
+    ``w1`` SIGKILLs itself mid-decode (``ReplicaChaos`` installed via the
+    spawn environment, so only that incarnation is poisoned). Criteria:
+    zero requests lost, failover outputs token- AND logprob-exact vs
+    control, failover bytes predicted == moved (``shadow_kv`` snapshots),
+    zero post-warmup XLA compiles on the survivors, the respawned worker
+    boots with zero compiles from the store, and the dead worker's
+    flight-recorder dump holds the kill. Prints the JSON report; exit
+    code 1 unless every criterion holds."""
+    import glob
+    import shutil
+    import tempfile
+
+    args.proc_clients = args.proc_clients or (10 if args.smoke else 16)
+    # full mode arrives fast enough that the targeted worker holds
+    # overlapping DECODING requests when the kill lands — the shadow
+    # snapshot then carries KV and the failover takes the priced path
+    args.proc_rate = args.proc_rate or (4.0 if args.smoke else 8.0)
+    # the kill must land deep enough in decode that the last-polled
+    # shadow snapshot carries decode-phase KV (queued/prefill snapshots
+    # are recompute-only), but well inside the decode ticks the load
+    # actually produces on the targeted worker; tick_block 2 with long
+    # budgets stretches each decode across many 10ms status polls so a
+    # decode-phase snapshot is always on file when the kill lands
+    crash_hit = 12 if args.smoke else 20
+    budgets = (16, 24, 32) if args.smoke else (24, 32, 48)
+    model_kwargs = {
+        "seq_len": 96, "max_position_embeddings": 96,
+        "vocab_size": 512, "hidden_size": 128, "intermediate_size": 256,
+    }
+    engine_kwargs = {
+        "num_slots": 2, "prompt_buckets": [8, 16], "max_len": 96, "tick_block": 2,
+    }
+    vocab = model_kwargs["vocab_size"]
+    events = proc_workload(args, vocab, np.random.default_rng(args.seed), budgets)
+    report = {
+        "bench": "bench_serving --proc-chaos",
+        "clients": args.proc_clients,
+        "rate_req_per_s": args.proc_rate,
+        "workers": 3,
+        "engine": engine_kwargs,
+        "crash": {"worker": "w1", "point": "mid_decode", "hit": crash_hit,
+                  "action": "sigkill"},
+        "host_cpu_count": os.cpu_count() or 1,
+    }
+
+    def build(run_dir, store_dir, chaos):
+        from accelerate_tpu.serving_proc import ProcConfig, ProcessSupervisor
+
+        sup = ProcessSupervisor(ProcConfig(
+            workers=3, run_dir=run_dir, store_dir=store_dir,
+            model_kwargs=model_kwargs, engine=engine_kwargs,
+            warm_prompt_lens=(4, 12), poll_interval_s=0.01,
+            heartbeat_timeout_s=20.0, shadow_kv=True, chaos=chaos,
+            seed=args.seed,
+        ))
+        t0 = time.monotonic()
+        sup.start(wait=True)
+        boot_s = time.monotonic() - t0
+        hellos = {
+            s["name"]: dict(s["hello"] or {}) for s in sup._slots
+        }
+        return sup, boot_s, hellos
+
+    def arm_summary(sup, elapsed, outs, lps, lost, boot_s, hellos):
+        health = sup.health()
+        return {
+            "boot_s": round(boot_s, 2),
+            "elapsed_s": round(elapsed, 2),
+            "completed": len(outs),
+            "lost": len(lost),
+            "warm_compiles": {n: h.get("compiles") for n, h in hellos.items()},
+            "warm_deserialized": {n: h.get("deserialized") for n, h in hellos.items()},
+            "health": {n: v["health"] for n, v in health.items()},
+            "summary": sup.summary(),
+        }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = os.path.join(tmp, "store")
+
+        # -- control arm: identical schedule, no fault ------------------- #
+        control, boot_c, hellos_c = build(os.path.join(tmp, "ctrl"), store, None)
+        elapsed_c, outs_c, lps_c, lost_c = proc_drive(control, events)
+        report["control"] = arm_summary(control, elapsed_c, outs_c, lps_c, lost_c,
+                                        boot_c, hellos_c)
+        control.shutdown()
+
+        # -- chaos arm: SIGKILL w1 at its Nth decode tick ---------------- #
+        chaos_dir = os.path.join(tmp, "chaos")
+        chaos_cfg = {"worker": "w1", "label": "mid_decode", "action": "sigkill",
+                     "hits": crash_hit}
+        sup, boot_x, hellos_x = build(chaos_dir, store, chaos_cfg)
+        elapsed_x, outs_x, lps_x, lost_x = proc_drive(sup, events)
+
+        # survivors must have compiled nothing past their warmup; wait for
+        # the respawned incarnation to hello so its spin-up is auditable
+        deadline = time.monotonic() + 120.0
+        respawned = None
+        while time.monotonic() < deadline:
+            sup.pump()
+            respawned = next(
+                (s for s in sup._slots
+                 if s["respawns"] > 0 and s["health"] == "healthy" and s["hello"]),
+                None,
+            )
+            if respawned is not None:
+                break
+            time.sleep(0.05)
+        health_x = sup.health()
+        survivor_compiles = {}
+        for name, h in health_x.items():
+            if name in hellos_x and h["health"] in ("healthy", "degraded"):
+                warm = int(hellos_x[name].get("compiles") or 0)
+                survivor_compiles[name] = int(h.get("compiles") or 0) - warm
+        acct = dict(sup.failover_accounting())
+        killed_fired = any(
+            s["respawns"] > 0 for s in sup._slots
+        ) or any(h["health"] == "dead" for h in health_x.values())
+        respawn_hello = dict(respawned["hello"]) if respawned is not None else {}
+
+        dump_path = next(iter(glob.glob(os.path.join(chaos_dir, "flight_w1.json"))), None)
+        dump_holds_kill = False
+        if dump_path:
+            with open(dump_path) as f:
+                dump = json.load(f)
+            dump_holds_kill = any(
+                e.get("name") == "proc_exit" and e.get("killed")
+                for e in dump.get("events", [])
+            )
+            if args.proc_artifact_dir:
+                os.makedirs(args.proc_artifact_dir, exist_ok=True)
+                shutil.copy(dump_path,
+                            os.path.join(args.proc_artifact_dir, "bench-proc-flight.json"))
+
+        report["chaos"] = arm_summary(sup, elapsed_x, outs_x, lps_x, lost_x,
+                                      boot_x, hellos_x)
+        report["chaos"].update({
+            "crash_fired": killed_fired,
+            "survivor_post_warmup_compiles": survivor_compiles,
+            "failover_accounting": acct,
+            "respawned_worker": None if respawned is None else respawned["name"],
+            "respawn_hello_compiles": respawn_hello.get("compiles"),
+            "respawn_hello_deserialized": respawn_hello.get("deserialized"),
+            "flight_dump": dump_path and os.path.basename(dump_path),
+            "flight_dump_holds_kill": dump_holds_kill,
+        })
+        sup.shutdown()
+
+    exact_tokens = len(outs_x) == len(outs_c) == len(events) and all(
+        np.array_equal(outs_x[i], outs_c[i]) for i in outs_c
+    )
+    exact_lps = len(lps_x) == len(lps_c) and all(
+        np.array_equal(lps_x[i], lps_c[i]) for i in lps_c
+    )
+    criteria = {
+        "chaos_completion_100": len(outs_x) == len(events) and not lost_x,
+        "zero_lost": not lost_x and not lost_c and acct["failovers_lost"] == 0,
+        "crash_fired": killed_fired,
+        "failover_exercised": acct["failovers"] >= 1,
+        "failover_kv_exercised": acct["failovers_kv"] >= 1,
+        "accounting_pinned": acct["bytes_predicted"] == acct["bytes_moved"]
+        and acct["bytes_moved"] > 0,
+        "token_exact_vs_control": exact_tokens,
+        "logprob_exact_vs_control": exact_lps,
+        "survivors_zero_new_compiles": bool(survivor_compiles)
+        and all(v == 0 for v in survivor_compiles.values()),
+        "respawn_zero_compiles": respawned is not None
+        and respawn_hello.get("compiles") == 0
+        and (respawn_hello.get("deserialized") or 0) > 0,
+        "flight_dump_holds_kill": dump_holds_kill,
+    }
+    report["criteria"] = criteria
+    report["ok"] = all(criteria.values())
+    print(json.dumps(report, indent=2))
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true", help="CPU CI mode: tiny model, bounded load")
@@ -883,6 +1115,15 @@ def main(argv=None):
                     help="trace mode: disaggregated fleet with request tracing on — "
                          "segment-sum reconciliation, priced handoff/failover spans, "
                          "crash flight dump")
+    ap.add_argument("--proc-chaos", dest="proc_chaos", action="store_true",
+                    help="process chaos mode: 3 real engine-worker subprocesses, "
+                         "SIGKILL one mid-decode, hold the fleet to zero-lost, "
+                         "token/logprob-exact failover and zero-compile respawn")
+    ap.add_argument("--proc-clients", dest="proc_clients", type=int, default=None)
+    ap.add_argument("--proc-rate", dest="proc_rate", type=float, default=None)
+    ap.add_argument("--proc-artifact-dir", dest="proc_artifact_dir", default=None,
+                    help="copy the dead worker's flight dump here as "
+                         "bench-proc-flight.json (CI artifact)")
     ap.add_argument("--preamble-len", dest="preamble_len", type=int, default=None)
     ap.add_argument("--n-preambles", dest="n_preambles", type=int, default=None)
     ap.add_argument("--fleet-clients", dest="fleet_clients", type=int, default=None)
@@ -905,6 +1146,8 @@ def main(argv=None):
     ap.add_argument("--schedulers", default="fifo,continuous")
     args = ap.parse_args(argv)
 
+    if args.proc_chaos:
+        raise SystemExit(run_proc_chaos(args))
     if args.trace:
         raise SystemExit(run_trace(args))
     if args.chaos:

@@ -34,6 +34,8 @@ MODULES = [
     "accelerate_tpu.diffusion",
     "accelerate_tpu.serving",
     "accelerate_tpu.serving_fleet",
+    "accelerate_tpu.serving_proc",
+    "accelerate_tpu.serving_transport",
     "accelerate_tpu.scheduling",
     "accelerate_tpu.speculative",
     "accelerate_tpu.big_modeling",

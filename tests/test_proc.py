@@ -1,0 +1,954 @@
+"""Multi-process serving fleet (serving_proc.py + serving_transport.py):
+framed-socket transport failure semantics, the supervisor's worker
+lifecycle driven by REAL process death (SIGKILL failover, hang
+degrade/quarantine, poison recompute-only, drain, respawn backoff cap,
+restart-storm breaker), per-process telemetry merging, the HTTP/SSE
+front door, and the model-checker drift gates that pin every explored
+lifecycle path to a named test in THIS file.
+
+The subprocess tests are ``slow`` (each boots real engine workers); the
+transport, telemetry, front-door-unit, and drift-gate tests are tier-1.
+"""
+
+import dataclasses
+import http.client
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.serving_transport import (
+    MAGIC,
+    VERSION,
+    FrameError,
+    PeerClosedError,
+    WorkerError,
+    recv_exact,
+    recv_msg,
+    request,
+    send_msg,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_HEADER = struct.Struct(">2sBBIII")
+
+
+# --------------------------------------------------------------------- #
+# transport: framing
+# --------------------------------------------------------------------- #
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_roundtrip_json_and_blob():
+    a, b = _pair()
+    try:
+        blob = bytes(range(256)) * 17
+        sent = send_msg(a, {"op": "status", "ack": [1, 2]}, blob)
+        obj, rblob = recv_msg(b)
+        assert obj == {"op": "status", "ack": [1, 2]}
+        assert rblob == blob
+        assert sent == _HEADER.size + len(json.dumps(obj, separators=(",", ":"))) + len(blob)
+        # empty-blob frame on the same connection stays in sync
+        send_msg(b, {"ok": True})
+        obj2, rblob2 = recv_msg(a)
+        assert obj2 == {"ok": True} and rblob2 == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_loops_over_partial_reads():
+    """TCP segmentation (short writes on the peer) must be invisible:
+    the peer dribbles one frame a few bytes at a time."""
+    a, b = _pair()
+    payload = json.dumps({"op": "x"}, separators=(",", ":")).encode()
+    blob = os.urandom(503)
+    crc = zlib.crc32(blob, zlib.crc32(payload))
+    wire = _HEADER.pack(MAGIC, VERSION, 0, len(payload), len(blob), crc) + payload + blob
+
+    def dribble():
+        for i in range(0, len(wire), 7):
+            a.sendall(wire[i : i + 7])
+            time.sleep(0.0005)
+        a.close()
+
+    t = threading.Thread(target=dribble)
+    t.start()
+    try:
+        obj, rblob = recv_msg(b)
+        assert obj == {"op": "x"} and rblob == blob
+    finally:
+        t.join()
+        b.close()
+
+
+def test_oversized_frame_refused_before_body():
+    """A corrupt length field must raise BEFORE any body allocation (and
+    without consuming the declared gigabytes)."""
+    a, b = _pair()
+    try:
+        header = _HEADER.pack(MAGIC, VERSION, 0, 1 << 30, 1 << 30, 0)
+        a.sendall(header)
+        with pytest.raises(FrameError, match="exceeds"):
+            recv_msg(b, max_frame=1 << 20)
+        # sender-side twin: an oversized payload refuses to serialize
+        with pytest.raises(FrameError, match="exceeds"):
+            send_msg(a, {"op": "big"}, b"x" * 32, max_frame=16)
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize(
+    "mutate, match",
+    [
+        (lambda h, p: (b"XX" + h[2:], p), "magic"),
+        (lambda h, p: (h[:2] + bytes([VERSION + 1]) + h[3:], p), "version"),
+        (lambda h, p: (h, p[:-1] + bytes([p[-1] ^ 0xFF])), "crc32"),
+    ],
+)
+def test_corrupt_frame_structured_error(mutate, match):
+    a, b = _pair()
+    try:
+        payload = json.dumps({"op": "x"}, separators=(",", ":")).encode()
+        header = _HEADER.pack(
+            MAGIC, VERSION, 0, len(payload), 0, zlib.crc32(b"", zlib.crc32(payload))
+        )
+        header, payload = mutate(header, payload)
+        a.sendall(header + payload)
+        with pytest.raises(FrameError, match=match):
+            recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_undecodable_json_is_frame_error():
+    a, b = _pair()
+    try:
+        payload = b"\xff\xfe not json"
+        header = _HEADER.pack(MAGIC, VERSION, 0, len(payload), 0, zlib.crc32(payload))
+        a.sendall(header + payload)
+        with pytest.raises(FrameError, match="undecodable"):
+            recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_death_mid_frame_raises_peer_closed():
+    """Worker death mid-frame is a structured error with the byte
+    position, never a hang: header promises 64 payload bytes, the peer
+    dies after 10."""
+    a, b = _pair()
+    try:
+        header = _HEADER.pack(MAGIC, VERSION, 0, 64, 0, 0)
+        a.sendall(header + b"x" * 10)
+        a.close()
+        with pytest.raises(PeerClosedError) as ei:
+            recv_msg(b)
+        assert ei.value.got == 10 and ei.value.want == 64
+    finally:
+        b.close()
+
+
+def test_recv_exact_zero_and_eof_semantics():
+    a, b = _pair()
+    try:
+        assert recv_exact(b, 0) == b""
+        a.close()
+        with pytest.raises(PeerClosedError):
+            recv_exact(b, 1)
+    finally:
+        b.close()
+
+
+def test_worker_error_reply_raises_structured():
+    a, b = _pair()
+
+    def server():
+        obj, _ = recv_msg(b)
+        send_msg(b, {"err": {"kind": "bad_uid", "detail": f"no uid {obj['uid']}"}})
+
+    t = threading.Thread(target=server)
+    t.start()
+    try:
+        with pytest.raises(WorkerError, match="no uid 7") as ei:
+            request(a, {"op": "result", "uid": 7}, timeout=5.0)
+        assert ei.value.kind == "bad_uid"
+    finally:
+        t.join()
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------------------------- #
+# telemetry: per-process seq disambiguation + supervisor run dirs
+# --------------------------------------------------------------------- #
+
+
+def test_merge_events_disambiguates_per_process_seq():
+    """Two worker PROCESSES restart their ``seq`` counters at 0; with a
+    coarse shared clock the merge must order by worker id, not interleave
+    the colliding (ts, seq) pairs arbitrarily."""
+    from accelerate_tpu.telemetry.eventlog import merge_events
+
+    w0 = [{"ts": 1.0, "seq": 0, "name": "a0"}, {"ts": 1.0, "seq": 1, "name": "a1"}]
+    w1 = [{"ts": 1.0, "seq": 0, "name": "b0"}, {"ts": 1.0, "seq": 1, "name": "b1"}]
+    merged = merge_events(w0, w1, source_ids=["w0", "w1"])
+    assert [r["name"] for r in merged] == ["a0", "a1", "b0", "b1"]
+    # without source ids, the record's own rank disambiguates
+    for r in w0:
+        r["rank"] = 0
+    for r in w1:
+        r["rank"] = 1
+    merged = merge_events(w1, w0)  # adversarial list order
+    assert [r["name"] for r in merged] == ["a0", "a1", "b0", "b1"]
+
+
+def test_trace_summarize_reads_supervisor_run_dir(tmp_path, capsys):
+    """``accelerate-tpu trace summarize <run_dir>`` merges the per-process
+    ``events_*.jsonl`` logs into one deterministic timeline."""
+    from accelerate_tpu.commands.trace import _load_events
+
+    for name, rank in (("supervisor", -1), ("w0", 0), ("w1", 1)):
+        recs = [
+            {"v": 1, "seq": s, "ts": 10.0, "rank": rank, "kind": "event", "name": f"{name}_{s}"}
+            for s in range(3)
+        ]
+        with open(tmp_path / f"events_{name}.jsonl", "w") as f:
+            f.write("\n".join(json.dumps(r) for r in recs) + "\n")
+    events = _load_events(str(tmp_path))
+    assert len(events) == 9
+    # per-source seq stays total within each worker despite the tied ts
+    for name in ("supervisor", "w0", "w1"):
+        sub = [e["name"] for e in events if e["name"].startswith(name)]
+        assert sub == [f"{name}_{s}" for s in range(3)]
+
+
+# --------------------------------------------------------------------- #
+# front door units (fake supervisor — no subprocesses)
+# --------------------------------------------------------------------- #
+
+
+class _FakeSupervisor:
+    """Duck-typed stand-in: enough surface for TelemetryHTTPD.for_supervisor."""
+
+    def __init__(self, health):
+        self._health = health
+        self._streams = {}
+        self._next = 0
+        self.submitted = []
+
+    def submit(self, prompt, max_new_tokens, stop_sequences, priority, wait):
+        rid = self._next
+        self._next += 1
+        self.submitted.append({"prompt": prompt, "priority": priority})
+        self._streams[rid] = {
+            "state": "done",
+            "tokens": [5, 6],
+            "lps": [-0.5, -0.25],
+            "final": list(prompt) + [5, 6],
+            "lost_reason": None,
+        }
+        return rid
+
+    def cancel(self, rid):
+        s = self._streams[rid]
+        s["state"] = "cancelled"
+        return s["tokens"]
+
+    def _stream(self, rid):
+        return self._streams[rid]
+
+    def health(self):
+        return self._health
+
+    def prometheus_text(self):
+        return "proc_requests 0\n"
+
+
+def _httpd(health):
+    from accelerate_tpu.telemetry.httpd import TelemetryHTTPD
+
+    sup = _FakeSupervisor(health)
+    httpd = TelemetryHTTPD.for_supervisor(sup, port=0)
+    httpd.start()
+    return sup, httpd
+
+
+def _get(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path, headers=headers or {})
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def test_healthz_503_on_zero_live_workers():
+    """The ISSUE-pinned fix: /healthz must flip 503 when no worker
+    process is live — dead/quarantined/spawning rows are not capacity."""
+    sup, httpd = _httpd(
+        {
+            "w0": {"health": "dead", "slot": 0},
+            "w1.2": {"health": "quarantined", "slot": 1},
+            "w2": {"health": "spawning", "slot": 2},
+        }
+    )
+    try:
+        status, body = _get(httpd.port, "/healthz")
+        assert status == 503
+        assert json.loads(body)["serving"] is False
+    finally:
+        httpd.stop()
+
+
+def test_healthz_200_while_any_worker_serves():
+    sup, httpd = _httpd(
+        {"w0": {"health": "dead", "slot": 0}, "w1": {"health": "degraded", "slot": 1}}
+    )
+    try:
+        status, body = _get(httpd.port, "/healthz")
+        assert status == 200
+        assert json.loads(body)["serving"] is True
+    finally:
+        httpd.stop()
+
+
+def test_front_door_submit_priority_headers_and_cancel():
+    sup, httpd = _httpd({"w0": {"health": "healthy", "slot": 0}})
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", httpd.port, timeout=10)
+        conn.request(
+            "POST",
+            "/v1/generate",
+            body=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 2}),
+            headers={"X-SLO-Class": "interactive"},
+        )
+        r = conn.getresponse()
+        out = json.loads(r.read())
+        conn.close()
+        assert r.status == 200 and out["state"] == "done"
+        assert out["final"] == [1, 2, 3, 5, 6]
+        from accelerate_tpu.telemetry.httpd import SLO_CLASSES
+
+        assert sup.submitted[0]["priority"] == SLO_CLASSES["interactive"]
+
+        # cancel replies the tokens so far
+        rid = sup.submit([9], 4, [], 0, True)
+        conn = http.client.HTTPConnection("127.0.0.1", httpd.port, timeout=10)
+        conn.request("DELETE", f"/v1/generate/{rid}")
+        r = conn.getresponse()
+        out = json.loads(r.read())
+        conn.close()
+        assert r.status == 200 and out["cancelled"] is True and out["tokens"] == [5, 6]
+        # unknown id -> structured 404
+        conn = http.client.HTTPConnection("127.0.0.1", httpd.port, timeout=10)
+        conn.request("DELETE", "/v1/generate/9999")
+        assert conn.getresponse().status == 404
+        conn.close()
+    finally:
+        httpd.stop()
+
+
+def test_front_door_sse_streams_tokens_then_done():
+    sup, httpd = _httpd({"w0": {"health": "healthy", "slot": 0}})
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", httpd.port, timeout=10)
+        conn.request(
+            "POST",
+            "/v1/generate",
+            body=json.dumps({"prompt": [1], "max_new_tokens": 2, "stream": True}),
+        )
+        r = conn.getresponse()
+        assert r.getheader("Content-Type", "").startswith("text/event-stream")
+        raw = r.read().decode()
+        conn.close()
+        events = [
+            (lines[0].split(": ", 1)[1], json.loads(lines[1].split(": ", 1)[1]))
+            for block in raw.strip().split("\n\n")
+            if (lines := block.split("\n"))
+        ]
+        kinds = [k for k, _ in events]
+        assert kinds == ["token", "token", "done"]
+        assert [d["token"] for k, d in events if k == "token"] == [5, 6]
+        assert events[-1][1]["state"] == "done"
+    finally:
+        httpd.stop()
+
+
+# --------------------------------------------------------------------- #
+# model-checker drift gates (mirror of test_fleet_rules.py)
+# --------------------------------------------------------------------- #
+
+
+def _real_proc_spec():
+    from accelerate_tpu.analysis.fleet_rules import load_proc_spec
+
+    spec, problems = load_proc_spec(os.path.join(REPO, "accelerate_tpu"))
+    assert spec is not None, f"extraction drifted: {problems}"
+    return spec
+
+
+def test_proc_spec_extracts_from_real_source():
+    spec = _real_proc_spec()
+    assert set(spec.states) == {"spawning", "healthy", "degraded", "quarantined", "dead"}
+    assert spec.kind_target("crash") == "dead"
+    assert spec.kind_target("poison") == "quarantined"
+    assert spec.kind_kv("poison") is False and spec.kind_kv("crash") is True
+    assert spec.respawn_cap_guard and spec.storm_breaker_guard
+    assert spec.sheds_on_zero_routable
+
+
+def test_proc_protocol_real_machine_zero_findings():
+    from accelerate_tpu.analysis.fleet_rules import (
+        PROC_CHAOS_COVERAGE,
+        proc_model_check,
+        proc_protocol_check,
+    )
+
+    findings, report = proc_protocol_check(package_root=os.path.join(REPO, "accelerate_tpu"))
+    assert findings == [], [f.message for f in findings]
+    assert not report.truncated
+    assert report.explored_paths == set(PROC_CHAOS_COVERAGE)
+    # determinism: a re-check explores the identical state space
+    report2 = proc_model_check(_real_proc_spec())
+    assert report2.explored_states == report.explored_states
+
+
+def test_proc_chaos_coverage_pins_real_tests():
+    """Every lifecycle path the checker explores must name a process-level
+    chaos test DEFINED IN THIS FILE — model-checks equal chaos-observes."""
+    import ast
+
+    from accelerate_tpu.analysis.fleet_rules import PROC_CHAOS_COVERAGE
+
+    tree = ast.parse(open(os.path.abspath(__file__)).read())
+    defined = {n.name for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)}
+    for path_key, test in PROC_CHAOS_COVERAGE.items():
+        assert test in defined, f"{path_key} pinned to missing test {test}"
+
+
+def test_seeded_defect_unbounded_respawn_fires():
+    from accelerate_tpu.analysis.fleet_rules import proc_protocol_check
+
+    spec = dataclasses.replace(_real_proc_spec(), respawn_cap_guard=False)
+    findings, report = proc_protocol_check(spec=spec)
+    assert any("respawn-unbounded" in f.message for f in findings)
+    assert all(f.rule == "TPU904" for f in findings)
+
+
+def test_seeded_defect_restart_storm_unchecked_fires():
+    from accelerate_tpu.analysis.fleet_rules import proc_protocol_check
+
+    spec = dataclasses.replace(_real_proc_spec(), storm_breaker_guard=False)
+    findings, _ = proc_protocol_check(spec=spec)
+    assert any("restart-storm-unchecked" in f.message for f in findings)
+
+
+def test_seeded_defect_missing_shed_strands_requests():
+    from accelerate_tpu.analysis.fleet_rules import proc_protocol_check
+
+    spec = dataclasses.replace(_real_proc_spec(), sheds_on_zero_routable=False)
+    findings, _ = proc_protocol_check(spec=spec)
+    assert any("breaker-missing" in f.message for f in findings)
+
+
+def test_seeded_defect_poisoned_kv_shipped_fires():
+    from accelerate_tpu.analysis.fleet_rules import proc_protocol_check
+
+    spec = _real_proc_spec()
+    trusting = tuple(
+        (k, True if k == "poison" else v) for k, v in spec.kv_trust
+    )
+    findings, _ = proc_protocol_check(spec=dataclasses.replace(spec, kv_trust=trusting))
+    assert any("poisoned-kv-shipped" in f.message for f in findings)
+
+
+def test_unpinned_path_is_a_finding():
+    from accelerate_tpu.analysis.fleet_rules import PROC_CHAOS_COVERAGE, proc_protocol_check
+
+    partial = dict(PROC_CHAOS_COVERAGE)
+    partial.pop(("respawn", "storm_breaker"))
+    findings, _ = proc_protocol_check(spec=_real_proc_spec(), chaos_coverage=partial)
+    assert any("storm_breaker" in f.message and "pinned to no" in f.message for f in findings)
+
+
+# --------------------------------------------------------------------- #
+# subprocess fleet harness (slow)
+# --------------------------------------------------------------------- #
+
+PROC_MODEL = {"seq_len": 64, "max_position_embeddings": 64}
+PROC_ENGINE = {"num_slots": 2, "prompt_buckets": [8], "max_len": 64, "tick_block": 2}
+
+
+@pytest.fixture(scope="module")
+def proc_store(tmp_path_factory):
+    """One ExecutableStore shared by every fleet in this module: the
+    first boot compiles, every later worker (including respawns)
+    deserializes — the zero-compile warm-start contract under test."""
+    return str(tmp_path_factory.mktemp("proc_store"))
+
+
+def _cfg(run_dir, store_dir, workers=2, **kw):
+    from accelerate_tpu.serving_proc import ProcConfig
+
+    kw.setdefault("model_kwargs", PROC_MODEL)
+    kw.setdefault("engine", PROC_ENGINE)
+    kw.setdefault("warm_prompt_lens", (4,))
+    kw.setdefault("poll_interval_s", 0.01)
+    kw.setdefault("heartbeat_timeout_s", 15.0)
+    kw.setdefault("shadow_kv", True)
+    return ProcConfig(workers=workers, run_dir=str(run_dir), store_dir=store_dir, **kw)
+
+
+def _boot(cfg):
+    from accelerate_tpu.serving_proc import ProcessSupervisor
+
+    sup = ProcessSupervisor(cfg)
+    sup.start(wait=True)
+    assert any(h["health"] == "healthy" for h in sup.health().values()), sup.health()
+    return sup
+
+
+def _pump_until(sup, cond, timeout_s=120.0, msg=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        sup.pump()
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"pump_until timed out: {msg or cond}")
+
+
+def _drive_all(sup, fids, timeout_s=120.0):
+    """Poll every request to a terminal state; returns (outs, lost)."""
+    from accelerate_tpu.serving_proc import FleetRequestError
+
+    outs, lost = {}, {}
+
+    def done():
+        for f in fids:
+            if f in outs or f in lost:
+                continue
+            try:
+                r = sup.poll(f)
+            except FleetRequestError as e:
+                lost[f] = str(e)
+                continue
+            if r is not None:
+                outs[f] = np.asarray(r)
+        return len(outs) + len(lost) == len(fids)
+
+    _pump_until(sup, done, timeout_s, "requests to finish")
+    return outs, lost
+
+
+def _prompts(n, rng=None, lo=3, hi=9):
+    rng = rng or np.random.default_rng(0)
+    return [[int(x) for x in rng.integers(1, 255, size=int(rng.integers(lo, hi)))] for _ in range(n)]
+
+
+# ---- chaos-coverage-pinned lifecycle tests (names are load-bearing: ---- #
+# ---- PROC_CHAOS_COVERAGE pins each explored path to one of these) ----- #
+
+
+@pytest.mark.slow
+def test_proc_sigkill_failover_completes_on_survivor(tmp_path, proc_store):
+    """(crash, failover) + (respawn, ok): SIGKILL a real worker process
+    mid-decode; its in-flight requests complete on the survivor, nothing
+    is lost, and the slot respawns into a fresh healthy incarnation."""
+    cfg = _cfg(
+        tmp_path, proc_store,
+        chaos={"worker": "w1", "label": "mid_decode", "action": "sigkill", "hits": 4},
+    )
+    sup = _boot(cfg)
+    try:
+        fids = [sup.submit(p, max_new_tokens=16) for p in _prompts(4)]
+        outs, lost = _drive_all(sup, fids)
+        assert not lost and len(outs) == 4
+        acct = sup.failover_accounting()
+        assert acct["failovers"] >= 1 and acct["failovers_lost"] == 0
+        # the killed slot comes back as w1.<n>, healthy, zero compiles
+        _pump_until(
+            sup,
+            lambda: any(
+                s["respawns"] > 0 and s["health"] == "healthy" and s["hello"]
+                for s in sup._slots
+            ),
+            msg="respawn to hello",
+        )
+        re = next(s for s in sup._slots if s["respawns"] > 0)
+        assert re["name"].startswith("w1.")
+        assert re["hello"]["compiles"] == 0 and re["hello"]["deserialized"] > 0
+        # the flight dump written at death holds the kill
+        dump = json.load(open(os.path.join(str(tmp_path), "flight_w1.json")))
+        assert any(e.get("name") == "proc_exit" and e.get("killed") for e in dump["events"])
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_sole_worker_death_lost_not_stranded(tmp_path, proc_store):
+    """(crash, capacity_lost) + (failover, lost_counted) + (capacity_lost,
+    shed) + (respawn, giveup): the only worker dies with no respawn
+    budget — in-flight requests surface as LOST (a structured error, not
+    a hang) and new submits shed at the supervisor edge."""
+    from accelerate_tpu.serving_proc import FleetRequestError
+
+    cfg = _cfg(
+        tmp_path, proc_store, workers=1, max_respawns=0,
+        chaos={"worker": "w0", "label": "mid_decode", "action": "sigkill", "hits": 3},
+    )
+    sup = _boot(cfg)
+    try:
+        fids = [sup.submit(p, max_new_tokens=16) for p in _prompts(2)]
+        outs, lost = _drive_all(sup, fids)
+        assert lost, "sole-worker death must surface as FleetRequestError"
+        summary = sup.summary()
+        assert summary["lost"] == len(lost)
+        assert sup.failover_accounting()["failovers_lost"] == len(lost)
+        # the slot gave up (max_respawns=0) instead of respawn-looping
+        assert any(s["gave_up"] for s in sup._slots)
+        # zero routable capacity -> a fresh submit sheds, never queues
+        fid = sup.submit([1, 2, 3], max_new_tokens=4)
+        _pump_until(sup, lambda: sup._stream(fid)["state"] in ("shed", "lost"), 30)
+        with pytest.raises(FleetRequestError):
+            sup.poll(fid)
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_restart_storm_opens_breaker(tmp_path, proc_store):
+    """(respawn, storm_breaker): correlated kills trip the fleet-wide
+    restart-storm circuit breaker instead of churning respawns forever."""
+    cfg = _cfg(
+        tmp_path, proc_store, workers=2, max_respawns=5,
+        storm_threshold=1, storm_window_s=300.0,
+        respawn_backoff_base_s=0.01, respawn_backoff_max_s=0.05,
+    )
+    sup = _boot(cfg)
+    try:
+        def kill_slot_one():
+            slot = sup._slots[1]
+            if slot["health"] == "healthy" and slot["proc"] is not None:
+                os.kill(slot["proc"].pid, signal.SIGKILL)
+                return True
+            return False
+
+        assert kill_slot_one()
+        # first death schedules respawn #1 (window count 1); wait for the
+        # fresh incarnation, then kill it too -> count >= threshold ->
+        # breaker opens and the slot gives up
+        _pump_until(
+            sup,
+            lambda: sup._slots[1]["respawns"] == 1 and sup._slots[1]["health"] == "healthy",
+            msg="first respawn",
+        )
+        assert kill_slot_one()
+        _pump_until(sup, lambda: sup.summary()["breaker_open"], msg="storm breaker")
+        assert sup._slots[1]["gave_up"]
+        assert sup._slots[1]["respawns"] == 1  # no further attempts
+        # the surviving worker still serves
+        fid = sup.submit([1, 2, 3, 4], max_new_tokens=4)
+        outs, lost = _drive_all(sup, [fid], 60)
+        assert not lost
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_hang_degrades_then_heals(tmp_path, proc_store):
+    """(timeout, degraded) + (degraded, heal): one transport timeout
+    degrades the worker; clean polls heal it back to healthy — no kill,
+    no migration, no respawn."""
+    cfg = _cfg(
+        tmp_path, proc_store, workers=1,
+        heartbeat_timeout_s=0.6, quarantine_after_timeouts=50, heal_after_polls=3,
+        chaos={"worker": "w0", "label": "mid_decode", "action": "hang",
+               "hits": 2, "hang_s": 1.2},
+    )
+    sup = _boot(cfg)
+    try:
+        seen = set()
+
+        def watch():
+            seen.add(sup.health()["w0"]["health"])
+            return "degraded" in seen
+
+        fid = sup.submit([1, 2, 3], max_new_tokens=12)
+        _pump_until(sup, watch, 60, "degraded")
+        _pump_until(sup, lambda: sup.health()["w0"]["health"] == "healthy", 60, "heal")
+        outs, lost = _drive_all(sup, [fid], 60)
+        assert not lost
+        assert sup.summary()["respawns_total"] == 0
+        assert sup.failover_accounting()["failovers"] == 0
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_stall_quarantines_and_respawns(tmp_path, proc_store):
+    """(timeout, quarantine): a hard stall crosses the timeout threshold
+    -> the worker is killed + quarantined, its requests migrate to the
+    survivor, and the slot respawns."""
+    cfg = _cfg(
+        tmp_path, proc_store, workers=2,
+        heartbeat_timeout_s=0.6, quarantine_after_timeouts=2,
+        chaos={"worker": "w1", "label": "mid_decode", "action": "hang",
+               "hits": 3, "hang_s": 30.0},
+    )
+    sup = _boot(cfg)
+    try:
+        fids = [sup.submit(p, max_new_tokens=16) for p in _prompts(4)]
+        outs, lost = _drive_all(sup, fids, 150)
+        assert not lost and len(outs) == 4
+        sup._log.flush()  # the event log buffers; the file is read mid-run
+        log = [
+            json.loads(line)
+            for line in open(os.path.join(str(tmp_path), "events_supervisor.jsonl"))
+        ]
+        states = [e["state"] for e in log if e.get("name") == "proc_health"]
+        assert "quarantined" in states
+        assert sup.summary()["respawns_total"] >= 1
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_sole_worker_stall_lost_not_stranded(tmp_path, proc_store):
+    """(timeout, capacity_lost): the only worker stalls into quarantine
+    with no survivor — requests are lost with a structured reason."""
+    cfg = _cfg(
+        tmp_path, proc_store, workers=1, max_respawns=0,
+        heartbeat_timeout_s=0.6, quarantine_after_timeouts=2,
+        chaos={"worker": "w0", "label": "mid_decode", "action": "hang",
+               "hits": 3, "hang_s": 30.0},
+    )
+    sup = _boot(cfg)
+    try:
+        fids = [sup.submit(p, max_new_tokens=16) for p in _prompts(2)]
+        outs, lost = _drive_all(sup, fids, 150)
+        assert lost and not outs
+        assert sup.health()["w0"]["health"] == "quarantined"
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_poison_quarantines_recompute_only(tmp_path, proc_store):
+    """(poison, quarantine_no_kv): a numerics-poisoned worker is
+    quarantined and its requests migrate WITHOUT their KV snapshots —
+    allow_kv=False forces the recompute path (poisoned cache never
+    ships)."""
+    cfg = _cfg(
+        tmp_path, proc_store, workers=2,
+        chaos={"worker": "w1", "label": "mid_decode", "action": "poison", "hits": 3},
+    )
+    sup = _boot(cfg)
+    try:
+        fids = [sup.submit(p, max_new_tokens=16) for p in _prompts(4)]
+        outs, lost = _drive_all(sup, fids, 150)
+        assert not lost and len(outs) == 4
+        acct = sup.failover_accounting()
+        assert acct["failovers"] >= 1
+        assert acct["failovers_kv"] == 0 and acct["failovers_recompute"] >= 1
+        assert acct["bytes_moved"] == 0
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_sole_worker_poison_lost_not_stranded(tmp_path, proc_store):
+    """(poison, capacity_lost): poison with no survivor — lost, counted,
+    structured."""
+    cfg = _cfg(
+        tmp_path, proc_store, workers=1, max_respawns=0,
+        chaos={"worker": "w0", "label": "mid_decode", "action": "poison", "hits": 3},
+    )
+    sup = _boot(cfg)
+    try:
+        fids = [sup.submit(p, max_new_tokens=16) for p in _prompts(2)]
+        outs, lost = _drive_all(sup, fids, 120)
+        assert lost and not outs
+        assert sup.failover_accounting()["failovers_lost"] == len(lost)
+    finally:
+        sup.shutdown()
+
+
+@pytest.mark.slow
+def test_proc_drain_worker_migrates(tmp_path, proc_store):
+    """(drain, migrate): planned maintenance — drain_worker exports the
+    full in-flight state (KV included), migrates to the survivor, and
+    shuts the process down; every request still completes."""
+    cfg = _cfg(tmp_path, proc_store, workers=2)
+    sup = _boot(cfg)
+    try:
+        # least-outstanding routing only reaches w1 once it is serving —
+        # a slow boot would otherwise send every request to w0
+        _pump_until(
+            sup,
+            lambda: all(h["health"] == "healthy" for h in sup.health().values()),
+            120, "both workers healthy",
+        )
+        fids = [sup.submit(p, max_new_tokens=24) for p in _prompts(4)]
+        _pump_until(
+            sup,
+            lambda: any(len(s["uids"]) > 0 for s in sup._slots if s["name"] == "w1"),
+            60, "w1 to own work",
+        )
+        routed_to_w1 = len(sup._slots[1]["uids"])
+        res = sup.drain_worker("w1")
+        assert res["migrated"] >= routed_to_w1 - 1  # some may have just finished
+        assert sup.health()["w1"]["health"] == "dead"
+        outs, lost = _drive_all(sup, fids, 150)
+        assert not lost and len(outs) == 4
+        assert sup.failover_accounting()["failovers_lost"] == 0
+    finally:
+        sup.shutdown()
+
+
+# ---- zero-compile spin-up + end-to-end front door ---------------------- #
+
+
+@pytest.mark.slow
+def test_fresh_subprocess_zero_compile_spin_up(tmp_path, proc_store):
+    """A fresh worker PROCESS against a warmed store deserializes every
+    executable: hello reports 0 compiles. The first boot of this module
+    may compile; the second boot (same store) must not."""
+    sup = _boot(_cfg(tmp_path / "a", proc_store, workers=1))
+    sup.shutdown()
+    sup = _boot(_cfg(tmp_path / "b", proc_store, workers=1))
+    try:
+        hello = sup._slots[0]["hello"]
+        assert hello["compiles"] == 0, hello
+        assert hello["deserialized"] > 0
+        fid = sup.submit([1, 2, 3, 4], max_new_tokens=8)
+        outs, lost = _drive_all(sup, [fid], 60)
+        assert not lost
+        # steady state stays replay-only on the warmed worker
+        assert sup.health()["w0"]["compiles"] == 0
+    finally:
+        sup.shutdown()
+
+
+def _sse_blocks(resp, n_events, timeout_s=60.0):
+    """Read SSE blocks incrementally from an http.client response."""
+    buf = b""
+    events = []
+    deadline = time.monotonic() + timeout_s
+    while len(events) < n_events and time.monotonic() < deadline:
+        chunk = resp.read1(4096) if hasattr(resp, "read1") else resp.read(1)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            block, buf = buf.split(b"\n\n", 1)
+            lines = block.decode().split("\n")
+            ev = lines[0].split(": ", 1)[1]
+            data = json.loads(lines[1].split(": ", 1)[1])
+            events.append((ev, data))
+    return events
+
+
+@pytest.mark.slow
+def test_serve_end_to_end_http_sse_cancel_drain(tmp_path, proc_store):
+    """``accelerate-tpu serve`` end to end against a real subprocess:
+    HTTP submit, SSE streaming, cancellation, /metrics + /healthz on real
+    liveness, and SIGTERM draining to exit 0."""
+    ready = tmp_path / "ready.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.serve",
+            "--workers", "1", "--run-dir", str(tmp_path / "run"),
+            "--store-dir", proc_store, "--http-port", "0",
+            "--model-kwargs", json.dumps(PROC_MODEL),
+            "--engine-kwargs", json.dumps(PROC_ENGINE),
+            "--ready-file", str(ready), "--max-runtime-s", "300",
+        ],
+        env=env, cwd=REPO,
+        stdout=open(tmp_path / "serve.log", "w"), stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 180
+        while not ready.exists() and time.monotonic() < deadline:
+            assert proc.poll() is None, open(tmp_path / "serve.log").read()
+            time.sleep(0.1)
+        assert ready.exists(), "serve never became ready"
+        port = json.load(open(ready))["http_port"]
+
+        status, body = _get(port, "/healthz")
+        assert status == 200 and json.loads(body)["serving"] is True
+
+        # plain JSON submit waits for the result
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompt": [1, 2, 3, 4], "max_new_tokens": 4}),
+        )
+        r = conn.getresponse()
+        out = json.loads(r.read())
+        conn.close()
+        assert r.status == 200 and out["state"] == "done"
+        assert len(out["final"]) == 8 and out["final"][:4] == [1, 2, 3, 4]
+
+        # SSE stream: token events then done (exact same fleet answer)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompt": [1, 2, 3, 4], "max_new_tokens": 4}),
+            headers={"Accept": "text/event-stream"},
+        )
+        r = conn.getresponse()
+        assert r.getheader("Content-Type", "").startswith("text/event-stream")
+        events = _sse_blocks(r, 5)
+        conn.close()
+        assert [k for k, _ in events] == ["token"] * 4 + ["done"]
+        assert [d["token"] for k, d in events[:4]] == out["final"][4:]
+
+        # cancellation: start a long stream, cancel it from the side
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/generate",
+            body=json.dumps({"prompt": [5, 6, 7], "max_new_tokens": 48, "stream": True}),
+        )
+        r = conn.getresponse()
+        rid = int(r.getheader("X-Request-Id"))
+        c2 = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        c2.request("DELETE", f"/v1/generate/{rid}")
+        assert c2.getresponse().status == 200
+        c2.close()
+        tail = _sse_blocks(r, 64)
+        conn.close()
+        assert tail and tail[-1][0] == "done" and tail[-1][1]["state"] == "cancelled"
+
+        # /metrics speaks prometheus with real per-worker gauges
+        status, body = _get(port, "/metrics")
+        assert status == 200 and b"proc_worker_state" in body
+
+        # SIGTERM drains gracefully: exit 0
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0, open(tmp_path / "serve.log").read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
