@@ -1,7 +1,7 @@
 # Repo quality/test targets (reference analogue: the reference Makefile's
 # quality/style/test tiers).
 
-.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check fleet-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck trace-selfcheck trace-bench ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
+.PHONY: quality style lint lint-sarif divergence flight-check perf-check numerics-check pipe-check fleet-check kernel-check tune-selfcheck tune-bench pipeline-bench telemetry-selfcheck trace-selfcheck trace-bench ft-selfcheck aot-selfcheck test test-slow test-all test-cli check-imports bench dryrun api-docs cache-pack cache-seed
 
 # Persistent XLA compile cache (tests/conftest.py points every run and its
 # subprocess children here). cache-pack snapshots a warm cache into a
@@ -43,6 +43,7 @@ lint:
 	$(MAKE) --no-print-directory tune-selfcheck
 	$(MAKE) --no-print-directory pipe-check
 	$(MAKE) --no-print-directory fleet-check
+	$(MAKE) --no-print-directory kernel-check
 	-$(MAKE) --no-print-directory flight-check
 	-$(MAKE) --no-print-directory telemetry-selfcheck
 	-$(MAKE) --no-print-directory trace-selfcheck
@@ -58,8 +59,8 @@ divergence:
 	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli divergence accelerate_tpu --selfcheck
 
 # Merged SARIF 2.1.0 artifact for GitHub code scanning: the AST,
-# divergence, numerics, pipe, and fleet tiers each contribute one runs[]
-# entry (five runs; scripts/merge_sarif.py's test pins the count).
+# divergence, numerics, pipe, fleet, and kernel tiers each contribute one
+# runs[] entry (six runs; scripts/merge_sarif.py's test pins the count).
 # Findings don't fail this target (make lint is the gate); the artifact
 # is for PR annotation.
 lint-sarif:
@@ -74,7 +75,9 @@ lint-sarif:
 		accelerate_tpu/telemetry/httpd.py accelerate_tpu/telemetry/flightrec.py \
 		accelerate_tpu/telemetry/trace.py accelerate_tpu/serving_proc.py \
 		accelerate_tpu/serving_transport.py --format sarif > .cache/fleet.sarif
-	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif .cache/fleet.sarif -o lint-merged.sarif
+	-env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli kernel-check \
+		examples/by_feature/kernel_check.py::decode_step --format sarif > .cache/kernel.sarif
+	python scripts/merge_sarif.py .cache/lint.sarif .cache/divergence.sarif .cache/numerics.sarif .cache/pipe.sarif .cache/fleet.sarif .cache/kernel.sarif -o lint-merged.sarif
 
 # Static perf tier: prove TPU501-505 fire on their seeded defects, each
 # clean twin stays silent, and the roofline math matches the hand-computed
@@ -151,6 +154,23 @@ fleet-check:
 		accelerate_tpu/telemetry/httpd.py accelerate_tpu/telemetry/flightrec.py \
 		accelerate_tpu/telemetry/trace.py accelerate_tpu/serving_proc.py \
 		accelerate_tpu/serving_transport.py
+
+# Kernel tier (kernelmodel + kernel_rules): prove TPU1001-1006 fire on
+# their seeded defects (VMEM overflow, ragged tile, index-map gap, alias
+# hazard, unregistered call, drifted contract), every clean twin (the
+# shipped reference kernels) stays silent, and the kernel cost math
+# matches the hand-computed reference exactly — then trace the example
+# decode step AND run the AST registration gate over every tree path
+# that issues a pallas_call (ops/ registration is the tracked follow-up;
+# the gate scopes to kernels/ + examples until those contracts land).
+# The gate is STRICT for TPU1001/1003/1005 (an unlowerable block, a
+# garbage output region, an invisible kernel cost) via their error
+# severity; TPU1002/1004/1006 warnings report but pass.
+kernel-check:
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli kernel-check --selfcheck \
+		examples/by_feature/kernel_check.py::decode_step --mesh data=8
+	env JAX_PLATFORMS=cpu python -m accelerate_tpu.commands.cli kernel-check \
+		accelerate_tpu/kernels examples
 
 # Pipeline analyzer A/B on CPU (committed evidence: BENCH_PIPE.json):
 # pipemodel's bubble-adjusted prediction vs StepTelemetry-measured step

@@ -1047,6 +1047,52 @@ class Accelerator:
             self._telemetry.set_static_step_estimate(report.predicted_step_ms)
         return report
 
+    def kernel_check(
+        self,
+        step_fn: Callable,
+        *sample_args,
+        generation: Optional[str] = None,
+        probe: bool = True,
+        ignore=(),
+    ):
+        """Static Pallas kernel analysis of ``step_fn`` against this
+        accelerator's mesh, *before* paying a compile: every
+        ``pl.pallas_call`` site is extracted from the traced jaxpr (grid,
+        BlockSpecs, concretely re-evaluated index maps, in/out aliases)
+        and checked with the TPU10xx rules — per-block VMEM occupancy vs
+        the generation's capacity, MXU/VPU tile alignment, index-map
+        coverage/races, grid-loop-carried alias hazards, and the
+        registered :class:`~accelerate_tpu.kernels.KernelCostSpec`
+        contracts (an unregistered call is TPU1005 error-severity; a
+        declaration drifting from the interpret-mode count is TPU1006).
+        On CPU the kernels are also executed in Pallas interpret mode as
+        a finiteness probe.
+
+        Same calling convention as :meth:`flight_check`; returns a
+        :class:`~accelerate_tpu.analysis.KernelReport`
+        (``.render_text()`` / ``.as_dict()``). Error-severity findings
+        are logged. See ``docs/usage_guides/kernels.md`` and
+        ``docs/usage_guides/static_analysis.md``.
+        """
+        from .analysis import render_text
+        from .analysis.kernelmodel import kernel_check as _kernel_check
+
+        report = _kernel_check(
+            step_fn,
+            *sample_args,
+            mesh=self.mesh,
+            generation=generation,
+            probe=probe,
+            ignore=ignore,
+        )
+        if not report.ok:
+            logger.warning(
+                "kernel-check found issues in %s:\n%s",
+                getattr(step_fn, "__name__", "step_fn"),
+                render_text(report.findings),
+            )
+        return report
+
     def numerics_check(
         self,
         step_fn: Callable,

@@ -77,11 +77,28 @@ Three analysis tiers behind one rule registry (``rules.RULES``, stable
   the PR-15 invariants (no stranded requests, poisoned KV never ships,
   the capacity breaker trips iff the last serving replica leaves),
   every explored failure path pinned to a ``ReplicaChaos`` test.
+* **kernel tier** (``kernel_check``) — the Pallas kernel analyzer
+  (``kernelmodel`` + ``kernel_rules``): extract every ``pl.pallas_call``
+  site from the traced jaxpr (grid, BlockSpecs, concretely re-evaluated
+  index maps, in/out aliases), check per-block VMEM occupancy against
+  the generation's ``VMEM_KB_TABLE``, MXU/VPU tile alignment,
+  index-map coverage/races and grid-loop-carried alias hazards
+  (TPU1001–1004), and enforce the registered
+  :class:`~accelerate_tpu.kernels.contracts.KernelCostSpec` cost
+  contracts: an unregistered call is TPU1005 (error — perfmodel prices
+  it at zero FLOPs, flight-check at zero bytes, numerics goes to ⊤), a
+  declaration drifting from the interpret-mode jaxpr-walk count beyond
+  tolerance is TPU1006. Registered contracts feed the OTHER tiers:
+  perfmodel rooflines the declared FLOPs/bytes, flight-check charges
+  the declared VMEM peak as the call's transient, numerics maps operand
+  intervals through the declared transfer, and the tuner refuses to
+  rank a candidate whose roofline is missing a kernel's cost.
 
 Surfaced as ``accelerate-tpu lint`` / ``accelerate-tpu flight-check`` /
 ``accelerate-tpu divergence`` / ``accelerate-tpu perf-check`` /
 ``accelerate-tpu numerics-check`` / ``accelerate-tpu tune`` /
-``accelerate-tpu pipe-check`` / ``accelerate-tpu fleet-check``
+``accelerate-tpu pipe-check`` / ``accelerate-tpu fleet-check`` /
+``accelerate-tpu kernel-check``
 (commands/)
 and ``Accelerator.lint`` / ``Accelerator.flight_check`` /
 ``Accelerator.perf_check`` / ``Accelerator.numerics_check`` /
@@ -108,6 +125,8 @@ from .fleet_rules import (
 from .flightcheck import FlightReport, LiveBuffer, estimate_peak_hbm, flight_check
 from .hostsim import host_check_file, host_check_paths, host_check_source
 from .jaxpr_lint import lint_step
+from .kernel_rules import check_kernel_rules
+from .kernelmodel import KernelReport, KernelSite, extract_kernel_sites, kernel_check, scan_paths
 from .numerics import AbsVal, Interval, NumericsInterpreter, NumericsReport, numerics_check
 from .numerics_rules import COMPRESSION_NUMERICS, check_key_reuse_source, check_numerics_rules
 from .perf_rules import check_perf_rules
@@ -130,6 +149,7 @@ from .searchspace import (
 from .selfcheck import (
     run_divergence_selfcheck,
     run_fleet_selfcheck,
+    run_kernel_selfcheck,
     run_numerics_selfcheck,
     run_perf_selfcheck,
     run_pipe_selfcheck,
@@ -179,6 +199,13 @@ __all__ = [
     "run_tune_selfcheck",
     "run_pipe_selfcheck",
     "run_fleet_selfcheck",
+    "run_kernel_selfcheck",
+    "kernel_check",
+    "scan_paths",
+    "extract_kernel_sites",
+    "check_kernel_rules",
+    "KernelReport",
+    "KernelSite",
     "host_check_source",
     "host_check_file",
     "host_check_paths",

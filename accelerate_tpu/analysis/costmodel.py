@@ -74,6 +74,20 @@ HBM_BW_TABLE: dict[str, float] = {
 #: share, fixture row.)
 HBM_GB_TABLE: dict[str, float] = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0, "cpu": 16.0}
 
+#: Per-core VMEM capacity (KiB) by generation — the on-chip vector memory
+#: every ``pl.pallas_call`` block must fit in (double-buffered while the
+#: grid pipeline is running). Published Pallas figures: ~16 MiB/core on
+#: v4, ~128 MiB on v5e/v5p/v6e. The ``cpu`` row is a deliberately SMALL
+#: nominal fixture (512 KiB) so kernel-check selfcheck fixtures can
+#: overflow it with tiny deterministic blocks under ``JAX_PLATFORMS=cpu``.
+VMEM_KB_TABLE: dict[str, float] = {
+    "v4": 16384.0,
+    "v5e": 131072.0,
+    "v5p": 131072.0,
+    "v6e": 131072.0,
+    "cpu": 512.0,
+}
+
 
 def device_generation(device=None) -> Optional[str]:
     """Map a jax device (default: the first local device of an
@@ -117,6 +131,12 @@ def hbm_bandwidth(generation: str) -> float:
     """HBM bytes/second per device for ``generation`` (v5e fallback for
     unknown generations, explicit ``cpu`` row for the host backend)."""
     return HBM_BW_TABLE.get(generation, HBM_BW_TABLE["v5e"])
+
+
+def vmem_bytes(generation: str) -> int:
+    """Per-core VMEM capacity in bytes for ``generation`` (v5e fallback
+    for unknown generations, explicit nominal ``cpu`` fixture row)."""
+    return int(VMEM_KB_TABLE.get(generation, VMEM_KB_TABLE["v5e"]) * 1024)
 
 #: Collectives the traffic walk prices. Maps primitive name -> wire-bytes
 #: multiplier ``f(n)`` applied to the (per-device) operand bytes ``B`` for

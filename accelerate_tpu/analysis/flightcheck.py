@@ -308,7 +308,33 @@ def _jaxpr_transient_peak(jaxpr) -> int:
 def _sub_transient_bytes(eqn) -> int:
     """Per-device transient of an opaque call eqn (pjit/shard_map body,
     control flow branches): the largest nested liveness peak. Sharding
-    inside the body is not modelled — the bound is conservative (high)."""
+    inside the body is not modelled — the bound is conservative (high).
+
+    A ``pallas_call`` is the exception: its body jaxpr holds ref-typed
+    VMEM views the generic walk would misprice as HBM intermediates, so
+    the transient is the registered
+    :class:`~accelerate_tpu.kernels.contracts.KernelCostSpec`'s declared
+    VMEM peak instead — and ZERO (with a one-time ``UnknownOpWarning``)
+    when the kernel carries no contract."""
+    if eqn.primitive.name == "pallas_call":
+        from ..kernels.contracts import (
+            eqn_kernel_name,
+            pallas_in_avals,
+            registered_spec,
+            warn_unknown_op,
+        )
+
+        kname = eqn_kernel_name(eqn.params) or "<pallas_call>"
+        spec = registered_spec(kname)
+        if spec is not None:
+            try:
+                return int(spec.vmem_peak_bytes(*pallas_in_avals(eqn.params)))
+            except Exception:
+                pass
+        warn_unknown_op(
+            "flight-check", f"pallas_call:{kname}", "transient working-set bytes"
+        )
+        return 0
     extra = 0
     for sub in _iter_subjaxprs(eqn.params):
         extra = max(extra, _jaxpr_transient_peak(sub))

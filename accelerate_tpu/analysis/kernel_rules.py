@@ -1,0 +1,287 @@
+"""TPU10xx: the Pallas kernel rules over extracted
+:class:`~accelerate_tpu.analysis.kernelmodel.KernelSite` records.
+
+Six rules, each either provable from the call's own metadata (grid,
+BlockSpecs, concretely-evaluated index maps, aliases) or a contract
+check against the registered
+:class:`~accelerate_tpu.kernels.contracts.KernelCostSpec`:
+
+* ``TPU1001`` (error) — VMEM occupancy: the double-buffered in/out block
+  working set exceeds the generation's
+  :data:`~accelerate_tpu.analysis.costmodel.VMEM_KB_TABLE` capacity.
+  Priced: occupancy vs capacity and the overflow factor.
+* ``TPU1002`` — block tile misaligned to the MXU lane (last dim ÷128) /
+  VPU sublane (second-to-last ÷ the dtype's
+  :data:`~accelerate_tpu.analysis.perfmodel.SUBLANE` count — the TPU501
+  pacing tables). Priced: the padded-fraction waste of every block.
+* ``TPU1003`` (error) — index-map coverage/overlap, proven by evaluating
+  the output index map at every grid step: an output block never written
+  is garbage; one revisited from *non-consecutive* steps is a write race
+  (consecutive revisits are the legal accumulation pattern — flash
+  attention's k-innermost grid).
+* ``TPU1004`` — alias hazard: an input/output-aliased operand whose
+  input and output index maps disagree at some grid step reads a
+  partially-overwritten buffer (the grid-loop-carried RAW hazard).
+* ``TPU1005`` (error) — no registered contract: the call is invisible to
+  perfmodel/flight-check/numerics, so blindness fails the lint.
+* ``TPU1006`` — contract drift: the declaration disagrees with the
+  interpret-mode jaxpr-walk count beyond the spec's tolerance.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence
+
+from .kernelmodel import (
+    MAX_ENUMERATED_GRID,
+    BlockInfo,
+    KernelSite,
+    counted_cost,
+    vmem_occupancy_bytes,
+)
+from .rules import Finding
+
+
+def _human(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} PB"
+
+
+def _anchor(site: KernelSite) -> dict:
+    return {"path": site.path, "line": site.line}
+
+
+def check_vmem_overflow(site: KernelSite, generation: str) -> list[Finding]:
+    """TPU1001: the per-step block working set must fit VMEM."""
+    from .costmodel import vmem_bytes
+
+    occ = vmem_occupancy_bytes(site)
+    cap = vmem_bytes(generation)
+    if occ <= cap:
+        return []
+    return [
+        Finding(
+            "TPU1001",
+            f"kernel `{site.kernel_name}`{site.location}: VMEM occupancy "
+            f"{_human(occ)} (in/out blocks double-buffered over a "
+            f"{site.grid_steps}-step grid) exceeds {generation} VMEM "
+            f"{_human(cap)} — {occ / cap:.1f}x over; shrink the block shapes "
+            "or split the grid finer",
+            **_anchor(site),
+        )
+    ]
+
+
+def _pad_up(v: int, m: int) -> int:
+    return -(-int(v) // m) * m
+
+
+def check_tile_alignment(site: KernelSite) -> list[Finding]:
+    """TPU1002: last dim ÷ MXU lane, second-to-last ÷ the dtype sublane."""
+    from .perfmodel import MXU_LANE, SUBLANE
+
+    findings = []
+    for block in site.in_blocks + site.out_blocks:
+        dims = [int(b) for b in block.block_shape if b]
+        if len(dims) < 2 or block.block_bytes == 0:
+            continue
+        sublane = SUBLANE.get(block.dtype, 8)
+        lane_pad = _pad_up(dims[-1], MXU_LANE)
+        sub_pad = _pad_up(dims[-2], sublane)
+        if lane_pad == dims[-1] and sub_pad == dims[-2]:
+            continue
+        numel = 1
+        for d in dims:
+            numel *= d
+        padded = numel // dims[-1] // dims[-2] * lane_pad * sub_pad
+        waste = 1.0 - numel / padded
+        findings.append(
+            Finding(
+                "TPU1002",
+                f"kernel `{site.kernel_name}`{site.location}: block "
+                f"{block.origin or 'operand'} {tuple(dims)} misaligned to the "
+                f"{sublane}x{MXU_LANE} {block.dtype} tile — padded to "
+                f"({sub_pad}, {lane_pad}) trailing dims, {waste:.0%} of every "
+                "block is wasted bandwidth and MACs",
+                **_anchor(site),
+            )
+        )
+    return findings
+
+
+def _enumerable(site: KernelSite) -> bool:
+    return (
+        bool(site.grid)
+        and not site.dynamic_index_maps
+        and 0 < site.grid_steps <= MAX_ENUMERATED_GRID
+    )
+
+
+def _block_trajectory(block: BlockInfo, grid) -> Optional[list[tuple]]:
+    """The block index the map selects at each grid step, in TPU grid
+    iteration order (row-major, last grid dim innermost); None when the
+    map cannot be evaluated concretely."""
+    if block.index_map is None:
+        return None
+    try:
+        return [
+            block.index_map(*pt)
+            for pt in itertools.product(*(range(int(g)) for g in grid))
+        ]
+    except Exception:
+        return None
+
+
+def check_index_map_coverage(site: KernelSite) -> list[Finding]:
+    """TPU1003: every output block written exactly once — or revisited
+    only from consecutive grid steps (legal accumulation)."""
+    if not _enumerable(site):
+        return []
+    findings = []
+    for block in site.out_blocks:
+        seq = _block_trajectory(block, site.grid)
+        if seq is None:
+            continue
+        expected = set(itertools.product(*(range(n) for n in block.blocks_per_dim())))
+        written: dict[tuple, list[int]] = {}
+        for step, idx in enumerate(seq):
+            written.setdefault(idx, []).append(step)
+        uncovered = sorted(expected - set(written))
+        if uncovered:
+            sample = ", ".join(str(u) for u in uncovered[:3])
+            findings.append(
+                Finding(
+                    "TPU1003",
+                    f"kernel `{site.kernel_name}`{site.location}: output "
+                    f"{block.origin or 'block'} index map leaves "
+                    f"{len(uncovered)} of {len(expected)} output block(s) "
+                    f"unwritten (e.g. {sample}) — those regions are garbage; "
+                    "the map must cover ceil(shape/block) on every dim",
+                    **_anchor(site),
+                )
+            )
+        races = {
+            idx: steps
+            for idx, steps in written.items()
+            if len(steps) > 1 and steps[-1] - steps[0] != len(steps) - 1
+        }
+        if races:
+            idx, steps = sorted(races.items())[0]
+            findings.append(
+                Finding(
+                    "TPU1003",
+                    f"kernel `{site.kernel_name}`{site.location}: output block "
+                    f"{idx} is written at non-consecutive grid steps "
+                    f"{steps[:4]} — a write race under the pipelined grid "
+                    "(consecutive revisits are the legal accumulation "
+                    "pattern; reorder the grid so revisits are innermost)",
+                    **_anchor(site),
+                )
+            )
+    return findings
+
+
+def check_alias_hazard(site: KernelSite) -> list[Finding]:
+    """TPU1004: aliased in/out index maps must agree at every step."""
+    if not _enumerable(site) or not site.io_aliases:
+        return []
+    findings = []
+    for in_idx, out_idx in site.io_aliases:
+        if in_idx >= len(site.in_blocks) or out_idx >= len(site.out_blocks):
+            continue
+        in_seq = _block_trajectory(site.in_blocks[in_idx], site.grid)
+        out_seq = _block_trajectory(site.out_blocks[out_idx], site.grid)
+        if in_seq is None or out_seq is None:
+            continue
+        for step, (i, o) in enumerate(zip(in_seq, out_seq)):
+            if i != o:
+                findings.append(
+                    Finding(
+                        "TPU1004",
+                        f"kernel `{site.kernel_name}`{site.location}: operand "
+                        f"{in_idx} is aliased to output {out_idx} but their "
+                        f"index maps disagree at grid step {step} (reads "
+                        f"block {i}, writes block {o}) — the read can observe "
+                        "a block an earlier grid step already overwrote "
+                        "in place; aliased operands need identical maps",
+                        **_anchor(site),
+                    )
+                )
+                break
+    return findings
+
+
+def check_unregistered(site: KernelSite) -> list[Finding]:
+    """TPU1005: every pallas call in a checked program carries a contract."""
+    if site.spec is not None:
+        return []
+    return [
+        Finding(
+            "TPU1005",
+            f"pallas call of `{site.kernel_name}`{site.location} has no "
+            "registered KernelCostSpec — perfmodel prices it at zero FLOPs, "
+            "flight-check at zero bytes, numerics goes to ⊤ through it; "
+            "register a contract with accelerate_tpu.kernels.kernel_cost",
+            **_anchor(site),
+        )
+    ]
+
+
+def check_cost_drift(site: KernelSite) -> list[Finding]:
+    """TPU1006: the declaration must agree with the interpret-mode count."""
+    spec = site.spec
+    if spec is None or site.inner_jaxpr is None:
+        return []
+    counted_flops, counted_hbm = counted_cost(site)
+    try:
+        declared_flops = float(spec.flops(*site.in_avals)) * site.count
+        declared_hbm = float(spec.hbm_bytes(*site.in_avals)) * site.count
+    except Exception as e:
+        return [
+            Finding(
+                "TPU1006",
+                f"kernel `{site.kernel_name}`{site.location}: registered "
+                f"KernelCostSpec raised {type(e).__name__}: {e} on these "
+                "operand avals — the contract cannot price this call",
+                **_anchor(site),
+            )
+        ]
+    findings = []
+    for label, declared, counted in (
+        ("FLOPs", declared_flops, counted_flops),
+        ("HBM bytes", declared_hbm, counted_hbm),
+    ):
+        rel = abs(declared - counted) / max(float(counted), 1.0)
+        if rel > spec.tolerance:
+            findings.append(
+                Finding(
+                    "TPU1006",
+                    f"kernel `{site.kernel_name}`{site.location}: declared "
+                    f"{label} {declared:.4g} vs interpret-mode count "
+                    f"{counted:.4g} — {rel:.0%} drift (tolerance "
+                    f"{spec.tolerance:.0%}); the contract no longer "
+                    "describes the kernel",
+                    **_anchor(site),
+                )
+            )
+    return findings
+
+
+def check_kernel_rules(
+    sites: Sequence[KernelSite], *, generation: str = "v5e"
+) -> list[Finding]:
+    """All six TPU10xx rules over every extracted site, program order."""
+    findings: list[Finding] = []
+    for site in sites:
+        findings += check_vmem_overflow(site, generation)
+        findings += check_tile_alignment(site)
+        findings += check_index_map_coverage(site)
+        findings += check_alias_hazard(site)
+        findings += check_unregistered(site)
+        findings += check_cost_drift(site)
+    return findings

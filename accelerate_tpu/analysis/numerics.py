@@ -818,6 +818,22 @@ class NumericsInterpreter:
         if name == "mul_add":  # fused a*b+c on some backends
             c = in_vals[2] if len(in_vals) > 2 else AbsVal()
             return out(_corners(_corners(a.iv, b.iv, lambda x, y: x * y), c.iv, lambda x, y: x + y))
+        if name == "pallas_call":
+            # a registered KernelCostSpec's interval transfer keeps the
+            # abstract interpretation alive through the opaque call —
+            # map the operand intervals through the declared contract;
+            # anything else (unregistered, no interval, spec error) is ⊤
+            from ..kernels.contracts import eqn_kernel_name, registered_spec
+
+            spec = registered_spec(eqn_kernel_name(eqn.params))
+            if spec is not None and spec.interval is not None:
+                try:
+                    lo, hi = spec.interval([(v.iv.lo, v.iv.hi) for v in in_vals])
+                    known = bool(in_vals) and all(v.iv.known for v in in_vals)
+                    return out(Interval(float(lo), float(hi), known))
+                except Exception:
+                    pass
+            return out(TOP)
         # unmodelled primitive: nothing proven about the value
         return out(TOP)
 

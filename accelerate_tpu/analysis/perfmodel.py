@@ -289,6 +289,9 @@ class PerfReport:
     generation: str = "v5e"
     ops: list[OpRecord] = field(default_factory=list)
     findings: list[Finding] = field(default_factory=list)
+    #: kernel names of pallas calls with no registered KernelCostSpec —
+    #: priced at ZERO above; the tuner folds these into TPU1005 findings
+    unpriced: list[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -351,6 +354,7 @@ class PerfReport:
                 "time_by_bound_us": self.time_by_bound(),
             },
             "ops": [o.as_dict() for o in self.ops],
+            "unpriced_kernels": list(self.unpriced),
             "findings": [f.as_dict() for f in self.findings],
         }
 
@@ -376,6 +380,12 @@ class PerfReport:
         )
         if self.mfu_upper_bound is not None:
             lines.append(f"  MFU upper bound       : {self.mfu_upper_bound:.1%}")
+        if self.unpriced:
+            lines.append(
+                "  unpriced pallas calls : "
+                + ", ".join(self.unpriced)
+                + "  (no KernelCostSpec — run `accelerate-tpu kernel-check`)"
+            )
         hot = sorted(self.ops, key=lambda o: -o.time_us)[:top_k]
         if hot:
             lines.append("  hottest ops:")
@@ -441,9 +451,19 @@ def walk_ops(
     in_shardings: Any = None,
     dcn: Optional[Sequence[str]] = None,
     generation: str = "v5e",
+    unpriced: Optional[list] = None,
 ) -> list[OpRecord]:
     """Price every equation of the (unwrapped) jaxpr; see the module
-    docstring for the model. Returns records in program order."""
+    docstring for the model. Returns records in program order.
+
+    A ``pallas_call`` is priced from its registered
+    :class:`~accelerate_tpu.kernels.contracts.KernelCostSpec` (declared
+    FLOPs/HBM bytes on the roofline) — never by walking its body, whose
+    ref-typed equations the nominal model would misprice. An unregistered
+    call costs ZERO: a one-time ``UnknownOpWarning`` names the blindness
+    and the kernel name is appended to ``unpriced`` when a list is
+    passed (``perf_check`` surfaces it on the report; the tuner turns it
+    into TPU1005)."""
     from .flightcheck import _arg_spec_axes, _main_jaxpr
     from .jaxpr_lint import _axis_names_in_params, _iter_subjaxprs, _sharding_axes
 
@@ -508,6 +528,50 @@ def walk_ops(
                             time_us=rec.time_us(generation),
                         )
                     )
+                continue
+            if name == "pallas_call":
+                from ..kernels.contracts import (
+                    eqn_kernel_name,
+                    pallas_in_avals,
+                    registered_spec,
+                    warn_unknown_op,
+                )
+
+                kname = eqn_kernel_name(eqn.params) or "<pallas_call>"
+                spec = registered_spec(kname)
+                work_shard = max(
+                    [shard_of(v) for v in eqn.invars if not _is_literal(v)]
+                    + [shard_of(o) for o in eqn.outvars]
+                    or [1]
+                )
+                flops = hbm = 0
+                if spec is not None:
+                    try:
+                        avals = pallas_in_avals(eqn.params)
+                        flops = int(spec.flops(*avals)) // work_shard
+                        hbm = int(spec.hbm_bytes(*avals)) // work_shard
+                    except Exception:
+                        spec = None  # a spec that cannot price is no spec
+                if spec is None:
+                    warn_unknown_op("perf-check", f"pallas_call:{kname}", "FLOPs / HBM bytes")
+                    if unpriced is not None and kname not in unpriced:
+                        unpriced.append(kname)
+                    continue
+                t_compute = flops / peak_flops(generation, "bf16") * 1e6
+                t_memory = hbm / hbm_bw * 1e6
+                records.append(
+                    OpRecord(
+                        primitive=f"pallas_call:{kname}",
+                        location=_eqn_loc(eqn),
+                        count=multiplier,
+                        flops=flops * multiplier,
+                        hbm_bytes=hbm * multiplier,
+                        wire_bytes=0,
+                        transport=None,
+                        bound=BOUND_COMPUTE if t_compute >= t_memory else BOUND_MEMORY,
+                        time_us=max(t_compute, t_memory) * multiplier,
+                    )
+                )
                 continue
             if subs:
                 sub_mult = multiplier
@@ -628,6 +692,7 @@ def perf_check(
         report.ops = walk_ops(
             closed, sample_args, mesh,
             in_shardings=in_shardings, dcn=dcn, generation=generation,
+            unpriced=report.unpriced,
         )
         if rules:
             from .perf_rules import check_perf_rules

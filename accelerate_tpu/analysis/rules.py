@@ -68,6 +68,22 @@ static_analysis.md for the worked catalogue):
   gate; also fired for an explored failure path with no pinned
   ``ReplicaChaos`` test), and non-daemon threads never joined / worker
   exceptions swallowed (the pre-PR-15 ``drain_threaded`` bug class).
+* ``TPU10xx`` — Pallas kernel rules (``analysis.kernel_rules``) over the
+  ``pl.pallas_call`` sites extracted from the traced program
+  (``analysis.kernelmodel``): per-block VMEM occupancy (with pipeline
+  double-buffering) against the generation's VMEM capacity (error
+  severity — an overflowing kernel cannot be lowered, the strict gate),
+  block tiles misaligned to the MXU/VPU lane-sublane geometry with the
+  padding waste priced, index maps whose concrete evaluation over the
+  grid leaves an output block unwritten or revisits it from
+  non-consecutive steps (error severity — garbage or a write race),
+  input/output aliases whose in/out index maps disagree across the grid
+  (the loop-carried read-after-write hazard), a pallas call with no
+  registered :class:`~accelerate_tpu.kernels.KernelCostSpec` (error
+  severity — an unpriced kernel blinds every roofline, liveness and
+  interval analysis above it, so blindness is a lint failure), and a
+  registered declaration that disagrees with the interpret-mode
+  jaxpr-walk count beyond tolerance (cost-contract drift).
 
 This module is deliberately stdlib-only so ``scripts/check_repo.py`` keeps
 its zero-extra-dependency property and the AST tier can run where jax is
@@ -94,6 +110,7 @@ TIER_NUMERICS = "numerics"
 TIER_CONFIG = "config"
 TIER_PIPE = "pipe"
 TIER_HOST = "host"
+TIER_KERNEL = "kernel"
 
 
 @dataclass(frozen=True)
@@ -166,6 +183,13 @@ RULES: dict[str, Rule] = {
         Rule("TPU903", "blocking-call-under-lock", WARNING, TIER_HOST, "blocking call (join/Queue.get/sleep/block_until_ready/socket recv) while holding a lock — every contender stalls for the full wait"),
         Rule("TPU904", "fleet-protocol-invariant-violated", ERROR, TIER_HOST, "exhaustive exploration of the replica health state machine reaches a state violating a declared invariant (stranded request, poisoned-KV handoff, mistimed capacity breaker) or an unpinned failure path"),
         Rule("TPU905", "unjoined-thread-or-swallowed-worker-error", WARNING, TIER_HOST, "non-daemon thread never joined, or a worker except-clause that drops the exception — the fault is invisible to the fleet"),
+        # -- tier 10: Pallas kernels (analysis.kernelmodel + analysis.kernel_rules)
+        Rule("TPU1001", "kernel-vmem-overflow", ERROR, TIER_KERNEL, "per-step block working set (double-buffered while pipelining) exceeds the generation's VMEM capacity — the kernel cannot be lowered"),
+        Rule("TPU1002", "kernel-tile-misaligned", WARNING, TIER_KERNEL, "block tile misaligned to the MXU lane / VPU sublane geometry — the padded fraction of every block is wasted bandwidth and MACs"),
+        Rule("TPU1003", "kernel-index-map-race-or-gap", ERROR, TIER_KERNEL, "concrete index-map evaluation over the grid leaves an output block unwritten (garbage) or revisits it from non-consecutive steps (write race)"),
+        Rule("TPU1004", "kernel-alias-hazard", WARNING, TIER_KERNEL, "input/output-aliased operand whose input and output index maps disagree at some grid step — the read observes a partially-overwritten buffer"),
+        Rule("TPU1005", "unregistered-pallas-call", ERROR, TIER_KERNEL, "pallas call with no registered KernelCostSpec — perfmodel/flightcheck/numerics are blind to its cost, so the roofline and liveness above it are quietly wrong"),
+        Rule("TPU1006", "kernel-cost-contract-drift", WARNING, TIER_KERNEL, "declared KernelCostSpec disagrees with the interpret-mode jaxpr-walk count beyond tolerance — the contract no longer describes the kernel"),
     )
 }
 

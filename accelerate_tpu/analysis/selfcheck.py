@@ -1140,6 +1140,260 @@ def run_fleet_selfcheck() -> tuple[bool, list[str]]:
     return ok, lines
 
 
+def _kernel_fixtures(mesh):
+    """``rule -> (fn, sample_args, kwargs)`` seeded kernel-tier
+    (TPU10xx) defects, checked through
+    :func:`analysis.kernelmodel.kernel_check` with ``generation="cpu"``
+    (512 KiB VMEM fixture row — small enough that tiny blocks overflow
+    it). Each has a clean twin in :func:`_kernel_clean_fixtures` that
+    must stay silent."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    f32 = jnp.float32
+
+    def copy_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def add_kernel(a_ref, d_ref, o_ref):
+        o_ref[...] = a_ref[...] + d_ref[...]
+
+    def vmem_hog(x):
+        # (512, 512) f32 blocks: 1 MiB in + 1 MiB out, double-buffered
+        # over the 2-step grid = 4 MiB — 8x the cpu fixture's 512 KiB
+        return pl.pallas_call(
+            copy_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((512, 512), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((512, 512), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((1024, 512), f32),
+            interpret=True,
+        )(x)
+
+    def ragged_tile(x):
+        # last dim 100 pads to the 128 MXU lanes: 22% of every block wasted
+        return pl.pallas_call(
+            copy_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 100), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 100), f32),
+            interpret=True,
+        )(x)
+
+    def gapped_map(x):
+        # the out map pins block (0, 0) at every step: block (1, 0) is
+        # never written — the uncovered half of the output is garbage
+        return pl.pallas_call(
+            copy_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), f32),
+            interpret=True,
+        )(x)
+
+    def hazardous_alias(a, d):
+        # operand 0 is aliased to the output but reads block (0, 0) while
+        # the grid writes (i, 0): step 1 reads rows step 0 overwrote
+        return pl.pallas_call(
+            add_kernel,
+            grid=(2,),
+            in_specs=[
+                pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), f32),
+            input_output_aliases={0: 0},
+            interpret=True,
+        )(a, d)
+
+    def unregistered_call(x):
+        return pl.pallas_call(
+            copy_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), f32),
+            interpret=True,
+        )(x)
+
+    def drifting_call(x):
+        # body is a single elementwise mul (counted 2048 FLOPs over the
+        # grid); the fixture registers a spec declaring 3x that
+        return pl.pallas_call(
+            _drifty_spec_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), f32),
+            interpret=True,
+        )(x)
+
+    def _drifty_spec_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    big = jax.ShapeDtypeStruct((1024, 512), f32)
+    ragged = jax.ShapeDtypeStruct((16, 100), f32)
+    tile = jax.ShapeDtypeStruct((16, 128), f32)
+    return {
+        "TPU1001": (vmem_hog, (big,), {}),
+        "TPU1002": (ragged_tile, (ragged,), {}),
+        "TPU1003": (gapped_map, (tile,), {}),
+        "TPU1004": (hazardous_alias, (tile, tile), {}),
+        "TPU1005": (unregistered_call, (tile,), {}),
+        "TPU1006": (drifting_call, (tile,), {}),
+    }, _drifty_spec_kernel
+
+
+def _kernel_clean_fixtures(mesh):
+    """The clean twin per TPU10xx rule: the shipped reference kernels,
+    whose blocks fit the cpu VMEM row, tiles are lane/sublane aligned,
+    index maps cover, aliases agree, and registered contracts match the
+    counted cost exactly — kernel-check must report ZERO findings."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.reference import block_accumulate, block_matmul_softmax
+
+    x = jax.ShapeDtypeStruct((16, 128), jnp.float32)
+    w = jax.ShapeDtypeStruct((128, 128), jnp.float32)
+
+    def clean_softmax(x, w):
+        return block_matmul_softmax(x, w)
+
+    def clean_accumulate(a, d):
+        return block_accumulate(a, d)
+
+    softmax = (clean_softmax, (x, w), {})
+    accumulate = (clean_accumulate, (x, x), {})
+    return {
+        "TPU1001": softmax,
+        "TPU1002": softmax,
+        "TPU1003": softmax,
+        "TPU1004": accumulate,  # aliased in place, maps agree — the legal twin
+        "TPU1005": softmax,
+        "TPU1006": softmax,
+    }
+
+
+def _kernel_reference(mesh) -> tuple[bool, list[str]]:
+    """The executable spec of the kernel cost math: the reference fused
+    block matmul-softmax (B=16, D=128, N=128, 8-row blocks) whose VMEM
+    occupancy / counted FLOPs / HBM bytes are hand-computed here and must
+    match extraction, the registered declaration, AND perfmodel's priced
+    roofline exactly — plus bit-exact f32 interpret parity with the stock
+    lax path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..kernels.reference import block_matmul_softmax
+    from .kernelmodel import counted_cost, kernel_check, vmem_occupancy_bytes
+
+    B, D, N = 16, 128, 128
+    x = jax.ShapeDtypeStruct((B, D), jnp.float32)
+    w = jax.ShapeDtypeStruct((D, N), jnp.float32)
+
+    def decode_step(x, w):
+        return block_matmul_softmax(x, w)
+
+    report = kernel_check(decode_step, x, w, mesh=mesh, generation="cpu", probe=False)
+    site = report.sites[0] if report.sites else None
+    # hand: blocks (8·128 + 128·128 + 8·128)·4 B = 73728 B, double-buffered
+    want_occ = 2 * (8 * D + D * N + 8 * N) * 4  # = 147456
+    # hand: 2·B·D·N MXU + 14·B·N VPU = 524288 + 28672 = 552960 FLOPs;
+    # HBM = per-step blocks × 2 grid steps = 147456 B
+    want_cost = (2 * B * D * N + 14 * B * N, (8 * D + D * N + 8 * N) * 4 * 2)
+    counted = counted_cost(site) if site else (0, 0)
+    declared = (
+        (int(site.spec.flops(*site.in_avals)), int(site.spec.hbm_bytes(*site.in_avals)))
+        if site and site.spec
+        else (0, 0)
+    )
+    perf = perf_check(decode_step, x, w, mesh=mesh, rules=False)
+    xs = jnp.asarray(np.linspace(-1.0, 1.0, B * D, dtype=np.float32).reshape(B, D))
+    ws = jnp.asarray(np.linspace(-0.5, 0.5, D * N, dtype=np.float32).reshape(D, N))
+    parity = bool(
+        jnp.array_equal(block_matmul_softmax(xs, ws), jax.nn.softmax(xs @ ws, axis=-1))
+    )
+    checks = [
+        ("one registered site", site is not None and site.spec is not None),
+        (f"occupancy == {want_occ}", site is not None and vmem_occupancy_bytes(site) == want_occ),
+        (f"counted == {want_cost}", counted == want_cost),
+        ("declared == counted", declared == want_cost),
+        ("perf prices the declaration", perf.total_flops == want_cost[0] and not perf.unpriced),
+        ("zero findings", not report.findings),
+        ("f32 interpret parity bit-exact", parity),
+    ]
+    ok = all(passed for _, passed in checks)
+    lines = [
+        f"[kernel selfcheck] cost reference ({B}x{D}@{D}x{N} softmax, 8-row blocks): "
+        + ("exact" if ok else "MISMATCH: " + ", ".join(name for name, passed in checks if not passed))
+    ]
+    return ok, lines
+
+
+def run_kernel_selfcheck(mesh=None) -> tuple[bool, list[str]]:
+    """Prove TPU1001-TPU1006 each fire on their seeded defect, each clean
+    twin (the shipped reference kernels) yields zero findings, and the
+    kernel cost math matches the hand-computed reference exactly."""
+    if mesh is None:
+        from ..parallel.mesh import MeshConfig
+
+        mesh = MeshConfig().build()
+    from ..kernels.contracts import (
+        KernelCostSpec,
+        register_kernel_cost,
+        unregister_kernel_cost,
+    )
+    from .kernelmodel import kernel_check
+
+    lines: list[str] = []
+    ok = True
+    fixtures, drifty_kernel = _kernel_fixtures(mesh)
+    clean = _kernel_clean_fixtures(mesh)
+    # TPU1006's fixture: a registered contract declaring 3x the counted
+    # FLOPs (HBM declared exactly so only the FLOPs drift fires)
+    register_kernel_cost(
+        KernelCostSpec(
+            name=drifty_kernel.__name__,
+            flops=lambda x: float(3 * 2 * x.shape[0] * x.shape[1]),
+            hbm_bytes=lambda x: float(2 * x.shape[0] * x.shape[1] * 4),
+            vmem_peak_bytes=lambda x: float(2 * 2 * 8 * x.shape[1] * 4),
+            notes="selfcheck fixture: deliberately mis-declared FLOPs",
+        )
+    )
+    try:
+        for rule, (fn, args, kwargs) in sorted(fixtures.items()):
+            report = kernel_check(
+                fn, *args, mesh=mesh, generation="cpu", select=(rule,), probe=False, **kwargs
+            )
+            fired = any(f.rule == rule for f in report.findings)
+            ok &= fired
+            lines.append(
+                f"[kernel selfcheck] {rule} fixture: {'detected' if fired else 'MISSED'}"
+            )
+            cfn, cargs, ckwargs = clean[rule]
+            twin = kernel_check(
+                cfn, *cargs, mesh=mesh, generation="cpu", probe=False, **ckwargs
+            )
+            quiet = not twin.findings
+            ok &= quiet
+            lines.append(
+                f"[kernel selfcheck] {rule} clean twin: "
+                + ("zero findings" if quiet else "DIRTY: " + ", ".join(f.rule for f in twin.findings))
+            )
+    finally:
+        unregister_kernel_cost(drifty_kernel.__name__)
+    ref_ok, ref_lines = _kernel_reference(mesh)
+    ok &= ref_ok
+    lines.extend(ref_lines)
+    return ok, lines
+
+
 def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     """Run every fixture; return ``(ok, report_lines)``. ``ok`` is False
     when any rule failed to fire on its seeded defect."""
@@ -1192,6 +1446,10 @@ def run_selfcheck(mesh=None) -> tuple[bool, list[str]]:
     fleet_ok, fleet_lines = run_fleet_selfcheck()
     ok &= fleet_ok
     lines.extend(fleet_lines)
+
+    kernel_ok, kernel_lines = run_kernel_selfcheck(mesh)
+    ok &= kernel_ok
+    lines.extend(kernel_lines)
 
     # suppression honoured: the TPU201 fixture with an inline disable
     suppressed_src = _AST_FIXTURES["TPU201"].replace(

@@ -534,6 +534,18 @@ def tune(
             continue
         cand.predicted_step_us = perf.predicted_step_us
         cand.mfu_upper_bound = perf.mfu_upper_bound
+        if perf.unpriced:
+            # an unpriced pallas call makes the score itself a lie —
+            # this candidate's roofline is missing the kernel's cost
+            cand.findings.append(
+                Finding(
+                    "TPU1005",
+                    f"candidate scored with unpriced pallas call(s) "
+                    f"{', '.join(sorted(set(perf.unpriced)))} — the roofline "
+                    "ranking misses their FLOPs/bytes; register a "
+                    "KernelCostSpec so tune can price them",
+                )
+            )
         by_bound = perf.time_by_bound()
         cand.bound = max(by_bound, key=by_bound.get) if perf.ops else None
         cand.wire_bytes = perf.total_wire_bytes

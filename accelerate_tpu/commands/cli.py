@@ -14,6 +14,7 @@ from .estimate import estimate_parser
 from .fleet import fleet_parser
 from .fleetcheck import fleetcheck_parser
 from .flightcheck import flightcheck_parser
+from .kernelcheck import kernelcheck_parser
 from .launch import launch_parser
 from .lint import lint_parser
 from .merge import merge_parser
@@ -43,6 +44,7 @@ def main():
     flightcheck_parser(subparsers)
     perfcheck_parser(subparsers)
     pipecheck_parser(subparsers)
+    kernelcheck_parser(subparsers)
     fleetcheck_parser(subparsers)
     numericscheck_parser(subparsers)
     tune_parser(subparsers)

@@ -1,0 +1,182 @@
+"""``accelerate-tpu kernel-check`` — the Pallas kernel static analyzer
++ TPU10xx rules, before any XLA compile.
+
+Two modes sharing one rule set:
+
+* **traced** (``file.py::fn`` or ``pkg.module:fn``, same target/arg
+  conventions as ``flight-check``): trace the step abstractly, extract
+  every ``pl.pallas_call`` site (grid, BlockSpecs, concretely-evaluated
+  index maps, aliases), run TPU1001–1006 — VMEM occupancy vs the
+  generation's capacity, MXU/VPU tile alignment, index-map
+  coverage/races, alias hazards, missing/drifting
+  :class:`~accelerate_tpu.kernels.contracts.KernelCostSpec` contracts —
+  and (on CPU) execute the kernels in Pallas interpret mode as a
+  finiteness probe.
+* **paths** (files/directories, or ``--changed`` for the git diff): the
+  cheap AST registration gate — every ``pl.pallas_call`` call site must
+  name a kernel with a registered contract (TPU1005). This is what keeps
+  an unregistered kernel from ever landing: perfmodel prices it at zero
+  FLOPs, flight-check at zero bytes, numerics goes to ⊤ through it.
+
+Examples::
+
+    accelerate-tpu kernel-check train.py::decode_step --arg "f32[16,128]" --arg "f32[128,128]"
+    accelerate-tpu kernel-check accelerate_tpu/kernels examples   # AST registration gate
+    accelerate-tpu kernel-check --changed                         # only git-touched files
+    accelerate-tpu kernel-check --selfcheck   # prove TPU1001-1006 fire, twins clean, reference exact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def kernelcheck_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "kernel-check",
+            help="Pallas kernel static analysis + registered cost contracts (TPU10xx)",
+        )
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu kernel-check")
+    parser.add_argument(
+        "targets", nargs="*",
+        help="file.py::fn / pkg.module:fn (traced mode) or files/directories (AST registration gate)",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="Gate only git-touched .py files (falls back to the given targets without git)",
+    )
+    parser.add_argument("--arg", action="append", default=[], help="sample arg spec like f32[8,128] (repeatable)")
+    parser.add_argument("--mesh", default=None, help="mesh shape, e.g. data=8 (default: all devices on data)")
+    parser.add_argument(
+        "--generation", default=None,
+        help="TPU generation for the VMEM table (v4/v5e/v5p/v6e/cpu; default: attached backend)",
+    )
+    parser.add_argument("--no-probe", action="store_true", help="Skip the interpret-mode execution probe")
+    parser.add_argument("--format", choices=("text", "json", "sarif"), default=None, help="Report format")
+    parser.add_argument("--select", default=None, help="Comma-separated rule IDs to run (default: all)")
+    parser.add_argument("--ignore", default="", help="Comma-separated rule IDs to skip")
+    parser.add_argument("--strict", action="store_true", help="Exit nonzero on warnings too")
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="Prove TPU1001-1006 fire on seeded defects, clean twins stay silent, reference cost exact",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=kernelcheck_command)
+    return parser
+
+
+def _split_ids(raw):
+    return frozenset(x.strip() for x in (raw or "").split(",") if x.strip())
+
+
+def _selfcheck() -> int:
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)
+    from accelerate_tpu.analysis.selfcheck import run_kernel_selfcheck
+
+    ok, lines = run_kernel_selfcheck()
+    for line in lines:
+        print(line)
+    if not ok:
+        print("kernel-check selfcheck FAILED")
+        return 1
+    return 0
+
+
+def _is_traced_target(target: str) -> bool:
+    if "::" in target:
+        return True
+    return ":" in target and not os.path.exists(target)
+
+
+def kernelcheck_command(args) -> int:
+    if args.selfcheck:
+        rc = _selfcheck()
+        if rc or not (args.targets or args.changed):
+            return rc
+
+    if not args.targets and not args.changed:
+        print(
+            "usage: accelerate-tpu kernel-check file.py::fn [--arg f32[8,128] ...] "
+            "| [paths ...] [--changed] [--selfcheck]"
+        )
+        return 2
+
+    from accelerate_tpu.analysis import exit_code, render_sarif, render_text
+    from accelerate_tpu.analysis.project_config import load_project_config
+
+    cfg = load_project_config()
+    fmt = cfg.resolve_format(args.format)
+    select = cfg.merge_select(_split_ids(args.select) if args.select else None)
+    ignore = cfg.merge_ignore(_split_ids(args.ignore) or frozenset())
+
+    traced = [t for t in args.targets if _is_traced_target(t)]
+    paths = [t for t in args.targets if not _is_traced_target(t)]
+    if args.changed:
+        from accelerate_tpu.analysis.changed import changed_python_files
+
+        scoped = changed_python_files()
+        if scoped is None:
+            import sys
+
+            print(
+                "kernel-check: --changed needs a git work tree; gating the full paths",
+                file=sys.stderr,
+            )
+        else:
+            paths = scoped
+
+    if traced:
+        from .flightcheck import build_mesh, load_step, resolve_sample_args
+
+        from accelerate_tpu.analysis.kernelmodel import kernel_check
+
+        mesh = build_mesh(args.mesh)
+        module, fn = load_step(traced[0])
+        sample_args = resolve_sample_args(module, fn, args.arg)
+        report = kernel_check(
+            fn,
+            *sample_args,
+            mesh=mesh,
+            generation=args.generation,
+            select=select,
+            ignore=tuple(ignore) + tuple(cfg.disable),
+            probe=not args.no_probe,
+        )
+        findings = cfg.apply_suppressions(report.findings)
+        if fmt == "json":
+            print(json.dumps(report.as_dict(), indent=2))
+        elif fmt == "sarif":
+            print(render_sarif(findings))
+        else:
+            print(report.render_text())
+        return exit_code(findings, strict=args.strict)
+
+    from accelerate_tpu.analysis.kernelmodel import scan_paths
+    from accelerate_tpu.analysis.rules import filter_findings
+
+    findings = filter_findings(
+        scan_paths(paths), select=select, ignore=tuple(ignore) + tuple(cfg.disable)
+    )
+    findings = cfg.apply_suppressions(findings)
+    if fmt == "json":
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        print(render_text(findings))
+        print(f"kernel-check: {len(findings)} finding(s) over {len(paths)} path(s)")
+    return exit_code(findings, strict=args.strict)
+
+
+def main():
+    raise SystemExit(kernelcheck_command(kernelcheck_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Contract-bearing Pallas kernels: the registration decorator, the
+:class:`KernelCostSpec` registry the analysis tiers consume, and the
+reference kernels ``accelerate-tpu kernel-check`` verifies itself
+against. See ``docs/usage_guides/kernels.md`` for the contract semantics
+and a worked kernel-check transcript.
+
+The contracts module is stdlib-only and always importable; the reference
+kernels need ``jax.experimental.pallas`` and are exported only when the
+installed jax has it (tests gate on the same condition).
+"""
+
+from .contracts import (
+    KERNEL_REGISTRY,
+    KernelCostSpec,
+    UnknownOpWarning,
+    eqn_kernel_name,
+    kernel_cost,
+    register_kernel_cost,
+    registered_spec,
+    reset_unknown_op_warnings,
+    unregister_kernel_cost,
+    warn_unknown_op,
+)
+
+__all__ = [
+    "KERNEL_REGISTRY",
+    "KernelCostSpec",
+    "UnknownOpWarning",
+    "eqn_kernel_name",
+    "kernel_cost",
+    "register_kernel_cost",
+    "registered_spec",
+    "reset_unknown_op_warnings",
+    "unregister_kernel_cost",
+    "warn_unknown_op",
+]
+
+try:  # the reference kernels need jax.experimental.pallas
+    from .reference import (  # noqa: F401
+        BLOCK_ROWS,
+        block_accumulate,
+        block_accumulate_kernel,
+        block_matmul_softmax,
+        block_matmul_softmax_kernel,
+    )
+
+    __all__ += [
+        "BLOCK_ROWS",
+        "block_accumulate",
+        "block_accumulate_kernel",
+        "block_matmul_softmax",
+        "block_matmul_softmax_kernel",
+    ]
+except ImportError:  # pragma: no cover - jax without pallas
+    pass

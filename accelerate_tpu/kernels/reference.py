@@ -1,0 +1,170 @@
+"""The reference contract-bearing Pallas kernels kernel-check ships.
+
+Two deliberately minimal kernels, each registered with an exact
+:class:`~accelerate_tpu.kernels.contracts.KernelCostSpec`:
+
+* :func:`block_matmul_softmax` — a fused block matmul + row softmax (the
+  decode-step logits shape: ``softmax(x @ w)`` with ``x`` tiled over
+  rows, ``w`` resident per grid step). This is the selfcheck's reference
+  kernel: its declared FLOPs are written to agree with the perfmodel
+  nominal model *exactly* (``2·B·D·N`` MXU + ``14·B·N`` VPU: reduce_max,
+  subtract, exp×10, reduce_sum, divide — one term per inner-jaxpr
+  equation), so TPU1006 drift must read zero, and interpret mode on CPU
+  reproduces the stock ``lax`` path bit-for-bit on f32.
+* :func:`block_accumulate` — an input/output-aliased in-place
+  accumulation (``acc += delta``) whose in/out index maps agree at every
+  grid step: the clean twin for the TPU1004 alias-hazard rule, and the
+  demo of a non-constant interval transfer (``[lo_a+lo_d, hi_a+hi_d]``).
+
+Block geometry is fixed at :data:`BLOCK_ROWS` rows per grid step; the
+registered contracts assume it (a different ``block_rows`` would change
+the HBM re-fetch term — exactly the drift TPU1006 exists to catch).
+
+On non-TPU backends the kernels run in Pallas interpreter mode, which is
+also what the parity tests and ``kernel-check --selfcheck`` exercise
+under ``JAX_PLATFORMS=cpu``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .contracts import kernel_cost
+
+#: rows of the tiled operand each grid step owns (sublane-aligned for f32)
+BLOCK_ROWS = 8
+
+
+def _itemsize(aval) -> int:
+    import numpy as np
+
+    return np.dtype(aval.dtype).itemsize
+
+
+def _softmax_flops(x, w) -> float:
+    """``2·B·D·N`` (dot_general) + ``14·B·N`` VPU — term-for-term the
+    perfmodel nominal count of the kernel body, summed over the grid."""
+    (b, d), n = x.shape, w.shape[1]
+    return 2.0 * b * d * n + 14.0 * b * n
+
+
+def _softmax_hbm_bytes(x, w) -> float:
+    """Per-step block traffic × grid steps: the x row-block and the f32
+    out block stream once, ``w`` is re-fetched every grid step (the
+    naive-pipelining model kernel-check counts)."""
+    (b, d), n = x.shape, w.shape[1]
+    steps = max(1, b // BLOCK_ROWS)
+    per_step = BLOCK_ROWS * d * _itemsize(x) + d * n * _itemsize(w) + BLOCK_ROWS * n * 4
+    return float(per_step * steps)
+
+
+def _softmax_vmem_peak(x, w) -> float:
+    """Double-buffered in/out blocks + the f32 logits intermediate."""
+    (_, d), n = x.shape, w.shape[1]
+    blocks = BLOCK_ROWS * d * _itemsize(x) + d * n * _itemsize(w) + BLOCK_ROWS * n * 4
+    return float(2 * blocks + BLOCK_ROWS * n * 4)
+
+
+@kernel_cost(
+    flops=_softmax_flops,
+    hbm_bytes=_softmax_hbm_bytes,
+    vmem_peak_bytes=_softmax_vmem_peak,
+    interval=lambda ins: (0.0, 1.0),  # row softmax: every output in [0, 1]
+    notes="fused block matmul + row softmax (decode logits step)",
+)
+def block_matmul_softmax_kernel(x_ref, w_ref, o_ref):
+    logits = jnp.dot(x_ref[...], w_ref[...], preferred_element_type=jnp.float32)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)
+    o_ref[...] = e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def block_matmul_softmax(
+    x: jax.Array,  # [B, D]
+    w: jax.Array,  # [D, N]
+    *,
+    block_rows: int = BLOCK_ROWS,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``softmax(x @ w, axis=-1)`` as a row-tiled Pallas kernel: grid
+    step ``i`` loads rows ``[i·block_rows, (i+1)·block_rows)`` of ``x``
+    plus all of ``w`` and writes the matching f32 output rows. ``B`` must
+    divide by ``block_rows``. Bit-equal to the stock lax path on f32
+    (same primitive sequence per row block)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, d = x.shape
+    n = w.shape[1]
+    if b % block_rows:
+        raise ValueError(f"rows {b} not divisible by block_rows {block_rows}")
+    return pl.pallas_call(
+        block_matmul_softmax_kernel,
+        grid=(b // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((d, n), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        interpret=interpret,
+    )(x, w)
+
+
+def _acc_flops(acc, delta) -> float:
+    b, n = acc.shape
+    return float(b * n)  # one add per element
+
+
+def _acc_hbm_bytes(acc, delta) -> float:
+    b, n = acc.shape
+    return float(3 * b * n * _itemsize(acc))  # read acc + delta, write out
+
+
+def _acc_vmem_peak(acc, delta) -> float:
+    n = acc.shape[1]
+    return float(2 * 3 * BLOCK_ROWS * n * _itemsize(acc))  # 3 blocks, double-buffered
+
+
+@kernel_cost(
+    flops=_acc_flops,
+    hbm_bytes=_acc_hbm_bytes,
+    vmem_peak_bytes=_acc_vmem_peak,
+    interval=lambda ins: (ins[0][0] + ins[1][0], ins[0][1] + ins[1][1]),
+    notes="in-place aliased accumulation (matching in/out index maps)",
+)
+def block_accumulate_kernel(a_ref, d_ref, o_ref):
+    o_ref[...] = a_ref[...] + d_ref[...]
+
+
+def block_accumulate(
+    acc: jax.Array,  # [B, N]
+    delta: jax.Array,  # [B, N]
+    *,
+    block_rows: int = BLOCK_ROWS,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``acc + delta`` with ``acc`` input/output-aliased in place — the
+    hazard-free aliasing pattern (identical in/out index maps at every
+    grid step), registered as TPU1004's clean twin."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, n = acc.shape
+    if b % block_rows:
+        raise ValueError(f"rows {b} not divisible by block_rows {block_rows}")
+    row_map = lambda i: (i, 0)  # noqa: E731 — shared by BOTH the aliased in and out specs
+    return pl.pallas_call(
+        block_accumulate_kernel,
+        grid=(b // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, n), row_map),
+            pl.BlockSpec((block_rows, n), row_map),
+        ],
+        out_specs=pl.BlockSpec((block_rows, n), row_map),
+        out_shape=jax.ShapeDtypeStruct((b, n), acc.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(acc, delta)

@@ -1,0 +1,99 @@
+"""Static Pallas kernel analysis before the first compile: extract every
+``pl.pallas_call`` from the traced step, prove the blocks fit VMEM, the
+tiles align to the MXU/VPU geometry, the index maps cover the output
+without races, and the registered ``KernelCostSpec`` contract still
+describes what the kernel body actually does (TPU1001–1006).
+
+Two surfaces on the same decode step:
+
+* ``Accelerator.kernel_check(step_fn, *sample_args)`` — programmatic,
+  against the accelerator's live mesh;
+* ``accelerate-tpu kernel-check examples/by_feature/kernel_check.py::decode_step``
+  — the CLI reads the sample shapes from ``decode_step_sample_args()``
+  below (or pass ``--arg f32[16,128]`` twice).
+
+``decode_step`` uses the shipped :func:`block_matmul_softmax` reference
+kernel, whose contract is exact — zero findings, and perfmodel prices
+the declared 0.55 MFLOP on the roofline instead of the zero it would
+count through an opaque call. The TPU1005 half of the story is shown
+against a throwaway file: ``accelerate-tpu kernel-check <paths>`` (the
+AST registration gate ``--changed`` scopes in CI) errors on any
+``pallas_call`` whose kernel carries no contract, because an unpriced
+kernel silently zeroes the roofline, liveness walk and interval proof
+above it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+BATCH = 16  # decode rows in flight
+WIDTH = 128  # model dim == vocab tile (one MXU lane width)
+
+_UNREGISTERED_SNIPPET = '''\
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def anonymous_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+def step(x):
+    return pl.pallas_call(
+        anonymous_kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )(x)
+'''
+
+
+def decode_step(x, w):
+    """One decode logits step: ``softmax(x @ w)`` through the registered
+    reference kernel (8-row blocks, w resident per grid step)."""
+    from accelerate_tpu.kernels import block_matmul_softmax
+
+    return block_matmul_softmax(x, w)
+
+
+def decode_step_sample_args():
+    """Abstract sample shapes for the CLI (nothing is allocated)."""
+    return (
+        jax.ShapeDtypeStruct((BATCH, WIDTH), jnp.float32),
+        jax.ShapeDtypeStruct((WIDTH, WIDTH), jnp.float32),
+    )
+
+
+def main():
+    from accelerate_tpu.utils.environment import force_host_platform
+
+    force_host_platform(8)  # fake 8-device CPU mesh, same as the test suite
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    report = accelerator.kernel_check(decode_step, *decode_step_sample_args())
+    accelerator.print(report.render_text())
+    assert not report.findings, "the registered reference kernel must be clean"
+
+    perf = accelerator.perf_check(decode_step, *decode_step_sample_args())
+    priced = [o for o in perf.ops if o.primitive.startswith("pallas_call:")]
+    accelerator.print(
+        f"\nperfmodel prices the contract: {priced[0].primitive} at "
+        f"{priced[0].flops / 1e6:.2f} MFLOP (declared, not zero)"
+    )
+
+    # the registration gate: an unregistered kernel is a TPU1005 error
+    import tempfile
+
+    from accelerate_tpu.analysis import render_text
+    from accelerate_tpu.analysis.kernelmodel import scan_paths
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as fh:
+        fh.write(_UNREGISTERED_SNIPPET)
+        path = fh.name
+    findings = scan_paths([path])
+    accelerator.print("\n" + render_text(findings))
+    assert any(f.rule == "TPU1005" for f in findings), "seeded TPU1005 must fire"
+
+
+if __name__ == "__main__":
+    main()

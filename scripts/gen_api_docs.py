@@ -97,9 +97,14 @@ MODULES = [
     "accelerate_tpu.analysis.pipe_rules",
     "accelerate_tpu.analysis.hostsim",
     "accelerate_tpu.analysis.fleet_rules",
+    "accelerate_tpu.analysis.kernelmodel",
+    "accelerate_tpu.analysis.kernel_rules",
     "accelerate_tpu.analysis.changed",
     "accelerate_tpu.analysis.project_config",
     "accelerate_tpu.analysis.report",
+    "accelerate_tpu.kernels",
+    "accelerate_tpu.kernels.contracts",
+    "accelerate_tpu.kernels.reference",
     "accelerate_tpu.telemetry",
     "accelerate_tpu.telemetry.eventlog",
     "accelerate_tpu.telemetry.step",

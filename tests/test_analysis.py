@@ -381,6 +381,7 @@ def test_registry_ids_are_stable():
         "TPU701", "TPU702", "TPU703", "TPU704", "TPU705",
         "TPU801", "TPU802", "TPU803", "TPU804", "TPU805",
         "TPU901", "TPU902", "TPU903", "TPU904", "TPU905",
+        "TPU1001", "TPU1002", "TPU1003", "TPU1004", "TPU1005", "TPU1006",
     }
     with pytest.raises(ValueError):
         Finding("TPU999", "no such rule")
@@ -435,5 +436,5 @@ def test_repo_tree_is_lint_clean():
 def test_selfcheck_all_rules_fire(mesh8):
     ok, lines = run_selfcheck(mesh8)
     assert ok, "\n".join(lines)
-    assert sum("detected" in line for line in lines) == 44  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf + 6 numerics + 5 config + 5 pipe + 5 fleet
+    assert sum("detected" in line for line in lines) == 50  # 6 AST + 4 jaxpr + 3 flight + 5 divergence + 5 perf + 6 numerics + 5 config + 5 pipe + 5 fleet + 6 kernel
     assert any("clean idiomatic script: zero findings" in line for line in lines)

@@ -885,23 +885,23 @@ def test_fleet_check_changed_scopes_too(tmp_path):
     assert "TPU901" in result.stdout and "old.py" not in result.stdout
 
 
-def test_lint_sarif_merges_five_runs(tmp_path):
+def test_lint_sarif_merges_six_runs(tmp_path):
     """The Makefile's lint-sarif artifact carries one runs[] entry per
-    analysis tier — AST, divergence, numerics, pipe, fleet. Pin the count
-    in the recipe AND prove merge_sarif keeps all five."""
+    analysis tier — AST, divergence, numerics, pipe, fleet, kernel. Pin
+    the count in the recipe AND prove merge_sarif keeps all six."""
     makefile = open(os.path.join(os.path.dirname(__file__), "..", "Makefile")).read()
     recipe = makefile.split("lint-sarif:")[1].split("\n\n")[0]
     inputs = [tok for tok in recipe.split() if tok.startswith(".cache/") and tok.endswith(".sarif")]
     merge_line = next(l for l in recipe.splitlines() if "merge_sarif.py" in l)
     merged_inputs = [t for t in merge_line.split() if t.endswith(".sarif") and t != "lint-merged.sarif"]
-    assert len(merged_inputs) == 5, merged_inputs
-    assert ".cache/fleet.sarif" in merged_inputs and ".cache/pipe.sarif" in merged_inputs
+    assert len(merged_inputs) == 6, merged_inputs
+    assert ".cache/fleet.sarif" in merged_inputs and ".cache/kernel.sarif" in merged_inputs
     assert sorted(set(inputs)) == sorted(merged_inputs)
 
     from accelerate_tpu.analysis import Finding, render_sarif
 
     files = []
-    for i in range(5):
+    for i in range(6):
         p = tmp_path / f"run{i}.sarif"
         p.write_text(render_sarif([Finding("TPU901", f"finding {i}")]))
         files.append(str(p))
@@ -913,7 +913,7 @@ def test_lint_sarif_merges_five_runs(tmp_path):
         capture_output=True, text=True, env=CPU_ENV,
     )
     assert result.returncode == 0, result.stderr
-    assert len(json.loads(merged_path.read_text())["runs"]) == 5
+    assert len(json.loads(merged_path.read_text())["runs"]) == 6
 
 
 # --------------------------------------------------------------------------- #
